@@ -1,0 +1,43 @@
+"""tpu-service-sdk: a TPU-native service-orchestration framework.
+
+A ground-up rebuild of the capabilities of the DC/OS Commons SDK
+(reference: /root/reference, surveyed in SURVEY.md) for TPU fleets:
+
+- declarative YAML ServiceSpecs compiled into plan-driven deployments
+  (deploy / update / recovery / decommission / uninstall as
+  Plan -> Phase -> Step state machines with serial/parallel/canary/
+  dependency rollout strategies),
+- a crash-safe control plane (write-ahead state store, config-diff
+  rolling updates, placement rules, health/readiness checks),
+- a TPU **slice inventory** replacing Mesos resource offers: hosts,
+  chips and ICI torus coordinates are first-class schedulable
+  resources, and placement constraints encode torus adjacency,
+- gang-scheduled multi-host `jax.pjit` pods as the flagship workload
+  (models/, ops/, parallel/ subpackages), rendezvoused through a
+  scheduler-issued coordinator address,
+- an HTTP API + CLI with the reference's verb set, and a no-cluster
+  simulation test harness.
+
+Layer map (mirrors SURVEY.md section 1):
+    storage/        L5  KV persistence (reference: sdk/scheduler .../storage/)
+    state/          L5  task/config/framework state (.../state/)
+    specification/  L4  typed service specs + YAML (.../specification/)
+    plan/           L2  plan engine + strategies (.../scheduler/plan/)
+    offer/          L3  slice snapshots + evaluation + placement (.../offer/)
+    recovery/       L2  failure recovery (.../scheduler/recovery/)
+    decommission/   L2  scale-down plans (.../scheduler/decommission/)
+    uninstall/      L2  teardown plans (.../scheduler/uninstall/)
+    multi/          L2  multi-service multiplexing (.../scheduler/multi/)
+    scheduler/      L2  core scheduler + builder (.../scheduler/)
+    runtime/        L1  event loop, reconciler, task killer (.../framework/)
+    agent/          T1  per-host agent / sandbox bootstrap (sdk/bootstrap/)
+    http/           L6  REST API (.../http/)
+    cli/            T2  operator CLI (cli/)
+    metrics/        X3  counters + Prometheus/StatsD (.../metrics/)
+    debug/          X3  offer-outcome / plan / status trackers (.../debug/)
+    testing/        T3  sim harness + integration helpers (sdk/testing/)
+    models/ ops/ parallel/ utils/   the TPU workload library (new; the
+                    reference has no data plane - SURVEY.md section 2.2)
+"""
+
+__version__ = "0.1.0"

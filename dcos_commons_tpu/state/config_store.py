@@ -1,0 +1,85 @@
+"""ConfigStore: UUID -> serialized ServiceSpec, plus target pointer.
+
+Reference: state/ConfigStore.java — configs are content-addressed by
+UUID; a separate "target" pointer names the config tasks should be
+running.  Config updates store a new UUID then flip the pointer
+(config/DefaultConfigurationUpdater.java:159).
+"""
+
+from __future__ import annotations
+
+import json
+import uuid as uuid_mod
+from typing import Any, Callable, Dict, List, Optional
+
+from dcos_commons_tpu.storage import Persister, PersisterError
+
+
+class ConfigStore:
+    """Stores configs as JSON dicts; the spec layer provides codecs."""
+
+    def __init__(self, persister: Persister, namespace: str = "") -> None:
+        self._persister = persister
+        self._root = f"/{namespace}" if namespace else ""
+
+    def _path(self, leaf: str) -> str:
+        return f"{self._root}/{leaf}"
+
+    def store(self, config: Dict[str, Any]) -> str:
+        config_id = str(uuid_mod.uuid4())
+        self._persister.set(
+            self._path(f"configurations/{config_id}"),
+            json.dumps(config, sort_keys=True).encode("utf-8"),
+        )
+        return config_id
+
+    def fetch(self, config_id: str) -> Optional[Dict[str, Any]]:
+        try:
+            raw = self._persister.get(self._path(f"configurations/{config_id}"))
+        except PersisterError:
+            return None
+        return json.loads(raw.decode("utf-8")) if raw is not None else None
+
+    def list_ids(self) -> List[str]:
+        return self._persister.get_children_or_empty(self._path("configurations"))
+
+    def clear(self, config_id: str) -> None:
+        try:
+            self._persister.recursive_delete(
+                self._path(f"configurations/{config_id}")
+            )
+        except PersisterError:
+            pass
+
+    # -- target pointer ----------------------------------------------
+
+    def set_target_config(self, config_id: str) -> None:
+        self._persister.set(
+            self._path("config-target"), config_id.encode("utf-8")
+        )
+
+    def get_target_config(self) -> Optional[str]:
+        try:
+            raw = self._persister.get(self._path("config-target"))
+        except PersisterError:
+            return None
+        return raw.decode("utf-8") if raw is not None else None
+
+    def fetch_target(self) -> Optional[Dict[str, Any]]:
+        target = self.get_target_config()
+        return self.fetch(target) if target else None
+
+    # -- GC (reference: DefaultConfigurationUpdater cleanup of configs
+    #    no longer referenced by any task) ---------------------------
+
+    def prune(self, referenced_ids: List[str]) -> List[str]:
+        keep = set(referenced_ids)
+        target = self.get_target_config()
+        if target:
+            keep.add(target)
+        removed = []
+        for config_id in self.list_ids():
+            if config_id not in keep:
+                self.clear(config_id)
+                removed.append(config_id)
+        return removed

@@ -1,0 +1,31 @@
+"""PersistentLaunchRecorder: WAL TaskInfos *before* launching.
+
+Reference: state/PersistentLaunchRecorder.java, invoked at
+DefaultScheduler.java:454-455 — every launch recommendation is written
+to the state store before the accept call goes to Mesos, so a
+scheduler crash between "decide" and "launch" resumes with the task
+recorded (and reconciliation then discovers whether it actually
+launched).  This idempotent WAL-before-act discipline is what makes
+the control plane crash-restart safe (SURVEY.md section 7 hard part 1).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from dcos_commons_tpu.common import TaskInfo
+from dcos_commons_tpu.state.state_store import StateStore
+
+
+class PersistentLaunchRecorder:
+    def __init__(self, state_store: StateStore) -> None:
+        self._state_store = state_store
+
+    def record(self, infos: List[TaskInfo]) -> None:
+        """Atomically persist the pod's TaskInfos + seeded STAGING statuses.
+
+        One persister transaction: a crash can never leave a gang launch
+        half-recorded.  The STAGING seed gives reconciliation something
+        to reconcile if the actual launch was lost in the crash.
+        """
+        self._state_store.store_launch(infos)

@@ -1,0 +1,35 @@
+"""L5 storage: pluggable hierarchical KV persistence.
+
+Reference: sdk/scheduler/.../storage/Persister.java:15-99 (interface),
+MemPersister.java (test impl), PersisterCache.java (write-through RAM
+cache), curator/CuratorPersister.java:43-110 (ZooKeeper impl with
+atomic multi-op transactions).
+
+The rebuild keeps the same contract — a hierarchical path->bytes store
+with atomic multi-op transactions — but swaps ZooKeeper for a local
+write-ahead-logged file store (TPU control planes run on the pod's
+admin VM; a single fsync'd WAL is the idiomatic substrate, and the
+interface stays pluggable for etcd later).
+"""
+
+from dcos_commons_tpu.storage.persister import (
+    DeleteOp,
+    MemPersister,
+    Persister,
+    PersisterError,
+    SetOp,
+    StorageError,
+)
+from dcos_commons_tpu.storage.file_persister import FileWalPersister
+from dcos_commons_tpu.storage.cache import PersisterCache
+
+__all__ = [
+    "DeleteOp",
+    "FileWalPersister",
+    "MemPersister",
+    "Persister",
+    "PersisterCache",
+    "PersisterError",
+    "SetOp",
+    "StorageError",
+]

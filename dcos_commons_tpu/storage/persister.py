@@ -1,0 +1,223 @@
+"""Persister contract + in-memory implementation.
+
+Reference: sdk/scheduler/.../storage/Persister.java:15-99 (get/set/
+setMany/getChildren/recursiveDelete/close), MemPersister.java,
+PersisterUtils path helpers.
+"""
+
+from __future__ import annotations
+
+import threading
+from abc import ABC, abstractmethod
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Union
+
+
+class StorageError(Exception):
+    """Base class for storage failures."""
+
+
+class PersisterError(StorageError):
+    """A path was missing or an operation conflicted."""
+
+    def __init__(self, message: str, path: str = ""):
+        super().__init__(message)
+        self.path = path
+
+
+def normalize_path(path: str) -> str:
+    """Canonical form: leading '/', no trailing '/', no empty segments.
+
+    Reference: storage/PersisterUtils.java path math.
+    """
+    parts = [p for p in path.split("/") if p]
+    return "/" + "/".join(parts)
+
+
+def parent_of(path: str) -> str:
+    path = normalize_path(path)
+    head, _, _ = path.rpartition("/")
+    return head or "/"
+
+
+def child_of(path: str, *names: str) -> str:
+    return normalize_path("/".join([path, *names]))
+
+
+@dataclass(frozen=True)
+class SetOp:
+    path: str
+    value: bytes
+
+
+@dataclass(frozen=True)
+class DeleteOp:
+    path: str  # recursive
+
+
+TransactionOp = Union[SetOp, DeleteOp]
+
+
+class Persister(ABC):
+    """Hierarchical path -> bytes store with atomic transactions.
+
+    Intermediate nodes are created implicitly on set (as the reference's
+    CuratorPersister does via creatingParentsIfNeeded) and may hold data
+    themselves.
+    """
+
+    @abstractmethod
+    def get(self, path: str) -> Optional[bytes]:
+        """Value at ``path``; raises PersisterError if path absent."""
+
+    @abstractmethod
+    def set(self, path: str, value: bytes) -> None: ...
+
+    @abstractmethod
+    def get_children(self, path: str) -> List[str]:
+        """Immediate child names (not full paths); PersisterError if absent."""
+
+    @abstractmethod
+    def recursive_delete(self, path: str) -> None:
+        """Delete subtree; PersisterError if absent."""
+
+    @abstractmethod
+    def apply(self, ops: Iterable[TransactionOp]) -> None:
+        """Apply all ops atomically (all-or-nothing).
+
+        Reference: CuratorPersister.java:86-110 atomic multi-op
+        transactions; this is what makes launch WAL + status writes
+        crash-consistent.
+        """
+
+    def close(self) -> None:  # pragma: no cover - trivial default
+        pass
+
+    # convenience -----------------------------------------------------
+
+    def exists(self, path: str) -> bool:
+        try:
+            self.get(path)
+            return True
+        except PersisterError:
+            return False
+
+    def get_children_or_empty(self, path: str) -> List[str]:
+        try:
+            return self.get_children(path)
+        except PersisterError:
+            return []
+
+    def clear_all_data(self) -> None:
+        """Reference: storage/PersisterUtils.java clearAllData (uninstall)."""
+        for child in self.get_children_or_empty("/"):
+            self.recursive_delete("/" + child)
+
+
+class _Node:
+    __slots__ = ("value", "children")
+
+    def __init__(self) -> None:
+        self.value: Optional[bytes] = None
+        self.children: Dict[str, "_Node"] = {}
+
+
+class MemPersister(Persister):
+    """In-memory tree store (reference: storage/MemPersister.java).
+
+    Used by unit tests and the simulation harness exactly as the
+    reference uses MemPersister in place of ZooKeeper.
+    """
+
+    def __init__(self) -> None:
+        self._root = _Node()
+        self._lock = threading.RLock()
+
+    # tree walking ----------------------------------------------------
+
+    def _find(self, path: str) -> Optional[_Node]:
+        node = self._root
+        for part in normalize_path(path).split("/"):
+            if not part:
+                continue
+            node = node.children.get(part)  # type: ignore[assignment]
+            if node is None:
+                return None
+        return node
+
+    def _ensure(self, path: str) -> _Node:
+        node = self._root
+        for part in normalize_path(path).split("/"):
+            if not part:
+                continue
+            node = node.children.setdefault(part, _Node())
+        return node
+
+    # Persister -------------------------------------------------------
+
+    def get(self, path: str) -> Optional[bytes]:
+        with self._lock:
+            node = self._find(path)
+            if node is None:
+                raise PersisterError(f"path not found: {path}", path)
+            return node.value
+
+    def set(self, path: str, value: bytes) -> None:
+        with self._lock:
+            self._ensure(path).value = value
+
+    def ensure_node(self, path: str) -> None:
+        """Create an empty node (tree shape without a value)."""
+        with self._lock:
+            self._ensure(path)
+
+    def get_children(self, path: str) -> List[str]:
+        with self._lock:
+            node = self._find(path)
+            if node is None:
+                raise PersisterError(f"path not found: {path}", path)
+            return sorted(node.children)
+
+    def recursive_delete(self, path: str) -> None:
+        with self._lock:
+            norm = normalize_path(path)
+            if norm == "/":
+                self._root = _Node()
+                return
+            parent = self._find(parent_of(norm))
+            name = norm.rsplit("/", 1)[1]
+            if parent is None or name not in parent.children:
+                raise PersisterError(f"path not found: {path}", path)
+            del parent.children[name]
+
+    def apply(self, ops: Iterable[TransactionOp]) -> None:
+        with self._lock:
+            ops = list(ops)
+            # validate deletes up front so the transaction is all-or-nothing
+            for op in ops:
+                if isinstance(op, DeleteOp) and self._find(op.path) is None:
+                    raise PersisterError(f"path not found: {op.path}", op.path)
+            for op in ops:
+                if isinstance(op, SetOp):
+                    self._ensure(op.path).value = op.value
+                else:
+                    try:
+                        self.recursive_delete(op.path)
+                    except PersisterError:
+                        pass  # deleted by an earlier op in this txn
+
+    # debugging -------------------------------------------------------
+
+    def dump(self) -> Dict[str, Optional[bytes]]:
+        """Flat {path: value} view of the whole tree (tests)."""
+        out: Dict[str, Optional[bytes]] = {}
+
+        def walk(node: _Node, path: str) -> None:
+            for name, child in node.children.items():
+                child_path = f"{path}/{name}" if path != "/" else f"/{name}"
+                out[child_path] = child.value
+                walk(child, child_path)
+
+        with self._lock:
+            walk(self._root, "/")
+        return out

@@ -1,0 +1,17 @@
+"""Test configuration.
+
+JAX tests run on a virtual 8-device CPU mesh so multi-chip sharding
+(dp/tp/sp) is exercised without TPU hardware, mirroring how the
+reference tests multi-node scheduling without a Mesos cluster
+(reference: sdk/testing/ServiceTestRunner.java runs the full scheduler
+against MemPersister + a mocked driver).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,170 @@
+"""State layer tests (mirrors reference StateStoreTest/ConfigStoreTest)."""
+
+import pytest
+
+from dcos_commons_tpu.common import TaskInfo, TaskState, TaskStatus, new_task_id, task_name_of
+from dcos_commons_tpu.state import (
+    ConfigStore,
+    FrameworkStore,
+    GoalStateOverride,
+    OverrideProgress,
+    PersistentLaunchRecorder,
+    SchemaVersionStore,
+    StateStore,
+    StateStoreException,
+)
+from dcos_commons_tpu.storage import MemPersister
+
+
+def make_info(name="hello-0-server", agent="host-0"):
+    return TaskInfo(
+        name=name,
+        task_id=new_task_id(name),
+        agent_id=agent,
+        pod_type="hello",
+        pod_index=0,
+        command="echo hi",
+        env={"FOO": "bar"},
+        tpu_chip_ids=["host-0/chip-0"],
+        labels={"target_configuration": "cfg-1"},
+    )
+
+
+def test_task_id_scheme():
+    tid = new_task_id("hello-0-server")
+    assert task_name_of(tid) == "hello-0-server"
+    with pytest.raises(ValueError):
+        task_name_of("no-separator")
+
+
+def test_task_info_roundtrip():
+    info = make_info()
+    restored = TaskInfo.from_bytes(info.to_bytes())
+    assert restored == info
+
+
+def test_state_store_tasks():
+    store = StateStore(MemPersister())
+    info = make_info()
+    store.store_tasks([info])
+    assert store.fetch_task_names() == ["hello-0-server"]
+    assert store.fetch_task("hello-0-server") == info
+    assert store.fetch_task("missing") is None
+    assert store.fetch_tasks() == [info]
+    store.clear_task("hello-0-server")
+    assert store.fetch_tasks() == []
+
+
+def test_state_store_status_validation():
+    store = StateStore(MemPersister())
+    info = make_info()
+    store.store_tasks([info])
+    status = TaskStatus(task_id=info.task_id, state=TaskState.RUNNING)
+    store.store_status(info.name, status)
+    fetched = store.fetch_status(info.name)
+    assert fetched.state == TaskState.RUNNING
+    assert fetched.state.is_running
+    # stale task-id dropped, not stored (reference: StateStore.java
+    # storeStatus validation; late statuses from old launches are normal)
+    assert not store.store_status(
+        info.name, TaskStatus(task_id="other__123", state=TaskState.FAILED)
+    )
+    assert store.fetch_status(info.name).state == TaskState.RUNNING
+
+
+def test_state_store_rejects_bad_task_names():
+    store = StateStore(MemPersister())
+    with pytest.raises(StateStoreException):
+        store.store_tasks([make_info("evil/name")])
+
+
+def test_store_launch_atomic():
+    store = StateStore(MemPersister())
+    infos = [make_info("p-0-a"), make_info("p-0-b")]
+    store.store_launch(infos)
+    assert store.fetch_status("p-0-a").state == TaskState.STAGING
+    assert store.fetch_task("p-0-b") == infos[1]
+
+
+def test_state_store_namespacing():
+    persister = MemPersister()
+    a = StateStore(persister, namespace="services/svc-a")
+    b = StateStore(persister, namespace="services/svc-b")
+    a.store_tasks([make_info("a-0-node")])
+    b.store_tasks([make_info("b-0-node")])
+    assert a.fetch_task_names() == ["a-0-node"]
+    assert b.fetch_task_names() == ["b-0-node"]
+
+
+def test_goal_override_roundtrip():
+    store = StateStore(MemPersister())
+    assert store.fetch_goal_override("t") == (
+        GoalStateOverride.NONE,
+        OverrideProgress.COMPLETE,
+    )
+    store.store_goal_override("t", GoalStateOverride.PAUSED, OverrideProgress.PENDING)
+    assert store.fetch_goal_override("t") == (
+        GoalStateOverride.PAUSED,
+        OverrideProgress.PENDING,
+    )
+
+
+def test_properties_and_deploy_bit():
+    store = StateStore(MemPersister())
+    store.store_property("suppressed", b"true")
+    assert store.fetch_property("suppressed") == b"true"
+    assert "suppressed" in store.fetch_property_keys()
+    store.clear_property("suppressed")
+    assert store.fetch_property("suppressed") is None
+    with pytest.raises(StateStoreException):
+        store.store_property("bad/key", b"x")
+    assert not store.deployment_was_completed()
+    store.set_deployment_completed()
+    assert store.deployment_was_completed()
+
+
+def test_config_store():
+    cs = ConfigStore(MemPersister())
+    cfg = {"name": "svc", "pods": [{"name": "hello", "count": 1}]}
+    cid = cs.store(cfg)
+    assert cs.fetch(cid) == cfg
+    cs.set_target_config(cid)
+    assert cs.get_target_config() == cid
+    assert cs.fetch_target() == cfg
+    cid2 = cs.store({"name": "svc", "pods": []})
+    cs.set_target_config(cid2)
+    removed = cs.prune(referenced_ids=[])
+    assert removed == [cid]
+    assert cs.fetch(cid) is None
+    assert cs.fetch(cid2) is not None
+
+
+def test_framework_store():
+    fs = FrameworkStore(MemPersister())
+    fid = fs.get_or_create_framework_id()
+    assert fs.get_or_create_framework_id() == fid
+    fs.store_coordinator_address("trainer", "10.0.0.1:8476")
+    assert fs.fetch_coordinator_address("trainer") == "10.0.0.1:8476"
+    assert fs.fetch_coordinator_address("other") is None
+    fs.clear_framework_id()
+    assert fs.fetch_framework_id() is None
+
+
+def test_schema_version():
+    p = MemPersister()
+    svs = SchemaVersionStore(p)
+    svs.check()  # initializes
+    assert svs.fetch() == SchemaVersionStore.CURRENT
+    svs.store(99)
+    with pytest.raises(RuntimeError):
+        SchemaVersionStore(p).check()
+
+
+def test_launch_recorder_seeds_staging():
+    store = StateStore(MemPersister())
+    recorder = PersistentLaunchRecorder(store)
+    infos = [make_info("p-0-a"), make_info("p-0-b")]
+    recorder.record(infos)
+    assert store.fetch_status("p-0-a").state == TaskState.STAGING
+    assert store.fetch_status("p-0-b").state == TaskState.STAGING
+    assert store.fetch_task("p-0-a").task_id == infos[0].task_id

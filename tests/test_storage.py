@@ -1,0 +1,126 @@
+"""Storage layer tests (mirrors reference MemPersisterTest/CuratorPersisterTest)."""
+
+import os
+
+import pytest
+
+from dcos_commons_tpu.storage import (
+    DeleteOp,
+    FileWalPersister,
+    MemPersister,
+    PersisterCache,
+    PersisterError,
+    SetOp,
+)
+
+
+def exercise_basic(p):
+    p.set("/a/b/c", b"hello")
+    assert p.get("/a/b/c") == b"hello"
+    assert p.get("/a/b") is None  # implicit parent, no value
+    assert p.get_children("/a") == ["b"]
+    assert p.get_children("/a/b") == ["c"]
+    p.set("/a/b/d", b"world")
+    assert p.get_children("/a/b") == ["c", "d"]
+    p.recursive_delete("/a/b")
+    with pytest.raises(PersisterError):
+        p.get("/a/b/c")
+    assert p.get_children("/a") == []
+
+
+def test_mem_persister_basic():
+    exercise_basic(MemPersister())
+
+
+def test_mem_persister_missing_paths():
+    p = MemPersister()
+    with pytest.raises(PersisterError):
+        p.get("/nope")
+    with pytest.raises(PersisterError):
+        p.get_children("/nope")
+    with pytest.raises(PersisterError):
+        p.recursive_delete("/nope")
+    assert not p.exists("/nope")
+
+
+def test_mem_persister_transaction():
+    p = MemPersister()
+    p.set("/x", b"1")
+    p.apply([SetOp("/y", b"2"), SetOp("/z", b"3"), DeleteOp("/x")])
+    assert p.get("/y") == b"2"
+    assert not p.exists("/x")
+    # failed transaction leaves no trace
+    with pytest.raises(PersisterError):
+        p.apply([SetOp("/w", b"4"), DeleteOp("/does-not-exist")])
+    assert not p.exists("/w")
+
+
+def test_mem_persister_clear_all():
+    p = MemPersister()
+    p.set("/a/b", b"1")
+    p.set("/c", b"2")
+    p.clear_all_data()
+    assert p.get_children_or_empty("/") == []
+
+
+def test_file_persister_basic(tmp_path):
+    exercise_basic(FileWalPersister(str(tmp_path), fsync=False))
+
+
+def test_file_persister_recovery(tmp_path):
+    p = FileWalPersister(str(tmp_path), fsync=False)
+    p.set("/tasks/pod-0-server/info", b"info-bytes")
+    p.apply([SetOp("/config-target", b"uuid-1"), SetOp("/x", b"y")])
+    p.recursive_delete("/x")
+    p.close()
+
+    p2 = FileWalPersister(str(tmp_path), fsync=False)
+    assert p2.get("/tasks/pod-0-server/info") == b"info-bytes"
+    assert p2.get("/config-target") == b"uuid-1"
+    assert not p2.exists("/x")
+    p2.close()
+
+
+def test_file_persister_torn_tail(tmp_path):
+    """A crash mid-append must not corrupt previously-committed records."""
+    p = FileWalPersister(str(tmp_path), fsync=False)
+    p.set("/good", b"committed")
+    p.close()
+    wal = os.path.join(str(tmp_path), FileWalPersister.WAL)
+    with open(wal, "ab") as f:
+        f.write(b"\xff\xff\xff\xff\x00torn")  # garbage partial record
+
+    p2 = FileWalPersister(str(tmp_path), fsync=False)
+    assert p2.get("/good") == b"committed"
+    p2.set("/after", b"ok")  # appends cleanly after truncation
+    p2.close()
+    p3 = FileWalPersister(str(tmp_path), fsync=False)
+    assert p3.get("/after") == b"ok"
+    p3.close()
+
+
+def test_file_persister_compaction(tmp_path):
+    p = FileWalPersister(str(tmp_path), fsync=False, compact_every=5)
+    for i in range(12):
+        p.set(f"/k{i}", str(i).encode())
+    p.close()
+    p2 = FileWalPersister(str(tmp_path), fsync=False)
+    for i in range(12):
+        assert p2.get(f"/k{i}") == str(i).encode()
+    # snapshot exists and WAL was truncated at the last compaction
+    assert os.path.exists(os.path.join(str(tmp_path), FileWalPersister.SNAPSHOT))
+    p2.close()
+
+
+def test_persister_cache_write_through(tmp_path):
+    backend = FileWalPersister(str(tmp_path), fsync=False)
+    cache = PersisterCache(backend)
+    cache.set("/a", b"1")
+    assert cache.get("/a") == b"1"
+    assert backend.get("/a") == b"1"
+    cache.close()
+    # reload: cache warms from backend
+    backend2 = FileWalPersister(str(tmp_path), fsync=False)
+    cache2 = PersisterCache(backend2)
+    assert cache2.get("/a") == b"1"
+    cache2.close()
