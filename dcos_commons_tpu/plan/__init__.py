@@ -1,0 +1,55 @@
+"""L2 plan engine: Plan -> Phase -> Step state machines + strategies.
+
+Reference: sdk/scheduler/.../scheduler/plan/ — Element.java:18,
+Plan.java:23, Phase.java:12, Step.java:15, Status.java:23-78,
+DefaultPlanCoordinator.java:33-90, PlanScheduler.java:50-100,
+DeploymentStep.java:122-193, strategy/ (SerialStrategy,
+ParallelStrategy, CanaryStrategy.java:30-58, DependencyStrategy,
+RandomStrategy), backoff/ExponentialBackoff.java:30-50.
+"""
+
+from dcos_commons_tpu.plan.status import Status
+from dcos_commons_tpu.plan.element import Element
+from dcos_commons_tpu.plan.step import DeploymentStep, PodInstanceRequirement, RecoveryType, Step
+from dcos_commons_tpu.plan.phase import Phase
+from dcos_commons_tpu.plan.plan import Plan
+from dcos_commons_tpu.plan.strategy import (
+    CanaryStrategy,
+    DependencyStrategy,
+    ParallelStrategy,
+    RandomStrategy,
+    SerialStrategy,
+    Strategy,
+    strategy_for_name,
+)
+from dcos_commons_tpu.plan.backoff import Backoff, DisabledBackoff, ExponentialBackoff
+from dcos_commons_tpu.plan.plan_manager import DefaultPlanManager, PlanManager
+from dcos_commons_tpu.plan.coordinator import DefaultPlanCoordinator
+from dcos_commons_tpu.plan.builders import DeployPlanFactory
+from dcos_commons_tpu.plan.generator import PlanGenerator
+
+__all__ = [
+    "Backoff",
+    "CanaryStrategy",
+    "DefaultPlanCoordinator",
+    "DefaultPlanManager",
+    "DependencyStrategy",
+    "DeployPlanFactory",
+    "DeploymentStep",
+    "DisabledBackoff",
+    "Element",
+    "ExponentialBackoff",
+    "ParallelStrategy",
+    "Phase",
+    "Plan",
+    "PlanGenerator",
+    "PlanManager",
+    "PodInstanceRequirement",
+    "RandomStrategy",
+    "RecoveryType",
+    "SerialStrategy",
+    "Status",
+    "Step",
+    "Strategy",
+    "strategy_for_name",
+]

@@ -1,0 +1,102 @@
+"""PlanGenerator: the YAML ``plans:`` section -> Plan objects.
+
+Reference: specification/PlanGenerator.java + yaml RawPlan/RawPhase
+(specification/yaml/RawServiceSpec beans).  YAML shape:
+
+    plans:
+      deploy:
+        strategy: serial
+        phases:
+          first-phase:
+            strategy: parallel
+            pod: hello
+            steps:            # optional explicit per-instance steps
+              - 0: [[task-a, task-b]]
+              - 1: [[task-a]]
+
+Without ``steps`` a phase covers every instance of the pod with every
+task (gang pods: one step for the whole slice).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from dcos_commons_tpu.plan.backoff import Backoff
+from dcos_commons_tpu.plan.builders import DeployPlanFactory
+from dcos_commons_tpu.plan.phase import Phase
+from dcos_commons_tpu.plan.plan import Plan
+from dcos_commons_tpu.plan.step import DeploymentStep, PodInstanceRequirement
+from dcos_commons_tpu.plan.strategy import strategy_for_name
+from dcos_commons_tpu.specification.specs import ServiceSpec, SpecError, task_full_name
+from dcos_commons_tpu.state.state_store import StateStore
+
+
+class PlanGenerator:
+    def __init__(self, backoff: Optional[Backoff] = None):
+        self._factory = DeployPlanFactory(backoff)
+        self._backoff = backoff
+
+    def generate(
+        self,
+        spec: ServiceSpec,
+        plan_name: str,
+        raw_plan: Dict[str, Any],
+        state_store: StateStore,
+        target_config_id: str,
+    ) -> Plan:
+        phases: List[Phase] = []
+        for phase_name, raw_phase in (raw_plan.get("phases") or {}).items():
+            phases.append(
+                self._generate_phase(
+                    spec, phase_name, raw_phase or {}, state_store, target_config_id
+                )
+            )
+        return Plan(
+            plan_name,
+            phases,
+            strategy_for_name(str(raw_plan.get("strategy", "serial"))),
+        )
+
+    def _generate_phase(
+        self,
+        spec: ServiceSpec,
+        phase_name: str,
+        raw_phase: Dict[str, Any],
+        state_store: StateStore,
+        target_config_id: str,
+    ) -> Phase:
+        pod_name = raw_phase.get("pod")
+        if not pod_name:
+            raise SpecError(f"phase {phase_name!r} requires a pod")
+        pod = spec.pod(str(pod_name))
+        strategy_name = str(raw_phase.get("strategy", "serial"))
+        raw_steps = raw_phase.get("steps")
+        if not raw_steps:
+            phase = self._factory.build_phase(
+                pod, state_store, target_config_id, strategy_name
+            )
+            return Phase(phase_name, phase.steps, strategy_for_name(strategy_name))
+        steps: List[DeploymentStep] = []
+        for entry in raw_steps:
+            if not isinstance(entry, dict) or len(entry) != 1:
+                raise SpecError(
+                    f"phase {phase_name!r}: each step must be one "
+                    "{index: [[tasks...]]} mapping"
+                )
+            ((index, task_groups),) = entry.items()
+            for tasks in task_groups:
+                task_list = [str(t) for t in tasks]
+                requirement = PodInstanceRequirement(
+                    pod=pod, instances=[int(index)], tasks_to_launch=task_list
+                )
+                step = DeploymentStep(
+                    f"{pod.type}-{index}:[{','.join(task_list)}]",
+                    requirement,
+                    backoff=self._backoff,
+                )
+                self._factory._seed_from_state(
+                    step, pod, [int(index)], state_store, target_config_id
+                )
+                steps.append(step)
+        return Phase(phase_name, steps, strategy_for_name(strategy_name))

@@ -1,0 +1,77 @@
+"""Plan element status machine.
+
+Reference: scheduler/plan/Status.java:23-78 — the full vocabulary
+including the WAITING (operator interrupt) and DELAYED (launch
+backoff) caveats called out in SURVEY.md section 7 hard part 5.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Iterable
+
+
+class Status(enum.Enum):
+    ERROR = "ERROR"            # element has errors (bad spec / failed update)
+    WAITING = "WAITING"        # operator interrupted; will not be offered work
+    PENDING = "PENDING"        # no work started
+    PREPARED = "PREPARED"      # placement evaluated, ops generated
+    STARTING = "STARTING"      # tasks launched, awaiting RUNNING
+    STARTED = "STARTED"        # tasks RUNNING, awaiting readiness/goal
+    COMPLETE = "COMPLETE"      # goal reached
+    IN_PROGRESS = "IN_PROGRESS"  # aggregate: some children done, some not
+    DELAYED = "DELAYED"        # launch backoff after crash-loop
+
+    @property
+    def is_complete(self) -> bool:
+        return self is Status.COMPLETE
+
+    @property
+    def is_running(self) -> bool:
+        """Work actively underway (reference: Status.isRunning)."""
+        return self in (
+            Status.PREPARED,
+            Status.STARTING,
+            Status.STARTED,
+            Status.IN_PROGRESS,
+        )
+
+    @property
+    def is_working(self) -> bool:
+        """Eligible for or doing work: not terminal, not parked."""
+        return self in (
+            Status.PENDING,
+            Status.PREPARED,
+            Status.STARTING,
+            Status.STARTED,
+            Status.IN_PROGRESS,
+            Status.DELAYED,
+        )
+
+
+def aggregate(child_statuses: Iterable[Status], interrupted: bool = False) -> Status:
+    """Roll child statuses up to a parent element.
+
+    Reference: the aggregation rules in PlanUtils/Element.getStatus:
+    ERROR dominates; an interrupt shows WAITING while incomplete;
+    all-complete is COMPLETE; untouched is PENDING; otherwise
+    IN_PROGRESS (with DELAYED surfaced when nothing else is moving).
+    """
+    statuses = list(child_statuses)
+    if not statuses:
+        return Status.COMPLETE
+    if any(s is Status.ERROR for s in statuses):
+        return Status.ERROR
+    if all(s is Status.COMPLETE for s in statuses):
+        return Status.COMPLETE
+    if interrupted:
+        return Status.WAITING
+    if all(s in (Status.PENDING, Status.WAITING) for s in statuses):
+        # children individually interrupted still read WAITING
+        return Status.WAITING if any(
+            s is Status.WAITING for s in statuses
+        ) else Status.PENDING
+    moving = [s for s in statuses if s.is_running]
+    if not moving and any(s is Status.DELAYED for s in statuses):
+        return Status.DELAYED
+    return Status.IN_PROGRESS

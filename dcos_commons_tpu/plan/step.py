@@ -1,0 +1,260 @@
+"""Steps: the leaves of the plan tree that actually launch pods.
+
+Reference: scheduler/plan/Step.java:15, DeploymentStep.java:122-193
+(TaskStatus -> step status mapping incl. readiness gating and DELAYED
+backoff), PodInstanceRequirement.java, recovery/RecoveryType.java:7-25.
+
+TPU-first: a step covers a whole pod *instance* as in the reference,
+but for ``gang: true`` pods the step factory emits one step per pod
+covering ALL instances (a pjit mesh launches and fails as a unit —
+SURVEY.md section 7 hard part 3).
+"""
+
+from __future__ import annotations
+
+import enum
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+from dcos_commons_tpu.common import TaskState, TaskStatus, task_name_of
+from dcos_commons_tpu.plan.backoff import Backoff, DisabledBackoff
+from dcos_commons_tpu.plan.element import Element
+from dcos_commons_tpu.plan.status import Status
+from dcos_commons_tpu.specification.specs import GoalState, PodSpec, task_full_name
+
+
+class RecoveryType(enum.Enum):
+    """Reference: recovery/RecoveryType.java:7-25."""
+
+    NONE = "NONE"
+    TRANSIENT = "TRANSIENT"    # relaunch in place, keep reservations
+    PERMANENT = "PERMANENT"    # destroy + replace elsewhere
+
+
+@dataclass
+class PodInstanceRequirement:
+    """What a step asks the offer evaluator for.
+
+    Reference: plan/PodInstanceRequirement.java — pod instance +
+    tasks-to-launch + recovery type.  ``instances`` is a list to
+    support gang pods (all instances evaluated/launched together).
+    """
+
+    pod: PodSpec
+    instances: List[int]
+    tasks_to_launch: List[str] = field(default_factory=list)
+    recovery_type: RecoveryType = RecoveryType.NONE
+
+    def __post_init__(self) -> None:
+        if not self.tasks_to_launch:
+            self.tasks_to_launch = [t.name for t in self.pod.tasks]
+
+    @property
+    def asset_names(self) -> Set[str]:
+        """Pod-instance names this requirement touches — the "dirty
+        assets" the coordinator uses for mutual exclusion
+        (DefaultPlanCoordinator.java:33-90)."""
+        return {f"{self.pod.type}-{i}" for i in self.instances}
+
+    def task_names(self) -> List[str]:
+        return [
+            task_full_name(self.pod.type, i, t)
+            for i in self.instances
+            for t in self.tasks_to_launch
+        ]
+
+    @property
+    def name(self) -> str:
+        idx = ",".join(str(i) for i in self.instances)
+        return f"{self.pod.type}-[{idx}]:[{','.join(self.tasks_to_launch)}]"
+
+
+class Step(Element):
+    """Reference: plan/Step.java:15."""
+
+    def start(self) -> Optional[PodInstanceRequirement]:
+        """Called when this step is a candidate; returns the work."""
+        raise NotImplementedError
+
+    def update_offer_status(self, launched: bool) -> None:
+        """Outcome of offer evaluation for this step's requirement."""
+        raise NotImplementedError
+
+    def update(self, status: TaskStatus) -> None:
+        """Route a TaskStatus belonging to this step."""
+        raise NotImplementedError
+
+    def get_asset_names(self) -> Set[str]:
+        return set()
+
+
+class DeploymentStep(Step):
+    """Launch one pod instance (or one gang) and drive it to goal.
+
+    Reference: plan/DeploymentStep.java — specifically the status
+    mapping at :122-193: launch recorded -> STARTING; TASK_RUNNING ->
+    STARTED, then COMPLETE once readiness passes (or immediately if no
+    readiness check); TASK_FINISHED -> COMPLETE for FINISH/ONCE goals;
+    failures -> PENDING, or DELAYED under launch backoff.
+    """
+
+    def __init__(
+        self,
+        name: str,
+        requirement: PodInstanceRequirement,
+        backoff: Optional[Backoff] = None,
+    ):
+        super().__init__(name)
+        self.requirement = requirement
+        self._status = Status.PENDING
+        self._interrupted = False
+        self._backoff = backoff or DisabledBackoff()
+        self._delay_until = 0.0
+        # task full-name -> expected task id (set at launch record time)
+        self._expected: Dict[str, str] = {}
+        # task full-name -> last seen state
+        self._task_states: Dict[str, TaskState] = {}
+        self._task_ready: Dict[str, bool] = {}
+
+    # -- candidate lifecycle -----------------------------------------
+
+    def start(self) -> Optional[PodInstanceRequirement]:
+        with self._lock:
+            if self._interrupted or self.has_errors():
+                return None
+            if self._status is Status.DELAYED:
+                if time.monotonic() < self._delay_until:
+                    return None
+                self._status = Status.PENDING
+            if self._status is Status.PENDING:
+                return self.requirement
+            return None
+
+    def record_launch(self, task_ids: Dict[str, str]) -> None:
+        """Called after the launch WAL: map task name -> task id."""
+        with self._lock:
+            self._expected = dict(task_ids)
+            self._task_states = {}
+            self._task_ready = {}
+            self._status = Status.STARTING
+
+    def update_offer_status(self, launched: bool) -> None:
+        with self._lock:
+            if launched:
+                # record_launch carries the ids; nothing more here
+                return
+            # no inventory matched: stay PENDING; the outcome tracker
+            # explains why (debug/OfferOutcomeTracker)
+
+    # -- status intake -----------------------------------------------
+
+    def update(self, status: TaskStatus) -> None:
+        with self._lock:
+            try:
+                name = task_name_of(status.task_id)
+            except ValueError:
+                return
+            if name not in self._expected:
+                return
+            if self._expected[name] and status.task_id != self._expected[name]:
+                return  # stale status from an older launch
+            self._task_states[name] = status.state
+            if status.ready:
+                self._task_ready[name] = True
+            self._recompute(failed=status.state.is_failure)
+
+    def _goal_of(self, task_full: str) -> GoalState:
+        # task full name: "<pod>-<index>-<task>"
+        for spec in self.requirement.pod.tasks:
+            if task_full.endswith(f"-{spec.name}"):
+                return spec.goal
+        return GoalState.RUNNING
+
+    def _needs_readiness(self, task_full: str) -> bool:
+        for spec in self.requirement.pod.tasks:
+            if task_full.endswith(f"-{spec.name}"):
+                return spec.readiness_check is not None
+        return False
+
+    def _task_done(self, task_full: str) -> bool:
+        state = self._task_states.get(task_full)
+        if state is None:
+            return False
+        goal = self._goal_of(task_full)
+        if goal in (GoalState.FINISH, GoalState.ONCE):
+            return state is TaskState.FINISHED
+        if state is TaskState.RUNNING:
+            return (not self._needs_readiness(task_full)) or self._task_ready.get(
+                task_full, False
+            )
+        return False
+
+    def _recompute(self, failed: bool) -> None:
+        expected = list(self._expected)
+        if failed:
+            # any failure in the gang resets the whole step: a pjit pod
+            # cannot run degraded (gang semantics; for non-gang pods the
+            # step covers a single instance anyway)
+            delay = self._backoff.next_delay(self.name)
+            if delay > 0:
+                self._delay_until = time.monotonic() + delay
+                self._status = Status.DELAYED
+            else:
+                self._status = Status.PENDING
+            return
+        if expected and all(self._task_done(t) for t in expected):
+            self._backoff.clear(self.name)
+            self._status = Status.COMPLETE
+        elif any(
+            self._task_states.get(t) is TaskState.RUNNING for t in expected
+        ):
+            self._status = Status.STARTED
+        # else remain STARTING
+
+    # -- Element -----------------------------------------------------
+
+    def get_status(self) -> Status:
+        with self._lock:
+            if self.has_errors():
+                return Status.ERROR
+            if self._interrupted and not self._status.is_complete:
+                return Status.WAITING
+            if self._status is Status.DELAYED and \
+                    time.monotonic() >= self._delay_until:
+                return Status.PENDING
+            return self._status
+
+    def get_raw_status(self) -> Status:
+        return self._status
+
+    def interrupt(self) -> None:
+        with self._lock:
+            self._interrupted = True
+
+    def proceed(self) -> None:
+        with self._lock:
+            self._interrupted = False
+
+    def is_interrupted(self) -> bool:
+        return self._interrupted
+
+    def restart(self) -> None:
+        """Reference: PlansQueries restart verb — back to PENDING."""
+        with self._lock:
+            self._status = Status.PENDING
+            self._expected = {}
+            self._task_states = {}
+            self._task_ready = {}
+            self._delay_until = 0.0
+
+    def force_complete(self) -> None:
+        with self._lock:
+            self._status = Status.COMPLETE
+
+    def get_asset_names(self) -> Set[str]:
+        return self.requirement.asset_names
+
+    def expected_task_ids(self) -> Dict[str, str]:
+        with self._lock:
+            return dict(self._expected)

@@ -1,0 +1,59 @@
+"""L4 specification: typed object model of a service + YAML front end.
+
+Reference: sdk/scheduler/.../specification/ (ServiceSpec/PodSpec/
+TaskSpec/ResourceSpec interfaces, DefaultServiceSpec.java) and
+specification/yaml/ (RawServiceSpec beans, TemplateUtils mustache
+rendering, YAMLToInternalMappers.java, 805 LoC).
+
+TPU-first deltas: the resource vocabulary gains a first-class
+``tpu:`` block ({generation, chips_per_host, topology}) replacing the
+reference's ``gpus:`` Mesos scalar, and pods gain ``gang: true`` for
+slice-wide gang scheduling (a pjit mesh cannot roll worker-by-worker;
+SURVEY.md section 7 hard part 4).
+"""
+
+from dcos_commons_tpu.specification.specs import (
+    GoalState,
+    HealthCheckSpec,
+    PodSpec,
+    PortSpec,
+    ReadinessCheckSpec,
+    ReplacementFailurePolicy,
+    ResourceSpec,
+    ServiceSpec,
+    SpecError,
+    TaskSpec,
+    TpuSpec,
+    VolumeSpec,
+)
+from dcos_commons_tpu.specification.yaml_spec import (
+    from_yaml,
+    from_yaml_file,
+    render_template,
+)
+from dcos_commons_tpu.specification.validation import (
+    ConfigValidationError,
+    default_validators,
+    validate_spec_change,
+)
+
+__all__ = [
+    "ConfigValidationError",
+    "GoalState",
+    "HealthCheckSpec",
+    "PodSpec",
+    "PortSpec",
+    "ReadinessCheckSpec",
+    "ReplacementFailurePolicy",
+    "ResourceSpec",
+    "ServiceSpec",
+    "SpecError",
+    "TaskSpec",
+    "TpuSpec",
+    "VolumeSpec",
+    "default_validators",
+    "from_yaml",
+    "from_yaml_file",
+    "render_template",
+    "validate_spec_change",
+]

@@ -1,0 +1,316 @@
+"""Typed service specification object model.
+
+Reference: sdk/scheduler/.../specification/ — ServiceSpec, PodSpec,
+TaskSpec, ResourceSpec/PortSpec/VolumeSpec, GoalState.java,
+ReplacementFailurePolicy (DefaultServiceSpec.java).  Specs are pure
+data: JSON-serializable, comparable, stored in the ConfigStore and
+diffed on config update.
+
+TPU-first: ResourceSpec has no ``gpus`` scalar (north-star requirement
+in BASELINE.md); pods request TPU via :class:`TpuSpec`, whose topology
+string ("2x2", "4x4", "2x2x4") names an ICI sub-slice shape.
+"""
+
+from __future__ import annotations
+
+import enum
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class SpecError(Exception):
+    pass
+
+
+class GoalState(enum.Enum):
+    """Reference: specification/GoalState.java.
+
+    RUNNING: stay up forever (restart on exit).
+    FINISH: run to successful completion, re-run on config change.
+    ONCE: run to successful completion exactly once ever.
+    """
+
+    RUNNING = "RUNNING"
+    FINISH = "FINISH"
+    ONCE = "ONCE"
+
+
+@dataclass(frozen=True)
+class TpuSpec:
+    """A pod's TPU requirement — the heart of the rebuild.
+
+    Replaces the reference's ``gpus:`` Mesos scalar resources.  A pod
+    instance runs on one host and consumes ``chips_per_host`` chips
+    there; ``topology`` names the ICI shape of the whole multi-host
+    slice the pod's instances must form (e.g. "4x4" = 16 chips over 4
+    hosts of 4).  The placement engine uses it to require torus
+    adjacency between instances (SURVEY.md section 7 delta b).
+    """
+
+    generation: str = "v5e"          # v4 / v5e / v5p / v6e ...
+    chips_per_host: int = 4
+    topology: str = ""               # "" = no multi-host shape required
+
+    def topology_dims(self) -> Tuple[int, ...]:
+        if not self.topology:
+            return ()
+        try:
+            dims = tuple(int(d) for d in self.topology.lower().split("x"))
+        except ValueError:
+            raise SpecError(f"bad topology {self.topology!r}")
+        if not dims or any(d <= 0 for d in dims):
+            raise SpecError(f"bad topology {self.topology!r}")
+        return dims
+
+    @property
+    def total_chips(self) -> int:
+        dims = self.topology_dims()
+        total = 1
+        for d in dims:
+            total *= d
+        return total if dims else self.chips_per_host
+
+
+@dataclass(frozen=True)
+class PortSpec:
+    """Reference: specification/PortSpec.java + NamedVIPSpec.java."""
+
+    name: str
+    port: int = 0                    # 0 = dynamically assigned
+    vip: str = ""                    # "name:port" service VIP
+    env_key: str = ""                # env var to expose the port under
+
+
+@dataclass(frozen=True)
+class VolumeSpec:
+    """Reference: specification/VolumeSpec.java (ROOT/MOUNT/profile)."""
+
+    container_path: str
+    size_mb: int
+    type: str = "ROOT"               # ROOT (shared disk) | MOUNT (dedicated)
+    profiles: Tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class ResourceSpec:
+    """Per-task scalar resources.  No ``gpus`` — TPU is per-pod TpuSpec."""
+
+    cpus: float = 0.1
+    memory_mb: int = 32
+    disk_mb: int = 0
+    ports: Tuple[PortSpec, ...] = ()
+
+
+@dataclass(frozen=True)
+class HealthCheckSpec:
+    """Reference: specification/HealthCheckSpec.java."""
+
+    cmd: str
+    interval_s: float = 30.0
+    grace_period_s: float = 30.0
+    timeout_s: float = 20.0
+    max_consecutive_failures: int = 3
+    delay_s: float = 0.0
+
+
+@dataclass(frozen=True)
+class ReadinessCheckSpec:
+    """Reference: specification/ReadinessCheckSpec.java — gates a step's
+    STARTED->COMPLETE transition (stored as a task label in the
+    reference, PodInfoBuilder.java:511-526)."""
+
+    cmd: str
+    interval_s: float = 5.0
+    timeout_s: float = 10.0
+
+
+@dataclass(frozen=True)
+class TaskSpec:
+    """Reference: specification/TaskSpec.java."""
+
+    name: str
+    goal: GoalState = GoalState.RUNNING
+    cmd: str = ""
+    env: Dict[str, str] = field(default_factory=dict)
+    resources: ResourceSpec = field(default_factory=ResourceSpec)
+    volumes: Tuple[VolumeSpec, ...] = ()
+    health_check: Optional[HealthCheckSpec] = None
+    readiness_check: Optional[ReadinessCheckSpec] = None
+    config_templates: Tuple[Tuple[str, str], ...] = ()   # (template, dest)
+    kill_grace_period_s: float = 0.0
+    essential: bool = True           # reference: TaskSpec.isEssential
+
+    def __post_init__(self) -> None:
+        if isinstance(self.goal, str):
+            object.__setattr__(self, "goal", GoalState(self.goal))
+
+
+@dataclass(frozen=True)
+class PodSpec:
+    """Reference: specification/PodSpec.java.
+
+    ``gang=True`` is the TPU-first addition: all ``count`` instances
+    form one scheduling unit (a pjit mesh), deployed and recovered
+    together, with rolling updates at pod granularity.
+    """
+
+    type: str
+    count: int = 1
+    tasks: Tuple[TaskSpec, ...] = ()
+    tpu: Optional[TpuSpec] = None
+    gang: bool = False
+    image: str = ""
+    networks: Tuple[str, ...] = ()
+    placement: str = ""              # placement DSL (offer/placement.py)
+    volumes: Tuple[VolumeSpec, ...] = ()   # pod-level shared volumes
+    pre_reserved_role: str = ""
+    allow_decommission: bool = False
+    share_pid_namespace: bool = False
+
+    def task(self, name: str) -> TaskSpec:
+        for t in self.tasks:
+            if t.name == name:
+                return t
+        raise SpecError(f"no task {name!r} in pod {self.type!r}")
+
+
+@dataclass(frozen=True)
+class ReplacementFailurePolicy:
+    """Reference: DefaultServiceSpec ReplacementFailurePolicy — governs
+    TRANSIENT->PERMANENT escalation (TimedFailureMonitor)."""
+
+    permanent_failure_timeout_s: float = 1200.0
+    min_replace_delay_s: float = 600.0
+
+
+@dataclass(frozen=True)
+class ServiceSpec:
+    """Reference: specification/ServiceSpec.java."""
+
+    name: str
+    role: str = ""
+    user: str = ""
+    region: str = ""
+    zone: str = ""
+    web_url: str = ""
+    pods: Tuple[PodSpec, ...] = ()
+    replacement_failure_policy: Optional[ReplacementFailurePolicy] = None
+    # raw plans section from YAML; compiled by plan.PlanGenerator
+    plans: Dict[str, Any] = field(default_factory=dict)
+
+    def pod(self, pod_type: str) -> PodSpec:
+        for p in self.pods:
+            if p.type == pod_type:
+                return p
+        raise SpecError(f"no pod {pod_type!r} in service {self.name!r}")
+
+    # -- serde (ConfigStore stores dicts; reference stores Jackson JSON
+    #    of DefaultServiceSpec) --------------------------------------
+
+    def to_dict(self) -> Dict[str, Any]:
+        return json.loads(json.dumps(self, default=_encode))
+
+    @staticmethod
+    def from_dict(data: Dict[str, Any]) -> "ServiceSpec":
+        return _decode_service(data)
+
+    def __eq__(self, other: object) -> bool:
+        if not isinstance(other, ServiceSpec):
+            return NotImplemented
+        return self.to_dict() == other.to_dict()
+
+    def __hash__(self) -> int:
+        return hash(json.dumps(self.to_dict(), sort_keys=True))
+
+
+def _encode(obj: Any) -> Any:
+    if isinstance(obj, enum.Enum):
+        return obj.value
+    if hasattr(obj, "__dataclass_fields__"):
+        return {
+            name: getattr(obj, name) for name in obj.__dataclass_fields__
+        }
+    if isinstance(obj, tuple):
+        return list(obj)
+    raise TypeError(f"cannot encode {obj!r}")
+
+
+def _decode_service(data: Dict[str, Any]) -> ServiceSpec:
+    pods = tuple(_decode_pod(p) for p in data.get("pods", []))
+    rfp = data.get("replacement_failure_policy")
+    return ServiceSpec(
+        name=data["name"],
+        role=data.get("role", ""),
+        user=data.get("user", ""),
+        region=data.get("region", ""),
+        zone=data.get("zone", ""),
+        web_url=data.get("web_url", ""),
+        pods=pods,
+        replacement_failure_policy=(
+            ReplacementFailurePolicy(**rfp) if rfp else None
+        ),
+        plans=data.get("plans", {}),
+    )
+
+
+def _decode_pod(data: Dict[str, Any]) -> PodSpec:
+    tpu = data.get("tpu")
+    return PodSpec(
+        type=data["type"],
+        count=data.get("count", 1),
+        tasks=tuple(_decode_task(t) for t in data.get("tasks", [])),
+        tpu=TpuSpec(**tpu) if tpu else None,
+        gang=data.get("gang", False),
+        image=data.get("image", ""),
+        networks=tuple(data.get("networks", ())),
+        placement=data.get("placement", ""),
+        volumes=tuple(VolumeSpec(**_vol(v)) for v in data.get("volumes", [])),
+        pre_reserved_role=data.get("pre_reserved_role", ""),
+        allow_decommission=data.get("allow_decommission", False),
+        share_pid_namespace=data.get("share_pid_namespace", False),
+    )
+
+
+def _vol(v: Dict[str, Any]) -> Dict[str, Any]:
+    v = dict(v)
+    if "profiles" in v:
+        v["profiles"] = tuple(v["profiles"])
+    return v
+
+
+def _decode_task(data: Dict[str, Any]) -> TaskSpec:
+    res = data.get("resources") or {}
+    ports = tuple(PortSpec(**p) for p in res.get("ports", []))
+    hc = data.get("health_check")
+    rc = data.get("readiness_check")
+    return TaskSpec(
+        name=data["name"],
+        goal=GoalState(data.get("goal", "RUNNING")),
+        cmd=data.get("cmd", ""),
+        env=dict(data.get("env", {})),
+        resources=ResourceSpec(
+            cpus=res.get("cpus", 0.1),
+            memory_mb=res.get("memory_mb", 32),
+            disk_mb=res.get("disk_mb", 0),
+            ports=ports,
+        ),
+        volumes=tuple(VolumeSpec(**_vol(v)) for v in data.get("volumes", [])),
+        health_check=HealthCheckSpec(**hc) if hc else None,
+        readiness_check=ReadinessCheckSpec(**rc) if rc else None,
+        config_templates=tuple(
+            (t[0], t[1]) for t in data.get("config_templates", [])
+        ),
+        kill_grace_period_s=data.get("kill_grace_period_s", 0.0),
+        essential=data.get("essential", True),
+    )
+
+
+def pod_instance_name(pod_type: str, index: int) -> str:
+    """"<pod>-<index>" (reference: PodInstance.getName())."""
+    return f"{pod_type}-{index}"
+
+
+def task_full_name(pod_type: str, index: int, task_name: str) -> str:
+    """"<pod>-<index>-<task>" (reference: TaskSpec.getInstanceName())."""
+    return f"{pod_type}-{index}-{task_name}"

@@ -1,0 +1,174 @@
+"""Config-update validators: what may change between target configs.
+
+Reference: sdk/scheduler/.../config/validate/ (19 validator classes,
+run by DefaultConfigurationUpdater.updateConfiguration,
+config/DefaultConfigurationUpdater.java:159).  Each validator compares
+the previous target spec against the candidate and emits errors; any
+error keeps the old target active and surfaces via /v1/plans errors.
+
+TPU-first addition: TpuTopologyCannotChange — you cannot reshape a
+live slice's ICI topology by rolling update; that requires pod
+replace (SURVEY.md section 2 build plan stage 2).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+from dcos_commons_tpu.specification.specs import ServiceSpec
+
+
+class ConfigValidationError(Exception):
+    def __init__(self, errors: List[str]):
+        super().__init__("; ".join(errors))
+        self.errors = errors
+
+
+Validator = Callable[[Optional[ServiceSpec], ServiceSpec], List[str]]
+
+
+def service_name_cannot_change(old, new):
+    """Reference: config/validate/ServiceNameCannotContainDoubleUnderscores
+    + the implicit identity check in DefaultConfigurationUpdater."""
+    errs = []
+    if "__" in new.name:
+        errs.append(f"service name {new.name!r} may not contain '__'")
+    if old is not None and old.name != new.name:
+        errs.append(f"service name cannot change: {old.name!r} -> {new.name!r}")
+    return errs
+
+
+def user_cannot_change(old, new):
+    """Reference: config/validate/UserCannotChange.java."""
+    if old is not None and old.user and old.user != new.user:
+        return [f"user cannot change: {old.user!r} -> {new.user!r}"]
+    return []
+
+
+def region_cannot_change(old, new):
+    """Reference: config/validate/RegionCannotChange.java."""
+    if old is not None and old.region != new.region:
+        return [f"region cannot change: {old.region!r} -> {new.region!r}"]
+    return []
+
+
+def pod_specs_cannot_shrink(old, new):
+    """Reference: config/validate/PodSpecsCannotShrink.java — pod count
+    may only shrink via explicit decommission (allow_decommission)."""
+    errs = []
+    if old is None:
+        return errs
+    new_pods = {p.type: p for p in new.pods}
+    for old_pod in old.pods:
+        new_pod = new_pods.get(old_pod.type)
+        if new_pod is None:
+            if not old_pod.allow_decommission:
+                errs.append(f"pod {old_pod.type!r} cannot be removed")
+        elif new_pod.count < old_pod.count and not old_pod.allow_decommission:
+            errs.append(
+                f"pod {old_pod.type!r} count cannot shrink "
+                f"{old_pod.count} -> {new_pod.count} without allow-decommission"
+            )
+    return errs
+
+
+def task_volumes_cannot_change(old, new):
+    """Reference: config/validate/TaskVolumesCannotChange.java."""
+    errs = []
+    if old is None:
+        return errs
+    new_pods = {p.type: p for p in new.pods}
+    for old_pod in old.pods:
+        new_pod = new_pods.get(old_pod.type)
+        if new_pod is None:
+            continue
+        if tuple(old_pod.volumes) != tuple(new_pod.volumes):
+            errs.append(f"pod {old_pod.type!r} volumes cannot change")
+        old_tasks = {t.name: t for t in old_pod.tasks}
+        for new_task in new_pod.tasks:
+            old_task = old_tasks.get(new_task.name)
+            if old_task and tuple(old_task.volumes) != tuple(new_task.volumes):
+                errs.append(
+                    f"task {old_pod.type}-{new_task.name} volumes cannot change"
+                )
+    return errs
+
+
+def tpu_topology_cannot_change(old, new):
+    """TPU-first: the ICI topology of a live pod cannot change by
+    rolling update — a pjit mesh is one XLA program over a fixed
+    device mesh.  Changing generation/topology requires pod replace."""
+    errs = []
+    if old is None:
+        return errs
+    new_pods = {p.type: p for p in new.pods}
+    for old_pod in old.pods:
+        new_pod = new_pods.get(old_pod.type)
+        if new_pod is None or old_pod.tpu is None:
+            continue
+        if new_pod.tpu is None:
+            errs.append(f"pod {old_pod.type!r} cannot drop its tpu block")
+        elif (
+            old_pod.tpu.generation != new_pod.tpu.generation
+            or old_pod.tpu.topology != new_pod.tpu.topology
+        ):
+            errs.append(
+                f"pod {old_pod.type!r} TPU topology cannot change "
+                f"({old_pod.tpu.generation}/{old_pod.tpu.topology} -> "
+                f"{new_pod.tpu.generation}/{new_pod.tpu.topology}); "
+                "use pod replace"
+            )
+    return errs
+
+
+def gang_pods_need_topology(old, new):
+    """A gang pod with a multi-host topology must have count matching
+    the topology's host count (total_chips / chips_per_host)."""
+    errs = []
+    for pod in new.pods:
+        if pod.tpu is None or not pod.tpu.topology:
+            continue
+        total = pod.tpu.total_chips
+        per_host = pod.tpu.chips_per_host
+        if total % per_host != 0:
+            errs.append(
+                f"pod {pod.type!r}: topology {pod.tpu.topology} total chips "
+                f"{total} not divisible by chips-per-host {per_host}"
+            )
+            continue
+        hosts = total // per_host
+        if pod.count != hosts:
+            errs.append(
+                f"pod {pod.type!r}: count {pod.count} != {hosts} hosts implied "
+                f"by topology {pod.tpu.topology} at {per_host} chips/host"
+            )
+    return errs
+
+
+def default_validators() -> List[Validator]:
+    return [
+        service_name_cannot_change,
+        user_cannot_change,
+        region_cannot_change,
+        pod_specs_cannot_shrink,
+        task_volumes_cannot_change,
+        tpu_topology_cannot_change,
+        gang_pods_need_topology,
+    ]
+
+
+def validate_spec_change(
+    old: Optional[ServiceSpec],
+    new: ServiceSpec,
+    validators: Optional[List[Validator]] = None,
+) -> None:
+    """Run all validators; raise ConfigValidationError on any failure.
+
+    Reference: DefaultConfigurationUpdater.updateConfiguration flow —
+    validation errors keep the previous target config active.
+    """
+    errors: List[str] = []
+    for validator in validators if validators is not None else default_validators():
+        errors.extend(validator(old, new))
+    if errors:
+        raise ConfigValidationError(errors)

@@ -1,0 +1,226 @@
+"""YAML front end: svc.yml -> ServiceSpec.
+
+Reference: specification/yaml/ — RawServiceSpec et al. (Jackson beans),
+TemplateUtils.java (mustache env substitution with missing-value
+errors), YAMLToInternalMappers.java (Raw -> Default* conversion, 805
+LoC).  The YAML shape mirrors the reference svc.yml dialect
+(frameworks/helloworld/src/main/dist/*.yml): pods and tasks are maps,
+scalar resources are inline task keys, plans name phases over pods.
+
+TPU-first: a pod-level ``tpu:`` block replaces per-task ``gpus:``
+scalars; ``gang: true`` requests slice-wide gang scheduling.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, Mapping, Optional
+
+import yaml
+
+from dcos_commons_tpu.specification.specs import (
+    GoalState,
+    HealthCheckSpec,
+    PodSpec,
+    PortSpec,
+    ReadinessCheckSpec,
+    ReplacementFailurePolicy,
+    ResourceSpec,
+    ServiceSpec,
+    SpecError,
+    TaskSpec,
+    TpuSpec,
+    VolumeSpec,
+)
+
+_TEMPLATE_RE = re.compile(r"\{\{([A-Za-z_][A-Za-z0-9_]*)(?::-([^}]*))?\}\}")
+
+
+def render_template(text: str, env: Mapping[str, str]) -> str:
+    """Mustache-style ``{{VAR}}`` substitution from an env map.
+
+    Reference: specification/yaml/TemplateUtils.java — missing values
+    are an error (listing every missing variable), so a bad install
+    fails loudly at spec-render time rather than at task runtime.
+    ``{{VAR:-default}}`` supplies a default.
+    """
+    missing = []
+
+    def sub(match: re.Match) -> str:
+        var, default = match.group(1), match.group(2)
+        if var in env:
+            return str(env[var])
+        if default is not None:
+            return default
+        missing.append(var)
+        return ""
+
+    rendered = _TEMPLATE_RE.sub(sub, text)
+    if missing:
+        raise SpecError(
+            f"missing template values: {sorted(set(missing))}"
+        )
+    return rendered
+
+
+def from_yaml_file(path: str, env: Optional[Mapping[str, str]] = None) -> ServiceSpec:
+    with open(path, "r", encoding="utf-8") as f:
+        return from_yaml(f.read(), env)
+
+
+def from_yaml(text: str, env: Optional[Mapping[str, str]] = None) -> ServiceSpec:
+    raw = yaml.safe_load(render_template(text, env or {}))
+    if not isinstance(raw, dict):
+        raise SpecError("service YAML must be a mapping")
+    return _map_service(raw)
+
+
+def _map_service(raw: Dict[str, Any]) -> ServiceSpec:
+    name = raw.get("name")
+    if not name:
+        raise SpecError("service requires a name")
+    pods_raw = raw.get("pods") or {}
+    if not pods_raw:
+        raise SpecError(f"service {name!r} requires at least one pod")
+    pods = tuple(
+        _map_pod(pod_name, pod_raw or {}) for pod_name, pod_raw in pods_raw.items()
+    )
+    rfp_raw = raw.get("replacement-failure-policy")
+    rfp = None
+    if rfp_raw:
+        rfp = ReplacementFailurePolicy(
+            permanent_failure_timeout_s=float(
+                rfp_raw.get("permanent-failure-timeout-secs", 1200)
+            ),
+            min_replace_delay_s=float(rfp_raw.get("min-replace-delay-secs", 600)),
+        )
+    return ServiceSpec(
+        name=str(name),
+        role=str(raw.get("role", "") or f"{name}-role"),
+        user=str(raw.get("user", "")),
+        region=str(raw.get("region", "")),
+        zone=str(raw.get("zone", "")),
+        web_url=str(raw.get("web-url", "")),
+        pods=pods,
+        replacement_failure_policy=rfp,
+        plans=raw.get("plans") or {},
+    )
+
+
+def _map_pod(pod_name: str, raw: Dict[str, Any]) -> PodSpec:
+    tasks_raw = raw.get("tasks") or {}
+    if not tasks_raw:
+        raise SpecError(f"pod {pod_name!r} requires at least one task")
+    tpu_raw = raw.get("tpu")
+    tpu = None
+    if tpu_raw:
+        tpu = TpuSpec(
+            generation=str(tpu_raw.get("generation", "v5e")),
+            chips_per_host=int(tpu_raw.get("chips-per-host", 4)),
+            topology=str(tpu_raw.get("topology", "")),
+        )
+    return PodSpec(
+        type=str(pod_name),
+        count=int(raw.get("count", 1)),
+        tasks=tuple(
+            _map_task(task_name, task_raw or {})
+            for task_name, task_raw in tasks_raw.items()
+        ),
+        tpu=tpu,
+        gang=bool(raw.get("gang", False)),
+        image=str(raw.get("image", "")),
+        networks=_map_networks(raw),
+        placement=str(raw.get("placement", "")),
+        volumes=_map_volumes(raw),
+        pre_reserved_role=str(raw.get("pre-reserved-role", "")),
+        allow_decommission=bool(raw.get("allow-decommission", False)),
+        share_pid_namespace=bool(raw.get("share-pid-namespace", False)),
+    )
+
+
+def _map_task(task_name: str, raw: Dict[str, Any]) -> TaskSpec:
+    ports = []
+    for port_name, port_raw in (raw.get("ports") or {}).items():
+        port_raw = port_raw or {}
+        ports.append(
+            PortSpec(
+                name=str(port_name),
+                port=int(port_raw.get("port", 0)),
+                vip=str(port_raw.get("vip", "")),
+                env_key=str(port_raw.get("env-key", "")),
+            )
+        )
+    hc_raw = raw.get("health-check")
+    hc = None
+    if hc_raw:
+        hc = HealthCheckSpec(
+            cmd=str(hc_raw["cmd"]),
+            interval_s=float(hc_raw.get("interval", 30)),
+            grace_period_s=float(hc_raw.get("grace-period", 30)),
+            timeout_s=float(hc_raw.get("timeout", 20)),
+            max_consecutive_failures=int(hc_raw.get("max-consecutive-failures", 3)),
+            delay_s=float(hc_raw.get("delay", 0)),
+        )
+    rc_raw = raw.get("readiness-check")
+    rc = None
+    if rc_raw:
+        rc = ReadinessCheckSpec(
+            cmd=str(rc_raw["cmd"]),
+            interval_s=float(rc_raw.get("interval", 5)),
+            timeout_s=float(rc_raw.get("timeout", 10)),
+        )
+    templates = []
+    for cfg_name, cfg_raw in (raw.get("configs") or {}).items():
+        cfg_raw = cfg_raw or {}
+        if "template" not in cfg_raw or "dest" not in cfg_raw:
+            raise SpecError(
+                f"config {cfg_name!r} in task {task_name!r} needs template+dest"
+            )
+        templates.append((str(cfg_raw["template"]), str(cfg_raw["dest"])))
+    return TaskSpec(
+        name=str(task_name),
+        goal=GoalState(str(raw.get("goal", "RUNNING")).upper()),
+        cmd=str(raw.get("cmd", "")),
+        env={str(k): str(v) for k, v in (raw.get("env") or {}).items()},
+        resources=ResourceSpec(
+            cpus=float(raw.get("cpus", 0.1)),
+            memory_mb=int(raw.get("memory", 32)),
+            disk_mb=int(raw.get("disk", 0)),
+            ports=tuple(ports),
+        ),
+        volumes=_map_volumes(raw),
+        health_check=hc,
+        readiness_check=rc,
+        config_templates=tuple(templates),
+        kill_grace_period_s=float(raw.get("kill-grace-period", 0)),
+        essential=bool(raw.get("essential", True)),
+    )
+
+
+def _map_networks(raw: Dict[str, Any]) -> tuple:
+    # reference YAML uses a map (network name -> options); lists accepted too
+    nets = raw.get("networks") or ()
+    if isinstance(nets, dict):
+        return tuple(str(n) for n in nets)
+    return tuple(str(n) for n in nets)
+
+
+def _map_volumes(raw: Dict[str, Any]) -> tuple:
+    vols = []
+    single = raw.get("volume")
+    multi = raw.get("volumes") or {}
+    entries = []
+    if single:
+        entries.append(single)
+    if isinstance(multi, dict):
+        entries.extend(v for v in multi.values() if v)
+    for v in entries:
+        vols.append(
+            VolumeSpec(
+                container_path=str(v["path"]),
+                size_mb=int(v.get("size", 0)),
+                type=str(v.get("type", "ROOT")).upper(),
+                profiles=tuple(v.get("profiles", ()) or ()),
+            )
+        )
+    return tuple(vols)

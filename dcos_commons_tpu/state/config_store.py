@@ -10,9 +10,10 @@ from __future__ import annotations
 
 import json
 import uuid as uuid_mod
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Dict, List, Optional
 
-from dcos_commons_tpu.storage import Persister, PersisterError
+from dcos_commons_tpu.storage import Persister
+from dcos_commons_tpu.storage.persister import namespace_root, validate_key
 
 
 class ConfigStore:
@@ -20,49 +21,49 @@ class ConfigStore:
 
     def __init__(self, persister: Persister, namespace: str = "") -> None:
         self._persister = persister
-        self._root = f"/{namespace}" if namespace else ""
+        self._root = namespace_root(namespace)
 
     def _path(self, leaf: str) -> str:
         return f"{self._root}/{leaf}"
 
+    def _config_path(self, config_id: str) -> str:
+        validate_key(config_id, "config id")
+        return self._path(f"configurations/{config_id}")
+
     def store(self, config: Dict[str, Any]) -> str:
         config_id = str(uuid_mod.uuid4())
         self._persister.set(
-            self._path(f"configurations/{config_id}"),
+            self._config_path(config_id),
             json.dumps(config, sort_keys=True).encode("utf-8"),
         )
         return config_id
 
     def fetch(self, config_id: str) -> Optional[Dict[str, Any]]:
-        try:
-            raw = self._persister.get(self._path(f"configurations/{config_id}"))
-        except PersisterError:
-            return None
+        raw = self._persister.get_or_none(self._config_path(config_id))
         return json.loads(raw.decode("utf-8")) if raw is not None else None
 
     def list_ids(self) -> List[str]:
         return self._persister.get_children_or_empty(self._path("configurations"))
 
     def clear(self, config_id: str) -> None:
+        from dcos_commons_tpu.storage import PersisterError
+
+        path = self._config_path(config_id)  # validates the id
         try:
-            self._persister.recursive_delete(
-                self._path(f"configurations/{config_id}")
-            )
+            self._persister.recursive_delete(path)
         except PersisterError:
-            pass
+            pass  # missing config: already cleared
 
     # -- target pointer ----------------------------------------------
 
     def set_target_config(self, config_id: str) -> None:
+        validate_key(config_id, "config id")
         self._persister.set(
             self._path("config-target"), config_id.encode("utf-8")
         )
 
     def get_target_config(self) -> Optional[str]:
-        try:
-            raw = self._persister.get(self._path("config-target"))
-        except PersisterError:
-            return None
+        raw = self._persister.get_or_none(self._path("config-target"))
         return raw.decode("utf-8") if raw is not None else None
 
     def fetch_target(self) -> Optional[Dict[str, Any]]:

@@ -27,10 +27,7 @@ class FrameworkStore:
         self._persister.set(self.ID_PATH, framework_id.encode("utf-8"))
 
     def fetch_framework_id(self) -> Optional[str]:
-        try:
-            raw = self._persister.get(self.ID_PATH)
-        except PersisterError:
-            return None
+        raw = self._persister.get_or_none(self.ID_PATH)
         return raw.decode("utf-8") if raw is not None else None
 
     def get_or_create_framework_id(self) -> str:
@@ -61,8 +58,5 @@ class FrameworkStore:
         return self._fetch_addrs().get(pod_type)
 
     def _fetch_addrs(self) -> dict:
-        try:
-            raw = self._persister.get(self.COORD_PATH)
-        except PersisterError:
-            return {}
+        raw = self._persister.get_or_none(self.COORD_PATH)
         return json.loads(raw.decode("utf-8")) if raw is not None else {}

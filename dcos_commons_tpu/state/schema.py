@@ -17,10 +17,7 @@ class SchemaVersionStore:
         self._persister = persister
 
     def fetch(self) -> int:
-        try:
-            raw = self._persister.get(self.PATH)
-        except PersisterError:
-            return 0
+        raw = self._persister.get_or_none(self.PATH)
         return int(raw.decode("utf-8")) if raw else 0
 
     def store(self, version: int) -> None:

@@ -20,6 +20,7 @@ from typing import Dict, List, Optional
 
 from dcos_commons_tpu.common import TaskInfo, TaskState, TaskStatus
 from dcos_commons_tpu.storage import Persister, PersisterError, SetOp
+from dcos_commons_tpu.storage.persister import namespace_root
 
 
 class StateStoreException(Exception):
@@ -47,7 +48,7 @@ class StateStore:
         # namespacing supports multi-service mode, where each service
         # gets its own subtree (reference: SchedulerBuilder namespacing,
         # scheduler/multi/).
-        self._root = f"/{namespace}" if namespace else ""
+        self._root = namespace_root(namespace)
         self._lock = threading.RLock()
 
     @property
@@ -76,10 +77,7 @@ class StateStore:
             self._persister.apply(ops)
 
     def fetch_task(self, task_name: str) -> Optional[TaskInfo]:
-        try:
-            raw = self._persister.get(self._task_path(task_name, "info"))
-        except PersisterError:
-            return None
+        raw = self._persister.get_or_none(self._task_path(task_name, "info"))
         return TaskInfo.from_bytes(raw) if raw is not None else None
 
     def fetch_task_names(self) -> List[str]:
@@ -113,10 +111,7 @@ class StateStore:
             return True
 
     def fetch_status(self, task_name: str) -> Optional[TaskStatus]:
-        try:
-            raw = self._persister.get(self._task_path(task_name, "status"))
-        except PersisterError:
-            return None
+        raw = self._persister.get_or_none(self._task_path(task_name, "status"))
         return TaskStatus.from_bytes(raw) if raw is not None else None
 
     def fetch_statuses(self) -> Dict[str, TaskStatus]:
@@ -171,10 +166,7 @@ class StateStore:
     def fetch_goal_override(
         self, task_name: str
     ) -> tuple[GoalStateOverride, OverrideProgress]:
-        try:
-            raw = self._persister.get(self._task_path(task_name, "override"))
-        except PersisterError:
-            return (GoalStateOverride.NONE, OverrideProgress.COMPLETE)
+        raw = self._persister.get_or_none(self._task_path(task_name, "override"))
         if raw is None:
             return (GoalStateOverride.NONE, OverrideProgress.COMPLETE)
         data = json.loads(raw.decode("utf-8"))
@@ -191,10 +183,7 @@ class StateStore:
 
     def fetch_property(self, key: str) -> Optional[bytes]:
         _validate_property_key(key)
-        try:
-            return self._persister.get(f"{self._root}/properties/{key}")
-        except PersisterError:
-            return None
+        return self._persister.get_or_none(f"{self._root}/properties/{key}")
 
     def fetch_property_keys(self) -> List[str]:
         return self._persister.get_children_or_empty(f"{self._root}/properties")

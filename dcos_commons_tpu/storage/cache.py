@@ -29,16 +29,18 @@ class PersisterCache(Persister):
         self._load()
 
     def _load(self) -> None:
+        # Load errors must propagate and fail the boot: a partially
+        # warmed cache would authoritatively answer "path not found"
+        # for state that exists, making a running service look like a
+        # fresh install.
         def walk(path: str) -> None:
-            try:
+            if path != "/":
                 value = self._backend.get(path)
-            except Exception:
-                return
-            if value is not None:
-                self._cache.set(path, value)
-            elif path != "/":
-                self._cache.set(path, None)  # type: ignore[arg-type]
-            for child in self._backend.get_children_or_empty(path):
+                if value is not None:
+                    self._cache.set(path, value)
+                else:
+                    self._cache.ensure_node(path)
+            for child in self._backend.get_children(path):
                 walk(path.rstrip("/") + "/" + child)
 
         walk("/")

@@ -32,6 +32,7 @@ from dcos_commons_tpu.storage.persister import (
     PersisterError,
     SetOp,
     TransactionOp,
+    normalize_path,
 )
 
 _HEADER = struct.Struct("<II")  # (length, crc32)
@@ -92,7 +93,18 @@ class FileWalPersister(Persister):
             payload = data[start:end]
             if zlib.crc32(payload) != crc:
                 break  # corrupt tail record
-            self._mem.apply(_decode_txn(payload))
+            # Replay must be idempotent: a crash inside compact() after
+            # the snapshot rename but before the WAL truncation leaves a
+            # WAL whose deletes may reference paths the snapshot no
+            # longer has.  Deletes of missing paths are no-ops here.
+            for op in _decode_txn(payload):
+                if isinstance(op, SetOp):
+                    self._mem.set(op.path, op.value)
+                else:
+                    try:
+                        self._mem.recursive_delete(op.path)
+                    except PersisterError:
+                        pass
             self._records_since_compact += 1
             offset, good = end, end
         if good < len(data):
@@ -153,6 +165,8 @@ class FileWalPersister(Persister):
 
     def set(self, path: str, value: bytes) -> None:
         with self._lock:
+            if normalize_path(path) == "/":
+                raise PersisterError("cannot store a value at '/'", path)
             self._append([SetOp(path, value)])
             self._mem.set(path, value)
             self._maybe_compact()
@@ -172,11 +186,12 @@ class FileWalPersister(Persister):
         with self._lock:
             ops = list(ops)
             # validate against the RAM image first: WAL must never
-            # contain a transaction that fails on replay
+            # contain a transaction that fails when applied below
             for op in ops:
-                if isinstance(op, DeleteOp) and not self._mem.exists(op.path) \
-                        and not self._mem.get_children_or_empty(op.path):
+                if isinstance(op, DeleteOp) and not self._mem.exists(op.path):
                     raise PersisterError(f"path not found: {op.path}", op.path)
+                if isinstance(op, SetOp) and normalize_path(op.path) == "/":
+                    raise PersisterError("cannot store a value at '/'", op.path)
             self._append(ops)
             self._mem.apply(ops)
             self._maybe_compact()

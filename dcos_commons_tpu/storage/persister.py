@@ -44,6 +44,21 @@ def child_of(path: str, *names: str) -> str:
     return normalize_path("/".join([path, *names]))
 
 
+def namespace_root(namespace: str) -> str:
+    """Root prefix for a (possibly multi-segment) service namespace.
+
+    Reference: SchedulerBuilder namespacing for multi-service mode.
+    """
+    return f"/{namespace.strip('/')}" if namespace else ""
+
+
+def validate_key(key: str, what: str = "key") -> str:
+    """Reject keys that would traverse or collapse storage paths."""
+    if not key or "/" in key:
+        raise PersisterError(f"invalid {what}: {key!r}")
+    return key
+
+
 @dataclass(frozen=True)
 class SetOp:
     path: str
@@ -94,6 +109,13 @@ class Persister(ABC):
         pass
 
     # convenience -----------------------------------------------------
+
+    def get_or_none(self, path: str) -> Optional[bytes]:
+        """Value at ``path``, or None when the path is absent."""
+        try:
+            return self.get(path)
+        except PersisterError:
+            return None
 
     def exists(self, path: str) -> bool:
         try:
@@ -163,6 +185,11 @@ class MemPersister(Persister):
             return node.value
 
     def set(self, path: str, value: bytes) -> None:
+        if normalize_path(path) == "/":
+            # the root carries no value: dump()/snapshots only cover
+            # children, so a root value would silently vanish across
+            # compaction — forbid it outright
+            raise PersisterError("cannot store a value at '/'", path)
         with self._lock:
             self._ensure(path).value = value
 

@@ -1,0 +1,387 @@
+"""Plan engine tests (mirrors reference plan/ + strategy/ test suites)."""
+
+import time
+
+import pytest
+
+from dcos_commons_tpu.common import Label, TaskInfo, TaskState, TaskStatus, new_task_id
+from dcos_commons_tpu.plan import (
+    CanaryStrategy,
+    DefaultPlanCoordinator,
+    DefaultPlanManager,
+    DependencyStrategy,
+    DeployPlanFactory,
+    DeploymentStep,
+    ExponentialBackoff,
+    ParallelStrategy,
+    Phase,
+    Plan,
+    PlanGenerator,
+    PodInstanceRequirement,
+    SerialStrategy,
+    Status,
+    strategy_for_name,
+)
+from dcos_commons_tpu.specification import from_yaml
+from dcos_commons_tpu.specification.specs import task_full_name
+from dcos_commons_tpu.state import StateStore
+from dcos_commons_tpu.storage import MemPersister
+
+YAML = """
+name: svc
+pods:
+  hello:
+    count: 3
+    tasks:
+      server:
+        goal: RUNNING
+        cmd: "sleep 1000"
+  once:
+    count: 1
+    tasks:
+      init:
+        goal: ONCE
+        cmd: "echo done"
+"""
+
+GANG_YAML = """
+name: jax
+pods:
+  trainer:
+    count: 4
+    gang: true
+    tpu:
+      topology: 4x4
+      chips-per-host: 4
+    tasks:
+      worker:
+        goal: RUNNING
+        cmd: "python train.py"
+"""
+
+
+def make_step(name="hello-0", pod_yaml=YAML, pod="hello", instances=None, backoff=None):
+    spec = from_yaml(pod_yaml)
+    req = PodInstanceRequirement(
+        pod=spec.pod(pod), instances=instances or [0]
+    )
+    return DeploymentStep(name, req, backoff=backoff)
+
+
+def drive_to_running(step, ready=True):
+    req = step.start()
+    assert req is not None
+    ids = {n: new_task_id(n) for n in req.task_names()}
+    step.record_launch(ids)
+    for name, tid in ids.items():
+        step.update(TaskStatus(task_id=tid, state=TaskState.RUNNING, ready=ready))
+    return ids
+
+
+# -- step lifecycle ---------------------------------------------------
+
+
+def test_step_happy_path():
+    step = make_step()
+    assert step.get_status() == Status.PENDING
+    req = step.start()
+    assert req.asset_names == {"hello-0"}
+    assert req.task_names() == ["hello-0-server"]
+    ids = {n: new_task_id(n) for n in req.task_names()}
+    step.record_launch(ids)
+    assert step.get_status() == Status.STARTING
+    step.update(
+        TaskStatus(task_id=ids["hello-0-server"], state=TaskState.RUNNING, ready=True)
+    )
+    assert step.get_status() == Status.COMPLETE
+    # complete step offers no more work
+    assert step.start() is None
+
+
+def test_step_readiness_gate():
+    yaml_rc = YAML.replace(
+        'cmd: "sleep 1000"',
+        'cmd: "sleep 1000"\n        readiness-check:\n          cmd: "test -f ready"',
+    )
+    step = make_step(pod_yaml=yaml_rc)
+    req = step.start()
+    ids = {n: new_task_id(n) for n in req.task_names()}
+    step.record_launch(ids)
+    tid = ids["hello-0-server"]
+    step.update(TaskStatus(task_id=tid, state=TaskState.RUNNING, ready=False))
+    assert step.get_status() == Status.STARTED  # running but not ready
+    step.update(TaskStatus(task_id=tid, state=TaskState.RUNNING, ready=True))
+    assert step.get_status() == Status.COMPLETE
+
+
+def test_step_once_goal():
+    step = make_step(pod="once", name="once-0")
+    req = step.start()
+    ids = {n: new_task_id(n) for n in req.task_names()}
+    step.record_launch(ids)
+    tid = ids["once-0-init"]
+    step.update(TaskStatus(task_id=tid, state=TaskState.RUNNING))
+    assert step.get_status() == Status.STARTED  # running isn't done for ONCE
+    step.update(TaskStatus(task_id=tid, state=TaskState.FINISHED))
+    assert step.get_status() == Status.COMPLETE
+
+
+def test_step_failure_resets():
+    step = make_step()
+    ids = drive_to_running(step)
+    assert step.get_status() == Status.COMPLETE
+    step.restart()
+    req = step.start()
+    ids = {n: new_task_id(n) for n in req.task_names()}
+    step.record_launch(ids)
+    step.update(
+        TaskStatus(task_id=ids["hello-0-server"], state=TaskState.FAILED)
+    )
+    assert step.get_status() == Status.PENDING  # no backoff -> straight back
+
+
+def test_step_failure_backoff_delays():
+    backoff = ExponentialBackoff(initial_s=30, factor=2, max_s=300)
+    step = make_step(backoff=backoff)
+    req = step.start()
+    ids = {n: new_task_id(n) for n in req.task_names()}
+    step.record_launch(ids)
+    step.update(TaskStatus(task_id=ids["hello-0-server"], state=TaskState.FAILED))
+    assert step.get_status() == Status.DELAYED
+    assert step.start() is None  # delayed step yields no work
+
+
+def test_step_stale_status_ignored():
+    step = make_step()
+    ids = drive_to_running(step)
+    step.update(
+        TaskStatus(task_id=new_task_id("hello-0-server"), state=TaskState.FAILED)
+    )
+    assert step.get_status() == Status.COMPLETE  # stale id dropped
+
+
+def test_gang_step_covers_all_instances():
+    step = make_step(
+        name="trainer-gang", pod_yaml=GANG_YAML, pod="trainer",
+        instances=[0, 1, 2, 3],
+    )
+    req = step.start()
+    assert req.asset_names == {"trainer-0", "trainer-1", "trainer-2", "trainer-3"}
+    ids = {n: new_task_id(n) for n in req.task_names()}
+    assert len(ids) == 4
+    step.record_launch(ids)
+    items = list(ids.items())
+    for name, tid in items[:3]:
+        step.update(TaskStatus(task_id=tid, state=TaskState.RUNNING, ready=True))
+    assert step.get_status() == Status.STARTED  # 3 of 4 running
+    step.update(TaskStatus(task_id=items[3][1], state=TaskState.RUNNING, ready=True))
+    assert step.get_status() == Status.COMPLETE
+    # one worker dying resets the WHOLE gang
+    step.update(TaskStatus(task_id=items[0][1], state=TaskState.FAILED))
+    assert step.get_status() == Status.PENDING
+
+
+def test_step_interrupt():
+    step = make_step()
+    step.interrupt()
+    assert step.get_status() == Status.WAITING
+    assert step.start() is None
+    step.proceed()
+    assert step.get_status() == Status.PENDING
+
+
+# -- strategies -------------------------------------------------------
+
+
+def completed_step(name):
+    step = make_step(name=name)
+    step.force_complete()
+    return step
+
+
+def test_serial_strategy():
+    steps = [make_step(f"s{i}", instances=[i]) for i in range(3)]
+    strat = SerialStrategy()
+    assert strat.candidates(steps, set()) == [steps[0]]
+    steps[0].force_complete()
+    assert strat.candidates(steps, set()) == [steps[1]]
+    # dirty asset blocks the candidate AND everything after it
+    assert strat.candidates(steps, {"hello-1"}) == []
+
+
+def test_parallel_strategy():
+    steps = [make_step(f"s{i}", instances=[i]) for i in range(3)]
+    strat = ParallelStrategy()
+    assert strat.candidates(steps, set()) == steps
+    steps[1].force_complete()
+    assert strat.candidates(steps, set()) == [steps[0], steps[2]]
+    assert strat.candidates(steps, {"hello-2"}) == [steps[0]]
+
+
+def test_canary_strategy():
+    steps = [make_step(f"s{i}", instances=[i]) for i in range(3)]
+    strat = CanaryStrategy(SerialStrategy(), canary_count=1)
+    assert strat.is_interrupted()
+    assert strat.candidates(steps, set()) == []
+    strat.proceed()  # release the canary
+    assert strat.candidates(steps, set()) == [steps[0]]
+    steps[0].force_complete()
+    assert strat.candidates(steps, set()) == []  # waits for 2nd proceed
+    strat.proceed()
+    assert strat.candidates(steps, set()) == [steps[1]]
+
+
+def test_dependency_strategy():
+    steps = {name: make_step(name, instances=[i])
+             for i, name in enumerate(["a", "b", "c"])}
+    strat = DependencyStrategy({"c": ["a", "b"], "b": ["a"]})
+    ordered = list(steps.values())
+    assert strat.candidates(ordered, set()) == [steps["a"]]
+    steps["a"].force_complete()
+    assert strat.candidates(ordered, set()) == [steps["b"]]
+    steps["b"].force_complete()
+    assert strat.candidates(ordered, set()) == [steps["c"]]
+
+
+def test_strategy_names():
+    assert isinstance(strategy_for_name("serial"), SerialStrategy)
+    assert isinstance(strategy_for_name("parallel"), ParallelStrategy)
+    assert isinstance(strategy_for_name("serial-canary"), CanaryStrategy)
+    with pytest.raises(ValueError):
+        strategy_for_name("bogus")
+
+
+# -- phases/plans/aggregation ----------------------------------------
+
+
+def test_plan_aggregation():
+    spec = from_yaml(YAML)
+    factory = DeployPlanFactory()
+    store = StateStore(MemPersister())
+    plan = factory.build(spec, store, "cfg-1")
+    assert plan.get_status() == Status.PENDING
+    assert [p.name for p in plan.phases] == ["hello", "once"]
+    # serial over phases: only first phase's first step is a candidate
+    candidates = plan.candidates(set())
+    assert [s.name for s in candidates] == ["hello-0:[server]"]
+    drive_to_running(candidates[0])
+    assert plan.get_status() == Status.IN_PROGRESS
+    # complete everything
+    for step in plan.all_steps():
+        step.force_complete()
+    assert plan.get_status() == Status.COMPLETE
+
+
+def test_plan_interrupt_waiting():
+    spec = from_yaml(YAML)
+    plan = DeployPlanFactory().build(spec, StateStore(MemPersister()), "c")
+    plan.interrupt()
+    assert plan.get_status() == Status.WAITING
+    assert plan.candidates(set()) == []
+    plan.proceed()
+    assert plan.get_status() == Status.PENDING
+
+
+def test_coordinator_dirty_assets():
+    spec = from_yaml(YAML)
+    store = StateStore(MemPersister())
+    deploy = DeployPlanFactory().build(spec, store, "c")
+    # a second plan touching the same pod instances
+    other = DeployPlanFactory().build(spec, store, "c", plan_name="other")
+    coordinator = DefaultPlanCoordinator(
+        [DefaultPlanManager(deploy), DefaultPlanManager(other)]
+    )
+    candidates = coordinator.get_candidates()
+    # both plans want hello-0 — only one may have it
+    assert len([s for s in candidates if "hello-0" in s.get_asset_names()]) == 1
+    assert coordinator.has_work()
+
+
+def test_coordinator_excludes_in_progress():
+    spec = from_yaml(YAML)
+    store = StateStore(MemPersister())
+    deploy = DeployPlanFactory().build(spec, store, "c")
+    other = DeployPlanFactory().build(spec, store, "c", plan_name="other")
+    coordinator = DefaultPlanCoordinator(
+        [DefaultPlanManager(deploy), DefaultPlanManager(other)]
+    )
+    # drive deploy's hello-0 to STARTING: it holds the asset
+    step = deploy.candidates(set())[0]
+    req = step.start()
+    step.record_launch({n: new_task_id(n) for n in req.task_names()})
+    assert step.get_status() == Status.STARTING
+    for cand in coordinator.get_candidates():
+        assert "hello-0" not in cand.get_asset_names()
+
+
+# -- factory + resume -------------------------------------------------
+
+
+def seed_running_task(store, pod_type, index, task, config_id):
+    full = task_full_name(pod_type, index, task)
+    info = TaskInfo(
+        name=full,
+        task_id=new_task_id(full),
+        pod_type=pod_type,
+        pod_index=index,
+        labels={Label.TARGET_CONFIG: config_id},
+    )
+    store.store_tasks([info])
+    store.store_status(
+        full, TaskStatus(task_id=info.task_id, state=TaskState.RUNNING, ready=True)
+    )
+    return info
+
+
+def test_factory_resumes_completed_steps():
+    """Scheduler-restart semantics (reference: SchedulerRestartServiceTest)."""
+    spec = from_yaml(YAML)
+    store = StateStore(MemPersister())
+    seed_running_task(store, "hello", 0, "server", "cfg")
+    plan = DeployPlanFactory().build(spec, store, "cfg")
+    statuses = {s.name: s.get_status() for s in plan.all_steps()}
+    assert statuses["hello-0:[server]"] == Status.COMPLETE
+    assert statuses["hello-1:[server]"] == Status.PENDING
+
+
+def test_factory_old_config_pending():
+    spec = from_yaml(YAML)
+    store = StateStore(MemPersister())
+    seed_running_task(store, "hello", 0, "server", "OLD-cfg")
+    plan = DeployPlanFactory().build(spec, store, "NEW-cfg")
+    assert plan.all_steps()[0].get_status() == Status.PENDING
+
+
+def test_factory_gang_plan():
+    spec = from_yaml(GANG_YAML)
+    store = StateStore(MemPersister())
+    plan = DeployPlanFactory().build(spec, store, "cfg")
+    steps = plan.all_steps()
+    assert len(steps) == 1
+    assert steps[0].requirement.instances == [0, 1, 2, 3]
+
+
+def test_plan_generator_custom_phases():
+    yaml_plans = YAML + """
+plans:
+  deploy:
+    strategy: serial
+    phases:
+      first:
+        strategy: parallel
+        pod: hello
+      boot:
+        strategy: serial
+        pod: once
+        steps:
+          - 0: [[init]]
+"""
+    spec = from_yaml(yaml_plans)
+    store = StateStore(MemPersister())
+    plan = PlanGenerator().generate(
+        spec, "deploy", spec.plans["deploy"], store, "cfg"
+    )
+    assert [p.name for p in plan.phases] == ["first", "boot"]
+    assert len(plan.phases[0].steps) == 3
+    assert isinstance(plan.phases[0].strategy, ParallelStrategy)
+    assert plan.phases[1].steps[0].requirement.tasks_to_launch == ["init"]

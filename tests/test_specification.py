@@ -1,0 +1,214 @@
+"""Spec layer tests (mirrors reference YAMLToInternalMappersTest + validate/ tests)."""
+
+import dataclasses
+
+import pytest
+
+from dcos_commons_tpu.specification import (
+    ConfigValidationError,
+    GoalState,
+    PodSpec,
+    ServiceSpec,
+    SpecError,
+    TaskSpec,
+    TpuSpec,
+    from_yaml,
+    render_template,
+    validate_spec_change,
+)
+from dcos_commons_tpu.specification.specs import (
+    ResourceSpec,
+    VolumeSpec,
+    pod_instance_name,
+    task_full_name,
+)
+
+HELLO_YAML = """
+name: {{FRAMEWORK_NAME}}
+user: nobody
+pods:
+  hello:
+    count: {{HELLO_COUNT:-2}}
+    placement: 'max-per-host:1'
+    tasks:
+      server:
+        goal: RUNNING
+        cmd: "echo hello >> hello-container-path/output && sleep 1000"
+        cpus: {{HELLO_CPUS:-0.5}}
+        memory: 256
+        volume:
+          path: hello-container-path
+          type: ROOT
+          size: 64
+        env:
+          GREETING: hi
+        ports:
+          http:
+            port: 0
+            vip: hello:80
+        health-check:
+          cmd: "stat hello-container-path/output"
+          interval: 15
+        readiness-check:
+          cmd: "test -f ready"
+plans:
+  deploy:
+    strategy: serial
+    phases:
+      hello-deploy:
+        strategy: parallel
+        pod: hello
+"""
+
+JAX_YAML = """
+name: jax-trainer
+pods:
+  trainer:
+    count: 4
+    gang: true
+    tpu:
+      generation: v5e
+      chips-per-host: 4
+      topology: 4x4
+    tasks:
+      worker:
+        goal: FINISH
+        cmd: "python -m train"
+        cpus: 4
+        memory: 8192
+"""
+
+
+def test_render_template():
+    env = {"A": "1"}
+    assert render_template("x={{A}} y={{B:-fallback}}", env) == "x=1 y=fallback"
+    with pytest.raises(SpecError) as err:
+        render_template("{{MISSING_ONE}} {{MISSING_TWO}}", {})
+    assert "MISSING_ONE" in str(err.value)
+    assert "MISSING_TWO" in str(err.value)
+
+
+def test_yaml_to_spec():
+    spec = from_yaml(HELLO_YAML, {"FRAMEWORK_NAME": "hello-world"})
+    assert spec.name == "hello-world"
+    assert spec.user == "nobody"
+    pod = spec.pod("hello")
+    assert pod.count == 2
+    assert pod.placement == "max-per-host:1"
+    task = pod.task("server")
+    assert task.goal == GoalState.RUNNING
+    assert task.resources.cpus == 0.5
+    assert task.resources.memory_mb == 256
+    assert task.resources.ports[0].name == "http"
+    assert task.resources.ports[0].vip == "hello:80"
+    assert task.volumes[0] == VolumeSpec(
+        container_path="hello-container-path", size_mb=64, type="ROOT"
+    )
+    assert task.env == {"GREETING": "hi"}
+    assert task.health_check.interval_s == 15
+    assert task.readiness_check.cmd == "test -f ready"
+    assert spec.plans["deploy"]["phases"]["hello-deploy"]["pod"] == "hello"
+
+
+def test_yaml_tpu_pod():
+    spec = from_yaml(JAX_YAML)
+    pod = spec.pod("trainer")
+    assert pod.gang
+    assert pod.tpu == TpuSpec(generation="v5e", chips_per_host=4, topology="4x4")
+    assert pod.tpu.total_chips == 16
+    assert pod.tpu.topology_dims() == (4, 4)
+    assert pod.task("worker").goal == GoalState.FINISH
+
+
+def test_no_gpus_anywhere():
+    """North-star requirement (BASELINE.md): no gpus scalar exists."""
+    assert not hasattr(ResourceSpec(), "gpus")
+
+
+def test_spec_roundtrip():
+    spec = from_yaml(HELLO_YAML, {"FRAMEWORK_NAME": "rt"})
+    restored = ServiceSpec.from_dict(spec.to_dict())
+    assert restored == spec
+    assert restored.pod("hello").task("server").health_check == \
+        spec.pod("hello").task("server").health_check
+
+
+def test_instance_naming():
+    assert pod_instance_name("hello", 0) == "hello-0"
+    assert task_full_name("hello", 1, "server") == "hello-1-server"
+
+
+def test_yaml_errors():
+    with pytest.raises(SpecError):
+        from_yaml("name: x\npods: {}")
+    with pytest.raises(SpecError):
+        from_yaml("pods:\n  a:\n    tasks:\n      t:\n        cmd: x")
+    with pytest.raises(SpecError):
+        from_yaml("name: x\npods:\n  a: {count: 1}")
+
+
+# -- validators -------------------------------------------------------
+
+
+def jax_spec(**overrides):
+    spec = from_yaml(JAX_YAML)
+    if overrides:
+        pod = dataclasses.replace(spec.pods[0], **overrides)
+        spec = dataclasses.replace(spec, pods=(pod,))
+    return spec
+
+
+def test_validate_initial_deploy_ok():
+    validate_spec_change(None, jax_spec())
+
+
+def test_validate_name_change_rejected():
+    old = jax_spec()
+    new = dataclasses.replace(old, name="renamed")
+    with pytest.raises(ConfigValidationError):
+        validate_spec_change(old, new)
+
+
+def test_validate_user_change_rejected():
+    old = dataclasses.replace(jax_spec(), user="alice")
+    new = dataclasses.replace(old, user="bob")
+    with pytest.raises(ConfigValidationError):
+        validate_spec_change(old, new)
+
+
+def test_validate_shrink_rejected():
+    old = from_yaml(HELLO_YAML, {"FRAMEWORK_NAME": "s", "HELLO_COUNT": "3"})
+    new = from_yaml(HELLO_YAML, {"FRAMEWORK_NAME": "s", "HELLO_COUNT": "2"})
+    with pytest.raises(ConfigValidationError) as err:
+        validate_spec_change(old, new)
+    assert "shrink" in str(err.value)
+    # growth is fine
+    bigger = from_yaml(HELLO_YAML, {"FRAMEWORK_NAME": "s", "HELLO_COUNT": "5"})
+    validate_spec_change(old, bigger)
+
+
+def test_validate_volume_change_rejected():
+    old = from_yaml(HELLO_YAML, {"FRAMEWORK_NAME": "s"})
+    changed = HELLO_YAML.replace("size: 64", "size: 128")
+    new = from_yaml(changed, {"FRAMEWORK_NAME": "s"})
+    with pytest.raises(ConfigValidationError) as err:
+        validate_spec_change(old, new)
+    assert "volume" in str(err.value)
+
+
+def test_validate_topology_change_rejected():
+    old = jax_spec()
+    new_yaml = JAX_YAML.replace("topology: 4x4", "topology: 2x2").replace(
+        "count: 4", "count: 1"
+    )
+    new = from_yaml(new_yaml)
+    with pytest.raises(ConfigValidationError) as err:
+        validate_spec_change(old, new)
+    assert "topology" in str(err.value).lower()
+
+
+def test_validate_gang_count_topology_mismatch():
+    bad = jax_spec(count=3)  # 4x4 topology at 4 chips/host implies 4 hosts
+    with pytest.raises(ConfigValidationError) as err:
+        validate_spec_change(None, bad)
+    assert "count 3" in str(err.value)
