@@ -1,0 +1,19 @@
+"""T1/L1: per-host agents — launch, kill, observe tasks.
+
+The reference splits this between Mesos agents (launching containers)
+and the sdk/bootstrap Go binary running inside each sandbox (DNS wait,
+config render, CA install — sdk/bootstrap/main.go:65-98).  The TPU
+rebuild owns both halves: an Agent launches worker processes on a host
+and provisions the sandbox (env, config templates, libtpu/JAX env),
+and reports TaskStatus transitions back to the scheduler.
+
+LocalProcessAgent runs tasks as real subprocesses on this machine —
+the integration substrate (every host in the simulated fleet maps to a
+sandbox directory).  A production deployment runs one agent per TPU VM
+speaking the same interface over DCN; the scheduler does not care.
+"""
+
+from dcos_commons_tpu.agent.base import Agent
+from dcos_commons_tpu.agent.local import LocalProcessAgent
+
+__all__ = ["Agent", "LocalProcessAgent"]

@@ -1,0 +1,33 @@
+"""Agent contract between the scheduler and host-local executors."""
+
+from __future__ import annotations
+
+from typing import List, Set
+
+from dcos_commons_tpu.common import TaskInfo, TaskStatus
+
+
+class Agent:
+    """What the scheduler needs from the thing that runs tasks.
+
+    Reference analogues: launch = OfferAccepter LAUNCH operations,
+    kill = TaskKiller -> driver.killTask, active_task_ids = the task
+    reconciliation query (ImplicitReconciler / ExplicitReconciler).
+    """
+
+    def launch(self, task_infos: List[TaskInfo]) -> None:
+        """Start the given tasks.  Must be idempotent per task_id."""
+        raise NotImplementedError
+
+    def kill(self, task_id: str, grace_period_s: float = 0.0) -> None:
+        """Request termination; a terminal TaskStatus must follow."""
+        raise NotImplementedError
+
+    def active_task_ids(self) -> Set[str]:
+        """Task ids currently known (running or starting) — the
+        reconciliation source of truth."""
+        raise NotImplementedError
+
+    def poll(self) -> List[TaskStatus]:
+        """Drain pending status transitions (RUNNING, FINISHED, ...)."""
+        raise NotImplementedError

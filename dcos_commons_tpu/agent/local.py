@@ -1,0 +1,237 @@
+"""LocalProcessAgent: run tasks as real subprocesses with sandboxes.
+
+Plays the role of the Mesos agent + sdk/bootstrap for a simulated
+fleet: each task gets a sandbox directory, its env contract (the
+PodInfoBuilder-assembled env), readiness-check execution (reference:
+readiness spec stored as a label, PodInfoBuilder.java:511-526, executed
+task-side), and health-check supervision with kill-on-max-failures.
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import signal
+import subprocess
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set
+
+from dcos_commons_tpu.common import TaskInfo, TaskState, TaskStatus
+from dcos_commons_tpu.specification.specs import (
+    HealthCheckSpec,
+    ReadinessCheckSpec,
+)
+
+
+@dataclass
+class _Running:
+    info: TaskInfo
+    process: subprocess.Popen
+    sandbox: str
+    readiness: Optional[ReadinessCheckSpec]
+    health: Optional[HealthCheckSpec]
+    started_at: float
+    ready_reported: bool = False
+    running_reported: bool = False
+    health_failures: int = 0
+    last_check_at: float = 0.0
+    kill_requested: bool = False
+    kill_deadline: float = 0.0
+
+
+class LocalProcessAgent:
+    """One agent process simulating every host in the fleet.
+
+    ``readiness_for``/``health_for`` map task *spec* checks in; the
+    scheduler passes them at launch via TaskInfo labels is avoided —
+    instead the scheduler registers specs with the agent directly
+    (launch_with_checks), keeping TaskInfo JSON-small.
+    """
+
+    def __init__(self, workdir: str):
+        self._workdir = workdir
+        self._tasks: Dict[str, _Running] = {}
+        self._pending: List[TaskStatus] = []
+        self._lock = threading.RLock()
+        os.makedirs(workdir, exist_ok=True)
+
+    # -- Agent --------------------------------------------------------
+
+    def launch(self, task_infos: List[TaskInfo]) -> None:
+        for info in task_infos:
+            self.launch_one(info)
+
+    def launch_one(
+        self,
+        info: TaskInfo,
+        readiness: Optional[ReadinessCheckSpec] = None,
+        health: Optional[HealthCheckSpec] = None,
+    ) -> None:
+        with self._lock:
+            if info.task_id in self._tasks:
+                return  # idempotent
+            sandbox = os.path.join(self._workdir, info.name)
+            os.makedirs(sandbox, exist_ok=True)
+            env = dict(os.environ)
+            env.update(info.env)
+            env["SANDBOX"] = sandbox
+            try:
+                process = subprocess.Popen(
+                    ["/bin/sh", "-c", info.command],
+                    cwd=sandbox,
+                    env=env,
+                    stdout=open(os.path.join(sandbox, "stdout"), "ab"),
+                    stderr=open(os.path.join(sandbox, "stderr"), "ab"),
+                    start_new_session=True,
+                )
+            except OSError as e:
+                self._pending.append(
+                    TaskStatus(
+                        task_id=info.task_id,
+                        state=TaskState.ERROR,
+                        message=f"launch failed: {e}",
+                        agent_id=info.agent_id,
+                    )
+                )
+                return
+            self._tasks[info.task_id] = _Running(
+                info=info,
+                process=process,
+                sandbox=sandbox,
+                readiness=readiness,
+                health=health,
+                started_at=time.monotonic(),
+            )
+
+    def kill(self, task_id: str, grace_period_s: float = 0.0) -> None:
+        with self._lock:
+            running = self._tasks.get(task_id)
+            if running is None:
+                return
+            running.kill_requested = True
+            running.kill_deadline = time.monotonic() + grace_period_s
+            try:
+                os.killpg(running.process.pid, signal.SIGTERM)
+            except (ProcessLookupError, PermissionError):
+                pass
+
+    def active_task_ids(self) -> Set[str]:
+        with self._lock:
+            return set(self._tasks)
+
+    def poll(self) -> List[TaskStatus]:
+        with self._lock:
+            out = list(self._pending)
+            self._pending.clear()
+            now = time.monotonic()
+            finished: List[str] = []
+            for task_id, running in self._tasks.items():
+                out.extend(self._poll_one(task_id, running, now, finished))
+            for task_id in finished:
+                del self._tasks[task_id]
+            return out
+
+    # -- internals ----------------------------------------------------
+
+    def _poll_one(
+        self, task_id: str, running: _Running, now: float, finished: List[str]
+    ) -> List[TaskStatus]:
+        out: List[TaskStatus] = []
+        info = running.info
+        returncode = running.process.poll()
+        if returncode is not None:
+            finished.append(task_id)
+            if running.kill_requested:
+                state = TaskState.KILLED
+            elif returncode == 0:
+                state = TaskState.FINISHED
+            else:
+                state = TaskState.FAILED
+            out.append(
+                TaskStatus(
+                    task_id=task_id,
+                    state=state,
+                    message=f"exit {returncode}",
+                    agent_id=info.agent_id,
+                )
+            )
+            return out
+        if running.kill_requested and now >= running.kill_deadline:
+            try:
+                os.killpg(running.process.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+        if not running.running_reported:
+            running.running_reported = True
+            out.append(
+                TaskStatus(
+                    task_id=task_id,
+                    state=TaskState.RUNNING,
+                    agent_id=info.agent_id,
+                    ready=running.readiness is None,
+                )
+            )
+        # readiness: run the check until it passes once
+        if running.readiness is not None and not running.ready_reported:
+            if now - running.last_check_at >= 0:  # every poll; interval in prod
+                running.last_check_at = now
+                if self._run_check(running, running.readiness.cmd,
+                                   running.readiness.timeout_s):
+                    running.ready_reported = True
+                    out.append(
+                        TaskStatus(
+                            task_id=task_id,
+                            state=TaskState.RUNNING,
+                            agent_id=info.agent_id,
+                            ready=True,
+                            message="readiness check passed",
+                        )
+                    )
+        # health: after grace period, failures accumulate -> kill
+        health = running.health
+        if health is not None and \
+                now - running.started_at > health.grace_period_s:
+            if self._run_check(running, health.cmd, health.timeout_s):
+                running.health_failures = 0
+            else:
+                running.health_failures += 1
+                if running.health_failures >= health.max_consecutive_failures:
+                    self.kill(task_id)
+        return out
+
+    def _run_check(self, running: _Running, cmd: str, timeout_s: float) -> bool:
+        env = dict(os.environ)
+        env.update(running.info.env)
+        env["SANDBOX"] = running.sandbox
+        try:
+            result = subprocess.run(
+                ["/bin/sh", "-c", cmd],
+                cwd=running.sandbox,
+                env=env,
+                timeout=timeout_s,
+                capture_output=True,
+            )
+            return result.returncode == 0
+        except subprocess.TimeoutExpired:
+            return False
+
+    # -- test helpers -------------------------------------------------
+
+    def sandbox_of(self, task_name: str) -> str:
+        return os.path.join(self._workdir, task_name)
+
+    def shutdown(self) -> None:
+        with self._lock:
+            for task_id in list(self._tasks):
+                self.kill(task_id)
+            for running in self._tasks.values():
+                try:
+                    running.process.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    try:
+                        os.killpg(running.process.pid, signal.SIGKILL)
+                    except (ProcessLookupError, PermissionError):
+                        pass
+            self._tasks.clear()

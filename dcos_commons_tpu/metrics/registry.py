@@ -1,0 +1,92 @@
+"""Counter/gauge registry with Prometheus text exposition.
+
+Reference: metrics/Metrics.java — counters incremented on the hot path
+(offers received/processed, revives, declines, suppresses, operation
+types, task statuses) and scraped at /v1/metrics/prometheus.  StatsD
+push is env-gated as in the reference (STATSD_UDP_HOST/PORT,
+Metrics.java:74-79).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+
+class Metrics:
+    def __init__(self) -> None:
+        self._counters: Dict[str, float] = {}
+        self._gauges: Dict[str, Callable[[], float]] = {}
+        self._timers: Dict[str, list] = {}
+        self._lock = threading.Lock()
+        self._statsd: Optional[socket.socket] = None
+        self._statsd_addr = None
+        host = os.environ.get("STATSD_UDP_HOST")
+        port = os.environ.get("STATSD_UDP_PORT")
+        if host and port:
+            self._statsd = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            self._statsd_addr = (host, int(port))
+
+    def incr(self, name: str, value: float = 1.0) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0.0) + value
+        if self._statsd is not None:
+            try:
+                self._statsd.sendto(
+                    f"{name}:{value}|c".encode(), self._statsd_addr
+                )
+            except OSError:
+                pass
+
+    def gauge(self, name: str, fn: Callable[[], float]) -> None:
+        with self._lock:
+            self._gauges[name] = fn
+
+    def time(self, name: str):
+        """Context manager recording wall seconds (offers.process timer)."""
+        registry = self
+
+        class _Timer:
+            def __enter__(self):
+                self._t0 = time.monotonic()
+                return self
+
+            def __exit__(self, *exc):
+                elapsed = time.monotonic() - self._t0
+                with registry._lock:
+                    registry._timers.setdefault(name, []).append(elapsed)
+                    del registry._timers[name][:-256]  # ring buffer
+                return False
+
+        return _Timer()
+
+    def counters(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._counters)
+
+    def snapshot(self) -> Dict[str, float]:
+        out = self.counters()
+        with self._lock:
+            gauges = dict(self._gauges)
+            for name, samples in self._timers.items():
+                if samples:
+                    out[f"{name}.avg_s"] = sum(samples) / len(samples)
+                    out[f"{name}.max_s"] = max(samples)
+        for name, fn in gauges.items():
+            try:
+                out[name] = float(fn())
+            except Exception:
+                pass
+        return out
+
+    def prometheus(self) -> str:
+        """Prometheus text format (reference: Metrics.java:85-97)."""
+        lines = []
+        for name, value in sorted(self.snapshot().items()):
+            metric = name.replace(".", "_").replace("-", "_").lower()
+            lines.append(f"# TYPE {metric} gauge")
+            lines.append(f"{metric} {value}")
+        return "\n".join(lines) + "\n"

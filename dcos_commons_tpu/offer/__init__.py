@@ -1,0 +1,49 @@
+"""L3 + L0-replacement: TPU slice inventory + evaluation + placement.
+
+The reference consumes Mesos resource *offers* (sdk/scheduler/.../offer/:
+MesosResourcePool, OfferEvaluator.java:65,113, evaluation stages,
+placement rules).  TPU fleets have no Mesos, so this package *owns*
+the substrate the reference outsourced (SURVEY.md section 7 delta a):
+
+- inventory.py   the fleet model: hosts, chips, ICI torus coordinates,
+                 and ResourceSnapshots (the offer equivalent)
+- ledger.py      the reservation ledger: WAL-backed, idempotent —
+                 replaces Mesos reservation labels + resource ids
+- torus.py       contiguous sub-slice search over the host grid
+- placement.py   placement-rule DSL (max-per-host, zones, task-type
+                 colocate/avoid, marathon-style JSON, torus rules)
+- evaluate.py    the evaluation pipeline: requirement + snapshots ->
+                 reserve/launch recommendations, or per-stage reasons
+- outcome.py     EvaluationOutcome + the "explain why placement
+                 failed" record (feeds debug/OfferOutcomeTracker)
+"""
+
+from dcos_commons_tpu.offer.inventory import (
+    ResourceSnapshot,
+    SliceInventory,
+    TpuHost,
+)
+from dcos_commons_tpu.offer.ledger import Reservation, ReservationLedger
+from dcos_commons_tpu.offer.outcome import EvaluationOutcome
+from dcos_commons_tpu.offer.placement import PlacementRule, parse_placement
+from dcos_commons_tpu.offer.evaluate import (
+    EvaluationResult,
+    LaunchRecommendation,
+    OfferEvaluator,
+    ReserveRecommendation,
+)
+
+__all__ = [
+    "EvaluationOutcome",
+    "EvaluationResult",
+    "LaunchRecommendation",
+    "OfferEvaluator",
+    "PlacementRule",
+    "Reservation",
+    "ReservationLedger",
+    "ReserveRecommendation",
+    "ResourceSnapshot",
+    "SliceInventory",
+    "TpuHost",
+    "parse_placement",
+]

@@ -1,0 +1,495 @@
+"""The evaluation pipeline: requirement + snapshots -> operations.
+
+Reference: offer/evaluate/OfferEvaluator.java:65,113 and its stage
+pipeline (:250-310, new-pod :411-522): Placement -> per-task resources
+(cpu/mem/ports/volumes) -> launch, each stage returning an
+EvaluationOutcome; first fully-passing host wins (:137-248); existing
+pods reuse prior reservation ids (TaskResourceMapper) so relaunches
+keep their footprint.  PodInfoBuilder's TaskInfo assembly (env, ports,
+readiness labels) lives in ``_build_task_info`` here.
+
+TPU-first: gang requirements are evaluated atomically across hosts
+via torus.find_subslice; the evaluator allocates the pjit rendezvous
+point (worker-0 coordinator address) and injects the JAX distributed
+env contract into every worker (the moral equivalent of the
+reference's bootstrap DNS-wait, sdk/bootstrap/main.go:218-289).
+"""
+
+from __future__ import annotations
+
+import uuid
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from dcos_commons_tpu.common import Label, TaskInfo, new_task_id
+from dcos_commons_tpu.offer.inventory import ResourceSnapshot, SliceInventory
+from dcos_commons_tpu.offer.ledger import (
+    Reservation,
+    ReservationLedger,
+    new_reservation_id,
+)
+from dcos_commons_tpu.offer.outcome import EvaluationOutcome
+from dcos_commons_tpu.offer.placement import (
+    PlacementContext,
+    PlacementRule,
+    SameSliceRule,
+    parse_placement,
+)
+from dcos_commons_tpu.offer.torus import find_subslice
+from dcos_commons_tpu.plan.step import PodInstanceRequirement, RecoveryType
+from dcos_commons_tpu.specification.specs import (
+    PodSpec,
+    TaskSpec,
+    task_full_name,
+)
+from dcos_commons_tpu.state.state_store import StateStore
+
+# env contract injected into every launched task (reference analogue:
+# offer/taskdata/EnvConstants + PodInfoBuilder env assembly)
+ENV_POD_INSTANCE_INDEX = "POD_INSTANCE_INDEX"
+ENV_TASK_NAME = "TASK_NAME"
+ENV_FRAMEWORK_NAME = "FRAMEWORK_NAME"
+ENV_TPU_WORKER_ID = "TPU_WORKER_ID"
+ENV_TPU_WORKER_COUNT = "TPU_WORKER_COUNT"
+ENV_TPU_CHIPS_PER_HOST = "TPU_CHIPS_PER_HOST"
+ENV_TPU_TOPOLOGY = "TPU_TOPOLOGY"
+ENV_TPU_GENERATION = "TPU_GENERATION"
+ENV_COORDINATOR_ADDRESS = "COORDINATOR_ADDRESS"
+COORDINATOR_PORT_NAME = "coordinator"
+
+
+@dataclass
+class ReserveRecommendation:
+    reservation: Reservation
+
+
+@dataclass
+class LaunchRecommendation:
+    task_info: TaskInfo
+
+
+@dataclass
+class EvaluationResult:
+    passed: bool
+    outcome: EvaluationOutcome
+    reservations: List[Reservation] = field(default_factory=list)
+    task_infos: List[TaskInfo] = field(default_factory=list)
+
+    @property
+    def recommendations(self) -> List[object]:
+        return [ReserveRecommendation(r) for r in self.reservations] + [
+            LaunchRecommendation(t) for t in self.task_infos
+        ]
+
+
+class OfferEvaluator:
+    def __init__(
+        self,
+        state_store: StateStore,
+        ledger: ReservationLedger,
+        service_name: str,
+        target_config_id: str,
+    ):
+        self._state_store = state_store
+        self._ledger = ledger
+        self._service_name = service_name
+        self._target_config_id = target_config_id
+
+    def set_target_config(self, config_id: str) -> None:
+        self._target_config_id = config_id
+
+    # ------------------------------------------------------------------
+
+    def evaluate(
+        self,
+        requirement: PodInstanceRequirement,
+        inventory: SliceInventory,
+    ) -> EvaluationResult:
+        """Match one requirement against the current inventory."""
+        snapshots = inventory.snapshots(self._ledger)
+        ctx = PlacementContext(
+            pod_type=requirement.pod.type,
+            existing_tasks=[
+                t
+                for t in self._state_store.fetch_tasks()
+                # tasks being relaunched must not block their own placement
+                if t.name not in set(requirement.task_names())
+            ],
+            hosts={h.host_id: h for h in inventory.hosts()},
+        )
+
+        # In-place relaunch: reuse committed reservations when they are
+        # still valid (reference: existing-pod pipeline reusing prior
+        # resource ids, OfferEvaluator.java:266-310).  PERMANENT
+        # recovery skips this and re-places from scratch.
+        if requirement.recovery_type is not RecoveryType.PERMANENT:
+            reuse = self._try_reuse(requirement, inventory)
+            if reuse is not None:
+                return reuse
+
+        pod = requirement.pod
+        rule = parse_placement(pod.placement)
+        if pod.gang and pod.tpu is not None and pod.tpu.topology:
+            return self._evaluate_gang(requirement, snapshots, rule, ctx)
+        return self._evaluate_instances(requirement, snapshots, rule, ctx)
+
+    # -- reuse path ----------------------------------------------------
+
+    def _try_reuse(
+        self,
+        requirement: PodInstanceRequirement,
+        inventory: SliceInventory,
+    ) -> Optional[EvaluationResult]:
+        """Relaunch on existing reservations if every task of the
+        requirement still has its full footprint on healthy hosts."""
+        placements: List[Tuple[int, str, List[Reservation]]] = []
+        for index in requirement.instances:
+            host_ids = set()
+            reservations: List[Reservation] = []
+            for task_name in requirement.tasks_to_launch:
+                full = task_full_name(requirement.pod.type, index, task_name)
+                task_reservations = self._ledger.for_task(full)
+                if not task_reservations:
+                    return None
+                reservations.extend(task_reservations)
+                host_ids |= {r.host_id for r in task_reservations}
+            if len(host_ids) != 1:
+                return None
+            host_id = host_ids.pop()
+            if not inventory.is_up(host_id):
+                return None  # host gone: fall through to fresh placement
+            placements.append((index, host_id, reservations))
+
+        outcome = EvaluationOutcome.ok(
+            "reuse", f"relaunching in place on {[p[1] for p in placements]}"
+        )
+        task_infos = []
+        coordinator = self._existing_coordinator(requirement)
+        for worker_id, (index, host_id, reservations) in enumerate(placements):
+            host = inventory.host(host_id)
+            chips = sorted({c for r in reservations for c in r.chip_ids})
+            for task_name in requirement.tasks_to_launch:
+                task_spec = requirement.pod.task(task_name)
+                full = task_full_name(requirement.pod.type, index, task_name)
+                task_res = [r for r in reservations if r.task_name == full
+                            and r.container_path != COORDINATOR_PORT_NAME]
+                # rebuild the PORT_* env contract from the reservation's
+                # port list (appended in spec order at claim time)
+                port_env: Dict[str, str] = {}
+                if task_res:
+                    for port_spec, port in zip(
+                        task_spec.resources.ports, task_res[0].ports
+                    ):
+                        key = port_spec.env_key or f"PORT_{port_spec.name.upper()}"
+                        port_env[key] = str(port)
+                task_infos.append(
+                    self._build_task_info(
+                        requirement, task_spec, index, host,
+                        reservations=task_res,
+                        chips=chips,
+                        coordinator=coordinator,
+                        worker_id=worker_id,
+                        extra_env=port_env,
+                    )
+                )
+        return EvaluationResult(True, outcome, [], task_infos)
+
+    def _existing_coordinator(
+        self, requirement: PodInstanceRequirement
+    ) -> str:
+        # relaunches keep the original rendezvous point: reservations
+        # for instance 0 carry the coordinator port
+        for r in self._ledger.for_task(
+            task_full_name(
+                requirement.pod.type, 0, requirement.tasks_to_launch[0]
+            )
+        ):
+            if r.container_path == COORDINATOR_PORT_NAME and r.ports:
+                host = r.host_id
+                return f"{host}:{r.ports[0]}"
+        return ""
+
+    # -- fresh placement ----------------------------------------------
+
+    def _evaluate_gang(
+        self,
+        requirement: PodInstanceRequirement,
+        snapshots: List[ResourceSnapshot],
+        rule: PlacementRule,
+        ctx: PlacementContext,
+    ) -> EvaluationResult:
+        pod = requirement.pod
+        scalar_needs = _pod_scalar_needs(pod, requirement.tasks_to_launch)
+
+        def eligible(snap: ResourceSnapshot) -> EvaluationOutcome:
+            rule_outcome = rule.filter(snap, ctx)
+            if not rule_outcome.passed:
+                return rule_outcome
+            probe = snap.copy()
+            if not probe.try_consume_scalar(*scalar_needs):
+                return EvaluationOutcome.fail(
+                    f"host:{snap.host.host_id}",
+                    f"insufficient cpu/mem/disk for {scalar_needs}",
+                )
+            return EvaluationOutcome.ok(f"host:{snap.host.host_id}")
+
+        placement = find_subslice(
+            snapshots, pod.tpu.topology_dims(), pod.tpu.chips_per_host, eligible
+        )
+        if not placement.snapshots:
+            return EvaluationResult(False, placement.outcome)
+        if len(placement.snapshots) != len(requirement.instances):
+            placement.outcome.passed = False
+            placement.outcome.reason = (
+                f"topology yields {len(placement.snapshots)} hosts but pod "
+                f"count is {len(requirement.instances)}"
+            )
+            return EvaluationResult(False, placement.outcome)
+
+        # worker 0's host carries the jax.distributed coordinator
+        coord_snap = placement.snapshots[0]
+        coord_port = coord_snap.copy().allocate_port()
+        coordinator = f"{coord_snap.host.host_id}:{coord_port}"
+
+        reservations: List[Reservation] = []
+        task_infos: List[TaskInfo] = []
+        for worker_id, (index, snap) in enumerate(
+            zip(requirement.instances, placement.snapshots)
+        ):
+            work = snap.copy()
+            chips = work.try_consume_chips(snap.host.chips_per_host)
+            if chips is None:  # cannot happen post-eligibility; guard anyway
+                return EvaluationResult(
+                    False,
+                    EvaluationOutcome.fail(
+                        "gang", f"chips vanished on {snap.host.host_id}"
+                    ),
+                )
+            res, infos = self._claim_instance(
+                requirement, index, work, chips, coordinator,
+                coordinator_here=(worker_id == 0), worker_id=worker_id,
+            )
+            if res is None:
+                return EvaluationResult(
+                    False,
+                    EvaluationOutcome.fail(
+                        "gang", f"resource claim failed on {snap.host.host_id}"
+                    ),
+                )
+            reservations.extend(res)
+            task_infos.extend(infos)
+        return EvaluationResult(True, placement.outcome, reservations, task_infos)
+
+    def _evaluate_instances(
+        self,
+        requirement: PodInstanceRequirement,
+        snapshots: List[ResourceSnapshot],
+        rule: PlacementRule,
+        ctx: PlacementContext,
+    ) -> EvaluationResult:
+        """Non-gang: place each instance independently, first host wins
+        (reference: first fully-passing offer, OfferEvaluator.java:137-171)."""
+        pod = requirement.pod
+        reservations: List[Reservation] = []
+        task_infos: List[TaskInfo] = []
+        root = EvaluationOutcome.ok("evaluate", pod.type)
+        claimed_hosts: Dict[str, ResourceSnapshot] = {}
+        for index in requirement.instances:
+            placed = False
+            for snap in snapshots:
+                snap = claimed_hosts.get(snap.host.host_id, snap)
+                rule_outcome = rule.filter(snap, ctx)
+                if not rule_outcome.passed:
+                    root.children.append(rule_outcome)
+                    continue
+                work = snap.copy()
+                chips = None
+                if pod.tpu is not None:
+                    if not snap.host.generation:
+                        root.children.append(EvaluationOutcome.fail(
+                            f"host:{snap.host.host_id}", "not a TPU host"
+                        ))
+                        continue
+                    chips = work.try_consume_chips(pod.tpu.chips_per_host)
+                    if chips is None:
+                        root.children.append(EvaluationOutcome.fail(
+                            f"host:{snap.host.host_id}",
+                            f"needs {pod.tpu.chips_per_host} chips, "
+                            f"{len(snap.free_chips)} free",
+                        ))
+                        continue
+                res, infos = self._claim_instance(
+                    requirement, index, work, chips or [], coordinator="",
+                    coordinator_here=False, worker_id=index,
+                )
+                if res is None:
+                    root.children.append(EvaluationOutcome.fail(
+                        f"host:{snap.host.host_id}", "insufficient cpu/mem/disk"
+                    ))
+                    continue
+                reservations.extend(res)
+                task_infos.extend(infos)
+                claimed_hosts[snap.host.host_id] = work
+                # placement context must see this instance for max-per
+                # rules on subsequent instances in the same requirement
+                ctx.existing_tasks.extend(infos)
+                placed = True
+                root.children.append(EvaluationOutcome.ok(
+                    f"host:{snap.host.host_id}",
+                    f"{pod.type}-{index} placed",
+                ))
+                break
+            if not placed:
+                root.passed = False
+                root.reason = f"no host satisfies {pod.type}-{index}"
+                return EvaluationResult(False, root)
+        return EvaluationResult(True, root, reservations, task_infos)
+
+    # -- claim + TaskInfo assembly ------------------------------------
+
+    def _claim_instance(
+        self,
+        requirement: PodInstanceRequirement,
+        index: int,
+        work: ResourceSnapshot,
+        chips: List[str],
+        coordinator: str,
+        coordinator_here: bool,
+        worker_id: int,
+    ):
+        """Consume scalars/ports on ``work`` and emit reservations +
+        TaskInfos for every task of one pod instance."""
+        pod = requirement.pod
+        reservations: List[Reservation] = []
+        task_infos: List[TaskInfo] = []
+        chips_assigned = False
+        coord_res: Optional[Reservation] = None
+        if coordinator_here:
+            coord_port = work.allocate_port(int(coordinator.rsplit(":", 1)[1]))
+            if coord_port is None:
+                coord_port = work.allocate_port()
+                coordinator = f"{work.host.host_id}:{coord_port}"
+            coord_res = Reservation(
+                reservation_id=new_reservation_id(),
+                host_id=work.host.host_id,
+                task_name=task_full_name(
+                    pod.type, index, requirement.tasks_to_launch[0]
+                ),
+                cpus=0.0,
+                ports=[coord_port],
+                container_path=COORDINATOR_PORT_NAME,
+            )
+            reservations.append(coord_res)
+        for task_name in requirement.tasks_to_launch:
+            task_spec = pod.task(task_name)
+            full = task_full_name(pod.type, index, task_name)
+            if not work.try_consume_scalar(
+                task_spec.resources.cpus,
+                task_spec.resources.memory_mb,
+                task_spec.resources.disk_mb
+                + sum(v.size_mb for v in task_spec.volumes),
+            ):
+                return None, None
+            ports: List[int] = []
+            port_env: Dict[str, str] = {}
+            for port_spec in task_spec.resources.ports:
+                port = work.allocate_port(port_spec.port)
+                if port is None:
+                    return None, None
+                ports.append(port)
+                key = port_spec.env_key or f"PORT_{port_spec.name.upper()}"
+                port_env[key] = str(port)
+            task_chips = chips if not chips_assigned else []
+            chips_assigned = chips_assigned or bool(chips)
+            reservation = Reservation(
+                reservation_id=new_reservation_id(),
+                host_id=work.host.host_id,
+                task_name=full,
+                role=self._service_name,
+                cpus=task_spec.resources.cpus,
+                memory_mb=task_spec.resources.memory_mb,
+                disk_mb=task_spec.resources.disk_mb
+                + sum(v.size_mb for v in task_spec.volumes),
+                chip_ids=list(task_chips),
+                ports=ports,
+                volume_id=(uuid.uuid4().hex if task_spec.volumes else ""),
+                container_path=(
+                    task_spec.volumes[0].container_path if task_spec.volumes else ""
+                ),
+            )
+            reservations.append(reservation)
+            # the coordinator-port claim rides on the first task's
+            # resource ids so reservation GC (which keeps every id
+            # referenced by a stored TaskInfo) never reclaims it
+            info_res = [reservation]
+            if coord_res is not None and not task_infos:
+                info_res.append(coord_res)
+            info = self._build_task_info(
+                requirement, task_spec, index, work.host,
+                reservations=info_res, chips=chips,
+                coordinator=coordinator, worker_id=worker_id,
+                extra_env=port_env,
+            )
+            task_infos.append(info)
+        return reservations, task_infos
+
+    def _build_task_info(
+        self,
+        requirement: PodInstanceRequirement,
+        task_spec: TaskSpec,
+        index: int,
+        host,
+        reservations: List[Reservation],
+        chips: List[str],
+        coordinator: str,
+        worker_id: int = 0,
+        extra_env: Optional[Dict[str, str]] = None,
+    ) -> TaskInfo:
+        """Reference: PodInfoBuilder (offer/evaluate/PodInfoBuilder.java,
+        831 LoC) — command, env, readiness label, discovery assembly."""
+        pod = requirement.pod
+        full = task_full_name(pod.type, index, task_spec.name)
+        env = dict(task_spec.env)
+        env.update(extra_env or {})
+        env[ENV_POD_INSTANCE_INDEX] = str(index)
+        env[ENV_TASK_NAME] = full
+        env[ENV_FRAMEWORK_NAME] = self._service_name
+        if pod.tpu is not None:
+            env[ENV_TPU_WORKER_ID] = str(worker_id)
+            env[ENV_TPU_WORKER_COUNT] = str(len(requirement.instances))
+            env[ENV_TPU_CHIPS_PER_HOST] = str(pod.tpu.chips_per_host)
+            env[ENV_TPU_GENERATION] = pod.tpu.generation
+            if pod.tpu.topology:
+                env[ENV_TPU_TOPOLOGY] = pod.tpu.topology
+            if coordinator:
+                env[ENV_COORDINATOR_ADDRESS] = coordinator
+        labels = {
+            Label.TARGET_CONFIG: self._target_config_id,
+            Label.HOSTNAME: host.hostname,
+            Label.ZONE: host.zone,
+            Label.REGION: host.region,
+            Label.GOAL_STATE: task_spec.goal.value,
+        }
+        return TaskInfo(
+            name=full,
+            task_id=new_task_id(full),
+            agent_id=host.host_id,
+            pod_type=pod.type,
+            pod_index=index,
+            command=task_spec.cmd,
+            env=env,
+            resource_ids=[r.reservation_id for r in reservations],
+            tpu_chip_ids=list(chips),
+            volume_ids=[r.volume_id for r in reservations if r.volume_id],
+            labels=labels,
+        )
+
+
+def _pod_scalar_needs(pod: PodSpec, tasks_to_launch: List[str]) -> Tuple[float, int, int]:
+    cpus, mem, disk = 0.0, 0, 0
+    for name in tasks_to_launch:
+        spec = pod.task(name)
+        cpus += spec.resources.cpus
+        mem += spec.resources.memory_mb
+        disk += spec.resources.disk_mb + sum(v.size_mb for v in spec.volumes)
+    return cpus, mem, disk

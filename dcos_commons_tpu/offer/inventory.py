@@ -1,0 +1,224 @@
+"""The fleet model: TPU hosts, chips, torus coordinates, snapshots.
+
+Replaces Mesos agents + offers (reference: offer/MesosResourcePool.java
+— the consumable view of one offer — and the agent attributes consumed
+by placement rules).  The scheduler owns this inventory and synthesizes
+"offers" (ResourceSnapshots) from it each cycle, instead of waiting
+for a Mesos master to send them.
+
+Torus model: each physical TPU pod ("slice") is a grid of hosts; each
+host owns a contiguous block of chips (e.g. a v5e host owns a 2x2
+block; an 8x8-host pod is a 16x16 chip torus).  Chip coordinates are
+global within the slice, so ICI adjacency between two hosts is
+checkable from their host-grid coordinates alone.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+
+@dataclass(frozen=True)
+class TpuHost:
+    """One TPU VM worker host.
+
+    ``slice_id`` names the physical pod this host belongs to;
+    ``grid`` is the host's (x, y) coordinate in that pod's host grid;
+    ``chip_block`` is the (w, h) block of chips the host owns.
+    CPU-only hosts (the helloworld case) have ``chip_block == (0, 0)``.
+    """
+
+    host_id: str
+    hostname: str = ""
+    slice_id: str = ""
+    generation: str = ""             # "" for CPU-only hosts
+    grid: Tuple[int, int] = (0, 0)
+    chip_block: Tuple[int, int] = (0, 0)
+    cpus: float = 8.0
+    memory_mb: int = 16384
+    disk_mb: int = 102400
+    ports: Tuple[Tuple[int, int], ...] = ((10000, 12000),)
+    attributes: Dict[str, str] = field(default_factory=dict)
+    zone: str = ""
+    region: str = ""
+
+    def __post_init__(self) -> None:
+        if not self.hostname:
+            object.__setattr__(self, "hostname", self.host_id)
+
+    @property
+    def chips_per_host(self) -> int:
+        return self.chip_block[0] * self.chip_block[1]
+
+    def chip_ids(self) -> List[str]:
+        """Global chip ids "slice/x,y" for every chip this host owns."""
+        w, h = self.chip_block
+        ox, oy = self.grid[0] * w, self.grid[1] * h
+        return [
+            f"{self.slice_id}/{ox + dx},{oy + dy}"
+            for dy in range(h)
+            for dx in range(w)
+        ]
+
+
+class ResourceSnapshot:
+    """A consumable view of one host's free resources — the offer.
+
+    Reference: offer/MesosResourcePool.java.  Mutated by evaluation
+    stages as they claim resources; commit/rollback is handled by the
+    evaluator working on copies (gang evaluation is all-or-nothing).
+    """
+
+    def __init__(
+        self,
+        host: TpuHost,
+        cpus: float,
+        memory_mb: int,
+        disk_mb: int,
+        free_chips: Set[str],
+        used_ports: Set[int],
+    ):
+        self.host = host
+        self.cpus = cpus
+        self.memory_mb = memory_mb
+        self.disk_mb = disk_mb
+        self.free_chips = set(free_chips)
+        self.used_ports = set(used_ports)
+
+    def copy(self) -> "ResourceSnapshot":
+        return ResourceSnapshot(
+            self.host, self.cpus, self.memory_mb, self.disk_mb,
+            set(self.free_chips), set(self.used_ports),
+        )
+
+    # -- consumption (evaluation stages call these) -------------------
+
+    def try_consume_scalar(self, cpus: float, memory_mb: int, disk_mb: int) -> bool:
+        if self.cpus + 1e-9 < cpus or self.memory_mb < memory_mb \
+                or self.disk_mb < disk_mb:
+            return False
+        self.cpus -= cpus
+        self.memory_mb -= memory_mb
+        self.disk_mb -= disk_mb
+        return True
+
+    def try_consume_chips(self, count: int) -> Optional[List[str]]:
+        if len(self.free_chips) < count:
+            return None
+        taken = sorted(self.free_chips)[:count]
+        self.free_chips -= set(taken)
+        return taken
+
+    def allocate_port(self, requested: int = 0) -> Optional[int]:
+        """Fixed port if requested, else next free dynamic port."""
+        if requested:
+            if requested in self.used_ports:
+                return None
+            self.used_ports.add(requested)
+            return requested
+        for lo, hi in self.host.ports:
+            for port in range(lo, hi):
+                if port not in self.used_ports:
+                    self.used_ports.add(port)
+                    return port
+        return None
+
+
+class SliceInventory:
+    """The fleet: hosts + the reservation ledger's committed claims.
+
+    ``snapshots()`` synthesizes the current "offers": per-host free
+    resources after subtracting every committed reservation.  This is
+    the L0-replacement — where the reference waits for resourceOffers
+    callbacks (FrameworkScheduler.java:196), our scheduler scans this.
+    """
+
+    def __init__(self, hosts: Optional[List[TpuHost]] = None):
+        self._hosts: Dict[str, TpuHost] = {}
+        self._down: Set[str] = set()
+        for host in hosts or []:
+            self.add_host(host)
+
+    def add_host(self, host: TpuHost) -> None:
+        self._hosts[host.host_id] = host
+
+    def remove_host(self, host_id: str) -> None:
+        self._hosts.pop(host_id, None)
+        self._down.discard(host_id)
+
+    def mark_down(self, host_id: str) -> None:
+        """Host lost/maintenance: excluded from snapshots (the TASK_LOST
+        / PARTITION_AWARE analogue, SURVEY.md section 5.3)."""
+        if host_id in self._hosts:
+            self._down.add(host_id)
+
+    def mark_up(self, host_id: str) -> None:
+        self._down.discard(host_id)
+
+    def is_up(self, host_id: str) -> bool:
+        return host_id in self._hosts and host_id not in self._down
+
+    def host(self, host_id: str) -> Optional[TpuHost]:
+        return self._hosts.get(host_id)
+
+    def hosts(self) -> List[TpuHost]:
+        return list(self._hosts.values())
+
+    def up_hosts(self) -> List[TpuHost]:
+        return [h for h in self._hosts.values() if h.host_id not in self._down]
+
+    def snapshots(self, ledger: "ReservationLedgerView") -> List[ResourceSnapshot]:
+        out = []
+        for host in self.up_hosts():
+            reserved = ledger.reserved_on(host.host_id)
+            free_chips = set(host.chip_ids())
+            used_ports: Set[int] = set()
+            cpus, mem, disk = host.cpus, host.memory_mb, host.disk_mb
+            for res in reserved:
+                cpus -= res.cpus
+                mem -= res.memory_mb
+                disk -= res.disk_mb
+                free_chips -= set(res.chip_ids)
+                used_ports |= set(res.ports)
+            out.append(
+                ResourceSnapshot(host, cpus, mem, disk, free_chips, used_ports)
+            )
+        return out
+
+
+class ReservationLedgerView:
+    """What SliceInventory needs from the ledger (breaks import cycle)."""
+
+    def reserved_on(self, host_id: str):  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+def make_test_fleet(
+    slice_id: str = "pod-0",
+    host_grid: Tuple[int, int] = (2, 2),
+    chip_block: Tuple[int, int] = (2, 2),
+    generation: str = "v5e",
+    cpus: float = 16.0,
+    memory_mb: int = 65536,
+    zone_of=None,
+) -> List[TpuHost]:
+    """Fabricate a TPU pod's hosts (the SendOffer-builder equivalent,
+    reference: sdk/testing Expect/SendOffer fixtures)."""
+    hosts = []
+    for gy in range(host_grid[1]):
+        for gx in range(host_grid[0]):
+            host_id = f"{slice_id}-h{gx}-{gy}"
+            hosts.append(
+                TpuHost(
+                    host_id=host_id,
+                    slice_id=slice_id,
+                    generation=generation,
+                    grid=(gx, gy),
+                    chip_block=chip_block,
+                    cpus=cpus,
+                    memory_mb=memory_mb,
+                    zone=zone_of(gx, gy) if zone_of else f"zone-{gx}",
+                )
+            )
+    return hosts

@@ -1,0 +1,364 @@
+"""Placement rules: which hosts may a pod instance land on.
+
+Reference: offer/evaluate/placement/ (38 classes, SURVEY.md section
+2.1): And/Or/Not combinators, Hostname/Attribute/Region/ZoneRule,
+TaskTypeRule colocate/avoid, MaxPerHostname/Zone/Region/Attribute,
+RoundRobinByHostname/Zone, string matchers Exact/Regex/Any, and
+MarathonConstraintParser for the JSON dialect
+(`[["hostname", "UNIQUE"]]`, GROUP_BY, CLUSTER, LIKE/UNLIKE, MAX_PER,
+IS) accepted in the YAML ``placement:`` field.
+
+TPU-first vocabulary additions: ``same-slice`` (all instances of a
+gang pod on one physical slice — ICI never crosses slices) and
+``generation:v5e`` (TPU generation match).  Torus *adjacency* is not a
+per-host rule — contiguity of the selected host set is enforced by
+offer/torus.py during gang evaluation.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from dcos_commons_tpu.common import TaskInfo
+from dcos_commons_tpu.offer.inventory import ResourceSnapshot, TpuHost
+from dcos_commons_tpu.offer.outcome import EvaluationOutcome
+
+
+@dataclass
+class PlacementContext:
+    """What rules may consult: the other tasks and the host map.
+
+    Reference: PlacementRule.filter(offer, allTasks) — rules see every
+    launched task so they can count/colocate/avoid.
+    """
+
+    pod_type: str
+    existing_tasks: List[TaskInfo] = field(default_factory=list)
+    hosts: Dict[str, TpuHost] = field(default_factory=dict)
+
+    def host_field(self, host: TpuHost, field_name: str) -> str:
+        if field_name == "hostname":
+            return host.hostname
+        if field_name == "zone":
+            return host.zone
+        if field_name == "region":
+            return host.region
+        if field_name == "generation":
+            return host.generation
+        if field_name == "slice":
+            return host.slice_id
+        return host.attributes.get(field_name, "")
+
+    def tasks_of_pod(self, pod_type: str) -> List[TaskInfo]:
+        # one counted entry per pod instance (not per task)
+        seen = {}
+        for info in self.existing_tasks:
+            if info.pod_type == pod_type:
+                seen[f"{info.pod_type}-{info.pod_index}"] = info
+        return list(seen.values())
+
+    def count_on(self, field_name: str, value: str, pod_type: str) -> int:
+        count = 0
+        for info in self.tasks_of_pod(pod_type):
+            host = self.hosts.get(info.agent_id)
+            if host is not None and self.host_field(host, field_name) == value:
+                count += 1
+        return count
+
+
+class PlacementRule:
+    def filter(
+        self, snapshot: ResourceSnapshot, ctx: PlacementContext
+    ) -> EvaluationOutcome:
+        raise NotImplementedError
+
+
+class PassthroughRule(PlacementRule):
+    def filter(self, snapshot, ctx):
+        return EvaluationOutcome.ok("passthrough")
+
+
+class AndRule(PlacementRule):
+    def __init__(self, rules: Sequence[PlacementRule]):
+        self.rules = list(rules)
+
+    def filter(self, snapshot, ctx):
+        children = [r.filter(snapshot, ctx) for r in self.rules]
+        passed = all(c.passed for c in children)
+        outcome = EvaluationOutcome(
+            passed, "and", "all passed" if passed else "a sub-rule failed"
+        )
+        outcome.children = children
+        return outcome
+
+
+class OrRule(PlacementRule):
+    def __init__(self, rules: Sequence[PlacementRule]):
+        self.rules = list(rules)
+
+    def filter(self, snapshot, ctx):
+        children = [r.filter(snapshot, ctx) for r in self.rules]
+        passed = any(c.passed for c in children)
+        outcome = EvaluationOutcome(
+            passed, "or", "a sub-rule passed" if passed else "no sub-rule passed"
+        )
+        outcome.children = children
+        return outcome
+
+
+class NotRule(PlacementRule):
+    def __init__(self, rule: PlacementRule):
+        self.rule = rule
+
+    def filter(self, snapshot, ctx):
+        child = self.rule.filter(snapshot, ctx)
+        outcome = EvaluationOutcome(
+            not child.passed, "not", f"inverted {child.source}"
+        )
+        outcome.children = [child]
+        return outcome
+
+
+class FieldMatchRule(PlacementRule):
+    """hostname/zone/region/attribute exact or regex match.
+
+    Reference: HostnameRule/ZoneRule/RegionRule/AttributeRule +
+    ExactMatcher/RegexMatcher.
+    """
+
+    def __init__(self, field_name: str, values: List[str], regex: bool = False,
+                 invert: bool = False):
+        self.field_name = field_name
+        self.values = values
+        self.regex = regex
+        self.invert = invert
+
+    def filter(self, snapshot, ctx):
+        actual = ctx.host_field(snapshot.host, self.field_name)
+        if self.regex:
+            matched = any(re.fullmatch(v, actual) for v in self.values)
+        else:
+            matched = actual in self.values
+        ok = matched != self.invert
+        name = f"{'un' if self.invert else ''}match:{self.field_name}"
+        if ok:
+            return EvaluationOutcome.ok(name, f"{actual!r} ok")
+        return EvaluationOutcome.fail(
+            name,
+            f"host {snapshot.host.host_id} {self.field_name}={actual!r} "
+            f"{'matches' if self.invert else 'not in'} {self.values}",
+        )
+
+
+class MaxPerRule(PlacementRule):
+    """At most N instances of this pod per distinct field value.
+
+    Reference: MaxPerHostnameRule / MaxPerZoneRule / etc.
+    """
+
+    def __init__(self, field_name: str, max_count: int):
+        self.field_name = field_name
+        self.max_count = max_count
+
+    def filter(self, snapshot, ctx):
+        value = ctx.host_field(snapshot.host, self.field_name)
+        count = ctx.count_on(self.field_name, value, ctx.pod_type)
+        if count < self.max_count:
+            return EvaluationOutcome.ok(
+                f"max-per-{self.field_name}",
+                f"{count}/{self.max_count} on {value!r}",
+            )
+        return EvaluationOutcome.fail(
+            f"max-per-{self.field_name}",
+            f"already {count}/{self.max_count} instances of "
+            f"{ctx.pod_type!r} on {self.field_name}={value!r}",
+        )
+
+
+class GroupByRule(PlacementRule):
+    """Spread instances evenly across field values.
+
+    Reference: RoundRobinByHostname/Zone + marathon GROUP_BY.
+    ``expected_values`` bounds the divisor when known (GROUP_BY:n).
+    """
+
+    def __init__(self, field_name: str, expected_values: int = 0):
+        self.field_name = field_name
+        self.expected_values = expected_values
+
+    def filter(self, snapshot, ctx):
+        value = ctx.host_field(snapshot.host, self.field_name)
+        values = {
+            ctx.host_field(h, self.field_name) for h in ctx.hosts.values()
+        } | {value}
+        divisor = self.expected_values or len(values) or 1
+        total = len(ctx.tasks_of_pod(ctx.pod_type)) + 1  # incl. this one
+        ceiling = math.ceil(total / divisor)
+        count = ctx.count_on(self.field_name, value, ctx.pod_type)
+        if count < ceiling:
+            return EvaluationOutcome.ok(
+                f"group-by-{self.field_name}",
+                f"{count}<{ceiling} on {value!r}",
+            )
+        return EvaluationOutcome.fail(
+            f"group-by-{self.field_name}",
+            f"{self.field_name}={value!r} already has {count} "
+            f"(ceiling {ceiling}) of {ctx.pod_type!r}",
+        )
+
+
+class TaskTypeRule(PlacementRule):
+    """Colocate with / avoid hosts running another pod type.
+
+    Reference: TaskTypeRule.colocateWith / avoid.
+    """
+
+    def __init__(self, other_pod_type: str, colocate: bool):
+        self.other = other_pod_type
+        self.colocate = colocate
+
+    def filter(self, snapshot, ctx):
+        hosts_of_other = {
+            info.agent_id for info in ctx.tasks_of_pod(self.other)
+        }
+        on_host = snapshot.host.host_id in hosts_of_other
+        name = f"task-type-{'colocate' if self.colocate else 'avoid'}:{self.other}"
+        if self.colocate:
+            if not hosts_of_other:
+                # nothing to colocate with yet: allow anywhere (the
+                # reference behaves the same when the target is absent)
+                return EvaluationOutcome.ok(name, f"no {self.other!r} tasks yet")
+            if on_host:
+                return EvaluationOutcome.ok(name, "colocated")
+            return EvaluationOutcome.fail(
+                name, f"host has no {self.other!r} task"
+            )
+        if on_host:
+            return EvaluationOutcome.fail(
+                name, f"host already runs {self.other!r}"
+            )
+        return EvaluationOutcome.ok(name, "avoided")
+
+
+class SameSliceRule(PlacementRule):
+    """TPU-first: all instances of the pod on one physical slice."""
+
+    def filter(self, snapshot, ctx):
+        slices = {
+            ctx.hosts[i.agent_id].slice_id
+            for i in ctx.tasks_of_pod(ctx.pod_type)
+            if i.agent_id in ctx.hosts
+        }
+        if not slices or snapshot.host.slice_id in slices:
+            return EvaluationOutcome.ok("same-slice", snapshot.host.slice_id)
+        return EvaluationOutcome.fail(
+            "same-slice",
+            f"pod pinned to slice {sorted(slices)}, host is on "
+            f"{snapshot.host.slice_id!r}",
+        )
+
+
+# ---------------------------------------------------------------------------
+# Parsers
+# ---------------------------------------------------------------------------
+
+
+def parse_placement(text: str) -> PlacementRule:
+    """Parse the YAML ``placement:`` field.
+
+    Two dialects, as in the reference: the marathon-style JSON list
+    (MarathonConstraintParser.java) and a colon DSL.  Colon DSL:
+
+        max-per-host:1
+        max-per-zone:2
+        hostname:exact:h1,h2        hostname:regex:tpu-.*
+        zone:exact:us-central2-b    attribute:tier:premium
+        task-type:avoid:data        task-type:colocate:data
+        group-by:zone               same-slice
+        generation:v5e
+        rule1 && rule2              (conjunction)
+    """
+    text = (text or "").strip()
+    if not text:
+        return PassthroughRule()
+    if text.startswith("["):
+        return _parse_marathon(text)
+    parts = [p.strip() for p in text.split("&&") if p.strip()]
+    rules = [_parse_one(p) for p in parts]
+    return rules[0] if len(rules) == 1 else AndRule(rules)
+
+
+_FIELD_ALIASES = {"host": "hostname", "hostname": "hostname", "zone": "zone",
+                  "region": "region", "slice": "slice"}
+
+
+def _parse_one(text: str) -> PlacementRule:
+    parts = text.split(":")
+    head = parts[0].lower()
+    if head == "max-per-host":
+        return MaxPerRule("hostname", int(parts[1]))
+    if head in ("max-per-zone", "max-per-region", "max-per-slice"):
+        return MaxPerRule(head.split("-")[-1], int(parts[1]))
+    if head == "max-per-attribute":
+        return MaxPerRule(parts[1], int(parts[2]))
+    if head == "group-by":
+        expected = int(parts[2]) if len(parts) > 2 else 0
+        return GroupByRule(_FIELD_ALIASES.get(parts[1], parts[1]), expected)
+    if head in _FIELD_ALIASES and len(parts) >= 3:
+        field_name = _FIELD_ALIASES[head]
+        mode, values = parts[1].lower(), parts[2]
+        return FieldMatchRule(
+            field_name, values.split(","), regex=(mode == "regex")
+        )
+    if head == "attribute" and len(parts) >= 3:
+        return FieldMatchRule(parts[1], [":".join(parts[2:])])
+    if head == "generation" and len(parts) == 2:
+        return FieldMatchRule("generation", [parts[1]])
+    if head == "task-type" and len(parts) == 3:
+        return TaskTypeRule(parts[2], colocate=(parts[1].lower() == "colocate"))
+    if head == "same-slice":
+        return SameSliceRule()
+    raise ValueError(f"unknown placement rule: {text!r}")
+
+
+def _parse_marathon(text: str) -> PlacementRule:
+    """Reference: MarathonConstraintParser.java — JSON like
+    [["hostname","UNIQUE"], ["zone","GROUP_BY","3"], ["tier","IS","hot"],
+    ["hostname","CLUSTER","h1"], ["zone","LIKE","us-.*"], ["zone","UNLIKE",".."],
+    ["hostname","MAX_PER","2"]]."""
+    try:
+        constraints = json.loads(text)
+    except json.JSONDecodeError as e:
+        raise ValueError(f"bad marathon placement JSON: {e}") from e
+    if constraints and isinstance(constraints[0], str):
+        constraints = [constraints]  # single constraint shorthand
+    rules: List[PlacementRule] = []
+    for constraint in constraints:
+        if not isinstance(constraint, list) or len(constraint) < 2:
+            raise ValueError(f"bad marathon constraint: {constraint!r}")
+        raw_field, op = constraint[0], constraint[1].upper()
+        field_name = _FIELD_ALIASES.get(raw_field, raw_field)
+        arg = constraint[2] if len(constraint) > 2 else None
+        if op == "UNIQUE":
+            rules.append(MaxPerRule(field_name, 1))
+        elif op == "MAX_PER":
+            rules.append(MaxPerRule(field_name, int(arg)))
+        elif op == "GROUP_BY":
+            rules.append(GroupByRule(field_name, int(arg) if arg else 0))
+        elif op == "IS" or op == "CLUSTER":
+            if arg is None:
+                raise ValueError(f"{op} requires a value: {constraint!r}")
+            rules.append(FieldMatchRule(field_name, [str(arg)]))
+        elif op == "LIKE":
+            rules.append(FieldMatchRule(field_name, [str(arg)], regex=True))
+        elif op == "UNLIKE":
+            rules.append(
+                FieldMatchRule(field_name, [str(arg)], regex=True, invert=True)
+            )
+        else:
+            raise ValueError(f"unknown marathon operator {op!r}")
+    return rules[0] if len(rules) == 1 else AndRule(rules)

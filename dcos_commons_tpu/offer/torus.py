@@ -1,0 +1,172 @@
+"""Contiguous sub-slice search over the ICI torus.
+
+The TPU-first replacement for NCCL-ring/host-affinity placement
+(BASELINE.json north star): a gang pod requesting topology (tx, ty)
+must land on a *contiguous axis-aligned rectangle* of hosts inside one
+physical slice, so that the XLA mesh's collectives ride ICI links.
+Contiguity on the torus also makes ring-attention neighbors
+ICI-adjacent (SURVEY.md section 5.7).
+
+Search: per slice, enumerate anchor positions row-major and take the
+first fully-eligible rectangle (corner-first packing keeps large holes
+open - simple and explainable, which matters more here than optimal
+bin packing; the outcome tracker reports every rejected anchor).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Tuple
+
+from dcos_commons_tpu.offer.inventory import ResourceSnapshot
+from dcos_commons_tpu.offer.outcome import EvaluationOutcome
+
+
+class TorusPlacement:
+    def __init__(self, snapshots: List[ResourceSnapshot], outcome: EvaluationOutcome):
+        self.snapshots = snapshots          # row-major, instance order
+        self.outcome = outcome
+
+
+def find_subslice(
+    snapshots: List[ResourceSnapshot],
+    topology: Tuple[int, ...],
+    chips_per_host: int,
+    eligible: Callable[[ResourceSnapshot], EvaluationOutcome],
+) -> TorusPlacement:
+    """Find hosts forming a contiguous ``topology`` chip rectangle.
+
+    ``eligible`` runs placement rules + scalar checks per host; its
+    failures are recorded in the returned outcome tree.
+    """
+    if len(topology) == 3 and topology[2] == 1:
+        topology = topology[:2]
+    if len(topology) == 1:
+        topology = (topology[0], 1)
+    if len(topology) != 2:
+        return TorusPlacement(
+            [],
+            EvaluationOutcome.fail(
+                "torus",
+                f"only 2D topologies supported this generation: {topology}",
+            ),
+        )
+    tx, ty = topology
+
+    outcome = EvaluationOutcome.ok("torus", f"searching {tx}x{ty}")
+    by_slice: Dict[str, List[ResourceSnapshot]] = {}
+    for snap in snapshots:
+        if snap.host.generation:
+            by_slice.setdefault(snap.host.slice_id, []).append(snap)
+
+    if not by_slice:
+        outcome.passed = False
+        outcome.reason = "no TPU hosts in inventory"
+        return TorusPlacement([], outcome)
+
+    for slice_id, slice_snaps in sorted(by_slice.items()):
+        placement = _search_slice(slice_id, slice_snaps, tx, ty, eligible, outcome)
+        if placement is not None:
+            return TorusPlacement(placement, outcome)
+
+    outcome.passed = False
+    outcome.reason = f"no contiguous {tx}x{ty} sub-slice available"
+    return TorusPlacement([], outcome)
+
+
+def _search_slice(
+    slice_id: str,
+    snaps: List[ResourceSnapshot],
+    tx: int,
+    ty: int,
+    eligible: Callable[[ResourceSnapshot], EvaluationOutcome],
+    outcome: EvaluationOutcome,
+) -> Optional[List[ResourceSnapshot]]:
+    blocks = {s.host.chip_block for s in snaps}
+    if len(blocks) != 1:
+        outcome.children.append(
+            EvaluationOutcome.fail(
+                f"slice:{slice_id}", f"mixed chip blocks {sorted(blocks)}"
+            )
+        )
+        return None
+    bw, bh = blocks.pop()
+    if bw == 0 or tx % bw or ty % bh:
+        outcome.children.append(
+            EvaluationOutcome.fail(
+                f"slice:{slice_id}",
+                f"topology {tx}x{ty} not tileable by host block {bw}x{bh}",
+            )
+        )
+        return None
+    need_x, need_y = tx // bw, ty // bh
+
+    grid: Dict[Tuple[int, int], ResourceSnapshot] = {
+        s.host.grid: s for s in snaps
+    }
+    max_x = max(g[0] for g in grid) + 1
+    max_y = max(g[1] for g in grid) + 1
+    if need_x > max_x or need_y > max_y:
+        outcome.children.append(
+            EvaluationOutcome.fail(
+                f"slice:{slice_id}",
+                f"slice host grid {max_x}x{max_y} smaller than "
+                f"required {need_x}x{need_y}",
+            )
+        )
+        return None
+
+    # cache per-host eligibility so each host is checked once per search
+    cache: Dict[Tuple[int, int], EvaluationOutcome] = {}
+
+    def check(pos: Tuple[int, int]) -> Optional[EvaluationOutcome]:
+        snap = grid.get(pos)
+        if snap is None:
+            return None
+        if pos not in cache:
+            child = eligible(snap)
+            if child.passed and len(snap.free_chips) < snap.host.chips_per_host:
+                child = EvaluationOutcome.fail(
+                    f"host:{snap.host.host_id}",
+                    f"only {len(snap.free_chips)}/{snap.host.chips_per_host} "
+                    "chips free (partially reserved)",
+                )
+            cache[pos] = child
+        return cache[pos]
+
+    for ay in range(max_y - need_y + 1):
+        for ax in range(max_x - need_x + 1):
+            rect = [
+                (ax + dx, ay + dy)
+                for dy in range(need_y)
+                for dx in range(need_x)
+            ]
+            failures = []
+            for pos in rect:
+                child = check(pos)
+                if child is None:
+                    failures.append(
+                        EvaluationOutcome.fail(
+                            f"slice:{slice_id}", f"no host at grid {pos}"
+                        )
+                    )
+                    break
+                if not child.passed:
+                    failures.append(child)
+                    break
+            if not failures:
+                outcome.children.append(
+                    EvaluationOutcome.ok(
+                        f"slice:{slice_id}",
+                        f"anchor {ax},{ay}: {need_x}x{need_y} hosts",
+                    )
+                )
+                return [grid[pos] for pos in rect]
+            outcome.children.append(
+                EvaluationOutcome(
+                    False,
+                    f"slice:{slice_id}@{ax},{ay}",
+                    "anchor rejected",
+                    failures,
+                )
+            )
+    return None

@@ -53,6 +53,7 @@ class DeployPlanFactory:
         state_store: StateStore,
         target_config_id: str,
         strategy_name: str = "serial",
+        phase_name: str = "",
     ) -> Phase:
         steps: List[DeploymentStep] = []
         if pod.gang:
@@ -67,7 +68,9 @@ class DeployPlanFactory:
                 steps.append(
                     self._make_step(pod, [index], state_store, target_config_id)
                 )
-        return Phase(pod.type, steps, strategy_for_name(strategy_name))
+        return Phase(
+            phase_name or pod.type, steps, strategy_for_name(strategy_name)
+        )
 
     def _make_step(
         self,
@@ -83,10 +86,10 @@ class DeployPlanFactory:
             else f"{pod.type}-gang:[{','.join(requirement.tasks_to_launch)}]"
         )
         step = DeploymentStep(name, requirement, backoff=self._backoff)
-        self._seed_from_state(step, pod, instances, state_store, target_config_id)
+        self.seed_step_from_state(step, pod, instances, state_store, target_config_id)
         return step
 
-    def _seed_from_state(
+    def seed_step_from_state(
         self,
         step: DeploymentStep,
         pod: PodSpec,

@@ -73,10 +73,10 @@ class PlanGenerator:
         strategy_name = str(raw_phase.get("strategy", "serial"))
         raw_steps = raw_phase.get("steps")
         if not raw_steps:
-            phase = self._factory.build_phase(
-                pod, state_store, target_config_id, strategy_name
+            return self._factory.build_phase(
+                pod, state_store, target_config_id, strategy_name,
+                phase_name=phase_name,
             )
-            return Phase(phase_name, phase.steps, strategy_for_name(strategy_name))
         steps: List[DeploymentStep] = []
         for entry in raw_steps:
             if not isinstance(entry, dict) or len(entry) != 1:
@@ -84,19 +84,40 @@ class PlanGenerator:
                     f"phase {phase_name!r}: each step must be one "
                     "{index: [[tasks...]]} mapping"
                 )
-            ((index, task_groups),) = entry.items()
+            ((raw_index, task_groups),) = entry.items()
+            try:
+                index = int(raw_index)
+            except (TypeError, ValueError):
+                raise SpecError(
+                    f"phase {phase_name!r}: step index {raw_index!r} "
+                    "is not an integer"
+                )
+            if not 0 <= index < pod.count:
+                raise SpecError(
+                    f"phase {phase_name!r}: step index {index} out of "
+                    f"range for pod {pod.type!r} (count {pod.count})"
+                )
             for tasks in task_groups:
                 task_list = [str(t) for t in tasks]
+                unknown = [
+                    t for t in task_list
+                    if t not in {s.name for s in pod.tasks}
+                ]
+                if unknown:
+                    raise SpecError(
+                        f"phase {phase_name!r}: unknown tasks {unknown} "
+                        f"for pod {pod.type!r}"
+                    )
                 requirement = PodInstanceRequirement(
-                    pod=pod, instances=[int(index)], tasks_to_launch=task_list
+                    pod=pod, instances=[index], tasks_to_launch=task_list
                 )
                 step = DeploymentStep(
                     f"{pod.type}-{index}:[{','.join(task_list)}]",
                     requirement,
                     backoff=self._backoff,
                 )
-                self._factory._seed_from_state(
-                    step, pod, [int(index)], state_store, target_config_id
+                self._factory.seed_step_from_state(
+                    step, pod, [index], state_store, target_config_id
                 )
                 steps.append(step)
         return Phase(phase_name, steps, strategy_for_name(strategy_name))

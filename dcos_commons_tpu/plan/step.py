@@ -116,6 +116,14 @@ class DeploymentStep(Step):
         # task full-name -> last seen state
         self._task_states: Dict[str, TaskState] = {}
         self._task_ready: Dict[str, bool] = {}
+        # exact full-name -> TaskSpec map (suffix matching would confuse
+        # task names that are dash-suffixes of each other)
+        self._spec_by_full = {
+            task_full_name(requirement.pod.type, i, spec.name): spec
+            for i in requirement.instances
+            for spec in requirement.pod.tasks
+            if spec.name in requirement.tasks_to_launch
+        }
 
     # -- candidate lifecycle -----------------------------------------
 
@@ -159,23 +167,24 @@ class DeploymentStep(Step):
                 return
             if self._expected[name] and status.task_id != self._expected[name]:
                 return  # stale status from an older launch
+            if self._status.is_complete:
+                # a completed deploy step never regresses: post-deploy
+                # failures belong to the recovery plan (reference:
+                # DeploymentStep stays COMPLETE; recovery manager owns
+                # keep-alive, DefaultRecoveryPlanManager.java:164)
+                return
             self._task_states[name] = status.state
             if status.ready:
                 self._task_ready[name] = True
             self._recompute(failed=status.state.is_failure)
 
     def _goal_of(self, task_full: str) -> GoalState:
-        # task full name: "<pod>-<index>-<task>"
-        for spec in self.requirement.pod.tasks:
-            if task_full.endswith(f"-{spec.name}"):
-                return spec.goal
-        return GoalState.RUNNING
+        spec = self._spec_by_full.get(task_full)
+        return spec.goal if spec is not None else GoalState.RUNNING
 
     def _needs_readiness(self, task_full: str) -> bool:
-        for spec in self.requirement.pod.tasks:
-            if task_full.endswith(f"-{spec.name}"):
-                return spec.readiness_check is not None
-        return False
+        spec = self._spec_by_full.get(task_full)
+        return spec is not None and spec.readiness_check is not None
 
     def _task_done(self, task_full: str) -> bool:
         state = self._task_states.get(task_full)
@@ -195,7 +204,12 @@ class DeploymentStep(Step):
         if failed:
             # any failure in the gang resets the whole step: a pjit pod
             # cannot run degraded (gang semantics; for non-gang pods the
-            # step covers a single instance anyway)
+            # step covers a single instance anyway).  The aborted
+            # launch's state is dropped so a re-delivered status from it
+            # cannot lift the step out of PENDING/DELAYED.
+            self._expected = {}
+            self._task_states = {}
+            self._task_ready = {}
             delay = self._backoff.next_delay(self.name)
             if delay > 0:
                 self._delay_until = time.monotonic() + delay

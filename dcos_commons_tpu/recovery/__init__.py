@@ -1,0 +1,35 @@
+"""L2: failure recovery — synthesized plans for crashed/lost tasks.
+
+Reference: sdk/scheduler/.../scheduler/recovery/ —
+DefaultRecoveryPlanManager.java:53,142,164,378-420 (plan synthesized
+on the fly from failed tasks; escalation TRANSIENT -> PERMANENT),
+RecoveryType.java:7-25, monitor/ (NeverFailureMonitor,
+TimedFailureMonitor.java:20-60, TestingFailureMonitor),
+FailureUtils (permanently-failed task labels),
+RecoveryPlanOverrider hook (CassandraRecoveryPlanOverrider.java:38).
+
+TPU mapping (SURVEY.md section 5.3): preemption/maintenance events play
+TASK_LOST; PERMANENT recovery of a gang pod = re-place the sub-slice
+and restart from checkpoint; one lost worker flips the WHOLE gang to
+recovery (the pjit mesh cannot run degraded).
+"""
+
+from dcos_commons_tpu.recovery.monitor import (
+    FailureMonitor,
+    NeverFailureMonitor,
+    TestingFailureMonitor,
+    TimedFailureMonitor,
+)
+from dcos_commons_tpu.recovery.manager import (
+    DefaultRecoveryPlanManager,
+    RecoveryPlanOverrider,
+)
+
+__all__ = [
+    "DefaultRecoveryPlanManager",
+    "FailureMonitor",
+    "NeverFailureMonitor",
+    "RecoveryPlanOverrider",
+    "TestingFailureMonitor",
+    "TimedFailureMonitor",
+]

@@ -1,0 +1,190 @@
+"""DefaultRecoveryPlanManager: synthesize recovery steps from failures.
+
+Reference: recovery/DefaultRecoveryPlanManager.java — updatePlan
+(:164) scans the state store for failed tasks each status update and
+appends recovery steps for pods not already being recovered; the
+FailureMonitor decides TRANSIENT (relaunch in place, reservations
+kept) vs PERMANENT (destroy + replace, :378-420); per-service
+RecoveryPlanOverriders may replace the default steps with a custom
+phase (Cassandra seed-replace choreography is the reference example).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional, Set
+
+from dcos_commons_tpu.common import Label, TaskState, TaskStatus, task_name_of
+from dcos_commons_tpu.plan.backoff import Backoff
+from dcos_commons_tpu.plan.phase import Phase
+from dcos_commons_tpu.plan.plan import RECOVERY_PLAN_NAME, Plan
+from dcos_commons_tpu.plan.plan_manager import PlanManager
+from dcos_commons_tpu.plan.status import Status
+from dcos_commons_tpu.plan.step import (
+    DeploymentStep,
+    PodInstanceRequirement,
+    RecoveryType,
+    Step,
+)
+from dcos_commons_tpu.plan.strategy import ParallelStrategy
+from dcos_commons_tpu.recovery.monitor import FailureMonitor, NeverFailureMonitor
+from dcos_commons_tpu.specification.specs import (
+    GoalState,
+    ServiceSpec,
+    pod_instance_name,
+    task_full_name,
+)
+from dcos_commons_tpu.state.state_store import StateStore
+
+# A RecoveryPlanOverrider may return a replacement Phase for a failed
+# pod instance (reference: RecoveryPlanOverrider(Factory)); return
+# None to keep the default single-step recovery.
+RecoveryPlanOverrider = Callable[
+    [str, List[int], RecoveryType], Optional[Phase]
+]
+
+
+class DefaultRecoveryPlanManager(PlanManager):
+    def __init__(
+        self,
+        spec: ServiceSpec,
+        state_store: StateStore,
+        failure_monitor: Optional[FailureMonitor] = None,
+        backoff: Optional[Backoff] = None,
+        overriders: Optional[List[RecoveryPlanOverrider]] = None,
+        externally_managed: Optional[Callable[[str], bool]] = None,
+    ):
+        self._spec = spec
+        self._state_store = state_store
+        self._monitor = failure_monitor or NeverFailureMonitor()
+        self._backoff = backoff
+        self._overriders = list(overriders or [])
+        # pods with incomplete work in another plan (deploy/update) are
+        # that plan's responsibility — recovering them here would race
+        # the rollout (reference: recovery defers to dirtied assets)
+        self._externally_managed = externally_managed or (lambda _name: False)
+        self._lock = threading.RLock()
+        # active recovery elements keyed by pod instance name
+        self._phases: Dict[str, Phase] = {}
+        self._plan = Plan(RECOVERY_PLAN_NAME, [], ParallelStrategy())
+
+    def set_spec(self, spec: ServiceSpec) -> None:
+        with self._lock:
+            self._spec = spec
+
+    # -- PlanManager --------------------------------------------------
+
+    def get_plan(self) -> Plan:
+        with self._lock:
+            self._prune_completed()
+            self._plan.phases = list(self._phases.values())
+            return self._plan
+
+    def get_candidates(self, dirty_assets: Set[str]) -> List[Step]:
+        with self._lock:
+            self._refresh()
+            return self.get_plan().candidates(dirty_assets)
+
+    def update(self, status: TaskStatus) -> None:
+        with self._lock:
+            for phase in self._phases.values():
+                phase.update(status)
+            self._refresh()
+
+    # -- plan synthesis ----------------------------------------------
+
+    def _prune_completed(self) -> None:
+        for key in [k for k, p in self._phases.items() if p.is_complete]:
+            del self._phases[key]
+
+    def _refresh(self) -> None:
+        """Reference: updatePlan (DefaultRecoveryPlanManager.java:164)."""
+        self._prune_completed()
+        failed = self._find_failed_pods()
+        for (pod_type, instances), recovery_type in failed.items():
+            key = pod_instance_name(pod_type, instances[0])
+            if any(
+                self._externally_managed(pod_instance_name(pod_type, i))
+                for i in instances
+            ):
+                continue
+            existing = self._phases.get(key)
+            if existing is not None:
+                # escalate in place: TRANSIENT phase upgraded if the
+                # monitor now says PERMANENT (reference :378-420)
+                if recovery_type is RecoveryType.PERMANENT:
+                    for step in existing.steps:
+                        if isinstance(step, DeploymentStep) and \
+                                step.requirement.recovery_type is RecoveryType.TRANSIENT:
+                            step.requirement.recovery_type = RecoveryType.PERMANENT
+                continue
+            phase = self._make_phase(pod_type, list(instances), recovery_type)
+            if phase is not None:
+                self._phases[key] = phase
+
+    def _find_failed_pods(self) -> Dict[tuple, RecoveryType]:
+        """Scan stored statuses for tasks needing recovery, grouped by
+        pod instance (whole pod for gang pods)."""
+        out: Dict[tuple, RecoveryType] = {}
+        for pod in self._spec.pods:
+            gang_failed: Set[int] = set()
+            gang_type = RecoveryType.TRANSIENT
+            for index in range(pod.count):
+                for task_spec in pod.tasks:
+                    full = task_full_name(pod.type, index, task_spec.name)
+                    info = self._state_store.fetch_task(full)
+                    status = self._state_store.fetch_status(full)
+                    if info is None or status is None:
+                        continue
+                    needs, rtype = self._needs_recovery(
+                        full, info, status, task_spec.goal
+                    )
+                    if not needs:
+                        continue
+                    if pod.gang:
+                        gang_failed.add(index)
+                        if rtype is RecoveryType.PERMANENT:
+                            gang_type = RecoveryType.PERMANENT
+                    else:
+                        out[(pod.type, (index,))] = rtype
+            if pod.gang and gang_failed:
+                # one worker down takes the whole slice through recovery
+                out[(pod.type, tuple(range(pod.count)))] = gang_type
+        return out
+
+    def _needs_recovery(self, full, info, status, goal):
+        if info.labels.get(Label.PERMANENTLY_FAILED):
+            return True, RecoveryType.PERMANENT
+        if not status.state.is_terminal:
+            self._monitor.clear(full)
+            return False, RecoveryType.NONE
+        # a terminal state satisfying the goal is success, not failure:
+        # FINISHED satisfies FINISH/ONCE; nothing terminal satisfies
+        # RUNNING (even exit 0 means the server died — relaunch it)
+        if goal in (GoalState.FINISH, GoalState.ONCE) and \
+                status.state is TaskState.FINISHED:
+            return False, RecoveryType.NONE
+        if self._monitor.has_failed_permanently(full, status):
+            # stamp the label so the escalation survives restart
+            self._state_store.store_tasks(
+                [info.with_label(Label.PERMANENTLY_FAILED, "true")]
+            )
+            return True, RecoveryType.PERMANENT
+        return True, RecoveryType.TRANSIENT
+
+    def _make_phase(
+        self, pod_type: str, instances: List[int], recovery_type: RecoveryType
+    ) -> Optional[Phase]:
+        for overrider in self._overriders:
+            phase = overrider(pod_type, instances, recovery_type)
+            if phase is not None:
+                return phase
+        pod = self._spec.pod(pod_type)
+        requirement = PodInstanceRequirement(
+            pod=pod, instances=instances, recovery_type=recovery_type
+        )
+        name = f"recover-{pod_instance_name(pod_type, instances[0])}" if len(
+            instances
+        ) == 1 else f"recover-{pod_type}-gang"
+        step = DeploymentStep(name, requirement, backoff=self._backoff)
+        return Phase(name, [step], ParallelStrategy())

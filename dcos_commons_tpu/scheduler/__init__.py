@@ -1,0 +1,15 @@
+"""L2 core: the service scheduler and its builder.
+
+Reference: sdk/scheduler/.../scheduler/ — MesosEventClient.java:14-68
+(the event contract), AbstractScheduler.java (reconcile gate, work-set
+revive), DefaultScheduler.java:81 (offer->plan wiring :423-470,
+unexpected-resource GC :483-538, status fan-out :541-568),
+SchedulerBuilder.java:331 (persister/state/config wiring, deploy-vs-
+update plan selection :644), SchedulerRunner.java:82.
+"""
+
+from dcos_commons_tpu.scheduler.config import SchedulerConfig
+from dcos_commons_tpu.scheduler.scheduler import DefaultScheduler
+from dcos_commons_tpu.scheduler.builder import SchedulerBuilder
+
+__all__ = ["DefaultScheduler", "SchedulerBuilder", "SchedulerConfig"]

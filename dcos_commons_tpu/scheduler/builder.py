@@ -1,0 +1,238 @@
+"""SchedulerBuilder: wire persister -> stores -> config update -> plans.
+
+Reference: scheduler/SchedulerBuilder.java:331 (744 LoC): persister +
+state/config store wiring, DefaultConfigurationUpdater invocation
+(config update validation + target flip), plan selection including
+selectDeployPlan's deploy-vs-update choice (:644), namespacing, and
+the final DefaultScheduler assembly (DefaultScheduler.java:147).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from dcos_commons_tpu.agent.base import Agent
+from dcos_commons_tpu.debug.trackers import OfferOutcomeTracker
+from dcos_commons_tpu.metrics.registry import Metrics
+from dcos_commons_tpu.offer.evaluate import OfferEvaluator
+from dcos_commons_tpu.offer.inventory import SliceInventory
+from dcos_commons_tpu.offer.ledger import ReservationLedger
+from dcos_commons_tpu.plan.backoff import (
+    Backoff,
+    DisabledBackoff,
+    ExponentialBackoff,
+)
+from dcos_commons_tpu.plan.builders import DeployPlanFactory
+from dcos_commons_tpu.plan.generator import PlanGenerator
+from dcos_commons_tpu.plan.plan import DEPLOY_PLAN_NAME, UPDATE_PLAN_NAME
+from dcos_commons_tpu.plan.plan_manager import DefaultPlanManager
+from dcos_commons_tpu.recovery.manager import (
+    DefaultRecoveryPlanManager,
+    RecoveryPlanOverrider,
+)
+from dcos_commons_tpu.recovery.monitor import (
+    FailureMonitor,
+    NeverFailureMonitor,
+    TimedFailureMonitor,
+)
+from dcos_commons_tpu.scheduler.config import SchedulerConfig
+from dcos_commons_tpu.scheduler.scheduler import DefaultScheduler
+from dcos_commons_tpu.specification.specs import ServiceSpec
+from dcos_commons_tpu.specification.validation import (
+    ConfigValidationError,
+    validate_spec_change,
+)
+from dcos_commons_tpu.state.config_store import ConfigStore
+from dcos_commons_tpu.state.schema import SchemaVersionStore
+from dcos_commons_tpu.state.state_store import StateStore
+from dcos_commons_tpu.storage import (
+    FileWalPersister,
+    MemPersister,
+    Persister,
+    PersisterCache,
+)
+
+LOG = logging.getLogger(__name__)
+
+
+class SchedulerBuilder:
+    def __init__(
+        self,
+        spec: ServiceSpec,
+        scheduler_config: Optional[SchedulerConfig] = None,
+        persister: Optional[Persister] = None,
+    ):
+        self._spec = spec
+        self._config = scheduler_config or SchedulerConfig()
+        self._persister = persister
+        self._inventory: Optional[SliceInventory] = None
+        self._agent: Optional[Agent] = None
+        self._plan_customizer = None
+        self._recovery_overriders: List[RecoveryPlanOverrider] = []
+        self._failure_monitor: Optional[FailureMonitor] = None
+        self._namespace = self._config.service_namespace
+
+    # -- fluent wiring (reference: SchedulerBuilder setters) ----------
+
+    def set_inventory(self, inventory: SliceInventory) -> "SchedulerBuilder":
+        self._inventory = inventory
+        return self
+
+    def set_agent(self, agent: Agent) -> "SchedulerBuilder":
+        self._agent = agent
+        return self
+
+    def set_plan_customizer(self, customizer) -> "SchedulerBuilder":
+        """customizer(plan) -> plan, applied to every built plan
+        (reference: PlanCustomizer hook)."""
+        self._plan_customizer = customizer
+        return self
+
+    def add_recovery_overrider(
+        self, overrider: RecoveryPlanOverrider
+    ) -> "SchedulerBuilder":
+        self._recovery_overriders.append(overrider)
+        return self
+
+    def set_failure_monitor(self, monitor: FailureMonitor) -> "SchedulerBuilder":
+        self._failure_monitor = monitor
+        return self
+
+    # -- build --------------------------------------------------------
+
+    def build(self) -> DefaultScheduler:
+        persister = self._persister
+        if persister is None:
+            persister = FileWalPersister(self._config.state_dir)
+        if self._config.state_cache_enabled and not isinstance(
+            persister, (MemPersister, PersisterCache)
+        ):
+            # FileWalPersister is RAM-backed already; the cache layer is
+            # for future remote persisters. Kept off by default here.
+            pass
+        SchemaVersionStore(persister).check()
+        state_store = StateStore(persister, self._namespace)
+        config_store = ConfigStore(persister, self._namespace)
+        ledger = ReservationLedger(persister, self._namespace)
+
+        target_id, config_errors = self._update_configuration(
+            state_store, config_store
+        )
+        target_spec = self._load_target_spec(config_store, target_id)
+
+        backoff = self._make_backoff()
+        factory = DeployPlanFactory(backoff)
+        plan_name = (
+            UPDATE_PLAN_NAME
+            if state_store.deployment_was_completed()
+            else DEPLOY_PLAN_NAME
+        )
+        raw_deploy = (target_spec.plans or {}).get("deploy")
+        if raw_deploy:
+            deploy_plan = PlanGenerator(backoff).generate(
+                target_spec, plan_name, raw_deploy, state_store, target_id
+            )
+        else:
+            deploy_plan = factory.build(
+                target_spec, state_store, target_id, plan_name
+            )
+        deploy_plan.errors.extend(config_errors)
+        if self._plan_customizer is not None:
+            deploy_plan = self._plan_customizer(deploy_plan) or deploy_plan
+        deploy_manager = DefaultPlanManager(deploy_plan)
+
+        monitor = self._failure_monitor
+        if monitor is None:
+            policy = target_spec.replacement_failure_policy
+            if policy is not None:
+                monitor = TimedFailureMonitor(policy.permanent_failure_timeout_s)
+            else:
+                monitor = NeverFailureMonitor()
+
+        def externally_managed(asset: str) -> bool:
+            for step in deploy_plan.all_steps():
+                if asset in step.get_asset_names() and not step.is_complete:
+                    return True
+            return False
+
+        recovery_manager = DefaultRecoveryPlanManager(
+            target_spec,
+            state_store,
+            failure_monitor=monitor,
+            backoff=backoff,
+            overriders=self._recovery_overriders,
+            externally_managed=externally_managed,
+        )
+
+        evaluator = OfferEvaluator(
+            state_store, ledger, target_spec.name, target_id
+        )
+        inventory = self._inventory or SliceInventory()
+        agent = self._agent
+        if agent is None:
+            from dcos_commons_tpu.agent.local import LocalProcessAgent
+
+            agent = LocalProcessAgent(self._config.sandbox_root)
+
+        return DefaultScheduler(
+            spec=target_spec,
+            state_store=state_store,
+            ledger=ledger,
+            inventory=inventory,
+            agent=agent,
+            evaluator=evaluator,
+            deploy_manager=deploy_manager,
+            recovery_manager=recovery_manager,
+        )
+
+    # -- config update (reference: DefaultConfigurationUpdater:159) ---
+
+    def _update_configuration(self, state_store, config_store):
+        errors: List[str] = []
+        old_target_id = config_store.get_target_config()
+        old_spec = None
+        if old_target_id:
+            old_dict = config_store.fetch(old_target_id)
+            if old_dict is not None:
+                old_spec = ServiceSpec.from_dict(old_dict)
+        if old_spec is not None and old_spec == self._spec:
+            return old_target_id, errors
+        try:
+            validate_spec_change(old_spec, self._spec)
+        except ConfigValidationError as e:
+            errors.extend(e.errors)
+            if old_target_id is not None:
+                LOG.error(
+                    "config update rejected, keeping target %s: %s",
+                    old_target_id, e.errors,
+                )
+                return old_target_id, errors
+            raise  # invalid initial config: refuse to start
+        new_id = config_store.store(self._spec.to_dict())
+        config_store.set_target_config(new_id)
+        if old_spec is not None:
+            # a fresh rollout begins: the update plan must redeploy
+            # changed pods, tracked against the new target id
+            LOG.info("target config %s -> %s", old_target_id, new_id)
+            referenced = set()
+            for info in state_store.fetch_tasks():
+                cfg = info.labels.get("target_configuration")
+                if cfg:
+                    referenced.add(cfg)
+            config_store.prune(list(referenced))
+        return new_id, errors
+
+    def _load_target_spec(self, config_store, target_id) -> ServiceSpec:
+        data = config_store.fetch(target_id)
+        return ServiceSpec.from_dict(data) if data else self._spec
+
+    def _make_backoff(self) -> Backoff:
+        if not self._config.backoff_enabled:
+            return DisabledBackoff()
+        return ExponentialBackoff(
+            initial_s=self._config.backoff_initial_s,
+            factor=self._config.backoff_factor,
+            max_s=self._config.backoff_max_s,
+        )

@@ -1,0 +1,55 @@
+"""SchedulerConfig: process-level configuration from env.
+
+Reference: scheduler/SchedulerConfig.java (666 LoC, ~45 env vars) +
+framework/EnvStore.java.  The same plane-(a) config surface
+(SURVEY.md section 5.6): process env -> typed config; service YAML and
+per-task env are the other two planes.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Mapping, Optional
+
+
+@dataclass
+class SchedulerConfig:
+    api_port: int = 8080
+    state_dir: str = "./state"
+    service_namespace: str = ""
+    uninstall: bool = False              # reference: SDK_UNINSTALL
+    state_cache_enabled: bool = True     # reference: DISABLE_STATE_CACHE
+    # launch backoff (reference: ExponentialBackoff env knobs)
+    backoff_enabled: bool = True
+    backoff_initial_s: float = 1.0
+    backoff_factor: float = 1.15
+    backoff_max_s: float = 300.0
+    # recovery escalation (overridden by ServiceSpec's policy)
+    permanent_failure_timeout_s: float = 1200.0
+    # agent sandbox root
+    sandbox_root: str = "./sandboxes"
+    # coordinator port range for pjit rendezvous
+    coordinator_port_base: int = 8476
+
+    @staticmethod
+    def from_env(env: Optional[Mapping[str, str]] = None) -> "SchedulerConfig":
+        env = env if env is not None else os.environ
+        return SchedulerConfig(
+            api_port=int(env.get("PORT_API", "8080")),
+            state_dir=env.get("STATE_DIR", "./state"),
+            service_namespace=env.get("SERVICE_NAMESPACE", ""),
+            uninstall=env.get("SDK_UNINSTALL", "") not in ("", "0", "false"),
+            state_cache_enabled=env.get("DISABLE_STATE_CACHE", "")
+            in ("", "0", "false"),
+            backoff_enabled=env.get("ENABLE_BACKOFF", "true")
+            not in ("0", "false"),
+            backoff_initial_s=float(env.get("BACKOFF_INITIAL_S", "1.0")),
+            backoff_factor=float(env.get("BACKOFF_FACTOR", "1.15")),
+            backoff_max_s=float(env.get("BACKOFF_MAX_S", "300")),
+            permanent_failure_timeout_s=float(
+                env.get("PERMANENT_FAILURE_TIMEOUT_S", "1200")
+            ),
+            sandbox_root=env.get("SANDBOX_ROOT", "./sandboxes"),
+            coordinator_port_base=int(env.get("COORDINATOR_PORT_BASE", "8476")),
+        )

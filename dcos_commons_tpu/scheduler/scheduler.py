@@ -1,0 +1,253 @@
+"""DefaultScheduler: the event loop tying every layer together.
+
+Reference: scheduler/DefaultScheduler.java:81 + framework/
+OfferProcessor.java — one cycle of ``run_cycle()`` corresponds to one
+pass of the reference's offer thread (OfferProcessor.java:294-418):
+
+    status intake  (statusUpdate fan-in,    DefaultScheduler.java:541-568)
+    reconcile gate (AbstractScheduler.java:163-184)
+    plan candidates -> evaluate -> WAL -> launch
+                   (PlanScheduler.java:50-100 -> OfferEvaluator ->
+                    PersistentLaunchRecorder, DefaultScheduler.java:423-470)
+    reservation GC (unexpected resources,   DefaultScheduler.java:483-538)
+    kill retries   (TaskKiller)
+
+The loop is synchronous and steppable — the sim harness and tests call
+run_cycle() directly (the reference's sim harness scripts ticks the
+same way); ``run_forever`` wraps it in a thread for production.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, List, Optional, Set
+
+from dcos_commons_tpu.agent.base import Agent
+from dcos_commons_tpu.common import Label, TaskState, TaskStatus, task_name_of
+from dcos_commons_tpu.debug.trackers import OfferOutcomeTracker
+from dcos_commons_tpu.metrics.registry import Metrics
+from dcos_commons_tpu.offer.evaluate import OfferEvaluator
+from dcos_commons_tpu.offer.inventory import SliceInventory
+from dcos_commons_tpu.offer.ledger import ReservationLedger
+from dcos_commons_tpu.plan.coordinator import DefaultPlanCoordinator
+from dcos_commons_tpu.plan.plan import DEPLOY_PLAN_NAME, Plan
+from dcos_commons_tpu.plan.plan_manager import DefaultPlanManager, PlanManager
+from dcos_commons_tpu.plan.step import DeploymentStep
+from dcos_commons_tpu.recovery.manager import DefaultRecoveryPlanManager
+from dcos_commons_tpu.runtime.reconciler import Reconciler
+from dcos_commons_tpu.runtime.task_killer import TaskKiller
+from dcos_commons_tpu.specification.specs import ServiceSpec, task_full_name
+from dcos_commons_tpu.state.launch_recorder import PersistentLaunchRecorder
+from dcos_commons_tpu.state.state_store import StateStore
+
+LOG = logging.getLogger(__name__)
+
+
+class DefaultScheduler:
+    def __init__(
+        self,
+        spec: ServiceSpec,
+        state_store: StateStore,
+        ledger: ReservationLedger,
+        inventory: SliceInventory,
+        agent: Agent,
+        evaluator: OfferEvaluator,
+        deploy_manager: DefaultPlanManager,
+        recovery_manager: DefaultRecoveryPlanManager,
+        other_managers: Optional[List[PlanManager]] = None,
+        metrics: Optional[Metrics] = None,
+        outcome_tracker: Optional[OfferOutcomeTracker] = None,
+    ):
+        self.spec = spec
+        self.state_store = state_store
+        self.ledger = ledger
+        self.inventory = inventory
+        self.agent = agent
+        self.evaluator = evaluator
+        self.deploy_manager = deploy_manager
+        self.recovery_manager = recovery_manager
+        self.other_managers = list(other_managers or [])
+        self.metrics = metrics or Metrics()
+        self.outcome_tracker = outcome_tracker or OfferOutcomeTracker()
+        # deploy before recovery: rollout owns incomplete pods, and the
+        # recovery manager defers to them via externally_managed
+        self.coordinator = DefaultPlanCoordinator(
+            [deploy_manager, recovery_manager, *self.other_managers]
+        )
+        self.launch_recorder = PersistentLaunchRecorder(state_store)
+        self.task_killer = TaskKiller(agent)
+        self.reconciler = Reconciler(state_store, agent)
+        self._suppressed = False
+        self._stop = threading.Event()
+        self._lock = threading.RLock()
+
+    # -- the loop -----------------------------------------------------
+
+    def run_cycle(self) -> None:
+        with self._lock:
+            self._intake_statuses()
+            if not self.reconciler.is_reconciled:
+                for status in self.reconciler.reconcile():
+                    self._process_status(status)
+                self.metrics.incr("reconciles")
+            self._process_candidates()
+            self._gc_reservations()
+            self.task_killer.retry_pending()
+            # first full deployment done: scheduler restarts now build
+            # an *update* plan (reference: StateStoreUtils deployment-
+            # completed bit read by SchedulerBuilder.selectDeployPlan)
+            if not self.state_store.deployment_was_completed() and \
+                    self.deploy_manager.get_plan().is_complete:
+                self.state_store.set_deployment_completed()
+
+    def run_forever(self, interval_s: float = 0.5) -> threading.Thread:
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    self.run_cycle()
+                except Exception:  # crash the process in prod; log here
+                    LOG.exception("scheduler cycle failed")
+                self._stop.wait(interval_s)
+
+        thread = threading.Thread(target=loop, name="scheduler-loop", daemon=True)
+        thread.start()
+        return thread
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    # -- status intake ------------------------------------------------
+
+    def _intake_statuses(self) -> None:
+        for status in self.agent.poll():
+            self._process_status(status)
+
+    def _process_status(self, status: TaskStatus) -> None:
+        """Reference: DefaultScheduler.processStatusUpdate (:541-568)."""
+        self.metrics.incr(f"task_status.{status.state.value}")
+        try:
+            task_name = task_name_of(status.task_id)
+        except ValueError:
+            LOG.warning("unparseable task id %s", status.task_id)
+            return
+        stored = self.state_store.store_status(task_name, status)
+        if not stored:
+            LOG.info("dropped stale status %s for %s",
+                     status.state.value, task_name)
+            return
+        self.task_killer.handle_status(status)
+        for manager in self.coordinator.plan_managers:
+            manager.update(status)
+
+    # -- candidates -> launches ---------------------------------------
+
+    def _process_candidates(self) -> None:
+        candidates = self.coordinator.get_candidates()
+        if not candidates:
+            if not self._suppressed:
+                self._suppressed = True
+                self.metrics.incr("suppresses")
+            return
+        if self._suppressed:
+            self._suppressed = False
+            self.metrics.incr("revives")
+        for step in candidates:
+            if not isinstance(step, DeploymentStep):
+                continue
+            requirement = step.start()
+            if requirement is None:
+                continue
+            result = self.evaluator.evaluate(requirement, self.inventory)
+            self.outcome_tracker.record(requirement.name, result.outcome)
+            self.metrics.incr("offers.evaluated")
+            if not result.passed:
+                step.update_offer_status(False)
+                self.metrics.incr("offers.declined")
+                continue
+            self._kill_previous_launches(result.task_infos)
+            # WAL discipline: reservations + task infos are durable
+            # BEFORE the agent sees a launch (DefaultScheduler.java:454)
+            self.ledger.commit(result.reservations)
+            self.launch_recorder.record(result.task_infos)
+            step.record_launch({t.name: t.task_id for t in result.task_infos})
+            self._launch(result.task_infos, requirement)
+            self.metrics.incr("operations.launch", len(result.task_infos))
+
+    def _kill_previous_launches(self, task_infos) -> None:
+        """A relaunch of task name N must kill N's previous process
+        before the new one starts (rolling update / recovery path)."""
+        active = self.agent.active_task_ids()
+        for info in task_infos:
+            for task_id in active:
+                try:
+                    if task_name_of(task_id) == info.name and task_id != info.task_id:
+                        self.task_killer.kill(task_id)
+                except ValueError:
+                    continue
+
+    def _launch(self, task_infos, requirement) -> None:
+        pod = requirement.pod
+        for info in task_infos:
+            task_spec = None
+            for spec in pod.tasks:
+                if info.name.endswith(f"-{spec.name}"):
+                    task_spec = spec
+                    break
+            launch_one = getattr(self.agent, "launch_one", None)
+            if launch_one is not None and task_spec is not None:
+                launch_one(
+                    info,
+                    readiness=task_spec.readiness_check,
+                    health=task_spec.health_check,
+                )
+            else:
+                self.agent.launch([info])
+
+    # -- reservation GC ----------------------------------------------
+
+    def _gc_reservations(self) -> None:
+        """Reference: unexpected-resource cleanup
+        (DefaultScheduler.java:483-538): any reservation no stored
+        TaskInfo references is released."""
+        expected: Set[str] = set()
+        for info in self.state_store.fetch_tasks():
+            expected |= set(info.resource_ids)
+        for reservation in self.ledger.all():
+            if reservation.reservation_id not in expected:
+                self.ledger.release(reservation.reservation_id)
+                self.metrics.incr("operations.unreserve")
+
+    # -- operator verbs (wired to HTTP in http/) ----------------------
+
+    def restart_pod(self, pod_type: str, index: int, replace: bool = False) -> List[str]:
+        """Reference: PodQueries.restart (:263) — ``replace`` marks
+        tasks permanently failed (pod replace), otherwise a plain
+        restart (kill; recovery relaunches in place)."""
+        pod = self.spec.pod(pod_type)
+        indices = list(range(pod.count)) if pod.gang else [index]
+        killed = []
+        for i in indices:
+            for task_spec in pod.tasks:
+                full = task_full_name(pod_type, i, task_spec.name)
+                info = self.state_store.fetch_task(full)
+                if info is None:
+                    continue
+                if replace:
+                    self.state_store.store_tasks(
+                        [info.with_label(Label.PERMANENTLY_FAILED, "true")]
+                    )
+                self.task_killer.kill(info.task_id, task_spec.kill_grace_period_s)
+                killed.append(full)
+        return killed
+
+    def plans(self) -> Dict[str, Plan]:
+        out = {}
+        for manager in self.coordinator.plan_managers:
+            plan = manager.get_plan()
+            out[plan.name] = plan
+        return out
+
+    def plan(self, name: str) -> Optional[Plan]:
+        return self.plans().get(name)

@@ -209,7 +209,9 @@ class ServiceSpec:
     #    of DefaultServiceSpec) --------------------------------------
 
     def to_dict(self) -> Dict[str, Any]:
-        return json.loads(json.dumps(self, default=_encode))
+        from dcos_commons_tpu.common import _to_jsonable
+
+        return _to_jsonable(self)
 
     @staticmethod
     def from_dict(data: Dict[str, Any]) -> "ServiceSpec":
@@ -222,18 +224,6 @@ class ServiceSpec:
 
     def __hash__(self) -> int:
         return hash(json.dumps(self.to_dict(), sort_keys=True))
-
-
-def _encode(obj: Any) -> Any:
-    if isinstance(obj, enum.Enum):
-        return obj.value
-    if hasattr(obj, "__dataclass_fields__"):
-        return {
-            name: getattr(obj, name) for name in obj.__dataclass_fields__
-        }
-    if isinstance(obj, tuple):
-        return list(obj)
-    raise TypeError(f"cannot encode {obj!r}")
 
 
 def _decode_service(data: Dict[str, Any]) -> ServiceSpec:

@@ -220,10 +220,12 @@ class MemPersister(Persister):
     def apply(self, ops: Iterable[TransactionOp]) -> None:
         with self._lock:
             ops = list(ops)
-            # validate deletes up front so the transaction is all-or-nothing
+            # validate up front so the transaction is all-or-nothing
             for op in ops:
                 if isinstance(op, DeleteOp) and self._find(op.path) is None:
                     raise PersisterError(f"path not found: {op.path}", op.path)
+                if isinstance(op, SetOp) and normalize_path(op.path) == "/":
+                    raise PersisterError("cannot store a value at '/'", op.path)
             for op in ops:
                 if isinstance(op, SetOp):
                     self._ensure(op.path).value = op.value

@@ -1,0 +1,360 @@
+"""Offer layer tests: inventory, ledger, placement DSL, torus, evaluator.
+
+Mirrors the reference's offer/evaluate + placement test suites
+(OfferEvaluatorTest, PlacementRule tests) over a fabricated fleet.
+"""
+
+import pytest
+
+from dcos_commons_tpu.common import TaskInfo
+from dcos_commons_tpu.offer import (
+    OfferEvaluator,
+    Reservation,
+    ReservationLedger,
+    SliceInventory,
+    TpuHost,
+    parse_placement,
+)
+from dcos_commons_tpu.offer.evaluate import ENV_COORDINATOR_ADDRESS
+from dcos_commons_tpu.offer.inventory import make_test_fleet
+from dcos_commons_tpu.offer.ledger import new_reservation_id
+from dcos_commons_tpu.offer.placement import PlacementContext
+from dcos_commons_tpu.plan.step import PodInstanceRequirement, RecoveryType
+from dcos_commons_tpu.specification import from_yaml
+from dcos_commons_tpu.state import StateStore
+from dcos_commons_tpu.storage import MemPersister
+
+CPU_YAML = """
+name: hello
+pods:
+  hello:
+    count: 2
+    placement: 'max-per-host:1'
+    tasks:
+      server:
+        cmd: "serve"
+        cpus: 1.0
+        memory: 1024
+        ports:
+          http: {port: 0, vip: "hello:80"}
+"""
+
+GANG_YAML = """
+name: jax
+pods:
+  trainer:
+    count: 4
+    gang: true
+    tpu:
+      generation: v5e
+      chips-per-host: 4
+      topology: 4x4
+    tasks:
+      worker:
+        goal: FINISH
+        cmd: "python train.py"
+        cpus: 2.0
+        memory: 4096
+"""
+
+
+def cpu_host(host_id, zone="z1", **kw):
+    return TpuHost(host_id=host_id, zone=zone, **kw)
+
+
+def build_eval(yaml_text, hosts, config="cfg-1"):
+    spec = from_yaml(yaml_text)
+    persister = MemPersister()
+    store = StateStore(persister)
+    ledger = ReservationLedger(persister)
+    ev = OfferEvaluator(store, ledger, spec.name, config)
+    inv = SliceInventory(hosts)
+    return spec, store, ledger, ev, inv
+
+
+# -- inventory / ledger ----------------------------------------------
+
+
+def test_host_chip_ids():
+    fleet = make_test_fleet(host_grid=(2, 2), chip_block=(2, 2))
+    h11 = [h for h in fleet if h.grid == (1, 1)][0]
+    assert h11.chip_ids() == ["pod-0/2,2", "pod-0/3,2", "pod-0/2,3", "pod-0/3,3"]
+    assert h11.chips_per_host == 4
+
+
+def test_snapshots_subtract_reservations():
+    persister = MemPersister()
+    ledger = ReservationLedger(persister)
+    fleet = make_test_fleet()
+    inv = SliceInventory(fleet)
+    ledger.commit([
+        Reservation(
+            reservation_id=new_reservation_id(),
+            host_id=fleet[0].host_id,
+            task_name="t-0-x",
+            cpus=10.0,
+            memory_mb=1000,
+            chip_ids=fleet[0].chip_ids()[:2],
+            ports=[10000],
+        )
+    ])
+    snap = {s.host.host_id: s for s in inv.snapshots(ledger)}[fleet[0].host_id]
+    assert snap.cpus == 6.0
+    assert len(snap.free_chips) == 2
+    assert 10000 in snap.used_ports
+    # ledger survives restart
+    ledger2 = ReservationLedger(persister)
+    assert len(ledger2.all()) == 1
+    assert ledger2.unexpected_reservations({"t-0-x"}) == []
+    assert len(ledger2.unexpected_reservations({"other"})) == 1
+
+
+def test_inventory_down_hosts_excluded():
+    fleet = make_test_fleet()
+    inv = SliceInventory(fleet)
+    inv.mark_down(fleet[0].host_id)
+    ledger = ReservationLedger(MemPersister())
+    assert len(inv.snapshots(ledger)) == 3
+    inv.mark_up(fleet[0].host_id)
+    assert len(inv.snapshots(ledger)) == 4
+
+
+# -- placement DSL ----------------------------------------------------
+
+
+def ctx_with(tasks, hosts):
+    return PlacementContext(
+        pod_type="hello",
+        existing_tasks=tasks,
+        hosts={h.host_id: h for h in hosts},
+    )
+
+
+def snap_for(host):
+    from dcos_commons_tpu.offer.inventory import ResourceSnapshot
+    return ResourceSnapshot(host, host.cpus, host.memory_mb, host.disk_mb,
+                            set(host.chip_ids()), set())
+
+
+def test_max_per_host_rule():
+    hosts = [cpu_host("h1"), cpu_host("h2")]
+    rule = parse_placement("max-per-host:1")
+    existing = [TaskInfo(name="hello-0-server", pod_type="hello",
+                         pod_index=0, agent_id="h1")]
+    ctx = ctx_with(existing, hosts)
+    assert not rule.filter(snap_for(hosts[0]), ctx).passed
+    assert rule.filter(snap_for(hosts[1]), ctx).passed
+
+
+def test_field_and_regex_rules():
+    h = cpu_host("h1", zone="us-central2-b")
+    ctx = ctx_with([], [h])
+    assert parse_placement("zone:exact:us-central2-b").filter(snap_for(h), ctx).passed
+    assert not parse_placement("zone:exact:eu-west4-a").filter(snap_for(h), ctx).passed
+    assert parse_placement("hostname:regex:h.*").filter(snap_for(h), ctx).passed
+    combined = parse_placement("zone:exact:us-central2-b && max-per-host:1")
+    assert combined.filter(snap_for(h), ctx).passed
+
+
+def test_task_type_rules():
+    hosts = [cpu_host("h1"), cpu_host("h2")]
+    data_task = TaskInfo(name="data-0-node", pod_type="data", pod_index=0,
+                         agent_id="h1")
+    ctx = ctx_with([data_task], hosts)
+    avoid = parse_placement("task-type:avoid:data")
+    colocate = parse_placement("task-type:colocate:data")
+    assert not avoid.filter(snap_for(hosts[0]), ctx).passed
+    assert avoid.filter(snap_for(hosts[1]), ctx).passed
+    assert colocate.filter(snap_for(hosts[0]), ctx).passed
+    assert not colocate.filter(snap_for(hosts[1]), ctx).passed
+
+
+def test_marathon_dialect():
+    hosts = [cpu_host("h1", zone="a"), cpu_host("h2", zone="b")]
+    existing = [TaskInfo(name="hello-0-server", pod_type="hello", pod_index=0,
+                         agent_id="h1")]
+    ctx = ctx_with(existing, hosts)
+    unique = parse_placement('[["hostname", "UNIQUE"]]')
+    assert not unique.filter(snap_for(hosts[0]), ctx).passed
+    assert unique.filter(snap_for(hosts[1]), ctx).passed
+    like = parse_placement('[["zone", "LIKE", "a"]]')
+    assert like.filter(snap_for(hosts[0]), ctx).passed
+    assert not like.filter(snap_for(hosts[1]), ctx).passed
+    unlike = parse_placement('[["zone", "UNLIKE", "a"]]')
+    assert not unlike.filter(snap_for(hosts[0]), ctx).passed
+    with pytest.raises(ValueError):
+        parse_placement('[["zone", "TELEPORT"]]')
+    with pytest.raises(ValueError):
+        parse_placement("teleport:3")
+
+
+# -- evaluator: CPU pods ----------------------------------------------
+
+
+def test_evaluate_cpu_pod_with_ports():
+    spec, store, ledger, ev, inv = build_eval(
+        CPU_YAML, [cpu_host("h1"), cpu_host("h2")]
+    )
+    req = PodInstanceRequirement(pod=spec.pod("hello"), instances=[0])
+    result = ev.evaluate(req, inv)
+    assert result.passed, result.outcome.flatten()
+    assert len(result.task_infos) == 1
+    info = result.task_infos[0]
+    assert info.name == "hello-0-server"
+    assert "PORT_HTTP" in info.env
+    assert info.labels["target_configuration"] == "cfg-1"
+    # commit + store, then the second instance must avoid h1
+    ledger.commit(result.reservations)
+    store.store_tasks(result.task_infos)
+    req2 = PodInstanceRequirement(pod=spec.pod("hello"), instances=[1])
+    result2 = ev.evaluate(req2, inv)
+    assert result2.passed
+    assert result2.task_infos[0].agent_id != info.agent_id
+
+
+def test_evaluate_fails_when_full():
+    spec, store, ledger, ev, inv = build_eval(CPU_YAML, [cpu_host("h1")])
+    req0 = PodInstanceRequirement(pod=spec.pod("hello"), instances=[0])
+    r0 = ev.evaluate(req0, inv)
+    ledger.commit(r0.reservations)
+    store.store_tasks(r0.task_infos)
+    r1 = ev.evaluate(
+        PodInstanceRequirement(pod=spec.pod("hello"), instances=[1]), inv
+    )
+    assert not r1.passed
+    # the "why" is explainable (outcome tracker contract)
+    text = "\n".join(r1.outcome.flatten())
+    assert "max-per-host" in text
+
+
+def test_evaluate_reuse_in_place():
+    """TRANSIENT relaunch reuses the committed footprint."""
+    spec, store, ledger, ev, inv = build_eval(
+        CPU_YAML, [cpu_host("h1"), cpu_host("h2")]
+    )
+    req = PodInstanceRequirement(pod=spec.pod("hello"), instances=[0])
+    first = ev.evaluate(req, inv)
+    ledger.commit(first.reservations)
+    store.store_tasks(first.task_infos)
+    again = ev.evaluate(
+        PodInstanceRequirement(
+            pod=spec.pod("hello"), instances=[0],
+            recovery_type=RecoveryType.TRANSIENT,
+        ),
+        inv,
+    )
+    assert again.passed
+    assert again.reservations == []  # no new claims
+    assert again.task_infos[0].agent_id == first.task_infos[0].agent_id
+    assert again.task_infos[0].env.get("PORT_HTTP") == \
+        first.task_infos[0].env.get("PORT_HTTP")
+    # PERMANENT forces fresh placement with NEW reservations (the old
+    # footprint is later reclaimed as unexpected-resource GC, mirroring
+    # DefaultScheduler.java:483-538); same-host is allowed if placement
+    # rules pass and the host is up
+    replaced = ev.evaluate(
+        PodInstanceRequirement(
+            pod=spec.pod("hello"), instances=[0],
+            recovery_type=RecoveryType.PERMANENT,
+        ),
+        inv,
+    )
+    assert replaced.passed
+    assert replaced.reservations  # new claims
+    old_ids = {r.reservation_id for r in first.reservations}
+    new_ids = {r.reservation_id for r in replaced.reservations}
+    assert not (old_ids & new_ids)
+
+
+def test_evaluate_reuse_skipped_when_host_down():
+    spec, store, ledger, ev, inv = build_eval(
+        CPU_YAML, [cpu_host("h1"), cpu_host("h2")]
+    )
+    req = PodInstanceRequirement(pod=spec.pod("hello"), instances=[0])
+    first = ev.evaluate(req, inv)
+    ledger.commit(first.reservations)
+    store.store_tasks(first.task_infos)
+    inv.mark_down(first.task_infos[0].agent_id)
+    relaunch = ev.evaluate(
+        PodInstanceRequirement(
+            pod=spec.pod("hello"), instances=[0],
+            recovery_type=RecoveryType.TRANSIENT,
+        ),
+        inv,
+    )
+    assert relaunch.passed
+    assert relaunch.task_infos[0].agent_id != first.task_infos[0].agent_id
+
+
+# -- evaluator: gang TPU pods ----------------------------------------
+
+
+def test_evaluate_gang_torus():
+    fleet = make_test_fleet(host_grid=(4, 4), chip_block=(2, 2))
+    spec, store, ledger, ev, inv = build_eval(GANG_YAML, fleet)
+    req = PodInstanceRequirement(
+        pod=spec.pod("trainer"), instances=[0, 1, 2, 3]
+    )
+    result = ev.evaluate(req, inv)
+    assert result.passed, result.outcome.flatten()
+    assert len(result.task_infos) == 4
+    # hosts form a contiguous 2x2 host rectangle (4x4 chips of 2x2 blocks)
+    grids = sorted(
+        inv.host(i.agent_id).grid for i in result.task_infos
+    )
+    assert grids == [(0, 0), (0, 1), (1, 0), (1, 1)]
+    # all workers share one coordinator address pointing at worker 0
+    coords = {i.env[ENV_COORDINATOR_ADDRESS] for i in result.task_infos}
+    assert len(coords) == 1
+    worker0 = [i for i in result.task_infos if i.env["TPU_WORKER_ID"] == "0"][0]
+    assert coords.pop().startswith(worker0.agent_id)
+    assert worker0.env["TPU_TOPOLOGY"] == "4x4"
+    assert len(worker0.tpu_chip_ids) == 4
+
+
+def test_gang_torus_avoids_reserved_hosts():
+    fleet = make_test_fleet(host_grid=(4, 2), chip_block=(2, 2))
+    spec, store, ledger, ev, inv = build_eval(GANG_YAML, fleet)
+    # burn a chip on host (0,0): the 2x2 anchor must shift right
+    blocked = [h for h in fleet if h.grid == (0, 0)][0]
+    ledger.commit([
+        Reservation(
+            reservation_id=new_reservation_id(), host_id=blocked.host_id,
+            task_name="intruder-0-x", chip_ids=blocked.chip_ids()[:1],
+        )
+    ])
+    req = PodInstanceRequirement(
+        pod=spec.pod("trainer"), instances=[0, 1, 2, 3]
+    )
+    result = ev.evaluate(req, inv)
+    assert result.passed
+    grids = sorted(inv.host(i.agent_id).grid for i in result.task_infos)
+    assert grids == [(1, 0), (1, 1), (2, 0), (2, 1)] or \
+        grids == [(2, 0), (2, 1), (3, 0), (3, 1)]
+
+
+def test_gang_torus_no_capacity_explains():
+    fleet = make_test_fleet(host_grid=(1, 1), chip_block=(2, 2))
+    spec, store, ledger, ev, inv = build_eval(GANG_YAML, fleet)
+    req = PodInstanceRequirement(
+        pod=spec.pod("trainer"), instances=[0, 1, 2, 3]
+    )
+    result = ev.evaluate(req, inv)
+    assert not result.passed
+    text = "\n".join(result.outcome.flatten())
+    assert "smaller than required" in text
+
+
+def test_gang_atomicity_no_partial_claims():
+    """A gang that cannot fully place claims NOTHING."""
+    fleet = make_test_fleet(host_grid=(2, 2), chip_block=(2, 2), cpus=1.0)
+    # trainer needs 2 cpus/host but hosts have 1: must fail with zero
+    # reservations
+    spec, store, ledger, ev, inv = build_eval(GANG_YAML, fleet)
+    result = ev.evaluate(
+        PodInstanceRequirement(pod=spec.pod("trainer"), instances=[0, 1, 2, 3]),
+        inv,
+    )
+    assert not result.passed
+    assert result.reservations == []
+    assert ledger.all() == []

@@ -176,9 +176,70 @@ def test_gang_step_covers_all_instances():
     assert step.get_status() == Status.STARTED  # 3 of 4 running
     step.update(TaskStatus(task_id=items[3][1], state=TaskState.RUNNING, ready=True))
     assert step.get_status() == Status.COMPLETE
-    # one worker dying resets the WHOLE gang
+    # post-completion failures do NOT regress the deploy step — the
+    # recovery plan owns keep-alive (gang recovery covers all workers)
     step.update(TaskStatus(task_id=items[0][1], state=TaskState.FAILED))
+    assert step.get_status() == Status.COMPLETE
+
+
+def test_gang_step_mid_deploy_failure_resets_whole_gang():
+    step = make_step(
+        name="trainer-gang", pod_yaml=GANG_YAML, pod="trainer",
+        instances=[0, 1, 2, 3],
+    )
+    req = step.start()
+    ids = {n: new_task_id(n) for n in req.task_names()}
+    step.record_launch(ids)
+    items = list(ids.items())
+    for name, tid in items[:3]:
+        step.update(TaskStatus(task_id=tid, state=TaskState.RUNNING, ready=True))
+    # 4th worker fails before the gang completed: whole step resets
+    step.update(TaskStatus(task_id=items[3][1], state=TaskState.FAILED))
     assert step.get_status() == Status.PENDING
+
+
+def test_step_failure_drops_aborted_launch_state():
+    """A re-delivered status from an aborted launch must not lift the
+    step out of PENDING (review regression: deploy wedge)."""
+    step = make_step(
+        name="trainer-gang", pod_yaml=GANG_YAML, pod="trainer",
+        instances=[0, 1],
+    )
+    # note: GANG_YAML trainer count is 4; 2 instances is fine for a step
+    req = step.start()
+    ids = {n: new_task_id(n) for n in req.task_names()}
+    step.record_launch(ids)
+    items = list(ids.items())
+    step.update(TaskStatus(task_id=items[0][1], state=TaskState.RUNNING, ready=True))
+    step.update(TaskStatus(task_id=items[1][1], state=TaskState.FAILED))
+    assert step.get_status() == Status.PENDING
+    # duplicate delivery of worker 0's RUNNING status: stays PENDING
+    step.update(TaskStatus(task_id=items[0][1], state=TaskState.RUNNING, ready=True))
+    assert step.get_status() == Status.PENDING
+    assert step.start() is not None  # still offers work
+
+
+def test_generator_rejects_bad_step_indices():
+    yaml_bad = YAML + """
+plans:
+  deploy:
+    phases:
+      p:
+        pod: hello
+        steps:
+          - 5: [[server]]
+"""
+    from dcos_commons_tpu.specification import SpecError
+    spec = from_yaml(yaml_bad)
+    store = StateStore(MemPersister())
+    with pytest.raises(SpecError) as err:
+        PlanGenerator().generate(spec, "deploy", spec.plans["deploy"], store, "c")
+    assert "out of range" in str(err.value)
+    yaml_bad2 = yaml_bad.replace("- 5: [[server]]", "- 0: [[bogus-task]]")
+    spec2 = from_yaml(yaml_bad2)
+    with pytest.raises(SpecError) as err2:
+        PlanGenerator().generate(spec2, "deploy", spec2.plans["deploy"], store, "c")
+    assert "unknown tasks" in str(err2.value)
 
 
 def test_step_interrupt():

@@ -1,0 +1,268 @@
+"""End-to-end scheduler tests with real subprocess tasks.
+
+The minimum end-to-end slice of SURVEY.md section 7: YAML -> spec ->
+plans -> evaluation over a fake fleet -> REAL processes launched by
+LocalProcessAgent -> statuses drive the plan to COMPLETE.  Mirrors the
+reference's ServiceTestRunner-based ServiceTest.java flows (deploy,
+task kill -> recovery, scheduler restart).
+"""
+
+import os
+import time
+
+import pytest
+
+from dcos_commons_tpu.agent import LocalProcessAgent
+from dcos_commons_tpu.common import TaskState
+from dcos_commons_tpu.offer.inventory import SliceInventory, TpuHost, make_test_fleet
+from dcos_commons_tpu.plan.status import Status
+from dcos_commons_tpu.recovery.monitor import TestingFailureMonitor
+from dcos_commons_tpu.scheduler import SchedulerBuilder, SchedulerConfig
+from dcos_commons_tpu.specification import from_yaml
+from dcos_commons_tpu.storage import FileWalPersister, MemPersister
+
+HELLO_YAML = """
+name: hello-world
+pods:
+  hello:
+    count: 2
+    placement: 'max-per-host:1'
+    tasks:
+      server:
+        goal: RUNNING
+        cmd: "echo hello-$POD_INSTANCE_INDEX > out.txt && sleep 60"
+        cpus: 0.1
+        memory: 32
+"""
+
+ONCE_YAML = """
+name: once-svc
+pods:
+  job:
+    count: 1
+    tasks:
+      run:
+        goal: FINISH
+        cmd: "echo done > result.txt"
+        cpus: 0.1
+        memory: 32
+"""
+
+
+def cpu_hosts(n):
+    return [TpuHost(host_id=f"h{i}") for i in range(n)]
+
+
+def build_scheduler(yaml_text, hosts, tmp_path, persister=None, **cfg_kw):
+    spec = from_yaml(yaml_text)
+    config = SchedulerConfig(
+        sandbox_root=str(tmp_path / "sandboxes"),
+        backoff_enabled=False,
+        **cfg_kw,
+    )
+    builder = SchedulerBuilder(spec, config, persister or MemPersister())
+    builder.set_inventory(SliceInventory(hosts))
+    builder.set_agent(LocalProcessAgent(str(tmp_path / "sandboxes")))
+    return builder
+
+
+def drive(scheduler, until, timeout_s=15.0, interval_s=0.05):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        scheduler.run_cycle()
+        if until(scheduler):
+            return True
+        time.sleep(interval_s)
+    return False
+
+
+def deploy_complete(s):
+    return s.deploy_manager.get_plan().is_complete
+
+
+def test_deploy_to_complete(tmp_path):
+    scheduler = build_scheduler(HELLO_YAML, cpu_hosts(3), tmp_path).build()
+    try:
+        assert drive(scheduler, deploy_complete), _debug(scheduler)
+        # placement respected: 2 pods on 2 distinct hosts
+        agents = {i.agent_id for i in scheduler.state_store.fetch_tasks()}
+        assert len(agents) == 2
+        # the tasks really ran: their sandboxes contain the output
+        out = os.path.join(
+            scheduler.agent.sandbox_of("hello-0-server"), "out.txt"
+        )
+        assert open(out).read().strip() == "hello-0"
+    finally:
+        scheduler.agent.shutdown()
+
+
+def test_finish_goal_task_completes(tmp_path):
+    scheduler = build_scheduler(ONCE_YAML, cpu_hosts(1), tmp_path).build()
+    try:
+        assert drive(scheduler, deploy_complete), _debug(scheduler)
+        status = scheduler.state_store.fetch_status("job-0-run")
+        assert status.state == TaskState.FINISHED
+        # FINISHED FINISH-goal tasks are not "recovered"
+        scheduler.run_cycle()
+        assert scheduler.recovery_manager.get_plan().phases == []
+    finally:
+        scheduler.agent.shutdown()
+
+
+def test_task_kill_triggers_recovery(tmp_path):
+    scheduler = build_scheduler(HELLO_YAML, cpu_hosts(3), tmp_path).build()
+    try:
+        assert drive(scheduler, deploy_complete)
+        victim = scheduler.state_store.fetch_task("hello-0-server")
+        # kill the process out-of-band (simulates a crash)
+        scheduler.agent.kill(victim.task_id)
+
+        def recovered(s):
+            info = s.state_store.fetch_task("hello-0-server")
+            status = s.state_store.fetch_status("hello-0-server")
+            return (
+                info.task_id != victim.task_id
+                and status.task_id == info.task_id
+                and status.state == TaskState.RUNNING
+            )
+
+        assert drive(scheduler, recovered), _debug(scheduler)
+        # deploy plan unaffected (stays COMPLETE); recovery did the work
+        assert scheduler.deploy_manager.get_plan().is_complete
+        # relaunch reused the same host (TRANSIENT, in place)
+        info2 = scheduler.state_store.fetch_task("hello-0-server")
+        assert info2.agent_id == victim.agent_id
+    finally:
+        scheduler.agent.shutdown()
+
+
+def test_permanent_failure_replaces(tmp_path):
+    spec_builder = build_scheduler(HELLO_YAML, cpu_hosts(3), tmp_path)
+    spec_builder.set_failure_monitor(
+        TestingFailureMonitor(permanent_tasks={"hello-0-server"})
+    )
+    scheduler = spec_builder.build()
+    try:
+        assert drive(scheduler, deploy_complete)
+        victim = scheduler.state_store.fetch_task("hello-0-server")
+        scheduler.agent.kill(victim.task_id)
+
+        def replaced(s):
+            info = s.state_store.fetch_task("hello-0-server")
+            status = s.state_store.fetch_status("hello-0-server")
+            return (
+                info.task_id != victim.task_id
+                and status.task_id == info.task_id
+                and status.state == TaskState.RUNNING
+            )
+
+        assert drive(scheduler, replaced), _debug(scheduler)
+        # fresh reservations were claimed; old ones GC'd
+        new_info = scheduler.state_store.fetch_task("hello-0-server")
+        assert set(new_info.resource_ids) != set(victim.resource_ids)
+        live_ids = {r.reservation_id for r in scheduler.ledger.all()}
+        assert not (live_ids & set(victim.resource_ids))
+    finally:
+        scheduler.agent.shutdown()
+
+
+def test_scheduler_restart_resumes(tmp_path):
+    """Crash the scheduler mid-deploy; a rebuilt one finishes the plan.
+
+    Reference: SchedulerRestartServiceTest via ServiceTestRunner state
+    handoff (ServiceTest.java:57-77).
+    """
+    persister = FileWalPersister(str(tmp_path / "state"), fsync=False)
+    builder = build_scheduler(HELLO_YAML, cpu_hosts(3), tmp_path, persister)
+    scheduler = builder.build()
+    agent = scheduler.agent
+    # run only until the FIRST pod instance is running
+    def first_running(s):
+        status = s.state_store.fetch_status("hello-0-server")
+        return status is not None and status.state == TaskState.RUNNING
+    assert drive(scheduler, first_running)
+    assert not scheduler.deploy_manager.get_plan().is_complete
+
+    # "crash": rebuild the whole scheduler over the same persister and
+    # the same (still running) agent
+    builder2 = build_scheduler(HELLO_YAML, cpu_hosts(3), tmp_path, persister)
+    builder2.set_agent(agent)
+    restarted = builder2.build()
+    try:
+        assert drive(restarted, deploy_complete), _debug(restarted)
+        # hello-0 was NOT relaunched (still the original task id)
+        original = scheduler.state_store.fetch_task("hello-0-server")
+        resumed = restarted.state_store.fetch_task("hello-0-server")
+        assert resumed.task_id == original.task_id
+    finally:
+        agent.shutdown()
+
+
+def test_reconciliation_recovers_wal_only_launch(tmp_path):
+    """Crash between WAL and launch: reconciliation -> LOST -> relaunch."""
+    persister = FileWalPersister(str(tmp_path / "state"), fsync=False)
+    scheduler = build_scheduler(
+        HELLO_YAML, cpu_hosts(3), tmp_path, persister
+    ).build()
+    # manually WAL a launch that never reached the agent
+    from dcos_commons_tpu.plan.step import PodInstanceRequirement
+
+    req = PodInstanceRequirement(pod=scheduler.spec.pod("hello"), instances=[0])
+    result = scheduler.evaluator.evaluate(req, scheduler.inventory)
+    scheduler.ledger.commit(result.reservations)
+    scheduler.launch_recorder.record(result.task_infos)
+    ghost_id = result.task_infos[0].task_id
+
+    try:
+        assert drive(scheduler, deploy_complete), _debug(scheduler)
+        info = scheduler.state_store.fetch_task("hello-0-server")
+        assert info.task_id != ghost_id  # ghost was declared LOST, relaunched
+    finally:
+        scheduler.agent.shutdown()
+
+
+def test_config_update_rolls_changed_pods(tmp_path):
+    persister = FileWalPersister(str(tmp_path / "state"), fsync=False)
+    scheduler = build_scheduler(
+        HELLO_YAML, cpu_hosts(3), tmp_path, persister
+    ).build()
+    agent = scheduler.agent
+    assert drive(scheduler, deploy_complete)
+    old_ids = {
+        i.name: i.task_id for i in scheduler.state_store.fetch_tasks()
+    }
+
+    updated_yaml = HELLO_YAML.replace("echo hello-", "echo updated-")
+    builder2 = build_scheduler(updated_yaml, cpu_hosts(3), tmp_path, persister)
+    builder2.set_agent(agent)
+    updated = builder2.build()
+    try:
+        # the new target config makes existing tasks outdated: plan is
+        # an update plan with PENDING steps
+        plan = updated.deploy_manager.get_plan()
+        assert plan.name == "update"
+        assert not plan.is_complete
+        assert drive(updated, deploy_complete), _debug(updated)
+        new_infos = {i.name: i for i in updated.state_store.fetch_tasks()}
+        assert all(
+            new_infos[name].task_id != old_ids[name] for name in old_ids
+        )
+        out = os.path.join(agent.sandbox_of("hello-1-server"), "out.txt")
+        assert open(out).read().strip() == "updated-1"
+    finally:
+        agent.shutdown()
+
+
+def _debug(scheduler):
+    from dcos_commons_tpu.debug.trackers import serialize_plan
+
+    return {
+        "plans": {
+            n: serialize_plan(p) for n, p in scheduler.plans().items()
+        },
+        "statuses": {
+            n: (s.state.value, s.task_id)
+            for n, s in scheduler.state_store.fetch_statuses().items()
+        },
+        "outcomes": scheduler.outcome_tracker.to_json()[-3:],
+    }
