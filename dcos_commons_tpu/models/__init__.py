@@ -1,0 +1,32 @@
+"""Workload model zoo for frameworks/jax.
+
+The reference SDK has no data plane (SURVEY.md: "the workloads are
+whatever the service YAML launches"); these are the flagship workloads
+the TPU rebuild ships so a user can stand up real training pods:
+
+- transformer.py  decoder-only LM, pure-JAX pytrees, scan-over-layers,
+                  bf16 compute, RoPE + GQA + SwiGLU, pallas kernels,
+                  dp/fsdp/tp/sp shardings for pjit
+- mlp.py          MNIST-scale MLP (the BASELINE.json config-3 demo)
+"""
+
+from dcos_commons_tpu.models.transformer import (
+    TransformerConfig,
+    init_params,
+    loss_fn,
+    make_train_step,
+    forward,
+)
+from dcos_commons_tpu.models.mlp import MlpConfig, mlp_forward, mlp_init, mlp_train_step
+
+__all__ = [
+    "MlpConfig",
+    "TransformerConfig",
+    "forward",
+    "init_params",
+    "loss_fn",
+    "make_train_step",
+    "mlp_forward",
+    "mlp_init",
+    "mlp_train_step",
+]

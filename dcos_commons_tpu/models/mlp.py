@@ -1,0 +1,66 @@
+"""MNIST-scale MLP: the BASELINE.json config-3 workload.
+
+The frameworks/jax single-host demo task trains this on one chip; it
+exists to prove the control plane launches real JAX work, not to be
+clever.  bf16 matmuls, f32 loss.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class MlpConfig:
+    d_in: int = 784
+    d_hidden: int = 512
+    d_out: int = 10
+    dtype: Any = jnp.bfloat16
+
+
+def mlp_init(config: MlpConfig, key: jax.Array) -> Dict[str, jax.Array]:
+    k1, k2, k3 = jax.random.split(key, 3)
+
+    def normal(key, shape, scale):
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(
+            config.dtype
+        )
+
+    return {
+        "w1": normal(k1, (config.d_in, config.d_hidden), config.d_in ** -0.5),
+        "b1": jnp.zeros((config.d_hidden,), config.dtype),
+        "w2": normal(k2, (config.d_hidden, config.d_hidden),
+                     config.d_hidden ** -0.5),
+        "b2": jnp.zeros((config.d_hidden,), config.dtype),
+        "w3": normal(k3, (config.d_hidden, config.d_out),
+                     config.d_hidden ** -0.5),
+        "b3": jnp.zeros((config.d_out,), config.dtype),
+    }
+
+
+def mlp_forward(params: Dict[str, jax.Array], x: jax.Array) -> jax.Array:
+    x = x.astype(params["w1"].dtype)
+    x = jax.nn.relu(x @ params["w1"] + params["b1"])
+    x = jax.nn.relu(x @ params["w2"] + params["b2"])
+    return (x @ params["w3"] + params["b3"]).astype(jnp.float32)
+
+
+def mlp_loss(params, x, y):
+    logits = mlp_forward(params, x)
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.take_along_axis(logp, y[:, None], axis=-1).mean()
+
+
+def mlp_train_step(optimizer):
+    @jax.jit
+    def step(params, opt_state, x, y):
+        loss, grads = jax.value_and_grad(mlp_loss)(params, x, y)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree.map(lambda p, u: p + u.astype(p.dtype), params, updates)
+        return params, opt_state, loss
+
+    return step

@@ -1,0 +1,283 @@
+"""Flagship decoder-only transformer LM, designed TPU-first.
+
+Design choices (not a port of anything):
+- pure-JAX pytree params; layers STACKED and iterated with lax.scan so
+  XLA compiles one layer once regardless of depth (compile-time and
+  code-size win over unrolled Python loops)
+- bf16 activations + params with f32 RMSNorm statistics, f32 logits
+  for the loss: the MXU-native mixed precision recipe
+- RoPE positions, grouped-query attention, SwiGLU MLP
+- attention via ops.flash_attention (pallas) on one device, or
+  parallel.ring.ring_attention when the sequence is sharded on "sp"
+- sharding rules map every param to a PartitionSpec over
+  (dp, fsdp, tp, sp) for pjit; batch shards over (dp, fsdp), heads
+  and ffn over tp, params over fsdp
+- optional jax.checkpoint (remat) per layer: recompute activations in
+  backward to trade FLOPs for HBM
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dcos_commons_tpu.ops.attention import flash_attention
+from dcos_commons_tpu.ops.rmsnorm import rms_norm
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab: int = 32768
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: int = 8          # < n_heads => GQA
+    d_ff: int = 1408             # SwiGLU hidden
+    max_seq: int = 2048
+    rope_theta: float = 10000.0
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    use_ring_attention: bool = False   # sequence sharded over "sp"
+    sp_axis: str = "sp"
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+Params = Dict[str, Any]
+
+
+def init_params(config: TransformerConfig, key: jax.Array) -> Params:
+    """Stacked-layer param tree: every per-layer array has a leading
+    n_layers axis consumed by lax.scan."""
+    keys = jax.random.split(key, 8)
+    d, h, kv, hd, f = (
+        config.d_model,
+        config.n_heads,
+        config.n_kv_heads,
+        config.head_dim,
+        config.d_ff,
+    )
+    n = config.n_layers
+    dt = config.dtype
+
+    def normal(key, shape, scale):
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dt)
+
+    return {
+        "embed": normal(keys[0], (config.vocab, d), d ** -0.5),
+        "layers": {
+            "attn_norm": jnp.ones((n, d), dt),
+            "wq": normal(keys[1], (n, d, h * hd), d ** -0.5),
+            "wk": normal(keys[2], (n, d, kv * hd), d ** -0.5),
+            "wv": normal(keys[3], (n, d, kv * hd), d ** -0.5),
+            "wo": normal(keys[4], (n, h * hd, d), (h * hd) ** -0.5),
+            "mlp_norm": jnp.ones((n, d), dt),
+            "w_gate": normal(keys[5], (n, d, f), d ** -0.5),
+            "w_up": normal(keys[6], (n, d, f), d ** -0.5),
+            "w_down": normal(keys[7], (n, f, d), f ** -0.5),
+        },
+        "final_norm": jnp.ones((d,), dt),
+    }
+
+
+def sharding_rules(config: TransformerConfig) -> Dict[str, P]:
+    """Param path -> PartitionSpec (scaling-book layout):
+    heads/ffn over tp, the other big axis over fsdp."""
+    return {
+        "embed": P("tp", "fsdp"),
+        "layers/attn_norm": P(None, None),
+        "layers/wq": P(None, "fsdp", "tp"),
+        "layers/wk": P(None, "fsdp", "tp"),
+        "layers/wv": P(None, "fsdp", "tp"),
+        "layers/wo": P(None, "tp", "fsdp"),
+        "layers/mlp_norm": P(None, None),
+        "layers/w_gate": P(None, "fsdp", "tp"),
+        "layers/w_up": P(None, "fsdp", "tp"),
+        "layers/w_down": P(None, "tp", "fsdp"),
+        "final_norm": P(None),
+    }
+
+
+def param_shardings(config: TransformerConfig, mesh: Mesh, shapes=None):
+    rules = sharding_rules(config)
+
+    def walk(tree, prefix=""):
+        if isinstance(tree, dict):
+            return {
+                name: walk(sub, f"{prefix}/{name}" if prefix else name)
+                for name, sub in tree.items()
+            }
+        return NamedSharding(mesh, rules[prefix])
+
+    if shapes is None:
+        shapes = jax.eval_shape(
+            functools.partial(init_params, config), jax.random.key(0)
+        )
+    return walk(shapes)
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embeddings; x [b, s, heads, head_dim]."""
+    half = x.shape[-1] // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions[:, :, None, None].astype(jnp.float32) * freqs
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = x[..., :half], x[..., half:]
+    rotated = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    )
+    return rotated.astype(x.dtype)
+
+
+def _attention_block(config: TransformerConfig, layer, x, positions):
+    b, s, d = x.shape
+    h, kv, hd = config.n_heads, config.n_kv_heads, config.head_dim
+    normed = rms_norm(x, layer["attn_norm"])
+    q = (normed @ layer["wq"]).reshape(b, s, h, hd)
+    k = (normed @ layer["wk"]).reshape(b, s, kv, hd)
+    v = (normed @ layer["wv"]).reshape(b, s, kv, hd)
+    q = _rope(q, positions, config.rope_theta)
+    k = _rope(k, positions, config.rope_theta)
+    if kv != h:
+        reps = h // kv
+        k = jnp.repeat(k, reps, axis=2)
+        v = jnp.repeat(v, reps, axis=2)
+    # [b, heads, s, hd] layout for the kernels
+    q, k, v = (t.transpose(0, 2, 1, 3) for t in (q, k, v))
+    if config.use_ring_attention:
+        from dcos_commons_tpu.parallel.ring import ring_attention
+
+        attn = ring_attention(q, k, v, axis_name=config.sp_axis, causal=True)
+    else:
+        attn = flash_attention(q, k, v, causal=True)
+    attn = attn.transpose(0, 2, 1, 3).reshape(b, s, h * hd)
+    return x + attn @ layer["wo"]
+
+
+def _mlp_block(layer, x):
+    normed = rms_norm(x, layer["mlp_norm"])
+    gate = jax.nn.silu(normed @ layer["w_gate"])
+    up = normed @ layer["w_up"]
+    return x + (gate * up) @ layer["w_down"]
+
+
+def forward(
+    config: TransformerConfig,
+    params: Params,
+    tokens: jax.Array,
+    positions: Optional[jax.Array] = None,
+) -> jax.Array:
+    """tokens [b, s] -> logits [b, s, vocab] (f32)."""
+    b, s = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+        if config.use_ring_attention:
+            # each sp shard holds a consecutive chunk; offset positions
+            idx = lax.axis_index(config.sp_axis)
+            positions = positions + idx * s
+    x = params["embed"][tokens].astype(config.dtype)
+
+    def layer_fn(x, layer):
+        x = _attention_block(config, layer, x, positions)
+        x = _mlp_block(layer, x)
+        return x, None
+
+    if config.remat:
+        layer_fn = jax.checkpoint(layer_fn)
+    x, _ = lax.scan(layer_fn, x, params["layers"])
+    x = rms_norm(x, params["final_norm"])
+    # tied embeddings; f32 logits for a stable softmax
+    return jnp.einsum(
+        "bsd,vd->bsv", x.astype(jnp.float32),
+        params["embed"].astype(jnp.float32),
+    )
+
+
+def loss_fn(
+    config: TransformerConfig, params: Params, tokens: jax.Array,
+    targets: jax.Array,
+) -> jax.Array:
+    logits = forward(config, params, tokens)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return nll.mean()
+
+
+def make_train_step(
+    config: TransformerConfig,
+    optimizer,
+    mesh: Optional[Mesh] = None,
+    donate: bool = True,
+):
+    """Build a jitted (params, opt_state, tokens, targets) ->
+    (params, opt_state, loss) step.
+
+    With a mesh, in/out shardings pin params to the rule layout and
+    batch to (dp, fsdp) x sp; XLA inserts the dp/fsdp gradient
+    reduce-scatters and tp activation collectives.
+    """
+
+    def step(params, opt_state, tokens, targets):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(config, p, tokens, targets)
+        )(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree.map(
+            lambda p, u: (p + u.astype(p.dtype)), params, updates
+        )
+        return params, opt_state, loss
+
+    if mesh is None:
+        return jax.jit(step, donate_argnums=(0, 1) if donate else ())
+
+    from dcos_commons_tpu.parallel.mesh import batch_spec, replicated as rep
+
+    params_shapes = jax.eval_shape(
+        functools.partial(init_params, config), jax.random.key(0)
+    )
+    p_shard = param_shardings(config, mesh, params_shapes)
+    opt_shapes = jax.eval_shape(optimizer.init, params_shapes)
+    batch_sharding = NamedSharding(mesh, batch_spec())
+    replicated = NamedSharding(mesh, rep())
+
+    # optimizer state shardings: any leaf shaped like a param (whose
+    # path ends with that param's path) inherits the param's sharding;
+    # everything else (adam counts, scalars) is replicated
+    def path_key(path):
+        return tuple(
+            str(getattr(k, "key", getattr(k, "idx", "?"))) for k in path
+        )
+
+    flat_params = {
+        path_key(path): leaf.shape
+        for path, leaf in jax.tree_util.tree_flatten_with_path(params_shapes)[0]
+    }
+    flat_pshard = {
+        path_key(path): leaf
+        for path, leaf in jax.tree_util.tree_flatten_with_path(p_shard)[0]
+    }
+
+    def opt_leaf_sharding(path, leaf):
+        for ppath, pshape in flat_params.items():
+            if leaf.shape == pshape and path[-len(ppath):] == ppath:
+                return flat_pshard[ppath]
+        return replicated
+
+    opt_shard = jax.tree_util.tree_map_with_path(
+        lambda path, leaf: opt_leaf_sharding(path_key(path), leaf),
+        opt_shapes,
+    )
+    return jax.jit(
+        step,
+        in_shardings=(p_shard, opt_shard, batch_sharding, batch_sharding),
+        out_shardings=(p_shard, opt_shard, replicated),
+        donate_argnums=(0, 1) if donate else (),
+    )

@@ -1,0 +1,14 @@
+"""Pallas TPU kernels for the hot ops, with jnp fallbacks.
+
+The reference links external native compute (libmesos JNI); our
+native compute layer is XLA + these Pallas kernels (SURVEY.md section
+2.2 native inventory note).  Every kernel has a jnp reference
+implementation used as the CPU fallback and the correctness oracle;
+kernels themselves are additionally testable on CPU via
+``interpret=True``.
+"""
+
+from dcos_commons_tpu.ops.attention import flash_attention
+from dcos_commons_tpu.ops.rmsnorm import rms_norm
+
+__all__ = ["flash_attention", "rms_norm"]

@@ -1,0 +1,165 @@
+"""Blocked flash attention for one device.
+
+MXU-first design (pallas_guide.md): Q blocks stream through a grid of
+(batch*heads, q_blocks); K/V live in VMEM per grid cell and the kernel
+walks K blocks with an online-softmax accumulator, so the [S, S] score
+matrix never materializes in HBM.  bf16 in, f32 accumulation,
+``preferred_element_type`` on every dot.
+
+For sequences sharded across devices use
+dcos_commons_tpu.parallel.ring.ring_attention, which applies the same
+accumulation across ring hops.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG = -1e30
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool):
+    from jax.experimental import pallas as pl
+
+    q_index = pl.program_id(1)
+    block_q = q_ref.shape[0]
+    head_dim = q_ref.shape[1]
+    seq_k = k_ref.shape[0]
+    scale = head_dim ** -0.5
+
+    q = q_ref[:].astype(jnp.float32) * scale
+    m = jnp.full((block_q, 1), _NEG, jnp.float32)
+    l = jnp.zeros((block_q, 1), jnp.float32)
+    acc = jnp.zeros((block_q, head_dim), jnp.float32)
+
+    q_pos = q_index * block_q + lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    k_off = lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+
+    def body(j, carry):
+        m, l, acc = carry
+        from jax.experimental import pallas as pl  # noqa: redefined for trace
+
+        k = k_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if causal:
+            valid = q_pos >= (j * block_k + k_off)
+            s = jnp.where(valid, s, _NEG)
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        if causal:
+            p = jnp.where(valid, p, 0.0)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + p.sum(axis=-1, keepdims=True)
+        acc_new = acc * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    if causal:
+        # K blocks fully in the future contribute nothing; stop after
+        # the block containing the last visible position
+        n_blocks = jnp.minimum(
+            pl.cdiv((q_index + 1) * block_q, block_k), seq_k // block_k
+        )
+    else:
+        n_blocks = seq_k // block_k
+    m, l, acc = lax.fori_loop(0, n_blocks, body, (m, l, acc))
+    o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "block_q", "block_k", "interpret")
+)
+def _pallas_attention(q, k, v, causal, block_q, block_k, interpret):
+    from jax.experimental import pallas as pl
+
+    batch, heads, seq_q, head_dim = q.shape
+    seq_k = k.shape[2]
+    bh = batch * heads
+    qr = q.reshape(bh, seq_q, head_dim)
+    kr = k.reshape(bh, seq_k, head_dim)
+    vr = v.reshape(bh, seq_k, head_dim)
+    grid = (bh, seq_q // block_q)
+    out = pl.pallas_call(
+        functools.partial(_kernel, block_k=block_k, causal=causal),
+        out_shape=jax.ShapeDtypeStruct(qr.shape, q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, block_q, head_dim), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, seq_k, head_dim), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, seq_k, head_dim), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, head_dim), lambda b, i: (b, i, 0)),
+        interpret=interpret,
+    )(qr, kr, vr)
+    return out.reshape(batch, heads, seq_q, head_dim)
+
+
+def _impl(q, k, v, causal, block_q, block_k, force_pallas, interpret):
+    seq_q, seq_k = q.shape[2], k.shape[2]
+    use_pallas = force_pallas or interpret or jax.default_backend() == "tpu"
+    tiles = seq_q % block_q == 0 and seq_k % block_k == 0
+    if use_pallas and tiles:
+        return _pallas_attention(q, k, v, causal, block_q, block_k, interpret)
+    from dcos_commons_tpu.parallel.ring import reference_attention
+
+    return reference_attention(q, k, v, causal)
+
+
+@functools.lru_cache(maxsize=None)
+def _make_attention(causal, block_q, block_k, force_pallas, interpret):
+    """Per-config differentiable attention: Pallas forward, backward
+    through the reference implementation's VJP (recompute-based — the
+    fused forward stays kernel-fast; the backward trades one dense
+    recompute for not having to persist softmax stats.  A dedicated
+    backward kernel is the obvious next optimization)."""
+    from dcos_commons_tpu.parallel.ring import reference_attention
+
+    @jax.custom_vjp
+    def attn(q, k, v):
+        return _impl(q, k, v, causal, block_q, block_k, force_pallas, interpret)
+
+    def fwd(q, k, v):
+        return attn(q, k, v), (q, k, v)
+
+    def bwd(residuals, g):
+        q, k, v = residuals
+        _, vjp = jax.vjp(
+            lambda q_, k_, v_: reference_attention(q_, k_, v_, causal), q, k, v
+        )
+        return vjp(g)
+
+    attn.defvjp(fwd, bwd)
+    return attn
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+    force_pallas: bool = False,
+    interpret: bool = False,
+) -> jax.Array:
+    """[batch, heads, seq, head_dim] attention, differentiable.
+
+    Dispatch: Pallas kernel on TPU (or when forced / interpreted for
+    tests); jnp reference otherwise.  Falls back when shapes do not
+    tile (ragged seq), keeping the call always-correct.
+    """
+    return _make_attention(causal, block_q, block_k, force_pallas, interpret)(
+        q, k, v
+    )

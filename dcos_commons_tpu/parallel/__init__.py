@@ -1,0 +1,31 @@
+"""ML parallelism for the TPU workload plane.
+
+The reference has NO tensor parallelism anywhere — its "parallel" is
+plan rollout (SURVEY.md section 2 census).  This package is the
+green-field ML-parallelism axis the rebuild adds: device meshes +
+named shardings (dp/fsdp/tp/sp) consumed by pjit, ring-attention
+context parallelism over the sp axis, and the worker-side
+jax.distributed bootstrap consuming the scheduler's env contract
+(COORDINATOR_ADDRESS et al., offer/evaluate.py).
+
+Design per the scaling-book recipe: pick a mesh, annotate shardings,
+let XLA insert the collectives, profile, iterate.  Collectives ride
+ICI because the scheduler's torus placement made mesh neighbors
+ICI-adjacent (offer/torus.py).
+"""
+
+from dcos_commons_tpu.parallel.mesh import (
+    MeshSpec,
+    make_mesh,
+    mesh_from_env,
+)
+from dcos_commons_tpu.parallel.ring import ring_attention
+from dcos_commons_tpu.parallel.distributed import initialize_from_env
+
+__all__ = [
+    "MeshSpec",
+    "initialize_from_env",
+    "make_mesh",
+    "mesh_from_env",
+    "ring_attention",
+]

@@ -1,0 +1,53 @@
+"""Worker-side distributed bootstrap: the rendezvous shim.
+
+The moral equivalent of the reference's sdk/bootstrap DNS-wait
+(sdk/bootstrap/main.go:218-289): instead of each task resolving its
+own DNS record, workers read the scheduler-issued env contract
+(offer/evaluate.py) and call jax.distributed.initialize against the
+coordinator address the scheduler allocated on worker 0's host.  The
+scheduler persisted that address in the FrameworkStore, so restarts
+rendezvous at the same point.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Mapping, Optional
+
+LOG = logging.getLogger(__name__)
+
+
+def initialize_from_env(
+    env: Optional[Mapping[str, str]] = None, timeout_s: int = 300
+) -> dict:
+    """Initialize jax.distributed from the scheduler env contract.
+
+    Returns the parsed contract.  Single-worker pods (no
+    COORDINATOR_ADDRESS) skip initialization — jax runs locally.
+    """
+    env = env if env is not None else os.environ
+    contract = {
+        "coordinator": env.get("COORDINATOR_ADDRESS", ""),
+        "worker_id": int(env.get("TPU_WORKER_ID", "0") or 0),
+        "worker_count": int(env.get("TPU_WORKER_COUNT", "1") or 1),
+        "chips_per_host": int(env.get("TPU_CHIPS_PER_HOST", "0") or 0),
+        "topology": env.get("TPU_TOPOLOGY", ""),
+        "generation": env.get("TPU_GENERATION", ""),
+    }
+    if contract["worker_count"] > 1 and contract["coordinator"]:
+        import jax
+
+        LOG.info(
+            "jax.distributed.initialize(%s, %d/%d)",
+            contract["coordinator"],
+            contract["worker_id"],
+            contract["worker_count"],
+        )
+        jax.distributed.initialize(
+            coordinator_address=contract["coordinator"],
+            num_processes=contract["worker_count"],
+            process_id=contract["worker_id"],
+            initialization_timeout=timeout_s,
+        )
+    return contract

@@ -1,0 +1,89 @@
+"""Device mesh construction + named sharding helpers.
+
+Axes vocabulary (scaling-book conventions):
+    dp    data parallel — batch split, gradient allreduce
+    fsdp  fully-sharded data parallel — params/optimizer sharded,
+          all-gathered per layer
+    tp    tensor parallel — heads/ffn split, activation collectives
+    sp    sequence/context parallel — ring attention over sequence
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Logical mesh shape; axes with size 1 are kept (harmless)."""
+
+    dp: int = 1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+
+    @property
+    def total(self) -> int:
+        return self.dp * self.fsdp * self.tp * self.sp
+
+    def axes(self) -> Dict[str, int]:
+        return {"dp": self.dp, "fsdp": self.fsdp, "tp": self.tp, "sp": self.sp}
+
+
+def make_mesh(spec: MeshSpec, devices: Optional[Sequence] = None) -> Mesh:
+    """Build a Mesh whose device order follows the hardware order.
+
+    jax puts same-host devices adjacent in jax.devices(); keeping the
+    fastest-varying mesh axis (tp) innermost maps tp collectives onto
+    intra-host ICI first — the scaling-book layout rule.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) < spec.total:
+        raise ValueError(
+            f"mesh {spec} needs {spec.total} devices, have {len(devices)}"
+        )
+    devices = devices[: spec.total]
+    arr = np.array(devices).reshape(spec.dp, spec.fsdp, spec.sp, spec.tp)
+    return Mesh(arr, ("dp", "fsdp", "sp", "tp"))
+
+
+def mesh_from_env(env: Dict[str, str], n_devices: Optional[int] = None) -> Mesh:
+    """Derive a mesh from the scheduler's env contract.
+
+    TPU_TOPOLOGY "XxY" at TPU_CHIPS_PER_HOST chips/host: default to
+    dp over hosts x tp within host — the layout the torus placement
+    guarantees is ICI-contiguous.
+    """
+    n = n_devices if n_devices is not None else len(jax.devices())
+    chips_per_host = int(env.get("TPU_CHIPS_PER_HOST", "0") or 0)
+    if chips_per_host and n % chips_per_host == 0 and n > chips_per_host:
+        return make_mesh(
+            MeshSpec(dp=n // chips_per_host, tp=chips_per_host)
+        )
+    return make_mesh(MeshSpec(dp=n))
+
+
+# -- sharding rules ---------------------------------------------------
+
+Rules = Tuple[Tuple[str, PartitionSpec], ...]
+
+
+def named(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+BATCH_AXES = ("dp", "fsdp")  # batch shards over both data axes
+
+
+def batch_spec() -> PartitionSpec:
+    return PartitionSpec(BATCH_AXES, "sp")  # [batch, seq, ...]
+
+
+def replicated() -> PartitionSpec:
+    return PartitionSpec()

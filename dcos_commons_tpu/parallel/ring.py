@@ -1,0 +1,111 @@
+"""Ring attention: context parallelism over the ``sp`` mesh axis.
+
+Long-context first-class requirement (task brief + SURVEY.md section
+5.7 green-field note): each device holds one contiguous sequence chunk
+of Q/K/V; K/V chunks rotate around the ring via ppermute while every
+device accumulates flash-style online-softmax partial results.  With
+the scheduler's torus placement (offer/torus.py) ring neighbors are
+ICI-adjacent, so each hop is one ICI transfer overlapped with the
+block attention compute.
+
+Numerics: accumulation in float32 with a finite mask sentinel; output
+cast back to the input dtype.  Causality is enforced across chunks by
+comparing global positions (chunk_index * chunk_len + offset).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG = -1e30
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = "sp",
+    causal: bool = True,
+    axis_size: Optional[int] = None,
+) -> jax.Array:
+    """Attention over a sequence sharded on ``axis_name``.
+
+    Must run inside shard_map/pjit with ``axis_name`` bound.  Shapes
+    (per device): q/k/v [batch, heads, chunk, head_dim].
+    """
+    if axis_size is None:
+        axis_size = lax.axis_size(axis_name)
+    chunk = q.shape[-2]
+    scale = q.shape[-1] ** -0.5
+    my_idx = lax.axis_index(axis_name)
+
+    q32 = q.astype(jnp.float32) * scale
+    # accumulators start as constants but become device-varying inside
+    # the loop; mark them varying up front for shard_map's vma checker
+    def _vary(x):
+        pcast = getattr(lax, "pcast", None)
+        if pcast is not None:
+            return pcast(x, (axis_name,), to="varying")
+        return lax.pvary(x, (axis_name,))
+
+    o = _vary(jnp.zeros(q.shape[:-1] + (v.shape[-1],), jnp.float32))
+    m = _vary(jnp.full(q.shape[:-1], _NEG, jnp.float32))
+    l = _vary(jnp.zeros(q.shape[:-1], jnp.float32))
+
+    q_pos = my_idx * chunk + lax.broadcasted_iota(jnp.int32, (chunk, chunk), 0)
+    k_off = lax.broadcasted_iota(jnp.int32, (chunk, chunk), 1)
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+
+    def step(i, carry):
+        o, m, l, k_cur, v_cur = carry
+        src = (my_idx - i) % axis_size  # chunk index currently held
+        s = jnp.einsum(
+            "bhqd,bhkd->bhqk", q32, k_cur.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        if causal:
+            valid = q_pos >= (src * chunk + k_off)
+            s = jnp.where(valid, s, _NEG)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        if causal:
+            p = jnp.where(valid, p, 0.0)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + p.sum(axis=-1)
+        o_new = o * alpha[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_cur.astype(jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+        # rotate K/V to the next ring position; the final rotation
+        # restores the original owner (a free no-op in steady state)
+        k_next = lax.ppermute(k_cur, axis_name, perm)
+        v_next = lax.ppermute(v_cur, axis_name, perm)
+        return o_new, m_new, l_new, k_next, v_next
+
+    o, m, l, _, _ = lax.fori_loop(0, axis_size, step, (o, m, l, k, v))
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(q.dtype)
+
+
+def reference_attention(q, k, v, causal: bool = True) -> jax.Array:
+    """Dense single-device attention — the numerics oracle for tests."""
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum(
+        "bhqd,bhkd->bhqk",
+        q.astype(jnp.float32) * scale,
+        k.astype(jnp.float32),
+    )
+    if causal:
+        qn, kn = s.shape[-2], s.shape[-1]
+        mask = lax.broadcasted_iota(jnp.int32, (qn, kn), 0) >= \
+            lax.broadcasted_iota(jnp.int32, (qn, kn), 1)
+        s = jnp.where(mask, s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32)
+    ).astype(q.dtype)

@@ -1,0 +1,14 @@
+"""Workload utilities: data, checkpointing, tree math."""
+
+from dcos_commons_tpu.utils.data import synthetic_tokens, synthetic_mnist
+from dcos_commons_tpu.utils.tree import param_count, param_bytes
+from dcos_commons_tpu.utils.checkpoint import save_checkpoint, restore_checkpoint
+
+__all__ = [
+    "param_bytes",
+    "param_count",
+    "restore_checkpoint",
+    "save_checkpoint",
+    "synthetic_mnist",
+    "synthetic_tokens",
+]

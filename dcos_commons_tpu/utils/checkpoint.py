@@ -1,0 +1,106 @@
+"""Workload checkpointing: npz with dtype-safe, multi-host-safe leaves.
+
+The control plane WALs its own state (SURVEY.md section 5.4); workload
+checkpointing is the service's job, and this is the pattern library:
+PERMANENT gang recovery = re-place the sub-slice, restore the latest
+step here, resume.
+
+Leaves that numpy cannot round-trip (bfloat16 and friends) are stored
+as float32 with the original dtype recorded; global jax.Arrays that
+span non-addressable devices (multi-host pjit) are gathered to the
+host first.  The step stamp is "next step to run", so resume never
+double-applies an update.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+
+def _host_array(leaf: Any) -> np.ndarray:
+    """Fetch a leaf to host memory, gathering multi-host arrays."""
+    try:
+        import jax
+
+        if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+            from jax.experimental import multihost_utils
+
+            leaf = multihost_utils.process_allgather(leaf, tiled=True)
+    except ImportError:  # pragma: no cover - jax always present here
+        pass
+    arr = np.asarray(leaf)
+    return arr
+
+
+def save_checkpoint(directory: str, step: int, tree: Any) -> str:
+    """Atomic save of a pytree; ``step`` = next step to run on resume.
+
+    In a multi-process mesh call this from every process (the gather is
+    collective) but only process 0 writes.
+    """
+    import jax
+
+    leaves, _ = jax.tree.flatten(tree)
+    arrays = {}
+    dtypes = {}
+    for i, leaf in enumerate(leaves):
+        arr = _host_array(leaf)
+        if arr.dtype.kind not in "fiub":
+            # numpy's npz cannot round-trip extension dtypes (ml_dtypes
+            # bfloat16 reads back as void): widen to f32 and remember
+            dtypes[str(i)] = arr.dtype.name
+            arr = arr.astype(np.float32)
+        arrays[f"leaf_{i}"] = arr
+
+    if getattr(jax, "process_index", lambda: 0)() != 0:
+        return ""
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, f"step_{step:010d}.npz")
+    tmp = path + ".tmp"
+    meta = json.dumps({"dtypes": dtypes, "step": step}).encode()
+    with open(tmp, "wb") as f:
+        np.savez(f, __meta__=np.frombuffer(meta, dtype=np.uint8), **arrays)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def latest_step(directory: str) -> Optional[int]:
+    if not os.path.isdir(directory):
+        return None
+    steps = [
+        int(name[len("step_"):-len(".npz")])
+        for name in os.listdir(directory)
+        if name.startswith("step_") and name.endswith(".npz")
+    ]
+    return max(steps) if steps else None
+
+
+def restore_checkpoint(
+    directory: str, like: Any, step: Optional[int] = None
+) -> Tuple[Any, Optional[int]]:
+    """Restore into the structure of ``like``; returns (tree, step) or
+    (like, None) when no checkpoint exists.  Each leaf is cast back to
+    ``like``'s dtype (jnp handles bfloat16 casts numpy cannot)."""
+    import jax
+    import jax.numpy as jnp
+
+    target = step if step is not None else latest_step(directory)
+    if target is None:
+        return like, None
+    path = os.path.join(directory, f"step_{target:010d}.npz")
+    data = np.load(path)
+    leaves, treedef = jax.tree.flatten(like)
+    restored = []
+    for i, leaf in enumerate(leaves):
+        arr = data[f"leaf_{i}"]
+        if hasattr(leaf, "dtype"):
+            restored.append(jnp.asarray(arr).astype(leaf.dtype))
+        else:
+            restored.append(arr)
+    return jax.tree.unflatten(treedef, restored), target

@@ -1,0 +1,28 @@
+"""Synthetic data generators (deterministic, device-friendly).
+
+Real input pipelines are service-specific (the reference's SDK ships
+none either); these feed the demo workloads and benches without
+host-side IO in the measured loop.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def synthetic_tokens(
+    key: jax.Array, batch: int, seq: int, vocab: int
+) -> Tuple[jax.Array, jax.Array]:
+    """(tokens, next-token targets) — a fixed random corpus slice."""
+    tokens = jax.random.randint(key, (batch, seq + 1), 0, vocab, jnp.int32)
+    return tokens[:, :-1], tokens[:, 1:]
+
+
+def synthetic_mnist(key: jax.Array, batch: int) -> Tuple[jax.Array, jax.Array]:
+    kx, ky = jax.random.split(key)
+    x = jax.random.uniform(kx, (batch, 784), jnp.float32)
+    y = jax.random.randint(ky, (batch,), 0, 10, jnp.int32)
+    return x, y

@@ -1,0 +1,55 @@
+"""Single-host MNIST training task (BASELINE.json config 3).
+
+Launched by the scheduler inside a sandbox; trains the MLP on
+synthetic MNIST for TRAIN_STEPS steps on whatever device JAX finds
+(the real TPU chip in the bench, CPU in tests), then exits 0 so the
+FINISH goal completes the deploy step.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.environ.get("REPO_ROOT", "/root/repo"))
+
+
+def main() -> int:
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # this image's sitecustomize re-selects the TPU platform at
+        # import; honor an explicit CPU request (tests / CPU fleets)
+        jax.config.update("jax_platforms", "cpu")
+    import optax
+
+    from dcos_commons_tpu.models import MlpConfig, mlp_init, mlp_train_step
+    from dcos_commons_tpu.utils import synthetic_mnist
+
+    steps = int(os.environ.get("TRAIN_STEPS", "60"))
+    config = MlpConfig()
+    params = mlp_init(config, jax.random.key(0))
+    optimizer = optax.adam(1e-3)
+    opt_state = optimizer.init(params)
+    step_fn = mlp_train_step(optimizer)
+    x, y = synthetic_mnist(jax.random.key(1), 256)
+
+    t0 = time.time()
+    first = last = None
+    for i in range(steps):
+        params, opt_state, loss = step_fn(params, opt_state, x, y)
+        if i == 0:
+            loss.block_until_ready()
+            first = float(loss)
+            print(f"step 0 loss={first:.4f} (compile {time.time()-t0:.1f}s)",
+                  flush=True)
+    last = float(loss)
+    print(
+        f"trained {steps} steps on {jax.devices()[0].platform}: "
+        f"loss {first:.4f} -> {last:.4f}",
+        flush=True,
+    )
+    return 0 if last < first else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,91 @@
+"""Multi-host pjit training worker (BASELINE.json config 4).
+
+One of these runs per host of the gang pod.  It consumes the
+scheduler's env contract (COORDINATOR_ADDRESS, TPU_WORKER_ID, ...),
+rendezvouses via jax.distributed, builds a dp-over-hosts x tp-within-
+host mesh, and trains the flagship transformer with orbax-style
+checkpointing so PERMANENT gang recovery resumes from the last step.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.environ.get("REPO_ROOT", "/root/repo"))
+
+
+def main() -> int:
+    from dcos_commons_tpu.parallel.distributed import initialize_from_env
+
+    contract = initialize_from_env()
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import optax
+
+    from dcos_commons_tpu.models import TransformerConfig, init_params, make_train_step
+    from dcos_commons_tpu.parallel.mesh import mesh_from_env
+    from dcos_commons_tpu.utils import (
+        restore_checkpoint,
+        save_checkpoint,
+        synthetic_tokens,
+    )
+
+    steps = int(os.environ.get("TRAIN_STEPS", "100"))
+    ckpt_dir = os.environ.get("CHECKPOINT_DIR", "checkpoints")
+    mesh = mesh_from_env(os.environ)
+    config = TransformerConfig(
+        vocab=int(os.environ.get("VOCAB", "8192")),
+        d_model=int(os.environ.get("D_MODEL", "512")),
+        n_layers=int(os.environ.get("N_LAYERS", "4")),
+        n_heads=8,
+        n_kv_heads=8,
+        d_ff=1408,
+        max_seq=int(os.environ.get("SEQ_LEN", "1024")),
+        dtype=jnp.bfloat16,
+    )
+    optimizer = optax.adamw(3e-4)
+    with mesh:
+        params = init_params(config, jax.random.key(0))
+        opt_state = optimizer.init(params)
+        # checkpoint carries params AND optimizer moments; its stamp is
+        # the next step to run, so resume never double-applies a step
+        state = {"params": params, "opt_state": opt_state}
+        state, start = restore_checkpoint(ckpt_dir, state)
+        params, opt_state = state["params"], state["opt_state"]
+        start = start or 0
+        step_fn = make_train_step(config, optimizer, mesh=mesh, donate=False)
+        batch = max(2, 2 * mesh.devices.size)
+        tokens, targets = synthetic_tokens(
+            jax.random.key(1), batch, config.max_seq, config.vocab
+        )
+        t0 = time.time()
+        for i in range(start, steps):
+            params, opt_state, loss = step_fn(params, opt_state, tokens, targets)
+            if i % 20 == 0 or i == steps - 1:
+                print(f"step {i} loss={float(loss):.4f}", flush=True)
+                save_checkpoint(
+                    ckpt_dir, i + 1,
+                    {"params": params, "opt_state": opt_state},
+                )
+        dt = time.time() - t0
+        tps = batch * config.max_seq * (steps - start) / max(dt, 1e-9)
+        print(
+            f"worker {contract['worker_id']}/{contract['worker_count']}: "
+            f"{steps - start} steps, {tps:,.0f} tokens/s", flush=True,
+        )
+    # goal RUNNING: stay alive serving the mesh until the scheduler
+    # kills or reconfigures the pod
+    keepalive = os.environ.get("KEEPALIVE_S")
+    if keepalive:
+        time.sleep(float(keepalive))
+    else:
+        while True:
+            time.sleep(60)
+
+
+if __name__ == "__main__":
+    sys.exit(main() or 0)

@@ -9,9 +9,17 @@ against MemPersister + a mocked driver).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# force CPU even when a real TPU is attached: tests exercise sharding
+# on the virtual mesh; bench.py is what runs on the chip.  The env var
+# alone is not enough — this image's sitecustomize re-selects the TPU
+# platform at import, so flip the jax config after import too.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

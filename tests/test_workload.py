@@ -1,0 +1,261 @@
+"""Workload library tests on the virtual 8-device CPU mesh.
+
+Kernel correctness against jnp oracles (pallas interpret mode), ring
+attention against dense attention, and the full sharded train step
+compiling + running over a dp/fsdp/tp/sp mesh — the multi-chip path
+the driver's dryrun exercises.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from dcos_commons_tpu.models import (
+    MlpConfig,
+    TransformerConfig,
+    init_params,
+    loss_fn,
+    make_train_step,
+    forward,
+    mlp_init,
+    mlp_train_step,
+)
+from dcos_commons_tpu.ops.attention import flash_attention
+from dcos_commons_tpu.ops.rmsnorm import rms_norm
+from dcos_commons_tpu.parallel.mesh import MeshSpec, make_mesh
+from dcos_commons_tpu.parallel.ring import reference_attention, ring_attention
+from dcos_commons_tpu.utils import (
+    param_count,
+    restore_checkpoint,
+    save_checkpoint,
+    synthetic_mnist,
+    synthetic_tokens,
+)
+
+
+def test_eight_devices_available():
+    assert len(jax.devices()) == 8, "conftest must force 8 CPU devices"
+
+
+# -- kernels ----------------------------------------------------------
+
+
+def test_flash_attention_matches_reference():
+    key = jax.random.key(0)
+    q, k, v = (
+        jax.random.normal(k_, (2, 4, 256, 64), jnp.float32)
+        for k_ in jax.random.split(key, 3)
+    )
+    oracle = reference_attention(q, k, v, causal=True)
+    kernel = flash_attention(q, k, v, causal=True, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(kernel), np.asarray(oracle), atol=2e-5, rtol=2e-5
+    )
+    # non-causal too
+    oracle_nc = reference_attention(q, k, v, causal=False)
+    kernel_nc = flash_attention(q, k, v, causal=False, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(kernel_nc), np.asarray(oracle_nc), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_flash_attention_ragged_falls_back():
+    key = jax.random.key(1)
+    q, k, v = (
+        jax.random.normal(k_, (1, 2, 100, 32), jnp.float32)
+        for k_ in jax.random.split(key, 3)
+    )
+    out = flash_attention(q, k, v, causal=True)  # 100 % 128 != 0
+    oracle = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(oracle),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_rms_norm_matches_reference():
+    x = jax.random.normal(jax.random.key(2), (512, 128), jnp.float32)
+    w = jax.random.normal(jax.random.key(3), (128,), jnp.float32)
+    kernel = rms_norm(x, w, interpret=True, block_rows=256)
+    x32 = x.astype(jnp.float32)
+    oracle = x32 * jax.lax.rsqrt(
+        jnp.mean(x32 * x32, -1, keepdims=True) + 1e-6
+    ) * w
+    np.testing.assert_allclose(np.asarray(kernel), np.asarray(oracle),
+                               atol=1e-5, rtol=1e-5)
+
+
+# -- ring attention ---------------------------------------------------
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_attention_matches_dense(causal):
+    mesh = make_mesh(MeshSpec(sp=8))
+    key = jax.random.key(4)
+    # global sequence 256 = 8 chunks of 32
+    q, k, v = (
+        jax.random.normal(k_, (2, 4, 256, 32), jnp.float32)
+        for k_ in jax.random.split(key, 3)
+    )
+    oracle = reference_attention(q, k, v, causal=causal)
+
+    ring = shard_map(
+        functools.partial(ring_attention, axis_name="sp", causal=causal,
+                          axis_size=8),
+        mesh=mesh,
+        in_specs=(P(None, None, "sp", None),) * 3,
+        out_specs=P(None, None, "sp", None),
+    )
+    out = ring(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(oracle),
+                               atol=2e-5, rtol=2e-5)
+
+
+# -- transformer ------------------------------------------------------
+
+
+SMALL = TransformerConfig(
+    vocab=128, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+    d_ff=128, max_seq=64, dtype=jnp.float32, remat=False,
+)
+
+
+def test_transformer_forward_shapes():
+    params = init_params(SMALL, jax.random.key(0))
+    tokens, targets = synthetic_tokens(jax.random.key(1), 2, 32, SMALL.vocab)
+    logits = forward(SMALL, params, tokens)
+    assert logits.shape == (2, 32, SMALL.vocab)
+    assert logits.dtype == jnp.float32
+    assert param_count(params) > 0
+
+
+def test_transformer_causality():
+    """Changing a future token must not change past logits."""
+    params = init_params(SMALL, jax.random.key(0))
+    tokens, _ = synthetic_tokens(jax.random.key(1), 1, 32, SMALL.vocab)
+    logits1 = forward(SMALL, params, tokens)
+    perturbed = tokens.at[0, -1].set((tokens[0, -1] + 1) % SMALL.vocab)
+    logits2 = forward(SMALL, params, perturbed)
+    np.testing.assert_allclose(
+        np.asarray(logits1[0, :-1]), np.asarray(logits2[0, :-1]),
+        atol=1e-5, rtol=1e-5,
+    )
+    assert not np.allclose(np.asarray(logits1[0, -1]), np.asarray(logits2[0, -1]))
+
+
+def test_transformer_training_reduces_loss():
+    params = init_params(SMALL, jax.random.key(0))
+    optimizer = optax.adam(1e-2)
+    opt_state = optimizer.init(params)
+    step = make_train_step(SMALL, optimizer)
+    tokens, targets = synthetic_tokens(jax.random.key(1), 4, 32, SMALL.vocab)
+    losses = []
+    for _ in range(10):
+        params, opt_state, loss = step(params, opt_state, tokens, targets)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.8, losses
+
+
+def test_transformer_sharded_train_step():
+    """The multi-chip path: dp=2 x fsdp=2 x tp=2 mesh, full train step."""
+    mesh = make_mesh(MeshSpec(dp=2, fsdp=2, tp=2))
+    config = TransformerConfig(
+        vocab=128, d_model=64, n_layers=2, n_heads=4, n_kv_heads=4,
+        d_ff=128, max_seq=32, dtype=jnp.float32, remat=True,
+    )
+    optimizer = optax.adam(1e-3)
+    with mesh:
+        params = init_params(config, jax.random.key(0))
+        opt_state = optimizer.init(params)
+        step = make_train_step(config, optimizer, mesh=mesh, donate=False)
+        tokens, targets = synthetic_tokens(jax.random.key(1), 8, 32, config.vocab)
+        params2, opt_state2, loss = step(params, opt_state, tokens, targets)
+        assert jnp.isfinite(loss)
+        # sharded result must equal the single-device result
+        step_local = make_train_step(config, optimizer, donate=False)
+        _, _, loss_local = step_local(params, opt_state, tokens, targets)
+        np.testing.assert_allclose(float(loss), float(loss_local),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_transformer_ring_attention_end_to_end():
+    """sp=4: forward with ring attention == unsharded forward."""
+    mesh = make_mesh(MeshSpec(sp=4, tp=2))
+    config = TransformerConfig(
+        vocab=128, d_model=64, n_layers=2, n_heads=4, n_kv_heads=4,
+        d_ff=128, max_seq=64, dtype=jnp.float32, remat=False,
+    )
+    ring_config = TransformerConfig(
+        **{**config.__dict__, "use_ring_attention": True}
+    )
+    params = init_params(config, jax.random.key(0))
+    tokens, targets = synthetic_tokens(jax.random.key(1), 2, 64, config.vocab)
+    oracle = loss_fn(config, params, tokens, targets)
+
+    def body(params, tokens, targets):
+        # per-chunk mean -> global mean (equal-sized chunks)
+        local = loss_fn(ring_config, params, tokens, targets)
+        return jax.lax.pmean(local, "sp")
+
+    with mesh:
+        ring_loss = shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(), P(None, "sp"), P(None, "sp")),
+            out_specs=P(),
+            check_vma=False,
+        )
+        loss = jax.jit(ring_loss)(params, tokens, targets)
+    np.testing.assert_allclose(float(loss), float(oracle), atol=1e-4, rtol=1e-4)
+
+
+# -- mlp + checkpointing ---------------------------------------------
+
+
+def test_mlp_trains():
+    config = MlpConfig(dtype=jnp.float32)
+    params = mlp_init(config, jax.random.key(0))
+    optimizer = optax.adam(1e-3)
+    opt_state = optimizer.init(params)
+    step = mlp_train_step(optimizer)
+    x, y = synthetic_mnist(jax.random.key(1), 64)
+    losses = []
+    for _ in range(20):
+        params, opt_state, loss = step(params, opt_state, x, y)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    config = MlpConfig(dtype=jnp.float32)
+    params = mlp_init(config, jax.random.key(0))
+    save_checkpoint(str(tmp_path), 7, params)
+    like = mlp_init(config, jax.random.key(1))
+    restored, step = restore_checkpoint(str(tmp_path), like)
+    assert step == 7
+    np.testing.assert_array_equal(
+        np.asarray(restored["w1"]), np.asarray(params["w1"])
+    )
+    # empty dir: returns like, None
+    _, none_step = restore_checkpoint(str(tmp_path / "empty"), like)
+    assert none_step is None
+
+
+def test_checkpoint_bf16_roundtrip(tmp_path):
+    """bf16 leaves must survive the npz round-trip (review regression)."""
+    tree = {"w": jnp.ones((4, 4), jnp.bfloat16) * 1.5,
+            "count": jnp.zeros((), jnp.int32)}
+    save_checkpoint(str(tmp_path), 3, tree)
+    like = {"w": jnp.zeros((4, 4), jnp.bfloat16),
+            "count": jnp.zeros((), jnp.int32)}
+    restored, step = restore_checkpoint(str(tmp_path), like)
+    assert step == 3
+    assert restored["w"].dtype == jnp.bfloat16
+    np.testing.assert_array_equal(
+        np.asarray(restored["w"].astype(jnp.float32)),
+        np.full((4, 4), 1.5, np.float32),
+    )
