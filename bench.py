@@ -132,9 +132,15 @@ def bench_transformer() -> dict:
     for _ in range(steps):
         params, opt_state, loss = step_fn(params, opt_state, tokens, targets)
     # block on the WHOLE output tree: on asynchronous backends waiting
-    # only on the scalar loss under-counts the step time
+    # only on the scalar loss under-counts the step time.  On the axon
+    # relay platform block_until_ready alone returns early, so ALSO
+    # force a device->host transfer of a value that depends on the
+    # final params (the next step's loss) before stopping the clock.
     jax.block_until_ready((params, opt_state, loss))
+    _, _, sync_loss = step_fn(params, opt_state, tokens, targets)
+    float(jax.device_get(sync_loss))
     dt = time.monotonic() - t0
+    steps += 1  # the sync step is a real timed step too
     tokens_per_s = batch * config.max_seq * steps / dt
     n_params = param_count(params)
     flops_per_token = 6 * n_params  # fwd+bwd dense estimate

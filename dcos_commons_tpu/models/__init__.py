@@ -6,7 +6,9 @@ the TPU rebuild ships so a user can stand up real training pods:
 
 - transformer.py  decoder-only LM, pure-JAX pytrees, scan-over-layers,
                   bf16 compute, RoPE + GQA + SwiGLU, pallas kernels,
-                  dp/fsdp/tp/sp shardings for pjit
+                  dp/fsdp/tp/sp shardings for pjit + pp pipeline trunk
+- moe.py          mixture-of-experts FFN, einsum dispatch, ep-parallel
+                  all_to_all expert exchange
 - mlp.py          MNIST-scale MLP (the BASELINE.json config-3 demo)
 """
 
@@ -16,17 +18,33 @@ from dcos_commons_tpu.models.transformer import (
     loss_fn,
     make_train_step,
     forward,
+    pipeline_forward,
+    pipeline_loss_fn,
+    pipeline_param_specs,
+)
+from dcos_commons_tpu.models.moe import (
+    MoEConfig,
+    expert_shard_spec,
+    init_moe_params,
+    moe_ffn,
 )
 from dcos_commons_tpu.models.mlp import MlpConfig, mlp_forward, mlp_init, mlp_train_step
 
 __all__ = [
     "MlpConfig",
+    "MoEConfig",
     "TransformerConfig",
+    "expert_shard_spec",
     "forward",
+    "init_moe_params",
     "init_params",
     "loss_fn",
     "make_train_step",
     "mlp_forward",
     "mlp_init",
     "mlp_train_step",
+    "moe_ffn",
+    "pipeline_forward",
+    "pipeline_loss_fn",
+    "pipeline_param_specs",
 ]

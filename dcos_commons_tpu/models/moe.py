@@ -1,0 +1,170 @@
+"""Mixture-of-Experts FFN with expert parallelism over the ``ep`` axis.
+
+TPU-first design, not a port: routing and dispatch are expressed as
+one-hot einsums (dense matmuls the MXU eats) with a STATIC per-expert
+capacity — no gather/scatter, no dynamic shapes, nothing XLA can't
+tile.  Expert parallelism is two ``lax.all_to_all``s around the expert
+FFN: dispatch local tokens to the ranks owning their experts, compute,
+and send results back — the standard TPU MoE recipe (tokens ride ICI
+both ways while the expert matmuls run).
+
+Shapes (per device, inside shard_map over ``ep``):
+    x            [tokens, d_model]      tokens sharded over ep
+    dispatch     [tokens, E, C]         one-hot token->slot
+    expert_in    [E, C, d]  --all_to_all-->  [E/ep, ep*C, d]
+    expert_out   [E/ep, ep*C, d] --all_to_all--> [E, C, d]
+
+Top-k routing with probability renormalisation over the chosen k, and
+the switch-transformer load-balancing auxiliary loss.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@dataclass(frozen=True)
+class MoEConfig:
+    d_model: int = 512
+    d_ff: int = 1024            # per-expert SwiGLU hidden
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.5
+    dtype: Any = jnp.bfloat16
+
+    def capacity(self, n_tokens: int) -> int:
+        """Static per-expert slot count for an n_tokens batch."""
+        cap = int(self.capacity_factor * self.top_k * n_tokens / self.n_experts)
+        return max(cap, 1)
+
+
+MoEParams = Dict[str, jax.Array]
+
+
+def init_moe_params(config: MoEConfig, key: jax.Array) -> MoEParams:
+    keys = jax.random.split(key, 4)
+    d, f, e = config.d_model, config.d_ff, config.n_experts
+    dt = config.dtype
+
+    def normal(key, shape, scale):
+        return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dt)
+
+    return {
+        # router stays f32: routing decisions are precision-sensitive
+        "router": jax.random.normal(keys[0], (d, e), jnp.float32) * d ** -0.5,
+        "w_gate": normal(keys[1], (e, d, f), d ** -0.5),
+        "w_up": normal(keys[2], (e, d, f), d ** -0.5),
+        "w_down": normal(keys[3], (e, f, d), f ** -0.5),
+    }
+
+
+def _routing(
+    config: MoEConfig, params: MoEParams, x: jax.Array, capacity: int
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Token->expert-slot assignment as dense one-hot tensors.
+
+    Returns (dispatch [t,E,C], combine [t,E,C], aux_loss scalar).
+    """
+    t = x.shape[0]
+    e, k = config.n_experts, config.top_k
+    logits = x.astype(jnp.float32) @ params["router"]
+    probs = jax.nn.softmax(logits, axis=-1)                    # [t, E]
+    gate_vals, expert_idx = lax.top_k(probs, k)                # [t, k]
+    gate_vals = gate_vals / jnp.maximum(
+        gate_vals.sum(-1, keepdims=True), 1e-9
+    )
+    # switch load-balance loss: fraction-of-tokens * mean-prob per expert
+    top1_hot = jax.nn.one_hot(expert_idx[:, 0], e, dtype=jnp.float32)
+    aux = e * jnp.mean(top1_hot.mean(0) * probs.mean(0))
+
+    # slot assignment: k choices claim capacity in priority order, so
+    # a token's 2nd choice never evicts another token's 1st choice
+    dispatch = jnp.zeros((t, e, capacity), jnp.float32)
+    combine = jnp.zeros((t, e, capacity), jnp.float32)
+    used = jnp.zeros((e,), jnp.float32)                        # slots taken
+    for slot_k in range(k):
+        hot = jax.nn.one_hot(expert_idx[:, slot_k], e, dtype=jnp.float32)  # [t,E]
+        pos = jnp.cumsum(hot, axis=0) - 1.0 + used[None, :]    # [t,E]
+        keep = hot * (pos < capacity)
+        slot_hot = keep[:, :, None] * jax.nn.one_hot(
+            jnp.clip(pos, 0, capacity - 1).astype(jnp.int32),
+            capacity, dtype=jnp.float32,
+        )                                                       # [t,E,C]
+        dispatch = dispatch + slot_hot
+        combine = combine + slot_hot * gate_vals[:, slot_k][:, None, None]
+        used = used + keep.sum(axis=0)
+    return dispatch, combine, aux
+
+
+def _expert_ffn(config: MoEConfig, params: MoEParams, h: jax.Array) -> jax.Array:
+    """h [E_local, slots, d] -> [E_local, slots, d]: batched SwiGLU."""
+    h = h.astype(config.dtype)
+    gate = jax.nn.silu(jnp.einsum("ecd,edf->ecf", h, params["w_gate"]))
+    up = jnp.einsum("ecd,edf->ecf", h, params["w_up"])
+    return jnp.einsum("ecf,efd->ecd", gate * up, params["w_down"])
+
+
+def moe_ffn(
+    config: MoEConfig,
+    params: MoEParams,
+    x: jax.Array,
+    axis_name: Optional[str] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """MoE FFN on x [tokens, d_model] -> (y, aux_loss).
+
+    Without ``axis_name``: all experts local (single device).  With
+    ``axis_name`` (inside shard_map): tokens are sharded over ep and
+    each rank owns n_experts / ep_size experts — params' expert axis
+    must be sharded over ep accordingly.
+    """
+    t, d = x.shape
+    capacity = config.capacity(t)
+    if axis_name is None:
+        dispatch, combine, aux = _routing(config, params, x, capacity)
+        expert_in = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
+        expert_out = _expert_ffn(config, params, expert_in)
+        y = jnp.einsum(
+            "tec,ecd->td", combine, expert_out.astype(jnp.float32)
+        )
+        return y.astype(x.dtype), aux
+
+    ep = lax.axis_size(axis_name)
+    e_local = config.n_experts // ep
+    if e_local * ep != config.n_experts:
+        raise ValueError(
+            f"n_experts {config.n_experts} not divisible by ep={ep}"
+        )
+    # every rank routes its LOCAL tokens against the global router
+    # (router weights replicated), then ships slots to expert owners
+    dispatch, combine, aux = _routing(config, params, x, capacity)
+    aux = lax.pmean(aux, axis_name)
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
+    # [E, C, d] -> [E/ep, ep*C, d]: each rank receives every other
+    # rank's slots for the experts it owns
+    expert_in = lax.all_to_all(
+        expert_in, axis_name, split_axis=0, concat_axis=1, tiled=True
+    )
+    expert_out = _expert_ffn(config, params, expert_in)
+    # reverse trip: [E/ep, ep*C, d] -> [E, C, d] back at the senders
+    expert_out = lax.all_to_all(
+        expert_out, axis_name, split_axis=1, concat_axis=0, tiled=True
+    )
+    y = jnp.einsum("tec,ecd->td", combine, expert_out.astype(jnp.float32))
+    return y.astype(x.dtype), aux
+
+
+def expert_shard_spec():
+    """PartitionSpec rules for the param tree under ep sharding."""
+    from jax.sharding import PartitionSpec as P
+
+    return {
+        "router": P(None, None),
+        "w_gate": P("ep", None, None),
+        "w_up": P("ep", None, None),
+        "w_down": P("ep", None, None),
+    }

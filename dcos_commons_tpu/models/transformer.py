@@ -169,6 +169,34 @@ def _mlp_block(layer, x):
     return x + (gate * up) @ layer["w_down"]
 
 
+def _layer_scan(config: TransformerConfig, layers, x, positions):
+    """Run x through a (sub)stack of layers with lax.scan."""
+
+    def layer_fn(x, layer):
+        x = _attention_block(config, layer, x, positions)
+        x = _mlp_block(layer, x)
+        return x, None
+
+    if config.remat:
+        layer_fn = jax.checkpoint(layer_fn)
+    x, _ = lax.scan(layer_fn, x, layers)
+    return x
+
+
+def _logits(config: TransformerConfig, params: Params, x: jax.Array) -> jax.Array:
+    x = rms_norm(x, params["final_norm"])
+    # tied embeddings; f32 logits for a stable softmax
+    return jnp.einsum(
+        "bsd,vd->bsv", x.astype(jnp.float32),
+        params["embed"].astype(jnp.float32),
+    )
+
+
+def _nll(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+
+
 def forward(
     config: TransformerConfig,
     params: Params,
@@ -184,31 +212,118 @@ def forward(
             idx = lax.axis_index(config.sp_axis)
             positions = positions + idx * s
     x = params["embed"][tokens].astype(config.dtype)
-
-    def layer_fn(x, layer):
-        x = _attention_block(config, layer, x, positions)
-        x = _mlp_block(layer, x)
-        return x, None
-
-    if config.remat:
-        layer_fn = jax.checkpoint(layer_fn)
-    x, _ = lax.scan(layer_fn, x, params["layers"])
-    x = rms_norm(x, params["final_norm"])
-    # tied embeddings; f32 logits for a stable softmax
-    return jnp.einsum(
-        "bsd,vd->bsv", x.astype(jnp.float32),
-        params["embed"].astype(jnp.float32),
-    )
+    x = _layer_scan(config, params["layers"], x, positions)
+    return _logits(config, params, x)
 
 
 def loss_fn(
     config: TransformerConfig, params: Params, tokens: jax.Array,
     targets: jax.Array,
 ) -> jax.Array:
-    logits = forward(config, params, tokens)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return nll.mean()
+    return _nll(forward(config, params, tokens), targets).mean()
+
+
+def _pipeline_trunk(
+    config: TransformerConfig,
+    params: Params,
+    tokens: jax.Array,
+    n_micro: int,
+    axis_name: str,
+) -> jax.Array:
+    """Embed + pipelined layer stack.  Returns microbatched
+    activations [n_micro, mb, s, d] — valid on the LAST pp rank only.
+    """
+    from dcos_commons_tpu.parallel.pipeline import (
+        pipeline_apply,
+        split_microbatches,
+    )
+
+    b, s = tokens.shape
+    if b % n_micro:
+        raise ValueError(f"batch {b} not divisible by n_micro={n_micro}")
+    mb = b // n_micro
+    positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (mb, s))
+    x = params["embed"][tokens].astype(config.dtype)
+    micro = split_microbatches(x, n_micro)
+    stage_fn = lambda layers, x: _layer_scan(config, layers, x, positions)
+    return pipeline_apply(stage_fn, params["layers"], micro, axis_name)
+
+
+def pipeline_forward(
+    config: TransformerConfig,
+    params: Params,
+    tokens: jax.Array,
+    n_micro: int,
+    axis_name: str = "pp",
+) -> jax.Array:
+    """Forward with the layer trunk pipelined over the ``pp`` axis.
+
+    Call inside shard_map with ``axis_name`` bound.  ``params`` holds
+    this rank's stage: every ``layers`` leaf carries only the local
+    n_layers/pp slice of the stack (shard the leading axis over pp);
+    embed/final_norm are replicated and computed identically on every
+    rank.  Batch is split into ``n_micro`` GPipe microbatches.
+    Returns replicated logits (an activation-sized psum — prefer
+    :func:`pipeline_loss_fn` for training, which only psums a scalar).
+    """
+    from dcos_commons_tpu.parallel.pipeline import (
+        last_stage_value,
+        merge_microbatches,
+    )
+
+    out = _pipeline_trunk(config, params, tokens, n_micro, axis_name)
+    out = last_stage_value(out, axis_name)
+    return _logits(config, params, merge_microbatches(out))
+
+
+def pipeline_loss_fn(
+    config: TransformerConfig,
+    params: Params,
+    tokens: jax.Array,
+    targets: jax.Array,
+    n_micro: int,
+    axis_name: str = "pp",
+) -> jax.Array:
+    """Mean NLL, replicated over pp ranks.
+
+    The vocab logits matmul + softmax run ONLY on the last pp rank
+    (a runtime branch on the rank index); the cross-rank collective is
+    a single scalar psum, not an activation broadcast.
+    """
+    from dcos_commons_tpu.parallel.pipeline import merge_microbatches
+
+    out = _pipeline_trunk(config, params, tokens, n_micro, axis_name)
+    x = merge_microbatches(out)
+    idx = lax.axis_index(axis_name)
+    n = lax.axis_size(axis_name)
+
+    def last_rank_loss(operands):
+        params, x, targets = operands
+        return _nll(_logits(config, params, x), targets).mean()
+
+    loss_local = lax.cond(
+        idx == n - 1,
+        last_rank_loss,
+        lambda operands: jnp.zeros((), jnp.float32),
+        (params, x, targets),
+    )
+    return lax.psum(loss_local, axis_name)
+
+
+def pipeline_param_specs(params_or_shapes) -> Dict[str, Any]:
+    """PartitionSpec tree for pp sharding: layer stacks split on the
+    leading axis, everything else replicated (shard_map in_specs)."""
+    from jax.sharding import PartitionSpec as P
+
+    def walk(tree, under_layers=False):
+        if isinstance(tree, dict):
+            return {
+                name: walk(sub, under_layers or name == "layers")
+                for name, sub in tree.items()
+            }
+        return P("pp") if under_layers else P()
+
+    return walk(params_or_shapes)
 
 
 def make_train_step(

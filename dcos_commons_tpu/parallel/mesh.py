@@ -4,6 +4,8 @@ Axes vocabulary (scaling-book conventions):
     dp    data parallel — batch split, gradient allreduce
     fsdp  fully-sharded data parallel — params/optimizer sharded,
           all-gathered per layer
+    ep    expert parallel — MoE experts split, all_to_all dispatch
+    pp    pipeline parallel — layer stages split, ppermute activations
     tp    tensor parallel — heads/ffn split, activation collectives
     sp    sequence/context parallel — ring attention over sequence
 """
@@ -27,13 +29,22 @@ class MeshSpec:
     fsdp: int = 1
     tp: int = 1
     sp: int = 1
+    pp: int = 1
+    ep: int = 1
 
     @property
     def total(self) -> int:
-        return self.dp * self.fsdp * self.tp * self.sp
+        return self.dp * self.fsdp * self.tp * self.sp * self.pp * self.ep
 
     def axes(self) -> Dict[str, int]:
-        return {"dp": self.dp, "fsdp": self.fsdp, "tp": self.tp, "sp": self.sp}
+        return {
+            "dp": self.dp,
+            "fsdp": self.fsdp,
+            "ep": self.ep,
+            "pp": self.pp,
+            "sp": self.sp,
+            "tp": self.tp,
+        }
 
 
 def make_mesh(spec: MeshSpec, devices: Optional[Sequence] = None) -> Mesh:
@@ -49,8 +60,12 @@ def make_mesh(spec: MeshSpec, devices: Optional[Sequence] = None) -> Mesh:
             f"mesh {spec} needs {spec.total} devices, have {len(devices)}"
         )
     devices = devices[: spec.total]
-    arr = np.array(devices).reshape(spec.dp, spec.fsdp, spec.sp, spec.tp)
-    return Mesh(arr, ("dp", "fsdp", "sp", "tp"))
+    # tp innermost (intra-host ICI), then sp ring, then pp neighbors,
+    # then ep all_to_alls; dp/fsdp outermost where DCN is tolerable
+    arr = np.array(devices).reshape(
+        spec.dp, spec.fsdp, spec.ep, spec.pp, spec.sp, spec.tp
+    )
+    return Mesh(arr, ("dp", "fsdp", "ep", "pp", "sp", "tp"))
 
 
 def mesh_from_env(env: Dict[str, str], n_devices: Optional[int] = None) -> Mesh:

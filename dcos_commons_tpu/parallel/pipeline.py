@@ -1,0 +1,107 @@
+"""Pipeline parallelism: layer stages over the ``pp`` mesh axis.
+
+GPipe-style microbatch schedule expressed the TPU way: every device
+holds one contiguous stage of layers; activations move to the next
+stage with a single ``lax.ppermute`` per tick, so each hop is one ICI
+transfer and the whole schedule is a statically-bounded ``fori_loop``
+that XLA can pipeline (no data-dependent control flow).
+
+The schedule runs M microbatches through S stages in M + S - 1 ticks.
+Each device computes its stage every tick; warm-up/drain bubbles are
+the standard GPipe bubble (S-1)/(M+S-1).  Differentiable end to end —
+reverse-mode AD through ppermute gives the reverse-direction gradient
+permutes automatically, which is exactly the backward pipeline.
+
+Runs inside shard_map with the ``pp`` axis bound.  Stage params are
+whatever pytree the caller's ``stage_fn`` consumes — shard their
+leading (layer) axis over ``pp`` so each device holds only its own
+layers (see ``stage_params_spec``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    microbatches: jax.Array,
+    axis_name: str = "pp",
+) -> jax.Array:
+    """Run microbatches through the pipeline.
+
+    Args:
+        stage_fn: (stage_params, x) -> y, this device's stage (its
+            slice of the layer stack).
+        stage_params: this device's shard of the params.
+        microbatches: [M, microbatch, ...] — the full input, identical
+            on every pp rank (replicated); only rank 0 actually feeds
+            it into the pipe.
+        axis_name: the pipeline mesh axis.
+
+    Returns:
+        [M, microbatch, ...] outputs — valid on the LAST pp rank
+        (other ranks hold zeros).  Use :func:`last_stage_value` to
+        broadcast to all ranks when the loss is computed replicated.
+    """
+    n_stages = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    n_micro = microbatches.shape[0]
+    ticks = n_micro + n_stages - 1
+    # send stage s -> s+1; the wrap edge (last -> 0) carries garbage
+    # that rank 0 always overwrites with a fresh microbatch
+    perm = [(s, (s + 1) % n_stages) for s in range(n_stages)]
+
+    def vary(x):
+        pcast = getattr(lax, "pcast", None)
+        if pcast is not None:
+            return pcast(x, (axis_name,), to="varying")
+        return lax.pvary(x, (axis_name,))
+
+    state = vary(jnp.zeros_like(microbatches[0]))
+    out = vary(jnp.zeros_like(microbatches))
+
+    def tick(t, carry):
+        state, out = carry
+        feed = microbatches[jnp.minimum(t, n_micro - 1)]
+        x = jnp.where(idx == 0, feed, state)
+        y = stage_fn(stage_params, x)
+        done_idx = t - (n_stages - 1)  # microbatch finishing this tick
+        is_last = idx == n_stages - 1
+        write = jnp.logical_and(is_last, done_idx >= 0)
+        slot = jnp.maximum(done_idx, 0)
+        out = jnp.where(
+            write, out.at[slot].set(y), out
+        )
+        state = lax.ppermute(y, axis_name, perm)
+        return state, out
+
+    _, out = lax.fori_loop(0, ticks, tick, (state, out), unroll=False)
+    return out
+
+
+def last_stage_value(x: jax.Array, axis_name: str = "pp") -> jax.Array:
+    """Broadcast the last pp rank's value to every rank (psum of a
+    one-hot mask — one collective, keeps the loss replicated)."""
+    idx = lax.axis_index(axis_name)
+    n = lax.axis_size(axis_name)
+    mask = (idx == n - 1).astype(x.dtype)
+    return lax.psum(x * mask, axis_name)
+
+
+def split_microbatches(batch: jax.Array, n_micro: int) -> jax.Array:
+    """[B, ...] -> [M, B/M, ...]; B must divide evenly (static shapes)."""
+    b = batch.shape[0]
+    if b % n_micro:
+        raise ValueError(f"batch {b} not divisible into {n_micro} microbatches")
+    return batch.reshape((n_micro, b // n_micro) + batch.shape[1:])
+
+
+def merge_microbatches(micro: jax.Array) -> jax.Array:
+    """Inverse of :func:`split_microbatches`."""
+    return micro.reshape((micro.shape[0] * micro.shape[1],) + micro.shape[2:])

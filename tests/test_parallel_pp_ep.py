@@ -1,0 +1,203 @@
+"""Pipeline (pp) and expert (ep) parallelism on the virtual CPU mesh.
+
+Both are compared against their single-device oracles: pipelining and
+expert dispatch are pure re-schedulings of the same math, so the
+outputs must agree to float tolerance.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from dcos_commons_tpu.models import (
+    MoEConfig,
+    TransformerConfig,
+    init_moe_params,
+    init_params,
+    loss_fn,
+    moe_ffn,
+    pipeline_loss_fn,
+    pipeline_param_specs,
+)
+from dcos_commons_tpu.parallel.mesh import MeshSpec, make_mesh
+from dcos_commons_tpu.parallel.pipeline import (
+    merge_microbatches,
+    pipeline_apply,
+    split_microbatches,
+)
+
+CONFIG = TransformerConfig(
+    vocab=128, d_model=64, n_layers=4, n_heads=4, n_kv_heads=4,
+    d_ff=128, max_seq=32, dtype=jnp.float32, remat=False,
+)
+
+
+# -- pipeline ---------------------------------------------------------
+
+
+def test_split_merge_microbatches_roundtrip():
+    x = jnp.arange(24.0).reshape(8, 3)
+    micro = split_microbatches(x, 4)
+    assert micro.shape == (4, 2, 3)
+    np.testing.assert_array_equal(np.asarray(merge_microbatches(micro)),
+                                  np.asarray(x))
+    with pytest.raises(ValueError):
+        split_microbatches(x, 3)
+
+
+def test_pipeline_apply_matches_sequential():
+    """4-stage toy pipeline == sequential layer application."""
+    mesh = make_mesh(MeshSpec(pp=4))
+    key = jax.random.key(0)
+    d = 16
+    w = jax.random.normal(key, (4, d, d), jnp.float32) * 0.3
+    x = jax.random.normal(jax.random.key(1), (8, d), jnp.float32)
+
+    def stage_fn(w_local, x):
+        def layer(x, wi):
+            return jnp.tanh(x @ wi), None
+
+        x, _ = jax.lax.scan(layer, x, w_local)
+        return x
+
+    # oracle: all four layers sequentially
+    oracle = stage_fn(w, x)
+
+    micro = split_microbatches(x, 4)
+    with mesh:
+        from dcos_commons_tpu.parallel.pipeline import last_stage_value
+
+        def run(w, micro):
+            out = pipeline_apply(stage_fn, w, micro, "pp")
+            return last_stage_value(out, "pp")
+
+        out = jax.jit(
+            shard_map(run, mesh=mesh, in_specs=(P("pp"), P()),
+                      out_specs=P(), check_vma=False)
+        )(w, micro)
+    np.testing.assert_allclose(
+        np.asarray(merge_microbatches(out)), np.asarray(oracle),
+        atol=1e-5, rtol=1e-5,
+    )
+
+
+def test_pipeline_transformer_loss_matches_dense():
+    """pp=4 pipelined flagship trunk == plain forward, incl. grads."""
+    mesh = make_mesh(MeshSpec(pp=4))
+    params = init_params(CONFIG, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (8, 32), 0, CONFIG.vocab)
+    targets = jax.random.randint(jax.random.key(2), (8, 32), 0, CONFIG.vocab)
+    oracle = loss_fn(CONFIG, params, tokens, targets)
+
+    piped = shard_map(
+        functools.partial(pipeline_loss_fn, CONFIG, n_micro=4, axis_name="pp"),
+        mesh=mesh,
+        in_specs=(pipeline_param_specs(params), P(), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    with mesh:
+        loss, grads = jax.jit(jax.value_and_grad(
+            lambda p: piped(p, tokens, targets)
+        ))(params)
+    np.testing.assert_allclose(float(loss), float(oracle), atol=1e-4, rtol=1e-4)
+    # gradients must match the dense ones (backward pipeline correct)
+    dense_grads = jax.grad(
+        lambda p: loss_fn(CONFIG, p, tokens, targets)
+    )(params)
+    flat, _ = jax.tree.flatten(grads)
+    dflat, _ = jax.tree.flatten(dense_grads)
+    for g, dg in zip(flat, dflat):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(dg),
+                                   atol=5e-4, rtol=5e-4)
+
+
+# -- mixture of experts ----------------------------------------------
+
+
+MOE = MoEConfig(d_model=32, d_ff=64, n_experts=8, top_k=2,
+                capacity_factor=8.0, dtype=jnp.float32)
+
+
+def test_moe_dense_forward_finite_and_trains():
+    params = init_moe_params(MOE, jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (64, 32), jnp.float32)
+    y, aux = moe_ffn(MOE, params, x)
+    assert y.shape == x.shape
+    assert jnp.isfinite(aux)
+    # gradient flows through routing + experts
+    def loss(p):
+        out, aux = moe_ffn(MOE, p, x)
+        return (out ** 2).mean() + 0.01 * aux
+
+    grads = jax.grad(loss)(params)
+    for leaf in jax.tree.leaves(grads):
+        assert bool(jnp.all(jnp.isfinite(leaf)))
+
+
+def test_moe_capacity_drops_tokens():
+    """Tiny capacity must zero out overflow tokens, not crash."""
+    tight = MoEConfig(d_model=32, d_ff=64, n_experts=8, top_k=1,
+                      capacity_factor=0.25, dtype=jnp.float32)
+    params = init_moe_params(tight, jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (64, 32), jnp.float32)
+    y, _ = moe_ffn(tight, params, x)
+    assert y.shape == x.shape
+    assert bool(jnp.all(jnp.isfinite(y)))
+
+
+def test_moe_ep_sharded_matches_dense():
+    """ep=8: expert-parallel all_to_all path == single-device path.
+
+    Capacity is per-rank in the sharded path, so use a generous
+    capacity_factor and per-rank token counts that never overflow —
+    then routing decisions are token-local and results must agree.
+    """
+    mesh = make_mesh(MeshSpec(ep=8))
+    params = init_moe_params(MOE, jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (64, 32), jnp.float32)
+    y_dense, _ = moe_ffn(MOE, params, x)
+
+    from dcos_commons_tpu.models import expert_shard_spec
+
+    sharded = shard_map(
+        functools.partial(moe_ffn, MOE, axis_name="ep"),
+        mesh=mesh,
+        in_specs=(expert_shard_spec(), P("ep")),
+        out_specs=(P("ep"), P()),
+        check_vma=False,
+    )
+    with mesh:
+        y_ep, aux = jax.jit(sharded)(params, x)
+    np.testing.assert_allclose(np.asarray(y_ep), np.asarray(y_dense),
+                               atol=1e-5, rtol=1e-5)
+    assert jnp.isfinite(aux)
+
+
+def test_moe_ep_gradients_finite():
+    mesh = make_mesh(MeshSpec(ep=4))
+    config = MoEConfig(d_model=32, d_ff=64, n_experts=8, top_k=2,
+                       capacity_factor=4.0, dtype=jnp.float32)
+    params = init_moe_params(config, jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (32, 32), jnp.float32)
+
+    from dcos_commons_tpu.models import expert_shard_spec
+
+    def body(p, x):
+        y, aux = moe_ffn(config, p, x, axis_name="ep")
+        return jax.lax.pmean((y ** 2).mean(), "ep") + 0.01 * aux
+
+    sharded = shard_map(
+        body, mesh=mesh,
+        in_specs=(expert_shard_spec(), P("ep")),
+        out_specs=P(), check_vma=False,
+    )
+    with mesh:
+        grads = jax.jit(jax.grad(lambda p: sharded(p, x)))(params)
+    for leaf in jax.tree.leaves(grads):
+        assert bool(jnp.all(jnp.isfinite(leaf)))
