@@ -29,6 +29,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from dcos_commons_tpu.ops.attention import flash_attention
 from dcos_commons_tpu.ops.rmsnorm import rms_norm
+from dcos_commons_tpu.parallel.pipeline import (
+    last_stage_value,
+    merge_microbatches,
+    pipeline_apply,
+    split_microbatches,
+)
 
 
 @dataclass(frozen=True)
@@ -233,14 +239,7 @@ def _pipeline_trunk(
     """Embed + pipelined layer stack.  Returns microbatched
     activations [n_micro, mb, s, d] — valid on the LAST pp rank only.
     """
-    from dcos_commons_tpu.parallel.pipeline import (
-        pipeline_apply,
-        split_microbatches,
-    )
-
     b, s = tokens.shape
-    if b % n_micro:
-        raise ValueError(f"batch {b} not divisible by n_micro={n_micro}")
     mb = b // n_micro
     positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (mb, s))
     x = params["embed"][tokens].astype(config.dtype)
@@ -266,11 +265,6 @@ def pipeline_forward(
     Returns replicated logits (an activation-sized psum — prefer
     :func:`pipeline_loss_fn` for training, which only psums a scalar).
     """
-    from dcos_commons_tpu.parallel.pipeline import (
-        last_stage_value,
-        merge_microbatches,
-    )
-
     out = _pipeline_trunk(config, params, tokens, n_micro, axis_name)
     out = last_stage_value(out, axis_name)
     return _logits(config, params, merge_microbatches(out))
@@ -290,8 +284,6 @@ def pipeline_loss_fn(
     (a runtime branch on the rank index); the cross-rank collective is
     a single scalar psum, not an activation broadcast.
     """
-    from dcos_commons_tpu.parallel.pipeline import merge_microbatches
-
     out = _pipeline_trunk(config, params, tokens, n_micro, axis_name)
     x = merge_microbatches(out)
     idx = lax.axis_index(axis_name)

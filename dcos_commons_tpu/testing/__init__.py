@@ -1,0 +1,86 @@
+"""Simulation test harness: full scheduler stack, no processes, no fleet.
+
+Rebuild of the reference's `sdk/testing/` simulation harness
+(reference: sdk/testing/.../ServiceTestRunner.java:38,
+SimulationTick.java:6, Expect.java:47-631): boot the *entire* scheduler
+(builder -> config update -> plans -> offer evaluation -> launch WAL)
+against a MemPersister and a scripted FakeAgent, then drive it with
+tick sequences -- `Send*` mutations followed by one scheduler cycle,
+`Expect*` assertions over the observable state.  Scheduler restarts
+are simulated by rebuilding the runner over the same persister, just
+as the reference rebuilds ServiceTestRunner over one MemPersister
+(ServiceTest.java:57-77).
+"""
+
+from dcos_commons_tpu.testing.fake_agent import FakeAgent
+from dcos_commons_tpu.testing.runner import ServiceTestRunner, SimulationWorld
+from dcos_commons_tpu.testing.ticks import (
+    AddHost,
+    AdvanceCycles,
+    Expect,
+    ExpectAllPlansComplete,
+    ExpectDeclined,
+    ExpectDeploymentComplete,
+    ExpectDistinctHosts,
+    ExpectLaunchedTasks,
+    ExpectNoLaunches,
+    ExpectPlanStatus,
+    ExpectRecoveryStep,
+    ExpectReservationCount,
+    ExpectSameHost,
+    ExpectStepStatus,
+    ExpectTaskEnv,
+    ExpectTaskKilled,
+    ExpectTaskNotKilled,
+    ExpectTaskStateStored,
+    MarkHostDown,
+    MarkHostUp,
+    PlanContinue,
+    PlanForceComplete,
+    PlanInterrupt,
+    PlanRestart,
+    RemoveHost,
+    Send,
+    SendStatus,
+    SendTaskFailed,
+    SendTaskFinished,
+    SendTaskRunning,
+    SimulationTick,
+)
+
+__all__ = [
+    "FakeAgent",
+    "ServiceTestRunner",
+    "SimulationWorld",
+    "SimulationTick",
+    "Send",
+    "Expect",
+    "SendStatus",
+    "SendTaskRunning",
+    "SendTaskFinished",
+    "SendTaskFailed",
+    "AddHost",
+    "RemoveHost",
+    "MarkHostDown",
+    "MarkHostUp",
+    "AdvanceCycles",
+    "PlanInterrupt",
+    "PlanContinue",
+    "PlanRestart",
+    "PlanForceComplete",
+    "ExpectLaunchedTasks",
+    "ExpectNoLaunches",
+    "ExpectTaskKilled",
+    "ExpectTaskNotKilled",
+    "ExpectPlanStatus",
+    "ExpectStepStatus",
+    "ExpectDeploymentComplete",
+    "ExpectAllPlansComplete",
+    "ExpectRecoveryStep",
+    "ExpectTaskEnv",
+    "ExpectTaskStateStored",
+    "ExpectReservationCount",
+    "ExpectDistinctHosts",
+    "ExpectSameHost",
+    "ExpectDeclined",
+]

@@ -1,0 +1,121 @@
+"""FakeAgent: the scripted stand-in for the whole fleet.
+
+Plays the role the mocked SchedulerDriver plays in the reference's sim
+harness (reference: sdk/testing/.../ServiceTestRunner.java wires a
+Mockito SchedulerDriver; launches/kills are captured, statuses are
+injected by `SendTaskStatus` ticks).  Nothing actually runs: launches
+are recorded, kills are recorded (and by default acknowledged with a
+TASK_KILLED status, since that is what a healthy agent would report),
+and tests inject every other status transition explicitly.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Set
+
+from dcos_commons_tpu.common import TaskInfo, TaskState, TaskStatus
+
+
+class FakeAgent:
+    def __init__(self, auto_ack_kills: bool = True):
+        self.auto_ack_kills = auto_ack_kills
+        # full launch history, in order (never pruned: tests assert on it)
+        self.launched: List[TaskInfo] = []
+        # kill-call history (task ids, duplicates possible via retries)
+        self.kills: List[str] = []
+        self.checks: Dict[str, Dict[str, object]] = {}
+        self._active: Dict[str, TaskInfo] = {}
+        self._queue: List[TaskStatus] = []
+        self._acked_kills: Set[str] = set()
+        self._lock = threading.RLock()
+
+    # -- Agent interface ---------------------------------------------
+
+    def launch(self, task_infos: List[TaskInfo]) -> None:
+        for info in task_infos:
+            self.launch_one(info)
+
+    def launch_one(self, info: TaskInfo, readiness=None, health=None) -> None:
+        with self._lock:
+            if info.task_id in self._active:
+                return  # idempotent, like the real agent
+            self._active[info.task_id] = info
+            self.launched.append(info)
+            self.checks[info.task_id] = {
+                "readiness": readiness,
+                "health": health,
+            }
+
+    def kill(self, task_id: str, grace_period_s: float = 0.0) -> None:
+        with self._lock:
+            self.kills.append(task_id)
+            if task_id not in self._active:
+                return
+            if self.auto_ack_kills and task_id not in self._acked_kills:
+                self._acked_kills.add(task_id)
+                self.send(
+                    TaskStatus(
+                        task_id=task_id,
+                        state=TaskState.KILLED,
+                        message="killed by scheduler",
+                        agent_id=self._active[task_id].agent_id,
+                    )
+                )
+
+    def active_task_ids(self) -> Set[str]:
+        with self._lock:
+            return set(self._active)
+
+    def poll(self) -> List[TaskStatus]:
+        with self._lock:
+            out = list(self._queue)
+            self._queue.clear()
+            return out
+
+    # -- scripting surface -------------------------------------------
+
+    def send(self, status: TaskStatus) -> None:
+        """Queue a status for the scheduler's next poll; terminal
+        statuses also remove the task from the active set (the process
+        is gone)."""
+        with self._lock:
+            self._queue.append(status)
+            if status.state.is_terminal:
+                self._active.pop(status.task_id, None)
+
+    def task_id_of(self, task_name: str) -> Optional[str]:
+        """Most recent launched task id for a task full-name."""
+        with self._lock:
+            for info in reversed(self.launched):
+                if info.name == task_name:
+                    return info.task_id
+            return None
+
+    def task_info_of(self, task_name: str) -> Optional[TaskInfo]:
+        with self._lock:
+            for info in reversed(self.launched):
+                if info.name == task_name:
+                    return info
+            return None
+
+    def launches_of(self, task_name: str) -> List[TaskInfo]:
+        with self._lock:
+            return [i for i in self.launched if i.name == task_name]
+
+    def killed_names(self) -> List[str]:
+        from dcos_commons_tpu.common import task_name_of
+
+        out = []
+        with self._lock:
+            for task_id in self.kills:
+                try:
+                    out.append(task_name_of(task_id))
+                except ValueError:
+                    pass
+        return out
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._active.clear()
+            self._queue.clear()

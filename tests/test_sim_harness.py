@@ -1,0 +1,214 @@
+"""Sim-harness tests: the reference's ServiceTest.java flows, scripted.
+
+Reference: frameworks/helloworld/src/test/.../ServiceTest.java:43-90
+(deploy tick sequence), CustomStepsTest.java (canary proceed),
+SchedulerRestartServiceTest.java (resume over one persister).  All
+scheduler behavior here is driven through FakeAgent scripting — no
+subprocesses, no sleeps.
+"""
+
+from dcos_commons_tpu.common import TaskState
+from dcos_commons_tpu.offer.inventory import TpuHost
+from dcos_commons_tpu.plan.status import Status
+from dcos_commons_tpu.specification.yaml_spec import from_yaml
+from dcos_commons_tpu.testing import (
+    AdvanceCycles,
+    ExpectDeclined,
+    ExpectDeploymentComplete,
+    ExpectDistinctHosts,
+    ExpectLaunchedTasks,
+    ExpectNoLaunches,
+    ExpectPlanStatus,
+    ExpectRecoveryStep,
+    ExpectStepStatus,
+    ExpectTaskEnv,
+    ExpectTaskKilled,
+    ExpectTaskStateStored,
+    PlanContinue,
+    SendTaskFailed,
+    SendTaskRunning,
+    ServiceTestRunner,
+)
+
+TWO_POD_YAML = """
+name: hello-world
+pods:
+  hello:
+    count: 2
+    placement: 'max-per-host:1'
+    tasks:
+      server:
+        goal: RUNNING
+        cmd: "sleep 1000"
+        cpus: 0.1
+        memory: 32
+"""
+
+
+def test_deploy_tick_sequence():
+    runner = ServiceTestRunner(TWO_POD_YAML)
+    runner.run([
+        AdvanceCycles(1),
+        # serial strategy: only the first instance launches
+        ExpectLaunchedTasks("hello-0-server"),
+        ExpectStepStatus("deploy", "hello", "hello-0:[server]", Status.STARTING),
+        SendTaskRunning("hello-0-server"),
+        ExpectStepStatus("deploy", "hello", "hello-0:[server]", Status.COMPLETE),
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("hello-1-server"),
+        SendTaskRunning("hello-1-server"),
+        ExpectDeploymentComplete(),
+        ExpectDistinctHosts("hello-0-server", "hello-1-server"),
+        ExpectTaskEnv("hello-0-server", "POD_INSTANCE_INDEX", "0"),
+    ])
+
+
+def test_insufficient_fleet_declines():
+    # max-per-host:1 with a single host: second instance cannot place
+    runner = ServiceTestRunner(
+        TWO_POD_YAML, hosts=[TpuHost(host_id="only-host")]
+    )
+    runner.run([
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("hello-0-server"),
+        SendTaskRunning("hello-0-server"),
+        AdvanceCycles(2),
+        ExpectNoLaunches(),
+        ExpectDeclined("hello-[1]"),
+        ExpectPlanStatus("deploy", Status.IN_PROGRESS),
+    ])
+    # capacity arrives (host added) -> deployment finishes
+    from dcos_commons_tpu.testing import AddHost
+
+    runner.run([
+        AddHost(TpuHost(host_id="late-host")),
+        ExpectLaunchedTasks("hello-1-server"),
+        SendTaskRunning("hello-1-server"),
+        ExpectDeploymentComplete(),
+    ])
+
+
+def test_failure_triggers_recovery():
+    runner = ServiceTestRunner(TWO_POD_YAML)
+    runner.run([
+        AdvanceCycles(1),
+        SendTaskRunning("hello-0-server"),
+        AdvanceCycles(1),
+        SendTaskRunning("hello-1-server"),
+        ExpectDeploymentComplete(),
+    ])
+    world = runner.run([
+        SendTaskFailed("hello-0-server"),
+        ExpectRecoveryStep("hello-0"),
+        AdvanceCycles(1),
+        SendTaskRunning("hello-0-server"),
+        ExpectPlanStatus("recovery", Status.COMPLETE),
+        ExpectTaskStateStored("hello-0-server", TaskState.RUNNING),
+    ])
+    # in-place (TRANSIENT) recovery relaunched the same task name twice
+    assert len(world.agent.launches_of("hello-0-server")) == 2
+
+
+def test_scheduler_restart_resumes_mid_deploy():
+    runner = ServiceTestRunner(TWO_POD_YAML)
+    runner.run([
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("hello-0-server"),
+    ])
+    # restart the scheduler over the same persister while hello-0 is
+    # still STARTING: the launch WAL must resume the step mid-flight
+    # (no duplicate launch), and the deployment then finishes normally
+    restarted = runner.restart()
+    restarted.run([
+        AdvanceCycles(1),
+        ExpectNoLaunches(),
+        ExpectStepStatus("deploy", "hello", "hello-0:[server]", Status.STARTING),
+        SendTaskRunning("hello-0-server"),
+        ExpectStepStatus("deploy", "hello", "hello-0:[server]", Status.COMPLETE),
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("hello-1-server"),
+        SendTaskRunning("hello-1-server"),
+        ExpectDeploymentComplete(),
+    ])
+
+
+CANARY_YAML = """
+name: canary-svc
+pods:
+  web:
+    count: 3
+    tasks:
+      node:
+        goal: RUNNING
+        cmd: "sleep 1000"
+        cpus: 0.1
+        memory: 32
+plans:
+  deploy:
+    strategy: canary
+    phases:
+      web-phase:
+        strategy: canary
+        pod: web
+"""
+
+
+def test_canary_waits_for_proceed():
+    runner = ServiceTestRunner(CANARY_YAML)
+    runner.run([
+        AdvanceCycles(2),
+        # canary: nothing launches until an operator proceeds
+        ExpectNoLaunches(),
+        ExpectPlanStatus("deploy", Status.WAITING),
+        # two gates: the plan-level canary over phases, then the
+        # phase-level canary over steps (reference: plan continue vs
+        # plan continue <phase>, PlansQueries.java:47-231)
+        PlanContinue("deploy"),
+        PlanContinue("deploy", "web-phase"),
+        ExpectLaunchedTasks("web-0-node"),
+        SendTaskRunning("web-0-node"),
+        AdvanceCycles(1),
+        # canary strategy requires a second proceed before the rest
+        ExpectNoLaunches(),
+        PlanContinue("deploy", "web-phase"),
+        ExpectLaunchedTasks("web-1-node"),
+        SendTaskRunning("web-1-node"),
+        AdvanceCycles(1),
+        # after the canary count (2), remaining steps flow freely
+        ExpectLaunchedTasks("web-2-node"),
+        SendTaskRunning("web-2-node"),
+        ExpectDeploymentComplete(),
+    ])
+
+
+def test_config_update_rolls_changed_pods():
+    runner = ServiceTestRunner(TWO_POD_YAML)
+    runner.run([
+        AdvanceCycles(1),
+        SendTaskRunning("hello-0-server"),
+        AdvanceCycles(1),
+        SendTaskRunning("hello-1-server"),
+        ExpectDeploymentComplete(),
+    ])
+    # bump the command -> new target config -> update plan redeploys
+    new_yaml = TWO_POD_YAML.replace("sleep 1000", "sleep 2000")
+    updated = ServiceTestRunner(
+        new_yaml,
+        persister=runner.persister,
+        hosts=runner.hosts,
+    )
+    updated.agent = runner.agent
+    updated.inventory = runner.inventory
+    updated.run([
+        AdvanceCycles(1),
+        # rolling update: instance 0 relaunched first, old task killed
+        ExpectTaskKilled("hello-0-server"),
+        SendTaskRunning("hello-0-server"),
+        AdvanceCycles(1),
+        ExpectTaskKilled("hello-1-server"),
+        SendTaskRunning("hello-1-server"),
+        ExpectPlanStatus("update", Status.COMPLETE),
+    ])
+    assert len(updated.agent.launches_of("hello-0-server")) == 2
+    new_info = updated.agent.task_info_of("hello-0-server")
+    assert "sleep 2000" in new_info.command
