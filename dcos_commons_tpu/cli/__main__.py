@@ -1,0 +1,12 @@
+"""``python -m dcos_commons_tpu.cli`` entry point.
+
+Reference: sdk/cli/main.go:1-12 — the 12-line default CLI binary every
+framework ships.
+"""
+
+import sys
+
+from dcos_commons_tpu.cli.commands import main
+
+if __name__ == "__main__":
+    sys.exit(main())

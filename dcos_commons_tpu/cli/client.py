@@ -1,0 +1,57 @@
+"""HTTP client for the scheduler API.
+
+Reference: cli/client/client.go — thin wrapper adding the service URL
+prefix and surfacing non-2xx responses as errors with the body text.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import Any, Optional
+from urllib.parse import urlencode
+
+
+class CliError(Exception):
+    def __init__(self, code: int, body: Any):
+        self.code = code
+        self.body = body
+        super().__init__(f"HTTP {code}: {body}")
+
+
+class ApiClient:
+    def __init__(self, base_url: str, timeout_s: float = 10.0):
+        self._base = base_url.rstrip("/")
+        self._timeout = timeout_s
+
+    def get(self, path: str) -> Any:
+        return self._request("GET", path)
+
+    def post(self, path: str, params: Optional[dict] = None) -> Any:
+        if params:
+            clean = {k: v for k, v in params.items() if v is not None}
+            if clean:
+                path = f"{path}?{urlencode(clean, doseq=True)}"
+        return self._request("POST", path)
+
+    def _request(self, method: str, path: str) -> Any:
+        request = urllib.request.Request(
+            self._base + path, method=method,
+            data=b"" if method == "POST" else None,
+        )
+        try:
+            with urllib.request.urlopen(request, timeout=self._timeout) as resp:
+                code, raw = resp.status, resp.read()
+        except urllib.error.HTTPError as e:
+            code, raw = e.code, e.read()
+        except urllib.error.URLError as e:
+            raise CliError(0, f"cannot reach scheduler at {self._base}: {e}")
+        body = raw.decode("utf-8", errors="replace")
+        try:
+            body = json.loads(body)
+        except json.JSONDecodeError:
+            pass
+        if code >= 400:
+            raise CliError(code, body)
+        return body

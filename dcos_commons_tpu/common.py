@@ -217,3 +217,4 @@ class Label:
     ZONE = "offer_zone"
     REGION = "offer_region"
     GOAL_STATE = "goal_state"
+    GOAL_STATE_OVERRIDE = "goal_state_override"

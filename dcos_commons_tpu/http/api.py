@@ -1,0 +1,389 @@
+"""Query logic behind every /v1 route (transport-free).
+
+Reference: http/queries/*.java — the reference splits Jersey resource
+classes (transport) from query logic classes; this module is the query
+half, returning ``(http_status, jsonable_body)`` tuples so both the
+HTTP server and in-process callers (tests, CLI fallback) share one
+implementation.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from dcos_commons_tpu.common import Label
+from dcos_commons_tpu.debug.trackers import serialize_plan
+from dcos_commons_tpu.plan.status import Status
+from dcos_commons_tpu.specification.specs import task_full_name
+
+Response = Tuple[int, Any]
+
+
+class SchedulerApi:
+    def __init__(self, scheduler):
+        self._scheduler = scheduler
+
+    # -- health (reference: http/endpoints/HealthResource.java) -------
+
+    def health(self) -> Response:
+        plans = self._scheduler.plans()
+        statuses = {name: p.get_status().value for name, p in plans.items()}
+        has_errors = any(p.has_errors() for p in plans.values())
+        deployed = all(
+            p.is_complete for n, p in plans.items()
+            if n in ("deploy", "update")
+        )
+        healthy = not has_errors
+        body = {
+            "healthy": healthy,
+            "deployed": deployed,
+            "plans": statuses,
+        }
+        return (200 if healthy else 503), body
+
+    # -- plans (reference: http/queries/PlansQueries.java:47-231) -----
+
+    def list_plans(self) -> Response:
+        return 200, sorted(self._scheduler.plans().keys())
+
+    def get_plan(self, plan_name: str) -> Response:
+        plan = self._scheduler.plan(plan_name)
+        if plan is None:
+            return 404, {"message": f"no plan named {plan_name}"}
+        body = serialize_plan(plan)
+        # the reference returns 202 while a plan is in progress and 200
+        # once complete (PlansQueries.getPlanInfo)
+        code = 200 if plan.is_complete else 202
+        return code, body
+
+    def _plan_element(
+        self, plan_name: str, phase: Optional[str], step: Optional[str]
+    ):
+        plan = self._scheduler.plan(plan_name)
+        if plan is None:
+            return None, (404, {"message": f"no plan named {plan_name}"})
+        if phase is None:
+            return plan, None
+        phase_el = plan.phase(phase)
+        if phase_el is None:
+            return None, (404, {"message": f"no phase {phase}"})
+        if step is None:
+            return phase_el, None
+        for s in phase_el.steps:
+            if s.name == step or s.id == step:
+                return s, None
+        return None, (404, {"message": f"no step {step}"})
+
+    def _plan_verb(
+        self,
+        plan_name: str,
+        phase: Optional[str],
+        step: Optional[str],
+        verb: str,
+    ) -> Response:
+        element, error = self._plan_element(plan_name, phase, step)
+        if error is not None:
+            return error
+        getattr(element, verb)()
+        return 200, {"message": f"{verb} invoked", "plan": plan_name}
+
+    def plan_interrupt(self, plan_name, phase=None, step=None) -> Response:
+        return self._plan_verb(plan_name, phase, step, "interrupt")
+
+    def plan_continue(self, plan_name, phase=None, step=None) -> Response:
+        return self._plan_verb(plan_name, phase, step, "proceed")
+
+    def plan_restart(self, plan_name, phase=None, step=None) -> Response:
+        return self._plan_verb(plan_name, phase, step, "restart")
+
+    def plan_force_complete(self, plan_name, phase=None, step=None) -> Response:
+        return self._plan_verb(plan_name, phase, step, "force_complete")
+
+    def plan_start(self, plan_name) -> Response:
+        """Reference: PlansQueries.start — restart + proceed (used for
+        sidecar plans like backup/restore)."""
+        element, error = self._plan_element(plan_name, None, None)
+        if error is not None:
+            return error
+        element.restart()
+        element.proceed()
+        return 200, {"message": "started", "plan": plan_name}
+
+    def plan_stop(self, plan_name) -> Response:
+        """Reference: PlansQueries.stop — interrupt + restart."""
+        element, error = self._plan_element(plan_name, None, None)
+        if error is not None:
+            return error
+        element.interrupt()
+        element.restart()
+        return 200, {"message": "stopped", "plan": plan_name}
+
+    # -- pods (reference: http/queries/PodQueries.java:69-263) --------
+
+    def list_pods(self) -> Response:
+        names = []
+        for pod in self._scheduler.spec.pods:
+            for i in range(pod.count):
+                names.append(f"{pod.type}-{i}")
+        return 200, names
+
+    def pod_statuses(self) -> Response:
+        statuses = self._scheduler.state_store.fetch_statuses()
+        body = []
+        for pod in self._scheduler.spec.pods:
+            instances = []
+            for i in range(pod.count):
+                tasks = []
+                for task_spec in pod.tasks:
+                    full = task_full_name(pod.type, i, task_spec.name)
+                    status = statuses.get(full)
+                    info = self._scheduler.state_store.fetch_task(full)
+                    tasks.append(
+                        {
+                            "name": full,
+                            "id": info.task_id if info else None,
+                            "status": status.state.value if status else None,
+                            "ready": status.ready if status else False,
+                        }
+                    )
+                instances.append({"name": f"{pod.type}-{i}", "tasks": tasks})
+            body.append({"name": pod.type, "instances": instances})
+        return 200, {"service": self._scheduler.spec.name, "pods": body}
+
+    def pod_status(self, pod_instance: str) -> Response:
+        pod_type, index, error = self._parse_instance(pod_instance)
+        if error:
+            return error
+        code, body = self.pod_statuses()
+        for pod in body["pods"]:
+            for instance in pod["instances"]:
+                if instance["name"] == pod_instance:
+                    return 200, instance
+        return 404, {"message": f"no pod instance {pod_instance}"}
+
+    def pod_info(self, pod_instance: str) -> Response:
+        pod_type, index, error = self._parse_instance(pod_instance)
+        if error:
+            return error
+        pod = self._scheduler.spec.pod(pod_type)
+        out = []
+        for task_spec in pod.tasks:
+            full = task_full_name(pod_type, index, task_spec.name)
+            info = self._scheduler.state_store.fetch_task(full)
+            if info is not None:
+                out.append(info.to_dict())
+        return 200, out
+
+    def pod_restart(self, pod_instance: str) -> Response:
+        return self._pod_restart(pod_instance, replace=False)
+
+    def pod_replace(self, pod_instance: str) -> Response:
+        return self._pod_restart(pod_instance, replace=True)
+
+    def _pod_restart(self, pod_instance: str, replace: bool) -> Response:
+        pod_type, index, error = self._parse_instance(pod_instance)
+        if error:
+            return error
+        killed = self._scheduler.restart_pod(pod_type, index, replace=replace)
+        return 200, {"pod": pod_instance, "tasks": killed}
+
+    def pod_pause(self, pod_instance: str, tasks=None) -> Response:
+        pod_type, index, error = self._parse_instance(pod_instance)
+        if error:
+            return error
+        touched = self._scheduler.pause_pod(pod_type, index, tasks)
+        if not touched:
+            # no-op transition rejected (reference: PodQueries refuses
+            # invalid override transitions)
+            return 409, {"message": f"{pod_instance} is already paused"}
+        return 200, {"pod": pod_instance, "tasks": touched}
+
+    def pod_resume(self, pod_instance: str, tasks=None) -> Response:
+        pod_type, index, error = self._parse_instance(pod_instance)
+        if error:
+            return error
+        touched = self._scheduler.resume_pod(pod_type, index, tasks)
+        if not touched:
+            return 409, {"message": f"{pod_instance} is not paused"}
+        return 200, {"pod": pod_instance, "tasks": touched}
+
+    def _parse_instance(self, pod_instance: str):
+        pod_type, sep, index = pod_instance.rpartition("-")
+        if not sep or not index.isdigit():
+            return None, None, (
+                400,
+                {"message": f"expected <pod>-<index>, got {pod_instance!r}"},
+            )
+        try:
+            self._scheduler.spec.pod(pod_type)
+        except Exception:
+            return None, None, (404, {"message": f"no pod type {pod_type}"})
+        return pod_type, int(index), None
+
+    # -- configs (reference: http/queries/ConfigQueries.java) ---------
+
+    def list_configs(self) -> Response:
+        store = self._scheduler.config_store
+        if store is None:
+            return 503, {"message": "no config store"}
+        return 200, store.list_ids()
+
+    def get_config(self, config_id: str) -> Response:
+        store = self._scheduler.config_store
+        if store is None:
+            return 503, {"message": "no config store"}
+        data = store.fetch(config_id)
+        if data is None:
+            return 404, {"message": f"no config {config_id}"}
+        return 200, data
+
+    def target_config_id(self) -> Response:
+        store = self._scheduler.config_store
+        if store is None:
+            return 503, {"message": "no config store"}
+        target = store.get_target_config()
+        if target is None:
+            return 404, {"message": "no target config"}
+        return 200, target
+
+    def target_config(self) -> Response:
+        code, target = self.target_config_id()
+        if code != 200:
+            return code, target
+        return self.get_config(target)
+
+    # -- state (reference: http/queries/StateQueries.java) ------------
+
+    def state_properties(self) -> Response:
+        return 200, self._scheduler.state_store.fetch_property_keys()
+
+    def state_property(self, key: str) -> Response:
+        value = self._scheduler.state_store.fetch_property(key)
+        if value is None:
+            return 404, {"message": f"no property {key}"}
+        try:
+            return 200, value.decode("utf-8")
+        except UnicodeDecodeError:
+            return 200, value.hex()
+
+    def state_framework_id(self) -> Response:
+        store = self._scheduler.framework_store
+        if store is None:
+            return 503, {"message": "no framework store"}
+        framework_id = store.fetch_framework_id()
+        if framework_id is None:
+            return 404, {"message": "not registered"}
+        return 200, framework_id
+
+    def state_zones(self) -> Response:
+        """Host -> zone map of the current inventory (reference:
+        StateQueries zone files)."""
+        return 200, {
+            h.host_id: h.zone for h in self._scheduler.inventory.hosts()
+        }
+
+    # -- endpoints (reference: http/endpoints/EndpointsResource) ------
+
+    def _endpoint_map(self) -> Dict[str, List[str]]:
+        """port name -> ["host:port", ...] over all running tasks, plus
+        TPU pod coordinator addresses under "coordinator"."""
+        out: Dict[str, List[str]] = {}
+        ledger = self._scheduler.ledger
+        hosts = {h.host_id: h for h in self._scheduler.inventory.hosts()}
+        for info in self._scheduler.state_store.fetch_tasks():
+            host = hosts.get(info.agent_id)
+            hostname = host.hostname if host else info.agent_id
+            pod = None
+            for p in self._scheduler.spec.pods:
+                if p.type == info.pod_type:
+                    pod = p
+            if pod is None:
+                continue
+            try:
+                task_spec = pod.task(info.name.rsplit("-", 1)[-1])
+            except Exception:
+                task_spec = None
+            for reservation in ledger.for_task(info.name):
+                port_specs = (
+                    task_spec.resources.ports if task_spec is not None else []
+                )
+                for port_spec, port in zip(port_specs, reservation.ports):
+                    out.setdefault(port_spec.name, []).append(
+                        f"{hostname}:{port}"
+                    )
+            coord = info.env.get("COORDINATOR_ADDRESS")
+            if coord:
+                entries = out.setdefault("coordinator", [])
+                if coord not in entries:
+                    entries.append(coord)
+        return out
+
+    def list_endpoints(self) -> Response:
+        return 200, sorted(self._endpoint_map().keys())
+
+    def get_endpoint(self, name: str) -> Response:
+        entries = self._endpoint_map().get(name)
+        if entries is None:
+            return 404, {"message": f"no endpoint {name}"}
+        return 200, {"name": name, "address": sorted(entries)}
+
+    # -- artifacts (reference: http/endpoints/ArtifactResource:50) ----
+
+    def artifact_template(
+        self, config_id: str, pod_type: str, task_name: str, template_name: str
+    ) -> Response:
+        """Serve a config template's raw content for the given stored
+        configuration (tasks pull these at bootstrap and render them
+        against their env, sdk/bootstrap/main.go:291-376)."""
+        store = self._scheduler.config_store
+        if store is None:
+            return 503, {"message": "no config store"}
+        data = store.fetch(config_id)
+        if data is None:
+            return 404, {"message": f"no config {config_id}"}
+        from dcos_commons_tpu.specification.specs import ServiceSpec
+
+        spec = ServiceSpec.from_dict(data)
+        try:
+            task_spec = spec.pod(pod_type).task(task_name)
+        except Exception:
+            return 404, {"message": f"no task {pod_type}/{task_name}"}
+        for template_path, dest in task_spec.config_templates:
+            if os.path.basename(template_path) == template_name or \
+                    dest == template_name:
+                try:
+                    with open(template_path, "r") as f:
+                        return 200, f.read()
+                except OSError as e:
+                    return 500, {"message": f"cannot read template: {e}"}
+        return 404, {"message": f"no template {template_name}"}
+
+    # -- debug (reference: debug/*.java, /v1/debug) -------------------
+
+    def debug_offers(self) -> Response:
+        return 200, self._scheduler.outcome_tracker.to_json()
+
+    def debug_plans(self) -> Response:
+        return 200, {
+            name: serialize_plan(plan)
+            for name, plan in self._scheduler.plans().items()
+        }
+
+    def debug_task_statuses(self) -> Response:
+        from dcos_commons_tpu.debug.trackers import TaskStatusesTracker
+
+        return 200, TaskStatusesTracker(self._scheduler.state_store).to_json()
+
+    def debug_reservations(self) -> Response:
+        from dcos_commons_tpu.debug.trackers import TaskReservationsTracker
+
+        return 200, TaskReservationsTracker(self._scheduler.ledger).to_json()
+
+    # -- metrics ------------------------------------------------------
+
+    def metrics_json(self) -> Response:
+        return 200, self._scheduler.metrics.snapshot()
+
+    def metrics_prometheus(self) -> Tuple[int, str]:
+        return 200, self._scheduler.metrics.prometheus()

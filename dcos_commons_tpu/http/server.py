@@ -1,0 +1,175 @@
+"""Threaded HTTP server mapping /v1 routes onto SchedulerApi.
+
+Reference: framework/ApiServer.java — the Jetty server started before
+offers are accepted (FrameworkRunner.java:130-138).  Stdlib-only:
+ThreadingHTTPServer + a small regex router; JSON in/out.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, List, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from dcos_commons_tpu.http.api import SchedulerApi
+
+Route = Tuple[str, re.Pattern, Callable]
+
+
+def build_routes(api: SchedulerApi) -> List[Route]:
+    def r(method: str, pattern: str, handler: Callable) -> Route:
+        return (method, re.compile(f"^{pattern}$"), handler)
+
+    # handlers receive (match, query) and return (code, body)
+    return [
+        r("GET", r"/v1/health", lambda m, q: api.health()),
+        # plans (verbs accept ?phase= & ?step=, as the reference's POST
+        # bodies/params do — PlansQueries.java:47-231)
+        r("GET", r"/v1/plans", lambda m, q: api.list_plans()),
+        r("GET", r"/v1/plans/([^/]+)", lambda m, q: api.get_plan(m.group(1))),
+        r("POST", r"/v1/plans/([^/]+)/interrupt",
+          lambda m, q: api.plan_interrupt(m.group(1), _one(q, "phase"),
+                                          _one(q, "step"))),
+        r("POST", r"/v1/plans/([^/]+)/continue",
+          lambda m, q: api.plan_continue(m.group(1), _one(q, "phase"),
+                                         _one(q, "step"))),
+        r("POST", r"/v1/plans/([^/]+)/forceComplete",
+          lambda m, q: api.plan_force_complete(m.group(1), _one(q, "phase"),
+                                               _one(q, "step"))),
+        r("POST", r"/v1/plans/([^/]+)/restart",
+          lambda m, q: api.plan_restart(m.group(1), _one(q, "phase"),
+                                        _one(q, "step"))),
+        r("POST", r"/v1/plans/([^/]+)/start",
+          lambda m, q: api.plan_start(m.group(1))),
+        r("POST", r"/v1/plans/([^/]+)/stop",
+          lambda m, q: api.plan_stop(m.group(1))),
+        # pods
+        r("GET", r"/v1/pod", lambda m, q: api.list_pods()),
+        r("GET", r"/v1/pod/status", lambda m, q: api.pod_statuses()),
+        r("GET", r"/v1/pod/([^/]+)/status",
+          lambda m, q: api.pod_status(m.group(1))),
+        r("GET", r"/v1/pod/([^/]+)/info",
+          lambda m, q: api.pod_info(m.group(1))),
+        r("POST", r"/v1/pod/([^/]+)/restart",
+          lambda m, q: api.pod_restart(m.group(1))),
+        r("POST", r"/v1/pod/([^/]+)/replace",
+          lambda m, q: api.pod_replace(m.group(1))),
+        r("POST", r"/v1/pod/([^/]+)/pause",
+          lambda m, q: api.pod_pause(m.group(1), q.get("task"))),
+        r("POST", r"/v1/pod/([^/]+)/resume",
+          lambda m, q: api.pod_resume(m.group(1), q.get("task"))),
+        # configs
+        r("GET", r"/v1/configs", lambda m, q: api.list_configs()),
+        r("GET", r"/v1/configs/targetId", lambda m, q: api.target_config_id()),
+        r("GET", r"/v1/configs/target", lambda m, q: api.target_config()),
+        r("GET", r"/v1/configs/([^/]+)",
+          lambda m, q: api.get_config(m.group(1))),
+        # state
+        r("GET", r"/v1/state/properties",
+          lambda m, q: api.state_properties()),
+        r("GET", r"/v1/state/properties/([^/]+)",
+          lambda m, q: api.state_property(m.group(1))),
+        r("GET", r"/v1/state/frameworkId",
+          lambda m, q: api.state_framework_id()),
+        r("GET", r"/v1/state/zones", lambda m, q: api.state_zones()),
+        # endpoints
+        r("GET", r"/v1/endpoints", lambda m, q: api.list_endpoints()),
+        r("GET", r"/v1/endpoints/([^/]+)",
+          lambda m, q: api.get_endpoint(m.group(1))),
+        # artifacts
+        r("GET", r"/v1/artifacts/template/([^/]+)/([^/]+)/([^/]+)/([^/]+)",
+          lambda m, q: api.artifact_template(
+              m.group(1), m.group(2), m.group(3), m.group(4))),
+        # debug
+        r("GET", r"/v1/debug/offers", lambda m, q: api.debug_offers()),
+        r("GET", r"/v1/debug/plans", lambda m, q: api.debug_plans()),
+        r("GET", r"/v1/debug/taskStatuses",
+          lambda m, q: api.debug_task_statuses()),
+        r("GET", r"/v1/debug/reservations",
+          lambda m, q: api.debug_reservations()),
+        # metrics
+        r("GET", r"/v1/metrics/prometheus",
+          lambda m, q: api.metrics_prometheus()),
+        r("GET", r"/v1/metrics", lambda m, q: api.metrics_json()),
+    ]
+
+
+def _one(query: dict, key: str) -> Optional[str]:
+    values = query.get(key)
+    return values[0] if values else None
+
+
+class ApiServer:
+    """Reference: framework/ApiServer.java — started before the event
+    loop accepts work; ``port=0`` binds an ephemeral port (tests)."""
+
+    def __init__(self, scheduler, port: int = 0, host: str = "127.0.0.1"):
+        api = SchedulerApi(scheduler)
+        routes = build_routes(api)
+
+        class Handler(BaseHTTPRequestHandler):
+            # quiet request logging (structured logs belong to the app)
+            def log_message(self, fmt, *args):
+                pass
+
+            def _dispatch(self, method: str) -> None:
+                parsed = urlparse(self.path)
+                query = parse_qs(parsed.query)
+                for route_method, pattern, handler in routes:
+                    if route_method != method:
+                        continue
+                    match = pattern.match(parsed.path)
+                    if match is None:
+                        continue
+                    try:
+                        code, body = handler(match, query)
+                    except Exception as e:  # surface, don't kill the server
+                        code, body = 500, {"message": f"internal error: {e}"}
+                    self._reply(code, body)
+                    return
+                self._reply(404, {"message": f"no route {method} {parsed.path}"})
+
+            def _reply(self, code: int, body) -> None:
+                if isinstance(body, str):
+                    payload = body.encode("utf-8")
+                    content_type = "text/plain; charset=utf-8"
+                else:
+                    payload = json.dumps(body, indent=2).encode("utf-8")
+                    content_type = "application/json"
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):
+                self._dispatch("GET")
+
+            def do_POST(self):
+                self._dispatch("POST")
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    @property
+    def url(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "ApiServer":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="api-server", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

@@ -42,10 +42,14 @@ from dcos_commons_tpu.specification.specs import (
     TaskSpec,
     task_full_name,
 )
-from dcos_commons_tpu.state.state_store import StateStore
+from dcos_commons_tpu.state.state_store import GoalStateOverride, StateStore
 
 # env contract injected into every launched task (reference analogue:
 # offer/taskdata/EnvConstants + PodInfoBuilder env assembly)
+# idle command a PAUSED task runs instead of its real cmd (reference:
+# the pause override sleep cmd in PodQueries/GoalStateOverride)
+PAUSE_COMMAND = "sleep 1209600"
+
 ENV_POD_INSTANCE_INDEX = "POD_INSTANCE_INDEX"
 ENV_TASK_NAME = "TASK_NAME"
 ENV_FRAMEWORK_NAME = "FRAMEWORK_NAME"
@@ -470,13 +474,22 @@ class OfferEvaluator:
             Label.REGION: host.region,
             Label.GOAL_STATE: task_spec.goal.value,
         }
+        # pod pause: a PAUSED goal override swaps the real command for
+        # an idle one, so the task occupies its reservations without
+        # doing work (reference: GoalStateOverride.PAUSED launched with
+        # a sleep override cmd, PodQueries.java:183-203)
+        command = task_spec.cmd
+        override, _progress = self._state_store.fetch_goal_override(full)
+        if override is GoalStateOverride.PAUSED:
+            command = PAUSE_COMMAND
+            labels[Label.GOAL_STATE_OVERRIDE] = override.value
         return TaskInfo(
             name=full,
             task_id=new_task_id(full),
             agent_id=host.host_id,
             pod_type=pod.type,
             pod_index=index,
-            command=task_spec.cmd,
+            command=command,
             env=env,
             resource_ids=[r.reservation_id for r in reservations],
             tpu_chip_ids=list(chips),

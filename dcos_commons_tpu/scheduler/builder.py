@@ -176,6 +176,8 @@ class SchedulerBuilder:
 
             agent = LocalProcessAgent(self._config.sandbox_root)
 
+        from dcos_commons_tpu.state.framework_store import FrameworkStore
+
         return DefaultScheduler(
             spec=target_spec,
             state_store=state_store,
@@ -185,6 +187,8 @@ class SchedulerBuilder:
             evaluator=evaluator,
             deploy_manager=deploy_manager,
             recovery_manager=recovery_manager,
+            config_store=config_store,
+            framework_store=FrameworkStore(persister),
         )
 
     # -- config update (reference: DefaultConfigurationUpdater:159) ---

@@ -40,7 +40,11 @@ from dcos_commons_tpu.runtime.reconciler import Reconciler
 from dcos_commons_tpu.runtime.task_killer import TaskKiller
 from dcos_commons_tpu.specification.specs import ServiceSpec, task_full_name
 from dcos_commons_tpu.state.launch_recorder import PersistentLaunchRecorder
-from dcos_commons_tpu.state.state_store import StateStore
+from dcos_commons_tpu.state.state_store import (
+    GoalStateOverride,
+    OverrideProgress,
+    StateStore,
+)
 
 LOG = logging.getLogger(__name__)
 
@@ -59,7 +63,13 @@ class DefaultScheduler:
         other_managers: Optional[List[PlanManager]] = None,
         metrics: Optional[Metrics] = None,
         outcome_tracker: Optional[OfferOutcomeTracker] = None,
+        config_store=None,
+        framework_store=None,
     ):
+        # stores surfaced to the HTTP API (/v1/configs, /v1/state);
+        # None when the scheduler is wired by hand in unit tests
+        self.config_store = config_store
+        self.framework_store = framework_store
         self.spec = spec
         self.state_store = state_store
         self.ledger = ledger
@@ -137,6 +147,17 @@ class DefaultScheduler:
             LOG.info("dropped stale status %s for %s",
                      status.state.value, task_name)
             return
+        # a pause/resume override completes once the task relaunched
+        # UNDER the override (progress IN_PROGRESS, set at launch time)
+        # reaches RUNNING; a RUNNING from the pre-override task arrives
+        # while progress is still PENDING and must not complete it
+        # (reference: GoalStateOverride progress machine)
+        if status.state.is_running:
+            override, progress = self.state_store.fetch_goal_override(task_name)
+            if progress is OverrideProgress.IN_PROGRESS:
+                self.state_store.store_goal_override(
+                    task_name, override, OverrideProgress.COMPLETE
+                )
         self.task_killer.handle_status(status)
         for manager in self.coordinator.plan_managers:
             manager.update(status)
@@ -171,6 +192,14 @@ class DefaultScheduler:
             # BEFORE the agent sees a launch (DefaultScheduler.java:454)
             self.ledger.commit(result.reservations)
             self.launch_recorder.record(result.task_infos)
+            for info in result.task_infos:
+                override, progress = self.state_store.fetch_goal_override(
+                    info.name
+                )
+                if progress is OverrideProgress.PENDING:
+                    self.state_store.store_goal_override(
+                        info.name, override, OverrideProgress.IN_PROGRESS
+                    )
             step.record_launch({t.name: t.task_id for t in result.task_infos})
             self._launch(result.task_infos, requirement)
             self.metrics.incr("operations.launch", len(result.task_infos))
@@ -192,15 +221,22 @@ class DefaultScheduler:
         for info in task_infos:
             task_spec = None
             for spec in pod.tasks:
-                if info.name.endswith(f"-{spec.name}"):
+                # exact-name match: suffix matching would confuse task
+                # names that are dash-suffixes of each other
+                if task_full_name(pod.type, info.pod_index, spec.name) == \
+                        info.name:
                     task_spec = spec
                     break
+            # paused tasks run an idle command: their readiness/health
+            # checks would probe a server that isn't there
+            paused = info.labels.get(Label.GOAL_STATE_OVERRIDE) == \
+                GoalStateOverride.PAUSED.value
             launch_one = getattr(self.agent, "launch_one", None)
             if launch_one is not None and task_spec is not None:
                 launch_one(
                     info,
-                    readiness=task_spec.readiness_check,
-                    health=task_spec.health_check,
+                    readiness=None if paused else task_spec.readiness_check,
+                    health=None if paused else task_spec.health_check,
                 )
             else:
                 self.agent.launch([info])
@@ -241,6 +277,56 @@ class DefaultScheduler:
                 self.task_killer.kill(info.task_id, task_spec.kill_grace_period_s)
                 killed.append(full)
         return killed
+
+    def pause_pod(
+        self, pod_type: str, index: int, tasks: Optional[List[str]] = None
+    ) -> List[str]:
+        """Reference: PodQueries pause (:183-203) — store a PAUSED goal
+        override and kill the tasks; recovery relaunches them with the
+        idle override command on their existing reservations."""
+        return self._override_pod(
+            pod_type, index, tasks, GoalStateOverride.PAUSED
+        )
+
+    def resume_pod(
+        self, pod_type: str, index: int, tasks: Optional[List[str]] = None
+    ) -> List[str]:
+        """Reference: PodQueries resume — clear the override and kill;
+        the relaunch restores the real command."""
+        return self._override_pod(
+            pod_type, index, tasks, GoalStateOverride.NONE
+        )
+
+    def _override_pod(
+        self,
+        pod_type: str,
+        index: int,
+        tasks: Optional[List[str]],
+        override: GoalStateOverride,
+    ) -> List[str]:
+        pod = self.spec.pod(pod_type)
+        indices = list(range(pod.count)) if pod.gang else [index]
+        touched = []
+        for i in indices:
+            for task_spec in pod.tasks:
+                if tasks and task_spec.name not in tasks:
+                    continue
+                full = task_full_name(pod_type, i, task_spec.name)
+                current, _progress = self.state_store.fetch_goal_override(full)
+                if current is override:
+                    # no-op transition (pause of a paused task, resume
+                    # of a running one): don't kill anything
+                    continue
+                self.state_store.store_goal_override(
+                    full, override, OverrideProgress.PENDING
+                )
+                touched.append(full)
+                info = self.state_store.fetch_task(full)
+                if info is not None:
+                    self.task_killer.kill(
+                        info.task_id, task_spec.kill_grace_period_s
+                    )
+        return touched
 
     def plans(self) -> Dict[str, Plan]:
         out = {}

@@ -1,0 +1,116 @@
+"""CLI tests: main() against a live API server over loopback.
+
+Reference: the Go CLI's verb surface (cli/commands/*.go) — here the
+CLI process boundary is exercised too (python -m dcos_commons_tpu.cli
+in a subprocess for one smoke case; the rest call main() in-process).
+"""
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from dcos_commons_tpu.cli.commands import main
+from dcos_commons_tpu.http import ApiServer
+from dcos_commons_tpu.testing import (
+    AdvanceCycles,
+    ExpectDeploymentComplete,
+    SendTaskRunning,
+    ServiceTestRunner,
+)
+
+YAML = """
+name: cli-svc
+pods:
+  app:
+    count: 1
+    tasks:
+      main:
+        goal: RUNNING
+        cmd: "serve"
+        cpus: 0.1
+        memory: 32
+"""
+
+
+@pytest.fixture()
+def deployed():
+    runner = ServiceTestRunner(YAML)
+    runner.run([
+        AdvanceCycles(1),
+        SendTaskRunning("app-0-main"),
+        ExpectDeploymentComplete(),
+    ])
+    server = ApiServer(runner.world.scheduler).start()
+    yield runner, server
+    server.stop()
+
+
+def cli(server, *argv, expect_rc=0, capsys=None):
+    rc = main(["--url", server.url, *argv])
+    assert rc == expect_rc
+    out = capsys.readouterr().out if capsys else ""
+    try:
+        return json.loads(out)
+    except json.JSONDecodeError:
+        return out.strip()
+
+
+def test_plan_and_pod_sections(deployed, capsys):
+    runner, server = deployed
+    assert cli(server, "plan", "list", capsys=capsys) == ["deploy", "recovery"]
+    plan = cli(server, "plan", "show", "deploy", capsys=capsys)
+    assert plan["status"] == "COMPLETE"
+    assert cli(server, "pod", "list", capsys=capsys) == ["app-0"]
+    status = cli(server, "pod", "status", "app-0", capsys=capsys)
+    assert status["tasks"][0]["status"] == "TASK_RUNNING"
+
+    cli(server, "pod", "restart", "app-0", capsys=capsys)
+    runner.run([AdvanceCycles(2), SendTaskRunning("app-0-main")])
+    assert len(runner.agent.launches_of("app-0-main")) == 2
+
+
+def test_config_state_endpoints_health(deployed, capsys):
+    runner, server = deployed
+    target = cli(server, "config", "target", capsys=capsys)
+    assert target["name"] == "cli-svc"
+    target_id = cli(server, "config", "target_id", capsys=capsys)
+    assert target_id in cli(server, "config", "list", capsys=capsys)
+    props = cli(server, "state", "properties", capsys=capsys)
+    assert "deployment-completed" in props
+    health = cli(server, "health", capsys=capsys)
+    assert health["healthy"]
+    metrics = cli(server, "metrics", capsys=capsys)
+    assert metrics["operations.launch"] >= 1
+    offers = cli(server, "debug", "offers", capsys=capsys)
+    assert offers[-1]["passed"]
+
+
+def test_plan_verbs(deployed, capsys):
+    runner, server = deployed
+    cli(server, "plan", "force-restart", "deploy", "app", "app-0:[main]",
+        capsys=capsys)
+    plan = cli(server, "plan", "show", "deploy", capsys=capsys)
+    assert plan["status"] == "PENDING"
+    cli(server, "plan", "force-complete", "deploy", "app", "app-0:[main]",
+        capsys=capsys)
+    plan = cli(server, "plan", "show", "deploy", capsys=capsys)
+    assert plan["status"] == "COMPLETE"
+
+
+def test_error_surfaces_as_exit_code(deployed, capsys):
+    runner, server = deployed
+    cli(server, "plan", "show", "nope", expect_rc=1, capsys=capsys)
+    err = capsys.readouterr  # stderr captured alongside; rc checked above
+
+
+def test_subprocess_smoke(deployed):
+    runner, server = deployed
+    result = subprocess.run(
+        [sys.executable, "-m", "dcos_commons_tpu.cli",
+         "--url", server.url, "plan", "list"],
+        capture_output=True, text=True, timeout=30, cwd="/root/repo",
+    )
+    assert result.returncode == 0, result.stderr
+    assert json.loads(result.stdout) == ["deploy", "recovery"]

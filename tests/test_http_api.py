@@ -1,0 +1,171 @@
+"""HTTP API tests: real sockets against a sim-harness scheduler.
+
+Reference: the /v1 surface of http/queries/PlansQueries.java,
+PodQueries.java, endpoints/*.java, exercised here over loopback.
+"""
+
+import json
+import urllib.request
+import urllib.error
+
+import pytest
+
+from dcos_commons_tpu.http import ApiServer
+from dcos_commons_tpu.testing import (
+    AdvanceCycles,
+    ExpectDeploymentComplete,
+    SendTaskRunning,
+    ServiceTestRunner,
+)
+
+YAML = """
+name: api-svc
+pods:
+  web:
+    count: 2
+    tasks:
+      srv:
+        goal: RUNNING
+        cmd: "serve"
+        cpus: 0.1
+        memory: 32
+        ports:
+          http:
+            env-key: PORT_HTTP
+"""
+
+
+@pytest.fixture()
+def deployed():
+    runner = ServiceTestRunner(YAML)
+    runner.run([
+        AdvanceCycles(1),
+        SendTaskRunning("web-0-srv"),
+        AdvanceCycles(1),
+        SendTaskRunning("web-1-srv"),
+        ExpectDeploymentComplete(),
+    ])
+    server = ApiServer(runner.world.scheduler).start()
+    yield runner, server
+    server.stop()
+
+
+def get(server, path, expect_code=200):
+    try:
+        with urllib.request.urlopen(server.url + path) as resp:
+            code, raw = resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        code, raw = e.code, e.read()
+    assert code == expect_code, f"GET {path} -> {code}: {raw[:200]}"
+    content = raw.decode("utf-8")
+    try:
+        return json.loads(content)
+    except json.JSONDecodeError:
+        return content  # text/plain bodies (ids, properties, prometheus)
+
+
+def post(server, path, expect_code=200):
+    req = urllib.request.Request(server.url + path, method="POST", data=b"")
+    try:
+        with urllib.request.urlopen(req) as resp:
+            code, raw = resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        code, raw = e.code, e.read()
+    assert code == expect_code, f"POST {path} -> {code}: {raw[:200]}"
+    return json.loads(raw.decode("utf-8"))
+
+
+def test_health_and_plans(deployed):
+    runner, server = deployed
+    health = get(server, "/v1/health")
+    assert health["healthy"] and health["deployed"]
+
+    assert get(server, "/v1/plans") == ["deploy", "recovery"]
+    plan = get(server, "/v1/plans/deploy")
+    assert plan["status"] == "COMPLETE"
+    assert plan["phases"][0]["steps"][0]["status"] == "COMPLETE"
+    get(server, "/v1/plans/nope", expect_code=404)
+
+
+def test_pod_surface(deployed):
+    runner, server = deployed
+    assert get(server, "/v1/pod") == ["web-0", "web-1"]
+    statuses = get(server, "/v1/pod/status")
+    assert statuses["service"] == "api-svc"
+    instance = get(server, "/v1/pod/web-0/status")
+    assert instance["tasks"][0]["status"] == "TASK_RUNNING"
+    info = get(server, "/v1/pod/web-0/info")
+    assert info[0]["name"] == "web-0-srv"
+
+    # restart kills the task; the scheduler relaunches it via recovery
+    result = post(server, "/v1/pod/web-0/restart")
+    assert result["tasks"] == ["web-0-srv"]
+    runner.run([
+        AdvanceCycles(2),
+        SendTaskRunning("web-0-srv"),
+    ])
+    assert len(runner.agent.launches_of("web-0-srv")) == 2
+
+    post(server, "/v1/pod/bogus-x/restart", expect_code=400)
+    post(server, "/v1/pod/nope-0/restart", expect_code=404)
+
+
+def test_pause_resume_verbs(deployed):
+    runner, server = deployed
+    # resuming a pod that was never paused is a rejected no-op: nothing
+    # may be killed (reference: PodQueries transition validation)
+    post(server, "/v1/pod/web-1/resume", expect_code=409)
+    assert runner.agent.kills == []
+    result = post(server, "/v1/pod/web-1/pause")
+    assert result["tasks"] == ["web-1-srv"]
+    post(server, "/v1/pod/web-1/pause", expect_code=409)
+    runner.run([AdvanceCycles(2), SendTaskRunning("web-1-srv")])
+    from dcos_commons_tpu.offer.evaluate import PAUSE_COMMAND
+
+    assert runner.agent.task_info_of("web-1-srv").command == PAUSE_COMMAND
+    post(server, "/v1/pod/web-1/resume")
+    runner.run([AdvanceCycles(2), SendTaskRunning("web-1-srv")])
+    assert runner.agent.task_info_of("web-1-srv").command == "serve"
+
+
+def test_configs_state_endpoints_debug_metrics(deployed):
+    runner, server = deployed
+    target_id = get(server, "/v1/configs/targetId")
+    assert target_id in get(server, "/v1/configs")
+    target = get(server, "/v1/configs/target")
+    assert target["name"] == "api-svc"
+
+    props = get(server, "/v1/state/properties")
+    assert "deployment-completed" in props
+    assert get(server, "/v1/state/properties/deployment-completed") is True
+    zones = get(server, "/v1/state/zones")
+    assert set(zones) == {"host-0", "host-1", "host-2"}
+
+    endpoints = get(server, "/v1/endpoints")
+    assert "http" in endpoints
+    ep = get(server, "/v1/endpoints/http")
+    assert len(ep["address"]) == 2
+
+    offers = get(server, "/v1/debug/offers")
+    assert offers and offers[-1]["passed"]
+    reservations = get(server, "/v1/debug/reservations")
+    assert len(reservations) >= 2
+    metrics = get(server, "/v1/metrics")
+    assert metrics["operations.launch"] >= 2
+    prom = get(server, "/v1/metrics/prometheus")
+    assert "operations_launch" in prom
+
+
+def test_plan_verbs_over_http(deployed):
+    runner, server = deployed
+    # a COMPLETE plan stays COMPLETE through interrupt/continue
+    post(server, "/v1/plans/deploy/interrupt")
+    assert get(server, "/v1/plans/deploy")["status"] == "COMPLETE"
+    post(server, "/v1/plans/deploy/continue")
+    assert get(server, "/v1/plans/deploy")["status"] == "COMPLETE"
+    # restart a single step by name, then force it complete again
+    post(server, "/v1/plans/deploy/restart?phase=web&step=web-1:%5Bsrv%5D")
+    assert get(server, "/v1/plans/deploy", expect_code=202)["status"] == \
+        "IN_PROGRESS"
+    post(server, "/v1/plans/deploy/forceComplete?phase=web&step=web-1:%5Bsrv%5D")
+    assert get(server, "/v1/plans/deploy")["status"] == "COMPLETE"

@@ -1,0 +1,99 @@
+"""Pod pause/resume via GoalStateOverride.
+
+Reference: http/queries/PodQueries.java:183-203 (pause/resume flip a
+GoalStateOverride and relaunch with a sleep override cmd),
+state/GoalStateOverride.java (PAUSED + progress machine).
+"""
+
+from dcos_commons_tpu.offer.evaluate import PAUSE_COMMAND
+from dcos_commons_tpu.plan.status import Status
+from dcos_commons_tpu.state.state_store import (
+    GoalStateOverride,
+    OverrideProgress,
+)
+from dcos_commons_tpu.testing import (
+    AdvanceCycles,
+    ExpectDeploymentComplete,
+    ExpectPlanStatus,
+    ExpectTaskKilled,
+    SendTaskRunning,
+    ServiceTestRunner,
+)
+
+YAML = """
+name: pausable
+pods:
+  web:
+    count: 1
+    tasks:
+      srv:
+        goal: RUNNING
+        cmd: "real-server --serve"
+        cpus: 0.1
+        memory: 32
+        readiness-check:
+          cmd: "check-it"
+          interval: 1
+          timeout: 5
+"""
+
+
+def deploy(runner):
+    runner.run([
+        AdvanceCycles(1),
+        SendTaskRunning("web-0-srv"),
+        ExpectDeploymentComplete(),
+    ])
+
+
+def test_pause_relaunches_idle_and_resume_restores():
+    runner = ServiceTestRunner(YAML)
+    deploy(runner)
+    world = runner.world
+    scheduler = world.scheduler
+
+    touched = scheduler.pause_pod("web", 0)
+    assert touched == ["web-0-srv"]
+    runner.run([
+        AdvanceCycles(1),         # kill ack arrives; recovery relaunches
+        ExpectTaskKilled("web-0-srv"),
+        AdvanceCycles(1),
+        SendTaskRunning("web-0-srv"),
+        ExpectPlanStatus("recovery", Status.COMPLETE),
+    ])
+    info = world.agent.task_info_of("web-0-srv")
+    assert info.command == PAUSE_COMMAND
+    # paused relaunch must not carry the readiness check
+    assert world.agent.checks[info.task_id]["readiness"] is None
+    override, progress = scheduler.state_store.fetch_goal_override("web-0-srv")
+    assert override is GoalStateOverride.PAUSED
+    assert progress is OverrideProgress.COMPLETE
+
+    scheduler.resume_pod("web", 0)
+    runner.run([
+        AdvanceCycles(1),
+        AdvanceCycles(1),
+        SendTaskRunning("web-0-srv"),
+        ExpectPlanStatus("recovery", Status.COMPLETE),
+    ])
+    info = world.agent.task_info_of("web-0-srv")
+    assert info.command == "real-server --serve"
+    assert world.agent.checks[info.task_id]["readiness"] is not None
+    override, progress = scheduler.state_store.fetch_goal_override("web-0-srv")
+    assert override is GoalStateOverride.NONE
+    assert progress is OverrideProgress.COMPLETE
+
+
+def test_pause_survives_scheduler_restart():
+    runner = ServiceTestRunner(YAML)
+    deploy(runner)
+    runner.world.scheduler.pause_pod("web", 0)
+    runner.run([AdvanceCycles(2)])
+
+    restarted = runner.restart()
+    restarted.run([
+        AdvanceCycles(2),
+        SendTaskRunning("web-0-srv"),
+    ])
+    info = restarted.agent.task_info_of("web-0-srv")
+    assert info.command == PAUSE_COMMAND
