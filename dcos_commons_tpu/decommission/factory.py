@@ -1,0 +1,114 @@
+"""DecommissionPlanFactory: state-vs-spec diff -> teardown plan.
+
+Reference: scheduler/decommission/DecommissionPlanFactory.java — for
+each surplus pod instance, a serial step sequence: mark + kill its
+tasks (TriggerDecommissionStep), unreserve its resources
+(ResourceCleanupStep analogue over the reservation ledger), erase its
+task state (EraseTaskStateStep).  Highest indices decommission first,
+so the surviving instances are always the dense prefix 0..count-1.
+
+The plan is re-derived from the state diff on every scheduler
+(re)build, which makes each step idempotent — a crash mid-teardown
+resumes by recomputing what is still surplus.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from dcos_commons_tpu.common import TaskInfo
+from dcos_commons_tpu.plan.phase import Phase
+from dcos_commons_tpu.plan.plan import Plan
+from dcos_commons_tpu.plan.step import ActionStep
+from dcos_commons_tpu.plan.strategy import SerialStrategy
+from dcos_commons_tpu.specification.specs import ServiceSpec, pod_instance_name
+from dcos_commons_tpu.state.state_store import StateStore
+
+DECOMMISSION_PLAN_NAME = "decommission"
+
+
+def find_surplus_instances(
+    spec: ServiceSpec, state_store: StateStore
+) -> List[Tuple[str, int, List[str]]]:
+    """(pod_type, index, task full-names) for every stored pod instance
+    the target spec no longer covers, highest indices first."""
+    by_instance: Dict[Tuple[str, int], List[TaskInfo]] = {}
+    for info in state_store.fetch_tasks():
+        by_instance.setdefault((info.pod_type, info.pod_index), []).append(info)
+    known_pods = {p.type: p for p in spec.pods}
+    surplus = []
+    for (pod_type, index), infos in by_instance.items():
+        pod = known_pods.get(pod_type)
+        if pod is not None and index < pod.count:
+            continue
+        surplus.append((pod_type, index, sorted(i.name for i in infos)))
+    surplus.sort(key=lambda s: (s[0], -s[1]))
+    return surplus
+
+
+class DecommissionPlanFactory:
+    def build(
+        self, spec: ServiceSpec, state_store: StateStore
+    ) -> Plan:
+        # kill grace periods come from the current spec; tasks of a pod
+        # type the spec dropped entirely fall back to immediate kill
+        grace_by_task: Dict[str, float] = {}
+        for pod in spec.pods:
+            for task_spec in pod.tasks:
+                grace_by_task[task_spec.name] = task_spec.kill_grace_period_s
+        phases = []
+        for pod_type, index, task_names in find_surplus_instances(
+            spec, state_store
+        ):
+            phases.append(
+                self._build_phase(pod_type, index, task_names, grace_by_task)
+            )
+        return Plan(DECOMMISSION_PLAN_NAME, phases, SerialStrategy())
+
+    def _build_phase(
+        self,
+        pod_type: str,
+        index: int,
+        task_names: List[str],
+        grace_by_task: Dict[str, float],
+    ) -> Phase:
+        instance = pod_instance_name(pod_type, index)
+        asset = {instance}
+
+        def kill_tasks(scheduler) -> bool:
+            """TriggerDecommissionStep + kill: issue graceful kills,
+            done when every task is terminally stopped."""
+            all_done = True
+            for name in task_names:
+                info = scheduler.state_store.fetch_task(name)
+                if info is None:
+                    continue
+                status = scheduler.state_store.fetch_status(name)
+                if status is not None and status.state.is_terminal:
+                    continue
+                grace = grace_by_task.get(name.rsplit("-", 1)[-1], 0.0)
+                scheduler.task_killer.kill(info.task_id, grace)
+                all_done = False
+            return all_done
+
+        def unreserve(scheduler) -> bool:
+            for name in task_names:
+                for reservation in scheduler.ledger.for_task(name):
+                    scheduler.ledger.release(reservation.reservation_id)
+                    scheduler.metrics.incr("operations.unreserve")
+            return True
+
+        def erase(scheduler) -> bool:
+            for name in task_names:
+                scheduler.state_store.clear_task(name)
+            return True
+
+        return Phase(
+            f"decommission-{instance}",
+            [
+                ActionStep(f"kill-{instance}", kill_tasks, assets=asset),
+                ActionStep(f"unreserve-{instance}", unreserve, assets=asset),
+                ActionStep(f"erase-{instance}", erase, assets=asset),
+            ],
+            SerialStrategy(),
+        )

@@ -89,6 +89,82 @@ class Step(Element):
         return set()
 
 
+class ActionStep(Step):
+    """A scheduler-side action instead of a pod launch.
+
+    Reference: the uninstall/decommission step families —
+    scheduler/uninstall/ResourceCleanupStep.java, DeregisterStep.java,
+    scheduler/decommission/TriggerDecommissionStep.java,
+    EraseTaskStateStep.java — steps whose work is performed by the
+    scheduler itself against its stores/agent.  ``action(scheduler)``
+    returns True when the work is done; False keeps the step pending
+    for the next cycle (e.g. waiting for kill acknowledgements).
+    """
+
+    def __init__(self, name: str, action, assets=None):
+        super().__init__(name)
+        self._action = action
+        self._assets = set(assets or ())
+        self._status = Status.PENDING
+        self._interrupted = False
+
+    def start(self) -> Optional[PodInstanceRequirement]:
+        return None  # nothing for the offer evaluator
+
+    def execute(self, scheduler) -> None:
+        with self._lock:
+            if self._status.is_complete or self._interrupted:
+                return
+            try:
+                done = self._action(scheduler)
+            except Exception as e:
+                # transient failures retry next cycle: replace (don't
+                # accumulate) the error, and let a later success clear
+                # it so the step isn't wedged at ERROR forever
+                self.errors[:] = [f"{self.name}: {e}"]
+                return
+            self.errors.clear()
+            self._status = Status.COMPLETE if done else Status.PENDING
+
+    def update_offer_status(self, launched: bool) -> None:
+        pass
+
+    def update(self, status: TaskStatus) -> None:
+        pass  # progress is re-checked by execute() each cycle
+
+    def get_status(self) -> Status:
+        with self._lock:
+            if self.has_errors():
+                return Status.ERROR
+            if self._interrupted and not self._status.is_complete:
+                return Status.WAITING
+            return self._status
+
+    def interrupt(self) -> None:
+        with self._lock:
+            self._interrupted = True
+
+    def proceed(self) -> None:
+        with self._lock:
+            self._interrupted = False
+
+    def is_interrupted(self) -> bool:
+        return self._interrupted
+
+    def restart(self) -> None:
+        with self._lock:
+            self._status = Status.PENDING
+            self.errors.clear()
+
+    def force_complete(self) -> None:
+        with self._lock:
+            self._status = Status.COMPLETE
+            self.errors.clear()
+
+    def get_asset_names(self) -> Set[str]:
+        return set(self._assets)
+
+
 class DeploymentStep(Step):
     """Launch one pod instance (or one gang) and drive it to goal.
 

@@ -117,6 +117,31 @@ class SchedulerBuilder:
         config_store = ConfigStore(persister, self._namespace)
         ledger = ReservationLedger(persister, self._namespace)
 
+        if self._config.uninstall:
+            # SDK_UNINSTALL set: tear down instead of deploying
+            # (reference: SchedulerBuilder.build returning
+            # UninstallScheduler).  Over already-wiped state every
+            # phase is trivially complete = the skeleton scheduler.
+            from dcos_commons_tpu.state.framework_store import FrameworkStore
+            from dcos_commons_tpu.uninstall import UninstallScheduler
+
+            inventory = self._inventory or SliceInventory()
+            agent = self._agent
+            if agent is None:
+                from dcos_commons_tpu.agent.local import LocalProcessAgent
+
+                agent = LocalProcessAgent(self._config.sandbox_root)
+            return UninstallScheduler(
+                spec=self._spec,
+                state_store=state_store,
+                ledger=ledger,
+                inventory=inventory,
+                agent=agent,
+                persister=persister,
+                config_store=config_store,
+                framework_store=FrameworkStore(persister),
+            )
+
         target_id, config_errors = self._update_configuration(
             state_store, config_store
         )
@@ -176,6 +201,21 @@ class SchedulerBuilder:
 
             agent = LocalProcessAgent(self._config.sandbox_root)
 
+        # scale-down: stored pod instances the target spec no longer
+        # covers get a decommission plan (kill -> unreserve -> erase)
+        from dcos_commons_tpu.decommission import DecommissionPlanFactory
+
+        other_managers: List = []
+        decommission_plan = DecommissionPlanFactory().build(
+            target_spec, state_store
+        )
+        if decommission_plan.phases:
+            if self._plan_customizer is not None:
+                decommission_plan = self._plan_customizer(
+                    decommission_plan
+                ) or decommission_plan
+            other_managers.append(DefaultPlanManager(decommission_plan))
+
         from dcos_commons_tpu.state.framework_store import FrameworkStore
 
         return DefaultScheduler(
@@ -187,6 +227,7 @@ class SchedulerBuilder:
             evaluator=evaluator,
             deploy_manager=deploy_manager,
             recovery_manager=recovery_manager,
+            other_managers=other_managers,
             config_store=config_store,
             framework_store=FrameworkStore(persister),
         )

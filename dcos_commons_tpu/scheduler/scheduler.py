@@ -34,7 +34,7 @@ from dcos_commons_tpu.offer.ledger import ReservationLedger
 from dcos_commons_tpu.plan.coordinator import DefaultPlanCoordinator
 from dcos_commons_tpu.plan.plan import DEPLOY_PLAN_NAME, Plan
 from dcos_commons_tpu.plan.plan_manager import DefaultPlanManager, PlanManager
-from dcos_commons_tpu.plan.step import DeploymentStep
+from dcos_commons_tpu.plan.step import ActionStep, DeploymentStep
 from dcos_commons_tpu.recovery.manager import DefaultRecoveryPlanManager
 from dcos_commons_tpu.runtime.reconciler import Reconciler
 from dcos_commons_tpu.runtime.task_killer import TaskKiller
@@ -175,6 +175,10 @@ class DefaultScheduler:
             self._suppressed = False
             self.metrics.incr("revives")
         for step in candidates:
+            if isinstance(step, ActionStep):
+                # scheduler-side work (decommission/uninstall/custom)
+                step.execute(self)
+                continue
             if not isinstance(step, DeploymentStep):
                 continue
             requirement = step.start()

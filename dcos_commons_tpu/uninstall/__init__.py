@@ -1,0 +1,20 @@
+"""Uninstall: full service teardown as a plan.
+
+Reference: scheduler/uninstall/ — UninstallScheduler (291 LoC),
+UninstallPlanFactory (phases: kill tasks -> unreserve resources ->
+deregister), UninstallRecorder write-ahead of dereservations,
+skeleton scheduler when already uninstalled
+(framework/FrameworkRunner.java:99-115,214-238).
+"""
+
+from dcos_commons_tpu.uninstall.scheduler import (
+    UNINSTALL_PLAN_NAME,
+    UninstallPlanFactory,
+    UninstallScheduler,
+)
+
+__all__ = [
+    "UNINSTALL_PLAN_NAME",
+    "UninstallPlanFactory",
+    "UninstallScheduler",
+]

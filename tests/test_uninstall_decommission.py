@@ -1,0 +1,167 @@
+"""Decommission (scale-down) and uninstall (full teardown) plans.
+
+Reference: scheduler/decommission/DecommissionPlanFactory.java (kill ->
+unreserve -> erase per surplus instance), scheduler/uninstall/
+UninstallScheduler.java (kill -> unreserve -> deregister, state wipe,
+skeleton on restart).
+"""
+
+from dcos_commons_tpu.plan.status import Status
+from dcos_commons_tpu.scheduler import SchedulerBuilder, SchedulerConfig
+from dcos_commons_tpu.specification.yaml_spec import from_yaml
+from dcos_commons_tpu.testing import (
+    AdvanceCycles,
+    ExpectDeploymentComplete,
+    SendTaskRunning,
+    ServiceTestRunner,
+)
+
+THREE_POD_YAML = """
+name: shrink-svc
+pods:
+  web:
+    count: 3
+    allow-decommission: true
+    tasks:
+      srv:
+        goal: RUNNING
+        cmd: "serve"
+        cpus: 0.1
+        memory: 32
+"""
+
+
+def deploy_three():
+    runner = ServiceTestRunner(THREE_POD_YAML)
+    runner.run([
+        AdvanceCycles(1),
+        SendTaskRunning("web-0-srv"),
+        AdvanceCycles(1),
+        SendTaskRunning("web-1-srv"),
+        AdvanceCycles(1),
+        SendTaskRunning("web-2-srv"),
+        ExpectDeploymentComplete(),
+    ])
+    return runner
+
+
+def test_scale_down_builds_decommission_plan():
+    runner = deploy_three()
+    assert len(runner.world.scheduler.ledger.all()) == 3
+
+    shrunk = ServiceTestRunner(
+        THREE_POD_YAML.replace("count: 3", "count: 2"),
+        persister=runner.persister,
+        hosts=runner.hosts,
+    )
+    shrunk.agent = runner.agent
+    shrunk.inventory = runner.inventory
+    world = shrunk.build()
+    plan = world.scheduler.plan("decommission")
+    assert plan is not None
+    assert [p.name for p in plan.phases] == ["decommission-web-2"]
+
+    # the count change is a config update: the surviving pods roll
+    # to the new target config while web-2 decommissions
+    shrunk.run([
+        AdvanceCycles(2),
+        SendTaskRunning("web-0-srv"),
+        AdvanceCycles(2),
+        SendTaskRunning("web-1-srv"),
+        AdvanceCycles(4),
+    ])
+    # task killed (FakeAgent auto-acks), state erased, footprint freed
+    assert plan.is_complete, [
+        (s.name, s.get_status().value) for p in plan.phases for s in p.steps
+    ]
+    assert "web-2-srv" in shrunk.agent.killed_names()
+    assert world.state_store.fetch_task("web-2-srv") is None
+    assert len(world.scheduler.ledger.all()) == 2
+    # surviving pods untouched, deploy (update plan) stays complete
+    assert world.state_store.fetch_task("web-0-srv") is not None
+    assert world.scheduler.deploy_manager.get_plan().is_complete
+
+
+def test_removed_pod_type_decommissions_all_instances():
+    runner = deploy_three()
+    no_web = """
+name: shrink-svc
+pods:
+  other:
+    count: 1
+    tasks:
+      one:
+        goal: RUNNING
+        cmd: "run"
+        cpus: 0.1
+        memory: 32
+"""
+    replaced = ServiceTestRunner(
+        no_web, persister=runner.persister, hosts=runner.hosts
+    )
+    replaced.agent = runner.agent
+    replaced.inventory = runner.inventory
+    world = replaced.build()
+    plan = world.scheduler.plan("decommission")
+    assert [p.name for p in plan.phases] == [
+        "decommission-web-2", "decommission-web-1", "decommission-web-0",
+    ]
+    replaced.run([
+        AdvanceCycles(14),
+        SendTaskRunning("other-0-one"),
+    ])
+    assert plan.is_complete
+    assert world.scheduler.ledger.for_task("web-0-srv") == []
+    assert world.state_store.fetch_task("other-0-one") is not None
+
+
+def test_uninstall_tears_everything_down():
+    runner = deploy_three()
+    config = SchedulerConfig(backoff_enabled=False, uninstall=True)
+    uninstaller = ServiceTestRunner(
+        THREE_POD_YAML,
+        persister=runner.persister,
+        hosts=runner.hosts,
+        scheduler_config=config,
+    )
+    uninstaller.agent = runner.agent
+    uninstaller.inventory = runner.inventory
+    world = uninstaller.build()
+    plan = world.scheduler.plan("uninstall")
+    assert plan is not None and not plan.is_complete
+
+    uninstaller.run([AdvanceCycles(4)])
+    assert world.scheduler.is_complete, [
+        (s.name, s.get_status().value) for p in plan.phases for s in p.steps
+    ]
+    # tasks killed, reservations gone, framework id cleared, state wiped
+    assert set(runner.agent.killed_names()) == {
+        "web-0-srv", "web-1-srv", "web-2-srv"
+    }
+    assert world.scheduler.ledger.all() == []
+    assert world.scheduler.framework_store.fetch_framework_id() is None
+    assert runner.persister.get_children_or_empty("/") == []
+    # the uninstall plan serves as "deploy" for package-manager polling
+    assert world.scheduler.plan("deploy").is_complete
+
+
+def test_skeleton_scheduler_after_wipe():
+    """Restarting an uninstalled service yields an immediately-complete
+    uninstall plan (reference: skeleton scheduler)."""
+    runner = deploy_three()
+    config = SchedulerConfig(backoff_enabled=False, uninstall=True)
+    first = ServiceTestRunner(
+        THREE_POD_YAML, persister=runner.persister, hosts=runner.hosts,
+        scheduler_config=config,
+    )
+    first.agent = runner.agent
+    first.inventory = runner.inventory
+    first.build()
+    first.run([AdvanceCycles(4)])
+    assert first.world.scheduler.is_complete
+
+    second = first.restart()
+    world = second.build()
+    second.run([AdvanceCycles(3)])
+    assert world.scheduler.is_complete
+    assert world.scheduler.plan("deploy").get_status() is Status.COMPLETE
