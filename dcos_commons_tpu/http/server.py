@@ -104,11 +104,17 @@ def _one(query: dict, key: str) -> Optional[str]:
 
 class ApiServer:
     """Reference: framework/ApiServer.java — started before the event
-    loop accepts work; ``port=0`` binds an ephemeral port (tests)."""
+    loop accepts work; ``port=0`` binds an ephemeral port (tests).
 
-    def __init__(self, scheduler, port: int = 0, host: str = "127.0.0.1"):
-        api = SchedulerApi(scheduler)
-        routes = build_routes(api)
+    Multi-service mode (``multi=``): /v1/multi lists/adds/removes
+    services, and /v1/multi/<name>/v1/... routes any single-service
+    path to that service (reference: http/endpoints/Multi*.java route
+    per-service by name)."""
+
+    def __init__(self, scheduler=None, port: int = 0, host: str = "127.0.0.1",
+                 multi=None):
+        routes = build_routes(SchedulerApi(scheduler)) if scheduler else []
+        multi_scheduler = multi
 
         class Handler(BaseHTTPRequestHandler):
             # quiet request logging (structured logs belong to the app)
@@ -118,6 +124,13 @@ class ApiServer:
             def _dispatch(self, method: str) -> None:
                 parsed = urlparse(self.path)
                 query = parse_qs(parsed.query)
+                if multi_scheduler is not None and \
+                        parsed.path.startswith("/v1/multi"):
+                    code, body = self._dispatch_multi(
+                        method, parsed.path, query
+                    )
+                    self._reply(code, body)
+                    return
                 for route_method, pattern, handler in routes:
                     if route_method != method:
                         continue
@@ -131,6 +144,56 @@ class ApiServer:
                     self._reply(code, body)
                     return
                 self._reply(404, {"message": f"no route {method} {parsed.path}"})
+
+            def _dispatch_multi(self, method: str, path: str, query):
+                rest = path[len("/v1/multi"):].strip("/")
+                if not rest:
+                    if method == "GET":
+                        return 200, multi_scheduler.service_names()
+                    return 405, {"message": "use GET /v1/multi"}
+                name, _, sub = rest.partition("/")
+                if method == "PUT" and not sub:
+                    # body: service YAML (reference: dynamic add via
+                    # MultiServiceResource / ServiceStore)
+                    length = int(self.headers.get("Content-Length", 0))
+                    raw = self.rfile.read(length).decode("utf-8")
+                    from dcos_commons_tpu.specification.yaml_spec import (
+                        from_yaml,
+                    )
+
+                    try:
+                        spec = from_yaml(raw)
+                        if spec.name != name:
+                            return 400, {
+                                "message": f"spec name {spec.name!r} does "
+                                           f"not match URL {name!r}"
+                            }
+                        multi_scheduler.add_service(spec)
+                    except Exception as e:
+                        return 400, {"message": str(e)}
+                    return 200, {"message": f"service {name} added"}
+                if method == "DELETE" and not sub:
+                    try:
+                        multi_scheduler.uninstall_service(name)
+                    except KeyError:
+                        return 404, {"message": f"no service {name}"}
+                    return 200, {"message": f"service {name} uninstalling"}
+                service = multi_scheduler.get_service(name)
+                if service is None:
+                    return 404, {"message": f"no service {name}"}
+                sub_path = f"/{sub}" if sub.startswith("v1") else f"/v1/{sub}"
+                sub_routes = build_routes(SchedulerApi(service))
+                for route_method, pattern, handler in sub_routes:
+                    if route_method != method:
+                        continue
+                    match = pattern.match(sub_path)
+                    if match is None:
+                        continue
+                    try:
+                        return handler(match, query)
+                    except Exception as e:
+                        return 500, {"message": f"internal error: {e}"}
+                return 404, {"message": f"no route {method} {sub_path}"}
 
             def _reply(self, code: int, body) -> None:
                 if isinstance(body, str):
@@ -150,6 +213,12 @@ class ApiServer:
 
             def do_POST(self):
                 self._dispatch("POST")
+
+            def do_PUT(self):
+                self._dispatch("PUT")
+
+            def do_DELETE(self):
+                self._dispatch("DELETE")
 
         self._server = ThreadingHTTPServer((host, port), Handler)
         self._thread: Optional[threading.Thread] = None

@@ -1,0 +1,24 @@
+"""Multi-service: one framework hosting N services.
+
+Reference: scheduler/multi/ — MultiServiceEventClient (fan-out of
+offers/statuses to per-service clients, auto-uninstall of removed
+services, MultiServiceEventClient.java:48,169-290),
+MultiServiceManager (add/remove/lookup), ServiceStore (persisted specs
+for dynamic add via HTTP), OfferDiscipline/ParallelFootprintDiscipline
+(bound how many services grow footprint at once,
+OfferDiscipline.java:11-33), MultiServiceRunner.
+"""
+
+from dcos_commons_tpu.multi.discipline import (
+    AnyFootprintDiscipline,
+    ParallelFootprintDiscipline,
+)
+from dcos_commons_tpu.multi.scheduler import MultiServiceScheduler
+from dcos_commons_tpu.multi.store import ServiceStore
+
+__all__ = [
+    "AnyFootprintDiscipline",
+    "ParallelFootprintDiscipline",
+    "MultiServiceScheduler",
+    "ServiceStore",
+]

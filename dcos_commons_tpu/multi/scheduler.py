@@ -1,0 +1,275 @@
+"""MultiServiceScheduler: fan-out event loop over N services.
+
+Reference: scheduler/multi/MultiServiceEventClient.java:48 (offer/
+status fan-out, auto-uninstall and removal of finished clients) +
+MultiServiceManager.java (add/remove/lookup) + MultiServiceRunner.
+Each service gets namespaced stores inside the shared persister and
+competes for the shared slice inventory through its own evaluator;
+the reservation ledgers are namespaced too, so the inventory view
+subtracts every service's claims (snapshots take a merged ledger).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Dict, List, Optional
+
+from dcos_commons_tpu.agent.base import Agent
+from dcos_commons_tpu.multi.discipline import AnyFootprintDiscipline
+from dcos_commons_tpu.multi.store import ServiceStore
+from dcos_commons_tpu.offer.inventory import SliceInventory
+from dcos_commons_tpu.scheduler.builder import SchedulerBuilder
+from dcos_commons_tpu.scheduler.config import SchedulerConfig
+from dcos_commons_tpu.scheduler.scheduler import DefaultScheduler
+from dcos_commons_tpu.specification.specs import ServiceSpec
+from dcos_commons_tpu.state.framework_store import FrameworkStore
+from dcos_commons_tpu.state.state_store import StateStore
+from dcos_commons_tpu.storage import Persister
+from dcos_commons_tpu.uninstall import UninstallScheduler
+
+LOG = logging.getLogger(__name__)
+
+
+class _ServiceAgentAdapter:
+    """Per-service view of the shared agent.
+
+    The shared agent's poll() drains its queue, so the multi scheduler
+    polls ONCE per cycle and routes each status to the owning service
+    (reference: MultiServiceEventClient.taskStatus fan-out,
+    MultiServiceEventClient.java:169-290).  Launch/kill pass through.
+    """
+
+    def __init__(self, agent: Agent):
+        self._agent = agent
+        self._queue: List = []
+
+    def launch(self, task_infos):
+        self._agent.launch(task_infos)
+
+    def launch_one(self, info, readiness=None, health=None):
+        launch_one = getattr(self._agent, "launch_one", None)
+        if launch_one is not None:
+            launch_one(info, readiness=readiness, health=health)
+        else:
+            self._agent.launch([info])
+
+    def kill(self, task_id, grace_period_s=0.0):
+        self._agent.kill(task_id, grace_period_s)
+
+    def active_task_ids(self):
+        return self._agent.active_task_ids()
+
+    def poll(self):
+        out = list(self._queue)
+        self._queue.clear()
+        return out
+
+    def deliver(self, status) -> None:
+        self._queue.append(status)
+
+
+class _MergedLedgerView:
+    """Union view over every service's reservation ledger, handed to
+    SliceInventory.snapshots so one service's free-capacity view
+    excludes every other service's claims."""
+
+    def __init__(self, multi: "MultiServiceScheduler"):
+        self._multi = multi
+
+    def reserved_on(self, host_id: str):
+        out = []
+        for service in self._multi.services().values():
+            out.extend(service.ledger.reserved_on(host_id))
+        return out
+
+
+class MultiServiceScheduler:
+    def __init__(
+        self,
+        persister: Persister,
+        inventory: SliceInventory,
+        agent: Agent,
+        scheduler_config: Optional[SchedulerConfig] = None,
+        discipline=None,
+        builder_hook: Optional[Callable[[SchedulerBuilder], None]] = None,
+    ):
+        self.persister = persister
+        self.inventory = inventory
+        self.agent = agent
+        self.config = scheduler_config or SchedulerConfig()
+        self.discipline = discipline or AnyFootprintDiscipline()
+        self.service_store = ServiceStore(persister)
+        self.framework_store = FrameworkStore(persister)
+        self._builder_hook = builder_hook
+        self._services: Dict[str, object] = {}  # name -> scheduler
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._reload()
+
+    # -- add/remove/lookup (reference: MultiServiceManager) -----------
+
+    def _reload(self) -> None:
+        """Restart resume: rebuild every persisted service, including
+        those mid-uninstall."""
+        for name in self.service_store.list_names():
+            entry = self.service_store.fetch(name)
+            if entry is None:
+                continue
+            spec = ServiceSpec.from_dict(entry["spec"])
+            if entry.get("uninstalling"):
+                self._services[name] = self._make_uninstaller(spec)
+            else:
+                self._services[name] = self._build(spec)
+
+    def add_service(self, spec: ServiceSpec) -> None:
+        with self._lock:
+            if spec.name in self._services:
+                raise ValueError(f"service {spec.name!r} already exists")
+            self.service_store.store(spec.name, spec.to_dict())
+            self._services[spec.name] = self._build(spec)
+
+    def uninstall_service(self, name: str) -> None:
+        """Flip the service to teardown; it is dropped from the set
+        once its uninstall plan completes (reference: uninstall flag +
+        client removal, MultiServiceEventClient.java:169-290)."""
+        with self._lock:
+            service = self._services.get(name)
+            if service is None:
+                raise KeyError(name)
+            if isinstance(service, UninstallScheduler):
+                return
+            entry = self.service_store.fetch(name)
+            self.service_store.store(name, entry["spec"], uninstalling=True)
+            self._services[name] = self._make_uninstaller(
+                ServiceSpec.from_dict(entry["spec"])
+            )
+
+    def get_service(self, name: str):
+        with self._lock:
+            return self._services.get(name)
+
+    def services(self) -> Dict[str, object]:
+        with self._lock:
+            return dict(self._services)
+
+    def service_names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._services)
+
+    # -- construction -------------------------------------------------
+
+    def _build(self, spec: ServiceSpec) -> DefaultScheduler:
+        import dataclasses
+
+        config = dataclasses.replace(
+            self.config, service_namespace=spec.name, uninstall=False
+        )
+        builder = SchedulerBuilder(spec, config, self.persister)
+        builder.set_inventory(self.inventory)
+        builder.set_agent(_ServiceAgentAdapter(self.agent))
+        if self._builder_hook is not None:
+            self._builder_hook(builder)
+        scheduler = builder.build()
+        # snapshots must subtract EVERY service's reservations, not
+        # just this service's own namespaced ledger
+        scheduler.evaluator.set_snapshot_view(_MergedLedgerView(self))
+        return scheduler
+
+    def _make_uninstaller(self, spec: ServiceSpec) -> UninstallScheduler:
+        from dcos_commons_tpu.offer.ledger import ReservationLedger
+        from dcos_commons_tpu.state.config_store import ConfigStore
+
+        return UninstallScheduler(
+            spec=spec,
+            state_store=StateStore(self.persister, spec.name),
+            ledger=ReservationLedger(self.persister, spec.name),
+            inventory=self.inventory,
+            agent=_ServiceAgentAdapter(self.agent),
+            persister=self.persister,
+            config_store=ConfigStore(self.persister, spec.name),
+            framework_store=self.framework_store,
+            namespace=spec.name,
+            deregister=False,
+        )
+
+    # -- the loop (reference: MultiServiceEventClient fan-out) --------
+
+    def run_cycle(self) -> None:
+        with self._lock:
+            services = dict(self._services)
+            self._route_statuses(services)
+            growing = [
+                name
+                for name, s in services.items()
+                if isinstance(s, DefaultScheduler) and self._is_growing(s)
+            ]
+            selected = self.discipline.select(growing)
+            for name, service in services.items():
+                try:
+                    if isinstance(service, DefaultScheduler):
+                        service.run_cycle(
+                            allow_footprint_growth=(
+                                name in selected or name not in growing
+                            )
+                        )
+                    else:
+                        service.run_cycle()
+                except Exception:
+                    LOG.exception("service %s cycle failed", name)
+            # drop services whose uninstall finished
+            for name, service in services.items():
+                if isinstance(service, UninstallScheduler) and \
+                        service.is_complete:
+                    self.service_store.remove(name)
+                    del self._services[name]
+                    LOG.info("service %s uninstalled and removed", name)
+
+    def _route_statuses(self, services: Dict[str, object]) -> None:
+        """Poll the shared agent once and deliver each status to the
+        service whose stored TaskInfo owns the task id; unroutable
+        statuses go to every service (their stale guards drop them)."""
+        from dcos_commons_tpu.common import task_name_of
+
+        for status in self.agent.poll():
+            routed = False
+            for service in services.values():
+                try:
+                    task_name = task_name_of(status.task_id)
+                except ValueError:
+                    continue
+                info = service.state_store.fetch_task(task_name)
+                if info is not None and info.task_id == status.task_id:
+                    service.agent.deliver(status)
+                    routed = True
+                    break
+            if not routed:
+                for service in services.values():
+                    service.agent.deliver(status)
+
+    @staticmethod
+    def _is_growing(scheduler: DefaultScheduler) -> bool:
+        """A service 'grows' while any plan that can take new
+        reservations is incomplete."""
+        for plan in scheduler.plans().values():
+            if plan.name == "recovery":
+                continue
+            if not plan.is_complete and not plan.has_errors():
+                return True
+        return False
+
+    def run_forever(self, interval_s: float = 0.5) -> threading.Thread:
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    self.run_cycle()
+                except Exception:
+                    LOG.exception("multi cycle failed")
+                self._stop.wait(interval_s)
+
+        thread = threading.Thread(target=loop, name="multi-loop", daemon=True)
+        thread.start()
+        return thread
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -1,0 +1,44 @@
+"""ServiceStore: persisted specs of dynamically added services.
+
+Reference: scheduler/multi/ServiceStore.java + ServiceFactory — raw
+spec payloads stored per service name so a restarted multi-scheduler
+re-creates every service, including ones mid-uninstall.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+from dcos_commons_tpu.storage import Persister, PersisterError
+from dcos_commons_tpu.storage.persister import validate_key
+
+ROOT = "/multi/services"
+
+
+class ServiceStore:
+    def __init__(self, persister: Persister):
+        self._persister = persister
+
+    def _path(self, name: str) -> str:
+        validate_key(name, "service name")
+        return f"{ROOT}/{name}"
+
+    def store(self, name: str, spec_dict: dict, uninstalling: bool = False) -> None:
+        payload = json.dumps(
+            {"spec": spec_dict, "uninstalling": uninstalling}, sort_keys=True
+        ).encode("utf-8")
+        self._persister.set(self._path(name), payload)
+
+    def fetch(self, name: str) -> Optional[dict]:
+        raw = self._persister.get_or_none(self._path(name))
+        return json.loads(raw.decode("utf-8")) if raw is not None else None
+
+    def list_names(self) -> List[str]:
+        return sorted(self._persister.get_children_or_empty(ROOT))
+
+    def remove(self, name: str) -> None:
+        try:
+            self._persister.recursive_delete(self._path(name))
+        except PersisterError:
+            pass

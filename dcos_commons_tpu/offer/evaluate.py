@@ -98,9 +98,17 @@ class OfferEvaluator:
         self._ledger = ledger
         self._service_name = service_name
         self._target_config_id = target_config_id
+        # multi-service: free-capacity snapshots must subtract EVERY
+        # service's claims, not just this service's namespaced ledger
+        # (reference: one Mesos master arbitrates all frameworks; here
+        # the merged ledger view is the arbiter)
+        self._snapshot_view = ledger
 
     def set_target_config(self, config_id: str) -> None:
         self._target_config_id = config_id
+
+    def set_snapshot_view(self, view) -> None:
+        self._snapshot_view = view
 
     # ------------------------------------------------------------------
 
@@ -110,7 +118,7 @@ class OfferEvaluator:
         inventory: SliceInventory,
     ) -> EvaluationResult:
         """Match one requirement against the current inventory."""
-        snapshots = inventory.snapshots(self._ledger)
+        snapshots = inventory.snapshots(self._snapshot_view)
         ctx = PlacementContext(
             pod_type=requirement.pod.type,
             existing_tasks=[

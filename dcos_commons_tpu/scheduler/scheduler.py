@@ -95,14 +95,19 @@ class DefaultScheduler:
 
     # -- the loop -----------------------------------------------------
 
-    def run_cycle(self) -> None:
+    def run_cycle(self, allow_footprint_growth: bool = True) -> None:
+        """One pass of the event loop.  ``allow_footprint_growth=False``
+        is the multi-service offer discipline: status intake, kills, GC
+        and in-place relaunches proceed, but no NEW reservations are
+        taken (reference: OfferDiscipline/ParallelFootprintDiscipline,
+        scheduler/multi/OfferDiscipline.java:11-33)."""
         with self._lock:
             self._intake_statuses()
             if not self.reconciler.is_reconciled:
                 for status in self.reconciler.reconcile():
                     self._process_status(status)
                 self.metrics.incr("reconciles")
-            self._process_candidates()
+            self._process_candidates(allow_footprint_growth)
             self._gc_reservations()
             self.task_killer.retry_pending()
             # first full deployment done: scheduler restarts now build
@@ -164,7 +169,7 @@ class DefaultScheduler:
 
     # -- candidates -> launches ---------------------------------------
 
-    def _process_candidates(self) -> None:
+    def _process_candidates(self, allow_footprint_growth: bool = True) -> None:
         candidates = self.coordinator.get_candidates()
         if not candidates:
             if not self._suppressed:
@@ -184,6 +189,9 @@ class DefaultScheduler:
             requirement = step.start()
             if requirement is None:
                 continue
+            if not allow_footprint_growth and \
+                    not self._has_full_footprint(requirement):
+                continue  # needs new reservations: wait for selection
             result = self.evaluator.evaluate(requirement, self.inventory)
             self.outcome_tracker.record(requirement.name, result.outcome)
             self.metrics.incr("offers.evaluated")
@@ -207,6 +215,13 @@ class DefaultScheduler:
             step.record_launch({t.name: t.task_id for t in result.task_infos})
             self._launch(result.task_infos, requirement)
             self.metrics.incr("operations.launch", len(result.task_infos))
+
+    def _has_full_footprint(self, requirement) -> bool:
+        """True when every task of the requirement already holds
+        committed reservations (an in-place relaunch, not growth)."""
+        return all(
+            self.ledger.for_task(name) for name in requirement.task_names()
+        )
 
     def _kill_previous_launches(self, task_infos) -> None:
         """A relaunch of task name N must kill N's previous process

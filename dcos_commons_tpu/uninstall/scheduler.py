@@ -62,7 +62,7 @@ class UninstallPlanFactory:
             """DeregisterStep: drop the framework identity and wipe all
             persisted state (reference: FrameworkID cleared + ZK wiped,
             FrameworkRunner.java:147-155, PersisterUtils.clearAllData)."""
-            if scheduler.framework_store is not None:
+            if scheduler._deregister and scheduler.framework_store is not None:
                 scheduler.framework_store.clear_framework_id()
             scheduler.wipe_state()
             return True
@@ -97,7 +97,15 @@ class UninstallScheduler:
         config_store=None,
         framework_store=None,
         metrics: Optional[Metrics] = None,
+        namespace: str = "",
+        deregister: bool = True,
     ):
+        # multi-service removal tears down ONE namespaced service: it
+        # wipes only its subtree and must not drop the shared framework
+        # identity (reference: MultiServiceEventClient uninstall-and-
+        # remove flow vs whole-framework uninstall)
+        self._namespace = namespace
+        self._deregister = deregister
         self.spec = spec
         self.state_store = state_store
         self.ledger = ledger
@@ -168,14 +176,24 @@ class UninstallScheduler:
             manager.update(status)
 
     def wipe_state(self) -> None:
-        """Delete every persisted node of this service."""
+        """Delete every persisted node of this service (the whole tree
+        for a standalone service, only the namespace subtree in
+        multi-service mode)."""
         from dcos_commons_tpu.storage import PersisterError
+        from dcos_commons_tpu.storage.persister import namespace_root
 
-        for child in self.persister.get_children_or_empty("/"):
+        root = namespace_root(self._namespace)
+        if root:
             try:
-                self.persister.recursive_delete(f"/{child}")
+                self.persister.recursive_delete(root)
             except PersisterError:
                 pass
+        else:
+            for child in self.persister.get_children_or_empty("/"):
+                try:
+                    self.persister.recursive_delete(f"/{child}")
+                except PersisterError:
+                    pass
         self._wiped = True
 
     # -- API surface --------------------------------------------------

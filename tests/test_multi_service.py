@@ -1,0 +1,169 @@
+"""Multi-service mode: N services in one framework.
+
+Reference: scheduler/multi/ — fan-out, namespaced state, footprint
+discipline, dynamic add/remove over HTTP, restart resume from the
+ServiceStore.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+from dcos_commons_tpu.common import TaskState, TaskStatus
+from dcos_commons_tpu.http import ApiServer
+from dcos_commons_tpu.multi import (
+    MultiServiceScheduler,
+    ParallelFootprintDiscipline,
+)
+from dcos_commons_tpu.offer.inventory import SliceInventory, TpuHost
+from dcos_commons_tpu.scheduler import SchedulerConfig
+from dcos_commons_tpu.specification.yaml_spec import from_yaml
+from dcos_commons_tpu.storage import MemPersister
+from dcos_commons_tpu.testing import FakeAgent
+
+
+def svc_yaml(name, count=1):
+    return f"""
+name: {name}
+pods:
+  app:
+    count: {count}
+    tasks:
+      main:
+        goal: RUNNING
+        cmd: "serve-{name}"
+        cpus: 0.1
+        memory: 32
+"""
+
+
+def make_multi(persister=None, agent=None, discipline=None, hosts=3):
+    return MultiServiceScheduler(
+        persister=persister or MemPersister(),
+        inventory=SliceInventory(
+            [TpuHost(host_id=f"h{i}") for i in range(hosts)]
+        ),
+        agent=agent or FakeAgent(),
+        scheduler_config=SchedulerConfig(backoff_enabled=False),
+        discipline=discipline,
+    )
+
+
+def ack_running(multi, task_name):
+    task_id = multi.agent.task_id_of(task_name)
+    assert task_id, f"no launch for {task_name}"
+    multi.agent.send(TaskStatus(task_id=task_id, state=TaskState.RUNNING,
+                                ready=True))
+
+
+def test_two_services_share_fleet_with_namespaced_state():
+    multi = make_multi()
+    multi.add_service(from_yaml(svc_yaml("alpha")))
+    multi.add_service(from_yaml(svc_yaml("beta")))
+    multi.run_cycle()
+    ack_running(multi, "app-0-main")  # alpha's launch
+    # both services deploy a pod named app-0-main — namespaced state
+    # keeps them separate
+    multi.run_cycle()
+    alpha = multi.get_service("alpha")
+    beta = multi.get_service("beta")
+    for _ in range(4):
+        for info in multi.agent.launched:
+            multi.agent.send(TaskStatus(task_id=info.task_id,
+                                        state=TaskState.RUNNING, ready=True))
+        multi.run_cycle()
+    assert alpha.deploy_manager.get_plan().is_complete
+    assert beta.deploy_manager.get_plan().is_complete
+    assert alpha.state_store.fetch_task("app-0-main") is not None
+    assert beta.state_store.fetch_task("app-0-main") is not None
+    assert "serve-alpha" in alpha.state_store.fetch_task("app-0-main").command
+    assert "serve-beta" in beta.state_store.fetch_task("app-0-main").command
+    # two separate launches despite identical task names
+    assert len(multi.agent.launched) == 2
+
+
+def test_footprint_discipline_serializes_growth():
+    multi = make_multi(discipline=ParallelFootprintDiscipline(1))
+    multi.add_service(from_yaml(svc_yaml("one")))
+    multi.add_service(from_yaml(svc_yaml("two")))
+    multi.run_cycle()
+    # only ONE service may grow footprint: one launch so far
+    assert len(multi.agent.launched) == 1
+    first = multi.agent.launched[0]
+    multi.agent.send(TaskStatus(task_id=first.task_id,
+                                state=TaskState.RUNNING, ready=True))
+    multi.run_cycle()  # first completes; slot frees
+    multi.run_cycle()  # second service now grows
+    assert len(multi.agent.launched) == 2
+
+
+def test_remove_service_uninstalls_and_drops():
+    multi = make_multi()
+    multi.add_service(from_yaml(svc_yaml("gone")))
+    multi.run_cycle()
+    ack_running(multi, "app-0-main")
+    multi.run_cycle()
+    assert multi.get_service("gone").deploy_manager.get_plan().is_complete
+
+    multi.uninstall_service("gone")
+    for _ in range(5):
+        multi.run_cycle()
+    assert multi.service_names() == []
+    assert "app-0-main" in multi.agent.killed_names()
+    # namespace subtree wiped, framework id retained
+    assert multi.persister.get_children_or_empty("/gone") == []
+    assert multi.framework_store is not None
+
+
+def test_restart_reloads_services_from_store():
+    persister = MemPersister()
+    agent = FakeAgent()
+    multi = make_multi(persister=persister, agent=agent)
+    multi.add_service(from_yaml(svc_yaml("keep")))
+    multi.run_cycle()
+    ack_running(multi, "app-0-main")
+    multi.run_cycle()
+
+    # new process over the same persister: service comes back, resumed
+    reborn = make_multi(persister=persister, agent=agent)
+    assert reborn.service_names() == ["keep"]
+    reborn.run_cycle()
+    service = reborn.get_service("keep")
+    assert service.deploy_manager.get_plan().is_complete
+    # no duplicate launch on resume
+    assert len(agent.launched) == 1
+
+
+def test_multi_http_surface():
+    multi = make_multi()
+    server = ApiServer(multi=multi).start()
+    try:
+        def get(path):
+            with urllib.request.urlopen(server.url + path) as resp:
+                return json.loads(resp.read().decode())
+
+        def send(method, path, data=None):
+            req = urllib.request.Request(
+                server.url + path, method=method,
+                data=data.encode() if data else b"",
+            )
+            with urllib.request.urlopen(req) as resp:
+                return json.loads(resp.read().decode())
+
+        assert get("/v1/multi") == []
+        send("PUT", "/v1/multi/websvc", svc_yaml("websvc"))
+        assert get("/v1/multi") == ["websvc"]
+        multi.run_cycle()
+        ack_running(multi, "app-0-main")
+        multi.run_cycle()
+        # per-service routing: plans + pod status through /v1/multi
+        plan = get("/v1/multi/websvc/v1/plans/deploy")
+        assert plan["status"] == "COMPLETE"
+        pods = get("/v1/multi/websvc/v1/pod")
+        assert pods == ["app-0"]
+        send("DELETE", "/v1/multi/websvc")
+        for _ in range(5):
+            multi.run_cycle()
+        assert get("/v1/multi") == []
+    finally:
+        server.stop()
