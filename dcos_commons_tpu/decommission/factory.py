@@ -21,7 +21,11 @@ from dcos_commons_tpu.plan.phase import Phase
 from dcos_commons_tpu.plan.plan import Plan
 from dcos_commons_tpu.plan.step import ActionStep
 from dcos_commons_tpu.plan.strategy import SerialStrategy
-from dcos_commons_tpu.specification.specs import ServiceSpec, pod_instance_name
+from dcos_commons_tpu.specification.specs import (
+    ServiceSpec,
+    pod_instance_name,
+    task_full_name,
+)
 from dcos_commons_tpu.state.state_store import StateStore
 
 DECOMMISSION_PLAN_NAME = "decommission"
@@ -51,17 +55,23 @@ class DecommissionPlanFactory:
         self, spec: ServiceSpec, state_store: StateStore
     ) -> Plan:
         # kill grace periods come from the current spec; tasks of a pod
-        # type the spec dropped entirely fall back to immediate kill
-        grace_by_task: Dict[str, float] = {}
-        for pod in spec.pods:
-            for task_spec in pod.tasks:
-                grace_by_task[task_spec.name] = task_spec.kill_grace_period_s
+        # type the spec dropped entirely fall back to immediate kill.
+        # The map is keyed by FULL task name (pod-index-task): suffix
+        # parsing of stored names would mis-key task specs whose names
+        # contain dashes.
+        known_pods = {p.type: p for p in spec.pods}
         phases = []
         for pod_type, index, task_names in find_surplus_instances(
             spec, state_store
         ):
+            grace_by_full: Dict[str, float] = {}
+            pod = known_pods.get(pod_type)
+            if pod is not None:
+                for task_spec in pod.tasks:
+                    full = task_full_name(pod_type, index, task_spec.name)
+                    grace_by_full[full] = task_spec.kill_grace_period_s
             phases.append(
-                self._build_phase(pod_type, index, task_names, grace_by_task)
+                self._build_phase(pod_type, index, task_names, grace_by_full)
             )
         return Plan(DECOMMISSION_PLAN_NAME, phases, SerialStrategy())
 
@@ -70,7 +80,7 @@ class DecommissionPlanFactory:
         pod_type: str,
         index: int,
         task_names: List[str],
-        grace_by_task: Dict[str, float],
+        grace_by_full: Dict[str, float],
     ) -> Phase:
         instance = pod_instance_name(pod_type, index)
         asset = {instance}
@@ -86,7 +96,7 @@ class DecommissionPlanFactory:
                 status = scheduler.state_store.fetch_status(name)
                 if status is not None and status.state.is_terminal:
                     continue
-                grace = grace_by_task.get(name.rsplit("-", 1)[-1], 0.0)
+                grace = grace_by_full.get(name, 0.0)
                 scheduler.task_killer.kill(info.task_id, grace)
                 all_done = False
             return all_done

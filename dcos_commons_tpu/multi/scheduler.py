@@ -174,6 +174,10 @@ class MultiServiceScheduler:
         # snapshots must subtract EVERY service's reservations, not
         # just this service's own namespaced ledger
         scheduler.evaluator.set_snapshot_view(_MergedLedgerView(self))
+        # the shared agent's task set spans every service: per-service
+        # orphan sweeps would kill siblings' tasks, so the multi loop
+        # runs ONE merged sweep instead (_kill_merged_orphans)
+        scheduler.kill_orphaned_tasks = False
         return scheduler
 
     def _make_uninstaller(self, spec: ServiceSpec) -> UninstallScheduler:
@@ -217,6 +221,7 @@ class MultiServiceScheduler:
                         service.run_cycle()
                 except Exception:
                     LOG.exception("service %s cycle failed", name)
+            self._kill_merged_orphans(services)
             # drop services whose uninstall finished
             for name, service in services.items():
                 if isinstance(service, UninstallScheduler) and \
@@ -225,6 +230,19 @@ class MultiServiceScheduler:
                     del self._services[name]
                     LOG.info("service %s uninstalled and removed", name)
 
+    def _kill_merged_orphans(self, services: Dict[str, object]) -> None:
+        """Kill agent tasks NO service's store owns (lost-kill safety
+        net; the per-service sweep is disabled in multi mode because
+        each service sees the shared agent's full task set)."""
+        expected = set()
+        for service in services.values():
+            expected |= {
+                info.task_id for info in service.state_store.fetch_tasks()
+            }
+        for task_id in self.agent.active_task_ids() - expected:
+            self.agent.kill(task_id)
+            LOG.info("killed orphaned task %s (no owning service)", task_id)
+
     def _route_statuses(self, services: Dict[str, object]) -> None:
         """Poll the shared agent once and deliver each status to the
         service whose stored TaskInfo owns the task id; unroutable
@@ -232,20 +250,36 @@ class MultiServiceScheduler:
         from dcos_commons_tpu.common import task_name_of
 
         for status in self.agent.poll():
+            try:
+                task_name = task_name_of(status.task_id)
+            except ValueError:
+                LOG.warning("dropped unparseable task id %s", status.task_id)
+                continue
             routed = False
+            holders = []  # services holding a TaskInfo under this name
             for service in services.values():
-                try:
-                    task_name = task_name_of(status.task_id)
-                except ValueError:
-                    continue
                 info = service.state_store.fetch_task(task_name)
-                if info is not None and info.task_id == status.task_id:
+                if info is None:
+                    continue
+                if info.task_id == status.task_id:
                     service.agent.deliver(status)
                     routed = True
                     break
-            if not routed:
-                for service in services.values():
+                holders.append(service)
+            if routed:
+                continue
+            # no exact id owner: deliver only to services that hold a
+            # stored TaskInfo for the NAME (their stale-id guards drop
+            # it); broadcasting to everyone would persist stray status
+            # nodes in services that never owned the task, which can
+            # later wedge their uninstall kill-all
+            if holders:
+                for service in holders:
                     service.agent.deliver(status)
+            else:
+                LOG.info(
+                    "dropped status for unknown task %s", status.task_id
+                )
 
     @staticmethod
     def _is_growing(scheduler: DefaultScheduler) -> bool:

@@ -65,6 +65,7 @@ class DefaultScheduler:
         outcome_tracker: Optional[OfferOutcomeTracker] = None,
         config_store=None,
         framework_store=None,
+        kill_orphaned_tasks: bool = True,
     ):
         # stores surfaced to the HTTP API (/v1/configs, /v1/state);
         # None when the scheduler is wired by hand in unit tests
@@ -89,6 +90,11 @@ class DefaultScheduler:
         self.launch_recorder = PersistentLaunchRecorder(state_store)
         self.task_killer = TaskKiller(agent)
         self.reconciler = Reconciler(state_store, agent)
+        # standalone mode sweeps agent tasks the store doesn't own
+        # (lost-kill safety net); in multi-service mode the agent view
+        # is SHARED, so the MultiServiceScheduler does a merged sweep
+        # instead and this is disabled per service
+        self.kill_orphaned_tasks = kill_orphaned_tasks
         self._suppressed = False
         self._stop = threading.Event()
         self._lock = threading.RLock()
@@ -109,6 +115,8 @@ class DefaultScheduler:
                 self.metrics.incr("reconciles")
             self._process_candidates(allow_footprint_growth)
             self._gc_reservations()
+            if self.kill_orphaned_tasks:
+                self._kill_orphans()
             self.task_killer.retry_pending()
             # first full deployment done: scheduler restarts now build
             # an *update* plan (reference: StateStoreUtils deployment-
@@ -225,15 +233,22 @@ class DefaultScheduler:
 
     def _kill_previous_launches(self, task_infos) -> None:
         """A relaunch of task name N must kill N's previous process
-        before the new one starts (rolling update / recovery path)."""
-        active = self.agent.active_task_ids()
+        before the new one starts (rolling update / recovery path).
+
+        The previous launch is identified by the task id recorded in
+        THIS service's own state store — never by an agent-wide name
+        scan, which in multi-service mode would kill another service's
+        same-named task (reference: prior task id read from the pod's
+        own state store via PersistentLaunchRecorder/StateStore)."""
         for info in task_infos:
-            for task_id in active:
-                try:
-                    if task_name_of(task_id) == info.name and task_id != info.task_id:
-                        self.task_killer.kill(task_id)
-                except ValueError:
-                    continue
+            prev = self.state_store.fetch_task(info.name)
+            if prev is None or prev.task_id == info.task_id:
+                continue
+            status = self.state_store.fetch_status(info.name)
+            if status is not None and status.task_id == prev.task_id \
+                    and status.state.is_terminal:
+                continue  # previous launch already dead
+            self.task_killer.kill(prev.task_id)
 
     def _launch(self, task_infos, requirement) -> None:
         pod = requirement.pod
@@ -260,6 +275,24 @@ class DefaultScheduler:
             else:
                 self.agent.launch([info])
 
+    def _kill_orphans(self) -> None:
+        """Kill agent tasks this service's store does not own — either
+        an unknown name or a stale id for a known name (a lost kill
+        whose successor already launched).  Reference: kill-unneeded-
+        tasks on register, DefaultScheduler.java:252-270.  The launch
+        WAL runs before the agent launch, so a freshly-launched task is
+        always store-known and never swept."""
+        for task_id in self.agent.active_task_ids():
+            try:
+                name = task_name_of(task_id)
+            except ValueError:
+                self.task_killer.kill(task_id)
+                continue
+            info = self.state_store.fetch_task(name)
+            if info is None or info.task_id != task_id:
+                self.task_killer.kill(task_id)
+                self.metrics.incr("operations.kill_orphan")
+
     # -- reservation GC ----------------------------------------------
 
     def _gc_reservations(self) -> None:
@@ -279,23 +312,30 @@ class DefaultScheduler:
     def restart_pod(self, pod_type: str, index: int, replace: bool = False) -> List[str]:
         """Reference: PodQueries.restart (:263) — ``replace`` marks
         tasks permanently failed (pod replace), otherwise a plain
-        restart (kill; recovery relaunches in place)."""
-        pod = self.spec.pod(pod_type)
-        indices = list(range(pod.count)) if pod.gang else [index]
-        killed = []
-        for i in indices:
-            for task_spec in pod.tasks:
-                full = task_full_name(pod_type, i, task_spec.name)
-                info = self.state_store.fetch_task(full)
-                if info is None:
-                    continue
-                if replace:
-                    self.state_store.store_tasks(
-                        [info.with_label(Label.PERMANENTLY_FAILED, "true")]
+        restart (kill; recovery relaunches in place).
+
+        Takes the scheduler lock: operator verbs arrive on HTTP server
+        threads and must serialize with run_cycle so kills/overrides
+        never interleave with an in-flight evaluation."""
+        with self._lock:
+            pod = self.spec.pod(pod_type)
+            indices = list(range(pod.count)) if pod.gang else [index]
+            killed = []
+            for i in indices:
+                for task_spec in pod.tasks:
+                    full = task_full_name(pod_type, i, task_spec.name)
+                    info = self.state_store.fetch_task(full)
+                    if info is None:
+                        continue
+                    if replace:
+                        self.state_store.store_tasks(
+                            [info.with_label(Label.PERMANENTLY_FAILED, "true")]
+                        )
+                    self.task_killer.kill(
+                        info.task_id, task_spec.kill_grace_period_s
                     )
-                self.task_killer.kill(info.task_id, task_spec.kill_grace_period_s)
-                killed.append(full)
-        return killed
+                    killed.append(full)
+            return killed
 
     def pause_pod(
         self, pod_type: str, index: int, tasks: Optional[List[str]] = None
@@ -323,29 +363,35 @@ class DefaultScheduler:
         tasks: Optional[List[str]],
         override: GoalStateOverride,
     ) -> List[str]:
-        pod = self.spec.pod(pod_type)
-        indices = list(range(pod.count)) if pod.gang else [index]
-        touched = []
-        for i in indices:
-            for task_spec in pod.tasks:
-                if tasks and task_spec.name not in tasks:
-                    continue
-                full = task_full_name(pod_type, i, task_spec.name)
-                current, _progress = self.state_store.fetch_goal_override(full)
-                if current is override:
-                    # no-op transition (pause of a paused task, resume
-                    # of a running one): don't kill anything
-                    continue
-                self.state_store.store_goal_override(
-                    full, override, OverrideProgress.PENDING
-                )
-                touched.append(full)
-                info = self.state_store.fetch_task(full)
-                if info is not None:
-                    self.task_killer.kill(
-                        info.task_id, task_spec.kill_grace_period_s
+        # serialized with run_cycle (see restart_pod): otherwise the
+        # PENDING->IN_PROGRESS flip can attach to a relaunch that was
+        # evaluated with the real (non-override) command
+        with self._lock:
+            pod = self.spec.pod(pod_type)
+            indices = list(range(pod.count)) if pod.gang else [index]
+            touched = []
+            for i in indices:
+                for task_spec in pod.tasks:
+                    if tasks and task_spec.name not in tasks:
+                        continue
+                    full = task_full_name(pod_type, i, task_spec.name)
+                    current, _progress = self.state_store.fetch_goal_override(
+                        full
                     )
-        return touched
+                    if current is override:
+                        # no-op transition (pause of a paused task,
+                        # resume of a running one): don't kill anything
+                        continue
+                    self.state_store.store_goal_override(
+                        full, override, OverrideProgress.PENDING
+                    )
+                    touched.append(full)
+                    info = self.state_store.fetch_task(full)
+                    if info is not None:
+                        self.task_killer.kill(
+                            info.task_id, task_spec.kill_grace_period_s
+                        )
+            return touched
 
     def plans(self) -> Dict[str, Plan]:
         out = {}

@@ -24,6 +24,8 @@ class FakeAgent:
         self.launched: List[TaskInfo] = []
         # kill-call history (task ids, duplicates possible via retries)
         self.kills: List[str] = []
+        # last grace period passed to kill() per task id
+        self.kill_graces: Dict[str, float] = {}
         self.checks: Dict[str, Dict[str, object]] = {}
         self._active: Dict[str, TaskInfo] = {}
         self._queue: List[TaskStatus] = []
@@ -50,6 +52,7 @@ class FakeAgent:
     def kill(self, task_id: str, grace_period_s: float = 0.0) -> None:
         with self._lock:
             self.kills.append(task_id)
+            self.kill_graces[task_id] = grace_period_s
             if task_id not in self._active:
                 return
             if self.auto_ack_kills and task_id not in self._acked_kills:

@@ -45,8 +45,17 @@ class UninstallPlanFactory:
                 scheduler.task_killer.kill(status.task_id)
                 all_done = False
             # tasks the agent knows but the store lost (torn WAL, old
-            # runs) die too — uninstall must leave nothing behind
+            # runs) die too — but ONLY in a standalone (whole-framework)
+            # uninstall.  A namespaced multi-service removal sees the
+            # SHARED agent's task set and must never touch ids other
+            # services own (reference: single-service removal tears
+            # down only that client's tasks, MultiServiceEventClient).
+            owned = {
+                info.task_id for info in scheduler.state_store.fetch_tasks()
+            }
             for task_id in scheduler.agent.active_task_ids():
+                if not scheduler._deregister and task_id not in owned:
+                    continue
                 scheduler.task_killer.kill(task_id)
                 all_done = False
             return all_done

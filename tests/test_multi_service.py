@@ -167,3 +167,58 @@ def test_multi_http_surface():
         assert get("/v1/multi") == []
     finally:
         server.stop()
+
+
+def test_second_service_launch_does_not_kill_first():
+    """Regression: _kill_previous_launches must kill by the task id in
+    THIS service's state store, not by an agent-wide name scan — beta
+    launching app-0-main must not kill alpha's running app-0-main."""
+    multi = make_multi()
+    multi.add_service(from_yaml(svc_yaml("alpha")))
+    multi.run_cycle()
+    ack_running(multi, "app-0-main")
+    multi.run_cycle()
+    alpha = multi.get_service("alpha")
+    assert alpha.deploy_manager.get_plan().is_complete
+    alpha_id = alpha.state_store.fetch_task("app-0-main").task_id
+
+    multi.add_service(from_yaml(svc_yaml("beta")))
+    for _ in range(4):
+        multi.run_cycle()
+        for info in multi.agent.launched:
+            if info.task_id in multi.agent.active_task_ids():
+                multi.agent.send(TaskStatus(task_id=info.task_id,
+                                            state=TaskState.RUNNING,
+                                            ready=True))
+    assert alpha_id not in multi.agent.kills
+    assert alpha_id in multi.agent.active_task_ids()
+    assert alpha.deploy_manager.get_plan().is_complete
+    assert multi.get_service("beta").deploy_manager.get_plan().is_complete
+
+
+def test_uninstall_one_service_spares_others():
+    """Regression: a namespaced uninstall must only kill task ids its
+    own state store owns, never sweep the shared agent's full set."""
+    multi = make_multi()
+    multi.add_service(from_yaml(svc_yaml("keep")))
+    multi.add_service(from_yaml(svc_yaml("gone")))
+    for _ in range(4):
+        multi.run_cycle()
+        for info in multi.agent.launched:
+            if info.task_id in multi.agent.active_task_ids():
+                multi.agent.send(TaskStatus(task_id=info.task_id,
+                                            state=TaskState.RUNNING,
+                                            ready=True))
+    keep = multi.get_service("keep")
+    assert keep.deploy_manager.get_plan().is_complete
+    keep_id = keep.state_store.fetch_task("app-0-main").task_id
+    gone_id = multi.get_service("gone").state_store.fetch_task(
+        "app-0-main").task_id
+
+    multi.uninstall_service("gone")
+    for _ in range(5):
+        multi.run_cycle()
+    assert multi.service_names() == ["keep"]
+    assert gone_id in multi.agent.kills
+    assert keep_id not in multi.agent.kills
+    assert keep_id in multi.agent.active_task_ids()

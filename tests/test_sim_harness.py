@@ -212,3 +212,30 @@ def test_config_update_rolls_changed_pods():
     assert len(updated.agent.launches_of("hello-0-server")) == 2
     new_info = updated.agent.task_info_of("hello-0-server")
     assert "sleep 2000" in new_info.command
+
+
+def test_orphaned_agent_task_is_swept():
+    """A task alive on the agent that the store doesn't own (lost kill
+    whose successor launched, or state loss) must be killed by the
+    standalone orphan sweep (reference: kill-unneeded-tasks,
+    DefaultScheduler.java:252-270)."""
+    from dcos_commons_tpu.common import TaskInfo, new_task_id
+
+    runner = ServiceTestRunner(TWO_POD_YAML)
+    runner.run([
+        AdvanceCycles(1),
+        SendTaskRunning("hello-0-server"),
+        AdvanceCycles(1),
+        SendTaskRunning("hello-1-server"),
+        ExpectDeploymentComplete(),
+    ])
+    scheduler = runner.world.scheduler
+    rogue_id = new_task_id("hello-0-server")  # stale id for a known name
+    runner.agent.launch_one(TaskInfo(name="hello-0-server", task_id=rogue_id))
+    unknown_id = new_task_id("ghost-9-task")  # name the store never saw
+    runner.agent.launch_one(TaskInfo(name="ghost-9-task", task_id=unknown_id))
+    good_id = scheduler.state_store.fetch_task("hello-0-server").task_id
+    scheduler.run_cycle()
+    assert rogue_id in runner.agent.kills
+    assert unknown_id in runner.agent.kills
+    assert good_id not in runner.agent.kills

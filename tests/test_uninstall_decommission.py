@@ -165,3 +165,46 @@ def test_skeleton_scheduler_after_wipe():
     second.run([AdvanceCycles(3)])
     assert world.scheduler.is_complete
     assert world.scheduler.plan("deploy").get_status() is Status.COMPLETE
+
+
+DASHED_TASK_YAML = """
+name: dash-svc
+pods:
+  web:
+    count: 2
+    allow-decommission: true
+    tasks:
+      main-server:
+        goal: RUNNING
+        cmd: "serve"
+        cpus: 0.1
+        memory: 32
+        kill-grace-period: 17
+"""
+
+
+def test_decommission_grace_honored_for_dashed_task_names():
+    """Regression: grace lookup must key by FULL task name — suffix
+    parsing of 'web-1-main-server' would yield 'server' and silently
+    fall back to an immediate kill."""
+    runner = ServiceTestRunner(DASHED_TASK_YAML)
+    runner.run([
+        AdvanceCycles(1),
+        SendTaskRunning("web-0-main-server"),
+        AdvanceCycles(1),
+        SendTaskRunning("web-1-main-server"),
+        ExpectDeploymentComplete(),
+    ])
+    shrunk = ServiceTestRunner(
+        DASHED_TASK_YAML.replace("count: 2", "count: 1"),
+        persister=runner.persister,
+        hosts=runner.hosts,
+    )
+    shrunk.agent = runner.agent
+    shrunk.inventory = runner.inventory
+    world = shrunk.build()
+    doomed_id = runner.agent.task_id_of("web-1-main-server")
+    for _ in range(4):
+        world.scheduler.run_cycle()
+    assert doomed_id in shrunk.agent.kills
+    assert shrunk.agent.kill_graces[doomed_id] == 17.0
