@@ -34,12 +34,15 @@ class SchedulerApi:
             p.is_complete for n, p in plans.items()
             if n in ("deploy", "update")
         )
-        healthy = not has_errors
+        fatal = getattr(self._scheduler, "fatal_error", None)
+        healthy = not has_errors and fatal is None
         body = {
             "healthy": healthy,
             "deployed": deployed,
             "plans": statuses,
         }
+        if fatal is not None:
+            body["fatal_error"] = fatal
         return (200 if healthy else 503), body
 
     # -- plans (reference: http/queries/PlansQueries.java:47-231) -----

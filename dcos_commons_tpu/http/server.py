@@ -102,6 +102,27 @@ def _one(query: dict, key: str) -> Optional[str]:
     return values[0] if values else None
 
 
+def _multi_health(multi) -> tuple:
+    """Aggregate /v1/health for multi-service mode: unhealthy when the
+    multi loop flagged fatal or any service's plans carry errors."""
+    fatal = getattr(multi, "fatal_error", None)
+    services = {}
+    has_errors = False
+    for name, svc in multi.services().items():
+        plans = svc.plans()
+        errors = any(p.has_errors() for p in plans.values())
+        has_errors = has_errors or errors
+        services[name] = {
+            "plans": {n: p.get_status().value for n, p in plans.items()},
+            "errors": errors,
+        }
+    healthy = fatal is None and not has_errors
+    body = {"healthy": healthy, "services": services}
+    if fatal is not None:
+        body["fatal_error"] = fatal
+    return (200 if healthy else 503), body
+
+
 class ApiServer:
     """Reference: framework/ApiServer.java — started before the event
     loop accepts work; ``port=0`` binds an ephemeral port (tests).
@@ -142,6 +163,12 @@ class ApiServer:
                     except Exception as e:  # surface, don't kill the server
                         code, body = 500, {"message": f"internal error: {e}"}
                     self._reply(code, body)
+                    return
+                if multi_scheduler is not None and method == "GET" and \
+                        parsed.path == "/v1/health":
+                    # aggregate health in multi-only mode (per-service
+                    # health is /v1/multi/<name>/v1/health)
+                    self._reply(*_multi_health(multi_scheduler))
                     return
                 self._reply(404, {"message": f"no route {method} {parsed.path}"})
 

@@ -19,6 +19,7 @@ from dcos_commons_tpu.agent.base import Agent
 from dcos_commons_tpu.multi.discipline import AnyFootprintDiscipline
 from dcos_commons_tpu.multi.store import ServiceStore
 from dcos_commons_tpu.offer.inventory import SliceInventory
+from dcos_commons_tpu.runtime.task_killer import TaskKiller
 from dcos_commons_tpu.scheduler.builder import SchedulerBuilder
 from dcos_commons_tpu.scheduler.config import SchedulerConfig
 from dcos_commons_tpu.scheduler.scheduler import DefaultScheduler
@@ -103,6 +104,15 @@ class MultiServiceScheduler:
         self.framework_store = FrameworkStore(persister)
         self._builder_hook = builder_hook
         self._services: Dict[str, object] = {}  # name -> scheduler
+        # merged orphan sweep goes through a TaskKiller so lost kill
+        # requests are retried and acked like every other kill
+        self.task_killer = TaskKiller(agent)
+        # wedge detection (mirrors DefaultScheduler.run_forever): a
+        # service failing this many consecutive cycles flags the whole
+        # process fatal for supervised restart
+        self.max_consecutive_failures = 5
+        self._cycle_failures: Dict[str, int] = {}
+        self._fatal_error: Optional[str] = None
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self._reload()
@@ -219,9 +229,23 @@ class MultiServiceScheduler:
                         )
                     else:
                         service.run_cycle()
-                except Exception:
-                    LOG.exception("service %s cycle failed", name)
+                    self._cycle_failures[name] = 0
+                except Exception as exc:
+                    failures = self._cycle_failures.get(name, 0) + 1
+                    self._cycle_failures[name] = failures
+                    LOG.exception(
+                        "service %s cycle failed (%d consecutive)",
+                        name, failures,
+                    )
+                    if failures >= self.max_consecutive_failures:
+                        self._fatal_error = f"service {name}: {exc!r}"
+                        LOG.critical(
+                            "service %s wedged after %d consecutive cycle "
+                            "failures; flagging fatal for supervised restart",
+                            name, failures,
+                        )
             self._kill_merged_orphans(services)
+            self.task_killer.retry_pending()
             # drop services whose uninstall finished
             for name, service in services.items():
                 if isinstance(service, UninstallScheduler) and \
@@ -240,8 +264,10 @@ class MultiServiceScheduler:
                 info.task_id for info in service.state_store.fetch_tasks()
             }
         for task_id in self.agent.active_task_ids() - expected:
-            self.agent.kill(task_id)
-            LOG.info("killed orphaned task %s (no owning service)", task_id)
+            if task_id in self.task_killer.pending_ids():
+                continue  # retry_pending re-issues until acked
+            LOG.info("killing orphaned task %s (no owning service)", task_id)
+            self.task_killer.kill(task_id)
 
     def _route_statuses(self, services: Dict[str, object]) -> None:
         """Poll the shared agent once and deliver each status to the
@@ -250,6 +276,7 @@ class MultiServiceScheduler:
         from dcos_commons_tpu.common import task_name_of
 
         for status in self.agent.poll():
+            self.task_killer.handle_status(status)
             try:
                 task_name = task_name_of(status.task_id)
             except ValueError:
@@ -292,18 +319,43 @@ class MultiServiceScheduler:
                 return True
         return False
 
-    def run_forever(self, interval_s: float = 0.5) -> threading.Thread:
+    def run_forever(
+        self,
+        interval_s: float = 0.5,
+        max_consecutive_failures: int = 5,
+    ) -> threading.Thread:
+        """Same crash-to-restart contract as DefaultScheduler: stop the
+        loop with ``fatal_error`` set once the outer cycle (or any one
+        service, tracked in run_cycle) is permanently wedged."""
         def loop():
+            failures = 0
             while not self._stop.is_set():
                 try:
                     self.run_cycle()
-                except Exception:
-                    LOG.exception("multi cycle failed")
+                    failures = 0
+                except Exception as exc:
+                    failures += 1
+                    LOG.exception(
+                        "multi cycle failed (%d consecutive)", failures
+                    )
+                    if failures >= max_consecutive_failures:
+                        self._fatal_error = repr(exc)
+                if self._fatal_error is not None:
+                    LOG.critical(
+                        "multi scheduler wedged (%s); stopping loop for "
+                        "supervised restart", self._fatal_error,
+                    )
+                    self._stop.set()
+                    break
                 self._stop.wait(interval_s)
 
         thread = threading.Thread(target=loop, name="multi-loop", daemon=True)
         thread.start()
         return thread
+
+    @property
+    def fatal_error(self) -> Optional[str]:
+        return self._fatal_error
 
     def stop(self) -> None:
         self._stop.set()

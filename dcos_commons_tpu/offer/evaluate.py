@@ -172,11 +172,28 @@ class OfferEvaluator:
                 return None  # host gone: fall through to fresh placement
             placements.append((index, host_id, reservations))
 
+        coordinator = self._existing_coordinator(requirement)
+        pod = requirement.pod
+        if pod.gang and pod.tpu is not None and pod.tpu.topology \
+                and not coordinator:
+            # a gang relaunch without the rendezvous reservation would
+            # launch workers that hang forever in
+            # jax.distributed.initialize — fail loudly instead; the
+            # operator escalates with `pod replace` (PERMANENT), which
+            # re-places from scratch and mints a fresh coordinator
+            return EvaluationResult(
+                False,
+                EvaluationOutcome.fail(
+                    "reuse",
+                    "no coordinator reservation found for gang "
+                    "relaunch; refusing to launch a gang that cannot "
+                    "rendezvous (escalate with pod replace)",
+                ),
+            )
         outcome = EvaluationOutcome.ok(
             "reuse", f"relaunching in place on {[p[1] for p in placements]}"
         )
         task_infos = []
-        coordinator = self._existing_coordinator(requirement)
         for worker_id, (index, host_id, reservations) in enumerate(placements):
             host = inventory.host(host_id)
             chips = sorted({c for r in reservations for c in r.chip_ids})

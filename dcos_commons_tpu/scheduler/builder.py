@@ -218,6 +218,8 @@ class SchedulerBuilder:
 
         from dcos_commons_tpu.state.framework_store import FrameworkStore
 
+        from dcos_commons_tpu.runtime.token_bucket import TokenBucket
+
         return DefaultScheduler(
             spec=target_spec,
             state_store=state_store,
@@ -230,6 +232,10 @@ class SchedulerBuilder:
             other_managers=other_managers,
             config_store=config_store,
             framework_store=FrameworkStore(persister),
+            revive_bucket=TokenBucket(
+                capacity=self._config.revive_capacity,
+                refill_interval_s=self._config.revive_refill_s,
+            ),
         )
 
     # -- config update (reference: DefaultConfigurationUpdater:159) ---

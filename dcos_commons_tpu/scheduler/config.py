@@ -27,6 +27,9 @@ class SchedulerConfig:
     backoff_max_s: float = 300.0
     # recovery escalation (overridden by ServiceSpec's policy)
     permanent_failure_timeout_s: float = 1200.0
+    # revive throttling (reference: ReviveManager token bucket)
+    revive_capacity: int = 4
+    revive_refill_s: float = 5.0
     # agent sandbox root
     sandbox_root: str = "./sandboxes"
     # coordinator port range for pjit rendezvous
@@ -50,6 +53,8 @@ class SchedulerConfig:
             permanent_failure_timeout_s=float(
                 env.get("PERMANENT_FAILURE_TIMEOUT_S", "1200")
             ),
+            revive_capacity=int(env.get("REVIVE_CAPACITY", "4")),
+            revive_refill_s=float(env.get("REVIVE_REFILL_S", "5.0")),
             sandbox_root=env.get("SANDBOX_ROOT", "./sandboxes"),
             coordinator_port_base=int(env.get("COORDINATOR_PORT_BASE", "8476")),
         )

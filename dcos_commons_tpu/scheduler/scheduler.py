@@ -38,6 +38,7 @@ from dcos_commons_tpu.plan.step import ActionStep, DeploymentStep
 from dcos_commons_tpu.recovery.manager import DefaultRecoveryPlanManager
 from dcos_commons_tpu.runtime.reconciler import Reconciler
 from dcos_commons_tpu.runtime.task_killer import TaskKiller
+from dcos_commons_tpu.runtime.token_bucket import TokenBucket
 from dcos_commons_tpu.specification.specs import ServiceSpec, task_full_name
 from dcos_commons_tpu.state.launch_recorder import PersistentLaunchRecorder
 from dcos_commons_tpu.state.state_store import (
@@ -66,6 +67,7 @@ class DefaultScheduler:
         config_store=None,
         framework_store=None,
         kill_orphaned_tasks: bool = True,
+        revive_bucket: Optional[TokenBucket] = None,
     ):
         # stores surfaced to the HTTP API (/v1/configs, /v1/state);
         # None when the scheduler is wired by hand in unit tests
@@ -95,7 +97,23 @@ class DefaultScheduler:
         # is SHARED, so the MultiServiceScheduler does a merged sweep
         # instead and this is disabled per service
         self.kill_orphaned_tasks = kill_orphaned_tasks
+        # revive throttling: a flapping work-set (task crash-looping
+        # between suppress and revive) may not hammer the inventory
+        # scan every cycle (reference: rate-limited ReviveManager,
+        # framework/ReviveManager.java + TokenBucket.java).  Fallback
+        # tuning comes from SchedulerConfig so there is one source of
+        # truth for the defaults.
+        if revive_bucket is None:
+            from dcos_commons_tpu.scheduler.config import SchedulerConfig
+
+            defaults = SchedulerConfig()
+            revive_bucket = TokenBucket(
+                capacity=defaults.revive_capacity,
+                refill_interval_s=defaults.revive_refill_s,
+            )
+        self.revive_bucket = revive_bucket
         self._suppressed = False
+        self._fatal_error: Optional[str] = None
         self._stop = threading.Event()
         self._lock = threading.RLock()
 
@@ -125,18 +143,48 @@ class DefaultScheduler:
                     self.deploy_manager.get_plan().is_complete:
                 self.state_store.set_deployment_completed()
 
-    def run_forever(self, interval_s: float = 0.5) -> threading.Thread:
+    def run_forever(
+        self,
+        interval_s: float = 0.5,
+        max_consecutive_failures: int = 5,
+    ) -> threading.Thread:
+        """A transient cycle failure is logged and retried; after
+        ``max_consecutive_failures`` in a row the loop declares itself
+        wedged, records ``fatal_error`` and stops, so the serving
+        process can exit and be restarted by its supervisor (reference:
+        deliberate crash-to-restart on deadlock, SchedulerConfig.java
+        DISABLE_DEADLOCK_EXIT semantics — exit is the default)."""
         def loop():
+            failures = 0
             while not self._stop.is_set():
                 try:
                     self.run_cycle()
-                except Exception:  # crash the process in prod; log here
-                    LOG.exception("scheduler cycle failed")
+                    failures = 0
+                except Exception as exc:
+                    failures += 1
+                    LOG.exception(
+                        "scheduler cycle failed (%d consecutive)", failures
+                    )
+                    if failures >= max_consecutive_failures:
+                        self._fatal_error = repr(exc)
+                        LOG.critical(
+                            "scheduler wedged after %d consecutive cycle "
+                            "failures; stopping loop for supervised restart",
+                            failures,
+                        )
+                        self._stop.set()
+                        break
                 self._stop.wait(interval_s)
 
         thread = threading.Thread(target=loop, name="scheduler-loop", daemon=True)
         thread.start()
         return thread
+
+    @property
+    def fatal_error(self) -> Optional[str]:
+        """Non-None once run_forever gave up; surfaced via /v1/health
+        and the serve entrypoint's exit code."""
+        return self._fatal_error
 
     def stop(self) -> None:
         self._stop.set()
@@ -185,6 +233,11 @@ class DefaultScheduler:
                 self.metrics.incr("suppresses")
             return
         if self._suppressed:
+            # new work while suppressed: revive, rate-limited so a
+            # crash-looping task can't force a full rescan every cycle
+            if not self.revive_bucket.try_acquire():
+                self.metrics.incr("revives.throttled")
+                return
             self._suppressed = False
             self.metrics.incr("revives")
         for step in candidates:

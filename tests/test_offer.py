@@ -358,3 +358,30 @@ def test_gang_atomicity_no_partial_claims():
     assert not result.passed
     assert result.reservations == []
     assert ledger.all() == []
+
+
+def test_gang_relaunch_without_coordinator_fails_loudly():
+    """Regression: a gang relaunch whose coordinator reservation is
+    gone must FAIL evaluation, not launch workers with an empty
+    COORDINATOR_ADDRESS that hang in jax.distributed.initialize."""
+    from dcos_commons_tpu.offer.evaluate import COORDINATOR_PORT_NAME
+
+    fleet = make_test_fleet(host_grid=(4, 4), chip_block=(2, 2))
+    spec, store, ledger, ev, inv = build_eval(GANG_YAML, fleet)
+    req = PodInstanceRequirement(
+        pod=spec.pod("trainer"), instances=[0, 1, 2, 3]
+    )
+    first = ev.evaluate(req, inv)
+    assert first.passed
+    ledger.commit(first.reservations)
+    store.store_tasks(first.task_infos)
+
+    # simulate partial state loss: only the rendezvous claim vanishes
+    for r in ledger.all():
+        if r.container_path == COORDINATOR_PORT_NAME:
+            ledger.release(r.reservation_id)
+
+    relaunch = ev.evaluate(req, inv)
+    assert not relaunch.passed
+    assert "coordinator" in "\n".join(relaunch.outcome.flatten())
+    assert relaunch.task_infos == []

@@ -1,0 +1,158 @@
+"""Runtime hardening: revive throttling, crash-to-restart on wedged
+loops, fail-loud gang relaunch without a rendezvous point.
+
+Reference: framework/ReviveManager.java + TokenBucket.java (revive
+rate limit); SchedulerConfig.java deadlock-exit semantics (a wedged
+scheduler exits for supervised restart rather than looping silently).
+"""
+
+from dcos_commons_tpu.runtime.token_bucket import TokenBucket
+from dcos_commons_tpu.testing import (
+    AdvanceCycles,
+    ExpectDeploymentComplete,
+    SendTaskFailed,
+    SendTaskRunning,
+    ServiceTestRunner,
+)
+
+ONE_POD_YAML = """
+name: throttle-svc
+pods:
+  app:
+    count: 1
+    tasks:
+      main:
+        goal: RUNNING
+        cmd: "serve"
+        cpus: 0.1
+        memory: 32
+"""
+
+
+def test_revive_throttled_by_token_bucket():
+    """A crash-looping task may not force a revive every cycle: the
+    second revive inside the refill window is throttled, then proceeds
+    once the bucket refills."""
+    runner = ServiceTestRunner(ONE_POD_YAML)
+    runner.run([
+        AdvanceCycles(1),
+        SendTaskRunning("app-0-main"),
+        ExpectDeploymentComplete(),
+    ])
+    scheduler = runner.world.scheduler
+    clock = [0.0]
+    scheduler.revive_bucket = TokenBucket(
+        capacity=1, refill_interval_s=100.0, clock=lambda: clock[0]
+    )
+    scheduler.run_cycle()  # no candidates -> suppressed
+    assert scheduler._suppressed
+
+    runner.run([SendTaskFailed("app-0-main"), AdvanceCycles(2)])
+    # first revive consumed the only token; relaunch happened
+    assert scheduler.metrics.counters()["revives"] == 1
+    assert len(runner.agent.launches_of("app-0-main")) == 2
+    runner.run([SendTaskRunning("app-0-main"), AdvanceCycles(1)])
+    assert scheduler._suppressed
+
+    runner.run([SendTaskFailed("app-0-main"), AdvanceCycles(3)])
+    # bucket empty: revive throttled, no relaunch
+    assert scheduler.metrics.counters()["revives.throttled"] >= 1
+    assert len(runner.agent.launches_of("app-0-main")) == 2
+    assert scheduler._suppressed
+
+    clock[0] = 101.0  # refill window passed
+    runner.run([AdvanceCycles(2)])
+    assert scheduler.metrics.counters()["revives"] == 2
+    assert len(runner.agent.launches_of("app-0-main")) == 3
+
+
+def test_run_forever_stops_after_consecutive_failures():
+    """A permanently-failing cycle must stop the loop and record a
+    fatal error instead of looping silently forever."""
+    runner = ServiceTestRunner(ONE_POD_YAML)
+    scheduler = runner.build().scheduler
+
+    calls = []
+
+    def broken_cycle(allow_footprint_growth=True):
+        calls.append(1)
+        raise RuntimeError("wedged")
+
+    scheduler.run_cycle = broken_cycle
+    thread = scheduler.run_forever(
+        interval_s=0.01, max_consecutive_failures=3
+    )
+    thread.join(timeout=5.0)
+    assert not thread.is_alive()
+    assert len(calls) == 3
+    assert "wedged" in scheduler.fatal_error
+
+
+def test_health_endpoint_reports_fatal_error():
+    import json
+    import urllib.request
+
+    from dcos_commons_tpu.http import ApiServer
+
+    runner = ServiceTestRunner(ONE_POD_YAML)
+    scheduler = runner.build().scheduler
+    scheduler._fatal_error = "RuntimeError('wedged')"
+    server = ApiServer(scheduler).start()
+    try:
+        try:
+            with urllib.request.urlopen(server.url + "/v1/health") as resp:
+                raise AssertionError("expected 503")
+        except urllib.error.HTTPError as err:
+            assert err.code == 503
+            body = json.loads(err.read().decode())
+            assert body["fatal_error"] == "RuntimeError('wedged')"
+            assert not body["healthy"]
+    finally:
+        server.stop()
+
+
+def test_multi_wedged_service_flags_fatal_and_health_503():
+    """A service that fails every cycle in multi mode must trip
+    fatal_error (for supervised restart) and turn aggregate
+    /v1/health 503 — not loop silently forever."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    from dcos_commons_tpu.http import ApiServer
+    from dcos_commons_tpu.multi import MultiServiceScheduler
+    from dcos_commons_tpu.offer.inventory import SliceInventory, TpuHost
+    from dcos_commons_tpu.scheduler import SchedulerConfig
+    from dcos_commons_tpu.specification.yaml_spec import from_yaml
+    from dcos_commons_tpu.storage import MemPersister
+    from dcos_commons_tpu.testing import FakeAgent
+
+    multi = MultiServiceScheduler(
+        persister=MemPersister(),
+        inventory=SliceInventory([TpuHost(host_id="h0")]),
+        agent=FakeAgent(),
+        scheduler_config=SchedulerConfig(backoff_enabled=False),
+    )
+    multi.add_service(from_yaml(ONE_POD_YAML))
+    broken = multi.get_service("throttle-svc")
+
+    def boom(*a, **k):
+        raise RuntimeError("store corrupted")
+
+    broken.run_cycle = boom
+    thread = multi.run_forever(interval_s=0.01)
+    thread.join(timeout=5.0)
+    assert not thread.is_alive()
+    assert "store corrupted" in multi.fatal_error
+
+    server = ApiServer(multi=multi).start()
+    try:
+        try:
+            urllib.request.urlopen(server.url + "/v1/health")
+            raise AssertionError("expected 503")
+        except urllib.error.HTTPError as err:
+            assert err.code == 503
+            body = json.loads(err.read().decode())
+            assert "store corrupted" in body["fatal_error"]
+    finally:
+        server.stop()
