@@ -1,0 +1,42 @@
+"""Process entrypoints for the framework.
+
+    python -m dcos_commons_tpu serve svc.yml --topology cluster.yml
+    python -m dcos_commons_tpu agent --host-id h0 --workdir ./sandbox
+    python -m dcos_commons_tpu cli  <verb> ...
+
+Reference: the pair of process mains the reference ships — the
+scheduler process (SchedulerRunner.java:82 via each framework's
+Main.java) and the task-side bootstrap (sdk/bootstrap/main.go:466) —
+plus the operator CLI binary (sdk/cli/main.go:1-12).
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__.strip())
+        return 0 if argv else 1
+    command, rest = argv[0], argv[1:]
+    if command == "serve":
+        from dcos_commons_tpu.runtime.runner import serve_main
+
+        return serve_main(rest)
+    if command == "agent":
+        from dcos_commons_tpu.agent.daemon import main as agent_main
+
+        return agent_main(rest)
+    if command == "cli":
+        from dcos_commons_tpu.cli.commands import main as cli_main
+
+        return cli_main(rest)
+    print(f"unknown command {command!r}; try serve | agent | cli",
+          file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -16,4 +16,25 @@ speaking the same interface over DCN; the scheduler does not care.
 from dcos_commons_tpu.agent.base import Agent
 from dcos_commons_tpu.agent.local import LocalProcessAgent
 
-__all__ = ["Agent", "LocalProcessAgent"]
+
+def __getattr__(name):
+    # daemon/remote pull in http machinery; import lazily so the core
+    # package stays light for workload-only users
+    if name in ("AgentDaemon",):
+        from dcos_commons_tpu.agent.daemon import AgentDaemon
+
+        return AgentDaemon
+    if name in ("RemoteAgentClient", "RemoteFleet"):
+        from dcos_commons_tpu.agent import remote
+
+        return getattr(remote, name)
+    raise AttributeError(name)
+
+
+__all__ = [
+    "Agent",
+    "AgentDaemon",
+    "LocalProcessAgent",
+    "RemoteAgentClient",
+    "RemoteFleet",
+]

@@ -1,0 +1,242 @@
+"""Per-host agent daemon: the task-running half of the control plane.
+
+One daemon process runs on each TPU-VM host and exposes the Agent
+contract over HTTP to the scheduler.  This is the rebuild's analogue of
+the Mesos agent + the reference's task-side bootstrap binary rolled
+into one long-lived process: launch/kill/status cross a real network
+boundary (reference: FrameworkScheduler.java:196 callbacks crossing the
+Mesos master process boundary; sdk/bootstrap/main.go doing task-side
+sandbox preparation), sandboxes are provisioned locally, and config
+templates are pulled from the scheduler's /v1/artifacts endpoint and
+rendered against the task env (sdk/bootstrap/main.go:291-376).
+
+Protocol (JSON over HTTP, scheduler -> agent):
+
+    GET  /v1/agent/info    {host_id, active, uptime_s}
+    POST /v1/agent/launch  {tasks: [{info, readiness?, health?, templates?}]}
+    POST /v1/agent/kill    {task_id, grace_period_s}
+    GET  /v1/agent/tasks   {task_ids: [...]}
+    POST /v1/agent/drain   -> {statuses: [...]}   (drains pending updates)
+    GET  /v1/agent/sandbox?task=<name>&file=<rel> -> file text (debugging)
+
+Statuses are *pulled* by the scheduler (drain), matching the poll-based
+Agent contract — the daemon never needs to know where the scheduler
+lives, which keeps scheduler failover trivial.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from dcos_commons_tpu.agent.local import LocalProcessAgent
+from dcos_commons_tpu.common import TaskInfo
+from dcos_commons_tpu.specification.specs import (
+    HealthCheckSpec,
+    ReadinessCheckSpec,
+)
+
+
+class AgentDaemon:
+    """HTTP front end over a LocalProcessAgent for ONE host."""
+
+    def __init__(
+        self,
+        host_id: str,
+        workdir: str,
+        port: int = 0,
+        bind: str = "127.0.0.1",
+    ):
+        self.host_id = host_id
+        self._executor = LocalProcessAgent(workdir)
+        self._started_at = time.monotonic()
+        daemon = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def _body(self) -> dict:
+                length = int(self.headers.get("Content-Length", 0))
+                if not length:
+                    return {}
+                return json.loads(self.rfile.read(length).decode("utf-8"))
+
+            def _reply(self, code: int, body) -> None:
+                if isinstance(body, str):
+                    payload = body.encode("utf-8")
+                    ctype = "text/plain; charset=utf-8"
+                else:
+                    payload = json.dumps(body).encode("utf-8")
+                    ctype = "application/json"
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):
+                parsed = urlparse(self.path)
+                if parsed.path == "/v1/agent/info":
+                    self._reply(200, daemon.info())
+                elif parsed.path == "/v1/agent/tasks":
+                    self._reply(
+                        200,
+                        {"task_ids": sorted(daemon._executor.active_task_ids())},
+                    )
+                elif parsed.path == "/v1/agent/sandbox":
+                    query = parse_qs(parsed.query)
+                    task = (query.get("task") or [""])[0]
+                    rel = (query.get("file") or ["stdout"])[0]
+                    path = daemon.resolve_sandbox_path(task, rel)
+                    if path is None or not os.path.isfile(path):
+                        self._reply(404, {"message": f"no file {rel}"})
+                        return
+                    with open(path, "r", errors="replace") as f:
+                        self._reply(200, f.read())
+                else:
+                    self._reply(404, {"message": f"no route {parsed.path}"})
+
+            def do_POST(self):
+                parsed = urlparse(self.path)
+                try:
+                    if parsed.path == "/v1/agent/launch":
+                        body = self._body()
+                        launched = daemon.launch(body.get("tasks", []))
+                        self._reply(200, {"launched": launched})
+                    elif parsed.path == "/v1/agent/kill":
+                        body = self._body()
+                        daemon._executor.kill(
+                            body["task_id"],
+                            float(body.get("grace_period_s", 0.0)),
+                        )
+                        self._reply(200, {"message": "kill requested"})
+                    elif parsed.path == "/v1/agent/drain":
+                        statuses = [
+                            s.to_dict() for s in daemon._executor.poll()
+                        ]
+                        self._reply(200, {"statuses": statuses})
+                    else:
+                        self._reply(404, {"message": f"no route {parsed.path}"})
+                except Exception as e:
+                    self._reply(500, {"message": f"agent error: {e}"})
+
+        self._server = ThreadingHTTPServer((bind, port), Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    # -- request handling --------------------------------------------
+
+    def resolve_sandbox_path(self, task: str, rel: str) -> Optional[str]:
+        """Confine sandbox reads to the named task's sandbox: both the
+        task name and the relative path are attacker-controlled query
+        params, so resolve symlinks/.. and require the result to stay
+        under ``<workdir>/<task>/``."""
+        if not task or os.sep in task or task in (".", ".."):
+            return None
+        sandbox = os.path.realpath(self._executor.sandbox_of(task))
+        workdir_prefix = os.path.realpath(self._executor._workdir) + os.sep
+        if not sandbox.startswith(workdir_prefix):
+            return None
+        path = os.path.realpath(os.path.join(sandbox, rel))
+        if path != sandbox and not path.startswith(sandbox + os.sep):
+            return None
+        return path
+
+    def info(self) -> dict:
+        return {
+            "host_id": self.host_id,
+            "active": len(self._executor.active_task_ids()),
+            "uptime_s": round(time.monotonic() - self._started_at, 1),
+            "pid": os.getpid(),
+        }
+
+    def launch(self, tasks: list) -> list:
+        launched = []
+        for entry in tasks:
+            info = TaskInfo.from_dict(entry["info"])
+            readiness = entry.get("readiness")
+            health = entry.get("health")
+            self._executor.launch_one(
+                info,
+                readiness=ReadinessCheckSpec(**readiness) if readiness else None,
+                health=HealthCheckSpec(**health) if health else None,
+                templates=entry.get("templates"),
+            )
+            launched.append(info.task_id)
+        return launched
+
+    # -- lifecycle ----------------------------------------------------
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    @property
+    def url(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "AgentDaemon":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name=f"agent-{self.host_id}",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def serve_forever(self) -> None:
+        self._server.serve_forever()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self._executor.shutdown()
+
+
+def serialize_check(check) -> Optional[dict]:
+    """Check specs -> JSON for the launch request wire format."""
+    if check is None:
+        return None
+    return dataclasses.asdict(check)
+
+
+def main(argv: Optional[list] = None) -> int:
+    """``python -m dcos_commons_tpu agent`` — run one host's daemon."""
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="dcos_commons_tpu agent", description=__doc__.splitlines()[0]
+    )
+    parser.add_argument("--host-id", required=True)
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--bind", default="127.0.0.1")
+    parser.add_argument("--workdir", default="./agent-sandboxes")
+    parser.add_argument(
+        "--announce-file",
+        default="",
+        help="write '<host_id> <url>' here once listening (ephemeral ports)",
+    )
+    args = parser.parse_args(argv)
+    daemon = AgentDaemon(
+        args.host_id, args.workdir, port=args.port, bind=args.bind
+    )
+    if args.announce_file:
+        from dcos_commons_tpu.common import atomic_write_text
+
+        atomic_write_text(
+            args.announce_file, f"{daemon.host_id} {daemon.url}\n"
+        )
+    try:
+        daemon.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        daemon.stop()
+    return 0

@@ -16,13 +16,67 @@ import subprocess
 import threading
 import time
 from dataclasses import dataclass
-from typing import Dict, List, Optional, Set
+from typing import Dict, List, Optional, Set, Tuple
 
 from dcos_commons_tpu.common import TaskInfo, TaskState, TaskStatus
 from dcos_commons_tpu.specification.specs import (
     HealthCheckSpec,
     ReadinessCheckSpec,
 )
+
+
+def prepare_templates(
+    task_env: Dict[str, str],
+    templates: Optional[List[dict]],
+) -> List[Tuple[str, str]]:
+    """Fetch + render config templates; no filesystem writes.
+
+    The task-side half of the per-task config plane: the reference's
+    bootstrap binary fetches each template from the scheduler's
+    /v1/artifacts endpoint and mustache-renders it against the task env
+    (sdk/bootstrap/main.go:291-376).  Each template dict carries
+    ``dest`` (sandbox-relative path) and either inline ``content`` or
+    a ``url`` to fetch from the scheduler.  Kept free of locks and
+    sandbox state: URL fetches can be slow and must not stall the
+    agent's kill/poll handling.
+    """
+    out: List[Tuple[str, str]] = []
+    for template in templates or []:
+        if "error" in template:
+            raise ValueError(template["error"])
+        dest = template["dest"]
+        content = template.get("content")
+        if content is None:
+            url = template.get("url")
+            if not url:
+                raise ValueError(
+                    f"template {template.get('name')!r} has neither "
+                    "content nor url"
+                )
+            import urllib.request
+
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                content = resp.read().decode("utf-8")
+        from dcos_commons_tpu.specification.yaml_spec import render_template
+
+        out.append((dest, render_template(content, task_env)))
+    return out
+
+
+def write_templates(sandbox: str, rendered: List[Tuple[str, str]]) -> None:
+    """Write rendered templates, confined to the sandbox: ``dest`` is
+    remote-controlled (launch request), so absolute paths and ``..``
+    escapes are rejected."""
+    root = os.path.normpath(sandbox)
+    for dest, text in rendered:
+        if os.path.isabs(dest):
+            raise ValueError(f"template dest must be sandbox-relative: {dest}")
+        path = os.path.normpath(os.path.join(root, dest))
+        if not path.startswith(root + os.sep):
+            raise ValueError(f"template dest escapes the sandbox: {dest}")
+        os.makedirs(os.path.dirname(path) or root, exist_ok=True)
+        with open(path, "w") as f:
+            f.write(text)
 
 
 @dataclass
@@ -68,15 +122,50 @@ class LocalProcessAgent:
         info: TaskInfo,
         readiness: Optional[ReadinessCheckSpec] = None,
         health: Optional[HealthCheckSpec] = None,
+        templates: Optional[List[dict]] = None,
     ) -> None:
         with self._lock:
             if info.task_id in self._tasks:
                 return  # idempotent
+        # template fetch/render happens OUTSIDE the lock: a slow
+        # scheduler artifact endpoint must not block kill/poll/tasks
+        # (and thereby trip the fleet's host-down detection)
+        try:
+            rendered = prepare_templates(info.env, templates)
+        except Exception as e:
+            # the reference's bootstrap exits nonzero on a failed
+            # template render, failing the task before its command
+            # ever runs (sdk/bootstrap/main.go:291-376)
+            with self._lock:
+                self._pending.append(
+                    TaskStatus(
+                        task_id=info.task_id,
+                        state=TaskState.ERROR,
+                        message=f"config template render failed: {e}",
+                        agent_id=info.agent_id,
+                    )
+                )
+            return
+        with self._lock:
+            if info.task_id in self._tasks:
+                return  # raced with a duplicate launch
             sandbox = os.path.join(self._workdir, info.name)
             os.makedirs(sandbox, exist_ok=True)
             env = dict(os.environ)
             env.update(info.env)
             env["SANDBOX"] = sandbox
+            try:
+                write_templates(sandbox, rendered)
+            except Exception as e:
+                self._pending.append(
+                    TaskStatus(
+                        task_id=info.task_id,
+                        state=TaskState.ERROR,
+                        message=f"config template render failed: {e}",
+                        agent_id=info.agent_id,
+                    )
+                )
+                return
             try:
                 process = subprocess.Popen(
                     ["/bin/sh", "-c", info.command],

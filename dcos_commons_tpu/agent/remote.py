@@ -1,0 +1,289 @@
+"""RemoteFleet: the scheduler's view of a fleet of per-host agents.
+
+Implements the Agent contract over HTTP against N AgentDaemon
+processes (one per TPU host), making the control plane distributed in
+fact: launches route to the daemon owning the task's placed host,
+statuses are pulled over real sockets, and an unreachable daemon is
+detected and surfaced as host-down + TASK_LOST so the recovery
+machinery replaces its tasks — the role Mesos master partition
+signals play for the reference (FrameworkRunner.java:185-189
+PARTITION_AWARE; agent loss -> TASK_LOST fan-in).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import urllib.error
+import urllib.request
+from typing import Callable, Dict, List, Optional, Set
+
+from dcos_commons_tpu.agent.base import Agent
+from dcos_commons_tpu.agent.daemon import serialize_check
+from dcos_commons_tpu.common import TaskInfo, TaskState, TaskStatus
+
+LOG = logging.getLogger(__name__)
+
+
+class RemoteAgentClient:
+    """HTTP client for one host's AgentDaemon."""
+
+    def __init__(self, host_id: str, base_url: str, timeout_s: float = 5.0):
+        self.host_id = host_id
+        self.base_url = base_url.rstrip("/")
+        self.timeout_s = timeout_s
+
+    def _request(self, method: str, path: str, body: Optional[dict] = None):
+        data = json.dumps(body).encode("utf-8") if body is not None else None
+        req = urllib.request.Request(
+            f"{self.base_url}{path}",
+            data=data,
+            method=method,
+            headers={"Content-Type": "application/json"},
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+            return json.loads(resp.read().decode("utf-8"))
+
+    def info(self) -> dict:
+        return self._request("GET", "/v1/agent/info")
+
+    def launch(self, entries: List[dict]) -> List[str]:
+        return self._request(
+            "POST", "/v1/agent/launch", {"tasks": entries}
+        )["launched"]
+
+    def kill(self, task_id: str, grace_period_s: float) -> None:
+        self._request(
+            "POST",
+            "/v1/agent/kill",
+            {"task_id": task_id, "grace_period_s": grace_period_s},
+        )
+
+    def tasks(self) -> Set[str]:
+        return set(self._request("GET", "/v1/agent/tasks")["task_ids"])
+
+    def drain(self) -> List[TaskStatus]:
+        raw = self._request("POST", "/v1/agent/drain")
+        return [TaskStatus.from_dict(s) for s in raw["statuses"]]
+
+    def sandbox_file(self, task_name: str, rel: str = "stdout") -> str:
+        from urllib.parse import quote
+
+        req = urllib.request.Request(
+            f"{self.base_url}/v1/agent/sandbox"
+            f"?task={quote(task_name)}&file={quote(rel)}"
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+            return resp.read().decode("utf-8")
+
+
+class RemoteFleet(Agent):
+    """Agent multiplexer over per-host daemons, keyed by ``agent_id``.
+
+    Host-down detection: ``down_after`` consecutive failed polls of a
+    daemon declare its host down — tracked tasks on it get synthesized
+    TASK_LOST and ``on_host_down(host_id)`` fires (the runner wires it
+    to SliceInventory.mark_down so placement stops offering the host).
+    A successful poll afterwards fires ``on_host_up``.
+    """
+
+    def __init__(
+        self,
+        timeout_s: float = 5.0,
+        down_after: int = 3,
+        on_host_down: Optional[Callable[[str], None]] = None,
+        on_host_up: Optional[Callable[[str], None]] = None,
+    ):
+        self._clients: Dict[str, RemoteAgentClient] = {}
+        self._timeout_s = timeout_s
+        self._down_after = down_after
+        self._failures: Dict[str, int] = {}
+        self._down: Set[str] = set()
+        # task_id -> host_id for kill routing + LOST synthesis; rebuilt
+        # lazily from daemon task lists after a scheduler restart
+        self._owners: Dict[str, str] = {}
+        self._pending: List[TaskStatus] = []
+        self.on_host_down = on_host_down
+        self.on_host_up = on_host_up
+        self._lock = threading.RLock()
+
+    def add_host(self, host_id: str, url: str) -> None:
+        with self._lock:
+            self._clients[host_id] = RemoteAgentClient(
+                host_id, url, self._timeout_s
+            )
+            self._failures[host_id] = 0
+
+    def hosts(self) -> List[str]:
+        with self._lock:
+            return sorted(self._clients)
+
+    def client(self, host_id: str) -> Optional[RemoteAgentClient]:
+        return self._clients.get(host_id)
+
+    # -- Agent --------------------------------------------------------
+
+    def launch(self, task_infos: List[TaskInfo]) -> None:
+        for info in task_infos:
+            self.launch_one(info)
+
+    def launch_one(
+        self,
+        info: TaskInfo,
+        readiness=None,
+        health=None,
+        templates: Optional[List[dict]] = None,
+    ) -> None:
+        client = self._clients.get(info.agent_id)
+        if client is None:
+            self._fail_launch(info, f"no agent for host {info.agent_id!r}")
+            return
+        entry = {
+            "info": info.to_dict(),
+            "readiness": serialize_check(readiness),
+            "health": serialize_check(health),
+            "templates": templates or [],
+        }
+        try:
+            client.launch([entry])
+        except (urllib.error.URLError, OSError, json.JSONDecodeError) as e:
+            # the daemon may be mid-crash: surface LOST so recovery
+            # replaces the task instead of the step hanging in STARTING
+            self._fail_launch(info, f"agent unreachable at launch: {e}")
+            return
+        with self._lock:
+            self._owners[info.task_id] = info.agent_id
+
+    def _fail_launch(self, info: TaskInfo, message: str) -> None:
+        LOG.warning("launch of %s failed: %s", info.task_id, message)
+        with self._lock:
+            self._pending.append(
+                TaskStatus(
+                    task_id=info.task_id,
+                    state=TaskState.LOST,
+                    message=message,
+                    agent_id=info.agent_id,
+                )
+            )
+
+    def kill(self, task_id: str, grace_period_s: float = 0.0) -> None:
+        with self._lock:
+            owner = self._owners.get(task_id)
+        clients = (
+            [self._clients[owner]]
+            if owner and owner in self._clients
+            # unknown owner (restart before any poll): broadcast — kill
+            # of an unknown id is an idempotent no-op daemon-side
+            else list(self._clients.values())
+        )
+        for client in clients:
+            try:
+                client.kill(task_id, grace_period_s)
+            except (urllib.error.URLError, OSError):
+                pass  # TaskKiller retries until a terminal status lands
+
+    def active_task_ids(self) -> Set[str]:
+        out: Set[str] = set()
+        for host_id, client in list(self._clients.items()):
+            try:
+                ids = client.tasks()
+            except (urllib.error.URLError, OSError):
+                # liveness is only scored by poll() — a scheduler cycle
+                # calls both methods, and double-counting would halve
+                # the documented down_after threshold.  A down host's
+                # tasks count as active until LOST is synthesized by
+                # poll(), so the reconciler doesn't double-report them.
+                with self._lock:
+                    ids = {
+                        t for t, h in self._owners.items() if h == host_id
+                    }
+                out |= ids
+                continue
+            self._note_success(host_id)
+            with self._lock:
+                for task_id in ids:
+                    self._owners.setdefault(task_id, host_id)
+            out |= ids
+        return out
+
+    def poll(self) -> List[TaskStatus]:
+        out: List[TaskStatus] = []
+        with self._lock:
+            out.extend(self._pending)
+            self._pending.clear()
+        for host_id, client in list(self._clients.items()):
+            try:
+                statuses = client.drain()
+            except (urllib.error.URLError, OSError):
+                self._note_failure(host_id)
+                # the threshold may have been crossed by a failed
+                # active_task_ids() call between polls; LOST synthesis
+                # is idempotent (owners entries are consumed), so run
+                # it whenever the host is down
+                with self._lock:
+                    is_down = host_id in self._down
+                if is_down:
+                    out.extend(self._lose_tasks_on(host_id))
+                continue
+            self._note_success(host_id)
+            for status in statuses:
+                with self._lock:
+                    if status.state.is_terminal:
+                        self._owners.pop(status.task_id, None)
+                    else:
+                        self._owners.setdefault(status.task_id, host_id)
+                out.append(status)
+        return out
+
+    # -- host liveness ------------------------------------------------
+
+    def _note_failure(self, host_id: str) -> bool:
+        """Returns True when this failure crosses the down threshold."""
+        with self._lock:
+            self._failures[host_id] = self._failures.get(host_id, 0) + 1
+            if (
+                self._failures[host_id] >= self._down_after
+                and host_id not in self._down
+            ):
+                self._down.add(host_id)
+                LOG.warning(
+                    "agent %s unreachable %d times: declaring host down",
+                    host_id, self._failures[host_id],
+                )
+                callback = self.on_host_down
+            else:
+                return False
+        if callback is not None:
+            callback(host_id)
+        return True
+
+    def _note_success(self, host_id: str) -> None:
+        with self._lock:
+            self._failures[host_id] = 0
+            if host_id not in self._down:
+                return
+            self._down.discard(host_id)
+            callback = self.on_host_up
+        LOG.info("agent %s reachable again: host back up", host_id)
+        if callback is not None:
+            callback(host_id)
+
+    def _lose_tasks_on(self, host_id: str) -> List[TaskStatus]:
+        with self._lock:
+            lost = [t for t, h in self._owners.items() if h == host_id]
+            for task_id in lost:
+                del self._owners[task_id]
+        return [
+            TaskStatus(
+                task_id=task_id,
+                state=TaskState.LOST,
+                message=f"host {host_id} unreachable",
+                agent_id=host_id,
+            )
+            for task_id in lost
+        ]
+
+    def down_hosts(self) -> Set[str]:
+        with self._lock:
+            return set(self._down)

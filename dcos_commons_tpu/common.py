@@ -206,6 +206,17 @@ class TaskStatus(SerializableMixin):
             self.timestamp = time.time()
 
 
+def atomic_write_text(path: str, content: str) -> None:
+    """Write-tmp-then-rename so readers never see a partial file
+    (announce files, PID files)."""
+    import os
+
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(content)
+    os.replace(tmp, path)
+
+
 class Label:
     """Well-known label keys (reference: offer/taskdata/LabelConstants.java)."""
 

@@ -1,0 +1,335 @@
+"""FrameworkRunner: the scheduler-process entrypoint.
+
+Reference: framework/FrameworkRunner.java:90 (registerAndRunFramework)
++ scheduler/SchedulerRunner.java:82-101 (lock -> metrics -> build ->
+run) + curator/CuratorLocker.java (single-instance mutex).  This is
+what makes the framework startable as a *service*:
+
+    python -m dcos_commons_tpu serve svc.yml --topology cluster.yml
+
+takes an exclusive file lock on the state directory (two schedulers
+over one state store corrupt plans — the CuratorLocker's job), loads
+the fleet topology, connects the per-host agent daemons, starts the
+API server BEFORE the event loop accepts work (FrameworkRunner.java:
+130-138), and runs until stopped or wedged.
+
+Exit codes (reference: framework/ProcessExit.java):
+    0  uninstall completed / clean stop
+    2  scheduler wedged (fatal_error set by run_forever)
+    3  another scheduler instance holds the lock
+    4  invalid configuration
+"""
+
+from __future__ import annotations
+
+import fcntl
+import logging
+import os
+import signal
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import yaml
+
+from dcos_commons_tpu.offer.inventory import SliceInventory, TpuHost
+from dcos_commons_tpu.scheduler.builder import SchedulerBuilder
+from dcos_commons_tpu.scheduler.config import SchedulerConfig
+
+LOG = logging.getLogger(__name__)
+
+EXIT_WEDGED = 2
+EXIT_LOCKED = 3
+EXIT_BAD_CONFIG = 4
+
+
+class InstanceLock:
+    """Exclusive advisory lock: one scheduler per state directory.
+
+    Reference: curator/CuratorLocker.java — taken in
+    SchedulerRunner.run() before anything touches the state store."""
+
+    def __init__(self, state_dir: str):
+        os.makedirs(state_dir, exist_ok=True)
+        self._path = os.path.join(state_dir, "scheduler.lock")
+        self._fd: Optional[int] = None
+
+    def acquire(self) -> bool:
+        fd = os.open(self._path, os.O_CREAT | os.O_RDWR, 0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        except OSError:
+            os.close(fd)
+            return False
+        os.ftruncate(fd, 0)
+        os.write(fd, str(os.getpid()).encode())
+        self._fd = fd
+        return True
+
+    def release(self) -> None:
+        if self._fd is not None:
+            fcntl.flock(self._fd, fcntl.LOCK_UN)
+            os.close(self._fd)
+            self._fd = None
+
+
+def load_topology(path: str) -> Tuple[List[TpuHost], Dict[str, str]]:
+    """Parse a fleet topology YAML into hosts + agent-daemon URLs.
+
+    Format (one entry per TPU-VM host)::
+
+        hosts:
+          - host_id: pod-0-h0-0
+            agent_url: http://10.0.0.1:8476   # omit for in-process mode
+            slice_id: pod-0
+            generation: v5e
+            grid: [0, 0]
+            chip_block: [2, 2]
+            cpus: 16
+            memory_mb: 65536
+            zone: z0
+
+    Every host must either have an ``agent_url`` (remote fleet) or none
+    may (single-process local mode) — mixing the two would leave some
+    placements unlaunchable.
+    """
+    with open(path) as f:
+        raw = yaml.safe_load(f) or {}
+    hosts: List[TpuHost] = []
+    urls: Dict[str, str] = {}
+    for entry in raw.get("hosts", []):
+        entry = dict(entry)
+        url = entry.pop("agent_url", "")
+        host = TpuHost(
+            host_id=entry["host_id"],
+            hostname=entry.get("hostname", ""),
+            slice_id=entry.get("slice_id", ""),
+            generation=entry.get("generation", ""),
+            grid=tuple(entry.get("grid", (0, 0))),
+            chip_block=tuple(entry.get("chip_block", (0, 0))),
+            cpus=float(entry.get("cpus", 8.0)),
+            memory_mb=int(entry.get("memory_mb", 16384)),
+            disk_mb=int(entry.get("disk_mb", 102400)),
+            attributes=dict(entry.get("attributes", {})),
+            zone=entry.get("zone", ""),
+            region=entry.get("region", ""),
+        )
+        hosts.append(host)
+        if url:
+            urls[host.host_id] = url
+    if not hosts:
+        raise ValueError(f"topology {path} defines no hosts")
+    if urls and len(urls) != len(hosts):
+        missing = [h.host_id for h in hosts if h.host_id not in urls]
+        raise ValueError(
+            f"topology mixes remote and local hosts; no agent_url for: "
+            f"{missing}"
+        )
+    return hosts, urls
+
+
+class FrameworkRunner:
+    """Build and run one service scheduler as a long-lived process."""
+
+    def __init__(
+        self,
+        spec,
+        config: Optional[SchedulerConfig] = None,
+        topology_hosts: Optional[List[TpuHost]] = None,
+        agent_urls: Optional[Dict[str, str]] = None,
+    ):
+        self.spec = spec
+        self.config = config or SchedulerConfig.from_env()
+        self.topology_hosts = topology_hosts or []
+        self.agent_urls = agent_urls or {}
+        self.scheduler = None
+        self.api_server = None
+        self.fleet = None
+        # when set, '<url>' is written here once the API is listening
+        # (lets launchers discover an ephemeral port)
+        self.announce_file: str = ""
+        # API bind address; 127.0.0.1 suits single-machine fleets, a
+        # real multi-host deployment binds 0.0.0.0 (or the DCN address)
+        self.api_bind: str = "127.0.0.1"
+        # externally-reachable URL agents use to pull /v1/artifacts;
+        # REQUIRED for remote fleets not on this machine — the default
+        # (the server's own loopback URL) is meaningless on other hosts
+        self.advertise_url: str = ""
+        self._lock = InstanceLock(self.config.state_dir)
+        self._stop_requested = threading.Event()
+
+    # -- assembly -----------------------------------------------------
+
+    def build(self) -> None:
+        inventory = SliceInventory(self.topology_hosts)
+        if self.agent_urls:
+            from dcos_commons_tpu.agent.remote import RemoteFleet
+
+            fleet = RemoteFleet(
+                on_host_down=inventory.mark_down,
+                on_host_up=inventory.mark_up,
+            )
+            for host_id, url in self.agent_urls.items():
+                fleet.add_host(host_id, url)
+            agent = fleet
+            self.fleet = fleet
+        else:
+            from dcos_commons_tpu.agent.local import LocalProcessAgent
+
+            agent = LocalProcessAgent(self.config.sandbox_root)
+        builder = SchedulerBuilder(self.spec, self.config)
+        builder.set_inventory(inventory)
+        builder.set_agent(agent)
+        self.scheduler = builder.build()
+
+    def run(self) -> int:
+        """Lock -> build -> serve -> loop.  Returns a process exit code."""
+        if not self._lock.acquire():
+            LOG.error(
+                "another scheduler instance holds the lock for %s",
+                self.config.state_dir,
+            )
+            return EXIT_LOCKED
+        try:
+            return self._run_locked()
+        finally:
+            self._lock.release()
+
+    def _run_locked(self) -> int:
+        from dcos_commons_tpu.http.server import ApiServer
+
+        try:
+            self.build()
+        except Exception:
+            LOG.exception("invalid configuration")
+            return EXIT_BAD_CONFIG
+        # API up before the loop starts taking work, so operators can
+        # always observe (FrameworkRunner.java:130-138)
+        self.api_server = ApiServer(
+            self.scheduler, port=self.config.api_port, host=self.api_bind
+        ).start()
+        thread = None
+        try:
+            if hasattr(self.scheduler, "artifact_base"):
+                self.scheduler.artifact_base = (
+                    self.advertise_url.rstrip("/") or self.api_server.url
+                )
+            if self.announce_file:
+                from dcos_commons_tpu.common import atomic_write_text
+
+                atomic_write_text(
+                    self.announce_file, self.api_server.url + "\n"
+                )
+            LOG.info(
+                "serving %s on %s (%d hosts, %s agents)",
+                self.spec.name,
+                self.api_server.url,
+                len(self.topology_hosts),
+                "remote" if self.agent_urls else "local",
+            )
+            thread = self.scheduler.run_forever()
+            try:
+                while thread.is_alive() and not self._stop_requested.is_set():
+                    thread.join(timeout=0.5)
+                    if self._uninstall_finished():
+                        break
+            except KeyboardInterrupt:
+                pass
+        finally:
+            self.scheduler.stop()
+            if thread is not None:
+                thread.join(timeout=10)
+            self.api_server.stop()
+        fatal = getattr(self.scheduler, "fatal_error", None)
+        if fatal:
+            LOG.critical("scheduler wedged: %s", fatal)
+            return EXIT_WEDGED
+        return 0
+
+    def _uninstall_finished(self) -> bool:
+        is_complete = getattr(self.scheduler, "is_complete", None)
+        return bool(self.config.uninstall and is_complete and is_complete())
+
+    def stop(self) -> None:
+        self._stop_requested.set()
+        if self.scheduler is not None:
+            self.scheduler.stop()
+
+
+def serve_main(argv: Optional[List[str]] = None) -> int:
+    """``python -m dcos_commons_tpu serve`` argument handling."""
+    import argparse
+
+    from dcos_commons_tpu.specification.yaml_spec import from_yaml_file
+
+    parser = argparse.ArgumentParser(
+        prog="dcos_commons_tpu serve",
+        description="Run a service scheduler process",
+    )
+    parser.add_argument("svc_yml", help="service definition YAML")
+    parser.add_argument(
+        "--topology", required=True, help="fleet topology YAML (hosts)"
+    )
+    parser.add_argument("--port", type=int, default=None, help="API port")
+    parser.add_argument("--state-dir", default=None)
+    parser.add_argument("--sandbox-root", default=None)
+    parser.add_argument(
+        "--env",
+        action="append",
+        default=[],
+        metavar="KEY=VALUE",
+        help="extra env for svc.yml template interpolation",
+    )
+    parser.add_argument(
+        "--announce-file",
+        default="",
+        help="write the API URL here once listening (ephemeral ports)",
+    )
+    parser.add_argument(
+        "--bind",
+        default="127.0.0.1",
+        help="API bind address (0.0.0.0 for multi-host fleets)",
+    )
+    parser.add_argument(
+        "--advertise-url",
+        default="",
+        help="externally-reachable API URL handed to agents for "
+             "artifact pulls (required when agents run on other hosts)",
+    )
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=os.environ.get("LOG_LEVEL", "INFO"),
+        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+    )
+    env = dict(os.environ)
+    for pair in args.env:
+        key, _, value = pair.partition("=")
+        env[key] = value
+    config = SchedulerConfig.from_env(env)
+    if args.port is not None:
+        config.api_port = args.port
+    if args.state_dir is not None:
+        config.state_dir = args.state_dir
+    if args.sandbox_root is not None:
+        config.sandbox_root = args.sandbox_root
+    try:
+        spec = from_yaml_file(args.svc_yml, env)
+        hosts, urls = load_topology(args.topology)
+    except Exception as e:
+        print(f"configuration error: {e}", file=sys.stderr)
+        return EXIT_BAD_CONFIG
+    runner = FrameworkRunner(
+        spec, config, topology_hosts=hosts, agent_urls=urls
+    )
+    runner.announce_file = args.announce_file
+    runner.api_bind = args.bind
+    runner.advertise_url = args.advertise_url
+
+    def _sigterm(signum, frame):
+        runner.stop()
+
+    signal.signal(signal.SIGTERM, _sigterm)
+    signal.signal(signal.SIGINT, _sigterm)
+    return runner.run()

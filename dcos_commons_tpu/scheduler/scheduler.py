@@ -112,6 +112,12 @@ class DefaultScheduler:
                 refill_interval_s=defaults.revive_refill_s,
             )
         self.revive_bucket = revive_bucket
+        # base URL of this scheduler's own API server, set by the serve
+        # runner; when present agents pull config templates from
+        # /v1/artifacts over HTTP (the reference bootstrap flow,
+        # sdk/bootstrap/main.go:291-376); when absent (in-process
+        # tests/bench) template content ships inline with the launch
+        self.artifact_base: Optional[str] = None
         self._suppressed = False
         self._fatal_error: Optional[str] = None
         self._stop = threading.Event()
@@ -324,9 +330,42 @@ class DefaultScheduler:
                     info,
                     readiness=None if paused else task_spec.readiness_check,
                     health=None if paused else task_spec.health_check,
+                    templates=self._templates_for(info, task_spec),
                 )
             else:
                 self.agent.launch([info])
+
+    def _templates_for(self, info, task_spec) -> List[dict]:
+        """Config templates for the agent to render into the sandbox.
+
+        URL mode (serve): the agent pulls from this scheduler's
+        /v1/artifacts endpoint, pinned to the task's target config id
+        so a mid-rollout task renders ITS config version (reference:
+        ArtifactResource.java:50 path carries the config UUID).
+        Inline mode: template text is read here and shipped with the
+        launch request."""
+        import os as _os
+
+        out: List[dict] = []
+        for template_path, dest in task_spec.config_templates:
+            name = _os.path.basename(template_path)
+            entry: dict = {"name": name, "dest": dest}
+            if self.artifact_base:
+                target = info.labels.get(Label.TARGET_CONFIG, "")
+                entry["url"] = (
+                    f"{self.artifact_base}/v1/artifacts/template/"
+                    f"{target}/{info.pod_type}/{task_spec.name}/{name}"
+                )
+            else:
+                try:
+                    with open(template_path, "r") as f:
+                        entry["content"] = f.read()
+                except OSError as e:
+                    # ship the failure to the agent: the task must
+                    # ERROR rather than run with a missing config
+                    entry["error"] = f"unreadable template: {e}"
+            out.append(entry)
+        return out
 
     def _kill_orphans(self) -> None:
         """Kill agent tasks this service's store does not own — either

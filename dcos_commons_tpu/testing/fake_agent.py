@@ -38,7 +38,8 @@ class FakeAgent:
         for info in task_infos:
             self.launch_one(info)
 
-    def launch_one(self, info: TaskInfo, readiness=None, health=None) -> None:
+    def launch_one(self, info: TaskInfo, readiness=None, health=None,
+                   templates=None) -> None:
         with self._lock:
             if info.task_id in self._active:
                 return  # idempotent, like the real agent

@@ -1,0 +1,294 @@
+"""The distributed control plane: agent daemons + RemoteFleet.
+
+Statuses cross real sockets here: each "host" is an AgentDaemon HTTP
+server with its own sandbox tree, the scheduler talks to them through
+RemoteFleet, and killing a daemon triggers host-down detection +
+PERMANENT recovery onto a surviving host — the category gap called out
+in VERDICT.md item 1 (reference: FrameworkScheduler callbacks crossing
+the Mesos process boundary, FrameworkScheduler.java:196).
+"""
+
+import time
+
+import pytest
+
+from dcos_commons_tpu.agent.daemon import AgentDaemon
+from dcos_commons_tpu.agent.remote import RemoteAgentClient, RemoteFleet
+from dcos_commons_tpu.common import TaskInfo, TaskState
+from dcos_commons_tpu.offer.inventory import SliceInventory, TpuHost
+from dcos_commons_tpu.recovery.monitor import TestingFailureMonitor
+from dcos_commons_tpu.scheduler import SchedulerBuilder, SchedulerConfig
+from dcos_commons_tpu.specification import from_yaml
+from dcos_commons_tpu.storage import MemPersister
+
+SERVERS_YAML = """
+name: web
+pods:
+  app:
+    count: 2
+    placement: 'max-per-host:1'
+    tasks:
+      server:
+        goal: RUNNING
+        cmd: "echo serving > out.txt && sleep 60"
+        cpus: 0.1
+        memory: 32
+"""
+
+
+@pytest.fixture
+def daemons(tmp_path):
+    started = []
+
+    def make(host_id):
+        daemon = AgentDaemon(
+            host_id, str(tmp_path / f"sandbox-{host_id}")
+        ).start()
+        started.append(daemon)
+        return daemon
+
+    yield make
+    for daemon in started:
+        daemon.stop()
+
+
+def drive(scheduler, until, timeout_s=15.0, interval_s=0.05):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        scheduler.run_cycle()
+        if until(scheduler):
+            return True
+        time.sleep(interval_s)
+    return False
+
+
+def test_daemon_launch_drain_roundtrip(daemons):
+    daemon = daemons("h0")
+    client = RemoteAgentClient("h0", daemon.url)
+    assert client.info()["host_id"] == "h0"
+    info = TaskInfo(
+        name="app-0-server",
+        task_id="app-0-server__1",
+        agent_id="h0",
+        command="echo hi > out.txt && sleep 0.5",
+    )
+    client.launch([{"info": info.to_dict()}])
+    assert "app-0-server__1" in client.tasks()
+    states = []
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        states += [s.state for s in client.drain()]
+        if TaskState.FINISHED in states:
+            break
+        time.sleep(0.05)
+    assert TaskState.RUNNING in states
+    assert TaskState.FINISHED in states
+    assert client.sandbox_file("app-0-server", "out.txt").strip() == "hi"
+
+
+def test_daemon_renders_templates_before_launch(daemons):
+    daemon = daemons("h0")
+    client = RemoteAgentClient("h0", daemon.url)
+    info = TaskInfo(
+        name="app-0-server",
+        task_id="app-0-server__t",
+        agent_id="h0",
+        command="cat conf/app.cfg > rendered.txt",
+        env={"APP_PORT": "8080"},
+    )
+    client.launch([{
+        "info": info.to_dict(),
+        "templates": [{
+            "name": "app.cfg",
+            "dest": "conf/app.cfg",
+            "content": "port={{APP_PORT}} mode={{MODE:-prod}}\n",
+        }],
+    }])
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if any(s.state is TaskState.FINISHED for s in client.drain()):
+            break
+        time.sleep(0.05)
+    assert client.sandbox_file("app-0-server", "rendered.txt").strip() == \
+        "port=8080 mode=prod"
+
+
+def test_template_render_failure_fails_task(daemons):
+    daemon = daemons("h0")
+    client = RemoteAgentClient("h0", daemon.url)
+    info = TaskInfo(
+        name="app-0-server", task_id="app-0-server__e", agent_id="h0",
+        command="sleep 60",
+    )
+    client.launch([{
+        "info": info.to_dict(),
+        "templates": [{
+            "name": "bad.cfg", "dest": "bad.cfg",
+            "content": "value={{UNSET_VARIABLE}}\n",
+        }],
+    }])
+    deadline = time.monotonic() + 5
+    errored = []
+    while time.monotonic() < deadline and not errored:
+        errored = [s for s in client.drain() if s.state is TaskState.ERROR]
+        time.sleep(0.05)
+    assert errored and "template" in errored[0].message
+
+
+def test_sandbox_read_confined_to_task_sandbox(daemons, tmp_path):
+    daemon = daemons("h0")
+    secret = tmp_path / "secret.txt"
+    secret.write_text("s3cret")
+    import urllib.error
+    import urllib.request
+    from urllib.parse import quote
+
+    for task, rel in [
+        ("../..", "secret.txt"),             # traversal via task name
+        ("app-0-server", "../../secret.txt"),  # traversal via file path
+        ("app-0-server", str(secret)),       # absolute path
+    ]:
+        url = (
+            f"{daemon.url}/v1/agent/sandbox"
+            f"?task={quote(task)}&file={quote(rel)}"
+        )
+        with pytest.raises(urllib.error.HTTPError) as err:
+            urllib.request.urlopen(url, timeout=5)
+        assert err.value.code == 404
+
+
+def test_template_dest_escape_fails_task(daemons, tmp_path):
+    daemon = daemons("h0")
+    client = RemoteAgentClient("h0", daemon.url)
+    info = TaskInfo(
+        name="app-0-server", task_id="app-0-server__esc", agent_id="h0",
+        command="sleep 60",
+    )
+    client.launch([{
+        "info": info.to_dict(),
+        "templates": [{
+            "name": "evil", "dest": "../outside.txt", "content": "x",
+        }],
+    }])
+    deadline = time.monotonic() + 5
+    errored = []
+    while time.monotonic() < deadline and not errored:
+        errored = [s for s in client.drain() if s.state is TaskState.ERROR]
+        time.sleep(0.05)
+    assert errored and "escapes the sandbox" in errored[0].message
+    assert not (tmp_path / "sandbox-h0" / "outside.txt").exists()
+
+
+def build_remote_scheduler(yaml_text, fleet, hosts, tmp_path, monitor=None):
+    spec = from_yaml(yaml_text)
+    builder = SchedulerBuilder(
+        spec,
+        SchedulerConfig(
+            sandbox_root=str(tmp_path / "unused"), backoff_enabled=False
+        ),
+        MemPersister(),
+    )
+    builder.set_inventory(SliceInventory(hosts))
+    builder.set_agent(fleet)
+    if monitor is not None:
+        builder.set_failure_monitor(monitor)
+    return builder.build()
+
+
+def test_deploy_across_remote_daemons(daemons, tmp_path):
+    fleet = RemoteFleet()
+    hosts = []
+    for i in range(2):
+        daemon = daemons(f"h{i}")
+        fleet.add_host(f"h{i}", daemon.url)
+        hosts.append(TpuHost(host_id=f"h{i}"))
+    scheduler = build_remote_scheduler(SERVERS_YAML, fleet, hosts, tmp_path)
+    assert drive(
+        scheduler, lambda s: s.deploy_manager.get_plan().is_complete
+    )
+    # one instance per host, placed and launched over the wire
+    placed = {
+        scheduler.state_store.fetch_task(f"app-{i}-server").agent_id
+        for i in range(2)
+    }
+    assert placed == {"h0", "h1"}
+    for i in range(2):
+        info = scheduler.state_store.fetch_task(f"app-{i}-server")
+        out = fleet.client(info.agent_id).sandbox_file(
+            "app-%d-server" % i, "out.txt"
+        )
+        assert out.strip() == "serving"
+
+
+def test_daemon_death_triggers_host_down_and_replace(daemons, tmp_path):
+    inventory_hosts = [TpuHost(host_id=f"h{i}") for i in range(3)]
+    fleet = RemoteFleet(down_after=2, timeout_s=1.0)
+    victim = daemons("h0")
+    for i, host in enumerate(inventory_hosts[:2]):
+        daemon = victim if i == 0 else daemons(f"h{i}")
+        fleet.add_host(f"h{i}", daemon.url)
+    spare = daemons("h2")
+    fleet.add_host("h2", spare.url)
+    scheduler = build_remote_scheduler(
+        SERVERS_YAML,
+        fleet,
+        inventory_hosts,
+        tmp_path,
+        # any terminal failure of these tasks escalates to PERMANENT
+        monitor=TestingFailureMonitor(
+            ["app-0-server", "app-1-server"]
+        ),
+    )
+    fleet.on_host_down = scheduler.inventory.mark_down
+    fleet.on_host_up = scheduler.inventory.mark_up
+    assert drive(
+        scheduler, lambda s: s.deploy_manager.get_plan().is_complete
+    )
+    placed = {
+        i: scheduler.state_store.fetch_task(f"app-{i}-server").agent_id
+        for i in range(2)
+    }
+    victim_index = next(i for i, h in placed.items() if h == "h0")
+
+    victim.stop()  # the host dies
+
+    def replaced(s):
+        info = s.state_store.fetch_task(f"app-{victim_index}-server")
+        status = s.state_store.fetch_status(f"app-{victim_index}-server")
+        return (
+            info is not None
+            and info.agent_id != "h0"
+            and status is not None
+            and status.task_id == info.task_id
+            and status.state is TaskState.RUNNING
+        )
+
+    assert drive(scheduler, replaced, timeout_s=30.0)
+    assert "h0" in fleet.down_hosts()
+    assert not scheduler.inventory.is_up("h0")
+    # the survivor never flapped
+    other_index = 1 - victim_index
+    other = scheduler.state_store.fetch_task(f"app-{other_index}-server")
+    assert other.agent_id == placed[other_index]
+
+
+def test_fleet_kill_unknown_owner_broadcasts(daemons):
+    fleet = RemoteFleet()
+    d0, d1 = daemons("h0"), daemons("h1")
+    fleet.add_host("h0", d0.url)
+    fleet.add_host("h1", d1.url)
+    info = TaskInfo(
+        name="app-0-server", task_id="app-0-server__b", agent_id="h1",
+        command="sleep 60",
+    )
+    RemoteAgentClient("h1", d1.url).launch([{"info": info.to_dict()}])
+    # fleet has no owner record (scheduler restart scenario)
+    fleet.kill("app-0-server__b")
+    deadline = time.monotonic() + 10
+    killed = False
+    while time.monotonic() < deadline and not killed:
+        killed = any(
+            s.state is TaskState.KILLED for s in fleet.poll()
+        )
+        time.sleep(0.05)
+    assert killed

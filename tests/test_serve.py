@@ -1,0 +1,183 @@
+"""The serve entrypoint: real scheduler + agent PROCESSES end to end.
+
+Everything here crosses process boundaries: agents are
+``python -m dcos_commons_tpu agent`` subprocesses, the scheduler is a
+``serve`` subprocess discovered via announce files and driven purely
+over its HTTP API with the integration harness (the sdk_plan/sdk_tasks
+analogue flow).  Covers VERDICT.md items 1 (distributed control
+plane), 2 (scheduler-process entrypoint + instance lock) and 7
+(integration harness) in one place.  Reference call stack:
+SchedulerRunner.java:82-101 -> FrameworkRunner.java:90.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from dcos_commons_tpu.runtime.runner import EXIT_LOCKED, load_topology
+from dcos_commons_tpu.testing.integration import (
+    AgentProcess,
+    SchedulerProcess,
+    ServiceClient,
+    wait_for,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SVC_YAML = """
+name: webfarm
+pods:
+  app:
+    count: 2
+    placement: 'max-per-host:1'
+    tasks:
+      server:
+        goal: RUNNING
+        cmd: "echo serving-$POD_INSTANCE_INDEX > out.txt && sleep 120"
+        cpus: 0.1
+        memory: 32
+"""
+
+
+def write_topology(path, agents, spare=()):
+    lines = ["hosts:"]
+    for agent in agents:
+        lines += [
+            f"  - host_id: {agent.host_id}",
+            f"    agent_url: {agent.url}",
+            "    cpus: 4.0",
+            "    memory_mb: 8192",
+        ]
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    """3 agent daemons + topology + svc.yml, ready to serve."""
+    agents = [
+        AgentProcess(f"h{i}", str(tmp_path / f"agent-{i}"), REPO)
+        for i in range(3)
+    ]
+    svc = tmp_path / "svc.yml"
+    svc.write_text(SVC_YAML)
+    topology = tmp_path / "topology.yml"
+    write_topology(str(topology), agents)
+    yield {"agents": agents, "svc": str(svc), "topology": str(topology)}
+    for agent in agents:
+        agent.stop()
+
+
+def test_serve_deploys_and_recovers_across_processes(cluster, tmp_path):
+    scheduler = SchedulerProcess(
+        cluster["svc"],
+        cluster["topology"],
+        str(tmp_path / "scheduler"),
+        env={
+            "ENABLE_BACKOFF": "false",
+            # fast TRANSIENT->PERMANENT escalation so a killed agent's
+            # task is replaced on a surviving host quickly
+            "PERMANENT_FAILURE_TIMEOUT_S": "1",
+        },
+        repo_root=REPO,
+    )
+    try:
+        client = scheduler.client()
+        client.wait_for_completed_deployment(timeout_s=60)
+        ids = client.task_ids()
+        assert set(ids) == {"app-0-server", "app-1-server"}
+
+        # find which agent process hosts app-0-server and kill it
+        placed = {
+            t["name"]: t
+            for pod in client.get("/v1/pod/status")["pods"]
+            for inst in pod["instances"] for t in inst["tasks"]
+        }
+        infos = client.get("/v1/pod/app-0/info")
+        victim_host = infos[0]["agent_id"]
+        victim = next(
+            a for a in cluster["agents"] if a.host_id == victim_host
+        )
+        victim.kill()
+
+        # recovery replaces the lost task on another host, new task id
+        new_ids = client.wait_for_tasks_updated(
+            {"app-0-server": ids["app-0-server"]},
+            prefix="app-0",
+            timeout_s=90,
+        )
+        assert new_ids["app-0-server"] != ids["app-0-server"]
+        infos = client.get("/v1/pod/app-0/info")
+        assert infos[0]["agent_id"] != victim_host
+        # the untouched pod never restarted
+        client.check_tasks_not_updated(ids, prefix="app-1")
+
+        health = client.get("/v1/health")
+        assert health["healthy"]
+    finally:
+        code = scheduler.terminate()
+        assert code == 0, scheduler.log_tail()
+
+
+def test_second_scheduler_instance_is_locked_out(cluster, tmp_path):
+    first = SchedulerProcess(
+        cluster["svc"], cluster["topology"], str(tmp_path / "s1"),
+        repo_root=REPO,
+    )
+    try:
+        first.client().wait_for_plan_status("deploy", "COMPLETE", 60)
+        # same state dir -> must refuse to start
+        second = subprocess.run(
+            [
+                sys.executable, "-m", "dcos_commons_tpu", "serve",
+                cluster["svc"],
+                "--topology", cluster["topology"],
+                "--port", "0",
+                "--state-dir", os.path.join(str(tmp_path / "s1"), "state"),
+                "--sandbox-root", str(tmp_path / "s2-sandboxes"),
+            ],
+            cwd=REPO,
+            capture_output=True,
+            timeout=60,
+        )
+        assert second.returncode == EXIT_LOCKED, second.stderr.decode()
+    finally:
+        assert first.terminate() == 0
+
+
+def test_scheduler_restart_resumes_over_same_state(cluster, tmp_path):
+    workdir = str(tmp_path / "scheduler")
+    scheduler = SchedulerProcess(
+        cluster["svc"], cluster["topology"], workdir, repo_root=REPO,
+    )
+    client = scheduler.client()
+    client.wait_for_completed_deployment(timeout_s=60)
+    ids = client.task_ids()
+    assert scheduler.terminate() == 0
+
+    # agents keep running their tasks; a new scheduler process over the
+    # same state dir reconciles instead of redeploying
+    scheduler = SchedulerProcess(
+        cluster["svc"], cluster["topology"], workdir, repo_root=REPO,
+    )
+    try:
+        client = scheduler.client()
+        client.wait_for_completed_deployment(timeout_s=60)
+        client.check_tasks_not_updated(ids)
+    finally:
+        assert scheduler.terminate() == 0
+
+
+def test_load_topology_rejects_mixed_mode(tmp_path):
+    path = tmp_path / "topology.yml"
+    path.write_text(
+        "hosts:\n"
+        "  - host_id: h0\n"
+        "    agent_url: http://127.0.0.1:1\n"
+        "  - host_id: h1\n"
+    )
+    with pytest.raises(ValueError, match="no agent_url"):
+        load_topology(str(path))
