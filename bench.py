@@ -1,11 +1,14 @@
 """Benchmark: the BASELINE.md headline on real TPU hardware.
 
-Phase 1 — config 3 of BASELINE.json: the control plane deploys
-frameworks/jax svc_mnist.yml (single-host, 1 chip) and the deploy plan
-runs a REAL JAX training subprocess on the TPU; we measure install ->
-plan COMPLETE wall-clock.  The reference publishes no numbers
-(BASELINE.md), so vs_baseline is measured against the 60 s target
-budget recorded there (>1.0 = faster than budget).
+Phase 1 — BASELINE.json configs through the real control plane with a
+real process-launching agent:
+  #1 frameworks/helloworld simple.yml single-pod deploy
+  #2 frameworks/helloworld max_per_host.yml (constraint respected)
+  #3 frameworks/jax svc_mnist.yml — a REAL JAX training subprocess on
+     the TPU; install -> plan COMPLETE wall-clock is the headline.
+The reference publishes no numbers (BASELINE.md), so vs_baseline is
+measured against the 60 s target budget recorded there (>1.0 = faster
+than budget).
 
 Phase 2 (extras) — flagship transformer train-step throughput on the
 chip (tokens/s + model FLOPs utilisation), the forward-looking perf
@@ -27,25 +30,99 @@ sys.path.insert(0, REPO)
 DEPLOY_BUDGET_S = 60.0
 
 
-def bench_deploy() -> dict:
-    """Control-plane deploy of the single-chip MNIST service."""
-    import shutil
+def _run_deploy(yaml_path: str, env: dict, hosts, budget_s: float = 600.0):
+    """Deploy one service YAML through the full control plane with a
+    real process-launching agent; returns (elapsed, completed,
+    scheduler, agent, workdir)."""
     import tempfile
 
     from dcos_commons_tpu.agent import LocalProcessAgent
-    from dcos_commons_tpu.offer.inventory import SliceInventory, TpuHost
+    from dcos_commons_tpu.offer.inventory import SliceInventory
     from dcos_commons_tpu.scheduler import SchedulerBuilder, SchedulerConfig
-    from dcos_commons_tpu.specification import from_yaml_file
     from dcos_commons_tpu.storage import FileWalPersister
 
     workdir = tempfile.mkdtemp(prefix="bench-")
-    spec = from_yaml_file(
-        os.path.join(REPO, "frameworks/jax/svc_mnist.yml"),
-        {
-            "JAX_FRAMEWORK_DIR": os.path.join(REPO, "frameworks/jax"),
-            "TRAIN_STEPS": os.environ.get("BENCH_MNIST_STEPS", "40"),
-        },
+    from dcos_commons_tpu.specification import from_yaml_file
+
+    spec = from_yaml_file(yaml_path, env)
+    builder = SchedulerBuilder(
+        spec,
+        SchedulerConfig(
+            sandbox_root=os.path.join(workdir, "sandboxes"),
+            backoff_enabled=False,
+        ),
+        FileWalPersister(os.path.join(workdir, "state"), fsync=False),
     )
+    builder.set_inventory(SliceInventory(list(hosts)))
+    agent = LocalProcessAgent(os.path.join(workdir, "sandboxes"))
+    builder.set_agent(agent)
+    scheduler = builder.build()
+
+    t0 = time.monotonic()
+    deadline = t0 + budget_s
+    completed = False
+    while time.monotonic() < deadline:
+        scheduler.run_cycle()
+        if scheduler.deploy_manager.get_plan().is_complete:
+            completed = True
+            break
+        time.sleep(0.1)
+    elapsed = time.monotonic() - t0
+    return elapsed, completed, scheduler, agent, workdir
+
+
+def _cpu_hosts(n: int):
+    from dcos_commons_tpu.offer.inventory import TpuHost
+
+    return [
+        TpuHost(host_id=f"host-{i}", cpus=8.0, memory_mb=16384)
+        for i in range(n)
+    ]
+
+
+def bench_helloworld() -> dict:
+    """BASELINE configs #1 and #2: helloworld CPU deploys through the
+    control plane (reference: frameworks/helloworld simple +
+    MAX_PER_HOST scenarios)."""
+    import shutil
+
+    results = {}
+    # config 1: single-pod deploy
+    elapsed, completed, scheduler, agent, workdir = _run_deploy(
+        os.path.join(REPO, "frameworks/helloworld/simple.yml"),
+        {"SLEEP_DURATION": "1000"},
+        _cpu_hosts(1),
+        budget_s=60.0,
+    )
+    results["helloworld_simple_deploy_s"] = round(elapsed, 3)
+    results["helloworld_simple_completed"] = completed
+    agent.shutdown()
+    shutil.rmtree(workdir, ignore_errors=True)
+
+    # config 2: 3 instances, max-per-host:1 over 3 hosts
+    elapsed, completed, scheduler, agent, workdir = _run_deploy(
+        os.path.join(REPO, "frameworks/helloworld/max_per_host.yml"),
+        {"SLEEP_DURATION": "1000"},
+        _cpu_hosts(3),
+        budget_s=60.0,
+    )
+    placed_hosts = set()
+    for info in scheduler.state_store.fetch_tasks():
+        placed_hosts.add(info.labels.get("offer_hostname", info.agent_id))
+    results["helloworld_max_per_host_deploy_s"] = round(elapsed, 3)
+    results["helloworld_max_per_host_completed"] = completed
+    results["helloworld_max_per_host_distinct_hosts"] = len(placed_hosts)
+    agent.shutdown()
+    shutil.rmtree(workdir, ignore_errors=True)
+    return results
+
+
+def bench_deploy() -> dict:
+    """Control-plane deploy of the single-chip MNIST service."""
+    import shutil
+
+    from dcos_commons_tpu.offer.inventory import TpuHost
+
     host = TpuHost(
         host_id="tpu-host-0",
         slice_id="bench-slice",
@@ -55,29 +132,14 @@ def bench_deploy() -> dict:
         cpus=8.0,
         memory_mb=32768,
     )
-    builder = SchedulerBuilder(
-        spec,
-        SchedulerConfig(
-            sandbox_root=os.path.join(workdir, "sandboxes"),
-            backoff_enabled=False,
-        ),
-        FileWalPersister(os.path.join(workdir, "state"), fsync=False),
+    elapsed, completed, scheduler, agent, workdir = _run_deploy(
+        os.path.join(REPO, "frameworks/jax/svc_mnist.yml"),
+        {
+            "JAX_FRAMEWORK_DIR": os.path.join(REPO, "frameworks/jax"),
+            "TRAIN_STEPS": os.environ.get("BENCH_MNIST_STEPS", "40"),
+        },
+        [host],
     )
-    builder.set_inventory(SliceInventory([host]))
-    agent = LocalProcessAgent(os.path.join(workdir, "sandboxes"))
-    builder.set_agent(agent)
-    scheduler = builder.build()
-
-    t0 = time.monotonic()
-    deadline = t0 + 600
-    completed = False
-    while time.monotonic() < deadline:
-        scheduler.run_cycle()
-        if scheduler.deploy_manager.get_plan().is_complete:
-            completed = True
-            break
-        time.sleep(0.1)
-    elapsed = time.monotonic() - t0
     status = scheduler.state_store.fetch_status("mnist-0-train")
     agent.shutdown()
     result = {
@@ -173,6 +235,10 @@ def _peak_bf16_tflops(device) -> float:
 
 def main() -> None:
     extras = {}
+    try:
+        extras.update(bench_helloworld())
+    except Exception as e:
+        extras["helloworld_error"] = repr(e)[:200]
     deploy = bench_deploy()
     extras.update(deploy)
     try:

@@ -52,8 +52,13 @@ class AgentDaemon:
         workdir: str,
         port: int = 0,
         bind: str = "127.0.0.1",
+        advertise_host: str = "",
     ):
         self.host_id = host_id
+        # a daemon bound to 0.0.0.0 must announce a routable address
+        # (the scheduler dials what the announce file says); mirrors the
+        # runner's --advertise-url
+        self.advertise_host = advertise_host
         self._executor = LocalProcessAgent(workdir)
         self._started_at = time.monotonic()
         daemon = self
@@ -83,25 +88,36 @@ class AgentDaemon:
 
             def do_GET(self):
                 parsed = urlparse(self.path)
-                if parsed.path == "/v1/agent/info":
-                    self._reply(200, daemon.info())
-                elif parsed.path == "/v1/agent/tasks":
-                    self._reply(
-                        200,
-                        {"task_ids": sorted(daemon._executor.active_task_ids())},
-                    )
-                elif parsed.path == "/v1/agent/sandbox":
-                    query = parse_qs(parsed.query)
-                    task = (query.get("task") or [""])[0]
-                    rel = (query.get("file") or ["stdout"])[0]
-                    path = daemon.resolve_sandbox_path(task, rel)
-                    if path is None or not os.path.isfile(path):
-                        self._reply(404, {"message": f"no file {rel}"})
-                        return
-                    with open(path, "r", errors="replace") as f:
-                        self._reply(200, f.read())
-                else:
-                    self._reply(404, {"message": f"no route {parsed.path}"})
+                try:
+                    if parsed.path == "/v1/agent/info":
+                        self._reply(200, daemon.info())
+                    elif parsed.path == "/v1/agent/tasks":
+                        self._reply(
+                            200,
+                            {"task_ids": sorted(
+                                daemon._executor.active_task_ids()
+                            )},
+                        )
+                    elif parsed.path == "/v1/agent/sandbox":
+                        query = parse_qs(parsed.query)
+                        task = (query.get("task") or [""])[0]
+                        rel = (query.get("file") or ["stdout"])[0]
+                        path = daemon.resolve_sandbox_path(task, rel)
+                        if path is None or not os.path.isfile(path):
+                            self._reply(404, {"message": f"no file {rel}"})
+                            return
+                        # the file can vanish between the isfile check
+                        # and the open (sandbox GC race) — the outer
+                        # guard turns that into a 500, not a dropped
+                        # connection
+                        with open(path, "r", errors="replace") as f:
+                            self._reply(200, f.read())
+                    else:
+                        self._reply(
+                            404, {"message": f"no route {parsed.path}"}
+                        )
+                except Exception as e:
+                    self._reply(500, {"message": f"agent error: {e}"})
 
             def do_POST(self):
                 parsed = urlparse(self.path)
@@ -180,6 +196,12 @@ class AgentDaemon:
     @property
     def url(self) -> str:
         host, port = self._server.server_address[:2]
+        if self.advertise_host:
+            host = self.advertise_host
+        elif host in ("0.0.0.0", "::"):
+            import socket
+
+            host = socket.gethostname()
         return f"http://{host}:{port}"
 
     def start(self) -> "AgentDaemon":
@@ -217,6 +239,12 @@ def main(argv: Optional[list] = None) -> int:
     parser.add_argument("--host-id", required=True)
     parser.add_argument("--port", type=int, default=0)
     parser.add_argument("--bind", default="127.0.0.1")
+    parser.add_argument(
+        "--advertise-host",
+        default="",
+        help="hostname/IP to announce instead of the bind address "
+             "(required when binding 0.0.0.0 on a multi-host fleet)",
+    )
     parser.add_argument("--workdir", default="./agent-sandboxes")
     parser.add_argument(
         "--announce-file",
@@ -225,7 +253,11 @@ def main(argv: Optional[list] = None) -> int:
     )
     args = parser.parse_args(argv)
     daemon = AgentDaemon(
-        args.host_id, args.workdir, port=args.port, bind=args.bind
+        args.host_id,
+        args.workdir,
+        port=args.port,
+        bind=args.bind,
+        advertise_host=args.advertise_host,
     )
     if args.announce_file:
         from dcos_commons_tpu.common import atomic_write_text

@@ -17,7 +17,8 @@ import logging
 import threading
 import urllib.error
 import urllib.request
-from typing import Callable, Dict, List, Optional, Set
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from dcos_commons_tpu.agent.base import Agent
 from dcos_commons_tpu.agent.daemon import serialize_check
@@ -29,12 +30,28 @@ LOG = logging.getLogger(__name__)
 class RemoteAgentClient:
     """HTTP client for one host's AgentDaemon."""
 
-    def __init__(self, host_id: str, base_url: str, timeout_s: float = 5.0):
+    def __init__(
+        self,
+        host_id: str,
+        base_url: str,
+        timeout_s: float = 5.0,
+        launch_timeout_s: float = 30.0,
+    ):
         self.host_id = host_id
         self.base_url = base_url.rstrip("/")
         self.timeout_s = timeout_s
+        # launches block on daemon-side template fetches (10s per
+        # template); a timeout shorter than that would declare a
+        # successfully-launching task LOST and double-book the slice
+        self.launch_timeout_s = launch_timeout_s
 
-    def _request(self, method: str, path: str, body: Optional[dict] = None):
+    def _request(
+        self,
+        method: str,
+        path: str,
+        body: Optional[dict] = None,
+        timeout_s: Optional[float] = None,
+    ):
         data = json.dumps(body).encode("utf-8") if body is not None else None
         req = urllib.request.Request(
             f"{self.base_url}{path}",
@@ -42,7 +59,9 @@ class RemoteAgentClient:
             method=method,
             headers={"Content-Type": "application/json"},
         )
-        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+        with urllib.request.urlopen(
+            req, timeout=timeout_s if timeout_s is not None else self.timeout_s
+        ) as resp:
             return json.loads(resp.read().decode("utf-8"))
 
     def info(self) -> dict:
@@ -50,7 +69,10 @@ class RemoteAgentClient:
 
     def launch(self, entries: List[dict]) -> List[str]:
         return self._request(
-            "POST", "/v1/agent/launch", {"tasks": entries}
+            "POST",
+            "/v1/agent/launch",
+            {"tasks": entries},
+            timeout_s=self.launch_timeout_s,
         )["launched"]
 
     def kill(self, task_id: str, grace_period_s: float) -> None:
@@ -107,6 +129,32 @@ class RemoteFleet(Agent):
         self.on_host_down = on_host_down
         self.on_host_up = on_host_up
         self._lock = threading.RLock()
+        # per-host RPCs fan out concurrently so one unreachable host's
+        # connect timeout cannot stall the whole scheduler cycle
+        self._pool: Optional[ThreadPoolExecutor] = None
+
+    def _fan_out(self, fn) -> List[Tuple[str, object]]:
+        """Run ``fn(host_id, client)`` for every host concurrently;
+        returns [(host_id, result-or-exception)] in host order."""
+        with self._lock:
+            clients = sorted(self._clients.items())
+            if self._pool is None or self._pool._max_workers < len(clients):
+                if self._pool is not None:
+                    self._pool.shutdown(wait=False)
+                self._pool = ThreadPoolExecutor(
+                    max_workers=max(4, len(clients)),
+                    thread_name_prefix="fleet-rpc",
+                )
+            pool = self._pool
+
+        def call(item):
+            host_id, client = item
+            try:
+                return host_id, fn(host_id, client)
+            except Exception as e:  # scored by the caller
+                return host_id, e
+
+        return list(pool.map(call, clients))
 
     def add_host(self, host_id: str, url: str) -> None:
         with self._lock:
@@ -170,41 +218,35 @@ class RemoteFleet(Agent):
     def kill(self, task_id: str, grace_period_s: float = 0.0) -> None:
         with self._lock:
             owner = self._owners.get(task_id)
-        clients = (
-            [self._clients[owner]]
-            if owner and owner in self._clients
-            # unknown owner (restart before any poll): broadcast — kill
-            # of an unknown id is an idempotent no-op daemon-side
-            else list(self._clients.values())
-        )
-        for client in clients:
+        if owner and owner in self._clients:
             try:
-                client.kill(task_id, grace_period_s)
+                self._clients[owner].kill(task_id, grace_period_s)
             except (urllib.error.URLError, OSError):
                 pass  # TaskKiller retries until a terminal status lands
+            return
+        # unknown owner (restart before any poll): broadcast — kill of
+        # an unknown id is an idempotent no-op daemon-side
+        self._fan_out(lambda _h, c: c.kill(task_id, grace_period_s))
 
     def active_task_ids(self) -> Set[str]:
         out: Set[str] = set()
-        for host_id, client in list(self._clients.items()):
-            try:
-                ids = client.tasks()
-            except (urllib.error.URLError, OSError):
+        for host_id, result in self._fan_out(lambda _h, c: c.tasks()):
+            if isinstance(result, Exception):
                 # liveness is only scored by poll() — a scheduler cycle
                 # calls both methods, and double-counting would halve
                 # the documented down_after threshold.  A down host's
                 # tasks count as active until LOST is synthesized by
                 # poll(), so the reconciler doesn't double-report them.
                 with self._lock:
-                    ids = {
+                    out |= {
                         t for t, h in self._owners.items() if h == host_id
                     }
-                out |= ids
                 continue
             self._note_success(host_id)
             with self._lock:
-                for task_id in ids:
+                for task_id in result:
                     self._owners.setdefault(task_id, host_id)
-            out |= ids
+            out |= result
         return out
 
     def poll(self) -> List[TaskStatus]:
@@ -212,10 +254,8 @@ class RemoteFleet(Agent):
         with self._lock:
             out.extend(self._pending)
             self._pending.clear()
-        for host_id, client in list(self._clients.items()):
-            try:
-                statuses = client.drain()
-            except (urllib.error.URLError, OSError):
+        for host_id, statuses in self._fan_out(lambda _h, c: c.drain()):
+            if isinstance(statuses, Exception):
                 self._note_failure(host_id)
                 # the threshold may have been crossed by a failed
                 # active_task_ids() call between polls; LOST synthesis

@@ -149,14 +149,22 @@ class SchedulerBuilder:
 
         backoff = self._make_backoff()
         factory = DeployPlanFactory(backoff)
-        plan_name = (
-            UPDATE_PLAN_NAME
-            if state_store.deployment_was_completed()
-            else DEPLOY_PLAN_NAME
-        )
-        raw_deploy = (target_spec.plans or {}).get("deploy")
-        if raw_deploy:
-            deploy_plan = PlanGenerator(backoff).generate(
+        generator = PlanGenerator(backoff)
+        plans_raw = target_spec.plans or {}
+        has_completed = state_store.deployment_was_completed()
+        plan_name = UPDATE_PLAN_NAME if has_completed else DEPLOY_PLAN_NAME
+        raw_deploy = plans_raw.get("deploy")
+        raw_update = plans_raw.get("update")
+        if has_completed and raw_update:
+            # a custom update plan replaces the deploy plan once the
+            # initial deployment has completed (reference:
+            # SchedulerBuilder.selectDeployPlan, SchedulerBuilder.java:644)
+            deploy_plan = generator.generate(
+                target_spec, UPDATE_PLAN_NAME, raw_update, state_store,
+                target_id,
+            )
+        elif raw_deploy:
+            deploy_plan = generator.generate(
                 target_spec, plan_name, raw_deploy, state_store, target_id
             )
         else:
@@ -206,6 +214,20 @@ class SchedulerBuilder:
         from dcos_commons_tpu.decommission import DecommissionPlanFactory
 
         other_managers: List = []
+        # custom YAML plans (sidecar: backup/restore/repair...) are
+        # built interrupted and kicked off by `plan start` (reference:
+        # SchedulerBuilder.java:155 createInterrupted; cassandra's
+        # backup plans are the canonical consumer)
+        for custom_name, raw_custom in plans_raw.items():
+            if custom_name in ("deploy", "update") or not raw_custom:
+                continue
+            custom_plan = generator.generate(
+                target_spec, custom_name, raw_custom, state_store, target_id
+            )
+            custom_plan.interrupt()
+            if self._plan_customizer is not None:
+                custom_plan = self._plan_customizer(custom_plan) or custom_plan
+            other_managers.append(DefaultPlanManager(custom_plan))
         decommission_plan = DecommissionPlanFactory().build(
             target_spec, state_store
         )

@@ -13,6 +13,7 @@ scalars; ``gang: true`` requests slice-wide gang scheduling.
 
 from __future__ import annotations
 
+import os
 import re
 from typing import Any, Dict, Mapping, Optional
 
@@ -65,17 +66,57 @@ def render_template(text: str, env: Mapping[str, str]) -> str:
 
 def from_yaml_file(path: str, env: Optional[Mapping[str, str]] = None) -> ServiceSpec:
     with open(path, "r", encoding="utf-8") as f:
-        return from_yaml(f.read(), env)
+        # config template paths resolve relative to the YAML's own
+        # directory (the reference ships templates next to svc.yml in
+        # the scheduler's dist dir)
+        return from_yaml(
+            f.read(), env, base_dir=os.path.dirname(os.path.abspath(path))
+        )
 
 
-def from_yaml(text: str, env: Optional[Mapping[str, str]] = None) -> ServiceSpec:
+def from_yaml(
+    text: str,
+    env: Optional[Mapping[str, str]] = None,
+    base_dir: str = "",
+) -> ServiceSpec:
     raw = yaml.safe_load(render_template(text, env or {}))
     if not isinstance(raw, dict):
         raise SpecError("service YAML must be a mapping")
-    return _map_service(raw)
+    return _map_service(raw, env or {}, base_dir)
 
 
-def _map_service(raw: Dict[str, Any]) -> ServiceSpec:
+def _env_name(pod_type: str) -> str:
+    """Pod type -> env-var fragment (reference: EnvUtils.toEnvName —
+    uppercase, non-alphanumerics to underscores)."""
+    return re.sub(r"[^A-Z0-9]", "_", pod_type.upper())
+
+
+def route_task_env(env: Mapping[str, str], pod_type: str) -> Dict[str, str]:
+    """Per-task config-plane routing: ``TASKCFG_ALL_FOO=x`` lands as
+    ``FOO=x`` in every task; ``TASKCFG_<PODTYPE>_FOO=x`` only in tasks
+    of that pod and wins over the ALL form.
+
+    Reference: config/TaskEnvRouter.java:17-30 — scheduler-process env
+    is the routing source, and routed values override YAML task env so
+    end users can retune a packaged service without editing its YAML.
+    """
+    routed: Dict[str, str] = {}
+    all_prefix = "TASKCFG_ALL_"
+    pod_prefix = f"TASKCFG_{_env_name(pod_type)}_"
+    for key, value in env.items():
+        if key.startswith(all_prefix) and key not in (all_prefix,):
+            routed.setdefault(key[len(all_prefix):], str(value))
+    for key, value in env.items():
+        if pod_prefix != all_prefix and key.startswith(pod_prefix):
+            routed[key[len(pod_prefix):]] = str(value)
+    return {k: v for k, v in routed.items() if k}
+
+
+def _map_service(
+    raw: Dict[str, Any],
+    env: Optional[Mapping[str, str]] = None,
+    base_dir: str = "",
+) -> ServiceSpec:
     name = raw.get("name")
     if not name:
         raise SpecError("service requires a name")
@@ -83,8 +124,18 @@ def _map_service(raw: Dict[str, Any]) -> ServiceSpec:
     if not pods_raw:
         raise SpecError(f"service {name!r} requires at least one pod")
     pods = tuple(
-        _map_pod(pod_name, pod_raw or {}) for pod_name, pod_raw in pods_raw.items()
+        _map_pod(pod_name, pod_raw or {}, env or {}, base_dir)
+        for pod_name, pod_raw in pods_raw.items()
     )
+    # 'recovery'/'decommission'/'uninstall' are built-in plan names; a
+    # custom YAML plan with one of them would shadow the real plan in
+    # scheduler.plans() and make its state unobservable
+    reserved = {"recovery", "decommission", "uninstall"}
+    clash = reserved & set((raw.get("plans") or {}).keys())
+    if clash:
+        raise SpecError(
+            f"service {name!r}: plan names {sorted(clash)} are reserved"
+        )
     rfp_raw = raw.get("replacement-failure-policy")
     rfp = None
     if rfp_raw:
@@ -107,10 +158,16 @@ def _map_service(raw: Dict[str, Any]) -> ServiceSpec:
     )
 
 
-def _map_pod(pod_name: str, raw: Dict[str, Any]) -> PodSpec:
+def _map_pod(
+    pod_name: str,
+    raw: Dict[str, Any],
+    env: Optional[Mapping[str, str]] = None,
+    base_dir: str = "",
+) -> PodSpec:
     tasks_raw = raw.get("tasks") or {}
     if not tasks_raw:
         raise SpecError(f"pod {pod_name!r} requires at least one task")
+    routed_env = route_task_env(env or {}, pod_name)
     tpu_raw = raw.get("tpu")
     tpu = None
     if tpu_raw:
@@ -123,7 +180,7 @@ def _map_pod(pod_name: str, raw: Dict[str, Any]) -> PodSpec:
         type=str(pod_name),
         count=int(raw.get("count", 1)),
         tasks=tuple(
-            _map_task(task_name, task_raw or {})
+            _map_task(task_name, task_raw or {}, routed_env, base_dir)
             for task_name, task_raw in tasks_raw.items()
         ),
         tpu=tpu,
@@ -138,7 +195,12 @@ def _map_pod(pod_name: str, raw: Dict[str, Any]) -> PodSpec:
     )
 
 
-def _map_task(task_name: str, raw: Dict[str, Any]) -> TaskSpec:
+def _map_task(
+    task_name: str,
+    raw: Dict[str, Any],
+    routed_env: Optional[Dict[str, str]] = None,
+    base_dir: str = "",
+) -> TaskSpec:
     ports = []
     for port_name, port_raw in (raw.get("ports") or {}).items():
         port_raw = port_raw or {}
@@ -176,12 +238,18 @@ def _map_task(task_name: str, raw: Dict[str, Any]) -> TaskSpec:
             raise SpecError(
                 f"config {cfg_name!r} in task {task_name!r} needs template+dest"
             )
-        templates.append((str(cfg_raw["template"]), str(cfg_raw["dest"])))
+        template_path = str(cfg_raw["template"])
+        if base_dir and not os.path.isabs(template_path):
+            template_path = os.path.join(base_dir, template_path)
+        templates.append((template_path, str(cfg_raw["dest"])))
     return TaskSpec(
         name=str(task_name),
         goal=GoalState(str(raw.get("goal", "RUNNING")).upper()),
         cmd=str(raw.get("cmd", "")),
-        env={str(k): str(v) for k, v in (raw.get("env") or {}).items()},
+        env={
+            **{str(k): str(v) for k, v in (raw.get("env") or {}).items()},
+            **(routed_env or {}),
+        },
         resources=ResourceSpec(
             cpus=float(raw.get("cpus", 0.1)),
             memory_mb=int(raw.get("memory", 32)),

@@ -39,6 +39,7 @@ from dcos_commons_tpu.testing.ticks import (
     PlanForceComplete,
     PlanInterrupt,
     PlanRestart,
+    PlanStart,
     RemoveHost,
     Send,
     SendStatus,
@@ -67,6 +68,7 @@ __all__ = [
     "PlanInterrupt",
     "PlanContinue",
     "PlanRestart",
+    "PlanStart",
     "PlanForceComplete",
     "ExpectLaunchedTasks",
     "ExpectNoLaunches",

@@ -163,6 +163,7 @@ class AgentProcess:
         os.makedirs(workdir, exist_ok=True)
         if os.path.exists(announce):
             os.remove(announce)  # never read a previous run's port
+        self._log = open(os.path.join(workdir, "agent.log"), "ab")
         self.process = subprocess.Popen(
             [
                 sys.executable, "-m", "dcos_commons_tpu", "agent",
@@ -171,7 +172,7 @@ class AgentProcess:
                 "--announce-file", announce,
             ],
             cwd=repo_root or None,
-            stdout=open(os.path.join(workdir, "agent.log"), "ab"),
+            stdout=self._log,
             stderr=subprocess.STDOUT,
         )
         announced = _read_announce(announce)
@@ -181,6 +182,7 @@ class AgentProcess:
         """Hard-kill the daemon — the host-failure injection."""
         self.process.kill()
         self.process.wait(timeout=10)
+        self._log.close()
 
     def stop(self) -> None:
         if self.process.poll() is None:
@@ -190,6 +192,8 @@ class AgentProcess:
             except subprocess.TimeoutExpired:
                 self.process.kill()
                 self.process.wait(timeout=10)
+        if not self._log.closed:
+            self._log.close()
 
 
 class SchedulerProcess:
@@ -211,6 +215,7 @@ class SchedulerProcess:
             os.remove(announce)  # never read a previous run's port
         run_env = dict(os.environ)
         run_env.update(env or {})
+        self._log = open(os.path.join(workdir, "scheduler.log"), "ab")
         self.process = subprocess.Popen(
             [
                 sys.executable, "-m", "dcos_commons_tpu", "serve",
@@ -223,7 +228,7 @@ class SchedulerProcess:
             ],
             cwd=repo_root or None,
             env=run_env,
-            stdout=open(os.path.join(workdir, "scheduler.log"), "ab"),
+            stdout=self._log,
             stderr=subprocess.STDOUT,
         )
         self.url = _read_announce(announce) if wait_listening else ""
@@ -239,6 +244,9 @@ class SchedulerProcess:
         except subprocess.TimeoutExpired:
             self.process.kill()
             return self.process.wait(timeout=10)
+        finally:
+            if not self._log.closed:
+                self._log.close()
 
     def log_tail(self, lines: int = 40) -> str:
         path = os.path.join(self.workdir, "scheduler.log")

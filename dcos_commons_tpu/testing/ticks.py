@@ -195,6 +195,16 @@ class PlanForceComplete(_PlanVerb):
         self._target(world).force_complete()
 
 
+class PlanStart(_PlanVerb):
+    """Kick an interrupted sidecar plan: restart + proceed, matching
+    the HTTP verb (reference: PlansQueries.start)."""
+
+    def mutate(self, world: SimulationWorld) -> None:
+        target = self._target(world)
+        target.restart()
+        target.proceed()
+
+
 # ---------------------------------------------------------------------------
 # Expect ticks
 # ---------------------------------------------------------------------------

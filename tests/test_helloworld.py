@@ -1,0 +1,420 @@
+"""frameworks/helloworld: the feature-matrix service, one test per YAML.
+
+Reference: frameworks/helloworld — 36 svc YAMLs x 40 integration test
+modules are the reference's coverage engine
+(frameworks/helloworld/src/main/dist/, frameworks/helloworld/tests/).
+Each test here loads the real YAML from frameworks/helloworld/ and
+drives it through the sim harness, mirroring the reference's
+ServiceTest.java flows for that YAML.
+"""
+
+import os
+
+import pytest
+
+from dcos_commons_tpu.common import TaskState
+from dcos_commons_tpu.offer.inventory import TpuHost
+from dcos_commons_tpu.plan.status import Status
+from dcos_commons_tpu.scheduler.config import SchedulerConfig
+from dcos_commons_tpu.testing import (
+    AddHost,
+    AdvanceCycles,
+    ExpectDeclined,
+    ExpectDeploymentComplete,
+    ExpectDistinctHosts,
+    ExpectLaunchedTasks,
+    ExpectNoLaunches,
+    ExpectPlanStatus,
+    ExpectStepStatus,
+    ExpectTaskKilled,
+    PlanContinue,
+    PlanStart,
+    SendTaskFailed,
+    SendTaskFinished,
+    SendTaskRunning,
+    ServiceTestRunner,
+)
+
+HELLOWORLD = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "frameworks",
+    "helloworld",
+)
+
+
+def load(yaml_name: str) -> str:
+    with open(os.path.join(HELLOWORLD, yaml_name), "r", encoding="utf-8") as f:
+        return f.read()
+
+
+def test_svc_default_two_pod_types():
+    """svc.yml: hello (volume + health check) then world x2 (two
+    volumes, readiness check) deploy serially to completion."""
+    runner = ServiceTestRunner(load("svc.yml"))
+    runner.run([
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("hello-0-server"),
+        SendTaskRunning("hello-0-server"),
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("world-0-server"),
+        # readiness check declared: RUNNING without ready must NOT
+        # complete the step (reference: readiness label gating,
+        # DeploymentStep.java:163-193)
+        SendTaskRunning("world-0-server", ready=False),
+        ExpectStepStatus(
+            "deploy", "world", "world-0:[server]", Status.STARTED
+        ),
+        SendTaskRunning("world-0-server"),
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("world-1-server"),
+        SendTaskRunning("world-1-server"),
+        ExpectDeploymentComplete(),
+    ])
+    info = runner.world.agent.task_info_of("hello-0-server")
+    assert "hello-container-path" in info.command
+    assert runner.world.agent.task_info_of("world-1-server") is not None
+
+
+def test_simple_single_pod_deploy():
+    """simple.yml: BASELINE config #1 — single-pod CPU-only deploy,
+    plan PENDING -> COMPLETE."""
+    runner = ServiceTestRunner(load("simple.yml"))
+    runner.run([
+        ExpectPlanStatus("deploy", Status.PENDING),
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("hello-0-server"),
+        SendTaskRunning("hello-0-server"),
+        ExpectDeploymentComplete(),
+    ])
+
+
+def test_max_per_host_constraint():
+    """max_per_host.yml: BASELINE config #2 — three instances, at most
+    one per host; constraint respected and blocking until capacity."""
+    hosts = [TpuHost(host_id=f"h{i}") for i in range(2)]
+    runner = ServiceTestRunner(load("max_per_host.yml"), hosts=hosts)
+    runner.run([
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("hello-0-server"),
+        SendTaskRunning("hello-0-server"),
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("hello-1-server"),
+        SendTaskRunning("hello-1-server"),
+        # only 2 hosts: the third instance cannot place
+        AdvanceCycles(2),
+        ExpectNoLaunches(),
+        ExpectDeclined("hello-[2]"),
+        ExpectPlanStatus("deploy", Status.IN_PROGRESS),
+        AddHost(TpuHost(host_id="h2")),
+        ExpectLaunchedTasks("hello-2-server"),
+        SendTaskRunning("hello-2-server"),
+        ExpectDeploymentComplete(),
+        ExpectDistinctHosts(
+            "hello-0-server", "hello-1-server", "hello-2-server"
+        ),
+    ])
+
+
+def test_canary_deploy_gated_on_proceed():
+    """canary.yml: nothing launches until `plan continue`; after the
+    canary count the remaining instances flow automatically."""
+    runner = ServiceTestRunner(load("canary.yml"))
+    runner.run([
+        AdvanceCycles(2),
+        ExpectNoLaunches(),
+        ExpectPlanStatus("deploy", Status.WAITING),
+        PlanContinue("deploy"),
+        PlanContinue("deploy", "hello-deploy"),
+        ExpectLaunchedTasks("hello-0-server"),
+        SendTaskRunning("hello-0-server"),
+        AdvanceCycles(1),
+        ExpectNoLaunches(),
+        PlanContinue("deploy", "hello-deploy"),
+        ExpectLaunchedTasks("hello-1-server"),
+        SendTaskRunning("hello-1-server"),
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("hello-2-server"),
+        SendTaskRunning("hello-2-server"),
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("hello-3-server"),
+        SendTaskRunning("hello-3-server"),
+        ExpectDeploymentComplete(),
+    ])
+
+
+def test_multistep_plan_orders_init_before_server():
+    """multistep_plan.yml: instance 0 runs its ONCE init task, then its
+    server; instance 1 goes straight to server."""
+    runner = ServiceTestRunner(load("multistep_plan.yml"))
+    runner.run([
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("hello-0-init"),
+        SendTaskFinished("hello-0-init"),
+        ExpectStepStatus("deploy", "hello-deploy", "hello-0:[init]",
+                         Status.COMPLETE),
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("hello-0-server"),
+        SendTaskRunning("hello-0-server"),
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("hello-1-server"),
+        SendTaskRunning("hello-1-server"),
+        ExpectDeploymentComplete(),
+    ])
+    # the ONCE init task ran exactly once, on instance 0 only
+    assert len(runner.world.agent.launches_of("hello-0-init")) == 1
+    assert runner.world.agent.task_id_of("hello-1-init") is None
+
+
+def test_sidecar_plan_runs_on_start_and_reruns():
+    """sidecar.yml: deploy completes without the sidecar task; `plan
+    start` runs it; a second start re-runs it (backup-plan shape,
+    reference: cassandra sidecar plans + PlansQueries.start)."""
+    runner = ServiceTestRunner(load("sidecar.yml"))
+    runner.run([
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("hello-0-server"),
+        SendTaskRunning("hello-0-server"),
+        ExpectDeploymentComplete(),
+        # sidecar plan exists, interrupted, not launched
+        AdvanceCycles(2),
+        ExpectNoLaunches(),
+    ])
+    assert runner.world.scheduler.plan("sidecar") is not None
+    runner.run([
+        PlanStart("sidecar"),
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("hello-0-once"),
+        SendTaskFinished("hello-0-once"),
+        ExpectPlanStatus("sidecar", Status.COMPLETE),
+    ])
+    runner.run([
+        PlanStart("sidecar"),
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("hello-0-once"),
+        SendTaskFinished("hello-0-once"),
+        ExpectPlanStatus("sidecar", Status.COMPLETE),
+    ])
+    assert len(runner.world.agent.launches_of("hello-0-once")) == 2
+
+
+def test_finish_state_goals_complete_and_stay_finished():
+    """finish_state.yml: ONCE/FINISH tasks complete the deploy on
+    TASK_FINISHED and are not relaunched afterwards; a scheduler
+    restart does not re-run the ONCE task."""
+    runner = ServiceTestRunner(load("finish_state.yml"))
+    runner.run([
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("hello-0-init"),
+        SendTaskFinished("hello-0-init"),
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("world-0-batch"),
+        SendTaskFinished("world-0-batch"),
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("world-1-batch"),
+        SendTaskFinished("world-1-batch"),
+        ExpectDeploymentComplete(),
+        AdvanceCycles(2),
+        ExpectNoLaunches(),
+    ])
+    restarted = runner.restart()
+    restarted.run([
+        AdvanceCycles(2),
+        ExpectNoLaunches(),
+        ExpectDeploymentComplete(),
+    ])
+    assert len(restarted.agent.launches_of("hello-0-init")) == 1
+
+
+def test_crash_loop_delays_relaunch():
+    """crash-loop.yml: with backoff enabled, repeated failures push the
+    step to DELAYED instead of hot-looping relaunches (reference:
+    ExponentialBackoff -> DELAYED, DeploymentStep.java:176-182)."""
+    runner = ServiceTestRunner(
+        load("crash-loop.yml"),
+        scheduler_config=SchedulerConfig(
+            backoff_enabled=True,
+            backoff_initial_s=60.0,
+            backoff_factor=2.0,
+            backoff_max_s=300.0,
+        ),
+    )
+    runner.run([
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("hello-0-server"),
+        SendTaskFailed("hello-0-server"),
+        ExpectStepStatus("deploy", "hello", "hello-0:[server]",
+                         Status.DELAYED),
+        AdvanceCycles(2),
+        ExpectNoLaunches(),
+        ExpectPlanStatus("deploy", Status.DELAYED),
+    ])
+    assert len(runner.world.agent.launches_of("hello-0-server")) == 1
+
+
+def test_custom_update_plan_used_after_deploy():
+    """update_plan.yml: initial rollout uses the serial deploy plan; a
+    config change afterwards rolls through the custom parallel update
+    plan (reference: SchedulerBuilder.selectDeployPlan:644)."""
+    yaml_text = load("update_plan.yml")
+    runner = ServiceTestRunner(yaml_text)
+    runner.run([
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("hello-0-server"),
+        SendTaskRunning("hello-0-server"),
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("hello-1-server"),
+        SendTaskRunning("hello-1-server"),
+        ExpectDeploymentComplete(),
+    ])
+    updated = ServiceTestRunner(
+        yaml_text,
+        persister=runner.persister,
+        hosts=runner.hosts,
+        env={"SLEEP_DURATION": "2000"},
+    )
+    updated.agent = runner.agent
+    updated.inventory = runner.inventory
+    kill_mark = len(runner.agent.kills)
+    world = updated.run([
+        AdvanceCycles(1),
+        # parallel update strategy: both instances roll in one cycle
+        ExpectTaskKilled("hello-0-server"),
+        SendTaskRunning("hello-0-server"),
+        SendTaskRunning("hello-1-server"),
+        ExpectPlanStatus("update", Status.COMPLETE),
+    ])
+    from dcos_commons_tpu.common import task_name_of
+
+    rolled = {task_name_of(k) for k in world.agent.kills[kill_mark:]}
+    assert rolled == {"hello-0-server", "hello-1-server"}
+    assert "sleep 2000" in world.agent.task_info_of("hello-0-server").command
+
+
+def test_decommission_on_world_scale_down():
+    """svc.yml with WORLD_COUNT dropped 2 -> 1: world-1 is killed and
+    its reservations erased through the decommission plan
+    (allow-decommission: true on the world pod)."""
+    runner = ServiceTestRunner(load("svc.yml"))
+    runner.run([
+        AdvanceCycles(1),
+        SendTaskRunning("hello-0-server"),
+        AdvanceCycles(1),
+        SendTaskRunning("world-0-server"),
+        AdvanceCycles(1),
+        SendTaskRunning("world-1-server"),
+        ExpectDeploymentComplete(),
+    ])
+    scaled = ServiceTestRunner(
+        load("svc.yml"),
+        persister=runner.persister,
+        hosts=runner.hosts,
+        env={"WORLD_COUNT": "1"},
+    )
+    scaled.agent = runner.agent
+    scaled.inventory = runner.inventory
+    world = scaled.run([
+        AdvanceCycles(1),
+        ExpectTaskKilled("world-1-server"),
+    ])
+    plan = world.scheduler.plan("decommission")
+    assert plan is not None
+    # confirm the kill, then let the erase steps run to completion
+    scaled.run([
+        SendTaskFailed("world-1-server"),
+        AdvanceCycles(3),
+        ExpectPlanStatus("decommission", Status.COMPLETE),
+    ])
+    assert world.state_store.fetch_task("world-1-server") is None
+
+
+def test_taskcfg_env_routed_into_launched_tasks():
+    """taskcfg.yml + TASKCFG_* scheduler env: routed vars appear in the
+    launched TaskInfo env (reference: TaskEnvRouter.java:17-30)."""
+    from dcos_commons_tpu.testing import ExpectTaskEnv
+
+    runner = ServiceTestRunner(
+        load("taskcfg.yml"),
+        env={
+            "TASKCFG_ALL_GREETING": "howdy-all",
+            "TASKCFG_HELLO_GREETING": "howdy-hello",
+        },
+    )
+    runner.run([
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("hello-0-server"),
+        ExpectTaskEnv("hello-0-server", "GREETING", "howdy-hello"),
+        SendTaskRunning("hello-0-server"),
+        ExpectDeploymentComplete(),
+    ])
+
+
+def test_taskcfg_template_rendered_and_rerendered_on_update(tmp_path):
+    """The per-task config plane end to end: the agent daemon renders
+    server.properties into the sandbox from the task env, and a config
+    update (new TASKCFG value -> new target config) relaunches the task
+    with a re-rendered file (reference: sdk/bootstrap/main.go:291-376
+    render; config update rolling relaunch)."""
+    import time as _time
+
+    from dcos_commons_tpu.agent.daemon import AgentDaemon
+    from dcos_commons_tpu.agent.remote import RemoteFleet
+    from dcos_commons_tpu.offer.inventory import SliceInventory, TpuHost
+    from dcos_commons_tpu.scheduler import SchedulerBuilder, SchedulerConfig
+    from dcos_commons_tpu.specification import from_yaml_file
+    from dcos_commons_tpu.storage import MemPersister
+
+    daemon = AgentDaemon("h0", str(tmp_path / "sandbox-h0")).start()
+    try:
+        fleet = RemoteFleet()
+        fleet.add_host("h0", daemon.url)
+        persister = MemPersister()
+        hosts = [TpuHost(host_id="h0")]
+
+        def build(greeting):
+            spec = from_yaml_file(
+                os.path.join(HELLOWORLD, "taskcfg.yml"),
+                env={"TASKCFG_ALL_GREETING": greeting},
+            )
+            builder = SchedulerBuilder(
+                spec,
+                SchedulerConfig(
+                    sandbox_root=str(tmp_path / "unused"),
+                    backoff_enabled=False,
+                ),
+                persister,
+            )
+            builder.set_inventory(SliceInventory(hosts))
+            builder.set_agent(fleet)
+            return builder.build()
+
+        def drive(scheduler, until, timeout_s=20.0):
+            deadline = _time.monotonic() + timeout_s
+            while _time.monotonic() < deadline:
+                scheduler.run_cycle()
+                if until(scheduler):
+                    return True
+                _time.sleep(0.05)
+            return False
+
+        scheduler = build("v1")
+        assert drive(
+            scheduler, lambda s: s.deploy_manager.get_plan().is_complete
+        )
+        rendered = fleet.client("h0").sandbox_file(
+            "hello-0-server", "server.properties"
+        )
+        assert "greeting=v1" in rendered
+        assert "pod-index=0" in rendered
+        assert "hostname=hello-0-server" in rendered
+
+        # config update: new TASKCFG value -> new target -> re-render
+        updated = build("v2")
+        assert drive(
+            updated, lambda s: s.deploy_manager.get_plan().is_complete
+        )
+        rendered = fleet.client("h0").sandbox_file(
+            "hello-0-server", "server.properties"
+        )
+        assert "greeting=v2" in rendered
+    finally:
+        daemon.stop()

@@ -212,3 +212,62 @@ def test_validate_gang_count_topology_mismatch():
     with pytest.raises(ConfigValidationError) as err:
         validate_spec_change(None, bad)
     assert "count 3" in str(err.value)
+
+
+# -- TASKCFG env routing (reference: config/TaskEnvRouter.java:17-30) --
+
+TASKCFG_YAML = """
+name: cfg-svc
+pods:
+  index:
+    count: 1
+    tasks:
+      node:
+        goal: RUNNING
+        cmd: "sleep 1"
+        cpus: 0.1
+        memory: 32
+        env:
+          MODE: yaml-default
+  data:
+    count: 1
+    tasks:
+      node:
+        goal: RUNNING
+        cmd: "sleep 1"
+        cpus: 0.1
+        memory: 32
+"""
+
+
+def test_taskcfg_all_routes_to_every_pod():
+    spec = from_yaml(TASKCFG_YAML, env={"TASKCFG_ALL_FOO": "bar"})
+    for pod in spec.pods:
+        assert pod.tasks[0].env["FOO"] == "bar"
+
+
+def test_taskcfg_pod_prefix_scopes_and_wins_over_all():
+    spec = from_yaml(
+        TASKCFG_YAML,
+        env={
+            "TASKCFG_ALL_FOO": "everywhere",
+            "TASKCFG_INDEX_FOO": "index-only",
+            "TASKCFG_INDEX_BAR": "baz",
+        },
+    )
+    index = spec.pod("index")
+    data = spec.pod("data")
+    assert index.tasks[0].env["FOO"] == "index-only"
+    assert index.tasks[0].env["BAR"] == "baz"
+    assert data.tasks[0].env["FOO"] == "everywhere"
+    assert "BAR" not in data.tasks[0].env
+
+
+def test_taskcfg_overrides_yaml_env():
+    # scheduler-env routing wins over the YAML default so end users can
+    # retune a packaged service without editing its YAML
+    spec = from_yaml(TASKCFG_YAML, env={"TASKCFG_INDEX_MODE": "tuned"})
+    assert spec.pod("index").tasks[0].env["MODE"] == "tuned"
+    # non-TASKCFG env vars never leak into task envs
+    spec2 = from_yaml(TASKCFG_YAML, env={"RANDOM_HOST_VAR": "x"})
+    assert "RANDOM_HOST_VAR" not in spec2.pod("index").tasks[0].env
