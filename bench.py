@@ -166,23 +166,28 @@ def bench_transformer() -> dict:
     from dcos_commons_tpu.models import TransformerConfig, init_params, make_train_step
     from dcos_commons_tpu.utils import param_count, synthetic_tokens
 
+    # chip-scale flagship (v5e, 16 GB): 872M params fills the MXU;
+    # full-layer remat + FA2 backward kernels + 512/256 attention tiles
+    # measured best in the round-2 block sweep
     config = TransformerConfig(
-        vocab=16384,
-        d_model=768,
-        n_layers=8,
-        n_heads=12,
-        n_kv_heads=12,
-        d_ff=2048,
-        max_seq=1024,
+        vocab=32768,
+        d_model=2048,
+        n_layers=12,
+        n_heads=16,
+        n_kv_heads=16,
+        d_ff=8192,
+        max_seq=2048,
         dtype=jnp.bfloat16,
-        remat=False,
+        remat=True,
+        attn_block_q=512,
+        attn_block_k=256,
     )
-    batch = int(os.environ.get("BENCH_BATCH", "8"))
-    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    batch = int(os.environ.get("BENCH_BATCH", "16"))
+    steps = int(os.environ.get("BENCH_STEPS", "30"))
     params = init_params(config, jax.random.key(0))
     optimizer = optax.adamw(3e-4)
     opt_state = optimizer.init(params)
-    step_fn = make_train_step(config, optimizer, donate=False)
+    step_fn = make_train_step(config, optimizer, donate=True)
     tokens, targets = synthetic_tokens(
         jax.random.key(1), batch, config.max_seq, config.vocab
     )
@@ -233,6 +238,31 @@ def _peak_bf16_tflops(device) -> float:
     return 197.0 if device.platform in ("tpu", "axon") else 0.0
 
 
+def bench_rooflines() -> dict:
+    """Chip rooflines + (multi-chip only) ICI collective bandwidth —
+    the BASELINE north-star measurement path.  On the single bench
+    chip the collective section reports the rooflines the multi-chip
+    GB/s numbers will sit under."""
+    import jax
+
+    from dcos_commons_tpu.parallel.collectives import (
+        collective_bandwidth,
+        single_chip_rooflines,
+    )
+
+    out = dict(single_chip_rooflines(payload_mb=128.0, iters=10))
+    devices = jax.devices()
+    if len(devices) >= 2:
+        from jax.sharding import Mesh
+
+        mesh = Mesh(devices, ("ici",))
+        for key, value in collective_bandwidth(
+            mesh, "ici", payload_mb=32.0, iters=10
+        ).items():
+            out[f"ici_{key}"] = value
+    return out
+
+
 def main() -> None:
     extras = {}
     try:
@@ -241,6 +271,10 @@ def main() -> None:
         extras["helloworld_error"] = repr(e)[:200]
     deploy = bench_deploy()
     extras.update(deploy)
+    try:
+        extras.update(bench_rooflines())
+    except Exception as e:
+        extras["roofline_error"] = repr(e)[:200]
     try:
         extras.update(bench_transformer())
     except Exception as e:  # deploy result still stands alone

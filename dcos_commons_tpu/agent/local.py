@@ -117,6 +117,28 @@ class LocalProcessAgent:
         for info in task_infos:
             self.launch_one(info)
 
+    def _attach_volumes(self, sandbox: str, info: TaskInfo) -> None:
+        """Materialize persistent volumes: a durable directory per
+        volume key under <workdir>/volumes/, symlinked into the sandbox
+        at the declared container path.
+
+        Reference: VolumeEvaluationStage + the Mesos agent's persistent
+        volume mount (offer/evaluate/VolumeEvaluationStage.java, 265
+        LoC).  TRANSIENT relaunches carry the same volume key and so
+        reattach their data; a PERMANENT replace minted a fresh
+        reservation (fresh key) and starts empty.
+        """
+        for container_path, volume_key in sorted(info.volumes.items()):
+            durable = os.path.join(
+                self._workdir, "volumes", volume_key.replace(os.sep, "_")
+            )
+            os.makedirs(durable, exist_ok=True)
+            link = os.path.join(sandbox, container_path)
+            if os.path.islink(link) or os.path.exists(link):
+                continue  # relaunch into an existing sandbox
+            os.makedirs(os.path.dirname(link), exist_ok=True)
+            os.symlink(durable, link)
+
     def launch_one(
         self,
         info: TaskInfo,
@@ -151,6 +173,18 @@ class LocalProcessAgent:
                 return  # raced with a duplicate launch
             sandbox = os.path.join(self._workdir, info.name)
             os.makedirs(sandbox, exist_ok=True)
+            try:
+                self._attach_volumes(sandbox, info)
+            except OSError as e:
+                self._pending.append(
+                    TaskStatus(
+                        task_id=info.task_id,
+                        state=TaskState.ERROR,
+                        message=f"volume provisioning failed: {e}",
+                        agent_id=info.agent_id,
+                    )
+                )
+                return
             env = dict(os.environ)
             env.update(info.env)
             env["SANDBOX"] = sandbox

@@ -68,11 +68,15 @@ class RemoteAgentClient:
         return self._request("GET", "/v1/agent/info")
 
     def launch(self, entries: List[dict]) -> List[str]:
+        # each config template may cost the daemon a fetch of up to 10s
+        # (agent/local.py prepare_templates); size the RPC timeout to
+        # the request or a false timeout here double-books the task
+        n_templates = sum(len(e.get("templates") or []) for e in entries)
         return self._request(
             "POST",
             "/v1/agent/launch",
             {"tasks": entries},
-            timeout_s=self.launch_timeout_s,
+            timeout_s=self.launch_timeout_s + 12.0 * n_templates,
         )["launched"]
 
     def kill(self, task_id: str, grace_period_s: float) -> None:

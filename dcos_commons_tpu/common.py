@@ -168,6 +168,11 @@ class TaskInfo(SerializableMixin):
     resource_ids: List[str] = field(default_factory=list)
     tpu_chip_ids: List[str] = field(default_factory=list)
     volume_ids: List[str] = field(default_factory=list)
+    # container_path -> durable volume key; the agent materializes each
+    # as a persistent directory symlinked into the sandbox, so TRANSIENT
+    # relaunches (same reservation -> same key) reattach their data and
+    # PERMANENT replaces (fresh reservation -> fresh key) start empty
+    volumes: Dict[str, str] = field(default_factory=dict)
     # labels carry the remaining metadata the reference keeps in
     # offer/taskdata/LabelConstants.java: target config id, readiness
     # spec, permanently-failed flag, hostname/zone of launch...
@@ -180,6 +185,7 @@ class TaskInfo(SerializableMixin):
             resource_ids=list(self.resource_ids),
             tpu_chip_ids=list(self.tpu_chip_ids),
             volume_ids=list(self.volume_ids),
+            volumes=dict(self.volumes),
             labels={**self.labels, key: value},
         )
         return info

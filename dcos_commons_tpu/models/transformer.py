@@ -51,6 +51,13 @@ class TransformerConfig:
     remat: bool = True
     use_ring_attention: bool = False   # sequence sharded over "sp"
     sp_axis: str = "sp"
+    # sequence-chunked cross entropy: the [b, s, vocab] f32 logits are
+    # never materialized — each chunk's logits are computed, reduced to
+    # a scalar, and rematerialized in backward.  0 = unchunked.
+    loss_chunk: int = 0
+    # flash-attention tile sizes (VMEM-tunable per chip generation)
+    attn_block_q: int = 128
+    attn_block_k: int = 128
 
     @property
     def head_dim(self) -> int:
@@ -163,7 +170,10 @@ def _attention_block(config: TransformerConfig, layer, x, positions):
 
         attn = ring_attention(q, k, v, axis_name=config.sp_axis, causal=True)
     else:
-        attn = flash_attention(q, k, v, causal=True)
+        attn = flash_attention(
+            q, k, v, causal=True,
+            block_q=config.attn_block_q, block_k=config.attn_block_k,
+        )
     attn = attn.transpose(0, 2, 1, 3).reshape(b, s, h * hd)
     return x + attn @ layer["wo"]
 
@@ -210,6 +220,16 @@ def forward(
     positions: Optional[jax.Array] = None,
 ) -> jax.Array:
     """tokens [b, s] -> logits [b, s, vocab] (f32)."""
+    return _logits(config, params, _trunk(config, params, tokens, positions))
+
+
+def _trunk(
+    config: TransformerConfig,
+    params: Params,
+    tokens: jax.Array,
+    positions: Optional[jax.Array] = None,
+) -> jax.Array:
+    """tokens [b, s] -> final hidden states [b, s, d] (pre-logits)."""
     b, s = tokens.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
@@ -218,15 +238,44 @@ def forward(
             idx = lax.axis_index(config.sp_axis)
             positions = positions + idx * s
     x = params["embed"][tokens].astype(config.dtype)
-    x = _layer_scan(config, params["layers"], x, positions)
-    return _logits(config, params, x)
+    return _layer_scan(config, params["layers"], x, positions)
+
+
+def _nll_mean(
+    config: TransformerConfig,
+    params: Params,
+    x: jax.Array,
+    targets: jax.Array,
+) -> jax.Array:
+    """Mean NLL over [b, s] positions from final hidden states.
+
+    With ``loss_chunk`` set, scans the sequence in chunks so only
+    [b, chunk, vocab] f32 logits are ever live; jax.checkpoint makes
+    the backward recompute each chunk instead of saving it — the same
+    FLOPs-for-HBM trade the layer remat makes.
+    """
+    b, s, _ = x.shape
+    chunk = config.loss_chunk
+    if chunk <= 0 or s % chunk != 0 or s == chunk:
+        return _nll(_logits(config, params, x), targets).mean()
+    n_chunks = s // chunk
+    xs = x.reshape(b, n_chunks, chunk, -1).swapaxes(0, 1)
+    ts = targets.reshape(b, n_chunks, chunk).swapaxes(0, 1)
+
+    def chunk_sum(total, operand):
+        xc, tc = operand
+        return total + _nll(_logits(config, params, xc), tc).sum(), None
+
+    total, _ = lax.scan(jax.checkpoint(chunk_sum), jnp.zeros((), jnp.float32),
+                        (xs, ts))
+    return total / (b * s)
 
 
 def loss_fn(
     config: TransformerConfig, params: Params, tokens: jax.Array,
     targets: jax.Array,
 ) -> jax.Array:
-    return _nll(forward(config, params, tokens), targets).mean()
+    return _nll_mean(config, params, _trunk(config, params, tokens), targets)
 
 
 def _pipeline_trunk(

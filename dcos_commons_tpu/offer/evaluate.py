@@ -138,6 +138,12 @@ class OfferEvaluator:
             reuse = self._try_reuse(requirement, inventory)
             if reuse is not None:
                 return reuse
+            # sidecar tasks (backup/bench plans) launch inside a pod
+            # instance whose footprint already exists: same host, own
+            # scalars, never the pod's chips
+            colocate = self._try_colocate(requirement, inventory, snapshots)
+            if colocate is not None:
+                return colocate
 
         pod = requirement.pod
         rule = parse_placement(pod.placement)
@@ -222,6 +228,73 @@ class OfferEvaluator:
                     )
                 )
         return EvaluationResult(True, outcome, [], task_infos)
+
+    def _try_colocate(
+        self,
+        requirement: PodInstanceRequirement,
+        inventory: SliceInventory,
+        snapshots: List[ResourceSnapshot],
+    ) -> Optional[EvaluationResult]:
+        """Place tasks into a pod instance whose footprint already
+        exists: sibling tasks of the instance hold reservations, so the
+        new tasks claim only their own cpu/mem/ports on that host.
+
+        This is the sidecar-plan path (reference: cassandra backup
+        plans run extra tasks inside the pod's existing executor
+        footprint rather than re-negotiating resources).  The pod's
+        chips are NOT re-reserved — they belong to its main tasks.
+        """
+        pod = requirement.pod
+        sibling_names = {t.name for t in pod.tasks} - set(
+            requirement.tasks_to_launch
+        )
+        if not sibling_names:
+            return None
+        placements: List[Tuple[int, str]] = []
+        for index in requirement.instances:
+            anchors: List[Reservation] = []
+            for other in sibling_names:
+                anchors.extend(
+                    self._ledger.for_task(
+                        task_full_name(pod.type, index, other)
+                    )
+                )
+            host_ids = {r.host_id for r in anchors}
+            if len(host_ids) != 1:
+                return None  # no (or ambiguous) footprint: fresh placement
+            host_id = host_ids.pop()
+            if not inventory.is_up(host_id):
+                return None
+            placements.append((index, host_id))
+        snap_by_host = {s.host.host_id: s for s in snapshots}
+        outcome = EvaluationOutcome.ok(
+            "colocate",
+            f"sidecar tasks joining existing footprint on "
+            f"{[h for _, h in placements]}",
+        )
+        reservations: List[Reservation] = []
+        task_infos: List[TaskInfo] = []
+        for worker_id, (index, host_id) in enumerate(placements):
+            snap = snap_by_host.get(host_id)
+            if snap is None:
+                return None
+            work = snap.copy()
+            res, infos = self._claim_instance(
+                requirement, index, work, [], coordinator="",
+                coordinator_here=False, worker_id=worker_id,
+            )
+            if res is None:
+                return EvaluationResult(
+                    False,
+                    EvaluationOutcome.fail(
+                        "colocate",
+                        f"pod {pod.type}-{index} footprint host {host_id} "
+                        "lacks cpu/mem for the sidecar task",
+                    ),
+                )
+            reservations.extend(res)
+            task_infos.extend(infos)
+        return EvaluationResult(True, outcome, reservations, task_infos)
 
     def _existing_coordinator(
         self, requirement: PodInstanceRequirement
@@ -508,6 +581,13 @@ class OfferEvaluator:
         if override is GoalStateOverride.PAUSED:
             command = PAUSE_COMMAND
             labels[Label.GOAL_STATE_OVERRIDE] = override.value
+        volume_id = next(
+            (r.volume_id for r in reservations if r.volume_id), ""
+        )
+        volumes = {
+            v.container_path: f"{volume_id}-{i}"
+            for i, v in enumerate(task_spec.volumes)
+        } if volume_id else {}
         return TaskInfo(
             name=full,
             task_id=new_task_id(full),
@@ -519,6 +599,7 @@ class OfferEvaluator:
             resource_ids=[r.reservation_id for r in reservations],
             tpu_chip_ids=list(chips),
             volume_ids=[r.volume_id for r in reservations if r.volume_id],
+            volumes=volumes,
             labels=labels,
         )
 

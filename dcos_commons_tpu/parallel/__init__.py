@@ -14,6 +14,10 @@ ICI because the scheduler's torus placement made mesh neighbors
 ICI-adjacent (offer/torus.py).
 """
 
+from dcos_commons_tpu.parallel.collectives import (
+    collective_bandwidth,
+    single_chip_rooflines,
+)
 from dcos_commons_tpu.parallel.mesh import (
     MeshSpec,
     make_mesh,
@@ -24,8 +28,10 @@ from dcos_commons_tpu.parallel.distributed import initialize_from_env
 
 __all__ = [
     "MeshSpec",
+    "collective_bandwidth",
     "initialize_from_env",
     "make_mesh",
     "mesh_from_env",
     "ring_attention",
+    "single_chip_rooflines",
 ]

@@ -78,6 +78,11 @@ class PlanGenerator:
                 phase_name=phase_name,
             )
         steps: List[DeploymentStep] = []
+        # '- default: [[tasks]]' covers every instance not explicitly
+        # listed (reference: cassandra svc.yml deploy steps use
+        # 'default' to stay count-agnostic)
+        explicit = set()
+        expanded = []
         for entry in raw_steps:
             if not isinstance(entry, dict) or len(entry) != 1:
                 raise SpecError(
@@ -85,18 +90,44 @@ class PlanGenerator:
                     "{index: [[tasks...]]} mapping"
                 )
             ((raw_index, task_groups),) = entry.items()
+            if str(raw_index) == "default":
+                if any(i is None for i, _ in expanded):
+                    raise SpecError(
+                        f"phase {phase_name!r}: multiple 'default' step "
+                        "entries would deploy the same instances twice"
+                    )
+                expanded.append((None, task_groups))
+                continue
             try:
                 index = int(raw_index)
             except (TypeError, ValueError):
                 raise SpecError(
                     f"phase {phase_name!r}: step index {raw_index!r} "
-                    "is not an integer"
+                    "is not an integer or 'default'"
                 )
             if not 0 <= index < pod.count:
                 raise SpecError(
                     f"phase {phase_name!r}: step index {index} out of "
                     f"range for pod {pod.type!r} (count {pod.count})"
                 )
+            explicit.add(index)
+            expanded.append((index, task_groups))
+        flat: List = []
+        for index, task_groups in expanded:
+            if index is None:
+                covered = [
+                    i for i in range(pod.count) if i not in explicit
+                ]
+                if pod.gang:
+                    # gang pods deploy slice-atomically: 'default' is
+                    # ONE step over every covered instance (matching
+                    # DeployPlanFactory's whole-slice step)
+                    flat.append((covered, task_groups))
+                else:
+                    flat.extend((([i], task_groups)) for i in covered)
+            else:
+                flat.append(([index], task_groups))
+        for instances, task_groups in flat:
             for tasks in task_groups:
                 task_list = [str(t) for t in tasks]
                 unknown = [
@@ -109,15 +140,21 @@ class PlanGenerator:
                         f"for pod {pod.type!r}"
                     )
                 requirement = PodInstanceRequirement(
-                    pod=pod, instances=[index], tasks_to_launch=task_list
+                    pod=pod, instances=list(instances),
+                    tasks_to_launch=task_list,
+                )
+                label = (
+                    f"{pod.type}-{instances[0]}"
+                    if len(instances) == 1
+                    else f"{pod.type}-gang"
                 )
                 step = DeploymentStep(
-                    f"{pod.type}-{index}:[{','.join(task_list)}]",
+                    f"{label}:[{','.join(task_list)}]",
                     requirement,
                     backoff=self._backoff,
                 )
                 self._factory.seed_step_from_state(
-                    step, pod, [index], state_store, target_config_id
+                    step, pod, list(instances), state_store, target_config_id
                 )
                 steps.append(step)
         return Phase(phase_name, steps, strategy_for_name(strategy_name))

@@ -138,11 +138,15 @@ class FrameworkRunner:
         config: Optional[SchedulerConfig] = None,
         topology_hosts: Optional[List[TpuHost]] = None,
         agent_urls: Optional[Dict[str, str]] = None,
+        builder_hook=None,
     ):
         self.spec = spec
         self.config = config or SchedulerConfig.from_env()
         self.topology_hosts = topology_hosts or []
         self.agent_urls = agent_urls or {}
+        # hook(builder, spec): framework-specific wiring (recovery
+        # overriders, plan customizers) — the Main.java analogue
+        self.builder_hook = builder_hook
         self.scheduler = None
         self.api_server = None
         self.fleet = None
@@ -181,6 +185,8 @@ class FrameworkRunner:
         builder = SchedulerBuilder(self.spec, self.config)
         builder.set_inventory(inventory)
         builder.set_agent(agent)
+        if self.builder_hook is not None:
+            self.builder_hook(builder, self.spec)
         self.scheduler = builder.build()
 
     def run(self) -> int:
@@ -257,7 +263,7 @@ class FrameworkRunner:
             self.scheduler.stop()
 
 
-def serve_main(argv: Optional[List[str]] = None) -> int:
+def serve_main(argv: Optional[List[str]] = None, builder_hook=None) -> int:
     """``python -m dcos_commons_tpu serve`` argument handling."""
     import argparse
 
@@ -321,7 +327,8 @@ def serve_main(argv: Optional[List[str]] = None) -> int:
         print(f"configuration error: {e}", file=sys.stderr)
         return EXIT_BAD_CONFIG
     runner = FrameworkRunner(
-        spec, config, topology_hosts=hosts, agent_urls=urls
+        spec, config, topology_hosts=hosts, agent_urls=urls,
+        builder_hook=builder_hook,
     )
     runner.announce_file = args.announce_file
     runner.api_bind = args.bind

@@ -32,9 +32,11 @@ class ConfigStore:
 
     def store(self, config: Dict[str, Any]) -> str:
         config_id = str(uuid_mod.uuid4())
+        # NO sort_keys: plan phase order is semantic (journal -> name
+        # -> data) and json round-trips preserve insertion order
         self._persister.set(
             self._config_path(config_id),
-            json.dumps(config, sort_keys=True).encode("utf-8"),
+            json.dumps(config).encode("utf-8"),
         )
         return config_id
 

@@ -71,8 +71,12 @@ class ServiceTestRunner:
             TpuHost(host_id=f"host-{i}") for i in range(3)
         ]
         self.persister = persister or MemPersister()
+        # sim cycles run in microseconds: the revive token bucket would
+        # throttle ordinary serial-deploy step boundaries that take
+        # seconds of wall clock in production.  Tests of the throttle
+        # itself install their own bucket.
         self.config = scheduler_config or SchedulerConfig(
-            backoff_enabled=False
+            backoff_enabled=False, revive_capacity=1_000_000
         )
         self._builder_hook = builder_hook
         self.agent = FakeAgent()

@@ -1,0 +1,96 @@
+"""Collective benchmark: bandwidth math on the CPU mesh + the sidecar
+plan wiring in frameworks/jax/svc.yml.
+
+Reference analogue: the cassandra backup/restore sidecar plans are the
+shape (frameworks/cassandra sidecar plans); the bandwidth axis itself
+is TPU green-field (BASELINE.json north star: pjit allreduce
+GB/s/chip).
+"""
+
+import os
+
+import jax
+import pytest
+from jax.sharding import Mesh
+
+from dcos_commons_tpu.offer.inventory import make_test_fleet
+from dcos_commons_tpu.parallel.collectives import (
+    collective_bandwidth,
+    single_chip_rooflines,
+)
+from dcos_commons_tpu.plan.status import Status
+from dcos_commons_tpu.testing import (
+    AdvanceCycles,
+    ExpectDeploymentComplete,
+    ExpectLaunchedTasks,
+    ExpectNoLaunches,
+    ExpectPlanStatus,
+    PlanStart,
+    SendTaskFinished,
+    SendTaskRunning,
+    ServiceTestRunner,
+)
+
+JAX_SVC = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "frameworks", "jax", "svc.yml",
+)
+
+
+def test_collective_bandwidth_on_virtual_mesh():
+    """All four collectives run, chain, and report positive bandwidth
+    on the 8-device CPU mesh (correctness now, line rate on HW)."""
+    mesh = Mesh(jax.devices(), ("ici",))
+    report = collective_bandwidth(mesh, "ici", payload_mb=0.5, iters=2)
+    assert report["axis_size"] == 8.0
+    for name in ("psum", "all_gather", "reduce_scatter", "ppermute"):
+        assert report[f"{name}_gbps_per_chip"] > 0, report
+
+
+def test_single_chip_rooflines_report():
+    report = single_chip_rooflines(
+        payload_mb=4.0, iters=2, chain_floor=2, matmul_dim=256
+    )
+    assert report["hbm_copy_gbps"] > 0
+    assert report["matmul_bf16_tflops"] > 0
+
+
+def test_collective_bandwidth_single_device_degenerates():
+    mesh = Mesh(jax.devices()[:1], ("ici",))
+    report = collective_bandwidth(mesh, "ici", payload_mb=0.5, iters=2)
+    assert report["axis_size"] == 1.0
+    assert "psum_gbps_per_chip" not in report
+
+
+def test_jax_svc_collectives_sidecar_plan():
+    """frameworks/jax svc.yml: deploy launches ONLY the workers (one
+    gang step); `plan start collectives` then launches the ONCE
+    collective-bench task on every gang member."""
+    with open(JAX_SVC) as f:
+        yaml_text = f.read()
+    hosts = make_test_fleet(host_grid=(2, 2), chip_block=(2, 2))
+    runner = ServiceTestRunner(yaml_text, hosts=hosts)
+    runner.run([
+        AdvanceCycles(1),
+        ExpectLaunchedTasks(*[f"trainer-{i}-worker" for i in range(4)]),
+    ])
+    for i in range(4):
+        runner.run([SendTaskRunning(f"trainer-{i}-worker")])
+    runner.run([
+        ExpectDeploymentComplete(),
+        AdvanceCycles(2),
+        ExpectNoLaunches(),  # sidecar interrupted until started
+    ])
+    runner.run([
+        PlanStart("collectives"),
+        AdvanceCycles(1),
+        ExpectLaunchedTasks(
+            *[f"trainer-{i}-collective-bench" for i in range(4)]
+        ),
+    ])
+    for i in range(4):
+        runner.run([SendTaskFinished(f"trainer-{i}-collective-bench")])
+    runner.run([ExpectPlanStatus("collectives", Status.COMPLETE)])
+    # the workers kept running through the bench
+    for i in range(4):
+        assert len(runner.world.agent.launches_of(f"trainer-{i}-worker")) == 1

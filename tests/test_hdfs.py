@@ -1,0 +1,198 @@
+"""frameworks/hdfs: the stateful multi-pod-type service.
+
+Reference: frameworks/hdfs (3 pod types, ordered deploy,
+HdfsRecoveryPlanOverrider name-node choreography) and BASELINE
+config #5 (hdfs + jax co-scheduled on shared inventory).
+"""
+
+import os
+import sys
+
+from dcos_commons_tpu.common import TaskState, TaskStatus
+from dcos_commons_tpu.multi import MultiServiceScheduler
+from dcos_commons_tpu.offer.inventory import SliceInventory, make_test_fleet
+from dcos_commons_tpu.plan.status import Status
+from dcos_commons_tpu.recovery.monitor import TestingFailureMonitor
+from dcos_commons_tpu.scheduler import SchedulerConfig
+from dcos_commons_tpu.specification.yaml_spec import from_yaml
+from dcos_commons_tpu.storage import MemPersister
+from dcos_commons_tpu.testing import (
+    AdvanceCycles,
+    ExpectDeploymentComplete,
+    ExpectLaunchedTasks,
+    ExpectPlanStatus,
+    FakeAgent,
+    SendTaskFailed,
+    SendTaskFinished,
+    SendTaskRunning,
+    ServiceTestRunner,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HDFS_DIR = os.path.join(REPO, "frameworks", "hdfs")
+sys.path.insert(0, HDFS_DIR)
+
+from scheduler import make_name_node_overrider  # noqa: E402
+
+
+def load_svc() -> str:
+    with open(os.path.join(HDFS_DIR, "svc.yml")) as f:
+        return f.read()
+
+
+def deploy_ticks():
+    """Scripted full deploy: journal x3 (parallel) -> name (format,
+    node; bootstrap, node) -> data x3 (parallel)."""
+    return [
+        AdvanceCycles(1),
+        ExpectLaunchedTasks(
+            "journal-0-node", "journal-1-node", "journal-2-node"
+        ),
+        SendTaskRunning("journal-0-node"),
+        SendTaskRunning("journal-1-node"),
+        SendTaskRunning("journal-2-node"),
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("name-0-format"),
+        SendTaskFinished("name-0-format"),
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("name-0-node"),
+        SendTaskRunning("name-0-node"),
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("name-1-bootstrap"),
+        SendTaskFinished("name-1-bootstrap"),
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("name-1-node"),
+        SendTaskRunning("name-1-node"),
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("data-0-node", "data-1-node", "data-2-node"),
+        SendTaskRunning("data-0-node"),
+        SendTaskRunning("data-1-node"),
+        SendTaskRunning("data-2-node"),
+        ExpectDeploymentComplete(),
+    ]
+
+
+def make_runner(**kw):
+    hosts = make_test_fleet(host_grid=(3, 3), chip_block=(1, 1))
+    return ServiceTestRunner(load_svc(), hosts=hosts, **kw)
+
+
+def test_ordered_multi_pod_deploy():
+    """Deploy honors the phase order and per-instance step
+    choreography of the custom plan (journal -> name -> data)."""
+    runner = make_runner()
+    runner.run(deploy_ticks())
+    # format ran only on name-0, bootstrap only on name-1
+    assert runner.world.agent.task_id_of("name-1-format") is None
+    assert runner.world.agent.task_id_of("name-0-bootstrap") is None
+
+
+def test_name_node_replace_runs_bootstrap_choreography():
+    """PERMANENT name-node failure triggers the overrider phase:
+    bootstrap re-runs BEFORE the node relaunches (reference:
+    HdfsRecoveryPlanOverrider; hook recovery/manager.py)."""
+    runner = make_runner()
+    # wire the overrider + a monitor that makes every failure PERMANENT
+    spec = runner.spec
+
+    def hook(builder):
+        builder.add_recovery_overrider(make_name_node_overrider(spec))
+        builder.set_failure_monitor(
+            TestingFailureMonitor(permanent_tasks=["name-1-node"])
+        )
+
+    runner._builder_hook = hook
+    runner.run(deploy_ticks())
+    runner.run([
+        SendTaskFailed("name-1-node"),
+        AdvanceCycles(1),
+    ])
+    recovery = runner.world.scheduler.plan("recovery")
+    phase = recovery.phases[0]
+    assert [s.name for s in phase.steps] == [
+        "bootstrap-name-1", "relaunch-name-1"
+    ]
+    runner.run([
+        ExpectLaunchedTasks("name-1-bootstrap"),
+        SendTaskFinished("name-1-bootstrap"),
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("name-1-node"),
+        SendTaskRunning("name-1-node"),
+        ExpectPlanStatus("recovery", Status.COMPLETE),
+    ])
+    # bootstrap ran twice total: once at deploy, once for the replace
+    assert len(runner.world.agent.launches_of("name-1-bootstrap")) == 2
+
+
+def test_journal_failure_uses_default_recovery():
+    """The overrider only fires for name-pod PERMANENT replaces;
+    journal failures keep the default single-step recovery."""
+    runner = make_runner()
+    spec = runner.spec
+    runner._builder_hook = lambda b: b.add_recovery_overrider(
+        make_name_node_overrider(spec)
+    )
+    runner.run(deploy_ticks())
+    runner.run([
+        SendTaskFailed("journal-2-node"),
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("journal-2-node"),
+        SendTaskRunning("journal-2-node"),
+        ExpectPlanStatus("recovery", Status.COMPLETE),
+    ])
+    assert len(runner.world.agent.launches_of("journal-2-node")) == 2
+
+
+def test_hdfs_jax_coschedule_shared_inventory():
+    """BASELINE config #5: hdfs + the jax gang pod co-scheduled by the
+    multi scheduler on one fleet without resource conflicts."""
+    with open(os.path.join(REPO, "frameworks", "jax", "svc.yml")) as f:
+        jax_yaml = f.read()
+    fleet = make_test_fleet(host_grid=(3, 3), chip_block=(2, 2))
+    agent = FakeAgent()
+    multi = MultiServiceScheduler(
+        persister=MemPersister(),
+        inventory=SliceInventory(fleet),
+        agent=agent,
+        scheduler_config=SchedulerConfig(backoff_enabled=False),
+    )
+    multi.add_service(from_yaml(load_svc()))
+    multi.add_service(from_yaml(jax_yaml, env={"TPU_TOPOLOGY": "4x4"}))
+    for _ in range(16):
+        multi.run_cycle()
+        for info in agent.launched:
+            goal = "FINISHED" if info.name.split("-")[-1] in (
+                "format", "bootstrap"
+            ) else "RUNNING"
+            agent.send(TaskStatus(
+                task_id=info.task_id,
+                state=TaskState.FINISHED if goal == "FINISHED"
+                else TaskState.RUNNING,
+                ready=True,
+            ))
+        hdfs = multi.get_service("hdfs")
+        trainer = multi.get_service("jax-trainer")
+        if (
+            hdfs.deploy_manager.get_plan().is_complete
+            and trainer.deploy_manager.get_plan().is_complete
+        ):
+            break
+    hdfs = multi.get_service("hdfs")
+    trainer = multi.get_service("jax-trainer")
+    assert hdfs.deploy_manager.get_plan().is_complete
+    assert trainer.deploy_manager.get_plan().is_complete
+    # gang workers each got a whole host's chips; no chip is
+    # double-booked across the two services' namespaced ledgers
+    reservations = [
+        r
+        for svc in (hdfs, trainer)
+        for r in svc.ledger.all()
+    ]
+    by_host_chips = {}
+    for r in reservations:
+        for c in r.chip_ids:
+            key = (r.host_id, c)
+            assert key not in by_host_chips, f"chip double-booked: {key}"
+            by_host_chips[key] = r.task_name
+    # hdfs placed all 8 tasks, jax placed 4 gang workers
+    assert len(agent.launched) >= 12
