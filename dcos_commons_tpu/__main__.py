@@ -3,6 +3,7 @@
     python -m dcos_commons_tpu serve svc.yml --topology cluster.yml
     python -m dcos_commons_tpu agent --host-id h0 --workdir ./sandbox
     python -m dcos_commons_tpu cli  <verb> ...
+    python -m dcos_commons_tpu state-server --data-dir ./cluster-state
 
 Reference: the pair of process mains the reference ships — the
 scheduler process (SchedulerRunner.java:82 via each framework's
@@ -33,8 +34,15 @@ def main(argv=None) -> int:
         from dcos_commons_tpu.cli.commands import main as cli_main
 
         return cli_main(rest)
-    print(f"unknown command {command!r}; try serve | agent | cli",
-          file=sys.stderr)
+    if command == "state-server":
+        from dcos_commons_tpu.storage.remote import main as state_main
+
+        return state_main(rest)
+    print(
+        f"unknown command {command!r}; "
+        "try serve | agent | cli | state-server",
+        file=sys.stderr,
+    )
     return 1
 
 

@@ -183,6 +183,8 @@ class AgentDaemon:
                 readiness=ReadinessCheckSpec(**readiness) if readiness else None,
                 health=HealthCheckSpec(**health) if health else None,
                 templates=entry.get("templates"),
+                files=entry.get("files"),
+                secret_env=entry.get("secret_env"),
             )
             launched.append(info.task_id)
         return launched

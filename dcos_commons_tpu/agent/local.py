@@ -117,6 +117,40 @@ class LocalProcessAgent:
         for info in task_infos:
             self.launch_one(info)
 
+    def _write_secure_files(
+        self, sandbox: str, files: Optional[List[dict]]
+    ) -> None:
+        """Write launch-shipped secret/TLS files, sandbox-confined,
+        with the scheduler-specified mode (0600 for keys).  An entry
+        carrying ``error`` fails the launch before the command runs
+        (the bootstrap fail-before-cmd discipline)."""
+        import base64 as _b64
+
+        for entry in files or []:
+            if "error" in entry:
+                raise ValueError(entry["error"])
+            dest = entry["dest"]
+            real_sandbox = os.path.realpath(sandbox)
+            path = os.path.realpath(os.path.join(real_sandbox, dest))
+            if path != real_sandbox and not path.startswith(
+                real_sandbox + os.sep
+            ):
+                raise ValueError(f"file dest escapes sandbox: {dest!r}")
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            content = _b64.b64decode(entry.get("content") or "")
+            fd = os.open(
+                path,
+                os.O_WRONLY | os.O_CREAT | os.O_TRUNC,
+                int(entry.get("mode", 0o600)),
+            )
+            try:
+                os.write(fd, content)
+            finally:
+                os.close(fd)
+            # O_CREAT mode is masked by umask and ignored on existing
+            # files: enforce explicitly
+            os.chmod(path, int(entry.get("mode", 0o600)))
+
     def _attach_volumes(self, sandbox: str, info: TaskInfo) -> None:
         """Materialize persistent volumes: a durable directory per
         volume key under <workdir>/volumes/, symlinked into the sandbox
@@ -134,8 +168,15 @@ class LocalProcessAgent:
             )
             os.makedirs(durable, exist_ok=True)
             link = os.path.join(sandbox, container_path)
-            if os.path.islink(link) or os.path.exists(link):
-                continue  # relaunch into an existing sandbox
+            if os.path.islink(link):
+                if os.readlink(link) == durable:
+                    continue  # relaunch with the same volume key
+                # new key into an old sandbox (PERMANENT replace on the
+                # same host): relink, or the task would silently
+                # reattach the previous incarnation's data
+                os.remove(link)
+            elif os.path.exists(link):
+                continue  # pre-existing real dir: leave it alone
             os.makedirs(os.path.dirname(link), exist_ok=True)
             os.symlink(durable, link)
 
@@ -145,6 +186,8 @@ class LocalProcessAgent:
         readiness: Optional[ReadinessCheckSpec] = None,
         health: Optional[HealthCheckSpec] = None,
         templates: Optional[List[dict]] = None,
+        files: Optional[List[dict]] = None,
+        secret_env: Optional[Dict[str, str]] = None,
     ) -> None:
         with self._lock:
             if info.task_id in self._tasks:
@@ -187,7 +230,22 @@ class LocalProcessAgent:
                 return
             env = dict(os.environ)
             env.update(info.env)
+            # secret env values ride the launch request only — merged
+            # here at exec time, never part of the persisted TaskInfo
+            env.update(secret_env or {})
             env["SANDBOX"] = sandbox
+            try:
+                self._write_secure_files(sandbox, files)
+            except Exception as e:
+                self._pending.append(
+                    TaskStatus(
+                        task_id=info.task_id,
+                        state=TaskState.ERROR,
+                        message=f"secure file provisioning failed: {e}",
+                        agent_id=info.agent_id,
+                    )
+                )
+                return
             try:
                 write_templates(sandbox, rendered)
             except Exception as e:

@@ -186,6 +186,8 @@ class RemoteFleet(Agent):
         readiness=None,
         health=None,
         templates: Optional[List[dict]] = None,
+        files: Optional[List[dict]] = None,
+        secret_env: Optional[Dict[str, str]] = None,
     ) -> None:
         client = self._clients.get(info.agent_id)
         if client is None:
@@ -196,6 +198,8 @@ class RemoteFleet(Agent):
             "readiness": serialize_check(readiness),
             "health": serialize_check(health),
             "templates": templates or [],
+            "files": files or [],
+            "secret_env": secret_env or {},
         }
         try:
             client.launch([entry])

@@ -274,14 +274,35 @@ class OfferEvaluator:
         )
         reservations: List[Reservation] = []
         task_infos: List[TaskInfo] = []
-        for worker_id, (index, host_id) in enumerate(placements):
-            snap = snap_by_host.get(host_id)
-            if snap is None:
+        # a gang sidecar group (the collectives bench) rendezvous like
+        # the main gang: instance 0's host carries a fresh coordinator
+        # port for THIS task group — the trainer's port is in use
+        gang_group = (
+            pod.gang and pod.tpu is not None and len(placements) > 1
+        )
+        coordinator = ""
+        if gang_group:
+            coord_host = placements[0][1]
+            coord_snap = snap_by_host.get(coord_host)
+            if coord_snap is None:
                 return None
-            work = snap.copy()
+            coord_port = coord_snap.copy().allocate_port()
+            coordinator = f"{coord_host}:{coord_port}"
+        # instances sharing a host consume from ONE working snapshot so
+        # capacity cannot be double-booked
+        claimed: Dict[str, ResourceSnapshot] = {}
+        for worker_id, (index, host_id) in enumerate(placements):
+            work = claimed.get(host_id)
+            if work is None:
+                snap = snap_by_host.get(host_id)
+                if snap is None:
+                    return None
+                work = snap.copy()
+                claimed[host_id] = work
             res, infos = self._claim_instance(
-                requirement, index, work, [], coordinator="",
-                coordinator_here=False, worker_id=worker_id,
+                requirement, index, work, [], coordinator=coordinator,
+                coordinator_here=(gang_group and worker_id == 0),
+                worker_id=worker_id,
             )
             if res is None:
                 return EvaluationResult(
@@ -465,6 +486,9 @@ class OfferEvaluator:
         reservations: List[Reservation] = []
         task_infos: List[TaskInfo] = []
         chips_assigned = False
+        # volume keys shared across the tasks claimed in THIS call
+        # (ledger lookups only see already-committed siblings)
+        instance_volumes: Dict[str, str] = {}
         coord_res: Optional[Reservation] = None
         if coordinator_here:
             coord_port = work.allocate_port(int(coordinator.rsplit(":", 1)[1]))
@@ -503,6 +527,9 @@ class OfferEvaluator:
                 port_env[key] = str(port)
             task_chips = chips if not chips_assigned else []
             chips_assigned = chips_assigned or bool(chips)
+            volumes = self._instance_volume_keys(
+                requirement, pod, index, task_spec, instance_volumes
+            )
             reservation = Reservation(
                 reservation_id=new_reservation_id(),
                 host_id=work.host.host_id,
@@ -518,6 +545,7 @@ class OfferEvaluator:
                 container_path=(
                     task_spec.volumes[0].container_path if task_spec.volumes else ""
                 ),
+                volumes=volumes,
             )
             reservations.append(reservation)
             # the coordinator-port claim rides on the first task's
@@ -534,6 +562,41 @@ class OfferEvaluator:
             )
             task_infos.append(info)
         return reservations, task_infos
+
+    def _instance_volume_keys(
+        self,
+        requirement,
+        pod,
+        index: int,
+        task_spec,
+        claimed_now: Optional[Dict[str, str]] = None,
+    ) -> Dict[str, str]:
+        """container_path -> durable volume key for one task.
+
+        Sibling tasks of one pod instance that declare the SAME
+        container path share one key, so the hdfs format-then-node
+        choreography writes and reads one durable directory
+        (reference: pods share their resource set's volumes).  A
+        PERMANENT replace never reuses old keys — the replacement
+        starts empty."""
+        keys: Dict[str, str] = {}
+        if not task_spec.volumes:
+            return keys
+        existing: Dict[str, str] = dict(claimed_now or {})
+        if requirement.recovery_type is not RecoveryType.PERMANENT:
+            for sibling in pod.tasks:
+                full = task_full_name(pod.type, index, sibling.name)
+                for res in self._ledger.for_task(full):
+                    for path, key in (res.volumes or {}).items():
+                        existing.setdefault(path, key)
+        for v in task_spec.volumes:
+            keys[v.container_path] = existing.get(
+                v.container_path, uuid.uuid4().hex
+            )
+            existing[v.container_path] = keys[v.container_path]
+        if claimed_now is not None:
+            claimed_now.update(keys)
+        return keys
 
     def _build_task_info(
         self,
@@ -581,13 +644,9 @@ class OfferEvaluator:
         if override is GoalStateOverride.PAUSED:
             command = PAUSE_COMMAND
             labels[Label.GOAL_STATE_OVERRIDE] = override.value
-        volume_id = next(
-            (r.volume_id for r in reservations if r.volume_id), ""
-        )
-        volumes = {
-            v.container_path: f"{volume_id}-{i}"
-            for i, v in enumerate(task_spec.volumes)
-        } if volume_id else {}
+        volumes: Dict[str, str] = {}
+        for r in reservations:
+            volumes.update(r.volumes or {})
         return TaskInfo(
             name=full,
             task_id=new_task_id(full),

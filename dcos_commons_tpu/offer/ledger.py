@@ -38,6 +38,10 @@ class Reservation(SerializableMixin):
     ports: List[int] = field(default_factory=list)
     volume_id: str = ""              # persistent volume surviving relaunch
     container_path: str = ""
+    # container_path -> durable volume key for EVERY volume of the
+    # task; sibling tasks of one pod instance that declare the same
+    # container path share the key (one durable dir per instance+path)
+    volumes: Dict[str, str] = field(default_factory=dict)
 
 
 def new_reservation_id() -> str:

@@ -38,11 +38,17 @@ def _sync(x) -> float:
     jax.block_until_ready(x)
     return float(jax.device_get(jnp.sum(x.astype(jnp.float32))))
 
-# bytes moved over ICI per chip, per payload byte, for an n-ring
+# bytes moved over ICI per chip, per byte of the PER-CHIP shard S, on
+# an n-ring (NCCL bus-bandwidth convention): allreduce carries S both
+# ways in n-1 chunked steps (2(n-1)/n * S since the reduce+broadcast
+# halves each move S/n per step over 2(n-1) steps... net 2(n-1)/n*S);
+# all_gather forwards n-1 shard-sized chunks ((n-1)*S); tiled
+# reduce_scatter reduces an n*S input down to S, also (n-1)*S per
+# chip; a ring ppermute moves exactly S.
 _ALGO_FACTOR = {
     "psum": lambda n: 2.0 * (n - 1) / n,
-    "all_gather": lambda n: (n - 1) / n,
-    "reduce_scatter": lambda n: (n - 1) / n,
+    "all_gather": lambda n: float(n - 1),
+    "reduce_scatter": lambda n: float(n - 1),
     "ppermute": lambda n: 1.0,
 }
 

@@ -147,6 +147,23 @@ class FrameworkRunner:
         # hook(builder, spec): framework-specific wiring (recovery
         # overriders, plan customizers) — the Main.java analogue
         self.builder_hook = builder_hook
+        if self.config.state_url:
+            # remote state => remote lease (CuratorLocker analogue): a
+            # per-host file lock cannot exclude a standby on another
+            # host, and lease expiry is what makes failover automatic
+            import os as _os
+            import socket as _socket
+
+            from dcos_commons_tpu.storage.remote import RemoteLocker
+
+            self._lock = RemoteLocker(
+                self.config.state_url,
+                name=f"scheduler-{spec.name}",
+                owner=f"{_socket.gethostname()}-{_os.getpid()}",
+                ttl_s=self.config.state_lease_ttl_s,
+            )
+        else:
+            self._lock = InstanceLock(self.config.state_dir)
         self.scheduler = None
         self.api_server = None
         self.fleet = None
@@ -160,7 +177,6 @@ class FrameworkRunner:
         # REQUIRED for remote fleets not on this machine — the default
         # (the server's own loopback URL) is meaningless on other hosts
         self.advertise_url: str = ""
-        self._lock = InstanceLock(self.config.state_dir)
         self._stop_requested = threading.Event()
 
     # -- assembly -----------------------------------------------------
@@ -279,6 +295,17 @@ def serve_main(argv: Optional[List[str]] = None, builder_hook=None) -> int:
     )
     parser.add_argument("--port", type=int, default=None, help="API port")
     parser.add_argument("--state-dir", default=None)
+    parser.add_argument(
+        "--state-url",
+        default=None,
+        help="cluster state server URL (remote persistence + lease "
+             "lock; omit for local file WAL state)",
+    )
+    parser.add_argument(
+        "--secrets-dir",
+        default=None,
+        help="operator-managed secrets directory (FileSecretsProvider)",
+    )
     parser.add_argument("--sandbox-root", default=None)
     parser.add_argument(
         "--env",
@@ -318,6 +345,10 @@ def serve_main(argv: Optional[List[str]] = None, builder_hook=None) -> int:
         config.api_port = args.port
     if args.state_dir is not None:
         config.state_dir = args.state_dir
+    if args.state_url is not None:
+        config.state_url = args.state_url
+    if args.secrets_dir is not None:
+        config.secrets_dir = args.secrets_dir
     if args.sandbox_root is not None:
         config.sandbox_root = args.sandbox_root
     try:

@@ -73,6 +73,7 @@ class SchedulerBuilder:
         self._recovery_overriders: List[RecoveryPlanOverrider] = []
         self._failure_monitor: Optional[FailureMonitor] = None
         self._namespace = self._config.service_namespace
+        self._secrets_provider = None
 
     # -- fluent wiring (reference: SchedulerBuilder setters) ----------
 
@@ -100,18 +101,26 @@ class SchedulerBuilder:
         self._failure_monitor = monitor
         return self
 
+    def set_secrets_provider(self, provider) -> "SchedulerBuilder":
+        """Reference: the SecretsClient the X2 subsystem talks to."""
+        self._secrets_provider = provider
+        return self
+
     # -- build --------------------------------------------------------
 
     def build(self) -> DefaultScheduler:
         persister = self._persister
         if persister is None:
-            persister = FileWalPersister(self._config.state_dir)
-        if self._config.state_cache_enabled and not isinstance(
-            persister, (MemPersister, PersisterCache)
-        ):
-            # FileWalPersister is RAM-backed already; the cache layer is
-            # for future remote persisters. Kept off by default here.
-            pass
+            if self._config.state_url:
+                # networked state (reference: CuratorPersister over ZK)
+                # behind the full-tree cache so reads never leave RAM
+                from dcos_commons_tpu.storage.remote import RemotePersister
+
+                persister = RemotePersister(self._config.state_url)
+                if self._config.state_cache_enabled:
+                    persister = PersisterCache(persister)
+            else:
+                persister = FileWalPersister(self._config.state_dir)
         SchemaVersionStore(persister).check()
         state_store = StateStore(persister, self._namespace)
         config_store = ConfigStore(persister, self._namespace)
@@ -238,11 +247,39 @@ class SchedulerBuilder:
                 ) or decommission_plan
             other_managers.append(DefaultPlanManager(decommission_plan))
 
+        # security plane: a secrets provider must exist BEFORE a spec
+        # that references secrets may deploy (reference: the
+        # TLSRequiresServiceAccount gating pattern — fail configuration,
+        # not the eventual launch); TLS just needs a persisted CA
+        secrets_provider = self._secrets_provider
+        if secrets_provider is None and self._config.secrets_dir:
+            from dcos_commons_tpu.security import FileSecretsProvider
+
+            secrets_provider = FileSecretsProvider(self._config.secrets_dir)
+        uses_secrets = any(pod.secrets for pod in target_spec.pods)
+        if uses_secrets and secrets_provider is None:
+            raise ConfigValidationError([
+                "service references secrets but no secrets provider is "
+                "configured (set SECRETS_DIR / --secrets-dir or wire one "
+                "via SchedulerBuilder.set_secrets_provider)"
+            ])
+        certificate_authority = None
+        if any(
+            task.transport_encryption
+            for pod in target_spec.pods
+            for task in pod.tasks
+        ):
+            from dcos_commons_tpu.security import CertificateAuthority
+
+            certificate_authority = CertificateAuthority.load_or_create(
+                persister
+            )
+
         from dcos_commons_tpu.state.framework_store import FrameworkStore
 
         from dcos_commons_tpu.runtime.token_bucket import TokenBucket
 
-        return DefaultScheduler(
+        scheduler = DefaultScheduler(
             spec=target_spec,
             state_store=state_store,
             ledger=ledger,
@@ -259,6 +296,9 @@ class SchedulerBuilder:
                 refill_interval_s=self._config.revive_refill_s,
             ),
         )
+        scheduler.secrets_provider = secrets_provider
+        scheduler.certificate_authority = certificate_authority
+        return scheduler
 
     # -- config update (reference: DefaultConfigurationUpdater:159) ---
 

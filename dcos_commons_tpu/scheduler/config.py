@@ -17,6 +17,15 @@ from typing import Mapping, Optional
 class SchedulerConfig:
     api_port: int = 8080
     state_dir: str = "./state"
+    # networked persistence: when set, scheduler state lives on the
+    # cluster state server (reference: ZK via CuratorPersister) and the
+    # instance lock is a TTL lease there (CuratorLocker) — the
+    # scheduler process becomes host-agnostic and failover is real
+    state_url: str = ""
+    state_lease_ttl_s: float = 15.0
+    # secrets provider root (reference: DC/OS secrets service; here an
+    # operator-managed directory tree read by FileSecretsProvider)
+    secrets_dir: str = ""
     service_namespace: str = ""
     uninstall: bool = False              # reference: SDK_UNINSTALL
     state_cache_enabled: bool = True     # reference: DISABLE_STATE_CACHE
@@ -41,6 +50,9 @@ class SchedulerConfig:
         return SchedulerConfig(
             api_port=int(env.get("PORT_API", "8080")),
             state_dir=env.get("STATE_DIR", "./state"),
+            state_url=env.get("STATE_URL", ""),
+            state_lease_ttl_s=float(env.get("STATE_LEASE_TTL_S", "15")),
+            secrets_dir=env.get("SECRETS_DIR", ""),
             service_namespace=env.get("SERVICE_NAMESPACE", ""),
             uninstall=env.get("SDK_UNINSTALL", "") not in ("", "0", "false"),
             state_cache_enabled=env.get("DISABLE_STATE_CACHE", "")

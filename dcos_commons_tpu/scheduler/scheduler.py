@@ -118,6 +118,12 @@ class DefaultScheduler:
         # sdk/bootstrap/main.go:291-376); when absent (in-process
         # tests/bench) template content ships inline with the launch
         self.artifact_base: Optional[str] = None
+        # security plane (X2): resolves pod secret refs at launch and
+        # issues per-task TLS PEMs; values ride ONLY the launch channel
+        # (never the state store or artifact URLs).  Set by the builder
+        # (reference: SecretsClient + CertificateAuthorityClient)
+        self.secrets_provider = None
+        self.certificate_authority = None
         self._suppressed = False
         self._fatal_error: Optional[str] = None
         self._stop = threading.Event()
@@ -326,14 +332,81 @@ class DefaultScheduler:
                 GoalStateOverride.PAUSED.value
             launch_one = getattr(self.agent, "launch_one", None)
             if launch_one is not None and task_spec is not None:
+                files, secret_env = self._security_payload(
+                    info, pod, task_spec
+                )
+                kwargs = {}
+                if files or secret_env:
+                    kwargs = {"files": files, "secret_env": secret_env}
                 launch_one(
                     info,
                     readiness=None if paused else task_spec.readiness_check,
                     health=None if paused else task_spec.health_check,
                     templates=self._templates_for(info, task_spec),
+                    **kwargs,
                 )
             else:
                 self.agent.launch([info])
+
+    def _security_payload(self, info, pod, task_spec):
+        """Secret files/env + TLS PEMs for one launch.
+
+        Reference: TLSEvaluationStage.java placing cert/key artifacts
+        and the Mesos Secret volume flow — values resolve at launch
+        and ship with the request; a missing secret fails the launch
+        as an ERROR file entry (the agent refuses to start the task),
+        matching the fail-before-cmd bootstrap discipline.
+        """
+        import base64 as _b64
+
+        files: List[dict] = []
+        secret_env: Dict[str, str] = {}
+
+        def add_file(dest: str, content: bytes, mode: int = 0o600) -> None:
+            files.append({
+                "dest": dest,
+                "content": _b64.b64encode(content).decode(),
+                "mode": mode,
+            })
+
+        for sec in pod.secrets:
+            try:
+                if self.secrets_provider is None:
+                    raise RuntimeError("no secrets provider configured")
+                value = self.secrets_provider.fetch(sec.secret)
+            except Exception as e:
+                files.append({
+                    "dest": sec.file or sec.secret,
+                    "error": f"secret {sec.secret!r} unavailable: {e}",
+                })
+                continue
+            if sec.file:
+                add_file(sec.file, value)
+            env_key = sec.effective_env_key()
+            if env_key:
+                secret_env[env_key] = value.decode("utf-8", "replace")
+        tls_specs = [
+            t for t in task_spec.transport_encryption
+            if t.type in ("TLS", "KEYSTORE")
+        ]
+        if tls_specs:
+            ca = self.certificate_authority
+            if ca is None:
+                files.append({
+                    "dest": f"{tls_specs[0].name}.crt",
+                    "error": "transport-encryption requested but the "
+                             "scheduler has no certificate authority",
+                })
+            else:
+                hostname = info.labels.get(Label.HOSTNAME, "")
+                for te in tls_specs:
+                    cert, key = ca.issue(
+                        info.name, sans=[info.name, hostname]
+                    )
+                    add_file(f"{te.name}.crt", cert, 0o644)
+                    add_file(f"{te.name}.key", key, 0o600)
+                    add_file(f"{te.name}.ca", ca.ca_cert_pem, 0o644)
+        return files, secret_env
 
     def _templates_for(self, info, task_spec) -> List[dict]:
         """Config templates for the agent to render into the sandbox.

@@ -1,0 +1,27 @@
+"""Security plane: secret materialization + TLS certificate issuance.
+
+Reference: the X2 subsystem (dcos/) — SecretsClient.java fetching from
+the DC/OS secrets service, CertificateAuthorityClient.java signing
+per-task certs consumed by TLSEvaluationStage.java (214 LoC), gated by
+the TLSRequiresServiceAccount validator.  TPU-first shape: secrets
+resolve through a pluggable provider on the scheduler, certs come from
+a CA the scheduler owns, and both land in task sandboxes as 0600 files
+shipped over the launch channel (never via env logging or artifacts
+URLs).
+"""
+
+from dcos_commons_tpu.security.secrets import (
+    FileSecretsProvider,
+    InMemorySecretsProvider,
+    SecretNotFound,
+    SecretsProvider,
+)
+from dcos_commons_tpu.security.tls import CertificateAuthority
+
+__all__ = [
+    "CertificateAuthority",
+    "FileSecretsProvider",
+    "InMemorySecretsProvider",
+    "SecretNotFound",
+    "SecretsProvider",
+]

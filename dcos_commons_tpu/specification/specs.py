@@ -126,6 +126,36 @@ class ReadinessCheckSpec:
 
 
 @dataclass(frozen=True)
+class SecretSpec:
+    """One secret ref (reference: specification/DefaultSecretSpec +
+    RawSecret {secret, env-key, file}).  ``secret`` is the provider
+    path; the value lands as a 0600 sandbox ``file`` and/or an
+    ``env_key`` env var.  With neither, the env key is derived from
+    the ref path (reference behavior for bare refs)."""
+
+    secret: str
+    env_key: str = ""
+    file: str = ""
+
+    def effective_env_key(self) -> str:
+        if self.env_key or self.file:
+            return self.env_key
+        import re as _re
+
+        return _re.sub(r"[^A-Z0-9]", "_", self.secret.upper())
+
+
+@dataclass(frozen=True)
+class TransportEncryptionSpec:
+    """Reference: specification/TransportEncryptionSpec (tls.yml
+    `transport-encryption:` entries).  ``type`` TLS emits
+    <name>.crt/<name>.key/<name>.ca PEMs into the sandbox."""
+
+    name: str
+    type: str = "TLS"
+
+
+@dataclass(frozen=True)
 class TaskSpec:
     """Reference: specification/TaskSpec.java."""
 
@@ -140,6 +170,7 @@ class TaskSpec:
     config_templates: Tuple[Tuple[str, str], ...] = ()   # (template, dest)
     kill_grace_period_s: float = 0.0
     essential: bool = True           # reference: TaskSpec.isEssential
+    transport_encryption: Tuple[TransportEncryptionSpec, ...] = ()
 
     def __post_init__(self) -> None:
         if isinstance(self.goal, str):
@@ -167,6 +198,9 @@ class PodSpec:
     pre_reserved_role: str = ""
     allow_decommission: bool = False
     share_pid_namespace: bool = False
+    # pod-level secret refs applied to every task of the pod
+    # (reference: RawPod secrets block, secrets.yml)
+    secrets: Tuple[SecretSpec, ...] = ()
 
     def task(self, name: str) -> TaskSpec:
         for t in self.tasks:
@@ -259,6 +293,7 @@ def _decode_pod(data: Dict[str, Any]) -> PodSpec:
         pre_reserved_role=data.get("pre_reserved_role", ""),
         allow_decommission=data.get("allow_decommission", False),
         share_pid_namespace=data.get("share_pid_namespace", False),
+        secrets=tuple(SecretSpec(**s) for s in data.get("secrets", [])),
     )
 
 
@@ -293,6 +328,10 @@ def _decode_task(data: Dict[str, Any]) -> TaskSpec:
         ),
         kill_grace_period_s=data.get("kill_grace_period_s", 0.0),
         essential=data.get("essential", True),
+        transport_encryption=tuple(
+            TransportEncryptionSpec(**t)
+            for t in data.get("transport_encryption", [])
+        ),
     )
 
 

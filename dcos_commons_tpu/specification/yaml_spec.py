@@ -27,10 +27,12 @@ from dcos_commons_tpu.specification.specs import (
     ReadinessCheckSpec,
     ReplacementFailurePolicy,
     ResourceSpec,
+    SecretSpec,
     ServiceSpec,
     SpecError,
     TaskSpec,
     TpuSpec,
+    TransportEncryptionSpec,
     VolumeSpec,
 )
 
@@ -192,7 +194,26 @@ def _map_pod(
         pre_reserved_role=str(raw.get("pre-reserved-role", "")),
         allow_decommission=bool(raw.get("allow-decommission", False)),
         share_pid_namespace=bool(raw.get("share-pid-namespace", False)),
+        secrets=_map_secrets(pod_name, raw),
     )
+
+
+def _map_secrets(pod_name: str, raw: Dict[str, Any]):
+    secrets = []
+    for sec_name, sec_raw in (raw.get("secrets") or {}).items():
+        sec_raw = sec_raw or {}
+        source = str(sec_raw.get("secret", ""))
+        if not source:
+            raise SpecError(
+                f"secret {sec_name!r} in pod {pod_name!r} needs a "
+                "'secret' ref"
+            )
+        secrets.append(SecretSpec(
+            secret=source,
+            env_key=str(sec_raw.get("env-key", "")),
+            file=str(sec_raw.get("file", "")),
+        ))
+    return tuple(secrets)
 
 
 def _map_task(
@@ -262,6 +283,13 @@ def _map_task(
         config_templates=tuple(templates),
         kill_grace_period_s=float(raw.get("kill-grace-period", 0)),
         essential=bool(raw.get("essential", True)),
+        transport_encryption=tuple(
+            TransportEncryptionSpec(
+                name=str(t.get("name", task_name)),
+                type=str(t.get("type", "TLS")).upper(),
+            )
+            for t in (raw.get("transport-encryption") or [])
+        ),
     )
 
 

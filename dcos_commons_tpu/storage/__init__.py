@@ -22,6 +22,11 @@ from dcos_commons_tpu.storage.persister import (
 )
 from dcos_commons_tpu.storage.file_persister import FileWalPersister
 from dcos_commons_tpu.storage.cache import PersisterCache
+from dcos_commons_tpu.storage.remote import (
+    RemoteLocker,
+    RemotePersister,
+    StateServer,
+)
 
 __all__ = [
     "DeleteOp",
@@ -29,6 +34,9 @@ __all__ = [
     "MemPersister",
     "Persister",
     "PersisterCache",
+    "RemoteLocker",
+    "RemotePersister",
+    "StateServer",
     "PersisterError",
     "SetOp",
     "StorageError",

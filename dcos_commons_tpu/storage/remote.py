@@ -1,0 +1,361 @@
+"""Networked persistence: a state server + remote Persister client.
+
+Reference: curator/CuratorPersister.java:43-110 — the reference keeps
+ALL scheduler state in ZooKeeper with atomic multi-op transactions so
+a scheduler process is disposable: kill it anywhere, restart it
+anywhere, and plans resume mid-step.  CuratorLocker (taken in
+SchedulerRunner.run) guarantees one active scheduler per service.
+
+This module is that pair for the TPU fleet, ZooKeeper replaced by a
+small HTTP state server (one per cluster / control-plane host):
+
+* ``StateServer`` — hierarchical KV over any local Persister
+  (FileWalPersister for durability), every mutation under one lock so
+  ``apply`` batches stay atomic, plus TTL leases for the scheduler
+  instance lock.
+* ``RemotePersister`` — the Persister contract over HTTP; network or
+  server failures surface as PersisterError, which fails the scheduler
+  cycle and (after the crash-to-restart threshold) the process —
+  exactly how the reference treats a ZK outage.
+* ``RemoteLocker`` — acquire/renew/release of a named TTL lease; the
+  renewal thread keeps the lease while the process lives, and a dead
+  scheduler's lease expires so a standby can take over (failover).
+
+Protocol (JSON over HTTP):
+
+    POST /v1/kv/get       {path}                -> {found, value?}
+    POST /v1/kv/set       {path, value}
+    POST /v1/kv/children  {path}                -> {found, children}
+    POST /v1/kv/delete    {path}                -> {found}
+    POST /v1/kv/apply     {ops: [{op, path, value?}]}   (atomic)
+    POST /v1/lock/acquire {name, owner, ttl_s}  -> {acquired, owner}
+    POST /v1/lock/release {name, owner}         -> {released}
+
+Values travel base64-encoded.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from dcos_commons_tpu.storage.persister import (
+    DeleteOp,
+    MemPersister,
+    Persister,
+    PersisterError,
+    SetOp,
+    TransactionOp,
+)
+
+
+class StateServer:
+    """HTTP front end over one local Persister (the cluster's ZK)."""
+
+    def __init__(
+        self,
+        backend: Optional[Persister] = None,
+        port: int = 0,
+        bind: str = "127.0.0.1",
+    ):
+        self._backend = backend or MemPersister()
+        self._lock = threading.RLock()
+        # lease name -> (owner, expiry monotonic deadline)
+        self._leases: Dict[str, Tuple[str, float]] = {}
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def _reply(self, code: int, body: dict) -> None:
+                payload = json.dumps(body).encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                    self._reply(200, server.handle(self.path, body))
+                except PersisterError as e:
+                    self._reply(409, {"error": str(e), "path": e.path})
+                except Exception as e:
+                    self._reply(500, {"error": repr(e)})
+
+        self._server = ThreadingHTTPServer((bind, port), Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    # -- request handling ---------------------------------------------
+
+    def handle(self, route: str, body: dict) -> dict:
+        with self._lock:
+            if route == "/v1/kv/get":
+                value = None
+                try:
+                    value = self._backend.get(body["path"])
+                    found = True
+                except PersisterError:
+                    found = False
+                return {
+                    "found": found,
+                    "value": base64.b64encode(value).decode()
+                    if value is not None else None,
+                }
+            if route == "/v1/kv/set":
+                self._backend.set(
+                    body["path"], base64.b64decode(body["value"] or "")
+                )
+                return {"ok": True}
+            if route == "/v1/kv/children":
+                try:
+                    return {
+                        "found": True,
+                        "children": self._backend.get_children(body["path"]),
+                    }
+                except PersisterError:
+                    return {"found": False, "children": []}
+            if route == "/v1/kv/delete":
+                try:
+                    self._backend.recursive_delete(body["path"])
+                    return {"found": True}
+                except PersisterError:
+                    return {"found": False}
+            if route == "/v1/kv/apply":
+                ops: List[TransactionOp] = []
+                for raw in body.get("ops", []):
+                    if raw["op"] == "set":
+                        ops.append(SetOp(
+                            raw["path"],
+                            base64.b64decode(raw.get("value") or ""),
+                        ))
+                    elif raw["op"] == "delete":
+                        ops.append(DeleteOp(raw["path"]))
+                    else:
+                        raise PersisterError(f"unknown op {raw['op']!r}")
+                self._backend.apply(ops)
+                return {"ok": True, "applied": len(ops)}
+            if route == "/v1/lock/acquire":
+                return self._acquire(
+                    body["name"], body["owner"],
+                    float(body.get("ttl_s", 15.0)),
+                )
+            if route == "/v1/lock/release":
+                return self._release(body["name"], body["owner"])
+            raise PersisterError(f"no route {route}")
+
+    def _acquire(self, name: str, owner: str, ttl_s: float) -> dict:
+        now = time.monotonic()
+        held = self._leases.get(name)
+        if held is not None and held[1] > now and held[0] != owner:
+            return {
+                "acquired": False,
+                "owner": held[0],
+                "expires_in": round(held[1] - now, 1),
+            }
+        # fresh acquire or renewal by the current owner
+        self._leases[name] = (owner, now + ttl_s)
+        return {"acquired": True, "owner": owner}
+
+    def _release(self, name: str, owner: str) -> dict:
+        held = self._leases.get(name)
+        if held is not None and held[0] == owner:
+            del self._leases[name]
+            return {"released": True}
+        return {"released": False}
+
+    # -- lifecycle ----------------------------------------------------
+
+    @property
+    def url(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "StateServer":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="state-server", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def serve_forever(self) -> None:
+        self._server.serve_forever()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        self._backend.close()
+
+
+class RemotePersister(Persister):
+    """Persister over a StateServer.  Failures raise PersisterError —
+    the scheduler treats a dead state server like the reference treats
+    a ZK outage: fail the cycle, crash to restart."""
+
+    def __init__(self, base_url: str, timeout_s: float = 10.0):
+        self._base = base_url.rstrip("/")
+        self._timeout_s = timeout_s
+
+    def _call(self, route: str, body: dict) -> dict:
+        data = json.dumps(body).encode("utf-8")
+        req = urllib.request.Request(
+            f"{self._base}{route}", data=data,
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self._timeout_s) as resp:
+                return json.loads(resp.read().decode("utf-8"))
+        except urllib.error.HTTPError as e:
+            try:
+                detail = json.loads(e.read().decode("utf-8"))
+            except Exception:
+                detail = {"error": str(e)}
+            raise PersisterError(
+                detail.get("error", str(e)), detail.get("path", "")
+            )
+        except (urllib.error.URLError, OSError) as e:
+            raise PersisterError(f"state server unreachable: {e}")
+
+    def get(self, path: str) -> Optional[bytes]:
+        out = self._call("/v1/kv/get", {"path": path})
+        if not out["found"]:
+            raise PersisterError(f"path not found: {path}", path)
+        value = out.get("value")
+        return base64.b64decode(value) if value is not None else None
+
+    def set(self, path: str, value: bytes) -> None:
+        self._call(
+            "/v1/kv/set",
+            {"path": path, "value": base64.b64encode(value).decode()},
+        )
+
+    def get_children(self, path: str) -> List[str]:
+        out = self._call("/v1/kv/children", {"path": path})
+        if not out["found"]:
+            raise PersisterError(f"path not found: {path}", path)
+        return out["children"]
+
+    def recursive_delete(self, path: str) -> None:
+        if not self._call("/v1/kv/delete", {"path": path})["found"]:
+            raise PersisterError(f"path not found: {path}", path)
+
+    def apply(self, ops: Iterable[TransactionOp]) -> None:
+        payload = []
+        for op in ops:
+            if isinstance(op, SetOp):
+                payload.append({
+                    "op": "set", "path": op.path,
+                    "value": base64.b64encode(op.value).decode(),
+                })
+            else:
+                payload.append({"op": "delete", "path": op.path})
+        self._call("/v1/kv/apply", {"ops": payload})
+
+
+class RemoteLocker:
+    """Named TTL lease on the state server: the CuratorLocker analogue.
+
+    ``acquire`` takes (or renews) the lease and starts a renewal thread
+    at a third of the TTL; if the holder dies, the lease expires and a
+    standby scheduler's next acquire succeeds — real failover, not a
+    per-host file lock.
+    """
+
+    def __init__(
+        self,
+        base_url: str,
+        name: str,
+        owner: str,
+        ttl_s: float = 15.0,
+        timeout_s: float = 5.0,
+    ):
+        self._persister = RemotePersister(base_url, timeout_s)
+        self.name = name
+        self.owner = owner
+        self.ttl_s = ttl_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _acquire_once(self) -> bool:
+        out = self._persister._call(
+            "/v1/lock/acquire",
+            {"name": self.name, "owner": self.owner, "ttl_s": self.ttl_s},
+        )
+        return bool(out.get("acquired"))
+
+    def acquire(self) -> bool:
+        try:
+            if not self._acquire_once():
+                return False
+        except PersisterError:
+            return False
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._renew_loop, name=f"lease-{self.name}", daemon=True
+        )
+        self._thread.start()
+        return True
+
+    def _renew_loop(self) -> None:
+        while not self._stop.wait(self.ttl_s / 3.0):
+            try:
+                self._acquire_once()
+            except PersisterError:
+                pass  # server hiccup: the lease may lapse; the next
+                # renewal re-takes it if nobody else has
+
+    def release(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self.ttl_s)
+        try:
+            self._persister._call(
+                "/v1/lock/release", {"name": self.name, "owner": self.owner}
+            )
+        except PersisterError:
+            pass  # lease will expire on its own
+
+
+def main(argv: Optional[list] = None) -> int:
+    """``python -m dcos_commons_tpu state-server`` — run the cluster
+    state server over a durable file WAL."""
+    import argparse
+
+    from dcos_commons_tpu.storage.file_persister import FileWalPersister
+
+    parser = argparse.ArgumentParser(prog="dcos_commons_tpu state-server")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--bind", default="127.0.0.1")
+    parser.add_argument("--data-dir", default="./state-server")
+    parser.add_argument(
+        "--announce-file", default="",
+        help="write the URL here once listening (ephemeral ports)",
+    )
+    args = parser.parse_args(argv)
+    server = StateServer(
+        FileWalPersister(args.data_dir), port=args.port, bind=args.bind
+    )
+    if args.announce_file:
+        from dcos_commons_tpu.common import atomic_write_text
+
+        atomic_write_text(args.announce_file, server.url + "\n")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -27,6 +27,7 @@ class FakeAgent:
         # last grace period passed to kill() per task id
         self.kill_graces: Dict[str, float] = {}
         self.checks: Dict[str, Dict[str, object]] = {}
+        self.payloads: Dict[str, Dict[str, object]] = {}
         self._active: Dict[str, TaskInfo] = {}
         self._queue: List[TaskStatus] = []
         self._acked_kills: Set[str] = set()
@@ -39,7 +40,7 @@ class FakeAgent:
             self.launch_one(info)
 
     def launch_one(self, info: TaskInfo, readiness=None, health=None,
-                   templates=None) -> None:
+                   templates=None, files=None, secret_env=None) -> None:
         with self._lock:
             if info.task_id in self._active:
                 return  # idempotent, like the real agent
@@ -48,6 +49,12 @@ class FakeAgent:
             self.checks[info.task_id] = {
                 "readiness": readiness,
                 "health": health,
+            }
+            # recorded for Expect assertions (secret files, TLS PEMs)
+            self.payloads[info.task_id] = {
+                "templates": templates or [],
+                "files": files or [],
+                "secret_env": dict(secret_env or {}),
             }
 
     def kill(self, task_id: str, grace_period_s: float = 0.0) -> None:

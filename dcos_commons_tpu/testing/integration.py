@@ -207,6 +207,7 @@ class SchedulerProcess:
         env: Optional[Dict[str, str]] = None,
         repo_root: str = "",
         wait_listening: bool = True,
+        extra_args: Optional[List[str]] = None,
     ):
         self.workdir = workdir
         announce = os.path.join(workdir, "announce")
@@ -225,6 +226,7 @@ class SchedulerProcess:
                 "--state-dir", os.path.join(workdir, "state"),
                 "--sandbox-root", os.path.join(workdir, "sandboxes"),
                 "--announce-file", announce,
+                *(extra_args or []),
             ],
             cwd=repo_root or None,
             env=run_env,

@@ -94,3 +94,36 @@ def test_jax_svc_collectives_sidecar_plan():
     # the workers kept running through the bench
     for i in range(4):
         assert len(runner.world.agent.launches_of(f"trainer-{i}-worker")) == 1
+
+
+def test_gang_sidecar_group_gets_own_rendezvous():
+    """The collectives sidecar on a gang pod rendezvous like the main
+    gang: every bench task carries the SAME coordinator address (a
+    fresh port, not the trainer's) and its own worker id — without
+    this, each bench task measures a single chip instead of the slice.
+    """
+    with open(JAX_SVC) as f:
+        yaml_text = f.read()
+    hosts = make_test_fleet(host_grid=(2, 2), chip_block=(2, 2))
+    runner = ServiceTestRunner(yaml_text, hosts=hosts)
+    runner.run([AdvanceCycles(1)])
+    for i in range(4):
+        runner.run([SendTaskRunning(f"trainer-{i}-worker")])
+    runner.run([
+        ExpectDeploymentComplete(),
+        PlanStart("collectives"),
+        AdvanceCycles(1),
+    ])
+    agent = runner.world.agent
+    coords, worker_ids = set(), set()
+    trainer_coord = agent.task_info_of("trainer-0-worker").env[
+        "COORDINATOR_ADDRESS"
+    ]
+    for i in range(4):
+        info = agent.task_info_of(f"trainer-{i}-collective-bench")
+        assert info is not None, f"bench task {i} not launched"
+        coords.add(info.env.get("COORDINATOR_ADDRESS"))
+        worker_ids.add(info.env.get("TPU_WORKER_ID"))
+    assert len(coords) == 1 and None not in coords
+    assert coords != {trainer_coord}, "bench group must not reuse the trainer port"
+    assert worker_ids == {"0", "1", "2", "3"}

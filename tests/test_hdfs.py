@@ -196,3 +196,50 @@ def test_hdfs_jax_coschedule_shared_inventory():
             by_host_chips[key] = r.task_name
     # hdfs placed all 8 tasks, jax placed 4 gang workers
     assert len(agent.launched) >= 12
+
+
+def test_name_volume_shared_between_sibling_tasks(tmp_path):
+    """Real-agent proof of the shared per-instance volume: format
+    writes name-data/fsimage, and the node task (whose command FAILS
+    unless the file exists) reads it from the SAME durable directory.
+    """
+    import time
+
+    from dcos_commons_tpu.agent.local import LocalProcessAgent
+    from dcos_commons_tpu.offer.inventory import SliceInventory, TpuHost
+    from dcos_commons_tpu.scheduler import SchedulerBuilder, SchedulerConfig
+    from dcos_commons_tpu.storage import MemPersister
+
+    spec = from_yaml(load_svc(), env={
+        "SLEEP_DURATION": "600",
+        "JOURNAL_COUNT": "1",
+        "DATA_COUNT": "1",
+    })
+    builder = SchedulerBuilder(
+        spec,
+        SchedulerConfig(
+            sandbox_root=str(tmp_path / "sbx"),
+            backoff_enabled=False,
+            revive_capacity=1_000_000,
+        ),
+        MemPersister(),
+    )
+    hosts = [TpuHost(host_id=f"h{i}", cpus=8.0, memory_mb=8192)
+             for i in range(3)]
+    builder.set_inventory(SliceInventory(hosts))
+    agent = LocalProcessAgent(str(tmp_path / "sbx"))
+    builder.set_agent(agent)
+    scheduler = builder.build()
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        scheduler.run_cycle()
+        if scheduler.deploy_manager.get_plan().is_complete:
+            break
+        time.sleep(0.05)
+    assert scheduler.deploy_manager.get_plan().is_complete
+    # both sibling sandboxes resolve name-data to ONE durable dir
+    fmt = os.path.realpath(str(tmp_path / "sbx/name-0-format/name-data"))
+    node = os.path.realpath(str(tmp_path / "sbx/name-0-node/name-data"))
+    assert fmt == node
+    assert (tmp_path / "sbx/name-0-node/name-data/fsimage").exists()
+    agent.shutdown()

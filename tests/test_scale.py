@@ -1,0 +1,150 @@
+"""Scale test: many services through the multi scheduler at once.
+
+Reference: frameworks/helloworld/tests/scale/test_scale.py deploys N
+service instances concurrently and watches them all complete; this is
+the sim-speed analogue over a shared fleet, asserting completion,
+isolation (every service's tasks land and no reservation collides)
+and that the control plane's per-cycle cost stays sane as N grows.
+"""
+
+import time
+
+from dcos_commons_tpu.common import TaskState, TaskStatus
+from dcos_commons_tpu.multi import MultiServiceScheduler
+from dcos_commons_tpu.offer.inventory import SliceInventory, TpuHost
+from dcos_commons_tpu.scheduler import SchedulerConfig
+from dcos_commons_tpu.specification.yaml_spec import from_yaml
+from dcos_commons_tpu.storage import MemPersister
+from dcos_commons_tpu.testing import FakeAgent
+
+N_SERVICES = 24
+PODS_PER_SERVICE = 2
+
+
+def service_yaml(i: int) -> str:
+    return f"""
+name: svc-{i:03d}
+pods:
+  app:
+    count: {PODS_PER_SERVICE}
+    tasks:
+      main:
+        goal: RUNNING
+        cmd: "serve-{i:03d}"
+        cpus: 0.5
+        memory: 256
+"""
+
+
+def ack_all_running(multi, agent):
+    for info in agent.launched:
+        if info.task_id in agent.active_task_ids():
+            agent.send(TaskStatus(
+                task_id=info.task_id, state=TaskState.RUNNING, ready=True
+            ))
+
+
+def test_scale_many_services_on_shared_fleet():
+    hosts = [
+        TpuHost(host_id=f"h{i:02d}", cpus=16.0, memory_mb=32768)
+        for i in range(8)
+    ]
+    agent = FakeAgent()
+    multi = MultiServiceScheduler(
+        persister=MemPersister(),
+        inventory=SliceInventory(hosts),
+        agent=agent,
+        scheduler_config=SchedulerConfig(
+            backoff_enabled=False, revive_capacity=1_000_000
+        ),
+    )
+    t0 = time.monotonic()
+    for i in range(N_SERVICES):
+        multi.add_service(from_yaml(service_yaml(i)))
+
+    deadline = time.monotonic() + 60
+    cycles = 0
+    while time.monotonic() < deadline:
+        multi.run_cycle()
+        cycles += 1
+        ack_all_running(multi, agent)
+        if all(
+            multi.get_service(f"svc-{i:03d}").deploy_manager.get_plan()
+            .is_complete
+            for i in range(N_SERVICES)
+        ):
+            break
+    elapsed = time.monotonic() - t0
+
+    for i in range(N_SERVICES):
+        svc = multi.get_service(f"svc-{i:03d}")
+        assert svc.deploy_manager.get_plan().is_complete, f"svc-{i:03d}"
+        for p in range(PODS_PER_SERVICE):
+            info = svc.state_store.fetch_task(f"app-{p}-main")
+            assert info is not None
+            assert f"serve-{i:03d}" in info.command
+    # every launch is alive exactly once: no cross-service task kills
+    assert len(agent.launched) == N_SERVICES * PODS_PER_SERVICE
+    assert agent.kills == []
+    # fleet-level accounting: total cpu claims fit the fleet
+    total_cpus = sum(
+        r.cpus
+        for i in range(N_SERVICES)
+        for r in multi.get_service(f"svc-{i:03d}").ledger.all()
+    )
+    assert total_cpus <= sum(h.cpus for h in hosts)
+    assert elapsed < 60, f"scale deploy too slow: {elapsed:.1f}s"
+
+
+def test_scale_uninstall_one_leaves_rest_running():
+    """Scaled-down isolation check under load: removing one service
+    kills only its own tasks (the ADVICE.md multi-kill regression at
+    fleet scale)."""
+    hosts = [
+        TpuHost(host_id=f"h{i:02d}", cpus=16.0, memory_mb=32768)
+        for i in range(4)
+    ]
+    agent = FakeAgent()
+    multi = MultiServiceScheduler(
+        persister=MemPersister(),
+        inventory=SliceInventory(hosts),
+        agent=agent,
+        scheduler_config=SchedulerConfig(
+            backoff_enabled=False, revive_capacity=1_000_000
+        ),
+    )
+    n = 6
+    for i in range(n):
+        multi.add_service(from_yaml(service_yaml(i)))
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        multi.run_cycle()
+        ack_all_running(multi, agent)
+        if all(
+            multi.get_service(f"svc-{i:03d}").deploy_manager.get_plan()
+            .is_complete
+            for i in range(n)
+        ):
+            break
+    victim_tasks = {
+        multi.get_service("svc-000").state_store.fetch_task(
+            f"app-{p}-main"
+        ).task_id
+        for p in range(PODS_PER_SERVICE)
+    }
+    multi.uninstall_service("svc-000")
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        multi.run_cycle()
+        if multi.get_service("svc-000") is None:
+            break
+    killed = set(agent.kills)
+    assert victim_tasks <= killed
+    survivor_ids = {
+        multi.get_service(f"svc-{i:03d}").state_store.fetch_task(
+            f"app-{p}-main"
+        ).task_id
+        for i in range(1, n)
+        for p in range(PODS_PER_SERVICE)
+    }
+    assert not (survivor_ids & killed)

@@ -1,0 +1,229 @@
+"""Security plane: secrets materialization + TLS issuance (X2).
+
+Reference: dcos/clients/SecretsClient.java + CertificateAuthority
+Client.java + offer/evaluate/TLSEvaluationStage.java + the
+TLSRequiresServiceAccount gating validator.
+"""
+
+import base64
+import os
+import stat
+import time
+
+import pytest
+
+from dcos_commons_tpu.security import (
+    CertificateAuthority,
+    FileSecretsProvider,
+    InMemorySecretsProvider,
+    SecretNotFound,
+)
+from dcos_commons_tpu.specification.validation import ConfigValidationError
+from dcos_commons_tpu.storage import MemPersister
+from dcos_commons_tpu.testing import (
+    AdvanceCycles,
+    ExpectDeploymentComplete,
+    ExpectLaunchedTasks,
+    SendTaskRunning,
+    ServiceTestRunner,
+)
+
+HELLOWORLD = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "frameworks", "helloworld",
+)
+
+
+def load(name):
+    with open(os.path.join(HELLOWORLD, name)) as f:
+        return f.read()
+
+
+# -- providers --------------------------------------------------------
+
+
+def test_file_secrets_provider_reads_tree(tmp_path):
+    (tmp_path / "app").mkdir()
+    (tmp_path / "app" / "password").write_bytes(b"hunter2")
+    provider = FileSecretsProvider(str(tmp_path))
+    assert provider.fetch("app/password") == b"hunter2"
+    with pytest.raises(SecretNotFound):
+        provider.fetch("app/missing")
+
+
+def test_file_secrets_provider_rejects_traversal(tmp_path):
+    (tmp_path / "safe").mkdir()
+    provider = FileSecretsProvider(str(tmp_path / "safe"))
+    (tmp_path / "outside").write_bytes(b"leak")
+    with pytest.raises(SecretNotFound):
+        provider.fetch("../outside")
+
+
+# -- certificate authority -------------------------------------------
+
+
+def test_ca_issues_verifiable_certs():
+    from cryptography import x509
+
+    ca = CertificateAuthority.create()
+    cert_pem, key_pem = ca.issue("web-0-server", sans=["web-0-server", "h0"])
+    cert = x509.load_pem_x509_certificate(cert_pem)
+    ca_cert = x509.load_pem_x509_certificate(ca.ca_cert_pem)
+    # signature chains to the CA
+    cert.verify_directly_issued_by(ca_cert)
+    sans = cert.extensions.get_extension_for_class(
+        x509.SubjectAlternativeName
+    ).value.get_values_for_type(x509.DNSName)
+    assert set(sans) == {"web-0-server", "h0"}
+    assert b"PRIVATE KEY" in key_pem
+
+
+def test_ca_persists_root_across_restarts():
+    persister = MemPersister()
+    first = CertificateAuthority.load_or_create(persister)
+    second = CertificateAuthority.load_or_create(persister)
+    assert first.ca_cert_pem == second.ca_cert_pem
+
+
+# -- launch-channel materialization (sim) ----------------------------
+
+
+def secrets_runner(values):
+    provider = InMemorySecretsProvider(values)
+    return ServiceTestRunner(
+        load("secrets.yml"),
+        builder_hook=lambda b: b.set_secrets_provider(provider),
+    )
+
+
+def test_secrets_ride_launch_channel_not_state(tmp_path):
+    runner = secrets_runner({
+        "hello-world/secret1": b"v-one",
+        "hello-world/secret2": b"v-two",
+    })
+    runner.run([
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("hello-0-server"),
+        SendTaskRunning("hello-0-server"),
+        ExpectDeploymentComplete(),
+    ])
+    agent = runner.world.agent
+    task_id = agent.task_id_of("hello-0-server")
+    payload = agent.payloads[task_id]
+    by_dest = {f["dest"]: f for f in payload["files"]}
+    assert base64.b64decode(
+        by_dest["HELLO_SECRET1_FILE"]["content"]
+    ) == b"v-one"
+    assert base64.b64decode(
+        by_dest["HELLO_SECRET2_FILE"]["content"]
+    ) == b"v-two"
+    assert by_dest["HELLO_SECRET1_FILE"]["mode"] == 0o600
+    assert payload["secret_env"]["HELLO_SECRET1_ENV"] == "v-one"
+    # the secret value never reaches the persisted TaskInfo
+    stored = runner.world.state_store.fetch_task("hello-0-server")
+    assert "v-one" not in str(stored.to_dict())
+    assert "HELLO_SECRET1_ENV" not in stored.env
+
+
+def test_missing_secret_fails_launch_payload():
+    runner = secrets_runner({"hello-world/secret1": b"only-one"})
+    runner.run([AdvanceCycles(1)])
+    agent = runner.world.agent
+    payload = agent.payloads[agent.task_id_of("hello-0-server")]
+    errors = [f for f in payload["files"] if "error" in f]
+    assert errors and "hello-world/secret2" in errors[0]["error"]
+
+
+def test_secrets_without_provider_refuse_to_build():
+    """The TLSRequiresServiceAccount gating pattern: a spec that
+    references secrets with no provider wired is a configuration
+    error, not an eventual launch failure."""
+    with pytest.raises(ConfigValidationError):
+        ServiceTestRunner(load("secrets.yml")).build()
+
+
+def test_tls_artifacts_in_payload():
+    runner = ServiceTestRunner(load("tls.yml"))
+    runner.run([
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("secure-0-node"),
+    ])
+    agent = runner.world.agent
+    payload = agent.payloads[agent.task_id_of("secure-0-node")]
+    by_dest = {f["dest"]: f for f in payload["files"]}
+    assert set(by_dest) == {
+        "secure-tls-pod.crt", "secure-tls-pod.key", "secure-tls-pod.ca"
+    }
+    assert by_dest["secure-tls-pod.key"]["mode"] == 0o600
+
+    from cryptography import x509
+
+    cert = x509.load_pem_x509_certificate(
+        base64.b64decode(by_dest["secure-tls-pod.crt"]["content"])
+    )
+    ca_cert = x509.load_pem_x509_certificate(
+        base64.b64decode(by_dest["secure-tls-pod.ca"]["content"])
+    )
+    cert.verify_directly_issued_by(ca_cert)
+
+
+# -- real agent e2e ---------------------------------------------------
+
+
+def test_secret_and_tls_files_land_in_real_sandbox(tmp_path):
+    """LocalProcessAgent writes 0600 secret files + TLS PEMs into the
+    sandbox and the process sees the secret env var."""
+    from dcos_commons_tpu.agent.local import LocalProcessAgent
+    from dcos_commons_tpu.common import TaskInfo
+
+    agent = LocalProcessAgent(str(tmp_path / "sbx"))
+    ca = CertificateAuthority.create()
+    cert, key = ca.issue("app-0-main", sans=["app-0-main"])
+    info = TaskInfo(
+        name="app-0-main",
+        task_id="app-0-main__1",
+        agent_id="h0",
+        command="echo -n $TOKEN > token-out.txt",
+    )
+    agent.launch_one(
+        info,
+        files=[
+            {"dest": "creds/password", "mode": 0o600,
+             "content": base64.b64encode(b"hunter2").decode()},
+            {"dest": "tls.crt", "mode": 0o644,
+             "content": base64.b64encode(cert).decode()},
+            {"dest": "tls.key", "mode": 0o600,
+             "content": base64.b64encode(key).decode()},
+        ],
+        secret_env={"TOKEN": "tok-123"},
+    )
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if any(s.state.value == "TASK_FINISHED" for s in agent.poll()):
+            break
+        time.sleep(0.05)
+    sandbox = tmp_path / "sbx" / "app-0-main"
+    assert (sandbox / "creds" / "password").read_bytes() == b"hunter2"
+    mode = stat.S_IMODE(os.stat(sandbox / "creds" / "password").st_mode)
+    assert mode == 0o600
+    assert stat.S_IMODE(os.stat(sandbox / "tls.key").st_mode) == 0o600
+    assert (sandbox / "token-out.txt").read_text() == "tok-123"
+    agent.shutdown()
+
+
+def test_secure_file_escape_rejected(tmp_path):
+    from dcos_commons_tpu.agent.local import LocalProcessAgent
+    from dcos_commons_tpu.common import TaskInfo, TaskState
+
+    agent = LocalProcessAgent(str(tmp_path / "sbx"))
+    agent.launch_one(
+        TaskInfo(name="bad-0-task", task_id="bad-0-task__1", command="true"),
+        files=[{
+            "dest": "../../etc/stolen",
+            "content": base64.b64encode(b"x").decode(),
+        }],
+    )
+    statuses = agent.poll()
+    assert any(s.state is TaskState.ERROR for s in statuses)
+    assert not (tmp_path / "etc").exists()
+    agent.shutdown()

@@ -181,3 +181,72 @@ def test_load_topology_rejects_mixed_mode(tmp_path):
     )
     with pytest.raises(ValueError, match="no agent_url"):
         load_topology(str(path))
+
+
+def test_scheduler_failover_over_state_server(cluster, tmp_path):
+    """Real failover: state lives on a state-server process; scheduler
+    A deploys, then dies without cleanup; standby B is locked out
+    until A's lease expires, then takes over and RESUMES the deployed
+    service without relaunching tasks (reference: CuratorPersister +
+    CuratorLocker over ZK)."""
+    state = subprocess.Popen(
+        [
+            sys.executable, "-m", "dcos_commons_tpu", "state-server",
+            "--data-dir", str(tmp_path / "cluster-state"),
+            "--announce-file", str(tmp_path / "state-announce"),
+        ],
+        cwd=REPO,
+    )
+    try:
+        state_url = wait_for(
+            lambda: (
+                open(tmp_path / "state-announce").read().strip()
+                if os.path.exists(tmp_path / "state-announce") else None
+            ),
+            20.0,
+            what="state server announce",
+        )
+        extra = ["--state-url", state_url]
+        env = {"STATE_LEASE_TTL_S": "2"}
+        sched_a = SchedulerProcess(
+            cluster["svc"], cluster["topology"], str(tmp_path / "sched-a"),
+            env=env, repo_root=REPO, extra_args=extra,
+        )
+        client = sched_a.client()
+        client.wait_for_completed_deployment(timeout_s=60)
+        before = client.task_ids()
+        assert set(before) == {"app-0-server", "app-1-server"}
+
+        # standby is locked out while A holds the lease
+        locked = subprocess.run(
+            [
+                sys.executable, "-m", "dcos_commons_tpu", "serve",
+                cluster["svc"],
+                "--topology", cluster["topology"],
+                "--port", "0",
+                "--state-dir", str(tmp_path / "sched-b1-state"),
+                "--sandbox-root", str(tmp_path / "sched-b1-sandboxes"),
+                *extra,
+            ],
+            cwd=REPO,
+            env={**os.environ, **env},
+            capture_output=True,
+            timeout=60,
+        )
+        assert locked.returncode == EXIT_LOCKED, locked.stderr.decode()
+
+        # A dies hard; after lease expiry B takes over and resumes
+        sched_a.process.kill()
+        sched_a.process.wait(timeout=10)
+        time.sleep(3.0)  # > lease ttl
+        sched_b = SchedulerProcess(
+            cluster["svc"], cluster["topology"], str(tmp_path / "sched-b2"),
+            env=env, repo_root=REPO, extra_args=extra,
+        )
+        client_b = sched_b.client()
+        client_b.wait_for_completed_deployment(timeout_s=60)
+        client_b.check_tasks_not_updated(before)
+        sched_b.terminate()
+    finally:
+        state.terminate()
+        state.wait(timeout=10)

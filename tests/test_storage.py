@@ -124,3 +124,144 @@ def test_persister_cache_write_through(tmp_path):
     cache2 = PersisterCache(backend2)
     assert cache2.get("/a") == b"1"
     cache2.close()
+
+
+# -- remote persister (reference: CuratorPersister over ZK) -----------
+
+
+@pytest.fixture
+def state_server():
+    from dcos_commons_tpu.storage import StateServer
+
+    server = StateServer().start()
+    yield server
+    server.stop()
+
+
+def test_remote_persister_contract(state_server):
+    from dcos_commons_tpu.storage import RemotePersister
+
+    exercise_basic(RemotePersister(state_server.url))
+
+
+def test_remote_persister_binary_values_roundtrip(state_server):
+    from dcos_commons_tpu.storage import RemotePersister
+
+    p = RemotePersister(state_server.url)
+    blob = bytes(range(256)) * 3
+    p.set("/bin", blob)
+    assert p.get("/bin") == blob
+
+
+def test_remote_persister_atomic_apply(state_server):
+    from dcos_commons_tpu.storage import RemotePersister
+
+    p = RemotePersister(state_server.url)
+    p.set("/t/a", b"1")
+    # delete of a missing path fails the WHOLE transaction: /t/a keeps
+    # its value, /t/b is never created
+    with pytest.raises(PersisterError):
+        p.apply([
+            SetOp("/t/b", b"2"),
+            DeleteOp("/missing"),
+        ])
+    assert p.get("/t/a") == b"1"
+    assert p.get_or_none("/t/b") is None
+    p.apply([SetOp("/t/b", b"2"), DeleteOp("/t/a")])
+    assert p.get("/t/b") == b"2"
+    assert not p.exists("/t/a")
+
+
+def test_remote_persister_unreachable_raises():
+    from dcos_commons_tpu.storage import RemotePersister
+
+    p = RemotePersister("http://127.0.0.1:1", timeout_s=0.5)
+    with pytest.raises(PersisterError):
+        p.get("/anything")
+
+
+def test_remote_persister_behind_cache(state_server):
+    from dcos_commons_tpu.storage import PersisterCache, RemotePersister
+
+    backend = RemotePersister(state_server.url)
+    backend.set("/warm/x", b"pre-existing")
+    cache = PersisterCache(backend)
+    assert cache.get("/warm/x") == b"pre-existing"
+    cache.set("/warm/y", b"through")
+    # write-through: a second uncached client sees it
+    assert RemotePersister(state_server.url).get("/warm/y") == b"through"
+
+
+def test_remote_lease_contention_and_expiry(state_server):
+    import time
+
+    from dcos_commons_tpu.storage import RemoteLocker
+
+    a = RemoteLocker(state_server.url, "svc", "owner-a", ttl_s=0.6)
+    b = RemoteLocker(state_server.url, "svc", "owner-b", ttl_s=0.6)
+    assert a.acquire()
+    assert not b.acquire()  # held by a
+    # a renews faster than expiry: b still locked out after a ttl
+    time.sleep(0.9)
+    assert not b.acquire()
+    # a dies (stop renewing, no release): lease expires, b takes over
+    a._stop.set()
+    a._thread.join(timeout=2)
+    time.sleep(0.9)
+    assert b.acquire()
+    b.release()
+
+
+def test_remote_lease_release_frees_immediately(state_server):
+    from dcos_commons_tpu.storage import RemoteLocker
+
+    a = RemoteLocker(state_server.url, "svc2", "owner-a", ttl_s=30.0)
+    b = RemoteLocker(state_server.url, "svc2", "owner-b", ttl_s=30.0)
+    assert a.acquire()
+    a.release()
+    assert b.acquire()
+    b.release()
+
+
+def test_scheduler_resumes_over_remote_state(state_server):
+    """The failover story at sim level: scheduler 1 deploys over the
+    remote persister; a fresh scheduler built over the SAME remote
+    state resumes without relaunching (reference: scheduler restart
+    over ZK, SchedulerRestartServiceTest)."""
+    from dcos_commons_tpu.storage import RemotePersister
+    from dcos_commons_tpu.testing import (
+        AdvanceCycles,
+        ExpectDeploymentComplete,
+        ExpectLaunchedTasks,
+        ExpectNoLaunches,
+        SendTaskRunning,
+        ServiceTestRunner,
+    )
+
+    yaml_text = """
+name: remote-svc
+pods:
+  app:
+    count: 1
+    tasks:
+      main:
+        goal: RUNNING
+        cmd: "sleep 1000"
+        cpus: 0.1
+        memory: 32
+"""
+    runner = ServiceTestRunner(
+        yaml_text, persister=RemotePersister(state_server.url)
+    )
+    runner.run([
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("app-0-main"),
+        SendTaskRunning("app-0-main"),
+        ExpectDeploymentComplete(),
+    ])
+    restarted = runner.restart()
+    restarted.run([
+        AdvanceCycles(2),
+        ExpectNoLaunches(),
+        ExpectDeploymentComplete(),
+    ])
