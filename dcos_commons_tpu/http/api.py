@@ -16,6 +16,10 @@ from dcos_commons_tpu.common import Label
 from dcos_commons_tpu.debug.trackers import serialize_plan
 from dcos_commons_tpu.plan.status import Status
 from dcos_commons_tpu.specification.specs import task_full_name
+from dcos_commons_tpu.state.state_store import (
+    GoalStateOverride,
+    OverrideProgress,
+)
 
 Response = Tuple[int, Any]
 
@@ -142,11 +146,24 @@ class SchedulerApi:
                     full = task_full_name(pod.type, i, task_spec.name)
                     status = statuses.get(full)
                     info = self._scheduler.state_store.fetch_task(full)
+                    shown = status.state.value if status else None
+                    # a PAUSED override rewrites the shown state
+                    # (reference: PodQueries surfacing PAUSING/PAUSED
+                    # instead of the raw Mesos state)
+                    override, progress = (
+                        self._scheduler.state_store.fetch_goal_override(full)
+                    )
+                    if override is GoalStateOverride.PAUSED:
+                        shown = (
+                            "PAUSED"
+                            if progress is OverrideProgress.COMPLETE
+                            else "PAUSING"
+                        )
                     tasks.append(
                         {
                             "name": full,
                             "id": info.task_id if info else None,
-                            "status": status.state.value if status else None,
+                            "status": shown,
                             "ready": status.ready if status else False,
                         }
                     )

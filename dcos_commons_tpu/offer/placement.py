@@ -244,6 +244,73 @@ class TaskTypeRule(PlacementRule):
         return EvaluationOutcome.ok(name, "avoided")
 
 
+class AgentRule(PlacementRule):
+    """Pin to / avoid specific host ids.
+
+    Reference: AgentRule (agent-id targeted placement).  The avoid form
+    is the TPU maintenance-drain verb: ``agent:avoid:h3`` keeps new
+    placements off a host scheduled for maintenance while existing
+    tasks drain.
+    """
+
+    def __init__(self, host_ids: List[str], avoid: bool = False):
+        self.host_ids = set(host_ids)
+        self.avoid = avoid
+
+    def filter(self, snapshot, ctx):
+        on_list = snapshot.host.host_id in self.host_ids
+        name = f"agent-{'avoid' if self.avoid else 'match'}"
+        if on_list != self.avoid:
+            return EvaluationOutcome.ok(name, snapshot.host.host_id)
+        return EvaluationOutcome.fail(
+            name,
+            f"host {snapshot.host.host_id!r} "
+            f"{'is drained' if self.avoid else 'not in'} "
+            f"{sorted(self.host_ids)}",
+        )
+
+
+class RoundRobinByRule(PlacementRule):
+    """Strict round robin: a host's field value may hold a new instance
+    only while no other known value holds fewer.
+
+    Reference: RoundRobinByHostname/Attribute/Region/ZoneRule — unlike
+    GROUP_BY's ceiling (which allows transient imbalance while filling)
+    round robin never lets any value get 2 ahead of the emptiest.
+    ``expected_values`` bounds the value set when topology knowledge is
+    partial (reference: the optional value-count parameter).
+    """
+
+    def __init__(self, field_name: str, expected_values: int = 0):
+        self.field_name = field_name
+        self.expected_values = expected_values
+
+    def filter(self, snapshot, ctx):
+        value = ctx.host_field(snapshot.host, self.field_name)
+        values = {
+            ctx.host_field(h, self.field_name) for h in ctx.hosts.values()
+        } | {value}
+        counts = {
+            v: ctx.count_on(self.field_name, v, ctx.pod_type) for v in values
+        }
+        floor = min(counts.values())
+        if self.expected_values and len(values) < self.expected_values:
+            # declared values not yet visible in the topology are empty
+            # by definition (reference: RoundRobin treats unknown
+            # declared values as the floor)
+            floor = 0
+        name = f"round-robin-{self.field_name}"
+        if counts[value] <= floor:
+            return EvaluationOutcome.ok(
+                name, f"{value!r} at {counts[value]} (floor {floor})"
+            )
+        return EvaluationOutcome.fail(
+            name,
+            f"{self.field_name}={value!r} has {counts[value]} of "
+            f"{ctx.pod_type!r}, another value is at {floor}",
+        )
+
+
 class SameSliceRule(PlacementRule):
     """TPU-first: all instances of the pod on one physical slice."""
 
@@ -278,18 +345,24 @@ def parse_placement(text: str) -> PlacementRule:
         hostname:exact:h1,h2        hostname:regex:tpu-.*
         zone:exact:us-central2-b    attribute:tier:premium
         task-type:avoid:data        task-type:colocate:data
-        group-by:zone               same-slice
-        generation:v5e
+        group-by:zone               round-robin:zone[:n]
+        agent:exact:h1,h2           agent:avoid:h3   (maintenance drain)
+        generation:v5e              same-slice
         rule1 && rule2              (conjunction)
+        rule1 || rule2              (disjunction; binds looser than &&)
     """
     text = (text or "").strip()
     if not text:
         return PassthroughRule()
     if text.startswith("["):
         return _parse_marathon(text)
-    parts = [p.strip() for p in text.split("&&") if p.strip()]
-    rules = [_parse_one(p) for p in parts]
-    return rules[0] if len(rules) == 1 else AndRule(rules)
+    alternatives = [a.strip() for a in text.split("||") if a.strip()]
+    or_rules: List[PlacementRule] = []
+    for alternative in alternatives:
+        parts = [p.strip() for p in alternative.split("&&") if p.strip()]
+        rules = [_parse_one(p) for p in parts]
+        or_rules.append(rules[0] if len(rules) == 1 else AndRule(rules))
+    return or_rules[0] if len(or_rules) == 1 else OrRule(or_rules)
 
 
 _FIELD_ALIASES = {"host": "hostname", "hostname": "hostname", "zone": "zone",
@@ -297,6 +370,15 @@ _FIELD_ALIASES = {"host": "hostname", "hostname": "hostname", "zone": "zone",
 
 
 def _parse_one(text: str) -> PlacementRule:
+    try:
+        return _parse_one_inner(text)
+    except (IndexError, KeyError) as e:
+        # arity errors surface as parse errors, not crashes — the spec
+        # validator turns these into config errors
+        raise ValueError(f"malformed placement rule {text!r}: {e}")
+
+
+def _parse_one_inner(text: str) -> PlacementRule:
     parts = text.split(":")
     head = parts[0].lower()
     if head == "max-per-host":
@@ -320,6 +402,18 @@ def _parse_one(text: str) -> PlacementRule:
         return FieldMatchRule("generation", [parts[1]])
     if head == "task-type" and len(parts) == 3:
         return TaskTypeRule(parts[2], colocate=(parts[1].lower() == "colocate"))
+    if head == "round-robin" and len(parts) >= 2:
+        expected = int(parts[2]) if len(parts) > 2 else 0
+        return RoundRobinByRule(
+            _FIELD_ALIASES.get(parts[1], parts[1]), expected
+        )
+    if head == "agent" and len(parts) >= 3:
+        mode = parts[1].lower()
+        ids = parts[2].split(",")
+        if mode in ("exact", "match"):
+            return AgentRule(ids)
+        if mode == "avoid":
+            return AgentRule(ids, avoid=True)
     if head == "same-slice":
         return SameSliceRule()
     raise ValueError(f"unknown placement rule: {text!r}")

@@ -133,10 +133,29 @@ def _search_slice(
             cache[pos] = child
         return cache[pos]
 
-    for ay in range(max_y - need_y + 1):
-        for ax in range(max_x - need_x + 1):
+    # wrap-around: a slice whose ICI closes into a torus on an axis
+    # (full-pod axes on v4/v5p, 16-wide v5e slices) admits rectangles
+    # that cross the edge.  Opt-in per slice via host attributes:
+    # ``ici_wrap`` in {x, y, both} plus the PHYSICAL ring
+    # circumference ``ring_x``/``ring_y`` — the modulo must come from
+    # the hardware ring, never the observed extent of up hosts (a down
+    # edge host would shrink it and join non-adjacent hosts).
+    attrs = next(iter(snaps)).host.attributes
+    wrap_attr = attrs.get("ici_wrap", "")
+    ring_x = int(attrs.get("ring_x", 0) or 0)
+    ring_y = int(attrs.get("ring_y", 0) or 0)
+    wrap_x = wrap_attr in ("x", "both") and ring_x >= max_x and \
+        need_x < ring_x
+    wrap_y = wrap_attr in ("y", "both") and ring_y >= max_y and \
+        need_y < ring_y
+    mod_x = ring_x if wrap_x else max(max_x, need_x)
+    mod_y = ring_y if wrap_y else max(max_y, need_y)
+    anchors_x = range(ring_x if wrap_x else max_x - need_x + 1)
+    anchors_y = range(ring_y if wrap_y else max_y - need_y + 1)
+    for ay in anchors_y:
+        for ax in anchors_x:
             rect = [
-                (ax + dx, ay + dy)
+                ((ax + dx) % mod_x, (ay + dy) % mod_y)
                 for dy in range(need_y)
                 for dx in range(need_x)
             ]

@@ -190,7 +190,7 @@ def single_chip_rooflines(
     y = copy_chain(x)
     _sync(y)
     dt = max(time.monotonic() - t0 - overhead, 1e-6)
-    out["hbm_copy_gbps"] = round(2 * nbytes * copy_iters / dt / 1e9, 1)
+    out["hbm_copy_gbps"] = round(2 * nbytes * copy_iters / dt / 1e9, 3)
 
     # MXU roofline: chained bf16 matmuls (4k x 4k fills the MXU)
     mm_iters = max(iters, chain_floor)
@@ -211,7 +211,7 @@ def single_chip_rooflines(
     y = matmul_chain(a)
     _sync(y)
     dt = max(time.monotonic() - t0 - overhead, 1e-6)
-    out["matmul_bf16_tflops"] = round(2 * m ** 3 * mm_iters / dt / 1e12, 1)
+    out["matmul_bf16_tflops"] = round(2 * m ** 3 * mm_iters / dt / 1e12, 3)
     return out
 
 

@@ -145,6 +145,21 @@ def gang_pods_need_topology(old, new):
     return errs
 
 
+def placement_rules_must_parse(old, new):
+    """A bad placement string is a CONFIG error, not a runtime crash in
+    the offer cycle (reference: InvalidPlacementRule records parse
+    failures so the scheduler surfaces them instead of wedging)."""
+    from dcos_commons_tpu.offer.placement import parse_placement
+
+    errs = []
+    for pod in new.pods:
+        try:
+            parse_placement(pod.placement)
+        except ValueError as e:
+            errs.append(f"pod {pod.type!r}: bad placement: {e}")
+    return errs
+
+
 def default_validators() -> List[Validator]:
     return [
         service_name_cannot_change,
@@ -154,6 +169,7 @@ def default_validators() -> List[Validator]:
         task_volumes_cannot_change,
         tpu_topology_cannot_change,
         gang_pods_need_topology,
+        placement_rules_must_parse,
     ]
 
 

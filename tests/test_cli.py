@@ -114,3 +114,86 @@ def test_subprocess_smoke(deployed):
     )
     assert result.returncode == 0, result.stderr
     assert json.loads(result.stdout) == ["deploy", "recovery"]
+
+
+def test_plan_start_stop_sidecar(deployed, capsys):
+    """plan start/stop drive an interrupted sidecar plan end to end
+    over the CLI (reference: cassandra backup via plan start)."""
+    runner, server = deployed
+    # rebuild the world with a sidecar plan service
+    sidecar_yaml = """
+name: cli-svc2
+pods:
+  app:
+    count: 1
+    tasks:
+      main: {goal: RUNNING, cmd: "serve", cpus: 0.1, memory: 32}
+      once: {goal: ONCE, cmd: "job", cpus: 0.1, memory: 32}
+plans:
+  deploy:
+    strategy: serial
+    phases:
+      main-phase:
+        strategy: serial
+        pod: app
+        steps:
+          - 0: [[main]]
+  backup:
+    strategy: serial
+    phases:
+      backup-phase:
+        strategy: serial
+        pod: app
+        steps:
+          - 0: [[once]]
+"""
+    from dcos_commons_tpu.http import ApiServer
+    from dcos_commons_tpu.testing import (
+        SendTaskFinished,
+        ServiceTestRunner,
+    )
+
+    side = ServiceTestRunner(sidecar_yaml)
+    side.run([
+        AdvanceCycles(1),
+        SendTaskRunning("app-0-main"),
+        ExpectDeploymentComplete(),
+    ])
+    server2 = ApiServer(side.world.scheduler).start()
+    try:
+        plans = cli(server2, "plan", "list", capsys=capsys)
+        assert "backup" in plans
+        cli(server2, "plan", "start", "backup", capsys=capsys)
+        side.run([AdvanceCycles(1), SendTaskFinished("app-0-once")])
+        status = cli(server2, "plan", "status", "backup", capsys=capsys)
+        assert status["status"] == "COMPLETE"
+        cli(server2, "plan", "stop", "backup", capsys=capsys)
+        status = cli(server2, "plan", "status", "backup", capsys=capsys)
+        assert status["status"] in ("WAITING", "PENDING")
+    finally:
+        server2.stop()
+
+
+def test_pod_pause_resume_verbs(deployed, capsys):
+    runner, server = deployed
+    cli(server, "pod", "pause", "app-0", capsys=capsys)
+    runner.run([AdvanceCycles(2)])
+    status = cli(server, "pod", "status", "app-0", capsys=capsys)
+    assert "PAUSING" in json.dumps(status)
+    runner.run([AdvanceCycles(1), SendTaskRunning("app-0-main")])
+    status = cli(server, "pod", "status", "app-0", capsys=capsys)
+    assert "PAUSED" in json.dumps(status)
+    cli(server, "pod", "resume", "app-0", capsys=capsys)
+    runner.run([AdvanceCycles(2), SendTaskRunning("app-0-main")])
+    status = cli(server, "pod", "status", "app-0", capsys=capsys)
+    assert "PAUS" not in json.dumps(status)
+
+
+def test_debug_and_metrics_sections(deployed, capsys):
+    runner, server = deployed
+    offers = cli(server, "debug", "offers", capsys=capsys)
+    assert isinstance(offers, (list, dict))
+    metrics = cli(server, "metrics", capsys=capsys)
+    assert "offers.evaluated" in json.dumps(metrics)
+    reservations = cli(server, "debug", "reservations", capsys=capsys)
+    assert reservations

@@ -385,3 +385,212 @@ def test_gang_relaunch_without_coordinator_fails_loudly():
     assert not relaunch.passed
     assert "coordinator" in "\n".join(relaunch.outcome.flatten())
     assert relaunch.task_infos == []
+
+
+def test_agent_rule_match_and_drain():
+    """agent:exact pins to host ids; agent:avoid is the maintenance
+    drain verb (reference: AgentRule)."""
+    hosts = [cpu_host("h1"), cpu_host("h2"), cpu_host("h3")]
+    ctx = ctx_with([], hosts)
+    pin = parse_placement("agent:exact:h1,h2")
+    assert pin.filter(snap_for(hosts[0]), ctx).passed
+    assert not pin.filter(snap_for(hosts[2]), ctx).passed
+    drain = parse_placement("agent:avoid:h3")
+    assert drain.filter(snap_for(hosts[0]), ctx).passed
+    outcome = drain.filter(snap_for(hosts[2]), ctx)
+    assert not outcome.passed
+    assert "drained" in outcome.reason
+
+
+def test_round_robin_rule_balances_zones():
+    """round-robin:zone never lets one zone get 2 ahead of the
+    emptiest (reference: RoundRobinByZoneRule)."""
+    hosts = [
+        cpu_host("a1", zone="za"), cpu_host("a2", zone="za"),
+        cpu_host("b1", zone="zb"),
+    ]
+    rule = parse_placement("round-robin:zone")
+    one_in_za = [TaskInfo(name="hello-0-server", pod_type="hello",
+                          pod_index=0, agent_id="a1")]
+    ctx = ctx_with(one_in_za, hosts)
+    # za is at 1, zb at 0: only zb placements pass
+    assert not rule.filter(snap_for(hosts[1]), ctx).passed
+    assert rule.filter(snap_for(hosts[2]), ctx).passed
+    # balanced again: both pass
+    balanced = one_in_za + [TaskInfo(name="hello-1-server", pod_type="hello",
+                                     pod_index=1, agent_id="b1")]
+    ctx = ctx_with(balanced, hosts)
+    assert rule.filter(snap_for(hosts[1]), ctx).passed
+    assert rule.filter(snap_for(hosts[2]), ctx).passed
+
+
+def test_placement_disjunction():
+    hosts = [cpu_host("h1", zone="za"), cpu_host("h2", zone="zb"),
+             cpu_host("h3", zone="zc")]
+    ctx = ctx_with([], hosts)
+    rule = parse_placement("zone:exact:za || zone:exact:zb && hostname:regex:h.*")
+    assert rule.filter(snap_for(hosts[0]), ctx).passed
+    assert rule.filter(snap_for(hosts[1]), ctx).passed
+    assert not rule.filter(snap_for(hosts[2]), ctx).passed
+
+
+def test_bad_placement_is_config_error():
+    from dcos_commons_tpu.specification.validation import (
+        ConfigValidationError,
+        validate_spec_change,
+    )
+
+    spec = from_yaml("""
+name: bad-placement
+pods:
+  app:
+    count: 1
+    placement: 'no-such-rule:1'
+    tasks:
+      main: {goal: RUNNING, cmd: "x", cpus: 0.1, memory: 32}
+""")
+    import pytest as _pytest
+
+    with _pytest.raises(ConfigValidationError) as err:
+        validate_spec_change(None, spec)
+    assert "placement" in str(err.value)
+
+
+# -- torus wrap-around + odd shapes ----------------------------------
+
+
+def _row_fleet(n, wrap=""):
+    """n hosts in a 1-row slice, 2x2 chips each."""
+    hosts = []
+    for i in range(n):
+        hosts.append(TpuHost(
+            host_id=f"r{i}",
+            slice_id="row-slice",
+            generation="v5e",
+            grid=(i, 0),
+            chip_block=(2, 2),
+            cpus=8.0,
+            memory_mb=16384,
+            attributes=(
+                {"ici_wrap": wrap, "ring_x": str(n), "ring_y": "1"}
+                if wrap else {}
+            ),
+        ))
+    return hosts
+
+
+def _all_ok(snap):
+    from dcos_commons_tpu.offer.outcome import EvaluationOutcome
+    return EvaluationOutcome.ok("test")
+
+
+def test_torus_no_wrap_blocked_by_middle_host():
+    from dcos_commons_tpu.offer.torus import find_subslice
+
+    inv = SliceInventory(_row_fleet(3))
+    ledger = ReservationLedger(MemPersister())
+    # reserve the middle host's chips: no contiguous 2-host rect left
+    middle = _row_fleet(3)[1]
+    ledger.commit([Reservation(
+        reservation_id=new_reservation_id(), host_id="r1",
+        task_name="blocker-0-x", chip_ids=middle.chip_ids(),
+    )])
+    placement = find_subslice(
+        inv.snapshots(ledger), (4, 2), 4, _all_ok
+    )
+    assert placement.snapshots == []
+
+
+def test_torus_wrap_spans_the_edge():
+    from dcos_commons_tpu.offer.torus import find_subslice
+
+    fleet = _row_fleet(3, wrap="x")
+    inv = SliceInventory(fleet)
+    ledger = ReservationLedger(MemPersister())
+    ledger.commit([Reservation(
+        reservation_id=new_reservation_id(), host_id="r1",
+        task_name="blocker-0-x", chip_ids=fleet[1].chip_ids(),
+    )])
+    placement = find_subslice(
+        inv.snapshots(ledger), (4, 2), 4, _all_ok
+    )
+    # r2 + r0 across the wrap link form the 4x2 rectangle
+    assert [s.host.host_id for s in placement.snapshots] == ["r2", "r0"]
+
+
+def test_torus_odd_shape_not_tileable():
+    from dcos_commons_tpu.offer.torus import find_subslice
+
+    inv = SliceInventory(_row_fleet(3))
+    ledger = ReservationLedger(MemPersister())
+    placement = find_subslice(inv.snapshots(ledger), (3, 2), 4, _all_ok)
+    assert placement.snapshots == []
+    assert any(
+        "not tileable" in c.reason for c in placement.outcome.children
+    )
+
+
+def test_torus_full_ring_uses_every_host():
+    from dcos_commons_tpu.offer.torus import find_subslice
+
+    inv = SliceInventory(_row_fleet(4, wrap="x"))
+    ledger = ReservationLedger(MemPersister())
+    placement = find_subslice(inv.snapshots(ledger), (8, 2), 4, _all_ok)
+    assert len(placement.snapshots) == 4
+
+
+def test_torus_wrap_needs_physical_ring_size():
+    """Wrap modulo must come from the declared hardware ring, never
+    the observed extent of up hosts: with the edge host DOWN, the
+    shrunken extent must not join non-adjacent hosts."""
+    from dcos_commons_tpu.offer.torus import find_subslice
+
+    fleet = _row_fleet(4, wrap="x")  # ring_x=4
+    inv = SliceInventory(fleet)
+    inv.mark_down("r3")  # the physical wrap neighbor of r0
+    ledger = ReservationLedger(MemPersister())
+    ledger.commit([Reservation(
+        reservation_id=new_reservation_id(), host_id="r1",
+        task_name="blocker-0-x", chip_ids=fleet[1].chip_ids(),
+    )])
+    placement = find_subslice(inv.snapshots(ledger), (4, 2), 4, _all_ok)
+    # r2+r0 would need the link through the down host r3: refuse
+    assert placement.snapshots == []
+
+
+def test_round_robin_partial_topology_knowledge():
+    """round-robin:zone:3 with only 2 zones visible: the declared but
+    unseen zone is empty by definition, so non-empty zones fail."""
+    hosts = [cpu_host("a1", zone="za"), cpu_host("b1", zone="zb")]
+    rule = parse_placement("round-robin:zone:3")
+    ctx = ctx_with(
+        [TaskInfo(name="hello-0-server", pod_type="hello", pod_index=0,
+                  agent_id="a1"),
+         TaskInfo(name="hello-1-server", pod_type="hello", pod_index=1,
+                  agent_id="b1")],
+        hosts,
+    )
+    assert not rule.filter(snap_for(hosts[0]), ctx).passed
+    assert not rule.filter(snap_for(hosts[1]), ctx).passed
+
+
+def test_malformed_placement_arity_is_config_error():
+    from dcos_commons_tpu.specification.validation import (
+        ConfigValidationError,
+        validate_spec_change,
+    )
+
+    for bad in ("group-by", "max-per-host", "agent:exact"):
+        spec = from_yaml(f"""
+name: bad-arity
+pods:
+  app:
+    count: 1
+    placement: '{bad}'
+    tasks:
+      main: {{goal: RUNNING, cmd: "x", cpus: 0.1, memory: 32}}
+""")
+        import pytest as _pytest
+
+        with _pytest.raises(ConfigValidationError):
+            validate_spec_change(None, spec)
