@@ -185,6 +185,7 @@ class AgentDaemon:
                 templates=entry.get("templates"),
                 files=entry.get("files"),
                 secret_env=entry.get("secret_env"),
+                kill_grace_s=float(entry.get("kill_grace_s", 5.0)),
             )
             launched.append(info.task_id)
         return launched

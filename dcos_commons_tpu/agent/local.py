@@ -9,6 +9,7 @@ task-side), and health-check supervision with kill-on-max-failures.
 
 from __future__ import annotations
 
+import json
 import os
 import shlex
 import signal
@@ -82,17 +83,73 @@ def write_templates(sandbox: str, rendered: List[Tuple[str, str]]) -> None:
 @dataclass
 class _Running:
     info: TaskInfo
-    process: subprocess.Popen
+    # Popen when this agent process launched the task; None for a task
+    # recovered from a previous agent incarnation (tracked by pid +
+    # the supervisor's durable exit_status record)
+    process: Optional[subprocess.Popen]
     sandbox: str
     readiness: Optional[ReadinessCheckSpec]
     health: Optional[HealthCheckSpec]
     started_at: float
+    pid: int = 0
+    pid_identity: str = ""          # /proc start time: pid-reuse guard
+    native: bool = False            # supervised by the C++ task_exec
+    record_dir: str = ""            # per-INCARNATION lifecycle records
     ready_reported: bool = False
     running_reported: bool = False
     health_failures: int = 0
     last_check_at: float = 0.0
     kill_requested: bool = False
     kill_deadline: float = 0.0
+
+    def exit_code(self) -> Optional[int]:
+        """None while alive; the exit code once done; -1 when the fate
+        is unknowable (supervisor lost / non-native recovery).
+
+        Self-launched tasks short-circuit on the Popen (the native
+        supervisor exits WITH the child's code); recovered tasks read
+        the supervisor's durable exit_status record."""
+        if self.process is not None:
+            return self.process.poll()
+        status_path = os.path.join(
+            self.record_dir or self.sandbox, "exit_status"
+        )
+        try:
+            with open(status_path) as f:
+                return int(f.read().strip())
+        except (OSError, ValueError):
+            pass
+        if self.pid and not _pid_alive(self.pid, self.pid_identity):
+            # pid gone (or recycled by another process) without a
+            # durable record: the fate is unknowable
+            return -1
+        return None
+
+
+def _proc_identity(pid: int) -> str:
+    """Process start time from /proc — distinguishes a live pid from a
+    recycled one.  Empty string when unavailable (non-Linux)."""
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            fields = f.read().rsplit(") ", 1)[-1].split()
+        # field 22 of /proc/pid/stat overall = index 19 after comm
+        return fields[19]
+    except (OSError, IndexError):
+        return ""
+
+
+def _pid_alive(pid: int, identity: str = "") -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        pass
+    if identity:
+        current = _proc_identity(pid)
+        if current and current != identity:
+            return False  # pid recycled by an unrelated process
+    return True
 
 
 class LocalProcessAgent:
@@ -104,12 +161,89 @@ class LocalProcessAgent:
     (launch_with_checks), keeping TaskInfo JSON-small.
     """
 
-    def __init__(self, workdir: str):
+    def __init__(self, workdir: str, use_native: bool = True):
         self._workdir = workdir
         self._tasks: Dict[str, _Running] = {}
         self._pending: List[TaskStatus] = []
+        # recovered terminal fates whose records retire at delivery
+        self._undelivered_records: Dict[str, str] = {}
         self._lock = threading.RLock()
+        self._use_native = use_native
         os.makedirs(workdir, exist_ok=True)
+        self._recover_tasks()
+
+    def _recover_tasks(self) -> None:
+        """Rebuild task state from sandbox records after an agent
+        restart: the C++ supervisor persisted task.json at launch and
+        exit_status at exit, so a daemon crash loses no task fates.
+
+        Still-running tasks resume monitoring by pid; exited ones get
+        their terminal status synthesized exactly once (the record is
+        renamed after delivery)."""
+        try:
+            names = os.listdir(self._workdir)
+        except OSError:
+            return
+        for name in names:
+            sandbox = os.path.join(self._workdir, name)
+            super_root = os.path.join(sandbox, ".super")
+            try:
+                incarnations = os.listdir(super_root)
+            except OSError:
+                continue
+            for task_id in incarnations:
+                record_dir = os.path.join(super_root, task_id)
+                record_path = os.path.join(record_dir, "task.json")
+                if not os.path.isfile(record_path):
+                    continue
+                try:
+                    with open(record_path) as f:
+                        record = json.load(f)
+                except (OSError, ValueError):
+                    continue
+                info = TaskInfo.from_dict(record["info"])
+                readiness = record.get("readiness")
+                health = record.get("health")
+                running = _Running(
+                    info=info,
+                    process=None,
+                    sandbox=sandbox,
+                    readiness=(
+                        ReadinessCheckSpec(**readiness) if readiness else None
+                    ),
+                    health=HealthCheckSpec(**health) if health else None,
+                    started_at=time.monotonic(),
+                    pid=int(record.get("pid", 0)),
+                    pid_identity=str(record.get("pid_identity", "")),
+                    native=bool(record.get("native", False)),
+                    record_dir=record_dir,
+                )
+                code = running.exit_code()
+                if code is None:
+                    # alive across the restart: resume supervision;
+                    # RUNNING is re-reported (status intake idempotent)
+                    self._tasks[info.task_id] = running
+                    continue
+                if code == -1:
+                    # no durable record (non-native fallback, or the
+                    # supervisor was SIGKILLed): the fate is unknowable
+                    # — LOST lets recovery decide, never claiming a
+                    # success or failure we cannot prove
+                    state = TaskState.LOST
+                else:
+                    state = TaskState.FINISHED if code == 0 else (
+                        TaskState.KILLED if code in (128 + 15, 128 + 9)
+                        else TaskState.FAILED
+                    )
+                self._pending.append(TaskStatus(
+                    task_id=info.task_id,
+                    state=state,
+                    message=f"recovered after agent restart: exit {code}",
+                    agent_id=info.agent_id,
+                ))
+                # the record is retired only when the fate is HANDED
+                # OUT (poll), so a crash before delivery re-recovers it
+                self._undelivered_records[info.task_id] = record_path
 
     # -- Agent --------------------------------------------------------
 
@@ -188,6 +322,7 @@ class LocalProcessAgent:
         templates: Optional[List[dict]] = None,
         files: Optional[List[dict]] = None,
         secret_env: Optional[Dict[str, str]] = None,
+        kill_grace_s: float = 5.0,
     ) -> None:
         with self._lock:
             if info.task_id in self._tasks:
@@ -258,15 +393,44 @@ class LocalProcessAgent:
                     )
                 )
                 return
+            # durable pre-launch record: a restarted agent rebuilds its
+            # task table from these (+ the supervisor's exit_status)
+            from dcos_commons_tpu.agent.daemon import serialize_check
+
+            native_exe = ""
+            if self._use_native:
+                from dcos_commons_tpu.native import task_exec_path
+
+                native_exe = task_exec_path()
             try:
-                process = subprocess.Popen(
-                    ["/bin/sh", "-c", info.command],
-                    cwd=sandbox,
-                    env=env,
-                    stdout=open(os.path.join(sandbox, "stdout"), "ab"),
-                    stderr=open(os.path.join(sandbox, "stderr"), "ab"),
-                    start_new_session=True,
-                )
+                # lifecycle records are per INCARNATION: a dying
+                # predecessor's exit record must never shadow the new
+                # launch.  Delivered (.done) records of other
+                # incarnations are pruned here.
+                record_dir = os.path.join(sandbox, ".super", info.task_id)
+                os.makedirs(record_dir, exist_ok=True)
+                self._prune_delivered_records(sandbox, keep=info.task_id)
+                if native_exe:
+                    process = subprocess.Popen(
+                        [
+                            native_exe,
+                            "--sandbox", sandbox,
+                            "--record-dir", record_dir,
+                            "--grace", str(kill_grace_s),
+                            "--", info.command,
+                        ],
+                        env=env,
+                        start_new_session=True,
+                    )
+                else:
+                    process = subprocess.Popen(
+                        ["/bin/sh", "-c", info.command],
+                        cwd=sandbox,
+                        env=env,
+                        stdout=open(os.path.join(sandbox, "stdout"), "ab"),
+                        stderr=open(os.path.join(sandbox, "stderr"), "ab"),
+                        start_new_session=True,
+                    )
             except OSError as e:
                 self._pending.append(
                     TaskStatus(
@@ -277,6 +441,23 @@ class LocalProcessAgent:
                     )
                 )
                 return
+            # the durable record is best-effort: a failed write only
+            # degrades RESTART recovery — the process is running and
+            # must be tracked regardless, or it leaks untracked
+            pid_identity = _proc_identity(process.pid)
+            try:
+                record = {
+                    "info": info.to_dict(),
+                    "pid": process.pid,
+                    "pid_identity": pid_identity,
+                    "native": bool(native_exe),
+                    "readiness": serialize_check(readiness),
+                    "health": serialize_check(health),
+                }
+                with open(os.path.join(record_dir, "task.json"), "w") as f:
+                    json.dump(record, f)
+            except OSError:
+                pass
             self._tasks[info.task_id] = _Running(
                 info=info,
                 process=process,
@@ -284,7 +465,26 @@ class LocalProcessAgent:
                 readiness=readiness,
                 health=health,
                 started_at=time.monotonic(),
+                pid=process.pid,
+                pid_identity=pid_identity,
+                native=bool(native_exe),
+                record_dir=record_dir,
             )
+
+    def _prune_delivered_records(self, sandbox: str, keep: str) -> None:
+        import shutil as _shutil
+
+        super_root = os.path.join(sandbox, ".super")
+        try:
+            entries = os.listdir(super_root)
+        except OSError:
+            return
+        for task_id in entries:
+            if task_id == keep:
+                continue
+            record_dir = os.path.join(super_root, task_id)
+            if os.path.exists(os.path.join(record_dir, "task.json.done")):
+                _shutil.rmtree(record_dir, ignore_errors=True)
 
     def kill(self, task_id: str, grace_period_s: float = 0.0) -> None:
         with self._lock:
@@ -292,11 +492,40 @@ class LocalProcessAgent:
             if running is None:
                 return
             running.kill_requested = True
-            running.kill_deadline = time.monotonic() + grace_period_s
+            # native tasks: the supervisor owns grace escalation; the
+            # Python deadline is only the lost-supervisor backstop
+            margin = 10.0 if running.native else 0.0
+            running.kill_deadline = (
+                time.monotonic() + grace_period_s + margin
+            )
             try:
-                os.killpg(running.process.pid, signal.SIGTERM)
+                if running.native:
+                    os.kill(running.pid, signal.SIGTERM)
+                else:
+                    os.killpg(running.pid, signal.SIGTERM)
             except (ProcessLookupError, PermissionError):
                 pass
+            if running.native and grace_period_s <= 0:
+                # an explicit zero grace means NOW — don't defer to the
+                # supervisor's launch-time grace
+                self._force_kill(running)
+
+    def _force_kill(self, running: _Running) -> None:
+        """SIGKILL the task's process group (non-native: the child IS
+        the group leader; native: read the supervisor's child.pid)."""
+        pid = running.pid
+        if running.native:
+            try:
+                with open(os.path.join(
+                    running.record_dir or running.sandbox, "child.pid"
+                )) as f:
+                    pid = int(f.read().strip())
+            except (OSError, ValueError):
+                pass
+        try:
+            os.killpg(pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
 
     def active_task_ids(self) -> Set[str]:
         with self._lock:
@@ -306,6 +535,15 @@ class LocalProcessAgent:
         with self._lock:
             out = list(self._pending)
             self._pending.clear()
+            for status in out:
+                record_path = self._undelivered_records.pop(
+                    status.task_id, None
+                )
+                if record_path:
+                    try:
+                        os.replace(record_path, record_path + ".done")
+                    except OSError:
+                        pass
             now = time.monotonic()
             finished: List[str] = []
             for task_id, running in self._tasks.items():
@@ -321,10 +559,20 @@ class LocalProcessAgent:
     ) -> List[TaskStatus]:
         out: List[TaskStatus] = []
         info = running.info
-        returncode = running.process.poll()
+        returncode = running.exit_code()
         if returncode is not None:
             finished.append(task_id)
-            if running.kill_requested:
+            # fate delivered: the durable record must not be re-
+            # reported by a later agent restart
+            if running.record_dir:
+                record = os.path.join(running.record_dir, "task.json")
+                try:
+                    os.replace(record, record + ".done")
+                except OSError:
+                    pass
+            if returncode == -1 and not running.kill_requested:
+                state = TaskState.LOST  # fate unknowable
+            elif running.kill_requested or returncode in (128 + 15, 128 + 9):
                 state = TaskState.KILLED
             elif returncode == 0:
                 state = TaskState.FINISHED
@@ -334,16 +582,16 @@ class LocalProcessAgent:
                 TaskStatus(
                     task_id=task_id,
                     state=state,
-                    message=f"exit {returncode}",
+                    message=(
+                        "supervisor lost" if returncode == -1
+                        else f"exit {returncode}"
+                    ),
                     agent_id=info.agent_id,
                 )
             )
             return out
         if running.kill_requested and now >= running.kill_deadline:
-            try:
-                os.killpg(running.process.pid, signal.SIGKILL)
-            except (ProcessLookupError, PermissionError):
-                pass
+            self._force_kill(running)
         if not running.running_reported:
             running.running_reported = True
             out.append(
@@ -408,11 +656,19 @@ class LocalProcessAgent:
             for task_id in list(self._tasks):
                 self.kill(task_id)
             for running in self._tasks.values():
-                try:
-                    running.process.wait(timeout=5)
-                except subprocess.TimeoutExpired:
+                if running.process is not None:
                     try:
-                        os.killpg(running.process.pid, signal.SIGKILL)
-                    except (ProcessLookupError, PermissionError):
-                        pass
+                        running.process.wait(timeout=5)
+                    except subprocess.TimeoutExpired:
+                        self._force_kill(running)
+                elif running.pid:
+                    # recovered task: give the supervisor a moment to
+                    # run its grace escalation, then force
+                    deadline = time.monotonic() + 5
+                    while time.monotonic() < deadline and _pid_alive(
+                        running.pid
+                    ):
+                        time.sleep(0.05)
+                    if _pid_alive(running.pid):
+                        self._force_kill(running)
             self._tasks.clear()

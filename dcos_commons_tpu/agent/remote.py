@@ -188,6 +188,7 @@ class RemoteFleet(Agent):
         templates: Optional[List[dict]] = None,
         files: Optional[List[dict]] = None,
         secret_env: Optional[Dict[str, str]] = None,
+        kill_grace_s: float = 5.0,
     ) -> None:
         client = self._clients.get(info.agent_id)
         if client is None:
@@ -200,6 +201,7 @@ class RemoteFleet(Agent):
             "templates": templates or [],
             "files": files or [],
             "secret_env": secret_env or {},
+            "kill_grace_s": kill_grace_s,
         }
         try:
             client.launch([entry])

@@ -48,11 +48,13 @@ class _ServiceAgentAdapter:
     def launch(self, task_infos):
         self._agent.launch(task_infos)
 
-    def launch_one(self, info, readiness=None, health=None, templates=None):
+    def launch_one(self, info, readiness=None, health=None, templates=None,
+                   **kwargs):
         launch_one = getattr(self._agent, "launch_one", None)
         if launch_one is not None:
             launch_one(
-                info, readiness=readiness, health=health, templates=templates
+                info, readiness=readiness, health=health,
+                templates=templates, **kwargs,
             )
         else:
             self._agent.launch([info])

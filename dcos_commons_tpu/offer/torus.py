@@ -142,8 +142,17 @@ def _search_slice(
     # edge host would shrink it and join non-adjacent hosts).
     attrs = next(iter(snaps)).host.attributes
     wrap_attr = attrs.get("ici_wrap", "")
-    ring_x = int(attrs.get("ring_x", 0) or 0)
-    ring_y = int(attrs.get("ring_y", 0) or 0)
+
+    def _ring(key: str) -> int:
+        # attributes are free-form operator strings: a typo must not
+        # crash the offer cycle — it just disables wrap on that axis
+        try:
+            return int(attrs.get(key, 0) or 0)
+        except (TypeError, ValueError):
+            return 0
+
+    ring_x = _ring("ring_x")
+    ring_y = _ring("ring_y")
     wrap_x = wrap_attr in ("x", "both") and ring_x >= max_x and \
         need_x < ring_x
     wrap_y = wrap_attr in ("y", "both") and ring_y >= max_y and \

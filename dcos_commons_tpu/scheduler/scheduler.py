@@ -343,6 +343,7 @@ class DefaultScheduler:
                     readiness=None if paused else task_spec.readiness_check,
                     health=None if paused else task_spec.health_check,
                     templates=self._templates_for(info, task_spec),
+                    kill_grace_s=task_spec.kill_grace_period_s,
                     **kwargs,
                 )
             else:

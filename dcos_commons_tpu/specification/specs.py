@@ -168,7 +168,9 @@ class TaskSpec:
     health_check: Optional[HealthCheckSpec] = None
     readiness_check: Optional[ReadinessCheckSpec] = None
     config_templates: Tuple[Tuple[str, str], ...] = ()   # (template, dest)
-    kill_grace_period_s: float = 0.0
+    # default matches the Mesos KillPolicy default grace (3s);
+    # an explicit 0 in YAML means kill immediately
+    kill_grace_period_s: float = 3.0
     essential: bool = True           # reference: TaskSpec.isEssential
     transport_encryption: Tuple[TransportEncryptionSpec, ...] = ()
 
@@ -326,7 +328,7 @@ def _decode_task(data: Dict[str, Any]) -> TaskSpec:
         config_templates=tuple(
             (t[0], t[1]) for t in data.get("config_templates", [])
         ),
-        kill_grace_period_s=data.get("kill_grace_period_s", 0.0),
+        kill_grace_period_s=data.get("kill_grace_period_s", 3.0),
         essential=data.get("essential", True),
         transport_encryption=tuple(
             TransportEncryptionSpec(**t)

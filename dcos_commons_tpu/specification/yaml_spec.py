@@ -281,7 +281,7 @@ def _map_task(
         health_check=hc,
         readiness_check=rc,
         config_templates=tuple(templates),
-        kill_grace_period_s=float(raw.get("kill-grace-period", 0)),
+        kill_grace_period_s=float(raw.get("kill-grace-period", 3)),
         essential=bool(raw.get("essential", True)),
         transport_encryption=tuple(
             TransportEncryptionSpec(

@@ -40,7 +40,8 @@ class FakeAgent:
             self.launch_one(info)
 
     def launch_one(self, info: TaskInfo, readiness=None, health=None,
-                   templates=None, files=None, secret_env=None) -> None:
+                   templates=None, files=None, secret_env=None,
+                   kill_grace_s: float = 5.0) -> None:
         with self._lock:
             if info.task_id in self._active:
                 return  # idempotent, like the real agent

@@ -1,0 +1,177 @@
+"""The C++ task supervisor + agent restart recovery.
+
+Reference: sdk/bootstrap/main.go — the reference puts NATIVE code at
+the task boundary (a static Go binary prepended to every command);
+here the native piece is the agent-side task_exec supervisor, which
+makes task fates durable: pid + exit status live in the sandbox, so a
+crashed-and-restarted agent daemon reconstructs every task instead of
+losing them with its heap.
+"""
+
+import os
+import signal
+import time
+
+import pytest
+
+from dcos_commons_tpu.agent.local import LocalProcessAgent
+from dcos_commons_tpu.common import TaskInfo, TaskState
+from dcos_commons_tpu.native import task_exec_path
+
+
+def wait_for_state(agent, task_id, state, timeout_s=10.0, collected=None):
+    statuses = collected if collected is not None else []
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        statuses.extend(agent.poll())
+        if any(
+            s.task_id == task_id and s.state is state for s in statuses
+        ):
+            return statuses
+        time.sleep(0.05)
+    raise AssertionError(
+        f"no {state} for {task_id}; saw "
+        f"{[(s.task_id, s.state.value) for s in statuses]}"
+    )
+
+
+def test_native_binary_builds():
+    assert task_exec_path(), "g++ is baked into this image"
+
+
+def test_native_launch_captures_output_and_exit(tmp_path):
+    agent = LocalProcessAgent(str(tmp_path / "w"))
+    agent.launch_one(TaskInfo(
+        name="t-0-a", task_id="t-0-a__1",
+        command="echo out-line && echo err-line >&2 && exit 7",
+    ))
+    wait_for_state(agent, "t-0-a__1", TaskState.FAILED)
+    sandbox = tmp_path / "w" / "t-0-a"
+    assert (sandbox / "stdout").read_text().strip() == "out-line"
+    assert (sandbox / "stderr").read_text().strip() == "err-line"
+    assert (sandbox / ".super" / "t-0-a__1" / "exit_status"
+            ).read_text().strip() == "7"
+    agent.shutdown()
+
+
+def test_native_kill_grace_then_escalation(tmp_path):
+    agent = LocalProcessAgent(str(tmp_path / "w"))
+    agent.launch_one(
+        TaskInfo(
+            name="t-0-g", task_id="t-0-g__1",
+            command=(
+                'trap "echo cleaning; sleep 0.2; exit 0" TERM; sleep 60'
+            ),
+        ),
+        kill_grace_s=5.0,
+    )
+    # let the shell install its trap
+    time.sleep(0.5)
+    agent.kill("t-0-g__1", grace_period_s=5.0)
+    statuses = wait_for_state(agent, "t-0-g__1", TaskState.KILLED)
+    out = (tmp_path / "w" / "t-0-g" / "stdout").read_text()
+    assert "cleaning" in out  # graceful path ran, not SIGKILL
+    agent.shutdown()
+
+
+def test_agent_restart_recovers_running_and_exited_tasks(tmp_path):
+    """The durability claim end to end: agent 1 launches a long task
+    and a short one, 'crashes' (dropped without shutdown), and agent 2
+    over the same workdir resumes the live task and reports the
+    finished one's exact exit fate."""
+    workdir = str(tmp_path / "w")
+    first = LocalProcessAgent(workdir)
+    first.launch_one(TaskInfo(
+        name="live-0-main", task_id="live-0-main__1",
+        command="sleep 30",
+    ))
+    first.launch_one(TaskInfo(
+        name="done-0-main", task_id="done-0-main__1",
+        command="exit 0",
+    ))
+    # wait for the short task's supervisor to persist exit_status,
+    # WITHOUT polling first (its fate must come from disk, not memory)
+    deadline = time.monotonic() + 10
+    exit_file = (tmp_path / "w" / "done-0-main" / ".super"
+                 / "done-0-main__1" / "exit_status")
+    while time.monotonic() < deadline and not exit_file.exists():
+        time.sleep(0.05)
+    assert exit_file.exists()
+    # agent 1 "crashes": no shutdown, no kills — tasks keep running
+    del first
+
+    second = LocalProcessAgent(workdir)
+    assert "live-0-main__1" in second.active_task_ids()
+    statuses = second.poll()
+    by_id = {(s.task_id, s.state) for s in statuses}
+    assert ("done-0-main__1", TaskState.FINISHED) in by_id
+    assert ("live-0-main__1", TaskState.RUNNING) in by_id
+    # the recovered live task is still killable
+    second.kill("live-0-main__1", grace_period_s=0.5)
+    wait_for_state(second, "live-0-main__1", TaskState.KILLED)
+    second.shutdown()
+
+
+def test_recovered_exit_reported_exactly_once(tmp_path):
+    workdir = str(tmp_path / "w")
+    first = LocalProcessAgent(workdir)
+    first.launch_one(TaskInfo(
+        name="once-0-main", task_id="once-0-main__1", command="exit 5",
+    ))
+    deadline = time.monotonic() + 10
+    exit_file = (tmp_path / "w" / "once-0-main" / ".super"
+                 / "once-0-main__1" / "exit_status")
+    while time.monotonic() < deadline and not exit_file.exists():
+        time.sleep(0.05)
+    del first
+    second = LocalProcessAgent(workdir)
+    assert any(
+        s.task_id == "once-0-main__1" and s.state is TaskState.FAILED
+        for s in second.poll()
+    )
+    # a third restart must NOT re-report the stale fate
+    third = LocalProcessAgent(workdir)
+    assert not any(
+        s.task_id == "once-0-main__1" for s in third.poll()
+    )
+
+
+def test_relaunch_clears_stale_exit_record(tmp_path):
+    """A new incarnation of the same task name must not be declared
+    dead by its predecessor's exit_status file."""
+    workdir = str(tmp_path / "w")
+    agent = LocalProcessAgent(workdir)
+    agent.launch_one(TaskInfo(
+        name="re-0-main", task_id="re-0-main__1", command="exit 1",
+    ))
+    wait_for_state(agent, "re-0-main__1", TaskState.FAILED)
+    agent.launch_one(TaskInfo(
+        name="re-0-main", task_id="re-0-main__2", command="sleep 10",
+    ))
+    statuses = []
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        statuses.extend(agent.poll())
+        if any(
+            s.task_id == "re-0-main__2" and s.state is TaskState.RUNNING
+            for s in statuses
+        ):
+            break
+        time.sleep(0.05)
+    assert not any(
+        s.task_id == "re-0-main__2" and s.state.is_terminal
+        for s in statuses
+    )
+    agent.shutdown()
+
+
+def test_python_fallback_when_native_disabled(tmp_path):
+    agent = LocalProcessAgent(str(tmp_path / "w"), use_native=False)
+    agent.launch_one(TaskInfo(
+        name="py-0-main", task_id="py-0-main__1",
+        command="echo plain && exit 0",
+    ))
+    wait_for_state(agent, "py-0-main__1", TaskState.FINISHED)
+    assert (tmp_path / "w" / "py-0-main" / "stdout").read_text().strip() \
+        == "plain"
+    agent.shutdown()

@@ -292,3 +292,62 @@ def test_fleet_kill_unknown_owner_broadcasts(daemons):
         )
         time.sleep(0.05)
     assert killed
+
+
+def test_daemon_crash_restart_recovers_tasks(daemons, tmp_path):
+    """A daemon that dies WITHOUT cleanup loses no tasks: the C++
+    supervisor's durable sandbox records (task.json/exit_status) let a
+    fresh daemon over the same workdir resume live tasks and report
+    exited ones' fates over the wire."""
+    workdir = str(tmp_path / "sandbox-crash")
+    first = AgentDaemon("hx", workdir).start()
+    client = RemoteAgentClient("hx", first.url)
+    client.launch([
+        {"info": TaskInfo(
+            name="app-0-long", task_id="app-0-long__1", agent_id="hx",
+            command="sleep 30",
+        ).to_dict()},
+        {"info": TaskInfo(
+            name="app-0-short", task_id="app-0-short__1", agent_id="hx",
+            command="exit 0",
+        ).to_dict()},
+    ])
+    deadline = time.monotonic() + 10
+    exit_file = (tmp_path / "sandbox-crash" / "app-0-short" / ".super"
+                 / "app-0-short__1" / "exit_status")
+    while time.monotonic() < deadline and not exit_file.exists():
+        time.sleep(0.05)
+    assert exit_file.exists()
+    # crash: HTTP server torn down, NO executor shutdown (tasks live)
+    first._server.shutdown()
+    first._server.server_close()
+
+    second = AgentDaemon("hx", workdir).start()
+    try:
+        client2 = RemoteAgentClient("hx", second.url)
+        assert "app-0-long__1" in client2.tasks()
+        states = {}
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            for s in client2.drain():
+                states[(s.task_id, s.state)] = True
+            if (("app-0-short__1", TaskState.FINISHED) in states
+                    and ("app-0-long__1", TaskState.RUNNING) in states):
+                break
+            time.sleep(0.05)
+        assert ("app-0-short__1", TaskState.FINISHED) in states
+        assert ("app-0-long__1", TaskState.RUNNING) in states
+        client2.kill("app-0-long__1", 0.5)
+        deadline = time.monotonic() + 10
+        killed = False
+        while time.monotonic() < deadline:
+            if any(
+                s.task_id == "app-0-long__1" and s.state is TaskState.KILLED
+                for s in client2.drain()
+            ):
+                killed = True
+                break
+            time.sleep(0.05)
+        assert killed
+    finally:
+        second.stop()
