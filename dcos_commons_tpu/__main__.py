@@ -38,9 +38,13 @@ def main(argv=None) -> int:
         from dcos_commons_tpu.storage.remote import main as state_main
 
         return state_main(rest)
+    if command == "package":
+        from dcos_commons_tpu.tools.packaging import main as package_main
+
+        return package_main(rest)
     print(
         f"unknown command {command!r}; "
-        "try serve | agent | cli | state-server",
+        "try serve | agent | cli | state-server | package",
         file=sys.stderr,
     )
     return 1

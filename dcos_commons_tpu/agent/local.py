@@ -169,6 +169,13 @@ class LocalProcessAgent:
         self._undelivered_records: Dict[str, str] = {}
         self._lock = threading.RLock()
         self._use_native = use_native
+        if use_native:
+            # build the supervisor binary NOW, before any lock is ever
+            # held: a first-launch g++ run under the agent lock would
+            # freeze poll()/status delivery for every running task
+            from dcos_commons_tpu.native import task_exec_path
+
+            task_exec_path()
         os.makedirs(workdir, exist_ok=True)
         self._recover_tasks()
 
@@ -231,8 +238,12 @@ class LocalProcessAgent:
                     # success or failure we cannot prove
                     state = TaskState.LOST
                 else:
-                    state = TaskState.FINISHED if code == 0 else (
-                        TaskState.KILLED if code in (128 + 15, 128 + 9)
+                    # signal deaths are FAILED: whether the pre-crash
+                    # agent had requested the kill is unknowable, and
+                    # KILLED (a non-failure state) would wedge a deploy
+                    # step waiting on this task
+                    state = (
+                        TaskState.FINISHED if code == 0
                         else TaskState.FAILED
                     )
                 self._pending.append(TaskStatus(
@@ -572,8 +583,11 @@ class LocalProcessAgent:
                     pass
             if returncode == -1 and not running.kill_requested:
                 state = TaskState.LOST  # fate unknowable
-            elif running.kill_requested or returncode in (128 + 15, 128 + 9):
+            elif running.kill_requested:
                 state = TaskState.KILLED
+                # NOTE: an unrequested signal death (OOM killer,
+                # operator SIGKILL) stays FAILED — KILLED is not a
+                # failure state and would leave a deploy step wedged
             elif returncode == 0:
                 state = TaskState.FINISHED
             else:

@@ -180,16 +180,24 @@ class ApiServer:
                     return 405, {"message": "use GET /v1/multi"}
                 name, _, sub = rest.partition("/")
                 if method == "PUT" and not sub:
-                    # body: service YAML (reference: dynamic add via
+                    # body: service YAML, or a framework package
+                    # tarball (Content-Type: application/gzip — the
+                    # Cosmos install flow; reference: dynamic add via
                     # MultiServiceResource / ServiceStore)
                     length = int(self.headers.get("Content-Length", 0))
-                    raw = self.rfile.read(length).decode("utf-8")
-                    from dcos_commons_tpu.specification.yaml_spec import (
-                        from_yaml,
-                    )
-
+                    body = self.rfile.read(length)
+                    ctype = self.headers.get("Content-Type", "")
                     try:
-                        spec = from_yaml(raw)
+                        if "gzip" in ctype or body[:2] == b"\x1f\x8b":
+                            multi_scheduler.install_package(name, body)
+                            return 200, {
+                                "message": f"package {name} installed"
+                            }
+                        from dcos_commons_tpu.specification.yaml_spec import (
+                            from_yaml,
+                        )
+
+                        spec = from_yaml(body.decode("utf-8"))
                         if spec.name != name:
                             return 400, {
                                 "message": f"spec name {spec.name!r} does "

@@ -143,6 +143,57 @@ class MultiServiceScheduler:
             self.service_store.store(spec.name, spec.to_dict())
             self._services[spec.name] = self._build(spec)
 
+    @property
+    def artifact_base(self):
+        return getattr(self, "_artifact_base", None)
+
+    @artifact_base.setter
+    def artifact_base(self, value) -> None:
+        """Apply to every service, existing AND future: the runner can
+        only learn the URL after the API server starts, which is after
+        seeded/reloaded services were built."""
+        self._artifact_base = value
+        for name, svc in self.services().items():
+            if hasattr(svc, "artifact_base"):
+                svc.artifact_base = (
+                    f"{value.rstrip('/')}/v1/multi/{name}" if value else None
+                )
+
+    def install_package(self, name: str, payload: bytes) -> None:
+        """Install a framework package tarball (the Cosmos flow): the
+        bundle is extracted into this scheduler's packages dir, its
+        svc.yml loads with template paths anchored there, and the
+        service joins the framework.
+
+        Reference: Cosmos rendering a universe package into a running
+        scheduler (tools/universe/ + marathon.json.mustache)."""
+        import os as _os
+        import re as _re
+
+        from dcos_commons_tpu.specification.yaml_spec import from_yaml_file
+        from dcos_commons_tpu.tools.packaging import extract_package
+
+        # the name comes straight off the URL: validate BEFORE it
+        # touches a filesystem path ('..' would extract into state_dir)
+        if not _re.fullmatch(r"[A-Za-z0-9][A-Za-z0-9._-]*", name) or \
+                name in (".", ".."):
+            from dcos_commons_tpu.specification.specs import SpecError
+
+            raise SpecError(f"invalid service name {name!r}")
+        target = _os.path.join(self.config.state_dir, "packages", name)
+        manifest = extract_package(payload, target)
+        spec = from_yaml_file(
+            _os.path.join(target, "svc.yml"), env=dict(_os.environ)
+        )
+        if spec.name != name:
+            from dcos_commons_tpu.specification.specs import SpecError
+
+            raise SpecError(
+                f"package {manifest['name']!r} defines service "
+                f"{spec.name!r}, not {name!r}"
+            )
+        self.add_service(spec)
+
     def uninstall_service(self, name: str) -> None:
         """Flip the service to teardown; it is dropped from the set
         once its uninstall plan completes (reference: uninstall flag +
@@ -185,6 +236,13 @@ class MultiServiceScheduler:
         if self._builder_hook is not None:
             self._builder_hook(builder)
         scheduler = builder.build()
+        # served multi mode: agents pull config templates from the one
+        # shared API server; per-service artifact paths route through
+        # /v1/multi/<name>/v1/artifacts/...
+        base = self.artifact_base
+        scheduler.artifact_base = (
+            f"{base.rstrip('/')}/v1/multi/{spec.name}" if base else None
+        )
         # snapshots must subtract EVERY service's reservations, not
         # just this service's own namespaced ledger
         scheduler.evaluator.set_snapshot_view(_MergedLedgerView(self))

@@ -233,7 +233,11 @@ class FrameworkRunner:
         ).start()
         thread = None
         try:
-            if hasattr(self.scheduler, "artifact_base"):
+            if hasattr(self.scheduler, "artifact_base") and self.agent_urls:
+                # URL-mode template pulls are for REMOTE agents only:
+                # an in-process agent fetching from this scheduler's
+                # own API while the event loop holds its lock would
+                # deadlock — local agents get template content inline
                 self.scheduler.artifact_base = (
                     self.advertise_url.rstrip("/") or self.api_server.url
                 )
@@ -279,6 +283,150 @@ class FrameworkRunner:
             self.scheduler.stop()
 
 
+class MultiFrameworkRunner:
+    """One framework process hosting N services (reference:
+    MultiServiceRunner + Multi*Resource routing).  Services are seeded
+    from svc.yml args and managed dynamically over
+    PUT/DELETE /v1/multi/<name>; the ServiceStore persists the set so
+    restarts reload every service mid-plan."""
+
+    def __init__(
+        self,
+        specs: List,
+        config: Optional[SchedulerConfig] = None,
+        topology_hosts: Optional[List[TpuHost]] = None,
+        agent_urls: Optional[Dict[str, str]] = None,
+        builder_hook=None,
+    ):
+        self.specs = list(specs)
+        self.config = config or SchedulerConfig.from_env()
+        self.topology_hosts = topology_hosts or []
+        self.agent_urls = agent_urls or {}
+        self.builder_hook = builder_hook
+        self.multi = None
+        self.api_server = None
+        self.announce_file: str = ""
+        self.api_bind: str = "127.0.0.1"
+        self.advertise_url: str = ""
+        self._stop_requested = threading.Event()
+        if self.config.state_url:
+            import socket as _socket
+
+            from dcos_commons_tpu.storage.remote import RemoteLocker
+
+            self._lock = RemoteLocker(
+                self.config.state_url,
+                name="multi-scheduler",
+                owner=f"{_socket.gethostname()}-{os.getpid()}",
+                ttl_s=self.config.state_lease_ttl_s,
+            )
+        else:
+            self._lock = InstanceLock(self.config.state_dir)
+
+    def build(self) -> None:
+        from dcos_commons_tpu.multi import MultiServiceScheduler
+        from dcos_commons_tpu.offer.inventory import SliceInventory
+
+        inventory = SliceInventory(self.topology_hosts)
+        if self.agent_urls:
+            from dcos_commons_tpu.agent.remote import RemoteFleet
+
+            fleet = RemoteFleet(
+                on_host_down=inventory.mark_down,
+                on_host_up=inventory.mark_up,
+            )
+            for host_id, url in self.agent_urls.items():
+                fleet.add_host(host_id, url)
+            agent = fleet
+        else:
+            from dcos_commons_tpu.agent.local import LocalProcessAgent
+
+            agent = LocalProcessAgent(self.config.sandbox_root)
+        if self.config.state_url:
+            from dcos_commons_tpu.storage import PersisterCache
+            from dcos_commons_tpu.storage.remote import RemotePersister
+
+            persister = PersisterCache(RemotePersister(self.config.state_url))
+        else:
+            from dcos_commons_tpu.storage import FileWalPersister
+
+            persister = FileWalPersister(self.config.state_dir)
+        self.multi = MultiServiceScheduler(
+            persister=persister,
+            inventory=inventory,
+            agent=agent,
+            scheduler_config=self.config,
+            builder_hook=(
+                (lambda b: self.builder_hook(b, None))
+                if self.builder_hook else None
+            ),
+        )
+        for spec in self.specs:
+            if self.multi.get_service(spec.name) is None:
+                self.multi.add_service(spec)
+
+    def run(self) -> int:
+        if not self._lock.acquire():
+            LOG.error("another scheduler instance holds the lock")
+            return EXIT_LOCKED
+        try:
+            return self._run_locked()
+        finally:
+            self._lock.release()
+
+    def _run_locked(self) -> int:
+        from dcos_commons_tpu.http.server import ApiServer
+
+        try:
+            self.build()
+        except Exception:
+            LOG.exception("invalid configuration")
+            return EXIT_BAD_CONFIG
+        self.api_server = ApiServer(
+            port=self.config.api_port, host=self.api_bind, multi=self.multi
+        ).start()
+        thread = None
+        try:
+            if self.agent_urls:
+                # see FrameworkRunner: URL-mode templates only for
+                # remote fleets; local agents take content inline
+                self.multi.artifact_base = (
+                    self.advertise_url.rstrip("/") or self.api_server.url
+                )
+            if self.announce_file:
+                from dcos_commons_tpu.common import atomic_write_text
+
+                atomic_write_text(
+                    self.announce_file, self.api_server.url + "\n"
+                )
+            LOG.info(
+                "serving %d services on %s (%d hosts)",
+                len(self.multi.service_names()),
+                self.api_server.url,
+                len(self.topology_hosts),
+            )
+            thread = self.multi.run_forever()
+            try:
+                while thread.is_alive() and not self._stop_requested.is_set():
+                    thread.join(timeout=0.5)
+            except KeyboardInterrupt:
+                pass
+        finally:
+            self.multi.stop()
+            if thread is not None:
+                thread.join(timeout=10)
+            self.api_server.stop()
+        if getattr(self.multi, "fatal_error", None):
+            LOG.critical("multi scheduler wedged: %s", self.multi.fatal_error)
+            return EXIT_WEDGED
+        return 0
+
+    def stop(self) -> None:
+        self._stop_requested.set()
+        if self.multi is not None:
+            self.multi.stop()
+
+
 def serve_main(argv: Optional[List[str]] = None, builder_hook=None) -> int:
     """``python -m dcos_commons_tpu serve`` argument handling."""
     import argparse
@@ -289,9 +437,20 @@ def serve_main(argv: Optional[List[str]] = None, builder_hook=None) -> int:
         prog="dcos_commons_tpu serve",
         description="Run a service scheduler process",
     )
-    parser.add_argument("svc_yml", help="service definition YAML")
+    parser.add_argument(
+        "svc_yml",
+        nargs="*",
+        help="service definition YAML(s); exactly one unless --multi",
+    )
     parser.add_argument(
         "--topology", required=True, help="fleet topology YAML (hosts)"
+    )
+    parser.add_argument(
+        "--multi",
+        action="store_true",
+        help="host MANY services in one framework process; services are "
+             "seeded from svc_yml args and managed dynamically over "
+             "PUT/DELETE /v1/multi/<name>",
     )
     parser.add_argument("--port", type=int, default=None, help="API port")
     parser.add_argument("--state-dir", default=None)
@@ -352,15 +511,25 @@ def serve_main(argv: Optional[List[str]] = None, builder_hook=None) -> int:
     if args.sandbox_root is not None:
         config.sandbox_root = args.sandbox_root
     try:
-        spec = from_yaml_file(args.svc_yml, env)
+        if not args.multi and len(args.svc_yml) != 1:
+            raise ValueError(
+                "exactly one svc.yml required (or pass --multi)"
+            )
+        specs = [from_yaml_file(path, env) for path in args.svc_yml]
         hosts, urls = load_topology(args.topology)
     except Exception as e:
         print(f"configuration error: {e}", file=sys.stderr)
         return EXIT_BAD_CONFIG
-    runner = FrameworkRunner(
-        spec, config, topology_hosts=hosts, agent_urls=urls,
-        builder_hook=builder_hook,
-    )
+    if args.multi:
+        runner = MultiFrameworkRunner(
+            specs, config, topology_hosts=hosts, agent_urls=urls,
+            builder_hook=builder_hook,
+        )
+    else:
+        runner = FrameworkRunner(
+            specs[0], config, topology_hosts=hosts, agent_urls=urls,
+            builder_hook=builder_hook,
+        )
     runner.announce_file = args.announce_file
     runner.api_bind = args.bind
     runner.advertise_url = args.advertise_url

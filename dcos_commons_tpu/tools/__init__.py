@@ -1,0 +1,24 @@
+"""Operator tooling: packaging and distribution.
+
+Reference: tools/universe/ (package_builder.py / package_manager.py /
+package_publisher.py) + the Cosmos install flow — a framework is
+bundled (svc.yml + templates + scripts + manifest), published to a
+catalog, and installed by name.  TPU-first shape: the package tarball
+travels TO the scheduler (PUT /v1/multi/<name> with a gzip body), which
+extracts it into its packages dir and serves the bundled config
+templates itself — no external catalog service required.
+"""
+
+from dcos_commons_tpu.tools.packaging import (
+    PackageError,
+    build_package,
+    extract_package,
+    read_manifest,
+)
+
+__all__ = [
+    "PackageError",
+    "build_package",
+    "extract_package",
+    "read_manifest",
+]

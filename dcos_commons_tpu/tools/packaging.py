@@ -1,0 +1,197 @@
+"""Framework packages: bundle, verify, extract, install.
+
+Reference: tools/universe/package_builder.py (manifest + artifact
+bundling) and the Cosmos install flow (frameworks/*/universe/
+package.json + resource.json).  A package is a tar.gz of one framework
+directory with a generated ``package.json`` manifest carrying name,
+version, and per-file SHA-256 digests; extraction verifies every
+digest and confines members to the target directory.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import tarfile
+from typing import Dict, Optional
+
+MANIFEST_NAME = "package.json"
+
+
+class PackageError(Exception):
+    pass
+
+
+def _sha256(path: str) -> str:
+    digest = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(65536), b""):
+            digest.update(chunk)
+    return digest.hexdigest()
+
+
+def build_package(
+    framework_dir: str,
+    out_path: str,
+    name: str = "",
+    version: str = "0.1.0",
+    description: str = "",
+) -> Dict:
+    """Bundle ``framework_dir`` (must contain svc.yml) into a tar.gz
+    with a digest manifest; returns the manifest."""
+    framework_dir = os.path.abspath(framework_dir)
+    svc = os.path.join(framework_dir, "svc.yml")
+    if not os.path.isfile(svc):
+        raise PackageError(f"{framework_dir} has no svc.yml")
+    if not name:
+        name = os.path.basename(framework_dir.rstrip(os.sep))
+    files: Dict[str, str] = {}
+    for root, _dirs, filenames in os.walk(framework_dir):
+        for filename in sorted(filenames):
+            path = os.path.join(root, filename)
+            rel = os.path.relpath(path, framework_dir)
+            if rel == MANIFEST_NAME or "__pycache__" in rel:
+                continue
+            files[rel] = _sha256(path)
+    manifest = {
+        "name": name,
+        "version": version,
+        "description": description,
+        "files": files,
+    }
+    os.makedirs(os.path.dirname(os.path.abspath(out_path)), exist_ok=True)
+    with tarfile.open(out_path, "w:gz") as tar:
+        payload = json.dumps(manifest, indent=2).encode("utf-8")
+        member = tarfile.TarInfo(MANIFEST_NAME)
+        member.size = len(payload)
+        tar.addfile(member, io.BytesIO(payload))
+        for rel in sorted(files):
+            tar.add(os.path.join(framework_dir, rel), arcname=rel)
+    return manifest
+
+
+def read_manifest(package_path: str) -> Dict:
+    with tarfile.open(package_path, "r:gz") as tar:
+        member = tar.extractfile(MANIFEST_NAME)
+        if member is None:
+            raise PackageError(f"{package_path}: no {MANIFEST_NAME}")
+        return json.loads(member.read().decode("utf-8"))
+
+
+def extract_package(package_bytes: bytes, target_dir: str) -> Dict:
+    """Extract a package into ``target_dir``, verifying the manifest
+    digests and rejecting members that would escape the directory.
+
+    Returns the manifest.  Reference: Cosmos unpacking a universe
+    package before handing the scheduler its config."""
+    os.makedirs(target_dir, exist_ok=True)
+    # realpath on BOTH sides: a symlinked target dir must not make
+    # every member look like an escape
+    target_dir = os.path.realpath(target_dir)
+    try:
+        tar = tarfile.open(fileobj=io.BytesIO(package_bytes), mode="r:gz")
+    except tarfile.TarError as e:
+        raise PackageError(f"not a package tarball: {e}")
+    with tar:
+        try:
+            manifest_member = tar.extractfile(MANIFEST_NAME)
+            if manifest_member is None:
+                raise KeyError(MANIFEST_NAME)
+            manifest = json.loads(manifest_member.read().decode("utf-8"))
+        except (KeyError, ValueError) as e:
+            raise PackageError(f"bad package manifest: {e}")
+        for member in tar.getmembers():
+            if member.name == MANIFEST_NAME:
+                continue
+            if not member.isfile():
+                raise PackageError(
+                    f"package member {member.name!r} is not a regular file"
+                )
+            dest = os.path.realpath(os.path.join(target_dir, member.name))
+            if not dest.startswith(target_dir + os.sep):
+                raise PackageError(
+                    f"package member escapes target: {member.name!r}"
+                )
+            expected = manifest.get("files", {}).get(member.name)
+            if expected is None:
+                raise PackageError(
+                    f"package member not in manifest: {member.name!r}"
+                )
+            data = tar.extractfile(member).read()
+            actual = hashlib.sha256(data).hexdigest()
+            if actual != expected:
+                raise PackageError(
+                    f"digest mismatch for {member.name!r}"
+                )
+            os.makedirs(os.path.dirname(dest), exist_ok=True)
+            with open(dest, "wb") as f:
+                f.write(data)
+    if "svc.yml" not in manifest.get("files", {}):
+        raise PackageError("package has no svc.yml")
+    return manifest
+
+
+def main(argv: Optional[list] = None) -> int:
+    """``python -m dcos_commons_tpu package`` — build/inspect/install."""
+    import argparse
+    import sys
+    import urllib.request
+
+    parser = argparse.ArgumentParser(prog="dcos_commons_tpu package")
+    sub = parser.add_subparsers(dest="verb", required=True)
+    p = sub.add_parser("build")
+    p.add_argument("framework_dir")
+    p.add_argument("-o", "--out", required=True)
+    p.add_argument("--name", default="")
+    p.add_argument("--version", default="0.1.0")
+    p.add_argument("--description", default="")
+    p = sub.add_parser("inspect")
+    p.add_argument("package")
+    p = sub.add_parser("install")
+    p.add_argument("package")
+    p.add_argument(
+        "--url", required=True, help="multi scheduler API URL"
+    )
+    p.add_argument(
+        "--name", default="",
+        help="service name (default: manifest name)",
+    )
+    args = parser.parse_args(argv)
+
+    if args.verb == "build":
+        manifest = build_package(
+            args.framework_dir, args.out,
+            name=args.name, version=args.version,
+            description=args.description,
+        )
+        print(json.dumps(
+            {k: manifest[k] for k in ("name", "version")}
+            | {"files": len(manifest["files"]), "out": args.out}
+        ))
+        return 0
+    if args.verb == "inspect":
+        print(json.dumps(read_manifest(args.package), indent=2))
+        return 0
+    # install: the tarball travels to the scheduler (Cosmos analogue)
+    with open(args.package, "rb") as f:
+        payload = f.read()
+    name = args.name or read_manifest(args.package)["name"]
+    req = urllib.request.Request(
+        f"{args.url.rstrip('/')}/v1/multi/{name}",
+        data=payload,
+        method="PUT",
+        headers={"Content-Type": "application/gzip"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            print(resp.read().decode("utf-8"))
+    except urllib.error.HTTPError as e:
+        print(e.read().decode("utf-8"), file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

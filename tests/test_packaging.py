@@ -1,0 +1,227 @@
+"""Framework packaging: build -> inspect -> install (the Cosmos flow).
+
+Reference: tools/universe/package_builder.py + Cosmos install;
+frameworks/*/universe/ manifests.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tarfile
+import time
+import urllib.request
+
+import pytest
+
+from dcos_commons_tpu.common import TaskState, TaskStatus
+from dcos_commons_tpu.multi import MultiServiceScheduler
+from dcos_commons_tpu.offer.inventory import SliceInventory, TpuHost
+from dcos_commons_tpu.scheduler import SchedulerConfig
+from dcos_commons_tpu.storage import MemPersister
+from dcos_commons_tpu.testing import FakeAgent
+from dcos_commons_tpu.tools import (
+    PackageError,
+    build_package,
+    extract_package,
+    read_manifest,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def make_framework(tmp_path, name="pkgsvc"):
+    d = tmp_path / name
+    d.mkdir()
+    (d / "svc.yml").write_text(f"""
+name: {name}
+pods:
+  app:
+    count: 1
+    tasks:
+      main:
+        goal: RUNNING
+        cmd: "cat app.cfg && sleep 100"
+        cpus: 0.1
+        memory: 32
+        configs:
+          cfg:
+            template: app.cfg.mustache
+            dest: app.cfg
+""")
+    (d / "app.cfg.mustache").write_text("task={{TASK_NAME}}\n")
+    return str(d)
+
+
+def test_build_inspect_roundtrip(tmp_path):
+    framework = make_framework(tmp_path)
+    out = str(tmp_path / "pkgsvc.tgz")
+    manifest = build_package(framework, out, version="1.2.3")
+    assert manifest["name"] == "pkgsvc"
+    assert set(manifest["files"]) == {"svc.yml", "app.cfg.mustache"}
+    assert read_manifest(out)["version"] == "1.2.3"
+
+
+def test_extract_verifies_digests(tmp_path):
+    framework = make_framework(tmp_path)
+    out = str(tmp_path / "pkgsvc.tgz")
+    build_package(framework, out)
+    with open(out, "rb") as f:
+        payload = f.read()
+    manifest = extract_package(payload, str(tmp_path / "x"))
+    assert (tmp_path / "x" / "svc.yml").exists()
+    assert manifest["files"]
+
+    # corrupt a member: digest mismatch must reject the package
+    bad_dir = tmp_path / "bad"
+    bad_dir.mkdir()
+    with tarfile.open(out, "r:gz") as tar:
+        tar.extractall(bad_dir, filter="data")
+    (bad_dir / "svc.yml").write_text("name: tampered\npods: {}\n")
+    bad_out = str(tmp_path / "bad.tgz")
+    with tarfile.open(bad_out, "w:gz") as tar:
+        for name in ("package.json", "svc.yml", "app.cfg.mustache"):
+            tar.add(str(bad_dir / name), arcname=name)
+    with open(bad_out, "rb") as f:
+        bad_payload = f.read()
+    with pytest.raises(PackageError, match="digest"):
+        extract_package(bad_payload, str(tmp_path / "y"))
+
+
+def test_extract_rejects_traversal(tmp_path):
+    import io
+
+    evil = io.BytesIO()
+    with tarfile.open(fileobj=evil, mode="w:gz") as tar:
+        manifest = json.dumps(
+            {"name": "evil", "files": {"../escape": "0" * 64,
+                                       "svc.yml": "0" * 64}}
+        ).encode()
+        member = tarfile.TarInfo("package.json")
+        member.size = len(manifest)
+        tar.addfile(member, io.BytesIO(manifest))
+        data = b"pwned"
+        member = tarfile.TarInfo("../escape")
+        member.size = len(data)
+        tar.addfile(member, io.BytesIO(data))
+    with pytest.raises(PackageError, match="escape"):
+        extract_package(evil.getvalue(), str(tmp_path / "t"))
+    assert not (tmp_path / "escape").exists()
+
+
+def test_install_package_into_multi_scheduler(tmp_path):
+    framework = make_framework(tmp_path)
+    out = str(tmp_path / "pkgsvc.tgz")
+    build_package(framework, out)
+    multi = MultiServiceScheduler(
+        persister=MemPersister(),
+        inventory=SliceInventory([TpuHost(host_id="h0")]),
+        agent=FakeAgent(),
+        scheduler_config=SchedulerConfig(
+            backoff_enabled=False,
+            revive_capacity=1_000_000,
+            state_dir=str(tmp_path / "state"),
+        ),
+    )
+    with open(out, "rb") as f:
+        multi.install_package("pkgsvc", f.read())
+    assert "pkgsvc" in multi.service_names()
+    # the packaged template resolved to the extracted location
+    svc = multi.get_service("pkgsvc")
+    template_path = svc.spec.pod("app").task("main").config_templates[0][0]
+    assert template_path.startswith(str(tmp_path / "state"))
+    assert os.path.isfile(template_path)
+    multi.run_cycle()
+    agent = multi.agent
+    assert agent.task_id_of("app-0-main") is not None
+    agent.send(TaskStatus(
+        task_id=agent.task_id_of("app-0-main"),
+        state=TaskState.RUNNING, ready=True,
+    ))
+    multi.run_cycle()
+    assert svc.deploy_manager.get_plan().is_complete
+
+
+def test_package_cli_build_and_wire_install(tmp_path):
+    """CLI build + install against a served --multi scheduler, with
+    the packaged config template rendered into the task sandbox."""
+    framework = make_framework(tmp_path)
+    out = str(tmp_path / "pkgsvc.tgz")
+    built = subprocess.run(
+        [sys.executable, "-m", "dcos_commons_tpu", "package", "build",
+         framework, "-o", out],
+        cwd=REPO, capture_output=True, text=True, timeout=60,
+    )
+    assert built.returncode == 0, built.stderr
+    topology = tmp_path / "topology.yml"
+    topology.write_text(
+        "hosts:\n  - host_id: h0\n    cpus: 8\n    memory_mb: 8192\n"
+    )
+    announce = tmp_path / "announce"
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "dcos_commons_tpu", "serve", "--multi",
+            "--topology", str(topology),
+            "--port", "0",
+            "--state-dir", str(tmp_path / "state"),
+            "--sandbox-root", str(tmp_path / "sbx"),
+            "--announce-file", str(announce),
+        ],
+        cwd=REPO,
+    )
+    try:
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not announce.exists():
+            time.sleep(0.1)
+        url = announce.read_text().strip()
+        installed = subprocess.run(
+            [sys.executable, "-m", "dcos_commons_tpu", "package",
+             "install", out, "--url", url],
+            cwd=REPO, capture_output=True, text=True, timeout=60,
+        )
+        assert installed.returncode == 0, installed.stderr
+
+        def get(path):
+            with urllib.request.urlopen(url + path, timeout=5) as r:
+                return json.loads(r.read())
+
+        deadline = time.monotonic() + 60
+        done = False
+        while time.monotonic() < deadline:
+            try:
+                if get("/v1/multi/pkgsvc/v1/plans/deploy")["status"] == \
+                        "COMPLETE":
+                    done = True
+                    break
+            except Exception:
+                pass
+            time.sleep(0.3)
+        assert done
+        rendered = tmp_path / "sbx" / "app-0-main" / "app.cfg"
+        assert rendered.read_text().strip() == "task=app-0-main"
+    finally:
+        proc.terminate()
+        proc.wait(timeout=20)
+
+
+def test_install_rejects_traversal_service_name(tmp_path):
+    multi = MultiServiceScheduler(
+        persister=MemPersister(),
+        inventory=SliceInventory([TpuHost(host_id="h0")]),
+        agent=FakeAgent(),
+        scheduler_config=SchedulerConfig(
+            state_dir=str(tmp_path / "state")
+        ),
+    )
+    from dcos_commons_tpu.specification.specs import SpecError
+
+    framework = make_framework(tmp_path, name="okpkg")
+    out = str(tmp_path / "okpkg.tgz")
+    build_package(framework, out)
+    with open(out, "rb") as f:
+        payload = f.read()
+    for bad in ("..", ".", "a/b", "", ".hidden"):
+        with pytest.raises(SpecError):
+            multi.install_package(bad, payload)
+    # nothing leaked outside the packages dir
+    assert not (tmp_path / "state" / "svc.yml").exists()

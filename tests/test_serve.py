@@ -250,3 +250,116 @@ def test_scheduler_failover_over_state_server(cluster, tmp_path):
     finally:
         state.terminate()
         state.wait(timeout=10)
+
+
+def test_multi_serve_dynamic_services(cluster, tmp_path):
+    """serve --multi end to end: two seeded services deploy, a third is
+    added dynamically over PUT /v1/multi/<name>, one is uninstalled
+    over DELETE, and a restart reloads the surviving set from the
+    ServiceStore."""
+    import urllib.request
+
+    svc_b = tmp_path / "svc-b.yml"
+    svc_b.write_text(SVC_YAML.replace("webfarm", "second"))
+    workdir = str(tmp_path / "multi")
+    os.makedirs(workdir, exist_ok=True)
+    announce = os.path.join(workdir, "announce")
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "dcos_commons_tpu", "serve",
+            "--multi", cluster["svc"], str(svc_b),
+            "--topology", cluster["topology"],
+            "--port", "0",
+            "--state-dir", os.path.join(workdir, "state"),
+            "--sandbox-root", os.path.join(workdir, "sandboxes"),
+            "--announce-file", announce,
+        ],
+        cwd=REPO,
+    )
+    try:
+        url = wait_for(
+            lambda: (
+                open(announce).read().strip()
+                if os.path.exists(announce) else None
+            ),
+            30.0, what="multi announce",
+        )
+
+        def get(path):
+            import json as _json
+
+            with urllib.request.urlopen(url + path, timeout=5) as r:
+                return _json.loads(r.read())
+
+        def wait_deployed(name):
+            def check():
+                # after a restart the rollout plan is named 'update'
+                for plan in ("deploy", "update"):
+                    try:
+                        body = get(f"/v1/multi/{name}/v1/plans/{plan}")
+                    except Exception:
+                        continue
+                    if body["status"] == "COMPLETE":
+                        return True
+                return None
+
+            wait_for(check, 60.0, what=f"{name} deployed")
+
+        assert set(get("/v1/multi")) == {"webfarm", "second"}
+        wait_deployed("webfarm")
+        wait_deployed("second")
+
+        # dynamic add over the wire
+        third = SVC_YAML.replace("webfarm", "third").encode()
+        req = urllib.request.Request(
+            url + "/v1/multi/third", data=third, method="PUT"
+        )
+        with urllib.request.urlopen(req, timeout=5) as r:
+            assert r.status == 200
+        wait_deployed("third")
+
+        # uninstall one; others untouched
+        req = urllib.request.Request(
+            url + "/v1/multi/second", method="DELETE"
+        )
+        with urllib.request.urlopen(req, timeout=5) as r:
+            assert r.status == 200
+        wait_for(
+            lambda: ("second" not in get("/v1/multi")) or None,
+            60.0, what="second removed",
+        )
+        assert get("/v1/multi/webfarm/v1/plans/deploy")["status"] == \
+            "COMPLETE"
+
+        # restart: the ServiceStore reloads the surviving services
+        proc.terminate()
+        assert proc.wait(timeout=20) == 0
+        os.remove(announce)
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "dcos_commons_tpu", "serve",
+                "--multi",
+                "--topology", cluster["topology"],
+                "--port", "0",
+                "--state-dir", os.path.join(workdir, "state"),
+                "--sandbox-root", os.path.join(workdir, "sandboxes"),
+                "--announce-file", announce,
+            ],
+            cwd=REPO,
+        )
+        url = wait_for(
+            lambda: (
+                open(announce).read().strip()
+                if os.path.exists(announce) else None
+            ),
+            30.0, what="multi announce after restart",
+        )
+        wait_for(
+            lambda: set(get("/v1/multi")) == {"webfarm", "third"} or None,
+            30.0, what="services reloaded",
+        )
+        wait_deployed("webfarm")
+        wait_deployed("third")
+    finally:
+        proc.terminate()
+        proc.wait(timeout=20)
