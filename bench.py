@@ -136,7 +136,7 @@ def bench_deploy() -> dict:
         os.path.join(REPO, "frameworks/jax/svc_mnist.yml"),
         {
             "JAX_FRAMEWORK_DIR": os.path.join(REPO, "frameworks/jax"),
-            "TRAIN_STEPS": os.environ.get("BENCH_MNIST_STEPS", "40"),
+            "TRAIN_STEPS": os.environ.get("BENCH_MNIST_STEPS", "30"),
         },
         [host],
     )
@@ -167,8 +167,8 @@ def bench_transformer() -> dict:
     from dcos_commons_tpu.utils import param_count, synthetic_tokens
 
     # chip-scale flagship (v5e, 16 GB): 872M params fills the MXU;
-    # full-layer remat + FA2 backward kernels + 512/256 attention tiles
-    # measured best in the round-2 block sweep
+    # full-layer remat + FA2 backward kernels + 1024/512 attention
+    # tiles measured best in the round-2 block sweeps
     config = TransformerConfig(
         vocab=32768,
         d_model=2048,
@@ -179,8 +179,8 @@ def bench_transformer() -> dict:
         max_seq=2048,
         dtype=jnp.bfloat16,
         remat=True,
-        attn_block_q=512,
-        attn_block_k=256,
+        attn_block_q=1024,
+        attn_block_k=512,
     )
     batch = int(os.environ.get("BENCH_BATCH", "16"))
     steps = int(os.environ.get("BENCH_STEPS", "30"))

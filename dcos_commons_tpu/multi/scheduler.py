@@ -173,25 +173,41 @@ class MultiServiceScheduler:
         from dcos_commons_tpu.specification.yaml_spec import from_yaml_file
         from dcos_commons_tpu.tools.packaging import extract_package
 
+        import shutil as _shutil
+
+        from dcos_commons_tpu.specification.specs import SpecError
+
         # the name comes straight off the URL: validate BEFORE it
         # touches a filesystem path ('..' would extract into state_dir)
         if not _re.fullmatch(r"[A-Za-z0-9][A-Za-z0-9._-]*", name) or \
                 name in (".", ".."):
-            from dcos_commons_tpu.specification.specs import SpecError
-
             raise SpecError(f"invalid service name {name!r}")
-        target = _os.path.join(self.config.state_dir, "packages", name)
-        manifest = extract_package(payload, target)
+        if self.get_service(name) is not None:
+            raise SpecError(f"service {name!r} already exists")
+        # stage the extraction: a rejected install must never clobber a
+        # running service's on-disk templates (launches read them)
+        packages_root = _os.path.join(self.config.state_dir, "packages")
+        staging = _os.path.join(packages_root, f".staging-{name}")
+        _shutil.rmtree(staging, ignore_errors=True)
+        try:
+            manifest = extract_package(payload, staging)
+            spec = from_yaml_file(
+                _os.path.join(staging, "svc.yml"), env=dict(_os.environ)
+            )
+            if spec.name != name:
+                raise SpecError(
+                    f"package {manifest['name']!r} defines service "
+                    f"{spec.name!r}, not {name!r}"
+                )
+            target = _os.path.join(packages_root, name)
+            _shutil.rmtree(target, ignore_errors=True)
+            _os.replace(staging, target)
+        finally:
+            _shutil.rmtree(staging, ignore_errors=True)
+        # re-anchor template paths in the final location
         spec = from_yaml_file(
             _os.path.join(target, "svc.yml"), env=dict(_os.environ)
         )
-        if spec.name != name:
-            from dcos_commons_tpu.specification.specs import SpecError
-
-            raise SpecError(
-                f"package {manifest['name']!r} defines service "
-                f"{spec.name!r}, not {name!r}"
-            )
         self.add_service(spec)
 
     def uninstall_service(self, name: str) -> None:

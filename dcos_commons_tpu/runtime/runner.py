@@ -74,6 +74,24 @@ class InstanceLock:
             self._fd = None
 
 
+def make_instance_lock(config: SchedulerConfig, name: str):
+    """One active scheduler per service: a TTL lease on the state
+    server when remote state is configured (failover-capable), else a
+    per-host file lock (reference: CuratorLocker vs local mutex)."""
+    if config.state_url:
+        import socket as _socket
+
+        from dcos_commons_tpu.storage.remote import RemoteLocker
+
+        return RemoteLocker(
+            config.state_url,
+            name=name,
+            owner=f"{_socket.gethostname()}-{os.getpid()}",
+            ttl_s=config.state_lease_ttl_s,
+        )
+    return InstanceLock(config.state_dir)
+
+
 def load_topology(path: str) -> Tuple[List[TpuHost], Dict[str, str]]:
     """Parse a fleet topology YAML into hosts + agent-daemon URLs.
 
@@ -147,23 +165,9 @@ class FrameworkRunner:
         # hook(builder, spec): framework-specific wiring (recovery
         # overriders, plan customizers) — the Main.java analogue
         self.builder_hook = builder_hook
-        if self.config.state_url:
-            # remote state => remote lease (CuratorLocker analogue): a
-            # per-host file lock cannot exclude a standby on another
-            # host, and lease expiry is what makes failover automatic
-            import os as _os
-            import socket as _socket
-
-            from dcos_commons_tpu.storage.remote import RemoteLocker
-
-            self._lock = RemoteLocker(
-                self.config.state_url,
-                name=f"scheduler-{spec.name}",
-                owner=f"{_socket.gethostname()}-{_os.getpid()}",
-                ttl_s=self.config.state_lease_ttl_s,
-            )
-        else:
-            self._lock = InstanceLock(self.config.state_dir)
+        self._lock = make_instance_lock(
+            self.config, f"scheduler-{spec.name}"
+        )
         self.scheduler = None
         self.api_server = None
         self.fleet = None
@@ -309,19 +313,7 @@ class MultiFrameworkRunner:
         self.api_bind: str = "127.0.0.1"
         self.advertise_url: str = ""
         self._stop_requested = threading.Event()
-        if self.config.state_url:
-            import socket as _socket
-
-            from dcos_commons_tpu.storage.remote import RemoteLocker
-
-            self._lock = RemoteLocker(
-                self.config.state_url,
-                name="multi-scheduler",
-                owner=f"{_socket.gethostname()}-{os.getpid()}",
-                ttl_s=self.config.state_lease_ttl_s,
-            )
-        else:
-            self._lock = InstanceLock(self.config.state_dir)
+        self._lock = make_instance_lock(self.config, "multi-scheduler")
 
     def build(self) -> None:
         from dcos_commons_tpu.multi import MultiServiceScheduler

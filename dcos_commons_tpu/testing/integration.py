@@ -210,6 +210,11 @@ class SchedulerProcess:
         extra_args: Optional[List[str]] = None,
     ):
         self.workdir = workdir
+        self._svc_yml = svc_yml
+        self._topology_yml = topology_yml
+        self._env = dict(env or {})
+        self._repo_root = repo_root
+        self._extra_args = list(extra_args or [])
         announce = os.path.join(workdir, "announce")
         os.makedirs(workdir, exist_ok=True)
         if os.path.exists(announce):
@@ -249,6 +254,43 @@ class SchedulerProcess:
         finally:
             if not self._log.closed:
                 self._log.close()
+
+    def upgrade(
+        self,
+        svc_yml: Optional[str] = None,
+        env: Optional[Dict[str, str]] = None,
+        timeout_s: float = 90.0,
+    ) -> "SchedulerProcess":
+        """The sdk_upgrade analogue: stop this scheduler, start a new
+        one over the SAME state with a changed service definition or
+        env, and wait for the resulting update plan to complete.
+
+        Returns the new SchedulerProcess (self is terminated).
+        Reference: testing/sdk_upgrade.py — bump the package/options,
+        wait_for_completed_deployment."""
+        assert self.terminate() == 0, self.log_tail()
+        successor = SchedulerProcess(
+            svc_yml or self._svc_yml,
+            self._topology_yml,
+            self.workdir,
+            env={**(self._env or {}), **(env or {})},
+            repo_root=self._repo_root,
+            extra_args=self._extra_args,
+        )
+        client = successor.client()
+
+        def rolled_out():
+            # rollout after a completed deployment is the 'update' plan
+            for plan in ("update", "deploy"):
+                try:
+                    if client.plan_status(plan) == "COMPLETE":
+                        return True
+                except CliError:
+                    continue
+            return None
+
+        wait_for(rolled_out, timeout_s, what="post-upgrade rollout")
+        return successor
 
     def log_tail(self, lines: int = 40) -> str:
         path = os.path.join(self.workdir, "scheduler.log")

@@ -63,21 +63,31 @@ def build_package(
     }
     os.makedirs(os.path.dirname(os.path.abspath(out_path)), exist_ok=True)
     with tarfile.open(out_path, "w:gz") as tar:
-        payload = json.dumps(manifest, indent=2).encode("utf-8")
-        member = tarfile.TarInfo(MANIFEST_NAME)
-        member.size = len(payload)
-        tar.addfile(member, io.BytesIO(payload))
+        def add_bytes(name: str, payload: bytes) -> None:
+            member = tarfile.TarInfo(name)
+            member.size = len(payload)
+            tar.addfile(member, io.BytesIO(payload))
+
+        add_bytes(
+            MANIFEST_NAME, json.dumps(manifest, indent=2).encode("utf-8")
+        )
         for rel in sorted(files):
-            tar.add(os.path.join(framework_dir, rel), arcname=rel)
+            # add by CONTENT: a symlinked template becomes a regular
+            # file in the package (extract rejects link members)
+            with open(os.path.join(framework_dir, rel), "rb") as f:
+                add_bytes(rel, f.read())
     return manifest
 
 
 def read_manifest(package_path: str) -> Dict:
-    with tarfile.open(package_path, "r:gz") as tar:
-        member = tar.extractfile(MANIFEST_NAME)
-        if member is None:
-            raise PackageError(f"{package_path}: no {MANIFEST_NAME}")
-        return json.loads(member.read().decode("utf-8"))
+    try:
+        with tarfile.open(package_path, "r:gz") as tar:
+            member = tar.extractfile(MANIFEST_NAME)
+            if member is None:
+                raise PackageError(f"{package_path}: no {MANIFEST_NAME}")
+            return json.loads(member.read().decode("utf-8"))
+    except (tarfile.TarError, KeyError, ValueError) as e:
+        raise PackageError(f"{package_path}: not a package: {e}")
 
 
 def extract_package(package_bytes: bytes, target_dir: str) -> Dict:
@@ -102,6 +112,7 @@ def extract_package(package_bytes: bytes, target_dir: str) -> Dict:
             manifest = json.loads(manifest_member.read().decode("utf-8"))
         except (KeyError, ValueError) as e:
             raise PackageError(f"bad package manifest: {e}")
+        extracted = set()
         for member in tar.getmembers():
             if member.name == MANIFEST_NAME:
                 continue
@@ -128,6 +139,12 @@ def extract_package(package_bytes: bytes, target_dir: str) -> Dict:
             os.makedirs(os.path.dirname(dest), exist_ok=True)
             with open(dest, "wb") as f:
                 f.write(data)
+            extracted.add(member.name)
+    missing = set(manifest.get("files", {})) - extracted
+    if missing:
+        # a truncated archive must fail NOW, not at task launch when a
+        # template turns out to be absent
+        raise PackageError(f"package missing manifest files: {sorted(missing)}")
     if "svc.yml" not in manifest.get("files", {}):
         raise PackageError("package has no svc.yml")
     return manifest
@@ -160,6 +177,18 @@ def main(argv: Optional[list] = None) -> int:
     )
     args = parser.parse_args(argv)
 
+    try:
+        return _run_verb(args)
+    except PackageError as e:
+        print(f"package error: {e}", file=sys.stderr)
+        return 1
+
+
+def _run_verb(args) -> int:
+    import json
+    import sys
+    import urllib.request
+
     if args.verb == "build":
         manifest = build_package(
             args.framework_dir, args.out,
@@ -189,6 +218,9 @@ def main(argv: Optional[list] = None) -> int:
             print(resp.read().decode("utf-8"))
     except urllib.error.HTTPError as e:
         print(e.read().decode("utf-8"), file=sys.stderr)
+        return 1
+    except urllib.error.URLError as e:
+        print(f"scheduler unreachable at {args.url}: {e}", file=sys.stderr)
         return 1
     return 0
 

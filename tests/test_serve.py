@@ -363,3 +363,27 @@ def test_multi_serve_dynamic_services(cluster, tmp_path):
     finally:
         proc.terminate()
         proc.wait(timeout=20)
+
+
+def test_upgrade_rolls_config_change_across_processes(cluster, tmp_path):
+    """The sdk_upgrade analogue: a TASKCFG env change on the scheduler
+    process rolls every affected task to a new incarnation, across
+    real processes, without touching unaffected state."""
+    scheduler = SchedulerProcess(
+        cluster["svc"], cluster["topology"], str(tmp_path / "sched"),
+        env={"TASKCFG_APP_MODE": "v1"},
+        repo_root=REPO,
+    )
+    client = scheduler.client()
+    client.wait_for_completed_deployment(timeout_s=60)
+    before = client.task_ids()
+
+    scheduler = scheduler.upgrade(env={"TASKCFG_APP_MODE": "v2"})
+    try:
+        client = scheduler.client()
+        after = client.wait_for_tasks_updated(before, timeout_s=90)
+        assert set(after) == set(before)
+        infos = client.get("/v1/pod/app-0/info")
+        assert infos[0]["env"]["MODE"] == "v2"
+    finally:
+        assert scheduler.terminate() == 0, scheduler.log_tail()
