@@ -182,33 +182,39 @@ class MultiServiceScheduler:
         if not _re.fullmatch(r"[A-Za-z0-9][A-Za-z0-9._-]*", name) or \
                 name in (".", ".."):
             raise SpecError(f"invalid service name {name!r}")
-        if self.get_service(name) is not None:
-            raise SpecError(f"service {name!r} already exists")
-        # stage the extraction: a rejected install must never clobber a
-        # running service's on-disk templates (launches read them)
-        packages_root = _os.path.join(self.config.state_dir, "packages")
-        staging = _os.path.join(packages_root, f".staging-{name}")
-        _shutil.rmtree(staging, ignore_errors=True)
-        try:
-            manifest = extract_package(payload, staging)
-            spec = from_yaml_file(
-                _os.path.join(staging, "svc.yml"), env=dict(_os.environ)
-            )
-            if spec.name != name:
-                raise SpecError(
-                    f"package {manifest['name']!r} defines service "
-                    f"{spec.name!r}, not {name!r}"
-                )
-            target = _os.path.join(packages_root, name)
-            _shutil.rmtree(target, ignore_errors=True)
-            _os.replace(staging, target)
-        finally:
+        # the whole exists-check -> extract -> commit -> register
+        # sequence holds the lock: the API server is threaded, and two
+        # concurrent PUTs for one name must not interleave their
+        # filesystem commits (the loser would clobber the winner's
+        # live templates before failing)
+        with self._lock:
+            if self.get_service(name) is not None:
+                raise SpecError(f"service {name!r} already exists")
+            # stage the extraction: a rejected install must never
+            # clobber a running service's templates (launches read them)
+            packages_root = _os.path.join(self.config.state_dir, "packages")
+            staging = _os.path.join(packages_root, f".staging-{name}")
             _shutil.rmtree(staging, ignore_errors=True)
-        # re-anchor template paths in the final location
-        spec = from_yaml_file(
-            _os.path.join(target, "svc.yml"), env=dict(_os.environ)
-        )
-        self.add_service(spec)
+            try:
+                manifest = extract_package(payload, staging)
+                spec = from_yaml_file(
+                    _os.path.join(staging, "svc.yml"), env=dict(_os.environ)
+                )
+                if spec.name != name:
+                    raise SpecError(
+                        f"package {manifest['name']!r} defines service "
+                        f"{spec.name!r}, not {name!r}"
+                    )
+                target = _os.path.join(packages_root, name)
+                _shutil.rmtree(target, ignore_errors=True)
+                _os.replace(staging, target)
+            finally:
+                _shutil.rmtree(staging, ignore_errors=True)
+            # re-anchor template paths in the final location
+            spec = from_yaml_file(
+                _os.path.join(target, "svc.yml"), env=dict(_os.environ)
+            )
+            self.add_service(spec)
 
     def uninstall_service(self, name: str) -> None:
         """Flip the service to teardown; it is dropped from the set

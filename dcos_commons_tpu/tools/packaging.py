@@ -24,14 +24,6 @@ class PackageError(Exception):
     pass
 
 
-def _sha256(path: str) -> str:
-    digest = hashlib.sha256()
-    with open(path, "rb") as f:
-        for chunk in iter(lambda: f.read(65536), b""):
-            digest.update(chunk)
-    return digest.hexdigest()
-
-
 def build_package(
     framework_dir: str,
     out_path: str,
@@ -47,35 +39,41 @@ def build_package(
         raise PackageError(f"{framework_dir} has no svc.yml")
     if not name:
         name = os.path.basename(framework_dir.rstrip(os.sep))
-    files: Dict[str, str] = {}
+    # read each file ONCE: content and digest must come from the same
+    # bytes, or a file rewritten mid-build ships with a manifest digest
+    # that can never verify
+    contents: Dict[str, bytes] = {}
     for root, _dirs, filenames in os.walk(framework_dir):
         for filename in sorted(filenames):
             path = os.path.join(root, filename)
             rel = os.path.relpath(path, framework_dir)
             if rel == MANIFEST_NAME or "__pycache__" in rel:
                 continue
-            files[rel] = _sha256(path)
+            # by CONTENT: a symlinked template becomes a regular file
+            # in the package (extract rejects link members)
+            with open(path, "rb") as f:
+                contents[rel] = f.read()
     manifest = {
         "name": name,
         "version": version,
         "description": description,
-        "files": files,
+        "files": {
+            rel: hashlib.sha256(data).hexdigest()
+            for rel, data in contents.items()
+        },
     }
     os.makedirs(os.path.dirname(os.path.abspath(out_path)), exist_ok=True)
     with tarfile.open(out_path, "w:gz") as tar:
-        def add_bytes(name: str, payload: bytes) -> None:
-            member = tarfile.TarInfo(name)
+        def add_bytes(member_name: str, payload: bytes) -> None:
+            member = tarfile.TarInfo(member_name)
             member.size = len(payload)
             tar.addfile(member, io.BytesIO(payload))
 
         add_bytes(
             MANIFEST_NAME, json.dumps(manifest, indent=2).encode("utf-8")
         )
-        for rel in sorted(files):
-            # add by CONTENT: a symlinked template becomes a regular
-            # file in the package (extract rejects link members)
-            with open(os.path.join(framework_dir, rel), "rb") as f:
-                add_bytes(rel, f.read())
+        for rel in sorted(contents):
+            add_bytes(rel, contents[rel])
     return manifest
 
 
@@ -86,7 +84,7 @@ def read_manifest(package_path: str) -> Dict:
             if member is None:
                 raise PackageError(f"{package_path}: no {MANIFEST_NAME}")
             return json.loads(member.read().decode("utf-8"))
-    except (tarfile.TarError, KeyError, ValueError) as e:
+    except (tarfile.TarError, KeyError, ValueError, OSError) as e:
         raise PackageError(f"{package_path}: not a package: {e}")
 
 

@@ -387,3 +387,45 @@ def test_upgrade_rolls_config_change_across_processes(cluster, tmp_path):
         assert infos[0]["env"]["MODE"] == "v2"
     finally:
         assert scheduler.terminate() == 0, scheduler.log_tail()
+
+
+def test_diagnostics_bundle_captures_everything(cluster, tmp_path):
+    """sdk_diag analogue: one call harvests plans, pod statuses, debug
+    trackers, metrics, logs and task sandbox tails into a bundle —
+    resilient to the scheduler being dead."""
+    from dcos_commons_tpu.testing.diagnostics import dump_bundle
+
+    scheduler = SchedulerProcess(
+        cluster["svc"], cluster["topology"], str(tmp_path / "sched"),
+        repo_root=REPO,
+    )
+    try:
+        scheduler.client().wait_for_completed_deployment(timeout_s=60)
+        bundle = str(tmp_path / "bundle")
+        results = dump_bundle(
+            scheduler.url,
+            bundle,
+            scheduler_log=os.path.join(str(tmp_path / "sched"),
+                                       "scheduler.log"),
+            sandbox_roots=[
+                os.path.join(str(tmp_path / f"agent-{i}"), "sandboxes")
+                for i in range(3)
+            ],
+        )
+        assert results["plans.json"] == "ok"
+        assert results["plan_trees.json"] == "ok"
+        assert results["debug_offers.json"] == "ok"
+        import json as _json
+
+        trees = _json.load(open(os.path.join(bundle, "plan_trees.json")))
+        assert trees["deploy"]["status"] == "COMPLETE"
+        # task sandbox tails came along
+        assert any(
+            name.startswith("task-app-") for name in os.listdir(bundle)
+        )
+    finally:
+        scheduler.terminate()
+    # dead scheduler: the bundle still materializes with errors noted
+    results = dump_bundle(scheduler.url, str(tmp_path / "bundle2"))
+    assert all("error" in v for k, v in results.items()
+               if k.endswith(".json") and k != "MANIFEST.json")
