@@ -58,6 +58,12 @@ ENV_TPU_WORKER_COUNT = "TPU_WORKER_COUNT"
 ENV_TPU_CHIPS_PER_HOST = "TPU_CHIPS_PER_HOST"
 ENV_TPU_TOPOLOGY = "TPU_TOPOLOGY"
 ENV_TPU_GENERATION = "TPU_GENERATION"
+# libtpu provisioning env (the reference bootstrap's task-side setup,
+# sdk/bootstrap/main.go; here the scheduler computes it at placement):
+# the chip ids this host contributes and the host's chip-grid bounds in
+# the TPU_CHIPS_PER_HOST_BOUNDS x,y,z form libtpu expects
+ENV_TPU_CHIP_IDS = "TPU_CHIP_IDS"
+ENV_TPU_HOST_BOUNDS = "TPU_CHIPS_PER_HOST_BOUNDS"
 ENV_COORDINATOR_ADDRESS = "COORDINATOR_ADDRESS"
 COORDINATOR_PORT_NAME = "coordinator"
 
@@ -624,6 +630,18 @@ class OfferEvaluator:
             env[ENV_TPU_WORKER_COUNT] = str(len(requirement.instances))
             env[ENV_TPU_CHIPS_PER_HOST] = str(pod.tpu.chips_per_host)
             env[ENV_TPU_GENERATION] = pod.tpu.generation
+            if chips:
+                # callers pass THIS host's chips (claim consumes per
+                # host; reuse gathers per instance); ';'-separated
+                # because chip ids carry grid commas ("pod-0/2,3")
+                env[ENV_TPU_CHIP_IDS] = ";".join(chips)
+                bx, by = host.chip_block
+                if bx and by and len(chips) == bx * by:
+                    # bounds describe the task's visible chip grid:
+                    # emitted only for full-host assignments (a partial
+                    # allocation has no rectangular contract to claim,
+                    # and a chip-less sidecar must get NEITHER var)
+                    env[ENV_TPU_HOST_BOUNDS] = f"{bx},{by},1"
             if pod.tpu.topology:
                 env[ENV_TPU_TOPOLOGY] = pod.tpu.topology
             if coordinator:

@@ -594,3 +594,74 @@ pods:
 
         with _pytest.raises(ConfigValidationError):
             validate_spec_change(None, spec)
+
+
+def test_gang_tasks_carry_libtpu_provisioning_env():
+    """Each gang worker's env carries ITS host's chip ids and the
+    host chip-grid bounds (the libtpu provisioning contract the
+    reference's bootstrap provided task-side)."""
+    fleet = make_test_fleet(host_grid=(2, 2), chip_block=(2, 2))
+    spec, store, ledger, ev, inv = build_eval(GANG_YAML, fleet)
+    from dcos_commons_tpu.plan.step import PodInstanceRequirement
+
+    req = PodInstanceRequirement(
+        pod=spec.pod("trainer"), instances=[0, 1, 2, 3]
+    )
+    result = ev.evaluate(req, inv)
+    assert result.passed
+    seen_chips = []
+    for info in result.task_infos:
+        env = info.env
+        assert env["TPU_CHIPS_PER_HOST_BOUNDS"] == "2,2,1"
+        chip_ids = env["TPU_CHIP_IDS"].split(";")
+        assert len(chip_ids) == 4  # this HOST's chips only
+        seen_chips.append(frozenset(chip_ids))
+    # no two workers share chips
+    assert len(set(seen_chips)) == len(seen_chips)
+
+
+def test_partial_and_sidecar_tasks_get_no_bounds_contract():
+    """A partial-host chip allocation emits chip ids but NO grid
+    bounds (no rectangular contract to claim), and a chip-less sidecar
+    gets neither var — the libtpu provisioning env is all-or-nothing."""
+    fleet = make_test_fleet(host_grid=(1, 1), chip_block=(2, 2))
+    partial_yaml = """
+name: partial
+pods:
+  worker:
+    count: 1
+    tpu:
+      generation: v5e
+      chips-per-host: 2
+    tasks:
+      main: {goal: RUNNING, cmd: "x", cpus: 0.5, memory: 64}
+      side: {goal: ONCE, cmd: "y", cpus: 0.1, memory: 32}
+"""
+    spec, store, ledger, ev, inv = build_eval(partial_yaml, fleet)
+    from dcos_commons_tpu.plan.step import PodInstanceRequirement
+
+    result = ev.evaluate(
+        PodInstanceRequirement(
+            pod=spec.pod("worker"), instances=[0],
+            tasks_to_launch=["main"],
+        ),
+        inv,
+    )
+    assert result.passed
+    env = result.task_infos[0].env
+    assert len(env["TPU_CHIP_IDS"].split(";")) == 2
+    assert "TPU_CHIPS_PER_HOST_BOUNDS" not in env
+    ledger.commit(result.reservations)
+    store.store_tasks(result.task_infos)
+    # sidecar colocates with zero chips: neither provisioning var
+    side = ev.evaluate(
+        PodInstanceRequirement(
+            pod=spec.pod("worker"), instances=[0],
+            tasks_to_launch=["side"],
+        ),
+        inv,
+    )
+    assert side.passed
+    side_env = side.task_infos[0].env
+    assert "TPU_CHIP_IDS" not in side_env
+    assert "TPU_CHIPS_PER_HOST_BOUNDS" not in side_env
