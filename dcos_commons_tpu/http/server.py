@@ -19,9 +19,13 @@ from dcos_commons_tpu.http.api import SchedulerApi
 Route = Tuple[str, re.Pattern, Callable]
 
 
+def compile_route(method: str, pattern: str, handler: Callable) -> Route:
+    """The one anchoring rule for every route, built-in or custom."""
+    return (method, re.compile(f"^{pattern}$"), handler)
+
+
 def build_routes(api: SchedulerApi) -> List[Route]:
-    def r(method: str, pattern: str, handler: Callable) -> Route:
-        return (method, re.compile(f"^{pattern}$"), handler)
+    r = compile_route
 
     # handlers receive (match, query) and return (code, body)
     return [
@@ -133,8 +137,16 @@ class ApiServer:
     per-service by name)."""
 
     def __init__(self, scheduler=None, port: int = 0, host: str = "127.0.0.1",
-                 multi=None):
-        routes = build_routes(SchedulerApi(scheduler)) if scheduler else []
+                 multi=None, extra_routes=None):
+        # frameworks may register CUSTOM endpoints (reference:
+        # Cassandra's SeedsResource, wired in each Main.java):
+        # extra_routes is [(method, pattern, handler(match, query))],
+        # compiled like the built-ins and matched FIRST
+        routes = [
+            compile_route(method, pattern, handler)
+            for method, pattern, handler in (extra_routes or [])
+        ]
+        routes += build_routes(SchedulerApi(scheduler)) if scheduler else []
         multi_scheduler = multi
 
         class Handler(BaseHTTPRequestHandler):

@@ -208,7 +208,6 @@ class OfferEvaluator:
         task_infos = []
         for worker_id, (index, host_id, reservations) in enumerate(placements):
             host = inventory.host(host_id)
-            chips = sorted({c for r in reservations for c in r.chip_ids})
             for task_name in requirement.tasks_to_launch:
                 task_spec = requirement.pod.task(task_name)
                 full = task_full_name(requirement.pod.type, index, task_name)
@@ -223,11 +222,15 @@ class OfferEvaluator:
                     ):
                         key = port_spec.env_key or f"PORT_{port_spec.name.upper()}"
                         port_env[key] = str(port)
+                # chips follow the reservation holder (see claim path)
+                task_chips = sorted({
+                    c for r in task_res for c in r.chip_ids
+                })
                 task_infos.append(
                     self._build_task_info(
                         requirement, task_spec, index, host,
                         reservations=task_res,
-                        chips=chips,
+                        chips=task_chips,
                         coordinator=coordinator,
                         worker_id=worker_id,
                         extra_env=port_env,
@@ -562,7 +565,11 @@ class OfferEvaluator:
                 info_res.append(coord_res)
             info = self._build_task_info(
                 requirement, task_spec, index, work.host,
-                reservations=info_res, chips=chips,
+                # chips follow the RESERVATION holder: only the task
+                # whose reservation carries the chip ids receives the
+                # libtpu provisioning env — a co-launched chip-less
+                # sidecar must not double-bind the devices
+                reservations=info_res, chips=list(task_chips),
                 coordinator=coordinator, worker_id=worker_id,
                 extra_env=port_env,
             )

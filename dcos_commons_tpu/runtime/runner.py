@@ -165,6 +165,11 @@ class FrameworkRunner:
         # hook(builder, spec): framework-specific wiring (recovery
         # overriders, plan customizers) — the Main.java analogue
         self.builder_hook = builder_hook
+        # framework-specific HTTP endpoints (reference: Cassandra's
+        # SeedsResource): routes_hook(scheduler) -> [(method, pattern,
+        # handler(match, query))], called after build so handlers can
+        # close over the live scheduler
+        self.routes_hook = None
         self._lock = make_instance_lock(
             self.config, f"scheduler-{spec.name}"
         )
@@ -232,8 +237,15 @@ class FrameworkRunner:
             return EXIT_BAD_CONFIG
         # API up before the loop starts taking work, so operators can
         # always observe (FrameworkRunner.java:130-138)
+        extra_routes = (
+            list(self.routes_hook(self.scheduler))
+            if self.routes_hook is not None else []
+        )
         self.api_server = ApiServer(
-            self.scheduler, port=self.config.api_port, host=self.api_bind
+            self.scheduler,
+            port=self.config.api_port,
+            host=self.api_bind,
+            extra_routes=extra_routes,
         ).start()
         thread = None
         try:
@@ -419,7 +431,9 @@ class MultiFrameworkRunner:
             self.multi.stop()
 
 
-def serve_main(argv: Optional[List[str]] = None, builder_hook=None) -> int:
+def serve_main(
+    argv: Optional[List[str]] = None, builder_hook=None, routes_hook=None
+) -> int:
     """``python -m dcos_commons_tpu serve`` argument handling."""
     import argparse
 
@@ -513,6 +527,15 @@ def serve_main(argv: Optional[List[str]] = None, builder_hook=None) -> int:
         print(f"configuration error: {e}", file=sys.stderr)
         return EXIT_BAD_CONFIG
     if args.multi:
+        if routes_hook is not None:
+            # silent dropping would make a framework's discovery
+            # endpoint vanish with no hint; refuse loudly
+            print(
+                "configuration error: custom routes (routes_hook) are "
+                "not supported with --multi",
+                file=sys.stderr,
+            )
+            return EXIT_BAD_CONFIG
         runner = MultiFrameworkRunner(
             specs, config, topology_hosts=hosts, agent_urls=urls,
             builder_hook=builder_hook,
@@ -522,6 +545,7 @@ def serve_main(argv: Optional[List[str]] = None, builder_hook=None) -> int:
             specs[0], config, topology_hosts=hosts, agent_urls=urls,
             builder_hook=builder_hook,
         )
+        runner.routes_hook = routes_hook
     runner.announce_file = args.announce_file
     runner.api_bind = args.bind
     runner.advertise_url = args.advertise_url

@@ -72,6 +72,29 @@ def make_name_node_overrider(spec: ServiceSpec):
     return overrider
 
 
+def make_name_nodes_routes(scheduler):
+    """Custom framework endpoint (reference: Cassandra's SeedsResource
+    — Main.java registers a service-specific HTTP resource next to the
+    SDK's): GET /v1/namenodes lists the name-node fleet with host
+    placement and liveness, the discovery surface HDFS clients use."""
+
+    def name_nodes(_match, _query):
+        statuses = scheduler.state_store.fetch_statuses()
+        nodes = []
+        for index in range(scheduler.spec.pod("name").count):
+            full = f"name-{index}-node"
+            info = scheduler.state_store.fetch_task(full)
+            status = statuses.get(full)
+            nodes.append({
+                "name": full,
+                "host": info.agent_id if info else None,
+                "state": status.state.value if status else None,
+            })
+        return 200, {"namenodes": nodes}
+
+    return [("GET", r"/v1/namenodes", name_nodes)]
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     from dcos_commons_tpu.runtime.runner import serve_main
 
@@ -83,6 +106,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         builder_hook=lambda builder, spec: builder.add_recovery_overrider(
             make_name_node_overrider(spec)
         ),
+        routes_hook=make_name_nodes_routes,
     )
 
 

@@ -243,3 +243,58 @@ def test_name_volume_shared_between_sibling_tasks(tmp_path):
     assert fmt == node
     assert (tmp_path / "sbx/name-0-node/name-data/fsimage").exists()
     agent.shutdown()
+
+
+def test_custom_namenodes_endpoint_served(tmp_path):
+    """Framework-specific HTTP resources (reference: SeedsResource)
+    register through the runner's routes hook and serve next to the
+    SDK routes — driven as a real served process."""
+    import json
+    import subprocess
+    import time
+    import urllib.request
+
+    topo = tmp_path / "topology.yml"
+    topo.write_text("hosts:\n" + "".join(
+        f"  - host_id: h{i}\n    cpus: 8\n    memory_mb: 8192\n"
+        for i in range(3)
+    ))
+    proc = subprocess.Popen(
+        [sys.executable, "frameworks/hdfs/scheduler.py",
+         "frameworks/hdfs/svc.yml",
+         "--topology", str(topo), "--port", "0",
+         "--state-dir", str(tmp_path / "state"),
+         "--sandbox-root", str(tmp_path / "sbx"),
+         "--announce-file", str(tmp_path / "announce"),
+         "--env", "SLEEP_DURATION=600"],
+        cwd=REPO,
+    )
+    try:
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not (
+            tmp_path / "announce"
+        ).exists():
+            time.sleep(0.1)
+        url = (tmp_path / "announce").read_text().strip()
+
+        def get(p):
+            with urllib.request.urlopen(url + p, timeout=5) as r:
+                return json.loads(r.read())
+
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            body = get("/v1/namenodes")
+            nodes = {n["name"]: n for n in body["namenodes"]}
+            if all(
+                n["state"] == "TASK_RUNNING" and n["host"]
+                for n in nodes.values()
+            ):
+                break
+            time.sleep(0.5)
+        assert set(nodes) == {"name-0-node", "name-1-node"}
+        assert all(n["host"] for n in nodes.values())
+        # SDK routes still serve beside the custom one
+        assert get("/v1/health")["healthy"]
+    finally:
+        proc.terminate()
+        proc.wait(timeout=20)

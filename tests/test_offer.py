@@ -665,3 +665,35 @@ pods:
     side_env = side.task_infos[0].env
     assert "TPU_CHIP_IDS" not in side_env
     assert "TPU_CHIPS_PER_HOST_BOUNDS" not in side_env
+
+
+def test_colaunched_sidecar_in_one_requirement_gets_no_chip_env():
+    """Both tasks of a TPU pod launched in ONE requirement: only the
+    reservation-holding task carries the chip provisioning env."""
+    fleet = make_test_fleet(host_grid=(1, 1), chip_block=(2, 2))
+    yaml_text = """
+name: both
+pods:
+  worker:
+    count: 1
+    tpu:
+      generation: v5e
+      chips-per-host: 4
+    tasks:
+      main: {goal: RUNNING, cmd: "x", cpus: 0.5, memory: 64}
+      side: {goal: ONCE, cmd: "y", cpus: 0.1, memory: 32}
+"""
+    spec, store, ledger, ev, inv = build_eval(yaml_text, fleet)
+    from dcos_commons_tpu.plan.step import PodInstanceRequirement
+
+    result = ev.evaluate(
+        PodInstanceRequirement(pod=spec.pod("worker"), instances=[0]),
+        inv,
+    )
+    assert result.passed
+    envs = {i.name: i.env for i in result.task_infos}
+    with_chips = [n for n, e in envs.items() if "TPU_CHIP_IDS" in e]
+    assert len(with_chips) == 1, envs
+    # bounds travel WITH the chips, never alone
+    for name, env in envs.items():
+        assert ("TPU_CHIPS_PER_HOST_BOUNDS" in env) == (name in with_chips)
