@@ -12,6 +12,7 @@ phase (Cassandra seed-replace choreography is the reference example).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Set
 
 from dcos_commons_tpu.common import Label, TaskState, TaskStatus, task_name_of
@@ -66,6 +67,10 @@ class DefaultRecoveryPlanManager(PlanManager):
         self._lock = threading.RLock()
         # active recovery elements keyed by pod instance name
         self._phases: Dict[str, Phase] = {}
+        # keys whose phase came from a RecoveryPlanOverrider: custom
+        # choreography is authoritative — never rebuilt/widened by the
+        # default scoping logic
+        self._custom_keys: Set[str] = set()
         self._plan = Plan(RECOVERY_PLAN_NAME, [], ParallelStrategy())
 
     def set_spec(self, spec: ServiceSpec) -> None:
@@ -96,40 +101,112 @@ class DefaultRecoveryPlanManager(PlanManager):
     def _prune_completed(self) -> None:
         for key in [k for k, p in self._phases.items() if p.is_complete]:
             del self._phases[key]
+            self._custom_keys.discard(key)
 
     def _refresh(self) -> None:
         """Reference: updatePlan (DefaultRecoveryPlanManager.java:164)."""
         self._prune_completed()
         failed = self._find_failed_pods()
-        for (pod_type, instances), recovery_type in failed.items():
+        for (pod_type, instances), (recovery_type, tasks) in failed.items():
             key = pod_instance_name(pod_type, instances[0])
             if any(
                 self._externally_managed(pod_instance_name(pod_type, i))
                 for i in instances
             ):
                 continue
+            if recovery_type is RecoveryType.PERMANENT:
+                # PERMANENT is whole-pod destroy+replace: a subset of a
+                # pod re-placed from scratch would split colocation
+                # (fresh host, fresh volumes) from its live siblings
+                tasks = None
             existing = self._phases.get(key)
             if existing is not None:
-                # escalate in place: TRANSIENT phase upgraded if the
-                # monitor now says PERMANENT (reference :378-420)
-                if recovery_type is RecoveryType.PERMANENT:
-                    for step in existing.steps:
-                        if isinstance(step, DeploymentStep) and \
-                                step.requirement.recovery_type is RecoveryType.TRANSIENT:
-                            step.requirement.recovery_type = RecoveryType.PERMANENT
+                if key in self._custom_keys:
+                    # overrider choreography is authoritative: escalate
+                    # its steps in place, never rebuild around it
+                    if recovery_type is RecoveryType.PERMANENT:
+                        for step in existing.steps:
+                            if isinstance(step, DeploymentStep) and \
+                                    step.requirement.recovery_type is \
+                                    RecoveryType.TRANSIENT:
+                                step.requirement.recovery_type = \
+                                    RecoveryType.PERMANENT
+                    continue
+                covered = self._phase_tasks(existing)
+                required = self._required_tasks(pod_type, instances, tasks)
+                if recovery_type is RecoveryType.PERMANENT and not all(
+                    isinstance(s, DeploymentStep)
+                    and s.requirement.recovery_type is RecoveryType.PERMANENT
+                    for s in existing.steps
+                ):
+                    # escalate by REBUILDING at whole-pod scope — an
+                    # in-place flip of a subset phase would permanently
+                    # re-place only part of the pod.  The rebuild is a
+                    # replace, so it counts against the rate limit.
+                    phase = self._make_phase(
+                        pod_type, list(instances), recovery_type, None
+                    )
+                    if phase is not None:
+                        self._phases[key] = phase
+                        self._record_replace(pod_type, instances)
+                elif covered is not None and not required <= covered:
+                    # a wider failure (an essential task died) arrived
+                    # while a subset phase was in flight: rebuild so the
+                    # new casualties are not deferred behind it
+                    phase = self._make_phase(
+                        pod_type, list(instances), recovery_type, None
+                    )
+                    if phase is not None:
+                        self._phases[key] = phase
                 continue
-            phase = self._make_phase(pod_type, list(instances), recovery_type)
+            phase = self._make_phase(
+                pod_type, list(instances), recovery_type, tasks
+            )
             if phase is not None:
                 self._phases[key] = phase
+                if recovery_type is RecoveryType.PERMANENT:
+                    self._record_replace(pod_type, instances)
 
-    def _find_failed_pods(self) -> Dict[tuple, RecoveryType]:
+    def _phase_tasks(self, phase: Phase) -> Optional[Set[str]]:
+        """Full task names a recovery phase covers; None when the phase
+        holds non-introspectable custom steps."""
+        covered: Set[str] = set()
+        for step in phase.steps:
+            if not isinstance(step, DeploymentStep):
+                return None
+            covered |= set(step.requirement.task_names())
+        return covered
+
+    def _required_tasks(
+        self, pod_type: str, instances, tasks: Optional[List[str]]
+    ) -> Set[str]:
+        pod = self._spec.pod(pod_type)
+        names = tasks if tasks is not None else [
+            t.name for t in pod.tasks
+        ]
+        return {
+            task_full_name(pod_type, i, n)
+            for i in instances
+            for n in names
+        }
+
+    def _find_failed_pods(self) -> Dict[tuple, tuple]:
         """Scan stored statuses for tasks needing recovery, grouped by
-        pod instance (whole pod for gang pods)."""
-        out: Dict[tuple, RecoveryType] = {}
+        pod instance (whole pod for gang pods).
+
+        Values are (recovery_type, tasks_to_launch or None).  Essential
+        semantics (reference: TaskSpec.isEssential): an essential
+        task's failure relaunches the whole pod instance; failures of
+        ONLY non-essential tasks relaunch just those tasks, leaving
+        their essential siblings running.
+        """
+        out: Dict[tuple, tuple] = {}
         for pod in self._spec.pods:
             gang_failed: Set[int] = set()
             gang_type = RecoveryType.TRANSIENT
             for index in range(pod.count):
+                failed_tasks: Dict[str, RecoveryType] = {}
+                essential_failed = False
                 for task_spec in pod.tasks:
                     full = task_full_name(pod.type, index, task_spec.name)
                     info = self._state_store.fetch_task(full)
@@ -137,23 +214,68 @@ class DefaultRecoveryPlanManager(PlanManager):
                     if info is None or status is None:
                         continue
                     needs, rtype = self._needs_recovery(
-                        full, info, status, task_spec.goal
+                        full, info, status, task_spec.goal,
+                        pod_instance_name(pod.type, index),
                     )
                     if not needs:
                         continue
-                    if pod.gang:
-                        gang_failed.add(index)
-                        if rtype is RecoveryType.PERMANENT:
-                            gang_type = RecoveryType.PERMANENT
-                    else:
-                        out[(pod.type, (index,))] = rtype
+                    failed_tasks[task_spec.name] = rtype
+                    essential_failed |= task_spec.essential
+                if not failed_tasks:
+                    continue
+                rtype = (
+                    RecoveryType.PERMANENT
+                    if RecoveryType.PERMANENT in failed_tasks.values()
+                    else RecoveryType.TRANSIENT
+                )
+                if pod.gang:
+                    gang_failed.add(index)
+                    if rtype is RecoveryType.PERMANENT:
+                        gang_type = RecoveryType.PERMANENT
+                elif essential_failed:
+                    out[(pod.type, (index,))] = (rtype, None)  # whole pod
+                else:
+                    out[(pod.type, (index,))] = (
+                        rtype, sorted(failed_tasks)
+                    )
             if pod.gang and gang_failed:
                 # one worker down takes the whole slice through recovery
-                out[(pod.type, tuple(range(pod.count)))] = gang_type
+                out[(pod.type, tuple(range(pod.count)))] = (gang_type, None)
         return out
 
-    def _needs_recovery(self, full, info, status, goal):
+    # -- min replace delay (reference: ReplacementFailurePolicy
+    #    minReplaceDelay — successive PERMANENT replaces of one pod
+    #    instance are rate limited) --------------------------------
+
+    def _record_replace(self, pod_type: str, instances) -> None:
+        """Stamp EVERY replaced instance (a gang replace covers all of
+        them — rate limiting keyed to instance 0 alone would let
+        failures seen on other workers bypass the delay)."""
+        now = str(time.time()).encode()
+        for index in instances:
+            self._state_store.store_property(
+                f"last-replace-{pod_instance_name(pod_type, index)}", now
+            )
+
+    def _replace_delay_elapsed(self, pod_instance: str) -> bool:
+        policy = self._spec.replacement_failure_policy
+        if policy is None or policy.min_replace_delay_s <= 0:
+            return True
+        raw = self._state_store.fetch_property(
+            f"last-replace-{pod_instance}"
+        )
+        if raw is None:
+            return True
+        try:
+            last = float(raw.decode())
+        except ValueError:
+            return True
+        return time.time() - last >= policy.min_replace_delay_s
+
+    def _needs_recovery(self, full, info, status, goal, pod_instance):
         if info.labels.get(Label.PERMANENTLY_FAILED):
+            # explicit operator intent (pod replace) or an already-
+            # stamped escalation: the replace delay never blocks these
             return True, RecoveryType.PERMANENT
         if not status.state.is_terminal:
             self._monitor.clear(full)
@@ -165,6 +287,11 @@ class DefaultRecoveryPlanManager(PlanManager):
                 status.state is TaskState.FINISHED:
             return False, RecoveryType.NONE
         if self._monitor.has_failed_permanently(full, status):
+            if not self._replace_delay_elapsed(pod_instance):
+                # monitor says replace, but the last replace of this
+                # instance was too recent: stay TRANSIENT for now
+                # (reference: minReplaceDelay)
+                return True, RecoveryType.TRANSIENT
             # stamp the label so the escalation survives restart
             self._state_store.store_tasks(
                 [info.with_label(Label.PERMANENTLY_FAILED, "true")]
@@ -173,15 +300,23 @@ class DefaultRecoveryPlanManager(PlanManager):
         return True, RecoveryType.TRANSIENT
 
     def _make_phase(
-        self, pod_type: str, instances: List[int], recovery_type: RecoveryType
+        self,
+        pod_type: str,
+        instances: List[int],
+        recovery_type: RecoveryType,
+        tasks: Optional[List[str]] = None,
     ) -> Optional[Phase]:
+        key = pod_instance_name(pod_type, instances[0])
         for overrider in self._overriders:
             phase = overrider(pod_type, instances, recovery_type)
             if phase is not None:
+                self._custom_keys.add(key)
                 return phase
+        self._custom_keys.discard(key)
         pod = self._spec.pod(pod_type)
         requirement = PodInstanceRequirement(
-            pod=pod, instances=instances, recovery_type=recovery_type
+            pod=pod, instances=instances, recovery_type=recovery_type,
+            tasks_to_launch=tasks,
         )
         name = f"recover-{pod_instance_name(pod_type, instances[0])}" if len(
             instances

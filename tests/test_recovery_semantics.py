@@ -1,0 +1,237 @@
+"""Essential-task scoping + min-replace-delay recovery semantics.
+
+Reference: TaskSpec.isEssential (a non-essential task's death must not
+restart its healthy siblings) and ReplacementFailurePolicy's
+minReplaceDelay (successive PERMANENT replaces of one pod instance are
+rate limited) — both parsed since round 1, now enforced.
+"""
+
+from dcos_commons_tpu.plan.step import RecoveryType
+from dcos_commons_tpu.recovery.monitor import TestingFailureMonitor
+from dcos_commons_tpu.testing import (
+    AdvanceCycles,
+    ExpectDeploymentComplete,
+    ExpectLaunchedTasks,
+    ExpectTaskNotKilled,
+    SendTaskFailed,
+    SendTaskRunning,
+    ServiceTestRunner,
+)
+
+MIXED_YAML = """
+name: mixed
+pods:
+  app:
+    count: 1
+    tasks:
+      server:
+        goal: RUNNING
+        cmd: "serve"
+        cpus: 0.2
+        memory: 64
+      metrics:
+        goal: RUNNING
+        cmd: "scrape"
+        cpus: 0.1
+        memory: 32
+        essential: false
+"""
+
+
+def deploy(runner):
+    runner.run([
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("app-0-server", "app-0-metrics"),
+        SendTaskRunning("app-0-server"),
+        SendTaskRunning("app-0-metrics"),
+        ExpectDeploymentComplete(),
+    ])
+
+
+def test_nonessential_failure_recovers_alone():
+    runner = ServiceTestRunner(MIXED_YAML)
+    deploy(runner)
+    server_launches = len(runner.world.agent.launches_of("app-0-server"))
+    runner.run([
+        SendTaskFailed("app-0-metrics"),
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("app-0-metrics"),
+        ExpectTaskNotKilled("app-0-server"),
+        SendTaskRunning("app-0-metrics"),
+    ])
+    # the essential sibling was never touched
+    assert len(runner.world.agent.launches_of("app-0-server")) == \
+        server_launches
+
+
+def test_essential_failure_recovers_whole_pod():
+    runner = ServiceTestRunner(MIXED_YAML)
+    deploy(runner)
+    runner.run([
+        SendTaskFailed("app-0-server"),
+        AdvanceCycles(1),
+    ])
+    recovery = runner.world.scheduler.plan("recovery")
+    steps = [s for p in recovery.phases for s in p.steps]
+    assert steps, "no recovery step synthesized"
+    # the requirement spans BOTH tasks: the pod restarts as a unit
+    assert set(steps[0].requirement.task_names()) == {
+        "app-0-server", "app-0-metrics"
+    }
+
+
+DELAY_YAML = """
+name: delayed
+replacement-failure-policy:
+  permanent-failure-timeout-secs: 0
+  min-replace-delay-secs: 3600
+pods:
+  app:
+    count: 1
+    tasks:
+      main:
+        goal: RUNNING
+        cmd: "serve"
+        cpus: 0.1
+        memory: 32
+"""
+
+
+def test_min_replace_delay_rate_limits_permanent():
+    """The monitor demands PERMANENT every failure, but within the
+    min-replace window the second failure stays TRANSIENT."""
+    runner = ServiceTestRunner(
+        DELAY_YAML,
+        builder_hook=lambda b: b.set_failure_monitor(
+            TestingFailureMonitor(permanent_tasks=["app-0-main"])
+        ),
+    )
+    runner.run([
+        AdvanceCycles(1),
+        SendTaskRunning("app-0-main"),
+        ExpectDeploymentComplete(),
+        SendTaskFailed("app-0-main"),
+        AdvanceCycles(1),
+    ])
+    scheduler = runner.world.scheduler
+
+    def recovery_types():
+        return [
+            s.requirement.recovery_type
+            for p in scheduler.plan("recovery").phases
+            for s in p.steps
+            if hasattr(s, "requirement")
+        ]
+
+    assert recovery_types() == [RecoveryType.PERMANENT]
+    runner.run([
+        SendTaskRunning("app-0-main"),
+        AdvanceCycles(1),
+        # fail again immediately: inside the 3600s window the monitor's
+        # PERMANENT verdict is held back to TRANSIENT
+        SendTaskFailed("app-0-main"),
+        AdvanceCycles(1),
+    ])
+    assert recovery_types() == [RecoveryType.TRANSIENT]
+
+
+def test_nonessential_permanent_escalates_to_whole_pod():
+    """A non-essential task escalated to PERMANENT must take the whole
+    pod: a subset re-placed from scratch would split colocation."""
+    runner = ServiceTestRunner(
+        MIXED_YAML,
+        builder_hook=lambda b: b.set_failure_monitor(
+            TestingFailureMonitor(permanent_tasks=["app-0-metrics"])
+        ),
+    )
+    deploy(runner)
+    runner.run([
+        SendTaskFailed("app-0-metrics"),
+        AdvanceCycles(1),
+    ])
+    recovery = runner.world.scheduler.plan("recovery")
+    steps = [s for p in recovery.phases for s in p.steps]
+    assert steps[0].requirement.recovery_type is RecoveryType.PERMANENT
+    assert set(steps[0].requirement.task_names()) == {
+        "app-0-server", "app-0-metrics"
+    }
+
+
+def test_essential_failure_widens_inflight_subset_phase():
+    """An essential task dying while a non-essential subset phase is
+    in flight must not be deferred behind it."""
+    runner = ServiceTestRunner(MIXED_YAML)
+    deploy(runner)
+    runner.run([
+        SendTaskFailed("app-0-metrics"),
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("app-0-metrics"),
+        # before metrics recovers, the essential server dies too
+        SendTaskFailed("app-0-server"),
+        AdvanceCycles(1),
+    ])
+    recovery = runner.world.scheduler.plan("recovery")
+    steps = [s for p in recovery.phases for s in p.steps]
+    assert set().union(*(
+        set(s.requirement.task_names()) for s in steps
+    )) == {"app-0-server", "app-0-metrics"}
+
+
+def test_gang_replace_delay_covers_every_worker():
+    """A gang PERMANENT replace stamps EVERY instance, so a follow-up
+    failure seen on a different worker is still rate limited."""
+    gang_yaml = """
+name: gangd
+replacement-failure-policy:
+  permanent-failure-timeout-secs: 0
+  min-replace-delay-secs: 3600
+pods:
+  worker:
+    count: 2
+    gang: true
+    tasks:
+      main:
+        goal: RUNNING
+        cmd: "train"
+        cpus: 0.1
+        memory: 32
+"""
+    from dcos_commons_tpu.offer.inventory import TpuHost
+
+    runner = ServiceTestRunner(
+        gang_yaml,
+        hosts=[TpuHost(host_id=f"h{i}") for i in range(3)],
+        builder_hook=lambda b: b.set_failure_monitor(
+            TestingFailureMonitor(
+                permanent_tasks=["worker-0-main", "worker-1-main"]
+            )
+        ),
+    )
+    runner.run([
+        AdvanceCycles(1),
+        SendTaskRunning("worker-0-main"),
+        SendTaskRunning("worker-1-main"),
+        ExpectDeploymentComplete(),
+        SendTaskFailed("worker-0-main"),
+        AdvanceCycles(1),
+    ])
+    scheduler = runner.world.scheduler
+
+    def recovery_types():
+        return [
+            s.requirement.recovery_type
+            for p in scheduler.plan("recovery").phases
+            for s in p.steps
+            if hasattr(s, "requirement")
+        ]
+
+    assert recovery_types() == [RecoveryType.PERMANENT]
+    runner.run([
+        SendTaskRunning("worker-0-main"),
+        SendTaskRunning("worker-1-main"),
+        AdvanceCycles(1),
+        # the OTHER worker fails inside the window: still rate limited
+        SendTaskFailed("worker-1-main"),
+        AdvanceCycles(1),
+    ])
+    assert recovery_types() == [RecoveryType.TRANSIENT]
