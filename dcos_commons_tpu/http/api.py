@@ -332,6 +332,15 @@ class SchedulerApi:
                     out.setdefault(port_spec.name, []).append(
                         f"{hostname}:{port}"
                     )
+                    if port_spec.vip:
+                        # VIP discovery (reference: NamedVIPEvaluation
+                        # Stage + EndpointUtils VIP listing): clients
+                        # resolve the stable VIP name to the live
+                        # backend set; "web:80" lists under "vip:web"
+                        vip_name = port_spec.vip.split(":", 1)[0]
+                        out.setdefault(f"vip:{vip_name}", []).append(
+                            f"{hostname}:{port}"
+                        )
             coord = info.env.get("COORDINATOR_ADDRESS")
             if coord:
                 entries = out.setdefault("coordinator", [])

@@ -290,8 +290,12 @@ class FrameworkRunner:
         return 0
 
     def _uninstall_finished(self) -> bool:
+        if not self.config.uninstall:
+            return False
         is_complete = getattr(self.scheduler, "is_complete", None)
-        return bool(self.config.uninstall and is_complete and is_complete())
+        if callable(is_complete):
+            is_complete = is_complete()
+        return bool(is_complete)
 
     def stop(self) -> None:
         self._stop_requested.set()

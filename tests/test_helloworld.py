@@ -418,3 +418,69 @@ def test_taskcfg_template_rendered_and_rerendered_on_update(tmp_path):
         assert "greeting=v2" in rendered
     finally:
         daemon.stop()
+
+
+def test_nonessential_yaml_scoped_recovery():
+    """nonessential_tasks.yml: sidecar death recovers alone; essential
+    death takes the pod (TaskSpec.isEssential semantics)."""
+    runner = ServiceTestRunner(load("nonessential_tasks.yml"))
+    runner.run([
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("hello-0-essential", "hello-0-nonessential"),
+        SendTaskRunning("hello-0-essential"),
+        SendTaskRunning("hello-0-nonessential"),
+        ExpectDeploymentComplete(),
+        SendTaskFailed("hello-0-nonessential"),
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("hello-0-nonessential"),
+        SendTaskRunning("hello-0-nonessential"),
+    ])
+    assert len(runner.world.agent.launches_of("hello-0-essential")) == 1
+    runner.run([
+        SendTaskFailed("hello-0-essential"),
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("hello-0-essential", "hello-0-nonessential"),
+    ])
+
+
+def test_multiport_env_and_endpoint_discovery():
+    """multiport.yml: fixed + dynamic + VIP ports land in task env
+    under their keys, stay distinct per host, and surface through
+    /v1/endpoints for clients (reference: EndpointUtils/VIPs)."""
+    from dcos_commons_tpu.http import ApiServer
+
+    runner = ServiceTestRunner(load("multiport.yml"))
+    runner.run([
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("hello-0-server"),
+        SendTaskRunning("hello-0-server"),
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("hello-1-server"),
+        SendTaskRunning("hello-1-server"),
+        ExpectDeploymentComplete(),
+    ])
+    agent = runner.world.agent
+    for i in range(2):
+        env = agent.task_info_of(f"hello-{i}-server").env
+        assert env["PORT_HTTP"] == "8080"
+        admin, gossip = int(env["PORT_ADMIN"]), int(env["PORT_GOSSIP"])
+        assert admin > 0 and gossip > 0 and admin != gossip
+    server = ApiServer(runner.world.scheduler).start()
+    try:
+        import json
+        import urllib.request
+
+        def get(p):
+            with urllib.request.urlopen(server.url + p, timeout=5) as r:
+                return json.loads(r.read())
+
+        names = get("/v1/endpoints")
+        assert "http" in names and "admin" in names
+        http_ep = get("/v1/endpoints/http")
+        assert len(http_ep["address"]) == 2  # one per instance
+        # the VIP name resolves to the same backend set
+        assert "vip:web" in names
+        vip_ep = get("/v1/endpoints/vip:web")
+        assert sorted(vip_ep["address"]) == sorted(http_ep["address"])
+    finally:
+        server.stop()

@@ -429,3 +429,43 @@ def test_diagnostics_bundle_captures_everything(cluster, tmp_path):
     results = dump_bundle(scheduler.url, str(tmp_path / "bundle2"))
     assert all("error" in v for k, v in results.items()
                if k.endswith(".json") and k != "MANIFEST.json")
+
+
+def test_uninstall_via_serve_exits_clean(cluster, tmp_path):
+    """SDK_UNINSTALL through the serve entrypoint: the uninstall plan
+    kills every task across the real agents, wipes state, and the
+    process exits 0 on its own (reference: SDK_UNINSTALL -> Uninstall
+    Scheduler -> deregister, FrameworkRunner.java:147-155)."""
+    workdir = str(tmp_path / "sched")
+    scheduler = SchedulerProcess(
+        cluster["svc"], cluster["topology"], workdir, repo_root=REPO,
+    )
+    try:
+        client = scheduler.client()
+        client.wait_for_completed_deployment(timeout_s=60)
+        ids = client.task_ids()
+        assert len(ids) == 2
+    finally:
+        assert scheduler.terminate() == 0
+
+    # restart in uninstall mode over the same state: it must finish
+    # the teardown and exit 0 WITHOUT being asked to stop
+    teardown = SchedulerProcess(
+        cluster["svc"], cluster["topology"], workdir,
+        env={"SDK_UNINSTALL": "1"},
+        repo_root=REPO,
+        wait_listening=False,
+    )
+    try:
+        assert teardown.process.wait(timeout=90) == 0, teardown.log_tail()
+    finally:
+        teardown.terminate()
+    # every task was torn down on the agents
+    import urllib.request
+    import json as _json
+
+    for agent in cluster["agents"]:
+        with urllib.request.urlopen(
+            agent.url + "/v1/agent/tasks", timeout=5
+        ) as r:
+            assert _json.loads(r.read())["task_ids"] == []
