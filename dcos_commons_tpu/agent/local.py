@@ -99,6 +99,7 @@ class _Running:
     running_reported: bool = False
     health_failures: int = 0
     last_check_at: float = 0.0
+    last_health_at: float = 0.0
     kill_requested: bool = False
     kill_deadline: float = 0.0
 
@@ -616,9 +617,11 @@ class LocalProcessAgent:
                     ready=running.readiness is None,
                 )
             )
-        # readiness: run the check until it passes once
+        # readiness: run the check at its declared interval until it
+        # passes once (a subprocess per poll per task would melt the
+        # agent at fleet scale and ignore the spec's cadence)
         if running.readiness is not None and not running.ready_reported:
-            if now - running.last_check_at >= 0:  # every poll; interval in prod
+            if now - running.last_check_at >= running.readiness.interval_s:
                 running.last_check_at = now
                 if self._run_check(running, running.readiness.cmd,
                                    running.readiness.timeout_s):
@@ -632,10 +635,17 @@ class LocalProcessAgent:
                             message="readiness check passed",
                         )
                     )
-        # health: after grace period, failures accumulate -> kill
+        # health: checking begins after delay_s AND grace_period_s,
+        # then runs at the declared interval; failures accumulate ->
+        # kill (reference HealthCheckSpec: delay gates the first check,
+        # grace suppresses failure counting while warming)
         health = running.health
         if health is not None and \
-                now - running.started_at > health.grace_period_s:
+                now - running.started_at > max(
+                    health.grace_period_s, health.delay_s
+                ) and \
+                now - running.last_health_at >= health.interval_s:
+            running.last_health_at = now
             if self._run_check(running, health.cmd, health.timeout_s):
                 running.health_failures = 0
             else:
