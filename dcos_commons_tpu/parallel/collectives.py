@@ -156,15 +156,17 @@ def _dispatch_overhead_s() -> float:
 def single_chip_rooflines(
     payload_mb: float = 256.0,
     iters: int = 20,
-    chain_floor: int = 400,
+    chain_floor: int = 2000,
     matmul_dim: int = 4096,
 ) -> Dict[str, float]:
     """HBM copy GB/s and bf16 matmul TFLOPs on the default device —
     the ceilings any collective/compute number sits under.
 
-    ``iters`` is a floor; chains are lengthened so on-device work
-    dwarfs dispatch latency, and the measured per-call overhead is
-    subtracted from each timing.
+    ``iters`` is a floor; chains are lengthened (chain_floor) so
+    on-device work DWARFS the ~200ms relay dispatch latency —
+    with short chains the overhead subtraction's own noise can
+    push results past physical peak — and the measured per-call
+    overhead is subtracted from each timing.
     """
     out: Dict[str, float] = {}
     overhead = _dispatch_overhead_s()
