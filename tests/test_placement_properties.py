@@ -1,0 +1,133 @@
+"""Property-based placement-rule tests.
+
+Drives max-per / group-by / round-robin through randomized fleets and
+task distributions, asserting the invariants the rules exist to
+provide — under arrangements unit tests don't enumerate.
+"""
+
+from hypothesis import given, settings, strategies as st
+
+from dcos_commons_tpu.common import TaskInfo
+from dcos_commons_tpu.offer.inventory import ResourceSnapshot, TpuHost
+from dcos_commons_tpu.offer.placement import (
+    PlacementContext,
+    parse_placement,
+)
+
+ZONES = ["za", "zb", "zc"]
+
+
+def fleet_and_tasks(draw):
+    n_hosts = draw(st.integers(min_value=1, max_value=6))
+    hosts = [
+        TpuHost(
+            host_id=f"h{i}",
+            hostname=f"h{i}",
+            zone=draw(st.sampled_from(ZONES)),
+            cpus=8.0,
+            memory_mb=16384,
+        )
+        for i in range(n_hosts)
+    ]
+    n_tasks = draw(st.integers(min_value=0, max_value=8))
+    tasks = [
+        TaskInfo(
+            name=f"app-{i}-main",
+            pod_type="app",
+            pod_index=i,
+            agent_id=draw(st.sampled_from([h.host_id for h in hosts])),
+        )
+        for i in range(n_tasks)
+    ]
+    return hosts, tasks
+
+
+arrangements = st.builds(lambda d: d, st.data())
+
+
+def snap(host):
+    return ResourceSnapshot(
+        host, host.cpus, host.memory_mb, host.disk_mb,
+        set(host.chip_ids()), set(),
+    )
+
+
+def counts_by(field, hosts, tasks):
+    by_host = {h.host_id: h for h in hosts}
+    out = {}
+    for t in tasks:
+        value = getattr(by_host[t.agent_id], field)
+        out[value] = out.get(value, 0) + 1
+    return out
+
+
+@settings(max_examples=80, deadline=None)
+@given(data=st.data(), cap=st.integers(min_value=1, max_value=3))
+def test_max_per_host_invariant(data, cap):
+    """Following the rule's verdicts can never exceed the cap."""
+    hosts, tasks = fleet_and_tasks(data.draw)
+    rule = parse_placement(f"max-per-host:{cap}")
+    ctx = PlacementContext(
+        pod_type="app",
+        existing_tasks=tasks,
+        hosts={h.host_id: h for h in hosts},
+    )
+    per_host = counts_by("hostname", hosts, tasks)
+    for host in hosts:
+        verdict = rule.filter(snap(host), ctx).passed
+        count = per_host.get(host.hostname, 0)
+        # rule passes exactly while the host is under its cap
+        assert verdict == (count < cap), (
+            f"cap={cap} host={host.hostname} count={count} "
+            f"verdict={verdict}"
+        )
+
+
+@settings(max_examples=80, deadline=None)
+@given(data=st.data())
+def test_round_robin_never_widens_imbalance(data):
+    """A placement the rule admits keeps max-min zone spread <= its
+    value before the placement + 1 (the rule only fills the floor)."""
+    hosts, tasks = fleet_and_tasks(data.draw)
+    rule = parse_placement("round-robin:zone")
+    ctx = PlacementContext(
+        pod_type="app",
+        existing_tasks=tasks,
+        hosts={h.host_id: h for h in hosts},
+    )
+    zones_present = {h.zone for h in hosts}
+    zone_counts = {
+        z: counts_by("zone", hosts, tasks).get(z, 0) for z in zones_present
+    }
+    floor = min(zone_counts.values())
+    for host in hosts:
+        if rule.filter(snap(host), ctx).passed:
+            # admitted placements are always into a floor zone
+            assert zone_counts[host.zone] == floor, (
+                f"admitted into {host.zone} at {zone_counts[host.zone]} "
+                f"while floor is {floor}"
+            )
+    # and at least one host is always admissible (no deadlock)
+    assert any(
+        rule.filter(snap(h), ctx).passed for h in hosts
+    )
+
+
+@settings(max_examples=60, deadline=None)
+@given(data=st.data(), expected=st.integers(min_value=1, max_value=4))
+def test_group_by_stays_within_ceiling(data, expected):
+    hosts, tasks = fleet_and_tasks(data.draw)
+    import math
+
+    rule = parse_placement(f"group-by:zone:{expected}")
+    ctx = PlacementContext(
+        pod_type="app",
+        existing_tasks=tasks,
+        hosts={h.host_id: h for h in hosts},
+    )
+    zone_counts = counts_by("zone", hosts, tasks)
+    total = len(tasks) + 1
+    ceiling = math.ceil(total / expected)
+    for host in hosts:
+        if rule.filter(snap(host), ctx).passed:
+            assert zone_counts.get(host.zone, 0) < ceiling
