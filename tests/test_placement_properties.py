@@ -5,6 +5,8 @@ task distributions, asserting the invariants the rules exist to
 provide — under arrangements unit tests don't enumerate.
 """
 
+import math
+
 from hypothesis import given, settings, strategies as st
 
 from dcos_commons_tpu.common import TaskInfo
@@ -40,9 +42,6 @@ def fleet_and_tasks(draw):
         for i in range(n_tasks)
     ]
     return hosts, tasks
-
-
-arrangements = st.builds(lambda d: d, st.data())
 
 
 def snap(host):
@@ -117,8 +116,6 @@ def test_round_robin_never_widens_imbalance(data):
 @given(data=st.data(), expected=st.integers(min_value=1, max_value=4))
 def test_group_by_stays_within_ceiling(data, expected):
     hosts, tasks = fleet_and_tasks(data.draw)
-    import math
-
     rule = parse_placement(f"group-by:zone:{expected}")
     ctx = PlacementContext(
         pod_type="app",
@@ -129,5 +126,7 @@ def test_group_by_stays_within_ceiling(data, expected):
     total = len(tasks) + 1
     ceiling = math.ceil(total / expected)
     for host in hosts:
-        if rule.filter(snap(host), ctx).passed:
-            assert zone_counts.get(host.zone, 0) < ceiling
+        verdict = rule.filter(snap(host), ctx).passed
+        # exact biconditional: the rule passes precisely while the
+        # host's zone is under the ceiling
+        assert verdict == (zone_counts.get(host.zone, 0) < ceiling)
