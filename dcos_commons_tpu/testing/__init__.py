@@ -13,6 +13,23 @@ as the reference rebuilds ServiceTestRunner over one MemPersister
 """
 
 from dcos_commons_tpu.testing.fake_agent import FakeAgent
+
+
+def drive_until(scheduler, predicate, timeout_s: float = 30.0,
+                interval_s: float = 0.05) -> bool:
+    """Run real scheduler cycles until ``predicate()`` is truthy.
+
+    The shared poll loop for tests that drive a scheduler against a
+    REAL agent (process launches) rather than scripted ticks."""
+    import time as _time
+
+    deadline = _time.monotonic() + timeout_s
+    while _time.monotonic() < deadline:
+        scheduler.run_cycle()
+        if predicate():
+            return True
+        _time.sleep(interval_s)
+    return False
 from dcos_commons_tpu.testing.runner import ServiceTestRunner, SimulationWorld
 from dcos_commons_tpu.testing.ticks import (
     AddHost,
@@ -51,6 +68,7 @@ from dcos_commons_tpu.testing.ticks import (
 
 __all__ = [
     "FakeAgent",
+    "drive_until",
     "ServiceTestRunner",
     "SimulationWorld",
     "SimulationTick",

@@ -484,3 +484,57 @@ def test_multiport_env_and_endpoint_discovery():
         assert sorted(vip_ep["address"]) == sorted(http_ep["address"])
     finally:
         server.stop()
+
+
+def test_graceful_shutdown_honors_kill_grace(tmp_path):
+    """graceful-shutdown.yml through a REAL agent: pod restart sends
+    SIGTERM, the task's trap takes ~1s of cleanup INSIDE the kill-grace
+    window (so an immediate-SIGKILL regression cannot pass), and the
+    supervisor's durable record shows a graceful exit 0."""
+    from dcos_commons_tpu.agent.local import LocalProcessAgent
+    from dcos_commons_tpu.offer.inventory import SliceInventory, TpuHost
+    from dcos_commons_tpu.scheduler import SchedulerBuilder, SchedulerConfig
+    from dcos_commons_tpu.specification import from_yaml
+    from dcos_commons_tpu.storage import MemPersister
+    from dcos_commons_tpu.testing import drive_until
+
+    spec = from_yaml(load("graceful-shutdown.yml"))
+    builder = SchedulerBuilder(
+        spec,
+        SchedulerConfig(
+            sandbox_root=str(tmp_path / "sbx"),
+            backoff_enabled=False,
+            revive_capacity=1_000_000,
+        ),
+        MemPersister(),
+    )
+    builder.set_inventory(SliceInventory([TpuHost(host_id="h0")]))
+    agent = LocalProcessAgent(str(tmp_path / "sbx"))
+    builder.set_agent(agent)
+    scheduler = builder.build()
+    try:
+        assert drive_until(
+            scheduler,
+            lambda: scheduler.deploy_manager.get_plan().is_complete,
+        )
+        first_id = scheduler.state_store.fetch_task(
+            "world-0-server"
+        ).task_id
+        # operator restart: SIGTERM -> trap (sleeps 1s) -> exit 0
+        scheduler.restart_pod("world", 0)
+        assert drive_until(
+            scheduler,
+            lambda: (
+                (info := scheduler.state_store.fetch_task(
+                    "world-0-server"
+                )) is not None and info.task_id != first_id
+            ),
+        )
+        # the trap SLEEPS 1s before writing: an immediate-SIGKILL
+        # regression would cut it mid-sleep and the line could never
+        # appear (the old incarnation's .super record is pruned at
+        # relaunch, so the log is the durable proof)
+        cleanup = tmp_path / "sbx" / "world-0-server" / "shutdown.log"
+        assert cleanup.read_text().strip() == "cleaned-up"
+    finally:
+        agent.shutdown()

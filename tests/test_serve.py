@@ -154,7 +154,9 @@ def test_scheduler_restart_resumes_over_same_state(cluster, tmp_path):
         cluster["svc"], cluster["topology"], workdir, repo_root=REPO,
     )
     client = scheduler.client()
-    client.wait_for_completed_deployment(timeout_s=60)
+    # generous timeouts: under full-suite load the subprocess trio can
+    # take far longer than in isolation (observed flake)
+    client.wait_for_completed_deployment(timeout_s=120)
     ids = client.task_ids()
     assert scheduler.terminate() == 0
 
@@ -165,7 +167,7 @@ def test_scheduler_restart_resumes_over_same_state(cluster, tmp_path):
     )
     try:
         client = scheduler.client()
-        client.wait_for_completed_deployment(timeout_s=60)
+        client.wait_for_completed_deployment(timeout_s=120)
         client.check_tasks_not_updated(ids)
     finally:
         assert scheduler.terminate() == 0
