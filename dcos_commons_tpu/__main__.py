@@ -42,9 +42,13 @@ def main(argv=None) -> int:
         from dcos_commons_tpu.tools.packaging import main as package_main
 
         return package_main(rest)
+    if command == "certs":
+        from dcos_commons_tpu.security.auth import certs_main
+
+        return certs_main(rest)
     print(
         f"unknown command {command!r}; "
-        "try serve | agent | cli | state-server | package",
+        "try serve | agent | cli | state-server | package | certs",
         file=sys.stderr,
     )
     return 1

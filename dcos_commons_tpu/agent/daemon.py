@@ -53,19 +53,36 @@ class AgentDaemon:
         port: int = 0,
         bind: str = "127.0.0.1",
         advertise_host: str = "",
+        auth_token: str = "",
+        tls=None,
+        ca_file: str = "",
     ):
+        from dcos_commons_tpu.security import auth as _auth
+
         self.host_id = host_id
         # a daemon bound to 0.0.0.0 must announce a routable address
         # (the scheduler dials what the announce file says); mirrors the
         # runner's --advertise-url
         self.advertise_host = advertise_host
-        self._executor = LocalProcessAgent(workdir)
+        self._executor = LocalProcessAgent(
+            workdir, auth_token=auth_token, ca_file=ca_file
+        )
         self._started_at = time.monotonic()
+        self._scheme = _auth.url_scheme(tls)
         daemon = self
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, fmt, *args):
                 pass
+
+            def _authorized(self) -> bool:
+                # launch IS remote command execution: with a token set,
+                # EVERY agent route (including sandbox reads) requires
+                # it — there is no anonymous surface on a daemon
+                if _auth.check_bearer(self.headers, auth_token):
+                    return True
+                self._reply(*_auth.UNAUTHORIZED)
+                return False
 
             def _body(self) -> dict:
                 length = int(self.headers.get("Content-Length", 0))
@@ -87,6 +104,8 @@ class AgentDaemon:
                 self.wfile.write(payload)
 
             def do_GET(self):
+                if not self._authorized():
+                    return
                 parsed = urlparse(self.path)
                 try:
                     if parsed.path == "/v1/agent/info":
@@ -120,6 +139,8 @@ class AgentDaemon:
                     self._reply(500, {"message": f"agent error: {e}"})
 
             def do_POST(self):
+                if not self._authorized():
+                    return
                 parsed = urlparse(self.path)
                 try:
                     if parsed.path == "/v1/agent/launch":
@@ -143,7 +164,9 @@ class AgentDaemon:
                 except Exception as e:
                     self._reply(500, {"message": f"agent error: {e}"})
 
-        self._server = ThreadingHTTPServer((bind, port), Handler)
+        self._server = _auth.wrap_http_server(
+            ThreadingHTTPServer((bind, port), Handler), tls
+        )
         self._thread: Optional[threading.Thread] = None
 
     # -- request handling --------------------------------------------
@@ -205,7 +228,7 @@ class AgentDaemon:
             import socket
 
             host = socket.gethostname()
-        return f"http://{host}:{port}"
+        return f"{self._scheme}://{host}:{port}"
 
     def start(self) -> "AgentDaemon":
         self._thread = threading.Thread(
@@ -232,6 +255,18 @@ def serialize_check(check) -> Optional[dict]:
     return dataclasses.asdict(check)
 
 
+def _tls_pair_or_die(cert: str, key: str):
+    from dcos_commons_tpu.security.auth import tls_pair
+
+    try:
+        return tls_pair(cert, key)
+    except ValueError as e:
+        import sys
+
+        print(f"configuration error: {e}", file=sys.stderr)
+        raise SystemExit(4)  # EXIT_BAD_CONFIG
+
+
 def main(argv: Optional[list] = None) -> int:
     """``python -m dcos_commons_tpu agent`` — run one host's daemon."""
     import argparse
@@ -254,13 +289,41 @@ def main(argv: Optional[list] = None) -> int:
         default="",
         help="write '<host_id> <url>' here once listening (ephemeral ports)",
     )
+    parser.add_argument(
+        "--auth-token-file",
+        default="",
+        help="cluster bearer token file; also $AUTH_TOKEN(_FILE). "
+             "REQUIRED for non-loopback binds (launch = remote exec)",
+    )
+    parser.add_argument("--tls-cert", default="", help="serve HTTPS: cert PEM")
+    parser.add_argument("--tls-key", default="", help="serve HTTPS: key PEM")
+    parser.add_argument(
+        "--tls-ca", default="",
+        help="CA bundle for verifying the scheduler's HTTPS artifact "
+             "endpoint; also $TLS_CA_FILE",
+    )
     args = parser.parse_args(argv)
+    from dcos_commons_tpu.security.auth import load_token
+
+    token = load_token(token_file=args.auth_token_file)
+    if not token and args.bind not in ("127.0.0.1", "localhost", "::1"):
+        import sys
+
+        print(
+            "WARNING: agent bound on a non-loopback address with NO auth "
+            "token — anyone who can reach this port can run commands. "
+            "Pass --auth-token-file (see security/auth.py trust model).",
+            file=sys.stderr,
+        )
     daemon = AgentDaemon(
         args.host_id,
         args.workdir,
         port=args.port,
         bind=args.bind,
         advertise_host=args.advertise_host,
+        auth_token=token,
+        tls=_tls_pair_or_die(args.tls_cert, args.tls_key),
+        ca_file=args.tls_ca or os.environ.get("TLS_CA_FILE", ""),
     )
     if args.announce_file:
         from dcos_commons_tpu.common import atomic_write_text

@@ -29,6 +29,8 @@ from dcos_commons_tpu.specification.specs import (
 def prepare_templates(
     task_env: Dict[str, str],
     templates: Optional[List[dict]],
+    auth_token: str = "",
+    ca_file: str = "",
 ) -> List[Tuple[str, str]]:
     """Fetch + render config templates; no filesystem writes.
 
@@ -56,7 +58,18 @@ def prepare_templates(
                 )
             import urllib.request
 
-            with urllib.request.urlopen(url, timeout=10) as resp:
+            from dcos_commons_tpu.security import auth as _auth
+
+            # the scheduler's /v1/artifacts is bearer-protected like
+            # every other route; the daemon holds the cluster token
+            req = urllib.request.Request(
+                url, headers=_auth.auth_headers(auth_token)
+            )
+            ctx = (
+                _auth.client_ssl_context(ca_file)
+                if url.startswith("https") else None
+            )
+            with urllib.request.urlopen(req, timeout=10, context=ctx) as resp:
                 content = resp.read().decode("utf-8")
         from dcos_commons_tpu.specification.yaml_spec import render_template
 
@@ -162,8 +175,13 @@ class LocalProcessAgent:
     (launch_with_checks), keeping TaskInfo JSON-small.
     """
 
-    def __init__(self, workdir: str, use_native: bool = True):
+    def __init__(self, workdir: str, use_native: bool = True,
+                 auth_token: str = "", ca_file: str = ""):
         self._workdir = workdir
+        # credentials for pulling templates off the scheduler's
+        # bearer-protected /v1/artifacts endpoint
+        self._auth_token = auth_token
+        self._ca_file = ca_file
         self._tasks: Dict[str, _Running] = {}
         self._pending: List[TaskStatus] = []
         # recovered terminal fates whose records retire at delivery
@@ -343,7 +361,10 @@ class LocalProcessAgent:
         # scheduler artifact endpoint must not block kill/poll/tasks
         # (and thereby trip the fleet's host-down detection)
         try:
-            rendered = prepare_templates(info.env, templates)
+            rendered = prepare_templates(
+                info.env, templates,
+                auth_token=self._auth_token, ca_file=self._ca_file,
+            )
         except Exception as e:
             # the reference's bootstrap exits nonzero on a failed
             # template render, failing the task before its command

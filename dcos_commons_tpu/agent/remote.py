@@ -36,7 +36,11 @@ class RemoteAgentClient:
         base_url: str,
         timeout_s: float = 5.0,
         launch_timeout_s: float = 30.0,
+        auth_token: str = "",
+        ca_file: str = "",
     ):
+        from dcos_commons_tpu.security import auth as _auth
+
         self.host_id = host_id
         self.base_url = base_url.rstrip("/")
         self.timeout_s = timeout_s
@@ -44,6 +48,12 @@ class RemoteAgentClient:
         # template); a timeout shorter than that would declare a
         # successfully-launching task LOST and double-book the slice
         self.launch_timeout_s = launch_timeout_s
+        self._headers = {"Content-Type": "application/json",
+                         **_auth.auth_headers(auth_token)}
+        self._ssl_ctx = (
+            _auth.client_ssl_context(ca_file)
+            if self.base_url.startswith("https") else None
+        )
 
     def _request(
         self,
@@ -57,10 +67,12 @@ class RemoteAgentClient:
             f"{self.base_url}{path}",
             data=data,
             method=method,
-            headers={"Content-Type": "application/json"},
+            headers=dict(self._headers),
         )
         with urllib.request.urlopen(
-            req, timeout=timeout_s if timeout_s is not None else self.timeout_s
+            req,
+            timeout=timeout_s if timeout_s is not None else self.timeout_s,
+            context=self._ssl_ctx,
         ) as resp:
             return json.loads(resp.read().decode("utf-8"))
 
@@ -98,9 +110,12 @@ class RemoteAgentClient:
 
         req = urllib.request.Request(
             f"{self.base_url}/v1/agent/sandbox"
-            f"?task={quote(task_name)}&file={quote(rel)}"
+            f"?task={quote(task_name)}&file={quote(rel)}",
+            headers=dict(self._headers),
         )
-        with urllib.request.urlopen(req, timeout=self.timeout_s) as resp:
+        with urllib.request.urlopen(
+            req, timeout=self.timeout_s, context=self._ssl_ctx
+        ) as resp:
             return resp.read().decode("utf-8")
 
 
@@ -120,9 +135,13 @@ class RemoteFleet(Agent):
         down_after: int = 3,
         on_host_down: Optional[Callable[[str], None]] = None,
         on_host_up: Optional[Callable[[str], None]] = None,
+        auth_token: str = "",
+        ca_file: str = "",
     ):
         self._clients: Dict[str, RemoteAgentClient] = {}
         self._timeout_s = timeout_s
+        self._auth_token = auth_token
+        self._ca_file = ca_file
         self._down_after = down_after
         self._failures: Dict[str, int] = {}
         self._down: Set[str] = set()
@@ -163,7 +182,8 @@ class RemoteFleet(Agent):
     def add_host(self, host_id: str, url: str) -> None:
         with self._lock:
             self._clients[host_id] = RemoteAgentClient(
-                host_id, url, self._timeout_s
+                host_id, url, self._timeout_s,
+                auth_token=self._auth_token, ca_file=self._ca_file,
             )
             self._failures[host_id] = 0
 

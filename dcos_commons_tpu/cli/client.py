@@ -21,27 +21,45 @@ class CliError(Exception):
 
 
 class ApiClient:
-    def __init__(self, base_url: str, timeout_s: float = 10.0):
+    def __init__(self, base_url: str, timeout_s: float = 10.0,
+                 auth_token: str = "", ca_file: str = ""):
+        from dcos_commons_tpu.security import auth as _auth
+
         self._base = base_url.rstrip("/")
         self._timeout = timeout_s
+        self._headers = _auth.auth_headers(auth_token)
+        self._ssl_ctx = (
+            _auth.client_ssl_context(ca_file)
+            if self._base.startswith("https") else None
+        )
 
     def get(self, path: str) -> Any:
         return self._request("GET", path)
 
-    def post(self, path: str, params: Optional[dict] = None) -> Any:
+    def post(self, path: str, params: Optional[dict] = None,
+             body: Optional[Any] = None) -> Any:
         if params:
             clean = {k: v for k, v in params.items() if v is not None}
             if clean:
                 path = f"{path}?{urlencode(clean, doseq=True)}"
-        return self._request("POST", path)
+        return self._request("POST", path, body=body)
 
-    def _request(self, method: str, path: str) -> Any:
+    def _request(self, method: str, path: str,
+                 body: Optional[Any] = None) -> Any:
+        if body is not None:
+            data = json.dumps(body).encode("utf-8")
+        else:
+            data = b"" if method == "POST" else None
         request = urllib.request.Request(
-            self._base + path, method=method,
-            data=b"" if method == "POST" else None,
+            self._base + path, method=method, data=data,
+            headers=dict(self._headers),
         )
+        if body is not None:
+            request.add_header("Content-Type", "application/json")
         try:
-            with urllib.request.urlopen(request, timeout=self._timeout) as resp:
+            with urllib.request.urlopen(
+                request, timeout=self._timeout, context=self._ssl_ctx
+            ) as resp:
                 code, raw = resp.status, resp.read()
         except urllib.error.HTTPError as e:
             code, raw = e.code, e.read()

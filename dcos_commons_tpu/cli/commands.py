@@ -26,6 +26,17 @@ def build_parser() -> argparse.ArgumentParser:
         default=os.environ.get("SCHEDULER_API_URL", "http://127.0.0.1:8080"),
         help="scheduler API base URL (default: $SCHEDULER_API_URL)",
     )
+    parser.add_argument(
+        "--auth-token-file",
+        default="",
+        help="cluster bearer token file; also $AUTH_TOKEN(_FILE)",
+    )
+    parser.add_argument(
+        "--tls-ca",
+        default=os.environ.get("TLS_CA_FILE", ""),
+        help="CA bundle for verifying an HTTPS scheduler "
+             "(default: $TLS_CA_FILE)",
+    )
     sections = parser.add_subparsers(dest="section", required=True)
 
     # plan (reference: cli/commands/plan.go:51-90)
@@ -50,6 +61,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("step")
     p = plan.add_parser("start")
     p.add_argument("plan")
+    p.add_argument(
+        "-p", "--param", action="append", default=[], metavar="KEY=VALUE",
+        help="env override launched into every task of the plan "
+             "(reference: `plan start <plan> -p KEY=VALUE`)",
+    )
     p = plan.add_parser("stop")
     p.add_argument("plan")
 
@@ -103,7 +119,13 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def run(args: argparse.Namespace) -> Any:
-    client = ApiClient(args.url)
+    from dcos_commons_tpu.security.auth import load_token
+
+    client = ApiClient(
+        args.url,
+        auth_token=load_token(token_file=getattr(args, "auth_token_file", "")),
+        ca_file=getattr(args, "tls_ca", ""),
+    )
     section = args.section
     if section == "plan":
         return _plan(client, args)
@@ -143,7 +165,16 @@ def _plan(client: ApiClient, args) -> Any:
     if verb == "force-complete":
         return client.post(f"/v1/plans/{args.plan}/forceComplete", params)
     if verb == "start":
-        return client.post(f"/v1/plans/{args.plan}/start")
+        env = {}
+        for pair in getattr(args, "param", []) or []:
+            key, sep, value = pair.partition("=")
+            if not sep or not key:
+                raise CliError(0, f"bad --param {pair!r}; want KEY=VALUE")
+            env[key] = value
+        return client.post(
+            f"/v1/plans/{args.plan}/start",
+            body={"env": env} if env else None,
+        )
     if verb == "stop":
         return client.post(f"/v1/plans/{args.plan}/stop")
     raise CliError(0, f"unknown plan verb {verb}")

@@ -107,15 +107,33 @@ class SchedulerApi:
     def plan_force_complete(self, plan_name, phase=None, step=None) -> Response:
         return self._plan_verb(plan_name, phase, step, "force_complete")
 
-    def plan_start(self, plan_name) -> Response:
-        """Reference: PlansQueries.start — restart + proceed (used for
-        sidecar plans like backup/restore)."""
+    def plan_start(self, plan_name, env=None) -> Response:
+        """Reference: PlansQueries.start (PlansQueries.java:47-231) —
+        restart + proceed, with an optional ``{"env": {...}}`` body
+        merged into every task the plan launches (what makes sidecar
+        plans like backup/restore operable: snapshot name, target
+        location)."""
         element, error = self._plan_element(plan_name, None, None)
         if error is not None:
             return error
+        if env:
+            if not isinstance(env, dict) or not all(
+                isinstance(k, str) and isinstance(v, str)
+                for k, v in env.items()
+            ):
+                return 400, {"message": "env must be a {str: str} object"}
+            setter = getattr(element, "set_env_overrides", None)
+            if setter is None:
+                return 409, {
+                    "message": f"plan {plan_name} cannot take env overrides"
+                }
+            setter(env)
         element.restart()
         element.proceed()
-        return 200, {"message": "started", "plan": plan_name}
+        return 200, {
+            "message": "started", "plan": plan_name,
+            "env": sorted(env) if env else [],
+        }
 
     def plan_stop(self, plan_name) -> Response:
         """Reference: PlansQueries.stop — interrupt + restart."""

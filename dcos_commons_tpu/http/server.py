@@ -16,12 +16,15 @@ from urllib.parse import parse_qs, urlparse
 
 from dcos_commons_tpu.http.api import SchedulerApi
 
-Route = Tuple[str, re.Pattern, Callable]
+Route = Tuple[str, re.Pattern, Callable, bool]
 
 
-def compile_route(method: str, pattern: str, handler: Callable) -> Route:
-    """The one anchoring rule for every route, built-in or custom."""
-    return (method, re.compile(f"^{pattern}$"), handler)
+def compile_route(method: str, pattern: str, handler: Callable,
+                  wants_body: bool = False) -> Route:
+    """The one anchoring rule for every route, built-in or custom.
+    ``wants_body`` handlers receive a third argument: the request's
+    parsed JSON body (``{}`` when absent/invalid)."""
+    return (method, re.compile(f"^{pattern}$"), handler, wants_body)
 
 
 def build_routes(api: SchedulerApi) -> List[Route]:
@@ -47,7 +50,8 @@ def build_routes(api: SchedulerApi) -> List[Route]:
           lambda m, q: api.plan_restart(m.group(1), _one(q, "phase"),
                                         _one(q, "step"))),
         r("POST", r"/v1/plans/([^/]+)/start",
-          lambda m, q: api.plan_start(m.group(1))),
+          lambda m, q, body: api.plan_start(m.group(1), body.get("env")),
+          True),
         r("POST", r"/v1/plans/([^/]+)/stop",
           lambda m, q: api.plan_stop(m.group(1))),
         # pods
@@ -137,15 +141,18 @@ class ApiServer:
     per-service by name)."""
 
     def __init__(self, scheduler=None, port: int = 0, host: str = "127.0.0.1",
-                 multi=None, extra_routes=None):
+                 multi=None, extra_routes=None, auth_token: str = "",
+                 tls=None):
+        # cluster bearer token (security/auth.py): when set, every
+        # route but /v1/health requires Authorization — the reference
+        # fronts its API with admin-router auth; tls=(cert, key) files
+        # serve HTTPS issued by the in-repo CA
+        from dcos_commons_tpu.security import auth as _auth
         # frameworks may register CUSTOM endpoints (reference:
         # Cassandra's SeedsResource, wired in each Main.java):
         # extra_routes is [(method, pattern, handler(match, query))],
         # compiled like the built-ins and matched FIRST
-        routes = [
-            compile_route(method, pattern, handler)
-            for method, pattern, handler in (extra_routes or [])
-        ]
+        routes = [compile_route(*entry) for entry in (extra_routes or [])]
         routes += build_routes(SchedulerApi(scheduler)) if scheduler else []
         multi_scheduler = multi
 
@@ -157,6 +164,11 @@ class ApiServer:
             def _dispatch(self, method: str) -> None:
                 parsed = urlparse(self.path)
                 query = parse_qs(parsed.query)
+                if parsed.path != "/v1/health" and not _auth.check_bearer(
+                    self.headers, auth_token
+                ):
+                    self._reply(*_auth.UNAUTHORIZED)
+                    return
                 if multi_scheduler is not None and \
                         parsed.path.startswith("/v1/multi"):
                     code, body = self._dispatch_multi(
@@ -164,14 +176,18 @@ class ApiServer:
                     )
                     self._reply(code, body)
                     return
-                for route_method, pattern, handler in routes:
+                for route_method, pattern, handler, wants_body in routes:
                     if route_method != method:
                         continue
                     match = pattern.match(parsed.path)
                     if match is None:
                         continue
                     try:
-                        code, body = handler(match, query)
+                        if wants_body:
+                            code, body = handler(match, query,
+                                                 self._json_body())
+                        else:
+                            code, body = handler(match, query)
                     except Exception as e:  # surface, don't kill the server
                         code, body = 500, {"message": f"internal error: {e}"}
                     self._reply(code, body)
@@ -230,17 +246,30 @@ class ApiServer:
                     return 404, {"message": f"no service {name}"}
                 sub_path = f"/{sub}" if sub.startswith("v1") else f"/v1/{sub}"
                 sub_routes = build_routes(SchedulerApi(service))
-                for route_method, pattern, handler in sub_routes:
+                for route_method, pattern, handler, wants_body in sub_routes:
                     if route_method != method:
                         continue
                     match = pattern.match(sub_path)
                     if match is None:
                         continue
                     try:
+                        if wants_body:
+                            return handler(match, query, self._json_body())
                         return handler(match, query)
                     except Exception as e:
                         return 500, {"message": f"internal error: {e}"}
                 return 404, {"message": f"no route {method} {sub_path}"}
+
+            def _json_body(self) -> dict:
+                length = int(self.headers.get("Content-Length", 0))
+                if not length:
+                    return {}
+                raw = self.rfile.read(length)
+                try:
+                    parsed_body = json.loads(raw.decode("utf-8"))
+                except (ValueError, UnicodeDecodeError):
+                    return {}
+                return parsed_body if isinstance(parsed_body, dict) else {}
 
             def _reply(self, code: int, body) -> None:
                 if isinstance(body, str):
@@ -267,7 +296,10 @@ class ApiServer:
             def do_DELETE(self):
                 self._dispatch("DELETE")
 
-        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._server = _auth.wrap_http_server(
+            ThreadingHTTPServer((host, port), Handler), tls
+        )
+        self._scheme = _auth.url_scheme(tls)
         self._thread: Optional[threading.Thread] = None
 
     @property
@@ -277,7 +309,7 @@ class ApiServer:
     @property
     def url(self) -> str:
         host, port = self._server.server_address[:2]
-        return f"http://{host}:{port}"
+        return f"{self._scheme}://{host}:{port}"
 
     def start(self) -> "ApiServer":
         self._thread = threading.Thread(

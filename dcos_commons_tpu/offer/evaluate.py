@@ -629,6 +629,9 @@ class OfferEvaluator:
         full = task_full_name(pod.type, index, task_spec.name)
         env = dict(task_spec.env)
         env.update(extra_env or {})
+        # parameterized-plan env (PodInstanceRequirement.env_overrides)
+        # beats the spec but never the system contract vars below
+        env.update(requirement.env_overrides)
         env[ENV_POD_INSTANCE_INDEX] = str(index)
         env[ENV_TASK_NAME] = full
         env[ENV_FRAMEWORK_NAME] = self._service_name

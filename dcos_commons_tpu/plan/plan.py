@@ -61,6 +61,17 @@ class Plan(Element):
         for phase in self.phases:
             phase.restart()
 
+    def set_env_overrides(self, env: dict) -> None:
+        """Parameterized start: merge operator env into every step's
+        launch requirement (reference: PlansQueries start-with-env).
+        Sticky until the next parameterized start — re-running a
+        backup plan without params reuses the previous target."""
+        for phase in self.phases:
+            for step in phase.steps:
+                requirement = getattr(step, "requirement", None)
+                if requirement is not None:
+                    requirement.env_overrides = dict(env)
+
     def force_complete(self) -> None:
         for phase in self.phases:
             phase.force_complete()

@@ -45,6 +45,11 @@ class PodInstanceRequirement:
     instances: List[int]
     tasks_to_launch: List[str] = field(default_factory=list)
     recovery_type: RecoveryType = RecoveryType.NONE
+    # operator-supplied env merged into every launched task, set by a
+    # parameterized `plan start` (reference: PlansQueries.java:47-231
+    # start-with-env — what makes cassandra's backup/restore sidecar
+    # plans operable: snapshot name, external location)
+    env_overrides: Dict[str, str] = field(default_factory=dict)
 
     def __post_init__(self) -> None:
         if not self.tasks_to_launch:

@@ -88,6 +88,8 @@ def make_instance_lock(config: SchedulerConfig, name: str):
             name=name,
             owner=f"{_socket.gethostname()}-{os.getpid()}",
             ttl_s=config.state_lease_ttl_s,
+            auth_token=config.auth_token,
+            ca_file=config.tls_ca_file,
         )
     return InstanceLock(config.state_dir)
 
@@ -187,6 +189,22 @@ class FrameworkRunner:
         # (the server's own loopback URL) is meaningless on other hosts
         self.advertise_url: str = ""
         self._stop_requested = threading.Event()
+        self._lease_lost: Optional[str] = None
+        self._wire_lease_loss()
+
+    def _wire_lease_loss(self) -> None:
+        """Lease loss is fatal (reference: CuratorLocker exits the
+        process on ZK lock loss) — a second active scheduler over the
+        same state tree corrupts plans, so stop immediately."""
+        if not hasattr(self._lock, "on_lost"):
+            return
+
+        def on_lost(reason: str) -> None:
+            LOG.critical("instance lease lost: %s — stopping", reason)
+            self._lease_lost = reason
+            self.stop()
+
+        self._lock.on_lost = on_lost
 
     # -- assembly -----------------------------------------------------
 
@@ -198,6 +216,8 @@ class FrameworkRunner:
             fleet = RemoteFleet(
                 on_host_down=inventory.mark_down,
                 on_host_up=inventory.mark_up,
+                auth_token=self.config.auth_token,
+                ca_file=self.config.tls_ca_file,
             )
             for host_id, url in self.agent_urls.items():
                 fleet.add_host(host_id, url)
@@ -246,6 +266,8 @@ class FrameworkRunner:
             port=self.config.api_port,
             host=self.api_bind,
             extra_routes=extra_routes,
+            auth_token=self.config.auth_token,
+            tls=self.config.api_tls,
         ).start()
         thread = None
         try:
@@ -283,6 +305,10 @@ class FrameworkRunner:
             if thread is not None:
                 thread.join(timeout=10)
             self.api_server.stop()
+        if self._lease_lost:
+            # another scheduler may already be active over this state
+            LOG.critical("exiting after lease loss: %s", self._lease_lost)
+            return EXIT_LOCKED
         fatal = getattr(self.scheduler, "fatal_error", None)
         if fatal:
             LOG.critical("scheduler wedged: %s", fatal)
@@ -330,6 +356,9 @@ class MultiFrameworkRunner:
         self.advertise_url: str = ""
         self._stop_requested = threading.Event()
         self._lock = make_instance_lock(self.config, "multi-scheduler")
+        self._lease_lost: Optional[str] = None
+        # same CuratorLocker-style fatality as FrameworkRunner
+        FrameworkRunner._wire_lease_loss(self)
 
     def build(self) -> None:
         from dcos_commons_tpu.multi import MultiServiceScheduler
@@ -342,6 +371,8 @@ class MultiFrameworkRunner:
             fleet = RemoteFleet(
                 on_host_down=inventory.mark_down,
                 on_host_up=inventory.mark_up,
+                auth_token=self.config.auth_token,
+                ca_file=self.config.tls_ca_file,
             )
             for host_id, url in self.agent_urls.items():
                 fleet.add_host(host_id, url)
@@ -354,7 +385,11 @@ class MultiFrameworkRunner:
             from dcos_commons_tpu.storage import PersisterCache
             from dcos_commons_tpu.storage.remote import RemotePersister
 
-            persister = PersisterCache(RemotePersister(self.config.state_url))
+            persister = PersisterCache(RemotePersister(
+                self.config.state_url,
+                auth_token=self.config.auth_token,
+                ca_file=self.config.tls_ca_file,
+            ))
         else:
             from dcos_commons_tpu.storage import FileWalPersister
 
@@ -391,7 +426,8 @@ class MultiFrameworkRunner:
             LOG.exception("invalid configuration")
             return EXIT_BAD_CONFIG
         self.api_server = ApiServer(
-            port=self.config.api_port, host=self.api_bind, multi=self.multi
+            port=self.config.api_port, host=self.api_bind, multi=self.multi,
+            auth_token=self.config.auth_token, tls=self.config.api_tls,
         ).start()
         thread = None
         try:
@@ -424,6 +460,9 @@ class MultiFrameworkRunner:
             if thread is not None:
                 thread.join(timeout=10)
             self.api_server.stop()
+        if self._lease_lost:
+            LOG.critical("exiting after lease loss: %s", self._lease_lost)
+            return EXIT_LOCKED
         if getattr(self.multi, "fatal_error", None):
             LOG.critical("multi scheduler wedged: %s", self.multi.fatal_error)
             return EXIT_WEDGED
@@ -499,6 +538,22 @@ def serve_main(
         help="externally-reachable API URL handed to agents for "
              "artifact pulls (required when agents run on other hosts)",
     )
+    parser.add_argument(
+        "--auth-token-file",
+        default="",
+        help="cluster bearer token file — required on every control-"
+             "plane request (API, agents, state server) when set; "
+             "also $AUTH_TOKEN(_FILE)",
+    )
+    parser.add_argument("--tls-cert", default="",
+                        help="serve the API over HTTPS: cert PEM")
+    parser.add_argument("--tls-key", default="",
+                        help="serve the API over HTTPS: key PEM")
+    parser.add_argument(
+        "--tls-ca", default="",
+        help="CA bundle for verifying agent/state-server HTTPS; "
+             "also $TLS_CA_FILE",
+    )
     args = parser.parse_args(argv)
 
     logging.basicConfig(
@@ -520,6 +575,31 @@ def serve_main(
         config.secrets_dir = args.secrets_dir
     if args.sandbox_root is not None:
         config.sandbox_root = args.sandbox_root
+    if args.auth_token_file:
+        from dcos_commons_tpu.security.auth import load_token
+
+        config.auth_token = load_token(token_file=args.auth_token_file)
+    if args.tls_cert:
+        config.tls_cert_file = args.tls_cert
+    if args.tls_key:
+        config.tls_key_file = args.tls_key
+    if args.tls_ca:
+        config.tls_ca_file = args.tls_ca
+    try:
+        config.api_tls  # half a cert/key pair is a config error
+    except ValueError as e:
+        print(f"configuration error: {e}", file=sys.stderr)
+        return EXIT_BAD_CONFIG
+    if not config.auth_token and args.bind not in (
+        "127.0.0.1", "localhost", "::1"
+    ):
+        print(
+            "WARNING: scheduler API bound on a non-loopback address with "
+            "NO auth token — any reachable client can drive plans and "
+            "kill tasks. Pass --auth-token-file "
+            "(see security/auth.py trust model).",
+            file=sys.stderr,
+        )
     try:
         if not args.multi and len(args.svc_yml) != 1:
             raise ValueError(

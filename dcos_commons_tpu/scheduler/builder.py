@@ -116,7 +116,11 @@ class SchedulerBuilder:
                 # behind the full-tree cache so reads never leave RAM
                 from dcos_commons_tpu.storage.remote import RemotePersister
 
-                persister = RemotePersister(self._config.state_url)
+                persister = RemotePersister(
+                    self._config.state_url,
+                    auth_token=self._config.auth_token,
+                    ca_file=self._config.tls_ca_file,
+                )
                 if self._config.state_cache_enabled:
                     persister = PersisterCache(persister)
             else:

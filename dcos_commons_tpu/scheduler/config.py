@@ -43,6 +43,21 @@ class SchedulerConfig:
     sandbox_root: str = "./sandboxes"
     # coordinator port range for pjit rendezvous
     coordinator_port_base: int = 8476
+    # control-plane credentials (security/auth.py): one cluster bearer
+    # token shared by scheduler API, agent daemons, and state server;
+    # TLS material for serving HTTPS / verifying peers
+    auth_token: str = ""
+    tls_ca_file: str = ""
+    tls_cert_file: str = ""
+    tls_key_file: str = ""
+
+    @property
+    def api_tls(self):
+        """(cert, key) for the scheduler's own HTTPS, or None.
+        Raises ValueError on half a pair (no silent plaintext)."""
+        from dcos_commons_tpu.security.auth import tls_pair
+
+        return tls_pair(self.tls_cert_file, self.tls_key_file)
 
     @staticmethod
     def from_env(env: Optional[Mapping[str, str]] = None) -> "SchedulerConfig":
@@ -69,4 +84,14 @@ class SchedulerConfig:
             revive_refill_s=float(env.get("REVIVE_REFILL_S", "5.0")),
             sandbox_root=env.get("SANDBOX_ROOT", "./sandboxes"),
             coordinator_port_base=int(env.get("COORDINATOR_PORT_BASE", "8476")),
+            auth_token=_load_token(env),
+            tls_ca_file=env.get("TLS_CA_FILE", ""),
+            tls_cert_file=env.get("TLS_CERT_FILE", ""),
+            tls_key_file=env.get("TLS_KEY_FILE", ""),
         )
+
+
+def _load_token(env: Mapping[str, str]) -> str:
+    from dcos_commons_tpu.security.auth import load_token
+
+    return load_token(env=env)

@@ -115,7 +115,18 @@ class CertificateAuthority:
                 critical=True,
             )
         )
-        alt_names = [x509.DNSName(n) for n in (sans or []) if n]
+        import ipaddress
+
+        alt_names: list = []
+        for n in (sans or []):
+            if not n:
+                continue
+            try:
+                # IP literals must land in iPAddress SANs or client
+                # hostname verification of e.g. https://127.0.0.1 fails
+                alt_names.append(x509.IPAddress(ipaddress.ip_address(n)))
+            except ValueError:
+                alt_names.append(x509.DNSName(n))
         if alt_names:
             builder = builder.add_extension(
                 x509.SubjectAlternativeName(alt_names), critical=False

@@ -55,19 +55,35 @@ from dcos_commons_tpu.storage.persister import (
 )
 
 
+LEASE_PREFIX = "/__cluster__/leases"
+
+
 class StateServer:
-    """HTTP front end over one local Persister (the cluster's ZK)."""
+    """HTTP front end over one local Persister (the cluster's ZK).
+
+    Leases are persisted through the backend (wall-clock expiry), so a
+    state-server restart does NOT silently drop the scheduler instance
+    lock — the reference's ZK ephemerals survive a ZK follower bounce
+    the same way (CuratorLocker over a ZK ensemble)."""
 
     def __init__(
         self,
         backend: Optional[Persister] = None,
         port: int = 0,
         bind: str = "127.0.0.1",
+        auth_token: str = "",
+        tls=None,
+        advertise_host: str = "",
     ):
+        from dcos_commons_tpu.security import auth as _auth
+
         self._backend = backend or MemPersister()
         self._lock = threading.RLock()
-        # lease name -> (owner, expiry monotonic deadline)
-        self._leases: Dict[str, Tuple[str, float]] = {}
+        # lease name -> (owner, wall-clock expiry); mirrored to the
+        # backend under LEASE_PREFIX on every mutation
+        self._leases: Dict[str, Tuple[str, float]] = self._load_leases()
+        self.advertise_host = advertise_host
+        self._scheme = _auth.url_scheme(tls)
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -83,6 +99,11 @@ class StateServer:
                 self.wfile.write(payload)
 
             def do_POST(self):
+                # ALL state routes are mutating or state-revealing:
+                # with a token set there is no anonymous surface
+                if not _auth.check_bearer(self.headers, auth_token):
+                    self._reply(*_auth.UNAUTHORIZED)
+                    return
                 length = int(self.headers.get("Content-Length", 0))
                 try:
                     body = json.loads(self.rfile.read(length) or b"{}")
@@ -92,8 +113,39 @@ class StateServer:
                 except Exception as e:
                     self._reply(500, {"error": repr(e)})
 
-        self._server = ThreadingHTTPServer((bind, port), Handler)
+        self._server = _auth.wrap_http_server(
+            ThreadingHTTPServer((bind, port), Handler), tls
+        )
         self._thread: Optional[threading.Thread] = None
+
+    # -- lease persistence --------------------------------------------
+
+    def _load_leases(self) -> Dict[str, Tuple[str, float]]:
+        leases: Dict[str, Tuple[str, float]] = {}
+        try:
+            names = self._backend.get_children(LEASE_PREFIX)
+        except PersisterError:
+            return leases
+        for name in names:
+            try:
+                raw = self._backend.get(f"{LEASE_PREFIX}/{name}")
+                entry = json.loads(raw or b"{}")
+                leases[name] = (entry["owner"], float(entry["expires_at"]))
+            except (PersisterError, KeyError, ValueError):
+                continue
+        return leases
+
+    def _store_lease(self, name: str, owner: str, expires_at: float) -> None:
+        self._backend.set(
+            f"{LEASE_PREFIX}/{name}",
+            json.dumps({"owner": owner, "expires_at": expires_at}).encode(),
+        )
+
+    def _drop_lease(self, name: str) -> None:
+        try:
+            self._backend.recursive_delete(f"{LEASE_PREFIX}/{name}")
+        except PersisterError:
+            pass
 
     # -- request handling ---------------------------------------------
 
@@ -154,7 +206,10 @@ class StateServer:
             raise PersisterError(f"no route {route}")
 
     def _acquire(self, name: str, owner: str, ttl_s: float) -> dict:
-        now = time.monotonic()
+        # wall-clock expiry (not monotonic): leases must survive a
+        # state-server restart via the backend, and monotonic clocks
+        # don't cross processes
+        now = time.time()
         held = self._leases.get(name)
         if held is not None and held[1] > now and held[0] != owner:
             return {
@@ -164,12 +219,14 @@ class StateServer:
             }
         # fresh acquire or renewal by the current owner
         self._leases[name] = (owner, now + ttl_s)
+        self._store_lease(name, owner, now + ttl_s)
         return {"acquired": True, "owner": owner}
 
     def _release(self, name: str, owner: str) -> dict:
         held = self._leases.get(name)
         if held is not None and held[0] == owner:
             del self._leases[name]
+            self._drop_lease(name)
             return {"released": True}
         return {"released": False}
 
@@ -178,7 +235,14 @@ class StateServer:
     @property
     def url(self) -> str:
         host, port = self._server.server_address[:2]
-        return f"http://{host}:{port}"
+        if self.advertise_host:
+            host = self.advertise_host
+        elif host in ("0.0.0.0", "::"):
+            # announce files must carry a dialable address (ADVICE r2)
+            import socket
+
+            host = socket.gethostname()
+        return f"{self._scheme}://{host}:{port}"
 
     def start(self) -> "StateServer":
         self._thread = threading.Thread(
@@ -201,18 +265,29 @@ class RemotePersister(Persister):
     the scheduler treats a dead state server like the reference treats
     a ZK outage: fail the cycle, crash to restart."""
 
-    def __init__(self, base_url: str, timeout_s: float = 10.0):
+    def __init__(self, base_url: str, timeout_s: float = 10.0,
+                 auth_token: str = "", ca_file: str = ""):
+        from dcos_commons_tpu.security import auth as _auth
+
         self._base = base_url.rstrip("/")
         self._timeout_s = timeout_s
+        self._headers = {"Content-Type": "application/json",
+                         **_auth.auth_headers(auth_token)}
+        self._ssl_ctx = (
+            _auth.client_ssl_context(ca_file)
+            if self._base.startswith("https") else None
+        )
 
     def _call(self, route: str, body: dict) -> dict:
         data = json.dumps(body).encode("utf-8")
         req = urllib.request.Request(
             f"{self._base}{route}", data=data,
-            headers={"Content-Type": "application/json"}, method="POST",
+            headers=dict(self._headers), method="POST",
         )
         try:
-            with urllib.request.urlopen(req, timeout=self._timeout_s) as resp:
+            with urllib.request.urlopen(
+                req, timeout=self._timeout_s, context=self._ssl_ctx
+            ) as resp:
                 return json.loads(resp.read().decode("utf-8"))
         except urllib.error.HTTPError as e:
             try:
@@ -268,6 +343,14 @@ class RemoteLocker:
     at a third of the TTL; if the holder dies, the lease expires and a
     standby scheduler's next acquire succeeds — real failover, not a
     per-host file lock.
+
+    Lease LOSS is fatal to the holder: if a renewal comes back
+    ``acquired=false`` (someone else took the lease — we stalled past
+    the TTL) or the server stays unreachable beyond the TTL, the
+    renewal thread fires ``on_lost`` exactly once and stops.  The
+    runner wires ``on_lost`` to crash the scheduler — the reference's
+    CuratorLocker exits the process on ZK lock loss for the same
+    reason: two active schedulers over one state tree corrupt plans.
     """
 
     def __init__(
@@ -277,11 +360,17 @@ class RemoteLocker:
         owner: str,
         ttl_s: float = 15.0,
         timeout_s: float = 5.0,
+        auth_token: str = "",
+        ca_file: str = "",
     ):
-        self._persister = RemotePersister(base_url, timeout_s)
+        self._persister = RemotePersister(
+            base_url, timeout_s, auth_token=auth_token, ca_file=ca_file
+        )
         self.name = name
         self.owner = owner
         self.ttl_s = ttl_s
+        # callable(reason: str); set before or after acquire()
+        self.on_lost = None
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -306,12 +395,30 @@ class RemoteLocker:
         return True
 
     def _renew_loop(self) -> None:
+        last_renewed = time.monotonic()
         while not self._stop.wait(self.ttl_s / 3.0):
             try:
-                self._acquire_once()
-            except PersisterError:
-                pass  # server hiccup: the lease may lapse; the next
-                # renewal re-takes it if nobody else has
+                if self._acquire_once():
+                    last_renewed = time.monotonic()
+                    continue
+                # someone else holds OUR lease: we stalled past the
+                # TTL and a standby took over — we are no longer the
+                # instance and must not keep mutating state
+                self._lost("lease taken by another scheduler instance")
+                return
+            except PersisterError as e:
+                # transient hiccups are survivable while the lease is
+                # still live; once we cannot renew for a full TTL the
+                # lease has lapsed server-side and a standby may hold
+                # it — same outcome as above
+                if time.monotonic() - last_renewed > self.ttl_s:
+                    self._lost(f"state server unreachable past TTL: {e}")
+                    return
+
+    def _lost(self, reason: str) -> None:
+        callback = self.on_lost
+        if callback is not None:
+            callback(reason)
 
     def release(self) -> None:
         self._stop.set()
@@ -335,14 +442,42 @@ def main(argv: Optional[list] = None) -> int:
     parser = argparse.ArgumentParser(prog="dcos_commons_tpu state-server")
     parser.add_argument("--port", type=int, default=0)
     parser.add_argument("--bind", default="127.0.0.1")
+    parser.add_argument(
+        "--advertise-host", default="",
+        help="hostname/IP to announce instead of the bind address "
+             "(required when binding 0.0.0.0 on a multi-host fleet)",
+    )
     parser.add_argument("--data-dir", default="./state-server")
     parser.add_argument(
         "--announce-file", default="",
         help="write the URL here once listening (ephemeral ports)",
     )
+    parser.add_argument(
+        "--auth-token-file", default="",
+        help="cluster bearer token file; also $AUTH_TOKEN(_FILE)",
+    )
+    parser.add_argument("--tls-cert", default="", help="serve HTTPS: cert PEM")
+    parser.add_argument("--tls-key", default="", help="serve HTTPS: key PEM")
     args = parser.parse_args(argv)
+    from dcos_commons_tpu.security.auth import load_token
+
+    token = load_token(token_file=args.auth_token_file)
+    if not token and args.bind not in ("127.0.0.1", "localhost", "::1"):
+        import sys
+
+        print(
+            "WARNING: state server bound on a non-loopback address with NO "
+            "auth token — anyone who can reach this port can clobber all "
+            "cluster state. Pass --auth-token-file.",
+            file=sys.stderr,
+        )
+    from dcos_commons_tpu.agent.daemon import _tls_pair_or_die
+
     server = StateServer(
-        FileWalPersister(args.data_dir), port=args.port, bind=args.bind
+        FileWalPersister(args.data_dir), port=args.port, bind=args.bind,
+        auth_token=token,
+        tls=_tls_pair_or_die(args.tls_cert, args.tls_key),
+        advertise_host=args.advertise_host,
     )
     if args.announce_file:
         from dcos_commons_tpu.common import atomic_write_text

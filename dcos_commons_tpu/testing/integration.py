@@ -156,7 +156,8 @@ def _read_announce(path: str, timeout_s: float = 20.0) -> str:
 class AgentProcess:
     """One agent daemon subprocess (a simulated TPU-VM host)."""
 
-    def __init__(self, host_id: str, workdir: str, repo_root: str = ""):
+    def __init__(self, host_id: str, workdir: str, repo_root: str = "",
+                 extra_args: Optional[List[str]] = None):
         self.host_id = host_id
         self.workdir = workdir
         announce = os.path.join(workdir, "announce")
@@ -170,6 +171,7 @@ class AgentProcess:
                 "--host-id", host_id,
                 "--workdir", os.path.join(workdir, "sandboxes"),
                 "--announce-file", announce,
+                *(extra_args or []),
             ],
             cwd=repo_root or None,
             stdout=self._log,
@@ -208,7 +210,11 @@ class SchedulerProcess:
         repo_root: str = "",
         wait_listening: bool = True,
         extra_args: Optional[List[str]] = None,
+        auth_token: str = "",
+        ca_file: str = "",
     ):
+        self.auth_token = auth_token
+        self.ca_file = ca_file
         self.workdir = workdir
         self._svc_yml = svc_yml
         self._topology_yml = topology_yml
@@ -241,7 +247,9 @@ class SchedulerProcess:
         self.url = _read_announce(announce) if wait_listening else ""
 
     def client(self) -> ServiceClient:
-        return ServiceClient(self.url)
+        return ServiceClient(
+            self.url, auth_token=self.auth_token, ca_file=self.ca_file
+        )
 
     def terminate(self) -> int:
         if self.process.poll() is None:
@@ -276,6 +284,8 @@ class SchedulerProcess:
             env={**(self._env or {}), **(env or {})},
             repo_root=self._repo_root,
             extra_args=self._extra_args,
+            auth_token=self.auth_token,
+            ca_file=self.ca_file,
         )
         client = successor.client()
 

@@ -1,0 +1,370 @@
+"""Control-plane authentication: bearer token + TLS on every surface.
+
+Reference posture: the reference authenticates every control-plane hop
+(dcos/auth token providers, ServiceAccountIAMTokenClient, ZK ACLs in
+CuratorPersister.java:43-110).  These tests prove the rebuild's
+analogue: with a cluster token set, anonymous launch / kill / kv-set /
+plan verbs are rejected with 401 on the scheduler API, the agent
+daemons, and the state server — while the authenticated deploy /
+recovery flow still works end to end across real processes.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from dcos_commons_tpu.http import ApiServer
+from dcos_commons_tpu.agent.daemon import AgentDaemon
+from dcos_commons_tpu.security.auth import auth_headers, certs_main
+from dcos_commons_tpu.storage.file_persister import FileWalPersister
+from dcos_commons_tpu.storage.persister import PersisterError
+from dcos_commons_tpu.storage.remote import (
+    RemoteLocker,
+    RemotePersister,
+    StateServer,
+)
+from dcos_commons_tpu.testing import (
+    AdvanceCycles,
+    ExpectDeploymentComplete,
+    SendTaskRunning,
+    ServiceTestRunner,
+)
+from dcos_commons_tpu.testing.integration import (
+    AgentProcess,
+    SchedulerProcess,
+    wait_for,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TOKEN = "test-cluster-token-0123456789abcdef"
+
+YAML = """
+name: authed
+pods:
+  web:
+    count: 1
+    tasks:
+      srv:
+        goal: RUNNING
+        cmd: "serve"
+        cpus: 0.1
+        memory: 32
+"""
+
+
+def http(method, url, body=None, token="", expect=200):
+    data = json.dumps(body).encode() if body is not None else (
+        b"" if method in ("POST", "PUT") else None
+    )
+    req = urllib.request.Request(
+        url, data=data, method=method, headers=auth_headers(token)
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            code, raw = resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        code, raw = e.code, e.read()
+    assert code == expect, f"{method} {url} -> {code}: {raw[:200]}"
+    return json.loads(raw) if raw else None
+
+
+# ---------------------------------------------------------------------------
+# scheduler API
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def api():
+    runner = ServiceTestRunner(YAML)
+    runner.run([
+        AdvanceCycles(1),
+        SendTaskRunning("web-0-srv"),
+        ExpectDeploymentComplete(),
+    ])
+    server = ApiServer(runner.world.scheduler, auth_token=TOKEN).start()
+    yield server
+    server.stop()
+
+
+def test_api_rejects_anonymous_reads_and_verbs(api):
+    http("GET", api.url + "/v1/plans", expect=401)
+    http("POST", api.url + "/v1/plans/deploy/interrupt", expect=401)
+    http("POST", api.url + "/v1/pod/web-0/restart", expect=401)
+    # wrong token is as good as none
+    http("GET", api.url + "/v1/plans", token="wrong", expect=401)
+
+
+def test_api_health_stays_open_for_probes(api):
+    body = http("GET", api.url + "/v1/health")
+    assert body["healthy"]
+
+
+def test_api_accepts_bearer_token(api):
+    plans = http("GET", api.url + "/v1/plans", token=TOKEN)
+    assert "deploy" in plans
+    http("POST", api.url + "/v1/plans/deploy/restart", token=TOKEN)
+
+
+# ---------------------------------------------------------------------------
+# agent daemon
+# ---------------------------------------------------------------------------
+
+
+def test_agent_daemon_rejects_anonymous_everything(tmp_path):
+    daemon = AgentDaemon("h0", str(tmp_path), auth_token=TOKEN).start()
+    try:
+        base = daemon.url
+        # launch IS remote command execution — the critical 401
+        http("POST", base + "/v1/agent/launch", body={"tasks": []},
+             expect=401)
+        http("POST", base + "/v1/agent/kill",
+             body={"task_id": "x"}, expect=401)
+        http("GET", base + "/v1/agent/info", expect=401)
+        http("GET", base + "/v1/agent/sandbox?task=a&file=stdout",
+             expect=401)
+        # the holder of the cluster token proceeds
+        out = http("POST", base + "/v1/agent/launch",
+                   body={"tasks": []}, token=TOKEN)
+        assert out == {"launched": []}
+        info = http("GET", base + "/v1/agent/info", token=TOKEN)
+        assert info["host_id"] == "h0"
+    finally:
+        daemon.stop()
+
+
+# ---------------------------------------------------------------------------
+# state server
+# ---------------------------------------------------------------------------
+
+
+def test_state_server_rejects_anonymous_kv(tmp_path):
+    server = StateServer(
+        FileWalPersister(str(tmp_path / "wal")), auth_token=TOKEN
+    ).start()
+    try:
+        http("POST", server.url + "/v1/kv/set",
+             body={"path": "/x", "value": "aGk="}, expect=401)
+        anon = RemotePersister(server.url)
+        with pytest.raises(PersisterError):
+            anon.set("/x", b"clobber")
+        authed = RemotePersister(server.url, auth_token=TOKEN)
+        authed.set("/x", b"hi")
+        assert authed.get("/x") == b"hi"
+    finally:
+        server.stop()
+
+
+def test_leases_survive_state_server_restart(tmp_path):
+    """ADVICE r2: leases were in-memory only — a state-server restart
+    silently dropped the scheduler instance lock.  Now they persist
+    through the backend WAL."""
+    wal_dir = str(tmp_path / "wal")
+    server = StateServer(FileWalPersister(wal_dir), auth_token=TOKEN).start()
+    holder = RemoteLocker(
+        server.url, "svc", "holder-1", ttl_s=30.0, auth_token=TOKEN
+    )
+    assert holder.acquire()
+    holder._stop.set()  # stop renewals; the lease itself stays live
+    server.stop()
+
+    revived = StateServer(FileWalPersister(wal_dir), auth_token=TOKEN).start()
+    try:
+        standby = RemoteLocker(
+            revived.url, "svc", "standby-2", ttl_s=30.0, auth_token=TOKEN
+        )
+        assert not standby.acquire(), (
+            "standby must NOT steal a live lease across a state-server "
+            "restart"
+        )
+    finally:
+        revived.stop()
+
+
+def test_locker_fires_on_lost_when_lease_stolen(tmp_path):
+    """ADVICE r2: a holder that stalls past the TTL must learn it lost
+    the lease (CuratorLocker exits the process on ZK lock loss)."""
+    server = StateServer(auth_token=TOKEN).start()
+    lost = threading.Event()
+    reasons = []
+    try:
+        holder = RemoteLocker(
+            server.url, "svc", "holder-1", ttl_s=0.9, auth_token=TOKEN
+        )
+        holder.on_lost = lambda reason: (reasons.append(reason), lost.set())
+        assert holder.acquire()
+        # simulate the holder stalling past the TTL: expire its lease
+        # server-side and hand it to a standby
+        with server._lock:
+            server._leases["svc"] = ("standby-2", time.time() + 60)
+        assert lost.wait(5.0), "on_lost never fired"
+        assert "another scheduler" in reasons[0]
+    finally:
+        server.stop()
+
+
+def test_state_server_tls_roundtrip(tmp_path):
+    """HTTPS from the in-repo CA: client verifies the server cert."""
+    certs = str(tmp_path / "certs")
+    certs_main(["--dir", certs, "--hosts", "127.0.0.1"])
+    server = StateServer(
+        auth_token=TOKEN,
+        tls=(os.path.join(certs, "127.0.0.1.cert.pem"),
+             os.path.join(certs, "127.0.0.1.key.pem")),
+    ).start()
+    try:
+        assert server.url.startswith("https://")
+        client = RemotePersister(
+            server.url, auth_token=TOKEN,
+            ca_file=os.path.join(certs, "ca.pem"),
+        )
+        client.set("/tls-check", b"encrypted")
+        assert client.get("/tls-check") == b"encrypted"
+        # a client that does NOT trust the CA refuses the connection
+        untrusting = RemotePersister(server.url, auth_token=TOKEN)
+        with pytest.raises(PersisterError):
+            untrusting.set("/x", b"y")
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# serve e2e: the full control plane under one cluster token
+# ---------------------------------------------------------------------------
+
+
+def test_serve_e2e_authenticated_control_plane(tmp_path):
+    """Scheduler + agents + state server all require the token;
+    anonymous launch/kill/kv-set/plan verbs are rejected while the
+    authenticated deploy completes across real processes."""
+    token_file = tmp_path / "token"
+    token_file.write_text(TOKEN + "\n")
+    auth_args = ["--auth-token-file", str(token_file)]
+    run_env = {**os.environ, "AUTH_TOKEN": ""}
+
+    import subprocess
+    import sys
+
+    state_announce = tmp_path / "state-announce"
+    state_log = open(tmp_path / "state.log", "ab")
+    state_proc = subprocess.Popen(
+        [sys.executable, "-m", "dcos_commons_tpu", "state-server",
+         "--data-dir", str(tmp_path / "cluster-state"),
+         "--announce-file", str(state_announce), *auth_args],
+        cwd=REPO, stdout=state_log, stderr=subprocess.STDOUT, env=run_env,
+    )
+    agents = []
+    scheduler = None
+    try:
+        state_url = wait_for(
+            lambda: state_announce.exists()
+            and state_announce.read_text().strip(),
+            what="state server announce",
+        )
+        agents = [
+            AgentProcess(f"h{i}", str(tmp_path / f"agent-{i}"), REPO,
+                         extra_args=auth_args)
+            for i in range(2)
+        ]
+        topology = tmp_path / "topology.yml"
+        topology.write_text("hosts:\n" + "".join(
+            f"  - host_id: {a.host_id}\n    agent_url: {a.url}\n"
+            "    cpus: 4.0\n    memory_mb: 8192\n"
+            for a in agents
+        ))
+        svc = tmp_path / "svc.yml"
+        svc.write_text(
+            "name: webfarm\n"
+            "pods:\n"
+            "  app:\n"
+            "    count: 2\n"
+            "    placement: 'max-per-host:1'\n"
+            "    tasks:\n"
+            "      server:\n"
+            "        goal: RUNNING\n"
+            "        cmd: \"sleep 120\"\n"
+            "        cpus: 0.1\n"
+            "        memory: 32\n"
+        )
+        scheduler = SchedulerProcess(
+            str(svc), str(topology), str(tmp_path / "scheduler"),
+            env={"ENABLE_BACKOFF": "false"},
+            repo_root=REPO,
+            extra_args=[*auth_args, "--state-url", state_url],
+            auth_token=TOKEN,
+        )
+        client = scheduler.client()
+        client.wait_for_completed_deployment(timeout_s=90)
+
+        # every anonymous mutation on every surface: rejected
+        http("POST", scheduler.url + "/v1/plans/deploy/restart", expect=401)
+        http("POST", agents[0].url + "/v1/agent/launch",
+             body={"tasks": [{"info": {
+                 "task_id": "evil", "name": "evil", "cmd": "id",
+             }}]}, expect=401)
+        http("POST", agents[0].url + "/v1/agent/kill",
+             body={"task_id": "app-0-server"}, expect=401)
+        http("POST", state_url + "/v1/kv/set",
+             body={"path": "/pwn", "value": "cHdu"}, expect=401)
+
+        # the authenticated plane still works end to end
+        assert set(client.task_ids()) == {"app-0-server", "app-1-server"}
+        health = client.get("/v1/health")
+        assert health["healthy"]
+    finally:
+        if scheduler is not None:
+            scheduler.terminate()
+        for agent in agents:
+            agent.stop()
+        state_proc.terminate()
+        state_proc.wait(timeout=10)
+        state_log.close()
+
+
+def test_partial_tls_config_is_a_hard_error():
+    """Half a cert/key pair must refuse to start, not silently serve
+    plaintext (code-review r3)."""
+    from dcos_commons_tpu.scheduler.config import SchedulerConfig
+    from dcos_commons_tpu.security.auth import tls_pair
+
+    with pytest.raises(ValueError):
+        tls_pair("cert.pem", "")
+    with pytest.raises(ValueError):
+        tls_pair("", "key.pem")
+    assert tls_pair("", "") is None
+    assert tls_pair("c", "k") == ("c", "k")
+    with pytest.raises(ValueError):
+        SchedulerConfig(tls_cert_file="cert.pem").api_tls
+
+
+def test_tls_handshake_stall_does_not_freeze_server(tmp_path):
+    """A client that opens TCP and never speaks TLS must not block the
+    accept loop (code-review r3): other clients keep being served."""
+    import socket
+
+    certs = str(tmp_path / "certs")
+    certs_main(["--dir", certs, "--hosts", "127.0.0.1"])
+    server = StateServer(
+        auth_token=TOKEN,
+        tls=(os.path.join(certs, "127.0.0.1.cert.pem"),
+             os.path.join(certs, "127.0.0.1.key.pem")),
+    ).start()
+    stalled = socket.create_connection(
+        ("127.0.0.1", int(server.url.rsplit(":", 1)[1])), timeout=5
+    )
+    try:
+        time.sleep(0.2)  # let the server accept the silent connection
+        client = RemotePersister(
+            server.url, auth_token=TOKEN,
+            ca_file=os.path.join(certs, "ca.pem"), timeout_s=5.0,
+        )
+        client.set("/alive", b"yes")
+        assert client.get("/alive") == b"yes"
+    finally:
+        stalled.close()
+        server.stop()

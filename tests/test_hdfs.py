@@ -298,3 +298,93 @@ def test_custom_namenodes_endpoint_served(tmp_path):
     finally:
         proc.terminate()
         proc.wait(timeout=20)
+
+
+def test_backup_restore_sidecar_plans_via_cli(tmp_path):
+    """Parameterized sidecar plans end to end, all via CLI verbs:
+    `plan start backup -p BACKUP_DIR=...` snapshots every data
+    volume's payload, the payload is destroyed on disk, and
+    `plan start restore -p BACKUP_DIR=...` brings it back intact.
+
+    Reference: cassandra's backup/restore sidecar plans driven by
+    PlansQueries start-with-env (PlansQueries.java:47-231,
+    frameworks/cassandra/src/main/dist/svc.yml)."""
+    import glob
+    import json
+    import subprocess
+    import time
+
+    topo = tmp_path / "topology.yml"
+    topo.write_text("hosts:\n" + "".join(
+        f"  - host_id: h{i}\n    cpus: 8\n    memory_mb: 8192\n"
+        for i in range(3)
+    ))
+    proc = subprocess.Popen(
+        [sys.executable, "frameworks/hdfs/scheduler.py",
+         "frameworks/hdfs/svc.yml",
+         "--topology", str(topo), "--port", "0",
+         "--state-dir", str(tmp_path / "state"),
+         "--sandbox-root", str(tmp_path / "sbx"),
+         "--announce-file", str(tmp_path / "announce"),
+         "--env", "SLEEP_DURATION=600",
+         "--env", "JOURNAL_COUNT=1",
+         "--env", "DATA_COUNT=2"],
+        cwd=REPO,
+    )
+    try:
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not (
+            tmp_path / "announce"
+        ).exists():
+            time.sleep(0.1)
+        url = (tmp_path / "announce").read_text().strip()
+
+        def cli(*argv):
+            out = subprocess.run(
+                [sys.executable, "-m", "dcos_commons_tpu", "cli",
+                 "--url", url, *argv],
+                cwd=REPO, capture_output=True, text=True, timeout=30,
+            )
+            assert out.returncode == 0, out.stderr
+            return json.loads(out.stdout)
+
+        def wait_plan(plan, timeout_s=120):
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                if cli("plan", "status", plan)["status"] == "COMPLETE":
+                    return
+                time.sleep(0.5)
+            raise AssertionError(
+                f"plan {plan} not COMPLETE: {cli('plan', 'status', plan)}"
+            )
+
+        wait_plan("deploy")
+
+        # stamp each data volume with a unique payload, then back up
+        data_logs = sorted(glob.glob(
+            str(tmp_path / "sbx" / "data-*-node" / "data-data" / "data.log")
+        ))
+        assert len(data_logs) == 2, data_logs
+        for i, path in enumerate(data_logs):
+            with open(path, "a") as f:
+                f.write(f"precious-payload-{i}\n")
+        originals = {p: open(p).read() for p in data_logs}
+
+        backup_dir = tmp_path / "backups" / "snap-1"
+        cli("plan", "start", "backup",
+            "-p", f"BACKUP_DIR={backup_dir}")
+        wait_plan("backup")
+        assert len(glob.glob(str(backup_dir / "data-*" / "data.log"))) == 2
+
+        # catastrophe: the payload vanishes from every data volume
+        for path in data_logs:
+            os.remove(path)
+
+        cli("plan", "start", "restore",
+            "-p", f"BACKUP_DIR={backup_dir}")
+        wait_plan("restore")
+        for path, content in originals.items():
+            assert open(path).read() == content, f"payload lost: {path}"
+    finally:
+        proc.terminate()
+        proc.wait(timeout=20)
