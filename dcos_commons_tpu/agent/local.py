@@ -531,6 +531,22 @@ class LocalProcessAgent:
             running.kill_deadline = (
                 time.monotonic() + grace_period_s + margin
             )
+            if running.native and grace_period_s > 0:
+                # hand the REQUESTED grace to the supervisor (it reads
+                # record_dir/grace on SIGTERM) — the launch-time --grace
+                # is only the default, and e.g. pod replace may ask for
+                # a different drain than the spec's kill-grace-period
+                from dcos_commons_tpu.common import atomic_write_text
+
+                try:
+                    atomic_write_text(
+                        os.path.join(
+                            running.record_dir or running.sandbox, "grace"
+                        ),
+                        f"{grace_period_s}\n",
+                    )
+                except OSError:
+                    pass  # supervisor falls back to the launch grace
             try:
                 if running.native:
                     os.kill(running.pid, signal.SIGTERM)

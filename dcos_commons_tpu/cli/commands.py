@@ -113,6 +113,20 @@ def build_parser() -> argparse.ArgumentParser:
         choices=["offers", "plans", "taskStatuses", "reservations"],
     )
 
+    # update (reference: cli/commands/update.go — `update start
+    # --options=...` pushes new options to the RUNNING scheduler,
+    # `update status` watches the resulting rolling update plan)
+    update = sections.add_parser("update").add_subparsers(
+        dest="verb", required=True
+    )
+    p = update.add_parser("start")
+    p.add_argument(
+        "-p", "--param", action="append", default=[], metavar="KEY=VALUE",
+        help="service option override (svc.yml template env), e.g. "
+             "-p SLEEP_DURATION=30",
+    )
+    update.add_parser("status")
+
     sections.add_parser("metrics")
     sections.add_parser("health")
     return parser
@@ -141,11 +155,37 @@ def run(args: argparse.Namespace) -> Any:
         return client.get("/v1/endpoints")
     if section == "debug":
         return client.get(f"/v1/debug/{args.tracker}")
+    if section == "update":
+        return _update(client, args)
     if section == "metrics":
         return client.get("/v1/metrics")
     if section == "health":
         return client.get("/v1/health")
     raise CliError(0, f"unknown section {section}")
+
+
+def _update(client: ApiClient, args) -> Any:
+    if args.verb == "start":
+        env = _parse_params(getattr(args, "param", None))
+        if not env:
+            raise CliError(0, "update start needs at least one -p KEY=VALUE")
+        return client.post("/v1/update", body={"env": env})
+    if args.verb == "status":
+        # the rolling update runs as the deploy/update plan
+        plans = client.get("/v1/plans")
+        name = "update" if "update" in plans else "deploy"
+        return client.get(f"/v1/plans/{name}")
+    raise CliError(0, f"unknown update verb {args.verb}")
+
+
+def _parse_params(pairs) -> dict:
+    env = {}
+    for pair in pairs or []:
+        key, sep, value = pair.partition("=")
+        if not sep or not key:
+            raise CliError(0, f"bad --param {pair!r}; want KEY=VALUE")
+        env[key] = value
+    return env
 
 
 def _plan(client: ApiClient, args) -> Any:
@@ -165,12 +205,7 @@ def _plan(client: ApiClient, args) -> Any:
     if verb == "force-complete":
         return client.post(f"/v1/plans/{args.plan}/forceComplete", params)
     if verb == "start":
-        env = {}
-        for pair in getattr(args, "param", []) or []:
-            key, sep, value = pair.partition("=")
-            if not sep or not key:
-                raise CliError(0, f"bad --param {pair!r}; want KEY=VALUE")
-            env[key] = value
+        env = _parse_params(getattr(args, "param", None))
         return client.post(
             f"/v1/plans/{args.plan}/start",
             body={"env": env} if env else None,

@@ -28,6 +28,11 @@ class SchedulerApi:
     def __init__(self, scheduler):
         self._scheduler = scheduler
 
+    def set_scheduler(self, scheduler) -> None:
+        """Swap the backing scheduler (live options update rebuilds it
+        in-process; the HTTP server and its routes stay up)."""
+        self._scheduler = scheduler
+
     # -- health (reference: http/endpoints/HealthResource.java) -------
 
     def health(self) -> Response:

@@ -153,7 +153,15 @@ class ApiServer:
         # extra_routes is [(method, pattern, handler(match, query))],
         # compiled like the built-ins and matched FIRST
         routes = [compile_route(*entry) for entry in (extra_routes or [])]
-        routes += build_routes(SchedulerApi(scheduler)) if scheduler else []
+        # the api object is long-lived and swappable: a live options
+        # update (POST /v1/update) rebuilds the scheduler in-process
+        # and repoints this server at it via set_scheduler(); custom
+        # routes (which close over the scheduler) are refreshed via
+        # set_extra_routes at the same time
+        self.api = SchedulerApi(scheduler) if scheduler else None
+        routes += build_routes(self.api) if self.api else []
+        self._routes = routes
+        self._extra_count = len(extra_routes or [])
         multi_scheduler = multi
 
         class Handler(BaseHTTPRequestHandler):
@@ -176,7 +184,8 @@ class ApiServer:
                     )
                     self._reply(code, body)
                     return
-                for route_method, pattern, handler, wants_body in routes:
+                # snapshot: set_extra_routes may splice concurrently
+                for route_method, pattern, handler, wants_body in list(routes):
                     if route_method != method:
                         continue
                     match = pattern.match(parsed.path)
@@ -301,6 +310,20 @@ class ApiServer:
         )
         self._scheme = _auth.url_scheme(tls)
         self._thread: Optional[threading.Thread] = None
+
+    def set_scheduler(self, scheduler) -> None:
+        """Repoint every route at a freshly-rebuilt scheduler (live
+        config update — the process and its listening socket survive)."""
+        if self.api is not None:
+            self.api.set_scheduler(scheduler)
+
+    def set_extra_routes(self, extra_routes) -> None:
+        """Replace the CUSTOM route block (framework endpoints close
+        over the scheduler object, so a live update must rebuild them
+        too or they would keep serving the pre-update scheduler)."""
+        compiled = [compile_route(*entry) for entry in extra_routes]
+        self._routes[: self._extra_count] = compiled
+        self._extra_count = len(compiled)
 
     @property
     def port(self) -> int:

@@ -146,6 +146,16 @@ int main(int argc, char** argv) {
   int status = 0;
   for (;;) {
     if (g_term_requested && !term_sent) {
+      // a kill-time override (record_dir/grace, written by the agent
+      // just before SIGTERM) wins over the launch-time --grace: a pod
+      // replace may want a longer drain than the spec default, and an
+      // operator kill a shorter one
+      FILE* gf = fopen((record_dir + "/grace").c_str(), "r");
+      if (gf) {
+        double v = 0.0;
+        if (fscanf(gf, "%lf", &v) == 1 && v >= 0.0) grace_s = v;
+        fclose(gf);
+      }
       kill(-child, SIGTERM);
       term_sent = true;
       kill_deadline = now_s() + grace_s;

@@ -149,6 +149,9 @@ def load_topology(path: str) -> Tuple[List[TpuHost], Dict[str, str]]:
     return hosts, urls
 
 
+OPTIONS_NODE = "service_options"
+
+
 class FrameworkRunner:
     """Build and run one service scheduler as a long-lived process."""
 
@@ -159,11 +162,18 @@ class FrameworkRunner:
         topology_hosts: Optional[List[TpuHost]] = None,
         agent_urls: Optional[Dict[str, str]] = None,
         builder_hook=None,
+        spec_source: Optional[Tuple[str, Dict[str, str]]] = None,
     ):
         self.spec = spec
         self.config = config or SchedulerConfig.from_env()
         self.topology_hosts = topology_hosts or []
         self.agent_urls = agent_urls or {}
+        # (svc_yml_path, base_env): when present the runner can
+        # RE-RENDER the spec with new option env — the live `update`
+        # flow (reference: Cosmos update pushing new options to a
+        # running scheduler, cli/commands.go:39,56).  Applied overrides
+        # persist in the state tree so restarts/failovers keep them.
+        self.spec_source = spec_source
         # hook(builder, spec): framework-specific wiring (recovery
         # overriders, plan customizers) — the Main.java analogue
         self.builder_hook = builder_hook
@@ -189,7 +199,11 @@ class FrameworkRunner:
         # (the server's own loopback URL) is meaningless on other hosts
         self.advertise_url: str = ""
         self._stop_requested = threading.Event()
+        self._reload_requested = threading.Event()
         self._lease_lost: Optional[str] = None
+        self._persister = None
+        self._inventory = None
+        self._agent = None
         self._wire_lease_loss()
 
     def _wire_lease_loss(self) -> None:
@@ -208,31 +222,148 @@ class FrameworkRunner:
 
     # -- assembly -----------------------------------------------------
 
-    def build(self) -> None:
-        inventory = SliceInventory(self.topology_hosts)
+    def _build_infra(self) -> None:
+        """Inventory, agent fleet, and persister live for the whole
+        process — a live options update rebuilds only the scheduler
+        over them (daemon connections and running sandboxes survive)."""
+        if self._inventory is not None:
+            return
+        from dcos_commons_tpu.scheduler.builder import make_persister
+
+        self._inventory = SliceInventory(self.topology_hosts)
         if self.agent_urls:
             from dcos_commons_tpu.agent.remote import RemoteFleet
 
             fleet = RemoteFleet(
-                on_host_down=inventory.mark_down,
-                on_host_up=inventory.mark_up,
+                on_host_down=self._inventory.mark_down,
+                on_host_up=self._inventory.mark_up,
                 auth_token=self.config.auth_token,
                 ca_file=self.config.tls_ca_file,
             )
             for host_id, url in self.agent_urls.items():
                 fleet.add_host(host_id, url)
-            agent = fleet
+            self._agent = fleet
             self.fleet = fleet
         else:
             from dcos_commons_tpu.agent.local import LocalProcessAgent
 
-            agent = LocalProcessAgent(self.config.sandbox_root)
-        builder = SchedulerBuilder(self.spec, self.config)
-        builder.set_inventory(inventory)
-        builder.set_agent(agent)
+            self._agent = LocalProcessAgent(self.config.sandbox_root)
+        self._persister = make_persister(self.config)
+
+    def _stored_options(self) -> Dict[str, str]:
+        import json
+
+        raw = self._persister.get_or_none(OPTIONS_NODE)
+        if not raw:
+            return {}
+        try:
+            data = json.loads(raw.decode("utf-8"))
+        except (ValueError, UnicodeDecodeError):
+            return {}
+        return {
+            str(k): str(v) for k, v in data.items()
+        } if isinstance(data, dict) else {}
+
+    def _render_spec(self, overrides: Dict[str, str]):
+        """Re-render svc.yml with base env + option overrides."""
+        from dcos_commons_tpu.specification.yaml_spec import from_yaml_file
+
+        yaml_path, base_env = self.spec_source
+        env = dict(base_env)
+        env.update(overrides)
+        return from_yaml_file(yaml_path, env)
+
+    def build(self) -> None:
+        self._build_infra()
+        if self.spec_source is not None:
+            overrides = self._stored_options()
+            if overrides:
+                LOG.info(
+                    "applying %d persisted option override(s): %s",
+                    len(overrides), sorted(overrides),
+                )
+            self.spec = self._render_spec(overrides)
+        builder = SchedulerBuilder(
+            self.spec, self.config, persister=self._persister
+        )
+        builder.set_inventory(self._inventory)
+        builder.set_agent(self._agent)
         if self.builder_hook is not None:
             self.builder_hook(builder, self.spec)
         self.scheduler = builder.build()
+
+    # -- live options update (reference: Cosmos `update` flow) --------
+
+    def update_options(self, env: Dict[str, str]):
+        """Validate + persist new option env, then rebuild the
+        scheduler in-process; returns an HTTP (code, body) pair.
+
+        Reference: the Cosmos package `update` + CLI update section
+        (cli/commands.go:39,56) push new options to a RUNNING
+        scheduler; the rolling update then proceeds under the new
+        target config exactly as a restart-with-new-env would."""
+        import json
+
+        from dcos_commons_tpu.specification.validation import (
+            ConfigValidationError,
+            ValidationContext,
+            validate_spec_change,
+        )
+
+        if self.spec_source is None:
+            return 409, {
+                "message": "scheduler was not started from a YAML source; "
+                           "live update is unavailable"
+            }
+        if not isinstance(env, dict) or not env or not all(
+            isinstance(k, str) and isinstance(v, str) for k, v in env.items()
+        ):
+            return 400, {"message": "body must be {\"env\": {str: str}}"}
+        merged = self._stored_options()
+        merged.update(env)
+        try:
+            new_spec = self._render_spec(merged)
+        except Exception as e:
+            return 400, {"message": f"spec render failed: {e}"}
+        scheduler = self.scheduler
+        old_spec = None
+        if scheduler is not None and scheduler.config_store is not None:
+            target = scheduler.config_store.get_target_config()
+            if target:
+                data = scheduler.config_store.fetch(target)
+                if data is not None:
+                    from dcos_commons_tpu.specification.specs import (
+                        ServiceSpec,
+                    )
+
+                    old_spec = ServiceSpec.from_dict(data)
+        try:
+            validate_spec_change(
+                old_spec,
+                new_spec,
+                context=ValidationContext(
+                    deployment_completed=(
+                        scheduler.state_store.deployment_was_completed()
+                        if scheduler is not None else None
+                    ),
+                    secrets_provider_present=bool(self.config.secrets_dir),
+                ),
+            )
+        except ConfigValidationError as e:
+            return 400, {"message": "invalid update", "errors": e.errors}
+        self._persister.set(
+            OPTIONS_NODE, json.dumps(merged, sort_keys=True).encode("utf-8")
+        )
+        # stop only the event-loop thread; _run_locked sees the reload
+        # flag, rebuilds over the same persister/agent, and swaps the
+        # API server's scheduler — the process and socket survive
+        self._reload_requested.set()
+        if scheduler is not None:
+            scheduler.stop()
+        return 200, {
+            "message": "update accepted; rolling update beginning",
+            "env": sorted(env),
+        }
 
     def run(self) -> int:
         """Lock -> build -> serve -> loop.  Returns a process exit code."""
@@ -257,10 +388,7 @@ class FrameworkRunner:
             return EXIT_BAD_CONFIG
         # API up before the loop starts taking work, so operators can
         # always observe (FrameworkRunner.java:130-138)
-        extra_routes = (
-            list(self.routes_hook(self.scheduler))
-            if self.routes_hook is not None else []
-        )
+        extra_routes = self._make_extra_routes()
         self.api_server = ApiServer(
             self.scheduler,
             port=self.config.api_port,
@@ -271,14 +399,7 @@ class FrameworkRunner:
         ).start()
         thread = None
         try:
-            if hasattr(self.scheduler, "artifact_base") and self.agent_urls:
-                # URL-mode template pulls are for REMOTE agents only:
-                # an in-process agent fetching from this scheduler's
-                # own API while the event loop holds its lock would
-                # deadlock — local agents get template content inline
-                self.scheduler.artifact_base = (
-                    self.advertise_url.rstrip("/") or self.api_server.url
-                )
+            self._set_artifact_base()
             if self.announce_file:
                 from dcos_commons_tpu.common import atomic_write_text
 
@@ -294,8 +415,34 @@ class FrameworkRunner:
             )
             thread = self.scheduler.run_forever()
             try:
-                while thread.is_alive() and not self._stop_requested.is_set():
+                while not self._stop_requested.is_set():
                     thread.join(timeout=0.5)
+                    if self._reload_requested.is_set():
+                        # live update: the loop (not the HTTP thread)
+                        # owns the swap.  Checked EVERY iteration so an
+                        # update landing at any moment — including just
+                        # after a previous rebuild — is applied; stop
+                        # is idempotent.  Rebuild over the SAME
+                        # persister/agent/inventory; the process and
+                        # its socket survive.
+                        self.scheduler.stop()
+                        thread.join(timeout=10)
+                        self._reload_requested.clear()
+                        try:
+                            self.build()
+                        except Exception:
+                            LOG.exception("rebuild after update failed")
+                            return EXIT_BAD_CONFIG
+                        self._set_artifact_base()
+                        self.api_server.set_scheduler(self.scheduler)
+                        self.api_server.set_extra_routes(
+                            self._make_extra_routes()
+                        )
+                        LOG.info("live update applied; scheduler rebuilt")
+                        thread = self.scheduler.run_forever()
+                        continue
+                    if not thread.is_alive():
+                        break  # loop died on its own (wedge etc.)
                     if self._uninstall_finished():
                         break
             except KeyboardInterrupt:
@@ -314,6 +461,32 @@ class FrameworkRunner:
             LOG.critical("scheduler wedged: %s", fatal)
             return EXIT_WEDGED
         return 0
+
+    def _make_extra_routes(self) -> list:
+        """Custom framework endpoints + the live-update route.  Rebuilt
+        on every live update because routes_hook handlers close over
+        the scheduler object."""
+        extra = (
+            list(self.routes_hook(self.scheduler))
+            if self.routes_hook is not None else []
+        )
+        # live options update (reference: the Cosmos/CLI `update` flow)
+        extra.append((
+            "POST", r"/v1/update",
+            lambda m, q, body: self.update_options(body.get("env")),
+            True,
+        ))
+        return extra
+
+    def _set_artifact_base(self) -> None:
+        if hasattr(self.scheduler, "artifact_base") and self.agent_urls:
+            # URL-mode template pulls are for REMOTE agents only: an
+            # in-process agent fetching from this scheduler's own API
+            # while the event loop holds its lock would deadlock —
+            # local agents get template content inline
+            self.scheduler.artifact_base = (
+                self.advertise_url.rstrip("/") or self.api_server.url
+            )
 
     def _uninstall_finished(self) -> bool:
         if not self.config.uninstall:
@@ -381,19 +554,9 @@ class MultiFrameworkRunner:
             from dcos_commons_tpu.agent.local import LocalProcessAgent
 
             agent = LocalProcessAgent(self.config.sandbox_root)
-        if self.config.state_url:
-            from dcos_commons_tpu.storage import PersisterCache
-            from dcos_commons_tpu.storage.remote import RemotePersister
+        from dcos_commons_tpu.scheduler.builder import make_persister
 
-            persister = PersisterCache(RemotePersister(
-                self.config.state_url,
-                auth_token=self.config.auth_token,
-                ca_file=self.config.tls_ca_file,
-            ))
-        else:
-            from dcos_commons_tpu.storage import FileWalPersister
-
-            persister = FileWalPersister(self.config.state_dir)
+        persister = make_persister(self.config)
         self.multi = MultiServiceScheduler(
             persister=persister,
             inventory=inventory,
@@ -628,6 +791,7 @@ def serve_main(
         runner = FrameworkRunner(
             specs[0], config, topology_hosts=hosts, agent_urls=urls,
             builder_hook=builder_hook,
+            spec_source=(args.svc_yml[0], env),
         )
         runner.routes_hook = routes_hook
     runner.announce_file = args.announce_file

@@ -42,6 +42,7 @@ from dcos_commons_tpu.scheduler.scheduler import DefaultScheduler
 from dcos_commons_tpu.specification.specs import ServiceSpec
 from dcos_commons_tpu.specification.validation import (
     ConfigValidationError,
+    ValidationContext,
     validate_spec_change,
 )
 from dcos_commons_tpu.state.config_store import ConfigStore
@@ -55,6 +56,25 @@ from dcos_commons_tpu.storage import (
 )
 
 LOG = logging.getLogger(__name__)
+
+
+def make_persister(config: SchedulerConfig) -> Persister:
+    """The one place persister selection lives: remote state server
+    (behind the full-tree cache) when --state-url is set, else the
+    local file WAL (reference: CuratorPersister-vs-local selection in
+    SchedulerRunner)."""
+    if config.state_url:
+        from dcos_commons_tpu.storage.remote import RemotePersister
+
+        persister: Persister = RemotePersister(
+            config.state_url,
+            auth_token=config.auth_token,
+            ca_file=config.tls_ca_file,
+        )
+        if config.state_cache_enabled:
+            persister = PersisterCache(persister)
+        return persister
+    return FileWalPersister(config.state_dir)
 
 
 class SchedulerBuilder:
@@ -111,20 +131,7 @@ class SchedulerBuilder:
     def build(self) -> DefaultScheduler:
         persister = self._persister
         if persister is None:
-            if self._config.state_url:
-                # networked state (reference: CuratorPersister over ZK)
-                # behind the full-tree cache so reads never leave RAM
-                from dcos_commons_tpu.storage.remote import RemotePersister
-
-                persister = RemotePersister(
-                    self._config.state_url,
-                    auth_token=self._config.auth_token,
-                    ca_file=self._config.tls_ca_file,
-                )
-                if self._config.state_cache_enabled:
-                    persister = PersisterCache(persister)
-            else:
-                persister = FileWalPersister(self._config.state_dir)
+            persister = make_persister(self._config)
         SchemaVersionStore(persister).check()
         state_store = StateStore(persister, self._namespace)
         config_store = ConfigStore(persister, self._namespace)
@@ -316,8 +323,15 @@ class SchedulerBuilder:
                 old_spec = ServiceSpec.from_dict(old_dict)
         if old_spec is not None and old_spec == self._spec:
             return old_target_id, errors
+        context = ValidationContext(
+            deployment_completed=state_store.deployment_was_completed(),
+            secrets_provider_present=(
+                self._secrets_provider is not None
+                or bool(self._config.secrets_dir)
+            ),
+        )
         try:
-            validate_spec_change(old_spec, self._spec)
+            validate_spec_change(old_spec, self._spec, context=context)
         except ConfigValidationError as e:
             errors.extend(e.errors)
             if old_target_id is not None:

@@ -13,6 +13,9 @@ replace (SURVEY.md section 2 build plan stage 2).
 
 from __future__ import annotations
 
+import inspect
+import re
+from dataclasses import dataclass
 from typing import Callable, List, Optional
 
 from dcos_commons_tpu.specification.specs import ServiceSpec
@@ -25,6 +28,20 @@ class ConfigValidationError(Exception):
 
 
 Validator = Callable[[Optional[ServiceSpec], ServiceSpec], List[str]]
+
+
+@dataclass
+class ValidationContext:
+    """Deployment-state context some validators need (reference:
+    validators like ServiceRoleCannotChangeOnIncompleteDeployment take
+    a StateStore; ours take this snapshot instead so the validator
+    functions stay pure).  ``None`` fields mean "unknown — skip the
+    check" so pure two-argument callers are unaffected."""
+
+    # has the initial deploy plan ever completed?
+    deployment_completed: Optional[bool] = None
+    # is a secrets provider wired (SECRETS_DIR / set_secrets_provider)?
+    secrets_provider_present: Optional[bool] = None
 
 
 def service_name_cannot_change(old, new):
@@ -160,23 +177,258 @@ def placement_rules_must_parse(old, new):
     return errs
 
 
+_DNS_LABEL = re.compile(r"^[a-z0-9]([a-z0-9-]*[a-z0-9])?$")
+
+
+def service_name_cannot_break_dns(old, new):
+    """Reference: config/validate/ServiceNameCannotBreakDNS.java — task
+    DNS names are derived from the service name, so every /-separated
+    folder component must be a valid DNS label."""
+    errs = []
+    for part in new.name.strip("/").split("/"):
+        if len(part) > 63 or not _DNS_LABEL.match(part):
+            errs.append(
+                f"service name component {part!r} is not a valid DNS "
+                "label (lowercase alphanumerics and dashes, max 63 chars)"
+            )
+    return errs
+
+
+def zone_cannot_change(old, new):
+    """Reference: config/validate/ZoneValidator.java — zone-aware
+    placement may only transition unset->off, on->on, off->off; here
+    the service-level zone pin follows RegionCannotChange semantics."""
+    if old is not None and old.zone != new.zone:
+        return [f"zone cannot change: {old.zone!r} -> {new.zone!r}"]
+    return []
+
+
+def _placement_references_zone(placement: str) -> bool:
+    """Walk the PARSED rule tree for field_name == 'zone' terms — a
+    substring test would misfire on e.g. hostname:like:tpu-zone1-.*."""
+    from dcos_commons_tpu.offer.placement import parse_placement
+
+    try:
+        rule = parse_placement(placement)
+    except ValueError:
+        return False  # placement_rules_must_parse reports this one
+    stack = [rule]
+    while stack:
+        node = stack.pop()
+        if getattr(node, "field_name", None) == "zone":
+            return True
+        stack.extend(getattr(node, "rules", []))
+        child = getattr(node, "rule", None)
+        if child is not None:
+            stack.append(child)
+    return False
+
+
+def zone_placement_cannot_change(old, new):
+    """Reference: ZoneValidator.java:14-21 — a pod cannot start or stop
+    *referencing zones* in its placement rules on update (the running
+    tasks were placed without zone bookkeeping, so the scheduler cannot
+    retroactively enforce it)."""
+    errs = []
+    if old is None:
+        return errs
+    new_pods = {p.type: p for p in new.pods}
+    for old_pod in old.pods:
+        new_pod = new_pods.get(old_pod.type)
+        if new_pod is None:
+            continue
+        old_zonal = _placement_references_zone(old_pod.placement)
+        new_zonal = _placement_references_zone(new_pod.placement)
+        if old_zonal != new_zonal:
+            errs.append(
+                f"pod {old_pod.type!r} cannot "
+                f"{'start' if new_zonal else 'stop'} referencing zones "
+                "in placement after deployment"
+            )
+    return errs
+
+
+def pod_networks_cannot_change(old, new):
+    """Reference: config/validate/PodSpecsCannotChangeNetworkRegime.java
+    — a pod on the host network holds real host ports; moving it onto a
+    virtual network (or back) would strand those reservations."""
+    errs = []
+    if old is None:
+        return errs
+    new_pods = {p.type: p for p in new.pods}
+    for old_pod in old.pods:
+        new_pod = new_pods.get(old_pod.type)
+        if new_pod is None:
+            continue
+        if sorted(old_pod.networks) != sorted(new_pod.networks):
+            errs.append(
+                f"pod {old_pod.type!r} networks cannot change "
+                f"{sorted(old_pod.networks)} -> {sorted(new_pod.networks)}"
+            )
+    return errs
+
+
+def pre_reserved_role_cannot_change(old, new):
+    """Reference: config/validate/PreReservationCannotChange.java —
+    reservations are stamped with the pre-reserved role at create time;
+    a different role cannot adopt them."""
+    errs = []
+    if old is None:
+        return errs
+    new_pods = {p.type: p for p in new.pods}
+    for old_pod in old.pods:
+        new_pod = new_pods.get(old_pod.type)
+        if new_pod is None:
+            continue
+        if old_pod.pre_reserved_role != new_pod.pre_reserved_role:
+            errs.append(
+                f"pod {old_pod.type!r} pre-reserved-role cannot change "
+                f"{old_pod.pre_reserved_role!r} -> "
+                f"{new_pod.pre_reserved_role!r}"
+            )
+    return errs
+
+
+def task_env_cannot_change_for_finished(old, new):
+    """Reference: config/validate/TaskEnvCannotChange.java — the env of
+    a ONCE/FINISH-goal task that already ran defines what it *did*;
+    changing it would silently not re-run with the new values."""
+    from dcos_commons_tpu.specification.specs import GoalState
+
+    errs = []
+    if old is None:
+        return errs
+    new_pods = {p.type: p for p in new.pods}
+    for old_pod in old.pods:
+        new_pod = new_pods.get(old_pod.type)
+        if new_pod is None:
+            continue
+        new_tasks = {t.name: t for t in new_pod.tasks}
+        for old_task in old_pod.tasks:
+            new_task = new_tasks.get(old_task.name)
+            if new_task is None:
+                continue
+            if (
+                old_task.goal in (GoalState.ONCE, GoalState.FINISH)
+                and old_task.env != new_task.env
+            ):
+                errs.append(
+                    f"task {old_pod.type}-{old_task.name} "
+                    f"(goal {old_task.goal.value}) env cannot change; "
+                    "use pod replace to re-run it"
+                )
+    return errs
+
+
+def gang_flag_cannot_change(old, new):
+    """TPU-first: gang scheduling is burned into how a pod's instances
+    were placed (atomically, one slice) — toggling it needs replace."""
+    errs = []
+    if old is None:
+        return errs
+    new_pods = {p.type: p for p in new.pods}
+    for old_pod in old.pods:
+        new_pod = new_pods.get(old_pod.type)
+        if new_pod is not None and old_pod.gang != new_pod.gang:
+            errs.append(
+                f"pod {old_pod.type!r} cannot toggle gang scheduling "
+                f"({old_pod.gang} -> {new_pod.gang}); use pod replace"
+            )
+    return errs
+
+
+_KNOWN_GENERATIONS = ("v4", "v5e", "v5p", "v6e")
+
+
+def tpu_generation_supported(old, new):
+    """Reference: PodSpecsCannotUseUnsupportedFeatures.java /
+    TaskSpecsCannotUseUnsupportedFeatures.java — a spec demanding a
+    capability the substrate lacks is a config error, not a forever-
+    pending deploy plan.  Here: the TPU generation must be one the
+    inventory model understands."""
+    errs = []
+    for pod in new.pods:
+        if pod.tpu is not None and pod.tpu.generation not in _KNOWN_GENERATIONS:
+            errs.append(
+                f"pod {pod.type!r}: unknown TPU generation "
+                f"{pod.tpu.generation!r} (supported: "
+                f"{', '.join(_KNOWN_GENERATIONS)})"
+            )
+    return errs
+
+
+def role_cannot_change_on_incomplete_deployment(old, new, context=None):
+    """Reference: ServiceRoleCannotChangeOnIncompleteDeployment.java —
+    a role migration is only safe once the initial deployment finished
+    (mid-deploy, half the reservations would carry the old role)."""
+    if old is None or old.role == new.role:
+        return []
+    completed = context.deployment_completed if context else None
+    if completed is None:
+        # no deployment-state context: allow (the completed-deploy
+        # role-migration path is legitimate and must not be blocked)
+        return []
+    if not completed:
+        return [
+            f"service role cannot change ({old.role!r} -> {new.role!r}) "
+            "while the initial deployment is incomplete"
+        ]
+    return []
+
+
+def secrets_require_provider(old, new, context=None):
+    """Reference: config/validate/TLSRequiresServiceAccount.java — a
+    spec whose tasks need credentials must fail CONFIGURATION when the
+    backing credential plane is absent, not the eventual launch."""
+    present = context.secrets_provider_present if context else None
+    if present is None or present:
+        return []
+    errs = []
+    for pod in new.pods:
+        if pod.secrets:
+            errs.append(
+                f"pod {pod.type!r} references secrets but no secrets "
+                "provider is configured (set SECRETS_DIR / --secrets-dir "
+                "or SchedulerBuilder.set_secrets_provider)"
+            )
+    return errs
+
+
 def default_validators() -> List[Validator]:
     return [
         service_name_cannot_change,
+        service_name_cannot_break_dns,
         user_cannot_change,
         region_cannot_change,
+        zone_cannot_change,
+        zone_placement_cannot_change,
         pod_specs_cannot_shrink,
         task_volumes_cannot_change,
+        task_env_cannot_change_for_finished,
+        pod_networks_cannot_change,
+        pre_reserved_role_cannot_change,
+        role_cannot_change_on_incomplete_deployment,
+        secrets_require_provider,
+        tpu_generation_supported,
+        gang_flag_cannot_change,
         tpu_topology_cannot_change,
         gang_pods_need_topology,
         placement_rules_must_parse,
     ]
 
 
+def _takes_context(validator) -> bool:
+    try:
+        return len(inspect.signature(validator).parameters) >= 3
+    except (TypeError, ValueError):
+        return False
+
+
 def validate_spec_change(
     old: Optional[ServiceSpec],
     new: ServiceSpec,
     validators: Optional[List[Validator]] = None,
+    context: Optional[ValidationContext] = None,
 ) -> None:
     """Run all validators; raise ConfigValidationError on any failure.
 
@@ -185,6 +437,9 @@ def validate_spec_change(
     """
     errors: List[str] = []
     for validator in validators if validators is not None else default_validators():
-        errors.extend(validator(old, new))
+        if _takes_context(validator):
+            errors.extend(validator(old, new, context))
+        else:
+            errors.extend(validator(old, new))
     if errors:
         raise ConfigValidationError(errors)

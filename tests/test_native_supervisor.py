@@ -74,6 +74,28 @@ def test_native_kill_grace_then_escalation(tmp_path):
     agent.shutdown()
 
 
+def test_native_kill_time_grace_overrides_launch_grace(tmp_path):
+    """The grace passed to kill() — not the one fixed at launch — must
+    drive the supervisor's SIGKILL escalation: a task that ignores
+    SIGTERM under a long launch-time grace dies within the SHORT
+    kill-time grace (advisor round-2 finding on agent/local.py kill)."""
+    agent = LocalProcessAgent(str(tmp_path / "w"))
+    agent.launch_one(
+        TaskInfo(
+            name="t-0-o", task_id="t-0-o__1",
+            command='trap "" TERM; sleep 60',
+        ),
+        kill_grace_s=45.0,  # launch-time default: far too long
+    )
+    time.sleep(0.5)  # let the shell install its trap
+    t0 = time.monotonic()
+    agent.kill("t-0-o__1", grace_period_s=1.0)
+    wait_for_state(agent, "t-0-o__1", TaskState.KILLED, timeout_s=15.0)
+    # well under the 45s launch grace => the 1s override was honored
+    assert time.monotonic() - t0 < 10.0
+    agent.shutdown()
+
+
 def test_agent_restart_recovers_running_and_exited_tasks(tmp_path):
     """The durability claim end to end: agent 1 launches a long task
     and a short one, 'crashes' (dropped without shutdown), and agent 2

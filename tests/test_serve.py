@@ -173,6 +173,76 @@ def test_scheduler_restart_resumes_over_same_state(cluster, tmp_path):
         assert scheduler.terminate() == 0
 
 
+UPDATABLE_YAML = """
+name: webfarm
+pods:
+  app:
+    count: {{APP_COUNT:-2}}
+    placement: 'max-per-host:1'
+    tasks:
+      server:
+        goal: RUNNING
+        cmd: "echo $MODE > mode.txt && sleep 120"
+        cpus: 0.1
+        memory: 32
+        env:
+          MODE: {{MODE:-blue}}
+"""
+
+
+def test_live_update_rolls_without_process_restart(cluster, tmp_path):
+    """POST /v1/update (CLI: `update start -p K=V`) pushes new service
+    options to the RUNNING scheduler: validator-gated, rolled out by
+    the update plan, no process restart, and the override survives a
+    later restart (reference: the Cosmos update flow + CLI update
+    section, cli/commands.go:39,56)."""
+    from dcos_commons_tpu.cli.client import CliError
+    from dcos_commons_tpu.cli.commands import main as cli_main
+
+    svc = tmp_path / "svc-upd.yml"
+    svc.write_text(UPDATABLE_YAML)
+    scheduler = SchedulerProcess(
+        str(svc), cluster["topology"], str(tmp_path / "sched"),
+        env={"ENABLE_BACKOFF": "false"}, repo_root=REPO,
+    )
+    try:
+        client = scheduler.client()
+        client.wait_for_completed_deployment(timeout_s=90)
+        ids = client.task_ids()
+        pid = scheduler.process.pid
+
+        # an update violating a validator is rejected wholesale (400)
+        with pytest.raises(CliError) as err:
+            client.post("/v1/update", body={"env": {"APP_COUNT": "1"}})
+        assert err.value.code == 400
+        assert "shrink" in str(err.value.body)
+
+        # a valid update through the CLI update section
+        assert cli_main([
+            "--url", scheduler.url, "update", "start", "-p", "MODE=green",
+        ]) == 0
+        new_ids = client.wait_for_tasks_updated(ids, timeout_s=120)
+        client.wait_for_completed_deployment(timeout_s=120)
+        # rolled on the SAME process — that's the live part
+        assert scheduler.process.poll() is None
+        assert scheduler.process.pid == pid
+        infos = client.get("/v1/pod/app-0/info")
+        assert infos[0]["env"]["MODE"] == "green"
+
+        # the override is persisted: a restarted scheduler renders the
+        # spec WITH it and does not roll anything back
+        assert scheduler.terminate() == 0
+        scheduler = SchedulerProcess(
+            str(svc), cluster["topology"], str(tmp_path / "sched"),
+            env={"ENABLE_BACKOFF": "false"}, repo_root=REPO,
+        )
+        client = scheduler.client()
+        client.wait_for_completed_deployment(timeout_s=90)
+        client.check_tasks_not_updated(new_ids)
+    finally:
+        scheduler.terminate()
+
+
 def test_load_topology_rejects_mixed_mode(tmp_path):
     path = tmp_path / "topology.yml"
     path.write_text(
