@@ -55,6 +55,10 @@ class TransformerConfig:
     # Measured on v5e the extra residual traffic made "save-attn"
     # slightly SLOWER (0.486 vs 0.525 MFU), so "full" is the default.
     remat_policy: str = "full"
+    # mixed remat: the last k layers store activations instead of
+    # recomputing (see _layer_scan) — each costs ~2.2 GB HBM at the
+    # flagship shape and buys back 1/n_layers of the recompute pass
+    no_remat_layers: int = 0
 
     def __post_init__(self) -> None:
         if self.remat_policy not in ("full", "save-attn"):
@@ -203,26 +207,45 @@ def _mlp_block(layer, x):
 
 
 def _layer_scan(config: TransformerConfig, layers, x, positions):
-    """Run x through a (sub)stack of layers with lax.scan."""
+    """Run x through a (sub)stack of layers with lax.scan.
+
+    Mixed remat (``no_remat_layers`` = k > 0): the LAST k layers scan
+    WITHOUT jax.checkpoint, storing their activations instead of
+    recomputing them in backward.  Full-layer remat costs a whole
+    extra forward (2NP FLOPs, ~24% of the train step at the flagship
+    size); every layer that fits its activations in leftover HBM buys
+    that fraction of the recompute back.  The non-remat span is the
+    tail because those activations die first in backward."""
 
     def layer_fn(x, layer):
         x = _attention_block(config, layer, x, positions)
         x = _mlp_block(layer, x)
         return x, None
 
+    remat_fn = layer_fn
     if config.remat:
         if config.remat_policy == "save-attn":
             from jax.ad_checkpoint import checkpoint_policies
 
-            layer_fn = jax.checkpoint(
+            remat_fn = jax.checkpoint(
                 layer_fn,
                 policy=checkpoint_policies.save_only_these_names(
                     "attn_out"
                 ),
             )
         else:
-            layer_fn = jax.checkpoint(layer_fn)
-    x, _ = lax.scan(layer_fn, x, layers)
+            remat_fn = jax.checkpoint(layer_fn)
+    k = config.no_remat_layers if config.remat else 0
+    if k <= 0:
+        x, _ = lax.scan(remat_fn, x, layers)
+        return x
+    n_layers = jax.tree.leaves(layers)[0].shape[0]
+    k = min(k, n_layers)
+    head = jax.tree.map(lambda a: a[: n_layers - k], layers)
+    tail = jax.tree.map(lambda a: a[n_layers - k:], layers)
+    if n_layers - k > 0:
+        x, _ = lax.scan(remat_fn, x, head)
+    x, _ = lax.scan(layer_fn, x, tail)
     return x
 
 

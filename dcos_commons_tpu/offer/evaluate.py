@@ -153,6 +153,22 @@ class OfferEvaluator:
 
         pod = requirement.pod
         rule = parse_placement(pod.placement)
+        if pod.pre_reserved_role:
+            # pre-reserved capacity (reference: ResourceSpec
+            # preReservedRole + PreReservationCannotChange): the fleet
+            # operator marks hosts as carved out for a role via the
+            # reserved_role attribute; a pod declaring the role places
+            # ONLY on those hosts, and the outcome tracker records the
+            # refusals like any placement term
+            from dcos_commons_tpu.offer.placement import (
+                AndRule,
+                FieldMatchRule,
+            )
+
+            rule = AndRule([
+                FieldMatchRule("reserved_role", [pod.pre_reserved_role]),
+                rule,
+            ])
         if pod.gang and pod.tpu is not None and pod.tpu.topology:
             return self._evaluate_gang(requirement, snapshots, rule, ctx)
         return self._evaluate_instances(requirement, snapshots, rule, ctx)
@@ -515,14 +531,15 @@ class OfferEvaluator:
                 container_path=COORDINATOR_PORT_NAME,
             )
             reservations.append(coord_res)
+        disk_seen_paths: set = set()
         for task_name in requirement.tasks_to_launch:
             task_spec = pod.task(task_name)
             full = task_full_name(pod.type, index, task_name)
+            task_disk = _task_disk_mb(task_spec, disk_seen_paths)
             if not work.try_consume_scalar(
                 task_spec.resources.cpus,
                 task_spec.resources.memory_mb,
-                task_spec.resources.disk_mb
-                + sum(v.size_mb for v in task_spec.volumes),
+                task_disk,
             ):
                 return None, None
             ports: List[int] = []
@@ -546,8 +563,7 @@ class OfferEvaluator:
                 role=self._service_name,
                 cpus=task_spec.resources.cpus,
                 memory_mb=task_spec.resources.memory_mb,
-                disk_mb=task_spec.resources.disk_mb
-                + sum(v.size_mb for v in task_spec.volumes),
+                disk_mb=task_disk,
                 chip_ids=list(task_chips),
                 ports=ports,
                 volume_id=(uuid.uuid4().hex if task_spec.volumes else ""),
@@ -691,11 +707,26 @@ class OfferEvaluator:
         )
 
 
+def _task_disk_mb(task_spec, seen_paths: set) -> int:
+    """Disk demand of one task within a pod instance.  A volume path
+    SHARED by sibling tasks (pod-level volumes are merged into every
+    task's spec) is one durable directory — only the first sibling
+    pays its size, or a 2-task pod would demand twice the disk the
+    instance actually uses."""
+    disk = task_spec.resources.disk_mb
+    for v in task_spec.volumes:
+        if v.container_path not in seen_paths:
+            seen_paths.add(v.container_path)
+            disk += v.size_mb
+    return disk
+
+
 def _pod_scalar_needs(pod: PodSpec, tasks_to_launch: List[str]) -> Tuple[float, int, int]:
     cpus, mem, disk = 0.0, 0, 0
+    seen_paths: set = set()
     for name in tasks_to_launch:
         spec = pod.task(name)
         cpus += spec.resources.cpus
         mem += spec.resources.memory_mb
-        disk += spec.resources.disk_mb + sum(v.size_mb for v in spec.volumes)
+        disk += _task_disk_mb(spec, seen_paths)
     return cpus, mem, disk

@@ -204,6 +204,9 @@ class FrameworkRunner:
         self._persister = None
         self._inventory = None
         self._agent = None
+        # serializes update_options' read-merge-write of the options
+        # node (ThreadingHTTPServer handles requests concurrently)
+        self._update_lock = threading.Lock()
         self._wire_lease_loss()
 
     def _wire_lease_loss(self) -> None:
@@ -302,14 +305,6 @@ class FrameworkRunner:
         (cli/commands.go:39,56) push new options to a RUNNING
         scheduler; the rolling update then proceeds under the new
         target config exactly as a restart-with-new-env would."""
-        import json
-
-        from dcos_commons_tpu.specification.validation import (
-            ConfigValidationError,
-            ValidationContext,
-            validate_spec_change,
-        )
-
         if self.spec_source is None:
             return 409, {
                 "message": "scheduler was not started from a YAML source; "
@@ -319,6 +314,18 @@ class FrameworkRunner:
             isinstance(k, str) and isinstance(v, str) for k, v in env.items()
         ):
             return 400, {"message": "body must be {\"env\": {str: str}}"}
+        with self._update_lock:
+            return self._update_options_locked(env)
+
+    def _update_options_locked(self, env: Dict[str, str]):
+        import json
+
+        from dcos_commons_tpu.specification.validation import (
+            ConfigValidationError,
+            ValidationContext,
+            validate_spec_change,
+        )
+
         merged = self._stored_options()
         merged.update(env)
         try:
@@ -346,7 +353,14 @@ class FrameworkRunner:
                         scheduler.state_store.deployment_was_completed()
                         if scheduler is not None else None
                     ),
-                    secrets_provider_present=bool(self.config.secrets_dir),
+                    # a provider may also be wired programmatically
+                    # (builder_hook -> set_secrets_provider); the built
+                    # scheduler carries it
+                    secrets_provider_present=(
+                        bool(self.config.secrets_dir)
+                        or getattr(scheduler, "secrets_provider", None)
+                        is not None
+                    ),
                 ),
             )
         except ConfigValidationError as e:

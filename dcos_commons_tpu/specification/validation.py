@@ -89,6 +89,17 @@ def pod_specs_cannot_shrink(old, new):
     return errs
 
 
+def _effective_volumes(pod, task):
+    """Pod-level volumes merged over the task's own, keyed by path —
+    the view the evaluator places with.  Comparing EFFECTIVE volumes
+    keeps target configs stored before the yaml-spec merge (tasks
+    without the pod volume copied in) compatible with re-renders after
+    it."""
+    merged = {v.container_path: v for v in pod.volumes}
+    merged.update({v.container_path: v for v in task.volumes})
+    return tuple(sorted(merged.items()))
+
+
 def task_volumes_cannot_change(old, new):
     """Reference: config/validate/TaskVolumesCannotChange.java."""
     errs = []
@@ -104,7 +115,8 @@ def task_volumes_cannot_change(old, new):
         old_tasks = {t.name: t for t in old_pod.tasks}
         for new_task in new_pod.tasks:
             old_task = old_tasks.get(new_task.name)
-            if old_task and tuple(old_task.volumes) != tuple(new_task.volumes):
+            if old_task and _effective_volumes(old_pod, old_task) != \
+                    _effective_volumes(new_pod, new_task):
                 errs.append(
                     f"task {old_pod.type}-{new_task.name} volumes cannot change"
                 )

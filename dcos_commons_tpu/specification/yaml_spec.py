@@ -178,19 +178,40 @@ def _map_pod(
             chips_per_host=int(tpu_raw.get("chips-per-host", 4)),
             topology=str(tpu_raw.get("topology", "")),
         )
+    tasks = tuple(
+        _map_task(task_name, task_raw or {}, routed_env, base_dir)
+        for task_name, task_raw in tasks_raw.items()
+    )
+    pod_volumes = _map_volumes(raw)
+    if pod_volumes:
+        # pod-level volumes are shared by every task of the pod
+        # (reference: pod volumes land in each task's resource set);
+        # merging them here lets the evaluator's sibling-sharing give
+        # all tasks ONE durable key per container path
+        import dataclasses as _dc
+
+        tasks = tuple(
+            _dc.replace(
+                t,
+                volumes=tuple(
+                    v for v in pod_volumes
+                    if v.container_path not in {
+                        tv.container_path for tv in t.volumes
+                    }
+                ) + t.volumes,
+            )
+            for t in tasks
+        )
     return PodSpec(
         type=str(pod_name),
         count=int(raw.get("count", 1)),
-        tasks=tuple(
-            _map_task(task_name, task_raw or {}, routed_env, base_dir)
-            for task_name, task_raw in tasks_raw.items()
-        ),
+        tasks=tasks,
         tpu=tpu,
         gang=bool(raw.get("gang", False)),
         image=str(raw.get("image", "")),
         networks=_map_networks(raw),
         placement=str(raw.get("placement", "")),
-        volumes=_map_volumes(raw),
+        volumes=pod_volumes,
         pre_reserved_role=str(raw.get("pre-reserved-role", "")),
         allow_decommission=bool(raw.get("allow-decommission", False)),
         share_pid_namespace=bool(raw.get("share-pid-namespace", False)),

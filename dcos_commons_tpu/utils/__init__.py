@@ -3,8 +3,10 @@
 from dcos_commons_tpu.utils.data import synthetic_tokens, synthetic_mnist
 from dcos_commons_tpu.utils.tree import param_count, param_bytes
 from dcos_commons_tpu.utils.checkpoint import save_checkpoint, restore_checkpoint
+from dcos_commons_tpu.utils.compile_cache import enable_compilation_cache
 
 __all__ = [
+    "enable_compilation_cache",
     "param_bytes",
     "param_count",
     "restore_checkpoint",

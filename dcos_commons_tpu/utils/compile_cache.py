@@ -1,0 +1,36 @@
+"""Persistent XLA compilation cache plumbing.
+
+Everything under jit is traced once and compiled; on a fresh process
+that compile dominates small-workload wall-clock (round-2 bench: 16s
+of the 23.6s MNIST deploy was XLA compilation).  The persistent cache
+keys compiled executables by HLO + platform, so any repeat deploy —
+scheduler restart, recovery relaunch, warm bench pass — skips straight
+to execution.  The reference has no analogue (its tasks are arbitrary
+binaries); this is TPU-first operational surface.
+"""
+
+from __future__ import annotations
+
+import os
+
+CACHE_ENV = "JAX_COMPILATION_CACHE_DIR"
+
+
+def enable_compilation_cache(cache_dir: str = "") -> bool:
+    """Point JAX's persistent compilation cache at ``cache_dir`` (or
+    $JAX_COMPILATION_CACHE_DIR).  Returns True when enabled.  Safe to
+    call before or after first device use; no-op without a directory.
+
+    The min-compile-time floor is zeroed: a scheduler deploy launches
+    MANY short-compile programs (MLP train step, eval, host transfers)
+    and the default 1s floor would skip exactly the programs a warm
+    relaunch needs."""
+    cache_dir = cache_dir or os.environ.get(CACHE_ENV, "")
+    if not cache_dir:
+        return False
+    import jax
+
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    return True

@@ -23,7 +23,14 @@ def main() -> int:
     import optax
 
     from dcos_commons_tpu.models import MlpConfig, mlp_init, mlp_train_step
-    from dcos_commons_tpu.utils import synthetic_mnist
+    from dcos_commons_tpu.utils import (
+        enable_compilation_cache,
+        synthetic_mnist,
+    )
+
+    # warm relaunches (scheduler restart, recovery, repeat deploys)
+    # skip XLA recompilation entirely ($JAX_COMPILATION_CACHE_DIR)
+    enable_compilation_cache()
 
     steps = int(os.environ.get("TRAIN_STEPS", "60"))
     config = MlpConfig()

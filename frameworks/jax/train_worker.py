@@ -29,10 +29,15 @@ def main() -> int:
     from dcos_commons_tpu.models import TransformerConfig, init_params, make_train_step
     from dcos_commons_tpu.parallel.mesh import mesh_from_env
     from dcos_commons_tpu.utils import (
+        enable_compilation_cache,
         restore_checkpoint,
         save_checkpoint,
         synthetic_tokens,
     )
+
+    # a recovered/replaced gang worker re-jits the identical train
+    # step; the persistent cache turns that into a disk read
+    enable_compilation_cache()
 
     steps = int(os.environ.get("TRAIN_STEPS", "100"))
     ckpt_dir = os.environ.get("CHECKPOINT_DIR", "checkpoints")

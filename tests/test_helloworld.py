@@ -225,6 +225,154 @@ def test_finish_state_goals_complete_and_stay_finished():
     assert len(restarted.agent.launches_of("hello-0-init")) == 1
 
 
+def test_pod_mount_volume_shared_between_tasks():
+    """pod_mount_volume.yml: a pod-level MOUNT volume gives BOTH tasks
+    of the pod one durable volume key (reference: pod-mount-volume.yml
+    + resource-set volume sharing), and a plain restart keeps it while
+    pod replace rotates it."""
+    runner = ServiceTestRunner(load("pod_mount_volume.yml"))
+    runner.run([
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("data-0-writer"),
+        SendTaskFinished("data-0-writer"),
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("data-0-server"),
+        SendTaskRunning("data-0-server"),
+        ExpectDeploymentComplete(),
+    ])
+    ledger = runner.world.scheduler.ledger
+
+    def volume_key(task: str) -> str:
+        vols = {}
+        for res in ledger.for_task(task):
+            vols.update(res.volumes or {})
+        assert "shared-data" in vols, f"no shared-data volume on {task}"
+        return vols["shared-data"]
+
+    writer_key = volume_key("data-0-writer")
+    assert writer_key == volume_key("data-0-server")
+
+    # restart (TRANSIENT relaunch) keeps the durable volume; replace
+    # (PERMANENT) starts empty with a fresh key
+    scheduler = runner.world.scheduler
+    scheduler.restart_pod("data", 0)
+    runner.run([
+        AdvanceCycles(2),
+        SendTaskRunning("data-0-server"),
+        AdvanceCycles(1),
+    ])
+    assert volume_key("data-0-server") == writer_key
+    scheduler.restart_pod("data", 0, replace=True)
+    runner.run([
+        AdvanceCycles(2),
+        SendTaskRunning("data-0-server"),
+        AdvanceCycles(1),
+    ])
+    assert volume_key("data-0-server") != writer_key
+
+
+def test_pre_reserved_role_places_only_on_reserved_hosts():
+    """pre_reserved.yml: a pod with pre-reserved-role only lands on
+    hosts carved out for that role (reserved_role attribute); the
+    second instance BLOCKS until a second reserved host exists
+    (reference: pre-reserved-role + PreReservationCannotChange)."""
+    hosts = [
+        TpuHost(host_id="plain-0"),
+        TpuHost(host_id="res-0", attributes={"reserved_role": "dedicated"}),
+    ]
+    runner = ServiceTestRunner(load("pre_reserved.yml"), hosts=hosts)
+    runner.run([
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("hello-0-server"),
+        SendTaskRunning("hello-0-server"),
+        AdvanceCycles(2),
+        ExpectNoLaunches(),  # plain-0 is not reserved for the role
+        ExpectPlanStatus("deploy", Status.IN_PROGRESS),
+        AddHost(TpuHost(
+            host_id="res-1", attributes={"reserved_role": "dedicated"},
+        )),
+        ExpectLaunchedTasks("hello-1-server"),
+        SendTaskRunning("hello-1-server"),
+        ExpectDeploymentComplete(),
+    ])
+    for name in ("hello-0-server", "hello-1-server"):
+        info = runner.agent.task_info_of(name)
+        assert info.agent_id.startswith("res-"), (
+            f"{name} placed on unreserved host {info.agent_id}"
+        )
+
+
+def test_zone_placement_max_per_zone():
+    """zone.yml: max-per-zone:1 — two hosts in one zone cannot take
+    two instances; deploy blocks until a distinct zone appears
+    (reference: MaxPerZoneRule / ZoneValidator flows)."""
+    hosts = [
+        TpuHost(host_id="a0", zone="zone-a"),
+        TpuHost(host_id="a1", zone="zone-a"),
+        TpuHost(host_id="b0", zone="zone-b"),
+    ]
+    runner = ServiceTestRunner(load("zone.yml"), hosts=hosts)
+    runner.run([
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("hello-0-server"),
+        SendTaskRunning("hello-0-server"),
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("hello-1-server"),
+        SendTaskRunning("hello-1-server"),
+        AdvanceCycles(2),
+        ExpectNoLaunches(),  # zone-a and zone-b are taken; a1 is blocked
+        ExpectPlanStatus("deploy", Status.IN_PROGRESS),
+        AddHost(TpuHost(host_id="c0", zone="zone-c")),
+        ExpectLaunchedTasks("hello-2-server"),
+        SendTaskRunning("hello-2-server"),
+        ExpectDeploymentComplete(),
+    ])
+    zones = set()
+    for i in range(3):
+        info = runner.agent.task_info_of(f"hello-{i}-server")
+        host = next(
+            h for h in runner.world.inventory.hosts()
+            if h.host_id == info.agent_id
+        )
+        zones.add(host.zone)
+    assert len(zones) == 3
+
+
+def test_once_goal_survives_restart_but_reruns_on_replace():
+    """once_goal.yml: the ONCE init runs exactly once per pod
+    incarnation — scheduler restart does not re-run it, pod REPLACE
+    does (fresh incarnation re-runs init before the server)."""
+    runner = ServiceTestRunner(load("once_goal.yml"))
+    runner.run([
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("node-0-init"),
+        SendTaskFinished("node-0-init"),
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("node-0-server"),
+        SendTaskRunning("node-0-server"),
+        ExpectDeploymentComplete(),
+    ])
+    restarted = runner.restart()
+    restarted.run([
+        AdvanceCycles(2),
+        ExpectNoLaunches(),
+        ExpectDeploymentComplete(),
+    ])
+    assert len(restarted.agent.launches_of("node-0-init")) == 1
+
+    # pod replace: a fresh incarnation re-runs init alongside the
+    # server (recovery relaunches the pod's tasks as one unit)
+    restarted.world.scheduler.restart_pod("node", 0, replace=True)
+    restarted.run([
+        AdvanceCycles(2),
+        ExpectLaunchedTasks("node-0-init", "node-0-server"),
+        SendTaskFinished("node-0-init"),
+        SendTaskRunning("node-0-server"),
+        AdvanceCycles(1),
+    ])
+    assert len(restarted.agent.launches_of("node-0-init")) == 2
+
+
 def test_crash_loop_delays_relaunch():
     """crash-loop.yml: with backoff enabled, repeated failures push the
     step to DELAYED instead of hot-looping relaunches (reference:
