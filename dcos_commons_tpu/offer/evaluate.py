@@ -65,6 +65,8 @@ ENV_TPU_GENERATION = "TPU_GENERATION"
 ENV_TPU_CHIP_IDS = "TPU_CHIP_IDS"
 ENV_TPU_HOST_BOUNDS = "TPU_CHIPS_PER_HOST_BOUNDS"
 ENV_COORDINATOR_ADDRESS = "COORDINATOR_ADDRESS"
+ENV_TPU_SLICE_INDEX = "TPU_SLICE_INDEX"
+ENV_TPU_NUM_SLICES = "TPU_NUM_SLICES"
 COORDINATOR_PORT_NAME = "coordinator"
 
 
@@ -153,20 +155,27 @@ class OfferEvaluator:
 
         pod = requirement.pod
         rule = parse_placement(pod.placement)
-        if pod.pre_reserved_role:
-            # pre-reserved capacity (reference: ResourceSpec
-            # preReservedRole + PreReservationCannotChange): the fleet
-            # operator marks hosts as carved out for a role via the
-            # reserved_role attribute; a pod declaring the role places
-            # ONLY on those hosts, and the outcome tracker records the
-            # refusals like any placement term
-            from dcos_commons_tpu.offer.placement import (
-                AndRule,
-                FieldMatchRule,
-            )
+        # pre-reserved capacity (reference: ResourceSpec preReservedRole
+        # + PreReservationCannotChange): the fleet operator marks hosts
+        # as carved out for a role via the reserved_role attribute.
+        # BOTH directions are enforced — a pod declaring the role
+        # places ONLY on those hosts, and an ordinary pod NEVER lands
+        # on a carved-out host (otherwise first-fit would consume the
+        # reservation); the outcome tracker records refusals like any
+        # placement term.
+        from dcos_commons_tpu.offer.placement import (
+            AndRule,
+            FieldMatchRule,
+        )
 
+        if pod.pre_reserved_role:
             rule = AndRule([
                 FieldMatchRule("reserved_role", [pod.pre_reserved_role]),
+                rule,
+            ])
+        else:
+            rule = AndRule([
+                FieldMatchRule("reserved_role", [""], invert=False),
                 rule,
             ])
         if pod.gang and pod.tpu is not None and pod.tpu.topology:
@@ -381,28 +390,57 @@ class OfferEvaluator:
                 )
             return EvaluationOutcome.ok(f"host:{snap.host.host_id}")
 
-        placement = find_subslice(
-            snapshots, pod.tpu.topology_dims(), pod.tpu.chips_per_host, eligible
+        # multi-slice gangs (tpu: slices: N): N slice-local sub-gangs,
+        # one contiguous `topology` rectangle in each of N DISTINCT
+        # slices.  Workers are numbered slice-major; every worker gets
+        # TPU_SLICE_INDEX/TPU_NUM_SLICES so the mesh layer lays the dcn
+        # (data-parallel-across-slices) axis over the slice boundary
+        # and keeps tp/sp collectives on ICI (scaling-book recipe).
+        n_slices = pod.tpu.slices
+        ordered: List[ResourceSnapshot] = []
+        used_slices: set = set()
+        outcome = EvaluationOutcome.ok(
+            "gang", f"{n_slices} slice(s) of {pod.tpu.topology}"
         )
-        if not placement.snapshots:
-            return EvaluationResult(False, placement.outcome)
-        if len(placement.snapshots) != len(requirement.instances):
-            placement.outcome.passed = False
-            placement.outcome.reason = (
-                f"topology yields {len(placement.snapshots)} hosts but pod "
-                f"count is {len(requirement.instances)}"
+        for _ in range(n_slices):
+            candidates = [
+                s for s in snapshots if s.host.slice_id not in used_slices
+            ]
+            placement = find_subslice(
+                candidates, pod.tpu.topology_dims(), pod.tpu.chips_per_host,
+                eligible,
             )
-            return EvaluationResult(False, placement.outcome)
+            outcome.children.append(placement.outcome)
+            if not placement.snapshots:
+                outcome.passed = False
+                outcome.reason = (
+                    f"no free slice for sub-gang "
+                    f"{len(used_slices) + 1}/{n_slices} "
+                    f"(excluded: {sorted(used_slices) or 'none'})"
+                )
+                return EvaluationResult(False, outcome)
+            used_slices.add(placement.snapshots[0].host.slice_id)
+            ordered.extend(placement.snapshots)
+        if len(ordered) != len(requirement.instances):
+            outcome.passed = False
+            outcome.reason = (
+                f"{n_slices} slice(s) of topology yield {len(ordered)} "
+                f"hosts but pod count is {len(requirement.instances)}"
+            )
+            return EvaluationResult(False, outcome)
 
-        # worker 0's host carries the jax.distributed coordinator
-        coord_snap = placement.snapshots[0]
+        # worker 0's host (slice 0) carries the jax.distributed
+        # coordinator for the WHOLE multi-slice gang: one global
+        # rendezvous, slice-local ICI + cross-slice DCN under one mesh
+        coord_snap = ordered[0]
         coord_port = coord_snap.copy().allocate_port()
         coordinator = f"{coord_snap.host.host_id}:{coord_port}"
+        hosts_per_slice = len(ordered) // n_slices
 
         reservations: List[Reservation] = []
         task_infos: List[TaskInfo] = []
         for worker_id, (index, snap) in enumerate(
-            zip(requirement.instances, placement.snapshots)
+            zip(requirement.instances, ordered)
         ):
             work = snap.copy()
             chips = work.try_consume_chips(snap.host.chips_per_host)
@@ -413,9 +451,16 @@ class OfferEvaluator:
                         "gang", f"chips vanished on {snap.host.host_id}"
                     ),
                 )
+            slice_env = {}
+            if n_slices > 1:
+                slice_env = {
+                    ENV_TPU_SLICE_INDEX: str(worker_id // hosts_per_slice),
+                    ENV_TPU_NUM_SLICES: str(n_slices),
+                }
             res, infos = self._claim_instance(
                 requirement, index, work, chips, coordinator,
                 coordinator_here=(worker_id == 0), worker_id=worker_id,
+                extra_env=slice_env,
             )
             if res is None:
                 return EvaluationResult(
@@ -426,7 +471,7 @@ class OfferEvaluator:
                 )
             reservations.extend(res)
             task_infos.extend(infos)
-        return EvaluationResult(True, placement.outcome, reservations, task_infos)
+        return EvaluationResult(True, outcome, reservations, task_infos)
 
     def _evaluate_instances(
         self,
@@ -504,6 +549,7 @@ class OfferEvaluator:
         coordinator: str,
         coordinator_here: bool,
         worker_id: int,
+        extra_env: Optional[Dict[str, str]] = None,
     ):
         """Consume scalars/ports on ``work`` and emit reservations +
         TaskInfos for every task of one pod instance."""
@@ -587,7 +633,7 @@ class OfferEvaluator:
                 # sidecar must not double-bind the devices
                 reservations=info_res, chips=list(task_chips),
                 coordinator=coordinator, worker_id=worker_id,
-                extra_env=port_env,
+                extra_env={**(extra_env or {}), **port_env},
             )
             task_infos.append(info)
         return reservations, task_infos

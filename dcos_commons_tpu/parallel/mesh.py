@@ -1,6 +1,9 @@
 """Device mesh construction + named sharding helpers.
 
 Axes vocabulary (scaling-book conventions):
+    dcn   cross-slice data parallel — batch split ACROSS ICI slices,
+          gradient allreduce rides the data-center network (the only
+          collective that should: params replicate over dcn)
     dp    data parallel — batch split, gradient allreduce
     fsdp  fully-sharded data parallel — params/optimizer sharded,
           all-gathered per layer
@@ -31,13 +34,16 @@ class MeshSpec:
     sp: int = 1
     pp: int = 1
     ep: int = 1
+    dcn: int = 1
 
     @property
     def total(self) -> int:
-        return self.dp * self.fsdp * self.tp * self.sp * self.pp * self.ep
+        return (self.dcn * self.dp * self.fsdp * self.tp * self.sp
+                * self.pp * self.ep)
 
     def axes(self) -> Dict[str, int]:
         return {
+            "dcn": self.dcn,
             "dp": self.dp,
             "fsdp": self.fsdp,
             "ep": self.ep,
@@ -61,11 +67,13 @@ def make_mesh(spec: MeshSpec, devices: Optional[Sequence] = None) -> Mesh:
         )
     devices = devices[: spec.total]
     # tp innermost (intra-host ICI), then sp ring, then pp neighbors,
-    # then ep all_to_alls; dp/fsdp outermost where DCN is tolerable
+    # then ep all_to_alls; dp/fsdp outer, and dcn OUTERMOST — jax
+    # orders devices slice-by-slice, so the leading axis is exactly
+    # the slice boundary and only dcn collectives cross it
     arr = np.array(devices).reshape(
-        spec.dp, spec.fsdp, spec.ep, spec.pp, spec.sp, spec.tp
+        spec.dcn, spec.dp, spec.fsdp, spec.ep, spec.pp, spec.sp, spec.tp
     )
-    return Mesh(arr, ("dp", "fsdp", "ep", "pp", "sp", "tp"))
+    return Mesh(arr, ("dcn", "dp", "fsdp", "ep", "pp", "sp", "tp"))
 
 
 def mesh_from_env(env: Dict[str, str], n_devices: Optional[int] = None) -> Mesh:
@@ -77,6 +85,19 @@ def mesh_from_env(env: Dict[str, str], n_devices: Optional[int] = None) -> Mesh:
     """
     n = n_devices if n_devices is not None else len(jax.devices())
     chips_per_host = int(env.get("TPU_CHIPS_PER_HOST", "0") or 0)
+    n_slices = int(env.get("TPU_NUM_SLICES", "1") or 1)
+    if n_slices > 1 and n % n_slices == 0:
+        # multi-slice gang: dcn (pure data parallel) over the slice
+        # boundary, dp x tp within each slice over ICI
+        per_slice = n // n_slices
+        if chips_per_host and per_slice % chips_per_host == 0 \
+                and per_slice >= chips_per_host:
+            return make_mesh(MeshSpec(
+                dcn=n_slices,
+                dp=per_slice // chips_per_host,
+                tp=chips_per_host,
+            ))
+        return make_mesh(MeshSpec(dcn=n_slices, dp=per_slice))
     if chips_per_host and n % chips_per_host == 0 and n > chips_per_host:
         return make_mesh(
             MeshSpec(dp=n // chips_per_host, tp=chips_per_host)
@@ -93,7 +114,7 @@ def named(mesh: Mesh, *spec) -> NamedSharding:
     return NamedSharding(mesh, PartitionSpec(*spec))
 
 
-BATCH_AXES = ("dp", "fsdp")  # batch shards over both data axes
+BATCH_AXES = ("dcn", "dp", "fsdp")  # batch shards over all data axes
 
 
 def batch_spec() -> PartitionSpec:

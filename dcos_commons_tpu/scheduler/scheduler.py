@@ -137,7 +137,10 @@ class DefaultScheduler:
         and in-place relaunches proceed, but no NEW reservations are
         taken (reference: OfferDiscipline/ParallelFootprintDiscipline,
         scheduler/multi/OfferDiscipline.java:11-33)."""
-        with self._lock:
+        with self._lock, self.metrics.time("cycle.process"):
+            # the reference's offers.process timer (Metrics.java:33):
+            # scale tests fence on this staying bounded as the fleet
+            # and service count grow
             self._intake_statuses()
             if not self.reconciler.is_reconciled:
                 for status in self.reconciler.reconcile():

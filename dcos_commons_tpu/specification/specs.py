@@ -51,6 +51,11 @@ class TpuSpec:
     generation: str = "v5e"          # v4 / v5e / v5p / v6e ...
     chips_per_host: int = 4
     topology: str = ""               # "" = no multi-host shape required
+    # multi-slice gangs: the pod spans `slices` ICI slices, each
+    # forming one `topology` sub-slice; slices talk over DCN (data
+    # parallel across slices is the standard recipe — the dcn mesh
+    # axis).  count must equal slices * hosts-per-slice.
+    slices: int = 1
 
     def topology_dims(self) -> Tuple[int, ...]:
         if not self.topology:
@@ -280,18 +285,50 @@ def _decode_service(data: Dict[str, Any]) -> ServiceSpec:
     )
 
 
+def merge_pod_volumes(tasks, pod_volumes):
+    """Pod-level volumes are shared by every task of the pod
+    (reference: pod volumes land in each task's resource set): copy
+    them into each task's volume list, task-level declarations winning
+    on path clashes.  Applied by BOTH the YAML mapper and from_dict so
+    stored target configs written before the merge existed normalize
+    to the same shape on load — keeping the builder's spec-equality
+    check (and so the target-config pointer) stable across upgrades."""
+    import dataclasses as _dc
+
+    if not pod_volumes:
+        return tuple(tasks)
+    return tuple(
+        _dc.replace(
+            t,
+            volumes=tuple(
+                v for v in pod_volumes
+                if v.container_path not in {
+                    tv.container_path for tv in t.volumes
+                }
+            ) + t.volumes,
+        )
+        for t in tasks
+    )
+
+
 def _decode_pod(data: Dict[str, Any]) -> PodSpec:
     tpu = data.get("tpu")
+    pod_volumes = tuple(
+        VolumeSpec(**_vol(v)) for v in data.get("volumes", [])
+    )
     return PodSpec(
         type=data["type"],
         count=data.get("count", 1),
-        tasks=tuple(_decode_task(t) for t in data.get("tasks", [])),
+        tasks=merge_pod_volumes(
+            tuple(_decode_task(t) for t in data.get("tasks", [])),
+            pod_volumes,
+        ),
         tpu=TpuSpec(**tpu) if tpu else None,
         gang=data.get("gang", False),
         image=data.get("image", ""),
         networks=tuple(data.get("networks", ())),
         placement=data.get("placement", ""),
-        volumes=tuple(VolumeSpec(**_vol(v)) for v in data.get("volumes", [])),
+        volumes=pod_volumes,
         pre_reserved_role=data.get("pre_reserved_role", ""),
         allow_decommission=data.get("allow_decommission", False),
         share_pid_namespace=data.get("share_pid_namespace", False),

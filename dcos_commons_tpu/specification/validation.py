@@ -140,36 +140,58 @@ def tpu_topology_cannot_change(old, new):
         elif (
             old_pod.tpu.generation != new_pod.tpu.generation
             or old_pod.tpu.topology != new_pod.tpu.topology
+            or old_pod.tpu.slices != new_pod.tpu.slices
         ):
             errs.append(
                 f"pod {old_pod.type!r} TPU topology cannot change "
-                f"({old_pod.tpu.generation}/{old_pod.tpu.topology} -> "
-                f"{new_pod.tpu.generation}/{new_pod.tpu.topology}); "
-                "use pod replace"
+                f"({old_pod.tpu.generation}/{old_pod.tpu.topology}"
+                f"x{old_pod.tpu.slices} -> "
+                f"{new_pod.tpu.generation}/{new_pod.tpu.topology}"
+                f"x{new_pod.tpu.slices}); use pod replace"
             )
     return errs
 
 
 def gang_pods_need_topology(old, new):
     """A gang pod with a multi-host topology must have count matching
-    the topology's host count (total_chips / chips_per_host)."""
+    slices x the per-slice host count (total_chips / chips_per_host)."""
     errs = []
     for pod in new.pods:
-        if pod.tpu is None or not pod.tpu.topology:
+        if pod.tpu is None:
+            continue
+        if not pod.tpu.topology:
+            if pod.tpu.slices > 1:
+                # a slices request without a topology (or gang) would
+                # silently take the per-instance placement path with
+                # no slice contract — reject, don't drop on the floor
+                errs.append(
+                    f"pod {pod.type!r}: tpu slices: {pod.tpu.slices} "
+                    "requires a topology (the per-slice ICI shape)"
+                )
+            continue
+        if pod.tpu.slices > 1 and not pod.gang:
+            errs.append(
+                f"pod {pod.type!r}: tpu slices: {pod.tpu.slices} "
+                "requires gang: true (sub-gangs place atomically)"
+            )
             continue
         total = pod.tpu.total_chips
         per_host = pod.tpu.chips_per_host
+        if pod.tpu.slices < 1:
+            errs.append(f"pod {pod.type!r}: slices must be >= 1")
+            continue
         if total % per_host != 0:
             errs.append(
                 f"pod {pod.type!r}: topology {pod.tpu.topology} total chips "
                 f"{total} not divisible by chips-per-host {per_host}"
             )
             continue
-        hosts = total // per_host
+        hosts = (total // per_host) * pod.tpu.slices
         if pod.count != hosts:
             errs.append(
                 f"pod {pod.type!r}: count {pod.count} != {hosts} hosts implied "
-                f"by topology {pod.tpu.topology} at {per_host} chips/host"
+                f"by {pod.tpu.slices} slice(s) of topology "
+                f"{pod.tpu.topology} at {per_host} chips/host"
             )
     return errs
 

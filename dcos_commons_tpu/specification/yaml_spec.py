@@ -177,31 +177,20 @@ def _map_pod(
             generation=str(tpu_raw.get("generation", "v5e")),
             chips_per_host=int(tpu_raw.get("chips-per-host", 4)),
             topology=str(tpu_raw.get("topology", "")),
+            slices=int(tpu_raw.get("slices", 1)),
         )
-    tasks = tuple(
-        _map_task(task_name, task_raw or {}, routed_env, base_dir)
-        for task_name, task_raw in tasks_raw.items()
-    )
-    pod_volumes = _map_volumes(raw)
-    if pod_volumes:
-        # pod-level volumes are shared by every task of the pod
-        # (reference: pod volumes land in each task's resource set);
-        # merging them here lets the evaluator's sibling-sharing give
-        # all tasks ONE durable key per container path
-        import dataclasses as _dc
+    from dcos_commons_tpu.specification.specs import merge_pod_volumes
 
-        tasks = tuple(
-            _dc.replace(
-                t,
-                volumes=tuple(
-                    v for v in pod_volumes
-                    if v.container_path not in {
-                        tv.container_path for tv in t.volumes
-                    }
-                ) + t.volumes,
-            )
-            for t in tasks
-        )
+    pod_volumes = _map_volumes(raw)
+    # shared with from_dict: the evaluator's sibling-sharing then gives
+    # all tasks ONE durable key per container path
+    tasks = merge_pod_volumes(
+        tuple(
+            _map_task(task_name, task_raw or {}, routed_env, base_dir)
+            for task_name, task_raw in tasks_raw.items()
+        ),
+        pod_volumes,
+    )
     return PodSpec(
         type=str(pod_name),
         count=int(raw.get("count", 1)),

@@ -302,6 +302,27 @@ def test_pre_reserved_role_places_only_on_reserved_hosts():
         )
 
 
+def test_unreserved_pods_never_consume_reserved_hosts():
+    """The carve-out holds in BOTH directions: an ordinary pod (no
+    pre-reserved-role) must not land on a reserved host even when it
+    is the only host with capacity (reference: pre-reserved resources
+    are invisible to other roles)."""
+    hosts = [TpuHost(
+        host_id="res-0", attributes={"reserved_role": "dedicated"},
+    )]
+    runner = ServiceTestRunner(load("simple.yml"), hosts=hosts)
+    runner.run([
+        AdvanceCycles(3),
+        ExpectNoLaunches(),
+        ExpectPlanStatus("deploy", Status.PENDING),
+        AddHost(TpuHost(host_id="plain-0")),
+        ExpectLaunchedTasks("hello-0-server"),
+        SendTaskRunning("hello-0-server"),
+        ExpectDeploymentComplete(),
+    ])
+    assert runner.agent.task_info_of("hello-0-server").agent_id == "plain-0"
+
+
 def test_zone_placement_max_per_zone():
     """zone.yml: max-per-zone:1 — two hosts in one zone cannot take
     two instances; deploy blocks until a distinct zone appears

@@ -333,6 +333,76 @@ def test_gang_torus_avoids_reserved_hosts():
         grids == [(2, 0), (2, 1), (3, 0), (3, 1)]
 
 
+MULTISLICE_YAML = """
+name: jax
+pods:
+  trainer:
+    count: 4
+    gang: true
+    tpu:
+      generation: v5e
+      chips-per-host: 4
+      topology: 2x4
+      slices: 2
+    tasks:
+      worker:
+        goal: FINISH
+        cmd: "python train.py"
+        cpus: 2.0
+        memory: 4096
+"""
+
+
+def test_evaluate_multislice_gang():
+    """tpu: slices: 2 — two slice-local 2x4 sub-gangs in DISTINCT
+    slices, slice-major worker ids, one global coordinator, and the
+    TPU_SLICE_INDEX/TPU_NUM_SLICES contract for the dcn mesh axis."""
+    fleet = (
+        make_test_fleet(slice_id="pod-a", host_grid=(1, 2),
+                        chip_block=(2, 2))
+        + make_test_fleet(slice_id="pod-b", host_grid=(1, 2),
+                          chip_block=(2, 2))
+        + make_test_fleet(slice_id="pod-c", host_grid=(1, 2),
+                          chip_block=(2, 2))
+    )
+    spec, store, ledger, ev, inv = build_eval(MULTISLICE_YAML, fleet)
+    req = PodInstanceRequirement(
+        pod=spec.pod("trainer"), instances=[0, 1, 2, 3]
+    )
+    result = ev.evaluate(req, inv)
+    assert result.passed, result.outcome.flatten()
+    assert len(result.task_infos) == 4
+    by_worker = sorted(
+        result.task_infos, key=lambda i: int(i.env["TPU_WORKER_ID"])
+    )
+    # slice-major numbering: workers 0-1 in one slice, 2-3 in another
+    slice_of = [inv.host(i.agent_id).slice_id for i in by_worker]
+    assert slice_of[0] == slice_of[1]
+    assert slice_of[2] == slice_of[3]
+    assert slice_of[0] != slice_of[2]
+    assert [i.env["TPU_SLICE_INDEX"] for i in by_worker] == \
+        ["0", "0", "1", "1"]
+    assert all(i.env["TPU_NUM_SLICES"] == "2" for i in by_worker)
+    # ONE coordinator for the whole multi-slice gang, on worker 0
+    coords = {i.env[ENV_COORDINATOR_ADDRESS] for i in result.task_infos}
+    assert len(coords) == 1
+    assert coords.pop().startswith(by_worker[0].agent_id)
+
+
+def test_multislice_gang_needs_distinct_slices():
+    """One free slice cannot host a slices: 2 gang — and the outcome
+    says which sub-gang failed."""
+    fleet = make_test_fleet(slice_id="pod-a", host_grid=(1, 2),
+                            chip_block=(2, 2))
+    spec, store, ledger, ev, inv = build_eval(MULTISLICE_YAML, fleet)
+    req = PodInstanceRequirement(
+        pod=spec.pod("trainer"), instances=[0, 1, 2, 3]
+    )
+    result = ev.evaluate(req, inv)
+    assert not result.passed
+    assert "no free slice for sub-gang 2/2" in result.outcome.reason
+
+
 def test_gang_torus_no_capacity_explains():
     fleet = make_test_fleet(host_grid=(1, 1), chip_block=(2, 2))
     spec, store, ledger, ev, inv = build_eval(GANG_YAML, fleet)

@@ -5,8 +5,16 @@ service instances concurrently and watches them all complete; this is
 the sim-speed analogue over a shared fleet, asserting completion,
 isolation (every service's tasks land and no reservation collides)
 and that the control plane's per-cycle cost stays sane as N grows.
+
+test_scale_distributed_fleet_with_churn crosses real sockets: 16
+agent daemon PROCESSES under one multi scheduler process, 24
+services, daemon-kill churn — the fleet fan-out
+(agent/remote.py concurrent poll) at fleet size.
 """
 
+import os
+import subprocess
+import sys
 import time
 
 from dcos_commons_tpu.common import TaskState, TaskStatus
@@ -148,3 +156,155 @@ def test_scale_uninstall_one_leaves_rest_running():
         for p in range(PODS_PER_SERVICE)
     }
     assert not (survivor_ids & killed)
+
+
+# -- distributed-plane scale: real daemons, real sockets --------------
+
+
+def test_scale_distributed_fleet_with_churn(tmp_path):
+    """16 agent daemon processes under one serve --multi scheduler
+    process, 24 services (48 tasks), then daemon-kill churn: the two
+    dead hosts' tasks are replaced on survivors, every unaffected
+    service keeps its task ids, and the per-cycle timer stays bounded
+    (reference: helloworld/tests/scale/test_scale.py + the
+    fleet fan-out in agent/remote.py:140-161)."""
+    from dcos_commons_tpu.testing.integration import (
+        AgentProcess,
+        ServiceClient,
+        wait_for,
+    )
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    n_daemons, n_services = 16, 24
+    daemons = [
+        AgentProcess(f"sh{i:02d}", str(tmp_path / f"agent-{i:02d}"), repo)
+        for i in range(n_daemons)
+    ]
+    svc_paths = []
+    for i in range(n_services):
+        path = tmp_path / f"svc-{i:03d}.yml"
+        # a REAL command — these run as processes inside the daemons
+        path.write_text(service_yaml(i).replace(
+            f'cmd: "serve-{i:03d}"',
+            f'cmd: "echo serve-{i:03d} && sleep 600"',
+        ))
+        svc_paths.append(str(path))
+    lines = ["hosts:"]
+    for daemon in daemons:
+        lines += [
+            f"  - host_id: {daemon.host_id}",
+            f"    agent_url: {daemon.url}",
+            "    cpus: 8.0",
+            "    memory_mb: 16384",
+        ]
+    topology = tmp_path / "topology.yml"
+    topology.write_text("\n".join(lines) + "\n")
+    announce = tmp_path / "announce"
+    log = open(tmp_path / "scheduler.log", "ab")
+    scheduler = subprocess.Popen(
+        [
+            sys.executable, "-m", "dcos_commons_tpu", "serve", "--multi",
+            *svc_paths,
+            "--topology", str(topology),
+            "--port", "0",
+            "--state-dir", str(tmp_path / "state"),
+            "--sandbox-root", str(tmp_path / "sbx"),
+            "--announce-file", str(announce),
+        ],
+        cwd=repo,
+        env={
+            **os.environ,
+            "ENABLE_BACKOFF": "false",
+            "PERMANENT_FAILURE_TIMEOUT_S": "1",
+        },
+        stdout=log,
+        stderr=subprocess.STDOUT,
+    )
+    try:
+        url = wait_for(
+            lambda: (
+                open(announce).read().strip()
+                if os.path.exists(announce) else None
+            ),
+            30.0,
+            what="multi scheduler announce",
+        )
+        client = ServiceClient(url)
+        names = [f"svc-{i:03d}" for i in range(n_services)]
+
+        def all_deployed():
+            for name in names:
+                plan = client.get(f"/v1/multi/{name}/v1/plans/deploy")
+                if plan["status"] != "COMPLETE":
+                    return None
+            return True
+
+        wait_for(all_deployed, 180.0, interval_s=1.0,
+                 what="24 services deployed over 16 daemons")
+
+        def ids_of(name):
+            infos = [
+                info
+                for p in range(PODS_PER_SERVICE)
+                for info in client.get(
+                    f"/v1/multi/{name}/v1/pod/app-{p}/info"
+                )
+            ]
+            return {i["name"]: (i["task_id"], i["agent_id"])
+                    for i in infos}
+
+        before = {name: ids_of(name) for name in names}
+        spread = {
+            agent_id
+            for svc in before.values()
+            for _, agent_id in svc.values()
+        }
+        # first-fit packs 16 tasks/host (8 cpus / 0.5) -> >= 3 hosts
+        assert len(spread) >= 3, f"fleet barely used: {sorted(spread)}"
+
+        # churn: kill two daemons that actually carry tasks
+        victim_hosts = sorted(spread)[:2]
+        victims = set(victim_hosts)
+        for daemon in daemons:
+            if daemon.host_id in victims:
+                daemon.kill()
+        affected = {
+            name for name, tasks in before.items()
+            if any(agent_id in victims for _, agent_id in tasks.values())
+        }
+        assert affected, "churn hit no services — topology spread broken"
+
+        def recovered():
+            for name in affected:
+                for task, (old_id, old_agent) in before[name].items():
+                    if old_agent not in victims:
+                        continue
+                    now = ids_of(name).get(task)
+                    if now is None or now[0] == old_id or \
+                            now[1] in victims:
+                        return None
+            return True
+
+        wait_for(recovered, 180.0, interval_s=1.0,
+                 what="churned tasks replaced on surviving daemons")
+
+        # no cross-service kills: unaffected services keep their ids
+        for name in sorted(set(names) - affected):
+            assert ids_of(name) == before[name], f"{name} was disturbed"
+
+        # per-cycle cost stays bounded at fleet size (cycle.process
+        # timer; generous CI bound — the point is not-seconds)
+        slowest = 0.0
+        for name in names[:4]:
+            snap = client.get(f"/v1/multi/{name}/v1/metrics")
+            slowest = max(slowest, snap.get("cycle.process.max_s", 0.0))
+        assert 0.0 < slowest < 5.0, f"cycle.process.max_s {slowest}"
+    finally:
+        scheduler.terminate()
+        try:
+            scheduler.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            scheduler.kill()
+        log.close()
+        for daemon in daemons:
+            daemon.stop()

@@ -243,6 +243,79 @@ def test_live_update_rolls_without_process_restart(cluster, tmp_path):
         scheduler.terminate()
 
 
+MULTISLICE_SVC = """
+name: twoslice
+pods:
+  trainer:
+    count: 2
+    gang: true
+    tpu:
+      generation: v5e
+      chips-per-host: 4
+      topology: 2x2
+      slices: 2
+    tasks:
+      worker:
+        goal: RUNNING
+        cmd: "echo slice=$TPU_SLICE_INDEX/$TPU_NUM_SLICES coord=$COORDINATOR_ADDRESS && sleep 120"
+        cpus: 0.1
+        memory: 64
+"""
+
+
+def test_serve_deploys_multislice_gang_over_daemons(tmp_path):
+    """A slices: 2 gang deploys over two agent daemon processes, one
+    per slice: slice-local sub-gangs, one global coordinator, the
+    TPU_SLICE_INDEX/TPU_NUM_SLICES contract visible in the running
+    tasks (SURVEY 5.8/7: inter-slice DCN gangs)."""
+    agents = [
+        AgentProcess(f"ts-h{i}", str(tmp_path / f"agent-{i}"), REPO)
+        for i in range(2)
+    ]
+    svc = tmp_path / "svc.yml"
+    svc.write_text(MULTISLICE_SVC)
+    lines = ["hosts:"]
+    for i, agent in enumerate(agents):
+        lines += [
+            f"  - host_id: {agent.host_id}",
+            f"    agent_url: {agent.url}",
+            f"    slice_id: slice-{i}",
+            "    generation: v5e",
+            "    grid: [0, 0]",
+            "    chip_block: [2, 2]",
+            "    cpus: 4.0",
+            "    memory_mb: 8192",
+        ]
+    topology = tmp_path / "topology.yml"
+    topology.write_text("\n".join(lines) + "\n")
+    scheduler = SchedulerProcess(
+        str(svc), str(topology), str(tmp_path / "sched"),
+        env={"ENABLE_BACKOFF": "false"}, repo_root=REPO,
+    )
+    try:
+        client = scheduler.client()
+        client.wait_for_completed_deployment(timeout_s=90)
+        infos = {
+            i["name"]: i
+            for idx in (0, 1)
+            for i in client.get(f"/v1/pod/trainer-{idx}/info")
+        }
+        assert set(infos) == {"trainer-0-worker", "trainer-1-worker"}
+        envs = {n: i["env"] for n, i in infos.items()}
+        assert {e["TPU_SLICE_INDEX"] for e in envs.values()} == {"0", "1"}
+        assert all(e["TPU_NUM_SLICES"] == "2" for e in envs.values())
+        coords = {e["COORDINATOR_ADDRESS"] for e in envs.values()}
+        assert len(coords) == 1
+        # the daemons really ran the workers with the slice contract
+        agent_ids = {i["agent_id"] for i in infos.values()}
+        assert agent_ids == {"ts-h0", "ts-h1"}
+    finally:
+        code = scheduler.terminate()
+        for agent in agents:
+            agent.stop()
+        assert code == 0, scheduler.log_tail()
+
+
 def test_load_topology_rejects_mixed_mode(tmp_path):
     path = tmp_path / "topology.yml"
     path.write_text(

@@ -342,6 +342,28 @@ def test_validate_secrets_need_provider():
     )
 
 
+def test_validate_slices_need_topology_and_gang():
+    # slices without a topology: rejected, not silently single-slice
+    bad = JAX_YAML.replace(
+        "      topology: 4x4\n", ""
+    ).replace("generation: v5e", "generation: v5e\n      slices: 2")
+    with pytest.raises(ConfigValidationError) as err:
+        validate_spec_change(None, from_yaml(bad))
+    assert "requires a topology" in str(err.value)
+    # slices without gang: equally rejected
+    bad2 = JAX_YAML.replace("gang: true", "gang: false").replace(
+        "generation: v5e", "generation: v5e\n      slices: 2"
+    ).replace("count: 4", "count: 8")
+    with pytest.raises(ConfigValidationError) as err:
+        validate_spec_change(None, from_yaml(bad2))
+    assert "requires gang" in str(err.value)
+    # a correct 2-slice spec passes (count = slices x hosts-per-slice)
+    ok = JAX_YAML.replace(
+        "generation: v5e", "generation: v5e\n      slices: 2"
+    ).replace("count: 4", "count: 8")
+    validate_spec_change(None, from_yaml(ok))
+
+
 def test_default_validator_breadth():
     """Reference config/validate/ has 19 validator classes; parity
     demands the default set covers at least 16 distinct checks."""
