@@ -66,7 +66,12 @@ class TransformerConfig:
                 f"remat_policy {self.remat_policy!r} not in "
                 "('full', 'save-attn')"
             )
-    use_ring_attention: bool = False   # sequence sharded over "sp"
+        if self.use_ring_attention and self.use_ulysses_attention:
+            raise ValueError(
+                "pick ONE sequence-parallel recipe: ring or ulysses"
+            )
+    use_ring_attention: bool = False     # sp: K/V rotate (ppermute)
+    use_ulysses_attention: bool = False  # sp: all_to_all head regroup
     sp_axis: str = "sp"
     # sequence-chunked cross entropy: the [b, s, vocab] f32 logits are
     # never materialized — each chunk's logits are computed, reduced to
@@ -186,6 +191,13 @@ def _attention_block(config: TransformerConfig, layer, x, positions):
         from dcos_commons_tpu.parallel.ring import ring_attention
 
         attn = ring_attention(q, k, v, axis_name=config.sp_axis, causal=True)
+    elif config.use_ulysses_attention:
+        from dcos_commons_tpu.parallel.ulysses import ulysses_attention
+
+        attn = ulysses_attention(
+            q, k, v, axis_name=config.sp_axis, causal=True,
+            block_q=config.attn_block_q, block_k=config.attn_block_k,
+        )
     else:
         attn = flash_attention(
             q, k, v, causal=True,
@@ -283,8 +295,9 @@ def _trunk(
     b, s = tokens.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
-        if config.use_ring_attention:
-            # each sp shard holds a consecutive chunk; offset positions
+        if config.use_ring_attention or config.use_ulysses_attention:
+            # each sp shard holds a consecutive chunk; RoPE needs the
+            # GLOBAL position of every token, so offset by the shard
             idx = lax.axis_index(config.sp_axis)
             positions = positions + idx * s
     x = params["embed"][tokens].astype(config.dtype)

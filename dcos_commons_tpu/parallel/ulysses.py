@@ -1,0 +1,82 @@
+"""Ulysses attention: all-to-all sequence/context parallelism.
+
+The second long-context recipe (task brief: "ring attention OR
+all-to-all sequence/context parallelism"; DeepSpeed-Ulysses is the
+public pattern).  Where ring attention keeps the sequence sharded and
+rotates K/V around the ``sp`` ring, Ulysses RESHAPES the parallelism
+with two all_to_alls:
+
+    [b, H, s/P, d]  --all_to_all-->  [b, H/P, s, d]
+         (sequence sharded)              (heads sharded)
+
+Each device then runs ordinary full-sequence attention — the in-repo
+flash kernel (ops/attention.py) — over its H/P heads, and a second
+all_to_all restores sequence sharding.  Two all_to_alls move the same
+bytes a single ring rotation does, but in O(1) collective steps
+instead of P ppermute hops, so Ulysses wins when the per-hop latency
+dominates (small chunks / large P) and ring wins when overlap with
+compute matters more.  Causality is exact: every device sees the FULL
+sequence for its heads, so the flash kernel's causal mask needs no
+cross-chunk bookkeeping.
+
+Requires heads % axis_size == 0 (heads are the split resource).
+Run inside shard_map with ``axis_name`` bound, sequence sharded on
+the -2 axis of q/k/v.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax import lax
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = "sp",
+    causal: bool = True,
+    block_q: int = 128,
+    block_k: int = 128,
+    axis_size: Optional[int] = None,
+) -> jax.Array:
+    """Attention over a sequence sharded on ``axis_name``.
+
+    Per-device shapes: q/k/v [batch, heads, chunk, head_dim] with the
+    FULL head count and chunk = seq / axis_size; returns the same
+    shape (sequence sharded again).
+    """
+    from dcos_commons_tpu.ops.attention import flash_attention
+
+    if axis_size is None:
+        axis_size = lax.axis_size(axis_name)
+    if axis_size == 1:
+        return flash_attention(
+            q, k, v, causal=causal, block_q=block_q, block_k=block_k
+        )
+    heads = q.shape[1]
+    if heads % axis_size != 0:
+        raise ValueError(
+            f"ulysses needs heads ({heads}) divisible by the sp axis "
+            f"size ({axis_size})"
+        )
+
+    def seq_to_heads(x):
+        # [b, H, s/P, d] -> [b, H/P, s, d]: split the head axis across
+        # the group, concatenate the sequence chunks
+        return lax.all_to_all(
+            x, axis_name, split_axis=1, concat_axis=2, tiled=True
+        )
+
+    def heads_to_seq(x):
+        return lax.all_to_all(
+            x, axis_name, split_axis=2, concat_axis=1, tiled=True
+        )
+
+    q_h, k_h, v_h = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    out = flash_attention(
+        q_h, k_h, v_h, causal=causal, block_q=block_q, block_k=block_k
+    )
+    return heads_to_seq(out)

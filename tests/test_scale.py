@@ -276,12 +276,13 @@ def test_scale_distributed_fleet_with_churn(tmp_path):
 
         def recovered():
             for name in affected:
+                now = ids_of(name)
                 for task, (old_id, old_agent) in before[name].items():
                     if old_agent not in victims:
                         continue
-                    now = ids_of(name).get(task)
-                    if now is None or now[0] == old_id or \
-                            now[1] in victims:
+                    current = now.get(task)
+                    if current is None or current[0] == old_id or \
+                            current[1] in victims:
                         return None
             return True
 
