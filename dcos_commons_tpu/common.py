@@ -235,3 +235,5 @@ class Label:
     REGION = "offer_region"
     GOAL_STATE = "goal_state"
     GOAL_STATE_OVERRIDE = "goal_state_override"
+    NETWORKS = "networks"
+    SHARE_PID_NAMESPACE = "share_pid_namespace"

@@ -178,6 +178,18 @@ class OfferEvaluator:
                 FieldMatchRule("reserved_role", [""], invert=False),
                 rule,
             ])
+        # profile volumes constrain placement: the host must advertise
+        # every storage profile the pod's volumes demand
+        profiles = {
+            p
+            for task in pod.tasks
+            for v in task.volumes
+            for p in v.profiles
+        }
+        if profiles:
+            from dcos_commons_tpu.offer.placement import VolumeProfilesRule
+
+            rule = AndRule([VolumeProfilesRule(profiles), rule])
         if pod.gang and pod.tpu is not None and pod.tpu.topology:
             return self._evaluate_gang(requirement, snapshots, rule, ctx)
         return self._evaluate_instances(requirement, snapshots, rule, ctx)
@@ -209,7 +221,7 @@ class OfferEvaluator:
                 return None  # host gone: fall through to fresh placement
             placements.append((index, host_id, reservations))
 
-        coordinator = self._existing_coordinator(requirement)
+        coordinator = self._existing_coordinator(requirement, inventory)
         pod = requirement.pod
         if pod.gang and pod.tpu is not None and pod.tpu.topology \
                 and not coordinator:
@@ -321,7 +333,7 @@ class OfferEvaluator:
             if coord_snap is None:
                 return None
             coord_port = coord_snap.copy().allocate_port()
-            coordinator = f"{coord_host}:{coord_port}"
+            coordinator = _coordinator_address(coord_snap.host, coord_port)
         # instances sharing a host consume from ONE working snapshot so
         # capacity cannot be double-booked
         claimed: Dict[str, ResourceSnapshot] = {}
@@ -352,7 +364,7 @@ class OfferEvaluator:
         return EvaluationResult(True, outcome, reservations, task_infos)
 
     def _existing_coordinator(
-        self, requirement: PodInstanceRequirement
+        self, requirement: PodInstanceRequirement, inventory
     ) -> str:
         # relaunches keep the original rendezvous point: reservations
         # for instance 0 carry the coordinator port
@@ -362,8 +374,10 @@ class OfferEvaluator:
             )
         ):
             if r.container_path == COORDINATOR_PORT_NAME and r.ports:
-                host = r.host_id
-                return f"{host}:{r.ports[0]}"
+                host = inventory.host(r.host_id)
+                if host is not None:
+                    return _coordinator_address(host, r.ports[0])
+                return f"{r.host_id}:{r.ports[0]}"
         return ""
 
     # -- fresh placement ----------------------------------------------
@@ -434,7 +448,7 @@ class OfferEvaluator:
         # rendezvous, slice-local ICI + cross-slice DCN under one mesh
         coord_snap = ordered[0]
         coord_port = coord_snap.copy().allocate_port()
-        coordinator = f"{coord_snap.host.host_id}:{coord_port}"
+        coordinator = _coordinator_address(coord_snap.host, coord_port)
         hosts_per_slice = len(ordered) // n_slices
 
         reservations: List[Reservation] = []
@@ -565,7 +579,7 @@ class OfferEvaluator:
             coord_port = work.allocate_port(int(coordinator.rsplit(":", 1)[1]))
             if coord_port is None:
                 coord_port = work.allocate_port()
-                coordinator = f"{work.host.host_id}:{coord_port}"
+                coordinator = _coordinator_address(work.host, coord_port)
             coord_res = Reservation(
                 reservation_id=new_reservation_id(),
                 host_id=work.host.host_id,
@@ -725,6 +739,14 @@ class OfferEvaluator:
             Label.REGION: host.region,
             Label.GOAL_STATE: task_spec.goal.value,
         }
+        if pod.networks:
+            # virtual network membership (reference: CNI networks on
+            # the ContainerInfo): recorded for the agent's container
+            # runtime and surfaced to the task
+            labels[Label.NETWORKS] = ",".join(pod.networks)
+            env["TASK_NETWORKS"] = ",".join(pod.networks)
+        if pod.share_pid_namespace:
+            labels[Label.SHARE_PID_NAMESPACE] = "true"
         # pod pause: a PAUSED goal override swaps the real command for
         # an idle one, so the task occupies its reservations without
         # doing work (reference: GoalStateOverride.PAUSED launched with
@@ -751,6 +773,13 @@ class OfferEvaluator:
             volumes=volumes,
             labels=labels,
         )
+
+
+def _coordinator_address(host, port) -> str:
+    """The jax.distributed rendezvous point workers DIAL — it must be
+    a reachable address, so the topology's ``hostname`` (the DCN
+    address of the host) wins over the logical host_id."""
+    return f"{host.hostname or host.host_id}:{port}"
 
 
 def _task_disk_mb(task_spec, seen_paths: set) -> int:

@@ -311,6 +311,36 @@ class RoundRobinByRule(PlacementRule):
         )
 
 
+class VolumeProfilesRule(PlacementRule):
+    """The pod's volumes demand storage profiles (reference: profile
+    MOUNT volumes matched against DC/OS storage profiles,
+    VolumeEvaluationStage.java): the host must advertise every
+    requested profile in its ``volume_profiles`` attribute
+    (comma-separated, e.g. ``volume_profiles: "ssd,nvme"``)."""
+
+    def __init__(self, profiles):
+        self.profiles = sorted(set(profiles))
+
+    def filter(self, snapshot, ctx):
+        advertised = {
+            p.strip()
+            for p in snapshot.host.attributes.get(
+                "volume_profiles", ""
+            ).split(",")
+            if p.strip()
+        }
+        missing = [p for p in self.profiles if p not in advertised]
+        if not missing:
+            return EvaluationOutcome.ok(
+                "volume-profiles", ",".join(self.profiles) or "any"
+            )
+        return EvaluationOutcome.fail(
+            "volume-profiles",
+            f"host {snapshot.host.host_id} lacks storage profile(s) "
+            f"{missing} (advertises {sorted(advertised) or 'none'})",
+        )
+
+
 class SameSliceRule(PlacementRule):
     """TPU-first: all instances of the pod on one physical slice."""
 

@@ -394,6 +394,76 @@ def test_once_goal_survives_restart_but_reruns_on_replace():
     assert len(restarted.agent.launches_of("node-0-init")) == 2
 
 
+def test_overlay_network_membership_in_task_contract():
+    """overlay.yml: network membership lands in the task's label + env
+    contract, and joining a network later is a rejected update
+    (network-regime validator)."""
+    from dcos_commons_tpu.common import Label
+    from dcos_commons_tpu.specification import (
+        ConfigValidationError,
+        from_yaml,
+        validate_spec_change,
+    )
+
+    runner = ServiceTestRunner(load("overlay.yml"))
+    runner.run([
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("hello-0-server"),
+        SendTaskRunning("hello-0-server"),
+        ExpectDeploymentComplete(),
+    ])
+    info = runner.agent.task_info_of("hello-0-server")
+    assert info.labels[Label.NETWORKS] == "dcos"
+    assert info.env["TASK_NETWORKS"] == "dcos"
+    # leaving the overlay on update: rejected
+    on_net = from_yaml(load("overlay.yml"), {"FRAMEWORK_NAME": "s"})
+    off_net = from_yaml(load("simple.yml"), {"FRAMEWORK_NAME": "s"})
+    with pytest.raises(ConfigValidationError) as err:
+        validate_spec_change(on_net, off_net)
+    assert "networks" in str(err.value)
+
+
+def test_profile_mount_volume_gates_placement():
+    """profile_mount.yml: the ssd-profile volume places only on hosts
+    advertising the profile; deploy blocks (with a traceable reason)
+    until one exists."""
+    hosts = [TpuHost(host_id="spinny-0")]  # no volume_profiles
+    runner = ServiceTestRunner(load("profile_mount.yml"), hosts=hosts)
+    runner.run([
+        AdvanceCycles(3),
+        ExpectNoLaunches(),
+        ExpectPlanStatus("deploy", Status.PENDING),
+        AddHost(TpuHost(
+            host_id="fast-0",
+            attributes={"volume_profiles": "ssd,nvme"},
+        )),
+        ExpectLaunchedTasks("data-0-server"),
+        SendTaskRunning("data-0-server"),
+        ExpectDeploymentComplete(),
+    ])
+    assert runner.agent.task_info_of("data-0-server").agent_id == "fast-0"
+    # the refusal is explainable (reference: OfferOutcomeTracker)
+    trace = runner.world.scheduler.outcome_tracker.to_json()
+    assert "volume_profiles" in str(trace) or "profile" in str(trace)
+
+
+def test_share_pid_namespace_label():
+    """share_pid.yml: both tasks carry the shared-pid contract."""
+    from dcos_commons_tpu.common import Label
+
+    runner = ServiceTestRunner(load("share_pid.yml"))
+    runner.run([
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("duo-0-server", "duo-0-watchdog"),
+        SendTaskRunning("duo-0-server"),
+        SendTaskRunning("duo-0-watchdog"),
+        ExpectDeploymentComplete(),
+    ])
+    for name in ("duo-0-server", "duo-0-watchdog"):
+        info = runner.agent.task_info_of(name)
+        assert info.labels[Label.SHARE_PID_NAMESPACE] == "true"
+
+
 def test_crash_loop_delays_relaunch():
     """crash-loop.yml: with backoff enabled, repeated failures push the
     step to DELAYED instead of hot-looping relaunches (reference:
