@@ -358,6 +358,54 @@ def bench_profile() -> dict:
     return out
 
 
+def bench_decode() -> dict:
+    """Serving throughput: KV-cache autoregressive generate on the
+    flagship (models/decode.py), one device dispatch for the whole
+    continuation (lax.scan over steps).  Decode is HBM-bound — each
+    step streams the full 1.7 GB bf16 parameter set — so the extras
+    report the HBM roofline next to the measured rate.
+
+    Run in a SUBPROCESS with a hard timeout by main(): the remote
+    compile helper has been observed to wedge on this program shape,
+    and a hung section must never stall the whole bench."""
+    import jax
+    import jax.numpy as jnp
+
+    from dcos_commons_tpu.models import generate, init_params
+    from dcos_commons_tpu.utils import param_bytes, synthetic_tokens
+
+    config = flagship_config()
+    batch = int(os.environ.get("BENCH_DECODE_BATCH", "16"))
+    new_tokens = int(os.environ.get("BENCH_DECODE_TOKENS", "64"))
+    prompt_len, max_len = 128, 512
+    params = init_params(config, jax.random.key(0))
+    prompt, _ = synthetic_tokens(
+        jax.random.key(1), batch, prompt_len, config.vocab
+    )
+    gen = jax.jit(lambda p, t: generate(
+        config, p, t, max_new_tokens=new_tokens, max_len=max_len
+    ))
+    t0 = time.monotonic()
+    out = gen(params, prompt)
+    float(jax.device_get(out[0, 0]))
+    compile_s = time.monotonic() - t0
+    t0 = time.monotonic()
+    out = gen(params, prompt)
+    float(jax.device_get(out[0, -1]))
+    dt = time.monotonic() - t0
+    steps_per_s = new_tokens / dt
+    hbm_gbps = 819.0  # v5e
+    return {
+        "decode_batch": batch,
+        "decode_compile_s": round(compile_s, 1),
+        "decode_steps_per_s": round(steps_per_s, 1),
+        "decode_tokens_per_s": round(batch * steps_per_s, 1),
+        "decode_hbm_roofline_steps_per_s": round(
+            hbm_gbps * 1e9 / max(param_bytes(params), 1), 1
+        ),
+    }
+
+
 def _peak_bf16_tflops(device) -> float:
     """Per-chip bf16 peak by device kind; 0 disables the MFU extra."""
     kind = getattr(device, "device_kind", "").lower()
@@ -395,6 +443,52 @@ def bench_rooflines() -> dict:
     return out
 
 
+def _run_subprocess_section(fn_name: str, timeout_s: float) -> dict:
+    """Run one bench section in a child process with a hard timeout so
+    a wedged XLA compile cannot stall the whole bench run.
+
+    Output goes to a FILE (not a pipe) and the child runs in its own
+    session: on timeout the whole process GROUP is killed — a wedged
+    grandchild (e.g. the remote compile helper) holding an inherited
+    pipe FD would otherwise block the read forever."""
+    import signal
+    import subprocess
+    import tempfile
+
+    code = (
+        "import json, sys; sys.path.insert(0, %r); import bench; "
+        "print('BENCHJSON ' + json.dumps(getattr(bench, %r)()))"
+        % (REPO, fn_name)
+    )
+    with tempfile.TemporaryFile(mode="w+") as out:
+        proc = subprocess.Popen(
+            [sys.executable, "-c", code],
+            stdout=out,
+            stderr=subprocess.STDOUT,
+            start_new_session=True,
+            text=True,
+        )
+        try:
+            rc = proc.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            proc.wait(timeout=10)
+            raise RuntimeError(
+                f"{fn_name} exceeded {timeout_s}s; process group killed"
+            )
+        out.seek(0)
+        text = out.read()
+    for line in text.splitlines():
+        if line.startswith("BENCHJSON "):
+            return json.loads(line[len("BENCHJSON "):])
+    raise RuntimeError(
+        f"{fn_name} subprocess rc={rc}: {text[-180:]}"
+    )
+
+
 def main() -> None:
     import tempfile
 
@@ -430,6 +524,10 @@ def main() -> None:
         extras.update(bench_profile())
     except Exception as e:
         extras["profile_error"] = repr(e)[:200]
+    try:
+        extras.update(_run_subprocess_section("bench_decode", timeout_s=420))
+    except Exception as e:
+        extras["decode_error"] = repr(e)[:200]
     value = deploy["deploy_wall_clock_s"]
     print(
         json.dumps(

@@ -22,6 +22,12 @@ from dcos_commons_tpu.models.transformer import (
     pipeline_loss_fn,
     pipeline_param_specs,
 )
+from dcos_commons_tpu.models.decode import (
+    decode_step,
+    generate,
+    init_kv_cache,
+    prefill,
+)
 from dcos_commons_tpu.models.moe import (
     MoEConfig,
     expert_shard_spec,
@@ -34,10 +40,14 @@ __all__ = [
     "MlpConfig",
     "MoEConfig",
     "TransformerConfig",
+    "decode_step",
     "expert_shard_spec",
     "forward",
+    "generate",
+    "init_kv_cache",
     "init_moe_params",
     "init_params",
+    "prefill",
     "loss_fn",
     "make_train_step",
     "mlp_forward",

@@ -1,0 +1,214 @@
+"""KV-cache autoregressive inference for the flagship transformer.
+
+The training side (transformer.py) is scan-over-layers with flash
+kernels; this is its serving half, built the TPU way: STATIC shapes
+throughout (the cache is allocated at ``max_len`` once; XLA never
+recompiles as generation advances), ``lax.scan`` over decode steps,
+``lax.dynamic_update_slice`` for in-place cache writes, and one fused
+masked-softmax attention per step (seq-1 queries gain nothing from the
+flash kernel's tiling — the dense einsum against the cache IS the
+MXU-friendly form).
+
+Layout: cache k/v are [n_layers, batch, max_len, n_kv_heads, head_dim]
+(GQA heads stored unexpanded; expanded per step).  Greedy decoding is
+exactly argmax-chaining full forwards — the equivalence test in
+tests/test_decode.py holds bit-for-bit argmax agreement.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from dcos_commons_tpu.models.transformer import (
+    TransformerConfig,
+    _mlp_block,
+    _rope,
+)
+from dcos_commons_tpu.ops.rmsnorm import rms_norm
+
+Params = Dict[str, Any]
+_NEG = -1e30
+
+
+def init_kv_cache(
+    config: TransformerConfig, batch: int, max_len: int
+) -> Dict[str, jax.Array]:
+    shape = (
+        config.n_layers, batch, max_len, config.n_kv_heads,
+        config.head_dim,
+    )
+    return {
+        "k": jnp.zeros(shape, config.dtype),
+        "v": jnp.zeros(shape, config.dtype),
+    }
+
+
+def _project_kv(config, layer, normed, positions):
+    """normed [b, s, d] -> roped q, k, v in [b, s, heads, hd]."""
+    b, s, _ = normed.shape
+    h, kv, hd = config.n_heads, config.n_kv_heads, config.head_dim
+    q = (normed @ layer["wq"]).reshape(b, s, h, hd)
+    k = (normed @ layer["wk"]).reshape(b, s, kv, hd)
+    v = (normed @ layer["wv"]).reshape(b, s, kv, hd)
+    q = _rope(q, positions, config.rope_theta)
+    k = _rope(k, positions, config.rope_theta)
+    return q, k, v
+
+
+def prefill(
+    config: TransformerConfig,
+    params: Params,
+    tokens: jax.Array,
+    max_len: int,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Run the prompt through the trunk, capturing per-layer K/V.
+
+    tokens [b, s] (s <= max_len) -> (logits of the LAST position
+    [b, vocab] in f32, cache filled for positions [0, s)).
+    """
+    b, s = tokens.shape
+    if s > max_len:
+        raise ValueError(f"prompt {s} exceeds cache max_len {max_len}")
+    positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+    x = params["embed"][tokens].astype(config.dtype)
+    h, kv = config.n_heads, config.n_kv_heads
+
+    def layer_fn(x, layer):
+        from dcos_commons_tpu.ops.attention import flash_attention
+
+        normed = rms_norm(x, layer["attn_norm"])
+        q, k, v = _project_kv(config, layer, normed, positions)
+        k_full, v_full = k, v
+        if kv != h:
+            reps = h // kv
+            k_full = jnp.repeat(k, reps, axis=2)
+            v_full = jnp.repeat(v, reps, axis=2)
+        attn = flash_attention(
+            *(t.transpose(0, 2, 1, 3) for t in (q, k_full, v_full)),
+            causal=True,
+            block_q=config.attn_block_q, block_k=config.attn_block_k,
+        )
+        attn = attn.transpose(0, 2, 1, 3).reshape(b, s, -1)
+        x = x + attn @ layer["wo"]
+        x = _mlp_block(layer, x)
+        # pad the captured K/V out to the static cache length
+        pad = [(0, 0), (0, max_len - s), (0, 0), (0, 0)]
+        return x, (jnp.pad(k, pad), jnp.pad(v, pad))
+
+    x, (ck, cv) = lax.scan(layer_fn, x, params["layers"])
+    x = rms_norm(x, params["final_norm"])
+    logits = jnp.einsum(
+        "bd,vd->bv", x[:, -1].astype(jnp.float32),
+        params["embed"].astype(jnp.float32),
+    )
+    return logits, {"k": ck, "v": cv}
+
+
+def decode_step(
+    config: TransformerConfig,
+    params: Params,
+    cache: Dict[str, jax.Array],
+    token: jax.Array,
+    pos: jax.Array,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """One autoregressive step: token [b] at position ``pos`` (scalar
+    int32, same for the whole batch) -> (logits [b, vocab] f32,
+    updated cache)."""
+    b = token.shape[0]
+    h, kv, hd = config.n_heads, config.n_kv_heads, config.head_dim
+    max_len = cache["k"].shape[2]
+    x = params["embed"][token][:, None, :].astype(config.dtype)
+    positions = jnp.broadcast_to(pos.astype(jnp.int32), (b, 1))
+    valid = (
+        lax.broadcasted_iota(jnp.int32, (1, 1, max_len), 2) <= pos
+    )  # [1, 1, max_len], broadcast over batch and heads
+
+    def layer_fn(x, inputs):
+        layer, ck, cv = inputs  # ck/cv [b, max_len, kv, hd]
+        normed = rms_norm(x, layer["attn_norm"])
+        q, k_new, v_new = _project_kv(config, layer, normed, positions)
+        ck = lax.dynamic_update_slice(ck, k_new, (0, pos, 0, 0))
+        cv = lax.dynamic_update_slice(cv, v_new, (0, pos, 0, 0))
+        # grouped GQA contraction against the UNEXPANDED cache: a
+        # jnp.repeat to full heads would multiply the cache bytes
+        # streamed per step by h/kv in an HBM-bound loop.
+        # q [b, 1, kv, reps, hd] x K [b, L, kv, hd] -> [b, kv, reps, L]
+        reps = h // kv
+        qg = (q.astype(jnp.float32) * hd ** -0.5).reshape(
+            b, kv, reps, hd
+        )
+        scores = jnp.einsum("bkrd,blkd->bkrl", qg, ck.astype(jnp.float32))
+        scores = jnp.where(valid[:, :, None, :], scores, _NEG)
+        probs = jax.nn.softmax(scores, axis=-1)
+        attn = jnp.einsum(
+            "bkrl,blkd->bkrd", probs, cv.astype(jnp.float32)
+        ).astype(config.dtype)
+        x = x + attn.reshape(b, 1, h * hd) @ layer["wo"]
+        x = _mlp_block(layer, x)
+        return x, (ck, cv)
+
+    x, (ck, cv) = lax.scan(
+        layer_fn, x, (params["layers"], cache["k"], cache["v"])
+    )
+    x = rms_norm(x, params["final_norm"])
+    logits = jnp.einsum(
+        "bd,vd->bv", x[:, 0].astype(jnp.float32),
+        params["embed"].astype(jnp.float32),
+    )
+    return logits, {"k": ck, "v": cv}
+
+
+def generate(
+    config: TransformerConfig,
+    params: Params,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    key: Optional[jax.Array] = None,
+    max_len: Optional[int] = None,
+) -> jax.Array:
+    """Autoregressive continuation: prompt [b, s] -> tokens
+    [b, max_new_tokens].  temperature 0 = greedy; otherwise softmax
+    sampling with ``key``.  Jit-friendly end to end: ONE prefill
+    compile + ONE decode-step compile regardless of lengths."""
+    b, s = prompt.shape
+    total = max_len if max_len is not None else s + max_new_tokens
+    if total < s + max_new_tokens:
+        # dynamic_update_slice CLAMPS out-of-range writes, which would
+        # silently corrupt the last cache slot instead of failing
+        raise ValueError(
+            f"max_len {total} cannot hold prompt {s} + "
+            f"{max_new_tokens} new tokens"
+        )
+    if temperature > 0.0 and key is None:
+        raise ValueError("sampling (temperature > 0) needs a PRNG key")
+    logits, cache = prefill(config, params, prompt, total)
+    key = key if key is not None else jax.random.key(0)
+
+    def pick(logits, key):
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            key, logits / temperature, axis=-1
+        ).astype(jnp.int32)
+
+    first = pick(logits, key)
+
+    def step(carry, step_key):
+        token, pos, cache = carry
+        logits, cache = decode_step(config, params, cache, token, pos)
+        nxt = pick(logits, step_key)
+        return (nxt, pos + 1, cache), token
+
+    keys = jax.random.split(key, max_new_tokens)
+    (_, _, _), out = lax.scan(
+        step,
+        (first, jnp.int32(s), cache),
+        keys,
+        length=max_new_tokens,
+    )
+    return out.swapaxes(0, 1)  # [b, max_new_tokens]

@@ -377,7 +377,11 @@ class OfferEvaluator:
                 host = inventory.host(r.host_id)
                 if host is not None:
                     return _coordinator_address(host, r.ports[0])
-                return f"{r.host_id}:{r.ports[0]}"
+                # coordinator host gone from the inventory: there is
+                # no dialable address — return nothing so the gang
+                # reuse guard fails LOUDLY instead of launching
+                # workers that hang in jax.distributed.initialize
+                return ""
         return ""
 
     # -- fresh placement ----------------------------------------------
