@@ -310,7 +310,7 @@ def bench_profile() -> dict:
     tokens, targets = synthetic_tokens(
         jax.random.key(1), batch, config.max_seq, config.vocab
     )
-    trunk = jax.jit(lambda p, t: tmod._trunk(config, p, t))
+    trunk = jax.jit(lambda p, t: tmod._trunk(config, p, t)[0])
     t_trunk = timeit(trunk, params, tokens)
     loss_fn = jax.jit(lambda p, t, tg: tmod.loss_fn(config, p, t, tg))
     t_fwd = timeit(loss_fn, params, tokens, targets)

@@ -11,8 +11,13 @@ MXU-friendly form).
 
 Layout: cache k/v are [n_layers, batch, max_len, n_kv_heads, head_dim]
 (GQA heads stored unexpanded; expanded per step).  Greedy decoding is
-exactly argmax-chaining full forwards — the equivalence test in
-tests/test_decode.py holds bit-for-bit argmax agreement.
+exactly argmax-chaining full forwards — the equivalence tests in
+tests/test_decode.py and test_workload.py hold argmax agreement.  For
+MoE configs decode routes DROP-FREE (capacity covers every token of
+the step); the equivalence therefore holds when the forward side is
+also in its drop-free capacity regime — with training-style capacity
+pressure, dropped tokens make full forwards differ from any
+drop-free server by construction.
 """
 
 from __future__ import annotations
@@ -25,7 +30,7 @@ from jax import lax
 
 from dcos_commons_tpu.models.transformer import (
     TransformerConfig,
-    _mlp_block,
+    _ffn_block,
     _rope,
 )
 from dcos_commons_tpu.ops.rmsnorm import rms_norm
@@ -94,7 +99,7 @@ def prefill(
         )
         attn = attn.transpose(0, 2, 1, 3).reshape(b, s, -1)
         x = x + attn @ layer["wo"]
-        x = _mlp_block(layer, x)
+        x, _moe_aux = _ffn_block(config, layer, x)
         # pad the captured K/V out to the static cache length
         pad = [(0, 0), (0, max_len - s), (0, 0), (0, 0)]
         return x, (jnp.pad(k, pad), jnp.pad(v, pad))
@@ -148,7 +153,7 @@ def decode_step(
             "bkrl,blkd->bkrd", probs, cv.astype(jnp.float32)
         ).astype(config.dtype)
         x = x + attn.reshape(b, 1, h * hd) @ layer["wo"]
-        x = _mlp_block(layer, x)
+        x, _moe_aux = _ffn_block(config, layer, x, decode=True)
         return x, (ck, cv)
 
     x, (ck, cv) = lax.scan(

@@ -114,6 +114,7 @@ def moe_ffn(
     params: MoEParams,
     x: jax.Array,
     axis_name: Optional[str] = None,
+    capacity: Optional[int] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """MoE FFN on x [tokens, d_model] -> (y, aux_loss).
 
@@ -121,9 +122,14 @@ def moe_ffn(
     ``axis_name`` (inside shard_map): tokens are sharded over ep and
     each rank owns n_experts / ep_size experts — params' expert axis
     must be sharded over ep accordingly.
+
+    ``capacity`` overrides the factor-derived per-expert slot count;
+    decode passes capacity = tokens so NO token is ever dropped (slot
+    competition is a training-time load-balancing pressure, not a
+    serving behavior).
     """
     t, d = x.shape
-    capacity = config.capacity(t)
+    capacity = capacity if capacity is not None else config.capacity(t)
     if axis_name is None:
         dispatch, combine, aux = _routing(config, params, x, capacity)
         expert_in = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))

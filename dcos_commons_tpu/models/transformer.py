@@ -73,6 +73,20 @@ class TransformerConfig:
     use_ring_attention: bool = False     # sp: K/V rotate (ppermute)
     use_ulysses_attention: bool = False  # sp: all_to_all head regroup
     sp_axis: str = "sp"
+    # MoE flagship variant: n_experts > 0 swaps every layer's dense
+    # SwiGLU for a mixture of experts (router + per-expert SwiGLU,
+    # models/moe.py) with the switch load-balancing aux loss.  Under
+    # jit the expert axis shards over the mesh's ep axis (sharding
+    # rules below) and GSPMD inserts the dispatch collectives.
+    n_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.5
+    moe_aux_weight: float = 0.01
+    # routing group size for the jit path: tokens route in groups of
+    # up to this many, bounding the one-hot dispatch tensors at
+    # group * E * C (C scales with the GROUP, not the global batch —
+    # an ungrouped b*s routing would be O(tokens^2) memory)
+    moe_group_size: int = 1024
     # sequence-chunked cross entropy: the [b, s, vocab] f32 logits are
     # never materialized — each chunk's logits are computed, reduced to
     # a scalar, and rematerialized in backward.  0 = unchunked.
@@ -106,27 +120,45 @@ def init_params(config: TransformerConfig, key: jax.Array) -> Params:
     def normal(key, shape, scale):
         return (jax.random.normal(key, shape, jnp.float32) * scale).astype(dt)
 
-    return {
-        "embed": normal(keys[0], (config.vocab, d), d ** -0.5),
-        "layers": {
-            "attn_norm": jnp.ones((n, d), dt),
-            "wq": normal(keys[1], (n, d, h * hd), d ** -0.5),
-            "wk": normal(keys[2], (n, d, kv * hd), d ** -0.5),
-            "wv": normal(keys[3], (n, d, kv * hd), d ** -0.5),
-            "wo": normal(keys[4], (n, h * hd, d), (h * hd) ** -0.5),
-            "mlp_norm": jnp.ones((n, d), dt),
+    layers = {
+        "attn_norm": jnp.ones((n, d), dt),
+        "wq": normal(keys[1], (n, d, h * hd), d ** -0.5),
+        "wk": normal(keys[2], (n, d, kv * hd), d ** -0.5),
+        "wv": normal(keys[3], (n, d, kv * hd), d ** -0.5),
+        "wo": normal(keys[4], (n, h * hd, d), (h * hd) ** -0.5),
+        "mlp_norm": jnp.ones((n, d), dt),
+    }
+    if config.n_experts > 0:
+        # one source of truth for the expert init recipe (router-f32
+        # policy, scales): moe.init_moe_params, vmapped over layers
+        from dcos_commons_tpu.models.moe import MoEConfig, init_moe_params
+
+        moe_config = MoEConfig(
+            d_model=d, d_ff=f, n_experts=config.n_experts,
+            top_k=config.moe_top_k,
+            capacity_factor=config.moe_capacity_factor, dtype=dt,
+        )
+        layers.update(jax.vmap(
+            lambda k: init_moe_params(moe_config, k)
+        )(jax.random.split(keys[5], n)))
+    else:
+        layers.update({
             "w_gate": normal(keys[5], (n, d, f), d ** -0.5),
             "w_up": normal(keys[6], (n, d, f), d ** -0.5),
             "w_down": normal(keys[7], (n, f, d), f ** -0.5),
-        },
+        })
+    return {
+        "embed": normal(keys[0], (config.vocab, d), d ** -0.5),
+        "layers": layers,
         "final_norm": jnp.ones((d,), dt),
     }
 
 
 def sharding_rules(config: TransformerConfig) -> Dict[str, P]:
     """Param path -> PartitionSpec (scaling-book layout):
-    heads/ffn over tp, the other big axis over fsdp."""
-    return {
+    heads/ffn over tp, the other big axis over fsdp; MoE expert axes
+    over ep (GSPMD then inserts the dispatch collectives)."""
+    rules = {
         "embed": P("tp", "fsdp"),
         "layers/attn_norm": P(None, None),
         "layers/wq": P(None, "fsdp", "tp"),
@@ -134,11 +166,22 @@ def sharding_rules(config: TransformerConfig) -> Dict[str, P]:
         "layers/wv": P(None, "fsdp", "tp"),
         "layers/wo": P(None, "tp", "fsdp"),
         "layers/mlp_norm": P(None, None),
-        "layers/w_gate": P(None, "fsdp", "tp"),
-        "layers/w_up": P(None, "fsdp", "tp"),
-        "layers/w_down": P(None, "tp", "fsdp"),
         "final_norm": P(None),
     }
+    if config.n_experts > 0:
+        rules.update({
+            "layers/router": P(None, None, None),
+            "layers/w_gate": P(None, "ep", "fsdp", "tp"),
+            "layers/w_up": P(None, "ep", "fsdp", "tp"),
+            "layers/w_down": P(None, "ep", "tp", "fsdp"),
+        })
+    else:
+        rules.update({
+            "layers/w_gate": P(None, "fsdp", "tp"),
+            "layers/w_up": P(None, "fsdp", "tp"),
+            "layers/w_down": P(None, "tp", "fsdp"),
+        })
+    return rules
 
 
 def param_shardings(config: TransformerConfig, mesh: Mesh, shapes=None):
@@ -218,6 +261,49 @@ def _mlp_block(layer, x):
     return x + (gate * up) @ layer["w_down"]
 
 
+def _ffn_block(config: TransformerConfig, layer, x, decode: bool = False):
+    """The per-layer FFN: dense SwiGLU or MoE.  Returns (x, aux).
+
+    MoE notes: tokens route in groups of <= moe_group_size (bounding
+    the one-hot dispatch tensors; groups never span batch rows, so the
+    slot cumsum stays within a dp shard).  In ``decode`` the capacity
+    covers every token of the step — token dropping is a training-time
+    load-balancing pressure; a server must not drop, and drop-free
+    routing is also what makes cached decode equal full forwards."""
+    if config.n_experts <= 0:
+        return _mlp_block(layer, x), jnp.zeros((), jnp.float32)
+    from dcos_commons_tpu.models.moe import MoEConfig, moe_ffn
+
+    b, s, d = x.shape
+    moe_config = MoEConfig(
+        d_model=d,
+        d_ff=config.d_ff,
+        n_experts=config.n_experts,
+        top_k=config.moe_top_k,
+        capacity_factor=config.moe_capacity_factor,
+        dtype=config.dtype,
+    )
+    moe_params = {
+        key: layer[key] for key in ("router", "w_gate", "w_up", "w_down")
+    }
+    normed = rms_norm(x, layer["mlp_norm"])
+    # group = a whole number of sequence positions per batch row so
+    # groups never straddle rows; fall back to one row per group
+    group = s if s <= config.moe_group_size else (
+        config.moe_group_size if s % config.moe_group_size == 0 else s
+    )
+    tokens = normed.reshape(b * s // group, group, d)
+    capacity = group if decode else None
+    # axis_name=None: under jit, GSPMD partitions the expert einsums
+    # from the param shardings (expert axis over ep) and inserts the
+    # dispatch collectives — the shard_map path stays available for
+    # explicit all_to_all control (dryrun's ep section)
+    y, aux = jax.vmap(
+        lambda g: moe_ffn(moe_config, moe_params, g, capacity=capacity)
+    )(tokens)
+    return x + y.reshape(b, s, d), aux.mean()
+
+
 def _layer_scan(config: TransformerConfig, layers, x, positions):
     """Run x through a (sub)stack of layers with lax.scan.
 
@@ -231,8 +317,8 @@ def _layer_scan(config: TransformerConfig, layers, x, positions):
 
     def layer_fn(x, layer):
         x = _attention_block(config, layer, x, positions)
-        x = _mlp_block(layer, x)
-        return x, None
+        x, aux = _ffn_block(config, layer, x)
+        return x, aux
 
     remat_fn = layer_fn
     if config.remat:
@@ -249,16 +335,18 @@ def _layer_scan(config: TransformerConfig, layers, x, positions):
             remat_fn = jax.checkpoint(layer_fn)
     k = config.no_remat_layers if config.remat else 0
     if k <= 0:
-        x, _ = lax.scan(remat_fn, x, layers)
-        return x
+        x, aux = lax.scan(remat_fn, x, layers)
+        return x, aux.sum()
     n_layers = jax.tree.leaves(layers)[0].shape[0]
     k = min(k, n_layers)
     head = jax.tree.map(lambda a: a[: n_layers - k], layers)
     tail = jax.tree.map(lambda a: a[n_layers - k:], layers)
+    aux_total = jnp.zeros((), jnp.float32)
     if n_layers - k > 0:
-        x, _ = lax.scan(remat_fn, x, head)
-    x, _ = lax.scan(layer_fn, x, tail)
-    return x
+        x, aux = lax.scan(remat_fn, x, head)
+        aux_total = aux_total + aux.sum()
+    x, aux = lax.scan(layer_fn, x, tail)
+    return x, aux_total + aux.sum()
 
 
 def _logits(config: TransformerConfig, params: Params, x: jax.Array) -> jax.Array:
@@ -282,7 +370,8 @@ def forward(
     positions: Optional[jax.Array] = None,
 ) -> jax.Array:
     """tokens [b, s] -> logits [b, s, vocab] (f32)."""
-    return _logits(config, params, _trunk(config, params, tokens, positions))
+    x, _aux = _trunk(config, params, tokens, positions)
+    return _logits(config, params, x)
 
 
 def _trunk(
@@ -290,8 +379,8 @@ def _trunk(
     params: Params,
     tokens: jax.Array,
     positions: Optional[jax.Array] = None,
-) -> jax.Array:
-    """tokens [b, s] -> final hidden states [b, s, d] (pre-logits)."""
+):
+    """tokens [b, s] -> (final hidden states [b, s, d], moe aux)."""
     b, s = tokens.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
@@ -338,7 +427,12 @@ def loss_fn(
     config: TransformerConfig, params: Params, tokens: jax.Array,
     targets: jax.Array,
 ) -> jax.Array:
-    return _nll_mean(config, params, _trunk(config, params, tokens), targets)
+    x, aux = _trunk(config, params, tokens)
+    loss = _nll_mean(config, params, x, targets)
+    if config.n_experts > 0:
+        # switch-transformer load-balancing term, averaged per layer
+        loss = loss + config.moe_aux_weight * aux / config.n_layers
+    return loss
 
 
 def _pipeline_trunk(
@@ -351,12 +445,17 @@ def _pipeline_trunk(
     """Embed + pipelined layer stack.  Returns microbatched
     activations [n_micro, mb, s, d] — valid on the LAST pp rank only.
     """
+    if config.n_experts > 0:
+        raise NotImplementedError(
+            "MoE layers are not pipelined yet: run ep x dp/fsdp/tp "
+            "meshes for the MoE flagship"
+        )
     b, s = tokens.shape
     mb = b // n_micro
     positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (mb, s))
     x = params["embed"][tokens].astype(config.dtype)
     micro = split_microbatches(x, n_micro)
-    stage_fn = lambda layers, x: _layer_scan(config, layers, x, positions)
+    stage_fn = lambda layers, x: _layer_scan(config, layers, x, positions)[0]
     return pipeline_apply(stage_fn, params["layers"], micro, axis_name)
 
 

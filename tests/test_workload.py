@@ -325,6 +325,99 @@ def test_config_rejects_both_sp_recipes():
                           use_ulysses_attention=True)
 
 
+# -- MoE flagship variant --------------------------------------------
+
+
+MOE_CFG = TransformerConfig(
+    vocab=128, d_model=64, n_layers=2, n_heads=4, n_kv_heads=4,
+    d_ff=128, max_seq=64, dtype=jnp.float32, remat=False,
+    n_experts=4, moe_top_k=2,
+)
+
+
+def test_moe_transformer_trains():
+    """n_experts > 0: every layer's FFN is a routed expert mixture;
+    the train step moves the loss and grads reach router + experts."""
+    import optax
+
+    params = init_params(MOE_CFG, jax.random.key(0))
+    assert params["layers"]["router"].shape == (2, 64, 4)
+    assert params["layers"]["w_gate"].shape == (2, 4, 64, 128)
+    tokens, targets = synthetic_tokens(jax.random.key(1), 4, 64, 128)
+    optimizer = optax.adam(1e-2)
+    step = make_train_step(MOE_CFG, optimizer, donate=False)
+    opt_state = optimizer.init(params)
+    p, o, loss0 = step(params, opt_state, tokens, targets)
+    for _ in range(20):
+        p, o, loss = step(p, o, tokens, targets)
+    assert jnp.isfinite(loss) and float(loss) < float(loss0)
+    router_delta = jnp.abs(
+        p["layers"]["router"] - params["layers"]["router"]
+    ).max()
+    assert float(router_delta) > 0  # the router actually learns
+
+
+def test_moe_transformer_sharded_train_step():
+    """The MoE flagship under a dp x ep mesh: expert params shard over
+    ep and the jitted (GSPMD) step runs — the jit-native counterpart
+    of the dryrun's explicit shard_map all_to_all path."""
+    import optax
+
+    mesh = make_mesh(MeshSpec(dp=2, ep=4))
+    optimizer = optax.adam(1e-3)
+    with mesh:
+        params = init_params(MOE_CFG, jax.random.key(0))
+        opt_state = optimizer.init(params)
+        step = make_train_step(MOE_CFG, optimizer, mesh=mesh, donate=False)
+        tokens, targets = synthetic_tokens(jax.random.key(1), 4, 64, 128)
+        p, o, loss = step(params, opt_state, tokens, targets)
+        loss.block_until_ready()
+    assert bool(jnp.isfinite(loss))
+    # expert weights really live sharded over ep
+    sharding = p["layers"]["w_gate"].sharding
+    assert "ep" in (sharding.spec[1] or ())
+
+
+def test_moe_generate_matches_forward_chain():
+    """KV-cache decode works for the MoE variant too: decode routes
+    DROP-FREE, so greedy generate equals argmax-chained full forwards
+    whenever the forward side is also in its drop-free regime (the
+    capacity factor here guarantees that; with training-style capacity
+    pressure, dropped tokens make forwards differ from ANY drop-free
+    server by construction).  Checked across several seeds — routing
+    equivalence must not be seed luck."""
+    from dcos_commons_tpu.models import generate
+
+    cfg = TransformerConfig(
+        **{**MOE_CFG.__dict__, "moe_capacity_factor": 8.0}
+    )
+    for seed in range(5):
+        params = init_params(cfg, jax.random.key(seed))
+        prompt, _ = synthetic_tokens(
+            jax.random.key(100 + seed), 2, 6, cfg.vocab
+        )
+        out = generate(cfg, params, prompt, max_new_tokens=4)
+        seq = prompt
+        for i in range(4):
+            nxt = jnp.argmax(
+                forward(cfg, params, seq)[:, -1], axis=-1
+            ).astype(jnp.int32)
+            np.testing.assert_array_equal(
+                np.asarray(out[:, i]), np.asarray(nxt),
+                err_msg=f"moe decode divergence seed {seed} step {i}",
+            )
+            seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+
+
+def test_moe_rejected_in_pipeline_path():
+    from dcos_commons_tpu.models import pipeline_forward
+
+    params = init_params(MOE_CFG, jax.random.key(0))
+    tokens, _ = synthetic_tokens(jax.random.key(3), 2, 64, 128)
+    with pytest.raises(NotImplementedError, match="not pipelined"):
+        pipeline_forward(MOE_CFG, params, tokens, n_micro=2)
+
+
 # -- mlp + checkpointing ---------------------------------------------
 
 
