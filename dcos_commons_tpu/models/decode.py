@@ -69,11 +69,19 @@ def prefill(
     params: Params,
     tokens: jax.Array,
     max_len: int,
+    true_len: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """Run the prompt through the trunk, capturing per-layer K/V.
 
-    tokens [b, s] (s <= max_len) -> (logits of the LAST position
+    tokens [b, s] (s <= max_len) -> (logits of the LAST REAL position
     [b, vocab] in f32, cache filled for positions [0, s)).
+
+    ``true_len`` (a TRACED scalar <= s, same for all rows) supports
+    RIGHT-padded prompts with one compile for every length: causal
+    attention means positions < true_len never see the padding, the
+    logits are read at true_len - 1, and decode overwrites/masks the
+    pad slots — so a server can pad to a static width without
+    changing any real token's computation.
     """
     b, s = tokens.shape
     if s > max_len:
@@ -99,15 +107,23 @@ def prefill(
         )
         attn = attn.transpose(0, 2, 1, 3).reshape(b, s, -1)
         x = x + attn @ layer["wo"]
-        x, _moe_aux = _ffn_block(config, layer, x)
+        # drop-free MoE routing: serving must not drop prompt tokens
+        # (capacity pressure is a training behavior), and the decode
+        # steps that continue this cache are drop-free too
+        x, _moe_aux = _ffn_block(config, layer, x, decode=True)
         # pad the captured K/V out to the static cache length
         pad = [(0, 0), (0, max_len - s), (0, 0), (0, 0)]
         return x, (jnp.pad(k, pad), jnp.pad(v, pad))
 
     x, (ck, cv) = lax.scan(layer_fn, x, params["layers"])
     x = rms_norm(x, params["final_norm"])
+    last = (
+        jnp.asarray(true_len, jnp.int32) - 1 if true_len is not None
+        else s - 1
+    )
+    x_last = lax.dynamic_index_in_dim(x, last, axis=1, keepdims=False)
     logits = jnp.einsum(
-        "bd,vd->bv", x[:, -1].astype(jnp.float32),
+        "bd,vd->bv", x_last.astype(jnp.float32),
         params["embed"].astype(jnp.float32),
     )
     return logits, {"k": ck, "v": cv}
@@ -172,14 +188,17 @@ def generate(
     params: Params,
     prompt: jax.Array,
     max_new_tokens: int,
-    temperature: float = 0.0,
+    temperature=0.0,
     key: Optional[jax.Array] = None,
     max_len: Optional[int] = None,
+    true_len: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Autoregressive continuation: prompt [b, s] -> tokens
     [b, max_new_tokens].  temperature 0 = greedy; otherwise softmax
-    sampling with ``key``.  Jit-friendly end to end: ONE prefill
-    compile + ONE decode-step compile regardless of lengths."""
+    sampling with ``key``.  Jit-friendly end to end, ONE compile
+    covering every prompt CONTENT, LENGTH (``true_len``: right-padded
+    prompts, traced), and TEMPERATURE (traced operand — a server must
+    not recompile per requested temperature)."""
     b, s = prompt.shape
     total = max_len if max_len is not None else s + max_new_tokens
     if total < s + max_new_tokens:
@@ -189,19 +208,27 @@ def generate(
             f"max_len {total} cannot hold prompt {s} + "
             f"{max_new_tokens} new tokens"
         )
-    if temperature > 0.0 and key is None:
+    if isinstance(temperature, (int, float)) and temperature > 0.0 \
+            and key is None:
         raise ValueError("sampling (temperature > 0) needs a PRNG key")
-    logits, cache = prefill(config, params, prompt, total)
+    logits, cache = prefill(config, params, prompt, total, true_len)
     key = key if key is not None else jax.random.key(0)
+    temp = jnp.asarray(temperature, jnp.float32)
 
     def pick(logits, key):
-        if temperature <= 0.0:
-            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(
-            key, logits / temperature, axis=-1
-        ).astype(jnp.int32)
+        # both branches are a few FLOPs on [b, vocab]; selecting
+        # beats a cond because temperature stays a traced operand
+        sampled = jax.random.categorical(
+            key, logits / jnp.maximum(temp, 1e-6), axis=-1
+        )
+        greedy = jnp.argmax(logits, axis=-1)
+        return jnp.where(temp > 0.0, sampled, greedy).astype(jnp.int32)
 
     first = pick(logits, key)
+    start = (
+        jnp.asarray(true_len, jnp.int32) if true_len is not None
+        else jnp.int32(s)
+    )
 
     def step(carry, step_key):
         token, pos, cache = carry
@@ -212,7 +239,7 @@ def generate(
     keys = jax.random.split(key, max_new_tokens)
     (_, _, _), out = lax.scan(
         step,
-        (first, jnp.int32(s), cache),
+        (first, start, cache),
         keys,
         length=max_new_tokens,
     )

@@ -1,0 +1,166 @@
+"""Inference serving task: the flagship behind an HTTP endpoint.
+
+The scheduler deploys this like any other task (svc_serve.yml): it
+builds the model, warms the KV-cache generate path (one compile), then
+serves POST /generate on the scheduler-assigned port — discoverable
+via /v1/endpoints and the VIP.  Readiness: the task's readiness check
+passes once the warmup file exists, so the deploy plan completes only
+when the server can actually answer.
+
+Request:  {"tokens": [[...]], "max_new_tokens": N, "temperature": T}
+Response: {"tokens": [[...]]} — the continuations only.
+"""
+
+import json
+import os
+import sys
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+sys.path.insert(0, os.environ.get("REPO_ROOT", "/root/repo"))
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    from dcos_commons_tpu.models import (
+        TransformerConfig,
+        generate,
+        init_params,
+    )
+    from dcos_commons_tpu.utils import (
+        enable_compilation_cache,
+        restore_checkpoint,
+    )
+
+    enable_compilation_cache()
+    config = TransformerConfig(
+        vocab=int(os.environ.get("VOCAB", "8192")),
+        d_model=int(os.environ.get("D_MODEL", "512")),
+        n_layers=int(os.environ.get("N_LAYERS", "4")),
+        n_heads=8,
+        n_kv_heads=8,
+        d_ff=int(os.environ.get("D_FF", "1408")),
+        max_seq=int(os.environ.get("SEQ_LEN", "1024")),
+        dtype=jnp.bfloat16 if os.environ.get(
+            "JAX_PLATFORMS"
+        ) != "cpu" else jnp.float32,
+        remat=False,
+    )
+    max_len = int(os.environ.get("MAX_LEN", "256"))
+    batch = int(os.environ.get("SERVE_BATCH", "1"))
+    new_tokens = int(os.environ.get("MAX_NEW_TOKENS", "32"))
+
+    params = init_params(config, jax.random.key(0))
+    ckpt_dir = os.environ.get("CHECKPOINT_DIR", "")
+    if ckpt_dir:
+        # serve the TRAINED weights when a checkpoint tree exists
+        # (the train pod's orbax-style output); params-only restore
+        state, step = restore_checkpoint(ckpt_dir, {"params": params})
+        if step is not None:
+            params = state["params"]
+            print(f"restored checkpoint step {step}", flush=True)
+
+    # ONE compile covers every request: static (batch, prompt_len)
+    # shapes with prompts RIGHT-padded and the true length TRACED
+    # (causal attention means real tokens never see the padding, and
+    # decode overwrites/masks the pad slots); temperature is a traced
+    # operand too — novel temperatures must not recompile
+    prompt_len = max_len - new_tokens
+    gen = jax.jit(lambda p, t, key, temp, n: generate(
+        config, p, t, max_new_tokens=new_tokens, max_len=max_len,
+        temperature=temp, key=key, true_len=n,
+    ))
+    lock = threading.Lock()
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):
+            pass
+
+        def do_POST(self):
+            if self.path != "/generate":
+                self.send_error(404)
+                return
+            length = int(self.headers.get("Content-Length", 0))
+            try:
+                body = json.loads(self.rfile.read(length))
+                rows = body["tokens"]
+                if len(rows) > batch:
+                    raise ValueError(
+                        f"{len(rows)} prompts > server batch {batch}; "
+                        "split the request"
+                    )
+                lens = {len(row) for row in rows}
+                if len(lens) > 1:
+                    raise ValueError(
+                        "all prompts in one request must share a length"
+                    )
+                temp = float(body.get("temperature", 0.0))
+                n = min(
+                    int(body.get("max_new_tokens", new_tokens)), new_tokens
+                )
+                true_len = min(max(lens or {1}), prompt_len)
+                padded = jnp.zeros((batch, prompt_len), jnp.int32)
+                for i, row in enumerate(rows):
+                    row = [int(t) % config.vocab for t in row][-true_len:]
+                    # RIGHT-pad: real tokens first, pads after (causal
+                    # attention never lets real positions see them)
+                    padded = padded.at[i, : len(row)].set(
+                        jnp.asarray(row, jnp.int32)
+                    )
+                with lock:  # one generate at a time per chip
+                    out = gen(
+                        params, padded,
+                        jax.random.key(abs(hash(str(rows))) % (2 ** 31)),
+                        jnp.float32(temp),
+                        jnp.int32(true_len),
+                    )
+                reply = {
+                    "tokens": [
+                        [int(t) for t in out[i, :n]]
+                        for i in range(len(rows))
+                    ]
+                }
+                payload = json.dumps(reply).encode()
+                self.send_response(200)
+            except Exception as e:  # noqa: BLE001 — surface to client
+                payload = json.dumps({"error": str(e)}).encode()
+                self.send_response(400)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+    # a RELAUNCH reuses the sandbox: a stale ready file from the
+    # previous incarnation must not pass readiness while we are cold
+    try:
+        os.remove("ready")
+    except OSError:
+        pass
+    # bind BEFORE warming and only then write the readiness file — a
+    # bind failure (port collision) must fail readiness, not pass it
+    port = int(os.environ.get("PORT_HTTP", "0"))
+    server = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+    warm = jnp.zeros((batch, prompt_len), jnp.int32)
+    out = gen(
+        params, warm, jax.random.key(0), jnp.float32(0.0),
+        jnp.int32(prompt_len),
+    )
+    jax.block_until_ready(out)
+    with open("ready", "w") as f:
+        f.write("warm\n")
+    print(
+        f"warm: serving generate({batch}x{prompt_len}->{new_tokens}) "
+        f"on {server.server_address[1]}",
+        flush=True,
+    )
+    server.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -90,6 +90,48 @@ def test_generate_is_jittable_once(params):
     assert fn._cache_size() == 1
 
 
+def test_right_padded_prompt_with_true_len_matches_exact(params):
+    """The serving contract: a RIGHT-padded prompt with a traced
+    true_len generates exactly what the unpadded prompt does (causal
+    attention hides the pads; logits read at true_len-1; decode
+    overwrites/masks pad slots) — and one compile serves any length."""
+    gen = jax.jit(lambda p, t, n: generate(
+        CFG, p, t, max_new_tokens=4, max_len=24, true_len=n
+    ))
+    for true_len in (3, 6, 9):
+        prompt, _ = synthetic_tokens(
+            jax.random.key(10 + true_len), 2, true_len, CFG.vocab
+        )
+        exact = generate(CFG, params, prompt, max_new_tokens=4)
+        padded = jnp.zeros((2, 12), jnp.int32).at[:, :true_len].set(prompt)
+        out = gen(params, padded, jnp.int32(true_len))
+        np.testing.assert_array_equal(
+            np.asarray(out), np.asarray(exact),
+            err_msg=f"padding changed generation at true_len {true_len}",
+        )
+    assert gen._cache_size() == 1  # one compile for all three lengths
+
+
+def test_temperature_is_traced_not_static(params):
+    """Novel temperatures must not recompile (a server takes them
+    from requests)."""
+    fn = jax.jit(lambda p, t, temp: generate(
+        CFG, p, t, max_new_tokens=3, max_len=12,
+        temperature=temp, key=jax.random.key(0),
+    ))
+    prompt, _ = synthetic_tokens(jax.random.key(20), 1, 4, CFG.vocab)
+    for temp in (0.0, 0.7, 1.3):
+        out = fn(params, prompt, jnp.float32(temp))
+        assert out.shape == (1, 3)
+    assert fn._cache_size() == 1
+    # traced temp 0.0 still means greedy
+    greedy = generate(CFG, params, prompt, max_new_tokens=3)
+    np.testing.assert_array_equal(
+        np.asarray(fn(params, prompt, jnp.float32(0.0))),
+        np.asarray(greedy),
+    )
+
+
 def test_sampling_needs_key_and_respects_temperature(params):
     prompt, _ = synthetic_tokens(jax.random.key(6), 1, 4, CFG.vocab)
     with pytest.raises(ValueError, match="PRNG key"):
