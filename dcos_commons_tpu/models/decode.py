@@ -208,9 +208,14 @@ def generate(
             f"max_len {total} cannot hold prompt {s} + "
             f"{max_new_tokens} new tokens"
         )
-    if isinstance(temperature, (int, float)) and temperature > 0.0 \
-            and key is None:
-        raise ValueError("sampling (temperature > 0) needs a PRNG key")
+    if key is None:
+        if not isinstance(temperature, (int, float)):
+            # a TRACED temperature could be > 0 at runtime; silently
+            # "sampling" with a fixed default key would look stochastic
+            # while returning identical tokens every call
+            raise ValueError("a traced temperature needs a PRNG key")
+        if temperature > 0.0:
+            raise ValueError("sampling (temperature > 0) needs a PRNG key")
     logits, cache = prefill(config, params, prompt, total, true_len)
     key = key if key is not None else jax.random.key(0)
     temp = jnp.asarray(temperature, jnp.float32)

@@ -99,14 +99,23 @@ def main() -> int:
                     raise ValueError(
                         "all prompts in one request must share a length"
                     )
+                true_len = max(lens, default=0)
+                if true_len < 1:
+                    raise ValueError("prompts must be non-empty")
+                if true_len > prompt_len:
+                    # refuse, don't silently continue a DIFFERENT
+                    # (truncated) prompt
+                    raise ValueError(
+                        f"prompt length {true_len} exceeds the server's "
+                        f"context {prompt_len}"
+                    )
                 temp = float(body.get("temperature", 0.0))
                 n = min(
                     int(body.get("max_new_tokens", new_tokens)), new_tokens
                 )
-                true_len = min(max(lens or {1}), prompt_len)
                 padded = jnp.zeros((batch, prompt_len), jnp.int32)
                 for i, row in enumerate(rows):
-                    row = [int(t) % config.vocab for t in row][-true_len:]
+                    row = [int(t) % config.vocab for t in row]
                     # RIGHT-pad: real tokens first, pads after (causal
                     # attention never lets real positions see them)
                     padded = padded.at[i, : len(row)].set(

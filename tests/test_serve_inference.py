@@ -11,6 +11,7 @@ one scheduler — the reference's model has no data plane at all
 import json
 import os
 import time
+import urllib.error
 import urllib.request
 
 from dcos_commons_tpu.agent import LocalProcessAgent
@@ -112,13 +113,18 @@ def test_inference_pod_serves_generate(tmp_path):
         # a different prompt (almost surely) diverges
         other = post({"tokens": [[9, 8, 7, 6, 5]], "max_new_tokens": 8})
         assert len(other["tokens"][0]) == 8
-        # more prompts than the server batch: a clean 400, not silent
-        # truncation
-        try:
-            post({"tokens": [[1], [2]]})
-            raise AssertionError("overflow request should fail")
-        except urllib.error.HTTPError as e:
-            assert e.code == 400
+        # malformed requests get clean 400s, never silent truncation:
+        # batch overflow, over-length prompt, empty prompt
+        for bad in (
+            {"tokens": [[1], [2]]},                 # > server batch
+            {"tokens": [list(range(41))]},          # > context (40)
+            {"tokens": [[]]},                       # empty prompt
+        ):
+            try:
+                post(bad)
+                raise AssertionError(f"should have failed: {bad}")
+            except urllib.error.HTTPError as e:
+                assert e.code == 400, bad
         # VIP discovery lists the live backend
         from dcos_commons_tpu.http.api import SchedulerApi
 
