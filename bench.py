@@ -307,9 +307,12 @@ def bench_profile() -> dict:
         lambda qq, _: (one(qq, k, v), None), q, None, length=chain
     )[0])
     t_attn = timeit(fwd, q, k, v, iters=3) / chain
+    # all three grads: dq chains through the scan carry, dk/dv
+    # accumulate across iterations — dropping them would prune half
+    # the backward kernels and understate the training cost
     grad = jax.jit(jax.grad(lambda q, k, v: _lax.scan(
         lambda qq, _: (one(qq, k, v), None), q, None, length=chain
-    )[0].astype(jnp.float32).sum(), argnums=0))
+    )[0].astype(jnp.float32).sum(), argnums=(0, 1, 2)))
     t_attn_fb = timeit(grad, q, k, v, iters=3) / chain
     out["profile_attn_fwd_ms"] = round(t_attn * 1e3, 2)
     out["profile_attn_fwd_tflops"] = round(attn_flops / t_attn / 1e12, 1)

@@ -220,15 +220,23 @@ class ApiServer:
                     # body: service YAML, or a framework package
                     # tarball (Content-Type: application/gzip — the
                     # Cosmos install flow; reference: dynamic add via
-                    # MultiServiceResource / ServiceStore)
+                    # MultiServiceResource / ServiceStore).  With
+                    # ?upgrade=true an existing service takes the new
+                    # package version (Cosmos `update`): validated
+                    # config diff -> rolling update over live state
                     length = int(self.headers.get("Content-Length", 0))
                     body = self.rfile.read(length)
                     ctype = self.headers.get("Content-Type", "")
+                    upgrade = (query.get("upgrade") or ["false"])[0] \
+                        .lower() in ("1", "true", "yes")
                     try:
                         if "gzip" in ctype or body[:2] == b"\x1f\x8b":
-                            multi_scheduler.install_package(name, body)
+                            multi_scheduler.install_package(
+                                name, body, upgrade=upgrade
+                            )
                             return 200, {
-                                "message": f"package {name} installed"
+                                "message": f"package {name} "
+                                f"{'upgraded' if upgrade else 'installed'}"
                             }
                         from dcos_commons_tpu.specification.yaml_spec import (
                             from_yaml,

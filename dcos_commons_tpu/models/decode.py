@@ -209,12 +209,14 @@ def generate(
             f"{max_new_tokens} new tokens"
         )
     if key is None:
-        if not isinstance(temperature, (int, float)):
+        from jax.core import Tracer
+
+        if isinstance(temperature, Tracer):
             # a TRACED temperature could be > 0 at runtime; silently
             # "sampling" with a fixed default key would look stochastic
             # while returning identical tokens every call
             raise ValueError("a traced temperature needs a PRNG key")
-        if temperature > 0.0:
+        if float(temperature) > 0.0:  # concrete scalars/arrays coerce
             raise ValueError("sampling (temperature > 0) needs a PRNG key")
     logits, cache = prefill(config, params, prompt, total, true_len)
     key = key if key is not None else jax.random.key(0)

@@ -140,8 +140,11 @@ class MultiServiceScheduler:
         with self._lock:
             if spec.name in self._services:
                 raise ValueError(f"service {spec.name!r} already exists")
+            # build BEFORE persisting: a spec that cannot build must
+            # not be stored, or _reload poisons every restart
+            built = self._build(spec)
             self.service_store.store(spec.name, spec.to_dict())
-            self._services[spec.name] = self._build(spec)
+            self._services[spec.name] = built
 
     @property
     def artifact_base(self):
@@ -159,11 +162,20 @@ class MultiServiceScheduler:
                     f"{value.rstrip('/')}/v1/multi/{name}" if value else None
                 )
 
-    def install_package(self, name: str, payload: bytes) -> None:
+    def install_package(
+        self, name: str, payload: bytes, upgrade: bool = False
+    ) -> None:
         """Install a framework package tarball (the Cosmos flow): the
         bundle is extracted into this scheduler's packages dir, its
         svc.yml loads with template paths anchored there, and the
         service joins the framework.
+
+        ``upgrade=True`` pushes a NEW package version to a RUNNING
+        service (reference: Cosmos `update --package-version`): the
+        bundle replaces the package dir and the service rebuilds over
+        its existing state — the config diff validates, a rejected
+        diff keeps the old target (errors surface on the plan), and an
+        accepted one rolls the update plan.
 
         Reference: Cosmos rendering a universe package into a running
         scheduler (tools/universe/ + marathon.json.mustache)."""
@@ -188,8 +200,16 @@ class MultiServiceScheduler:
         # filesystem commits (the loser would clobber the winner's
         # live templates before failing)
         with self._lock:
-            if self.get_service(name) is not None:
-                raise SpecError(f"service {name!r} already exists")
+            existing = self._services.get(name)
+            if isinstance(existing, UninstallScheduler):
+                raise SpecError(f"service {name!r} is uninstalling")
+            if existing is not None and not upgrade:
+                raise SpecError(
+                    f"service {name!r} already exists (pass upgrade=true "
+                    "to push a new package version)"
+                )
+            if existing is None and upgrade:
+                raise SpecError(f"no service {name!r} to upgrade")
             # stage the extraction: a rejected install must never
             # clobber a running service's templates (launches read them)
             packages_root = _os.path.join(self.config.state_dir, "packages")
@@ -205,8 +225,21 @@ class MultiServiceScheduler:
                         f"package {manifest['name']!r} defines service "
                         f"{spec.name!r}, not {name!r}"
                     )
-                target = _os.path.join(packages_root, name)
+                # VERSIONED final location: upgrades never delete the
+                # dir a still-active (or kept-after-rejected-diff)
+                # target config's templates live in — a rejected v2
+                # must leave v1's templates untouched on disk
+                import hashlib as _hashlib
+
+                digest = _hashlib.sha256(payload).hexdigest()[:12]
+                version = str(manifest.get("version", "0")).replace(
+                    _os.sep, "_"
+                )
+                target = _os.path.join(
+                    packages_root, name, f"{version}-{digest}"
+                )
                 _shutil.rmtree(target, ignore_errors=True)
+                _os.makedirs(_os.path.dirname(target), exist_ok=True)
                 _os.replace(staging, target)
             finally:
                 _shutil.rmtree(staging, ignore_errors=True)
@@ -214,7 +247,18 @@ class MultiServiceScheduler:
             spec = from_yaml_file(
                 _os.path.join(target, "svc.yml"), env=dict(_os.environ)
             )
-            self.add_service(spec)
+            if existing is not None:
+                # rebuild over the SAME namespace/state: the builder's
+                # config-update pass validates the diff and selects
+                # the update plan; the swapped-in scheduler resumes
+                # running tasks instead of redeploying.  BUILD FIRST —
+                # persisting a spec that cannot build would poison
+                # every restart's _reload
+                rebuilt = self._build(spec)
+                self.service_store.store(name, spec.to_dict())
+                self._services[name] = rebuilt
+            else:
+                self.add_service(spec)
 
     def uninstall_service(self, name: str) -> None:
         """Flip the service to teardown; it is dropped from the set

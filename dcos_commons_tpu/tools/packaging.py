@@ -173,6 +173,12 @@ def main(argv: Optional[list] = None) -> int:
         "--name", default="",
         help="service name (default: manifest name)",
     )
+    p.add_argument(
+        "--upgrade", action="store_true",
+        help="push a new package version to a RUNNING service "
+             "(Cosmos `update --package-version` analogue): validated "
+             "config diff, rolling update over live state",
+    )
     args = parser.parse_args(argv)
 
     try:
@@ -205,8 +211,9 @@ def _run_verb(args) -> int:
     with open(args.package, "rb") as f:
         payload = f.read()
     name = args.name or read_manifest(args.package)["name"]
+    suffix = "?upgrade=true" if getattr(args, "upgrade", False) else ""
     req = urllib.request.Request(
-        f"{args.url.rstrip('/')}/v1/multi/{name}",
+        f"{args.url.rstrip('/')}/v1/multi/{name}{suffix}",
         data=payload,
         method="PUT",
         headers={"Content-Type": "application/gzip"},

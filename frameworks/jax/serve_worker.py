@@ -110,9 +110,12 @@ def main() -> int:
                         f"context {prompt_len}"
                     )
                 temp = float(body.get("temperature", 0.0))
-                n = min(
-                    int(body.get("max_new_tokens", new_tokens)), new_tokens
-                )
+                n = int(body.get("max_new_tokens", new_tokens))
+                if n < 1:
+                    raise ValueError(
+                        f"max_new_tokens must be >= 1, got {n}"
+                    )
+                n = min(n, new_tokens)
                 padded = jnp.zeros((batch, prompt_len), jnp.int32)
                 for i, row in enumerate(rows):
                     row = [int(t) % config.vocab for t in row]

@@ -225,3 +225,72 @@ def test_install_rejects_traversal_service_name(tmp_path):
             multi.install_package(bad, payload)
     # nothing leaked outside the packages dir
     assert not (tmp_path / "state" / "svc.yml").exists()
+
+
+def test_package_upgrade_rolls_running_service(tmp_path):
+    """Cosmos `update --package-version` analogue: a NEW package
+    version pushed to a RUNNING service validates the diff and rolls
+    the update plan over live state; without upgrade=True an existing
+    name is refused, and upgrading a non-existent service fails."""
+    import pytest
+
+    from dcos_commons_tpu.specification.specs import SpecError
+
+    framework = make_framework(tmp_path)
+    v1 = str(tmp_path / "v1.tgz")
+    build_package(framework, v1)
+    multi = MultiServiceScheduler(
+        persister=MemPersister(),
+        inventory=SliceInventory([TpuHost(host_id="h0")]),
+        agent=FakeAgent(),
+        scheduler_config=SchedulerConfig(
+            backoff_enabled=False,
+            revive_capacity=1_000_000,
+            state_dir=str(tmp_path / "state"),
+        ),
+    )
+    agent = multi.agent
+    with open(v1, "rb") as f:
+        payload_v1 = f.read()
+    with pytest.raises(SpecError, match="no service"):
+        multi.install_package("pkgsvc", payload_v1, upgrade=True)
+    multi.install_package("pkgsvc", payload_v1)
+
+    def drive_until_complete():
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            multi.run_cycle()
+            for task in ("app-0-main",):
+                task_id = agent.task_id_of(task)
+                if task_id is not None and task_id in agent.active_task_ids():
+                    agent.send(TaskStatus(
+                        task_id=task_id, state=TaskState.RUNNING, ready=True,
+                    ))
+            svc = multi.get_service("pkgsvc")
+            plans = svc.plans()
+            rollout = plans.get("update") or plans.get("deploy")
+            if rollout.is_complete:
+                return svc
+        raise AssertionError("rollout did not complete")
+
+    svc = drive_until_complete()
+    first_id = svc.state_store.fetch_task("app-0-main").task_id
+    first_cmd = svc.state_store.fetch_task("app-0-main").command
+
+    # re-push the SAME version without the flag: refused
+    with pytest.raises(SpecError, match="already exists"):
+        multi.install_package("pkgsvc", payload_v1)
+
+    # version 2 changes the task command -> rolling update
+    with open(os.path.join(framework, "svc.yml")) as f:
+        yaml_v2 = f.read().replace("sleep 100", "sleep 200")
+    with open(os.path.join(framework, "svc.yml"), "w") as f:
+        f.write(yaml_v2)
+    v2 = str(tmp_path / "v2.tgz")
+    build_package(framework, v2, version="0.2.0")
+    with open(v2, "rb") as f:
+        multi.install_package("pkgsvc", f.read(), upgrade=True)
+    svc = drive_until_complete()
+    info = svc.state_store.fetch_task("app-0-main")
+    assert info.task_id != first_id, "upgrade did not roll the task"
+    assert "sleep 200" in info.command and "sleep 200" not in first_cmd
