@@ -133,28 +133,51 @@ def test_temperature_is_traced_not_static(params):
 
 
 def test_sharded_generate_matches_unsharded(params):
-    """Multi-chip SERVING: generate under a dp x tp mesh (params
-    tp-sharded, batch dp-sharded, GSPMD inserts the activation
-    collectives) produces exactly the unsharded greedy tokens."""
+    """Multi-chip SERVING: prefill + decode under a dp x tp mesh
+    (params tp-sharded, batch dp-sharded, GSPMD activation
+    collectives) reproduce the unsharded LOGITS to float tolerance —
+    exact token equality would be tie-fragile because the tp psum
+    reorders the f32 reduction — and the full sharded generate runs
+    end to end."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    from dcos_commons_tpu.models.decode import init_kv_cache
     from dcos_commons_tpu.models.transformer import param_shardings
     from dcos_commons_tpu.parallel.mesh import MeshSpec, make_mesh
 
     mesh = make_mesh(MeshSpec(dp=2, tp=4))
     prompt, _ = synthetic_tokens(jax.random.key(30), 4, 8, CFG.vocab)
-    ref = generate(CFG, params, prompt, max_new_tokens=4)
+    ref_logits, ref_cache = prefill(CFG, params, prompt, max_len=16)
     with mesh:
         shards = param_shardings(CFG, mesh)
         sparams = jax.tree.map(jax.device_put, params, shards)
         sprompt = jax.device_put(
             prompt, NamedSharding(mesh, P(("dcn", "dp", "fsdp"), None))
         )
+        logits, cache = jax.jit(
+            lambda p, t: prefill(CFG, p, t, max_len=16)
+        )(sparams, sprompt)
+        np.testing.assert_allclose(
+            np.asarray(logits), np.asarray(ref_logits),
+            atol=2e-4, rtol=2e-4,
+        )
+        # one sharded decode step reproduces the unsharded step logits
+        nxt = jnp.argmax(ref_logits, axis=-1).astype(jnp.int32)
+        step_logits, _ = jax.jit(lambda p, c, t: decode_step(
+            CFG, p, c, t, jnp.int32(8)
+        ))(sparams, cache, nxt)
+        ref_step, _ = decode_step(CFG, params, ref_cache, nxt, jnp.int32(8))
+        np.testing.assert_allclose(
+            np.asarray(step_logits), np.asarray(ref_step),
+            atol=3e-4, rtol=3e-4,
+        )
+        # the full scan-decode generate runs sharded end to end
         out = jax.jit(lambda p, t: generate(
             CFG, p, t, max_new_tokens=4, max_len=16
         ))(sparams, sprompt)
         jax.block_until_ready(out)
-    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    assert out.shape == (4, 4)
+    assert bool(jnp.all((out >= 0) & (out < CFG.vocab)))
 
 
 def test_sampling_needs_key_and_respects_temperature(params):
