@@ -148,6 +148,49 @@ def extract_package(package_bytes: bytes, target_dir: str) -> Dict:
     return manifest
 
 
+def lint_airgap(framework_dir: str) -> list:
+    """Air-gap lint (reference: tools/airgap_linter.py): a framework
+    destined for a fleet with no egress must not bake external URLs or
+    image pulls into its svc.yml / templates / scripts.  Returns a
+    list of "path:line: finding" strings; empty = clean."""
+    import re as _re
+
+    url_re = _re.compile(r"https?://[^\s\"']+", _re.IGNORECASE)
+    image_re = _re.compile(r"^\s*image:\s*(\S+)")
+    findings = []
+    for dirpath, _dirs, files in os.walk(framework_dir):
+        for name in sorted(files):
+            path = os.path.join(dirpath, name)
+            rel = os.path.relpath(path, framework_dir)
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    lines = f.readlines()
+            except (UnicodeDecodeError, OSError):
+                continue  # binaries are the tasks' problem, not ours
+            for i, line in enumerate(lines, 1):
+                stripped = line.strip()
+                if stripped.startswith(("#", "//", "*")):
+                    continue
+                for url in url_re.findall(stripped):
+                    host = url.split("//", 1)[1].split("/", 1)[0]
+                    if host.split(":")[0] in (
+                        "localhost", "127.0.0.1", "0.0.0.0",
+                    ):
+                        continue  # loopback is not egress
+                    findings.append(
+                        f"{rel}:{i}: external URL {url} — unreachable "
+                        "in an air-gapped fleet"
+                    )
+                image = image_re.match(line)
+                if image and "/" in image.group(1) and \
+                        "." in image.group(1).split("/")[0]:
+                    findings.append(
+                        f"{rel}:{i}: image {image.group(1)} pulls from "
+                        "an external registry"
+                    )
+    return findings
+
+
 def main(argv: Optional[list] = None) -> int:
     """``python -m dcos_commons_tpu package`` — build/inspect/install."""
     import argparse
@@ -164,6 +207,8 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("--description", default="")
     p = sub.add_parser("inspect")
     p.add_argument("package")
+    p = sub.add_parser("lint")
+    p.add_argument("framework_dir")
     p = sub.add_parser("install")
     p.add_argument("package")
     p.add_argument(
@@ -206,6 +251,15 @@ def _run_verb(args) -> int:
         return 0
     if args.verb == "inspect":
         print(json.dumps(read_manifest(args.package), indent=2))
+        return 0
+    if args.verb == "lint":
+        findings = lint_airgap(args.framework_dir)
+        for finding in findings:
+            print(finding)
+        if findings:
+            print(f"{len(findings)} air-gap finding(s)", file=sys.stderr)
+            return 1
+        print("air-gap clean")
         return 0
     # install: the tarball travels to the scheduler (Cosmos analogue)
     with open(args.package, "rb") as f:

@@ -294,3 +294,40 @@ def test_package_upgrade_rolls_running_service(tmp_path):
     info = svc.state_store.fetch_task("app-0-main")
     assert info.task_id != first_id, "upgrade did not roll the task"
     assert "sleep 200" in info.command and "sleep 200" not in first_cmd
+
+
+def test_airgap_lint(tmp_path):
+    """Reference tools/airgap_linter.py analogue: external URLs and
+    registry image pulls are findings; loopback and comments are not;
+    all shipped frameworks/ lint clean."""
+    from dcos_commons_tpu.tools.packaging import lint_airgap
+
+    d = tmp_path / "fw"
+    d.mkdir()
+    (d / "svc.yml").write_text(
+        "name: x\n"
+        "# comment with https://example.com is fine\n"
+        "pods:\n"
+        "  app:\n"
+        "    count: 1\n"
+        "    image: registry.example.com/app:1\n"
+        "    tasks:\n"
+        "      main:\n"
+        "        goal: RUNNING\n"
+        '        cmd: "curl https://artifacts.example.com/blob '
+        '&& curl http://127.0.0.1:8080/ok && sleep 1"\n'
+        "        cpus: 0.1\n"
+        "        memory: 32\n"
+    )
+    findings = lint_airgap(str(d))
+    assert any("artifacts.example.com" in f for f in findings)
+    assert any("registry.example.com" in f for f in findings)
+    assert not any("example.com is fine" in f for f in findings)
+    assert not any("127.0.0.1" in f for f in findings)
+    assert len(findings) == 2
+
+    # every framework this repo ships must BE air-gap clean
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for name in ("helloworld", "hdfs", "jax"):
+        clean = lint_airgap(os.path.join(repo, "frameworks", name))
+        assert clean == [], f"{name}: {clean}"
