@@ -155,10 +155,19 @@ def lint_airgap(framework_dir: str) -> list:
     list of "path:line: finding" strings; empty = clean."""
     import re as _re
 
+    if not os.path.isdir(framework_dir):
+        # a typo'd path must not pass as "clean" (mirrors build_package
+        # raising on a missing svc.yml)
+        raise PackageError(f"no such framework dir: {framework_dir}")
     url_re = _re.compile(r"https?://[^\s\"']+", _re.IGNORECASE)
     image_re = _re.compile(r"^\s*image:\s*(\S+)")
     findings = []
-    for dirpath, _dirs, files in os.walk(framework_dir):
+    for dirpath, dirs, files in os.walk(framework_dir):
+        # lint the file set build_package would SHIP (no VCS/cache
+        # droppings — a .git/config URL is not a package finding)
+        dirs[:] = [
+            d for d in dirs if d != "__pycache__" and not d.startswith(".")
+        ]
         for name in sorted(files):
             path = os.path.join(dirpath, name)
             rel = os.path.relpath(path, framework_dir)
@@ -169,12 +178,18 @@ def lint_airgap(framework_dir: str) -> list:
                 continue  # binaries are the tasks' problem, not ours
             for i, line in enumerate(lines, 1):
                 stripped = line.strip()
-                if stripped.startswith(("#", "//", "*")):
+                # NOTE: '*' is NOT a comment marker — a shell case arm
+                # `*) curl https://...` must be flagged
+                if stripped.startswith(("#", "//")):
                     continue
                 for url in url_re.findall(stripped):
                     host = url.split("//", 1)[1].split("/", 1)[0]
-                    if host.split(":")[0] in (
-                        "localhost", "127.0.0.1", "0.0.0.0",
+                    if host.startswith("["):  # bracketed IPv6
+                        bare = host[1:].split("]", 1)[0]
+                    else:
+                        bare = host.split(":")[0]
+                    if bare in (
+                        "localhost", "127.0.0.1", "0.0.0.0", "::1",
                     ):
                         continue  # loopback is not egress
                     findings.append(

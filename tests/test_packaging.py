@@ -319,12 +319,31 @@ def test_airgap_lint(tmp_path):
         "        cpus: 0.1\n"
         "        memory: 32\n"
     )
+    (d / "run.sh").write_text(
+        "case $1 in\n"
+        "*) curl https://sneaky.example.com/payload ;;\n"
+        "esac\n"
+        "echo http://[::1]:9000/metrics\n"
+    )
+    git_dir = d / ".git"
+    git_dir.mkdir()
+    (git_dir / "config").write_text("url = https://github.com/x/y\n")
     findings = lint_airgap(str(d))
     assert any("artifacts.example.com" in f for f in findings)
     assert any("registry.example.com" in f for f in findings)
+    # '*' is NOT a comment: the shell case arm is a real violation
+    assert any("sneaky.example.com" in f for f in findings)
     assert not any("example.com is fine" in f for f in findings)
     assert not any("127.0.0.1" in f for f in findings)
-    assert len(findings) == 2
+    assert not any("::1" in f for f in findings)  # IPv6 loopback ok
+    assert not any(".git" in f for f in findings)  # unshipped files
+    assert len(findings) == 3
+
+    # a typo'd path must raise, not pass as clean
+    from dcos_commons_tpu.tools.packaging import PackageError
+
+    with pytest.raises(PackageError, match="no such framework"):
+        lint_airgap(str(tmp_path / "definitely-not-here"))
 
     # every framework this repo ships must BE air-gap clean
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
