@@ -198,6 +198,27 @@ class AgentProcess:
             self._log.close()
 
 
+def reap_orphan_tasks(agents) -> None:
+    """Kill task process groups that outlive their daemons.  Stopping
+    (or killing) a daemon leaves its supervised tasks RUNNING by
+    design — durable-task semantics — so tests that launch real
+    long-running commands must reap them or leak processes into the
+    host.  Pids come from the supervisors' durable records."""
+    import signal
+
+    for agent in agents:
+        root = os.path.join(agent.workdir, "sandboxes")
+        for dirpath, _dirs, files in os.walk(root):
+            for name in ("child.pid", "task.pid"):
+                if name not in files:
+                    continue
+                try:
+                    pid = int(open(os.path.join(dirpath, name)).read())
+                    os.killpg(pid, signal.SIGKILL)
+                except (OSError, ValueError):
+                    pass
+
+
 class SchedulerProcess:
     """One served scheduler subprocess (``dcos_commons_tpu serve``)."""
 

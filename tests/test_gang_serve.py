@@ -19,6 +19,7 @@ import pytest
 from dcos_commons_tpu.testing.integration import (
     AgentProcess,
     SchedulerProcess,
+    reap_orphan_tasks,
     wait_for,
 )
 
@@ -209,24 +210,4 @@ def test_gang_trains_and_resumes_from_checkpoint_after_host_loss(tmp_path):
         scheduler.terminate()
         for agent in agents:
             agent.stop()
-        _reap_orphan_tasks(agents)
-
-
-def _reap_orphan_tasks(agents):
-    """Kill task process groups that outlive their daemons.  A KILLED
-    daemon's supervisors keep running by design (durable-task
-    semantics); a test must not leak 4000-step trainers into the CI
-    host.  Pids come from the supervisors' durable records."""
-    import signal
-
-    for agent in agents:
-        root = os.path.join(agent.workdir, "sandboxes")
-        for dirpath, _dirs, files in os.walk(root):
-            for name in ("child.pid", "task.pid"):
-                if name not in files:
-                    continue
-                try:
-                    pid = int(open(os.path.join(dirpath, name)).read())
-                    os.killpg(pid, signal.SIGKILL)
-                except (OSError, ValueError):
-                    pass
+        reap_orphan_tasks(agents)

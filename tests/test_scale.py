@@ -171,6 +171,7 @@ def test_scale_distributed_fleet_with_churn(tmp_path):
     from dcos_commons_tpu.testing.integration import (
         AgentProcess,
         ServiceClient,
+        reap_orphan_tasks,
         wait_for,
     )
 
@@ -309,3 +310,7 @@ def test_scale_distributed_fleet_with_churn(tmp_path):
         log.close()
         for daemon in daemons:
             daemon.stop()
+        # stopped daemons leave their tasks running (durable-task
+        # semantics): 48 sleep-600 supervisors must not pile up on
+        # the CI host across runs
+        reap_orphan_tasks(daemons)

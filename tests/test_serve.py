@@ -69,6 +69,9 @@ def cluster(tmp_path):
     yield {"agents": agents, "svc": str(svc), "topology": str(topology)}
     for agent in agents:
         agent.stop()
+    from dcos_commons_tpu.testing.integration import reap_orphan_tasks
+
+    reap_orphan_tasks(agents)  # stopped daemons leave tasks running
 
 
 def test_serve_deploys_and_recovers_across_processes(cluster, tmp_path):
