@@ -12,6 +12,7 @@ everything crosses sockets, nothing is in-process.
 from __future__ import annotations
 
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -204,8 +205,6 @@ def reap_orphan_tasks(agents) -> None:
     design — durable-task semantics — so tests that launch real
     long-running commands must reap them or leak processes into the
     host.  Pids come from the supervisors' durable records."""
-    import signal
-
     for agent in agents:
         root = os.path.join(agent.workdir, "sandboxes")
         for dirpath, _dirs, files in os.walk(root):

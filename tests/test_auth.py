@@ -321,6 +321,9 @@ def test_serve_e2e_authenticated_control_plane(tmp_path):
             scheduler.terminate()
         for agent in agents:
             agent.stop()
+        from dcos_commons_tpu.testing.integration import reap_orphan_tasks
+
+        reap_orphan_tasks(agents)
         state_proc.terminate()
         state_proc.wait(timeout=10)
         state_log.close()

@@ -22,6 +22,7 @@ from dcos_commons_tpu.testing.integration import (
     AgentProcess,
     SchedulerProcess,
     ServiceClient,
+    reap_orphan_tasks,
     wait_for,
 )
 
@@ -69,8 +70,6 @@ def cluster(tmp_path):
     yield {"agents": agents, "svc": str(svc), "topology": str(topology)}
     for agent in agents:
         agent.stop()
-    from dcos_commons_tpu.testing.integration import reap_orphan_tasks
-
     reap_orphan_tasks(agents)  # stopped daemons leave tasks running
 
 
@@ -316,6 +315,7 @@ def test_serve_deploys_multislice_gang_over_daemons(tmp_path):
         code = scheduler.terminate()
         for agent in agents:
             agent.stop()
+        reap_orphan_tasks(agents)
         assert code == 0, scheduler.log_tail()
 
 
