@@ -199,6 +199,28 @@ class AgentProcess:
             self._log.close()
 
 
+def start_state_server(workdir: str, repo_root: str = ""):
+    """Spawn a ``state-server`` subprocess; returns (proc, state_url,
+    log_file).  Caller terminates the proc and closes the log."""
+    announce = os.path.join(workdir, "state-announce")
+    os.makedirs(workdir, exist_ok=True)
+    if os.path.exists(announce):
+        os.remove(announce)
+    log = open(os.path.join(workdir, "state-server.log"), "ab")
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "dcos_commons_tpu", "state-server",
+            "--data-dir", os.path.join(workdir, "data"),
+            "--announce-file", announce,
+        ],
+        cwd=repo_root or None,
+        stdout=log,
+        stderr=subprocess.STDOUT,
+    )
+    url = _read_announce(announce)
+    return proc, url, log
+
+
 def reap_orphan_tasks(agents) -> None:
     """Kill task process groups that outlive their daemons.  Stopping
     (or killing) a daemon leaves its supervised tasks RUNNING by

@@ -175,6 +175,57 @@ def test_scheduler_restart_resumes_over_same_state(cluster, tmp_path):
         assert scheduler.terminate() == 0
 
 
+def test_live_update_overrides_survive_failover(cluster, tmp_path):
+    """Feature interaction: options applied via POST /v1/update persist
+    in the STATE SERVER, so a standby taking over after the active
+    scheduler dies renders the spec WITH the overrides — no rollback
+    of a live update on failover."""
+    from dcos_commons_tpu.testing.integration import start_state_server
+
+    svc = tmp_path / "svc-upd.yml"
+    svc.write_text(UPDATABLE_YAML)
+    state, state_url, state_log = start_state_server(
+        str(tmp_path / "state"), REPO
+    )
+    sched_a = sched_b = None
+    try:
+        env = {"ENABLE_BACKOFF": "false", "STATE_LEASE_TTL_S": "2"}
+        extra = ["--state-url", state_url]
+        sched_a = SchedulerProcess(
+            str(svc), cluster["topology"], str(tmp_path / "sched-a"),
+            env=env, repo_root=REPO, extra_args=extra,
+        )
+        client = sched_a.client()
+        client.wait_for_completed_deployment(timeout_s=90)
+        before = client.task_ids()  # BEFORE the update: the rollout
+        client.post("/v1/update", body={"env": {"MODE": "green"}})
+        ids = client.wait_for_tasks_updated(before, timeout_s=120)
+        client.wait_for_completed_deployment(timeout_s=120)
+
+        # active dies HARD mid-flight; standby takes over after the
+        # lease expires and must keep MODE=green — not roll back to
+        # the YAML default
+        sched_a.process.kill()
+        sched_a.process.wait(timeout=10)
+        time.sleep(3.0)  # > lease ttl
+        sched_b = SchedulerProcess(
+            str(svc), cluster["topology"], str(tmp_path / "sched-b"),
+            env=env, repo_root=REPO, extra_args=extra,
+        )
+        client_b = sched_b.client()
+        client_b.wait_for_completed_deployment(timeout_s=120)
+        client_b.check_tasks_not_updated(ids)  # nothing rolled back
+        infos = client_b.get("/v1/pod/app-0/info")
+        assert infos[0]["env"]["MODE"] == "green"
+    finally:
+        for sched in (sched_a, sched_b):
+            if sched is not None:
+                sched.terminate()
+        state.terminate()
+        state.wait(timeout=10)
+        state_log.close()
+
+
 UPDATABLE_YAML = """
 name: webfarm
 pods:
@@ -337,23 +388,12 @@ def test_scheduler_failover_over_state_server(cluster, tmp_path):
     until A's lease expires, then takes over and RESUMES the deployed
     service without relaunching tasks (reference: CuratorPersister +
     CuratorLocker over ZK)."""
-    state = subprocess.Popen(
-        [
-            sys.executable, "-m", "dcos_commons_tpu", "state-server",
-            "--data-dir", str(tmp_path / "cluster-state"),
-            "--announce-file", str(tmp_path / "state-announce"),
-        ],
-        cwd=REPO,
+    from dcos_commons_tpu.testing.integration import start_state_server
+
+    state, state_url, state_log = start_state_server(
+        str(tmp_path / "state"), REPO
     )
     try:
-        state_url = wait_for(
-            lambda: (
-                open(tmp_path / "state-announce").read().strip()
-                if os.path.exists(tmp_path / "state-announce") else None
-            ),
-            20.0,
-            what="state server announce",
-        )
         extra = ["--state-url", state_url]
         env = {"STATE_LEASE_TTL_S": "2"}
         sched_a = SchedulerProcess(
@@ -398,6 +438,7 @@ def test_scheduler_failover_over_state_server(cluster, tmp_path):
     finally:
         state.terminate()
         state.wait(timeout=10)
+        state_log.close()
 
 
 def test_multi_serve_dynamic_services(cluster, tmp_path):
