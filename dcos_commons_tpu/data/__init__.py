@@ -1,0 +1,13 @@
+from dcos_commons_tpu.data.loader import (
+    DevicePrefetcher,
+    TokenDataset,
+    list_shards,
+    write_token_shard,
+)
+
+__all__ = [
+    "DevicePrefetcher",
+    "TokenDataset",
+    "list_shards",
+    "write_token_shard",
+]

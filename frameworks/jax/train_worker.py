@@ -64,11 +64,43 @@ def main() -> int:
         start = start or 0
         step_fn = make_train_step(config, optimizer, mesh=mesh, donate=False)
         batch = max(2, 2 * mesh.devices.size)
-        tokens, targets = synthetic_tokens(
-            jax.random.key(1), batch, config.max_seq, config.vocab
-        )
+        data_dir = os.environ.get("DATA_DIR", "")
+        batches = None
+        if data_dir:
+            # real corpus: memory-mapped token shards round-robin over
+            # the gang (disjoint per worker), device-prefetched; the
+            # stream is a pure function of (seed, step) so checkpoint
+            # resume continues EXACTLY where the dead incarnation left
+            from jax.sharding import NamedSharding
+
+            from dcos_commons_tpu.data import DevicePrefetcher, TokenDataset
+            from dcos_commons_tpu.parallel.mesh import batch_spec
+
+            dataset = TokenDataset(
+                data_dir, config.max_seq,
+                worker_id=contract["worker_id"],
+                worker_count=contract["worker_count"],
+            )
+            # batches must land SHARDED like the train step expects
+            # (each process's distinct batch is its dp slice of the
+            # global batch) — a plain device_put would fight the jit's
+            # in_shardings on any multi-device mesh
+            batches = DevicePrefetcher(
+                dataset.batches(batch, start_step=start), depth=2,
+                sharding=NamedSharding(mesh, batch_spec()),
+            )
+            print(
+                f"data: {dataset.n_sequences} sequences for worker "
+                f"{contract['worker_id']}", flush=True,
+            )
+        else:
+            tokens, targets = synthetic_tokens(
+                jax.random.key(1), batch, config.max_seq, config.vocab
+            )
         t0 = time.time()
         for i in range(start, steps):
+            if batches is not None:
+                tokens, targets = next(batches)
             params, opt_state, loss = step_fn(params, opt_state, tokens, targets)
             if i % 20 == 0 or i == steps - 1:
                 print(f"step {i} loss={float(loss):.4f}", flush=True)
@@ -76,6 +108,8 @@ def main() -> int:
                     ckpt_dir, i + 1,
                     {"params": params, "opt_state": opt_state},
                 )
+        if batches is not None:
+            batches.close()
         dt = time.time() - t0
         tps = batch * config.max_seq * (steps - start) / max(dt, 1e-9)
         print(
