@@ -160,26 +160,31 @@ class DevicePrefetcher:
         self._error: Optional[BaseException] = None
         self._stop = threading.Event()
 
+        def put_or_stop(item) -> None:
+            # every enqueue respects close(): an unbounded put would
+            # leave the pump thread (and its staged device batches)
+            # blocked forever when the consumer stops early
+            while not self._stop.is_set():
+                try:
+                    self._queue.put(item, timeout=0.2)
+                    return
+                except queue.Full:
+                    continue
+
         def pump():
             try:
                 for host_batch in it:
-                    staged = tuple(
+                    put_or_stop(tuple(
                         self._place(arr) for arr in host_batch
-                    )
-                    while not self._stop.is_set():
-                        try:
-                            self._queue.put(staged, timeout=0.2)
-                            break
-                        except queue.Full:
-                            continue
+                    ))
                     if self._stop.is_set():
                         return
                 # normal exhaustion (finite eval sets): the sentinel
                 # with no error becomes StopIteration, not a deadlock
-                self._queue.put(None)
+                put_or_stop(None)
             except BaseException as e:  # surfaced on next __next__
                 self._error = e
-                self._queue.put(None)
+                put_or_stop(None)
 
         self._thread = threading.Thread(
             target=pump, name="data-prefetch", daemon=True
@@ -197,6 +202,10 @@ class DevicePrefetcher:
         return self
 
     def __next__(self):
+        if self._stop.is_set():
+            # after exhaustion/error/close: keep raising (iterator
+            # protocol) instead of blocking on an empty queue forever
+            raise (self._error or StopIteration)
         item = self._queue.get()
         if item is None:
             self.close()

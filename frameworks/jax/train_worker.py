@@ -84,9 +84,13 @@ def main() -> int:
             # batches must land SHARDED like the train step expects
             # (each process's distinct batch is its dp slice of the
             # global batch) — a plain device_put would fight the jit's
-            # in_shardings on any multi-device mesh
+            # in_shardings on any multi-device mesh.  Each process
+            # therefore yields its SHARE of the global batch: feeding
+            # `batch` rows per process would silently train at
+            # batch x worker_count (JAX infers global = local x procs)
+            local_rows = max(1, batch // contract["worker_count"])
             batches = DevicePrefetcher(
-                dataset.batches(batch, start_step=start), depth=2,
+                dataset.batches(local_rows, start_step=start), depth=2,
                 sharding=NamedSharding(mesh, batch_spec()),
             )
             print(

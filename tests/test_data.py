@@ -118,12 +118,22 @@ def test_prefetcher_surfaces_source_errors():
 
 def test_prefetcher_finite_iterator_stops_cleanly():
     """A finite source (eval sets) ends in StopIteration, never a
-    deadlocked queue.get."""
-    src = iter([
-        (np.zeros((1, 4), np.int32), np.zeros((1, 4), np.int32))
-    ] * 3)
-    pre = DevicePrefetcher(src, depth=1)
+    deadlocked queue.get — and KEEPS raising on re-next (iterator
+    protocol), and close() without draining unblocks the pump."""
+    batch = (np.zeros((1, 4), np.int32), np.zeros((1, 4), np.int32))
+    pre = DevicePrefetcher(iter([batch] * 3), depth=1)
     assert sum(1 for _ in pre) == 3
+    with pytest.raises(StopIteration):
+        next(pre)  # a second next() must not hang
+    # close-without-drain: the pump (blocked on a full queue with more
+    # to send) must exit, not hold staged device batches forever
+    pre2 = DevicePrefetcher(iter([batch] * 50), depth=1)
+    next(pre2)
+    pre2.close()
+    pre2._thread.join(timeout=5)
+    assert not pre2._thread.is_alive()
+    with pytest.raises(StopIteration):
+        next(pre2)
 
 
 def test_prefetcher_with_mesh_sharding_feeds_sharded_train_step(tmp_path):

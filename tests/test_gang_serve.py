@@ -40,7 +40,7 @@ pods:
         goal: RUNNING
         cmd: >-
           JAX_PLATFORMS=cpu REPO_ROOT={{REPO_ROOT}}
-          CHECKPOINT_DIR={{CKPT_DIR}}
+          CHECKPOINT_DIR={{CKPT_DIR}} DATA_DIR={{DATA_DIR}}
           VOCAB=128 D_MODEL=64 N_LAYERS=2 SEQ_LEN=64 TRAIN_STEPS=4000
           python {{REPO_ROOT}}/frameworks/jax/train_worker.py
         cpus: 1.0
@@ -87,6 +87,10 @@ def _worker_logs(agents):
 
 @pytest.mark.slow
 def test_gang_trains_and_resumes_from_checkpoint_after_host_loss(tmp_path):
+    import numpy as np
+
+    from dcos_commons_tpu.data import write_token_shard
+
     agents = [
         AgentProcess(f"g{i}", str(tmp_path / f"agent-{i}"), REPO)
         for i in range(4)
@@ -96,6 +100,16 @@ def test_gang_trains_and_resumes_from_checkpoint_after_host_loss(tmp_path):
     topology = tmp_path / "topology.yml"
     _write_topology(str(topology), agents)
     ckpt_dir = tmp_path / "ckpt"
+    # a REAL corpus: the gang trains from memmap shards (disjoint per
+    # worker via the env contract), not synthetic tokens
+    data_dir = tmp_path / "corpus"
+    data_dir.mkdir()
+    rng = np.random.default_rng(0)
+    for i in range(4):
+        write_token_shard(
+            str(data_dir / f"shard-{i}.tokens"),
+            rng.integers(0, 128, 8000),
+        )
     scheduler = SchedulerProcess(
         str(svc), str(topology), str(tmp_path / "sched"),
         env={
@@ -103,6 +117,7 @@ def test_gang_trains_and_resumes_from_checkpoint_after_host_loss(tmp_path):
             "PERMANENT_FAILURE_TIMEOUT_S": "1",
             "REPO_ROOT": REPO,
             "CKPT_DIR": str(ckpt_dir),
+            "DATA_DIR": str(data_dir),
         },
         repo_root=REPO,
     )
@@ -110,15 +125,20 @@ def test_gang_trains_and_resumes_from_checkpoint_after_host_loss(tmp_path):
         client = scheduler.client()
         client.wait_for_completed_deployment(timeout_s=120)
 
-        # both workers rendezvous (2-process Gloo mesh) and make real
-        # training steps; worker 0 writes checkpoints every 20 steps
+        # both workers rendezvous (2-process Gloo mesh), load DISJOINT
+        # corpus shards, and make real training steps; worker 0 writes
+        # checkpoints every 20 steps
         def progressed():
             logs = _worker_logs(agents)
+            loaded = sum(
+                1 for entries in logs.values()
+                for _, text in entries if "data: " in text
+            )
             stepped = sum(
                 1 for entries in logs.values()
                 for _, text in entries if "step 20 " in text
             )
-            return stepped >= 1 or None
+            return (loaded >= 2 and stepped >= 1) or None
 
         wait_for(progressed, 240.0, interval_s=2.0,
                  what="gang made 20+ real training steps")
