@@ -231,7 +231,11 @@ def generate(
         greedy = jnp.argmax(logits, axis=-1)
         return jnp.where(temp > 0.0, sampled, greedy).astype(jnp.int32)
 
-    first = pick(logits, key)
+    # split once up front: the prefill pick and the scan step keys must
+    # be derived from DISTINCT keys, or the first sampled token's
+    # randomness correlates with the step keys (PRNG key reuse)
+    first_key, rest_key = jax.random.split(key)
+    first = pick(logits, first_key)
     start = (
         jnp.asarray(true_len, jnp.int32) if true_len is not None
         else jnp.int32(s)
@@ -243,7 +247,7 @@ def generate(
         nxt = pick(logits, step_key)
         return (nxt, pos + 1, cache), token
 
-    keys = jax.random.split(key, max_new_tokens)
+    keys = jax.random.split(rest_key, max_new_tokens)
     (_, _, _), out = lax.scan(
         step,
         (first, start, cache),

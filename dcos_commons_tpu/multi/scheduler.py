@@ -257,6 +257,33 @@ class MultiServiceScheduler:
                 rebuilt = self._build(spec)
                 self.service_store.store(name, spec.to_dict())
                 self._services[name] = rebuilt
+                # prune superseded version dirs: repeated upgrades
+                # otherwise grow state_dir without bound.  Keep the new
+                # target plus every dir any STORED config still
+                # references — a rejected-diff upgrade keeps the old
+                # target config live, and relaunches read its templates
+                # from disk (rejected v2/v3 must not orphan v1).
+                import json as _json
+
+                keep = {_os.path.basename(target)}
+                marker = _re.escape(f"packages/{name}/") + r"([^/\"\\]+)"
+                cfg_store = getattr(rebuilt, "config_store", None)
+                if cfg_store is not None:
+                    for cfg_id in cfg_store.list_ids():
+                        data = cfg_store.fetch(cfg_id)
+                        if data:
+                            for m in _re.finditer(
+                                marker, _json.dumps(data)
+                            ):
+                                keep.add(m.group(1))
+                svc_root = _os.path.join(packages_root, name)
+                for entry_name in _os.listdir(svc_root):
+                    if entry_name in keep or entry_name.startswith("."):
+                        continue
+                    _shutil.rmtree(
+                        _os.path.join(svc_root, entry_name),
+                        ignore_errors=True,
+                    )
             else:
                 self.add_service(spec)
 

@@ -242,8 +242,25 @@ class OfferEvaluator:
         outcome = EvaluationOutcome.ok(
             "reuse", f"relaunching in place on {[p[1] for p in placements]}"
         )
+        # multi-slice gangs carry a slice env contract
+        # (TPU_SLICE_INDEX/TPU_NUM_SLICES, set at claim time in
+        # _evaluate_gang); an in-place relaunch must restore it or the
+        # mesh layer builds a dcn-less mesh.  Derived from the INSTANCE
+        # index and pod.count: at claim time instances are [0..count-1]
+        # slice-major, so worker_id == index — a subset relaunch (a
+        # per-index deploy step) must not renumber from its enumerate
+        # position.
+        n_slices = pod.tpu.slices if pod.tpu is not None else 1
+        hosts_per_slice = max(1, pod.count // max(1, n_slices))
         task_infos = []
-        for worker_id, (index, host_id, reservations) in enumerate(placements):
+        for index, host_id, reservations in placements:
+            worker_id = index
+            slice_env: Dict[str, str] = {}
+            if n_slices > 1:
+                slice_env = {
+                    ENV_TPU_SLICE_INDEX: str(index // hosts_per_slice),
+                    ENV_TPU_NUM_SLICES: str(n_slices),
+                }
             host = inventory.host(host_id)
             for task_name in requirement.tasks_to_launch:
                 task_spec = requirement.pod.task(task_name)
@@ -270,7 +287,7 @@ class OfferEvaluator:
                         chips=task_chips,
                         coordinator=coordinator,
                         worker_id=worker_id,
-                        extra_env=port_env,
+                        extra_env={**port_env, **slice_env},
                     )
                 )
         return EvaluationResult(True, outcome, [], task_infos)
@@ -717,7 +734,11 @@ class OfferEvaluator:
         env[ENV_FRAMEWORK_NAME] = self._service_name
         if pod.tpu is not None:
             env[ENV_TPU_WORKER_ID] = str(worker_id)
-            env[ENV_TPU_WORKER_COUNT] = str(len(requirement.instances))
+            # a gang's worker count is the GANG size, even when this
+            # evaluation covers a subset (per-index relaunch step)
+            env[ENV_TPU_WORKER_COUNT] = str(
+                pod.count if pod.gang else len(requirement.instances)
+            )
             env[ENV_TPU_CHIPS_PER_HOST] = str(pod.tpu.chips_per_host)
             env[ENV_TPU_GENERATION] = pod.tpu.generation
             if chips:

@@ -207,6 +207,14 @@ class FrameworkRunner:
         # serializes update_options' read-merge-write of the options
         # node (ThreadingHTTPServer handles requests concurrently)
         self._update_lock = threading.Lock()
+        # pre-update options node value, kept so a failed rebuild can
+        # un-poison the store.  _options_dirty gates it: the snapshot
+        # is taken only when no rollback is already pending (stacked
+        # updates must roll back to the last BUILD-VALIDATED value,
+        # not an unvalidated intermediate), and cleared only under
+        # _update_lock once a rebuild succeeds with no update pending.
+        self._options_rollback: Optional[bytes] = None
+        self._options_dirty = False
         self._wire_lease_loss()
 
     def _wire_lease_loss(self) -> None:
@@ -365,6 +373,17 @@ class FrameworkRunner:
             )
         except ConfigValidationError as e:
             return 400, {"message": "invalid update", "errors": e.errors}
+        # remember the pre-update node so a rebuild failure (build()
+        # can fail for non-validation reasons) can roll it back —
+        # otherwise the poisoned overrides re-apply and re-fail on
+        # every restart.  Only the FIRST update since the last
+        # successful rebuild snapshots: its value is the last one a
+        # build actually validated.
+        if not self._options_dirty:
+            self._options_rollback = self._persister.get_or_none(
+                OPTIONS_NODE
+            )
+            self._options_dirty = True
         self._persister.set(
             OPTIONS_NODE, json.dumps(merged, sort_keys=True).encode("utf-8")
         )
@@ -378,6 +397,38 @@ class FrameworkRunner:
             "message": "update accepted; rolling update beginning",
             "env": sorted(env),
         }
+
+    def _rollback_options(self) -> None:
+        """Restore the options node to its pre-update value after a
+        failed rebuild, so the next restart renders the last-good
+        spec instead of re-failing on the poisoned overrides."""
+        with self._update_lock:
+            if not self._options_dirty:
+                return
+            if self._reload_requested.is_set():
+                # another update was validated, persisted, and
+                # acknowledged (HTTP 200) while this rebuild was
+                # failing — its node value must survive; the restart
+                # will render IT, not the poisoned intermediate
+                LOG.warning(
+                    "rebuild failed but a newer accepted update is "
+                    "pending; leaving its options in place"
+                )
+                return
+            prev = self._options_rollback
+            try:
+                if prev is None:
+                    self._persister.recursive_delete(OPTIONS_NODE)
+                else:
+                    self._persister.set(OPTIONS_NODE, prev)
+                LOG.warning(
+                    "rolled options back to pre-update value after "
+                    "rebuild failure"
+                )
+                self._options_rollback = None
+                self._options_dirty = False
+            except Exception:
+                LOG.exception("options rollback failed")
 
     def run(self) -> int:
         """Lock -> build -> serve -> loop.  Returns a process exit code."""
@@ -446,7 +497,16 @@ class FrameworkRunner:
                             self.build()
                         except Exception:
                             LOG.exception("rebuild after update failed")
+                            self._rollback_options()
                             return EXIT_BAD_CONFIG
+                        # clear the rollback only when no further
+                        # update is already pending — and under the
+                        # update lock, so a concurrent handler's
+                        # snapshot can't be clobbered
+                        with self._update_lock:
+                            if not self._reload_requested.is_set():
+                                self._options_rollback = None
+                                self._options_dirty = False
                         self._set_artifact_base()
                         self.api_server.set_scheduler(self.scheduler)
                         self.api_server.set_extra_routes(

@@ -124,10 +124,13 @@ def main() -> int:
                     padded = padded.at[i, : len(row)].set(
                         jnp.asarray(row, jnp.int32)
                     )
+                # fresh entropy per request: hashing only the prompt
+                # made temperature>0 replies deterministic per process
+                seed = int.from_bytes(os.urandom(4), "little")
                 with lock:  # one generate at a time per chip
                     out = gen(
                         params, padded,
-                        jax.random.key(abs(hash(str(rows))) % (2 ** 31)),
+                        jax.random.key(seed),
                         jnp.float32(temp),
                         jnp.int32(true_len),
                     )

@@ -389,6 +389,41 @@ def test_evaluate_multislice_gang():
     assert coords.pop().startswith(by_worker[0].agent_id)
 
 
+def test_multislice_gang_relaunch_restores_slice_env():
+    """A TRANSIENT in-place relaunch of a slices>1 gang must carry the
+    same TPU_SLICE_INDEX/TPU_NUM_SLICES contract the claim path set —
+    losing it builds a dcn-less mesh (r3 advisor, evaluate.py reuse)."""
+    fleet = (
+        make_test_fleet(slice_id="pod-a", host_grid=(1, 2),
+                        chip_block=(2, 2))
+        + make_test_fleet(slice_id="pod-b", host_grid=(1, 2),
+                          chip_block=(2, 2))
+    )
+    spec, store, ledger, ev, inv = build_eval(MULTISLICE_YAML, fleet)
+    req = PodInstanceRequirement(
+        pod=spec.pod("trainer"), instances=[0, 1, 2, 3]
+    )
+    first = ev.evaluate(req, inv)
+    assert first.passed, first.outcome.flatten()
+    ledger.commit(first.reservations)
+    store.store_tasks(first.task_infos)
+    again = ev.evaluate(
+        PodInstanceRequirement(
+            pod=spec.pod("trainer"), instances=[0, 1, 2, 3],
+            recovery_type=RecoveryType.TRANSIENT,
+        ),
+        inv,
+    )
+    assert again.passed, again.outcome.flatten()
+    assert again.reservations == []  # in-place: no new claims
+    by_worker = sorted(
+        again.task_infos, key=lambda i: int(i.env["TPU_WORKER_ID"])
+    )
+    assert [i.env.get("TPU_SLICE_INDEX") for i in by_worker] == \
+        ["0", "0", "1", "1"]
+    assert all(i.env.get("TPU_NUM_SLICES") == "2" for i in by_worker)
+
+
 def test_multislice_gang_needs_distinct_slices():
     """One free slice cannot host a slices: 2 gang — and the outcome
     says which sub-gang failed."""

@@ -296,6 +296,67 @@ def test_package_upgrade_rolls_running_service(tmp_path):
     assert "sleep 200" in info.command and "sleep 200" not in first_cmd
 
 
+def test_package_upgrade_prunes_superseded_version_dirs(tmp_path):
+    """Repeated upgrades must not grow state_dir without bound — but
+    the prune keep-set is every version dir a STORED config still
+    references (a rejected-diff upgrade keeps the old target's
+    templates live on disk), plus the newly-installed target."""
+    framework = make_framework(tmp_path)
+    v1 = str(tmp_path / "v1.tgz")
+    build_package(framework, v1, version="0.1.0")
+    multi = MultiServiceScheduler(
+        persister=MemPersister(),
+        inventory=SliceInventory([TpuHost(host_id="h0")]),
+        agent=FakeAgent(),
+        scheduler_config=SchedulerConfig(
+            backoff_enabled=False,
+            revive_capacity=1_000_000,
+            state_dir=str(tmp_path / "state"),
+        ),
+    )
+    with open(v1, "rb") as f:
+        multi.install_package("pkgsvc", f.read())
+    pkg_root = tmp_path / "state" / "packages" / "pkgsvc"
+
+    def version_dirs():
+        return sorted(
+            d for d in os.listdir(pkg_root) if not d.startswith(".")
+        )
+
+    assert len(version_dirs()) == 1
+    # push three successive versions; each changes the cmd so the
+    # config updater accepts the diff and re-targets
+    for n, ver in enumerate(("0.2.0", "0.3.0", "0.4.0"), start=2):
+        with open(os.path.join(framework, "svc.yml")) as f:
+            yaml_n = f.read().replace(
+                f"sleep {(n - 1) * 100}", f"sleep {n * 100}"
+            )
+        with open(os.path.join(framework, "svc.yml"), "w") as f:
+            f.write(yaml_n)
+        tgz = str(tmp_path / f"v{n}.tgz")
+        build_package(framework, tgz, version=ver)
+        with open(tgz, "rb") as f:
+            multi.install_package("pkgsvc", f.read(), upgrade=True)
+    dirs = version_dirs()
+    # the new target always survives
+    assert any(d.startswith("0.4.0-") for d in dirs), dirs
+    # superseded dirs whose configs nothing references are gone:
+    # never more than the stored-config fan-out (target + prior
+    # config that still holds the pre-roll tasks)
+    assert len(dirs) <= 3, dirs
+    assert not any(d.startswith("0.1.0-") for d in dirs), (
+        "v0.1.0 dir should have been pruned: %s" % dirs
+    )
+    # the dirs every stored config references are all still present
+    svc = multi.get_service("pkgsvc")
+    referenced = set()
+    for cfg_id in svc.config_store.list_ids():
+        blob = json.dumps(svc.config_store.fetch(cfg_id))
+        for part in blob.split("packages/pkgsvc/")[1:]:
+            referenced.add(part.split("/")[0].split('"')[0])
+    assert referenced <= set(dirs), (referenced, dirs)
+
+
 def test_airgap_lint(tmp_path):
     """Reference tools/airgap_linter.py analogue: external URLs and
     registry image pulls are findings; loopback and comments are not;
