@@ -209,6 +209,7 @@ class AgentDaemon:
                 files=entry.get("files"),
                 secret_env=entry.get("secret_env"),
                 kill_grace_s=float(entry.get("kill_grace_s", 5.0)),
+                uris=entry.get("uris"),
             )
             launched.append(info.task_id)
         return launched

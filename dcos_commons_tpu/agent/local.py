@@ -77,6 +77,165 @@ def prepare_templates(
     return out
 
 
+def _sandbox_path(sandbox: str, dest: str, what: str) -> str:
+    """Resolve a sandbox-relative dest, rejecting escapes (dest is
+    remote-controlled via the launch request)."""
+    root = os.path.normpath(sandbox)
+    if os.path.isabs(dest):
+        raise ValueError(f"{what} dest must be sandbox-relative: {dest}")
+    path = os.path.normpath(os.path.join(root, dest))
+    if not path.startswith(root + os.sep):
+        raise ValueError(f"{what} dest escapes the sandbox: {dest}")
+    return path
+
+
+def stage_uris(
+    uris: Optional[List[dict]],
+    cache_dir: str,
+    ca_file: str = "",
+) -> List[Tuple[dict, str]]:
+    """Download task artifacts; no sandbox writes (slow network work
+    happens OUTSIDE the agent lock, like prepare_templates).
+
+    The task-side half of the reference's pre-launch artifact fetch
+    (``uris:`` in YAML, fetched by the Mesos fetcher before the task
+    command runs; YAMLToInternalMappers.java:397).  Digest-pinned
+    artifacts (``sha256``) are cached per host under ``cache_dir``
+    keyed by digest — a TPU fleet stages the same corpus/tokenizer on
+    every host, and relaunches must not re-download gigabytes.
+    Unpinned artifacts are fetched fresh every launch (a mutable URL
+    must not serve a stale cache).  The cluster bearer token is NEVER
+    attached: these are arbitrary operator URLs, not scheduler routes
+    — leaking the token to an external host would hand out the
+    control plane.  Returns [(entry, staged_file_path)].
+    """
+    import hashlib
+    import tempfile
+    import urllib.request
+
+    def sha256_file(path: str) -> str:
+        digest = hashlib.sha256()
+        with open(path, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                digest.update(chunk)
+        return digest.hexdigest()
+
+    staged: List[Tuple[dict, str]] = []
+    os.makedirs(cache_dir, exist_ok=True)
+    try:
+        for entry in uris or []:
+            uri = entry.get("uri", "")
+            if not uri:
+                raise ValueError(f"artifact entry without a uri: {entry!r}")
+            pin = str(entry.get("sha256", "")).lower()
+            if pin:
+                cached = os.path.join(cache_dir, pin)
+                if os.path.exists(cached) and sha256_file(cached) == pin:
+                    staged.append((entry, cached))
+                    continue
+                if os.path.exists(cached):
+                    os.remove(cached)  # corrupted cache entry: refetch
+            ctx = None
+            if uri.startswith("https"):
+                from dcos_commons_tpu.security import auth as _auth
+
+                ctx = _auth.client_ssl_context(ca_file)
+            # STREAM to disk while hashing: artifacts are corpus-sized
+            # (gigabytes) — buffering one in RAM would OOM the agent
+            # and every task it supervises
+            digest = hashlib.sha256()
+            fd, tmp = tempfile.mkstemp(dir=cache_dir, prefix=".fetch-")
+            try:
+                with os.fdopen(fd, "wb") as f, urllib.request.urlopen(
+                    uri, timeout=120, context=ctx
+                ) as resp:
+                    for chunk in iter(lambda: resp.read(1 << 20), b""):
+                        digest.update(chunk)
+                        f.write(chunk)
+                if pin and digest.hexdigest() != pin:
+                    raise ValueError(
+                        f"artifact {uri} digest mismatch: expected "
+                        f"{pin}, got {digest.hexdigest()}"
+                    )
+            except BaseException:
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+                raise
+            if pin:
+                os.replace(tmp, os.path.join(cache_dir, pin))
+                staged.append((entry, os.path.join(cache_dir, pin)))
+            else:
+                staged.append((entry, tmp))
+    except BaseException:
+        discard_staged(staged)
+        raise
+    return staged
+
+
+def discard_staged(staged: List[Tuple[dict, str]]) -> None:
+    """Remove unpinned temp files that were never consumed by
+    install_uris (launch aborted between stage and install) — churny
+    relaunches must not fill the agent's disk with orphans.  Pinned
+    entries live in the cache by design and are kept."""
+    for entry, path in staged:
+        if entry.get("sha256"):
+            continue
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+
+
+def install_uris(
+    sandbox: str, staged: List[Tuple[dict, str]]
+) -> None:
+    """Place staged artifacts into the sandbox: copy to dest
+    (traversal-safe), optional +x, optional tar extraction (member
+    paths validated — a hostile archive must not escape).  Unpinned
+    temp files are consumed."""
+    import shutil
+    import tarfile
+
+    for entry, source in staged:
+        dest = entry.get("dest") or \
+            entry["uri"].rstrip("/").rsplit("/", 1)[-1].split("?")[0]
+        path = _sandbox_path(sandbox, dest, "artifact")
+        os.makedirs(os.path.dirname(path) or sandbox, exist_ok=True)
+        pinned = bool(entry.get("sha256"))
+        if pinned:
+            shutil.copyfile(source, path)  # cache entry stays
+        else:
+            os.replace(source, path)
+        if entry.get("executable"):
+            os.chmod(path, os.stat(path).st_mode | 0o755)
+        if entry.get("extract"):
+            target_dir = os.path.dirname(path) or sandbox
+            with tarfile.open(path) as tar:
+                for member in tar.getmembers():
+                    member_path = os.path.normpath(
+                        os.path.join(target_dir, member.name)
+                    )
+                    root = os.path.normpath(sandbox)
+                    # './' members (tar -C dir .) normalize to the
+                    # root itself — benign, allowed
+                    if member_path != root and \
+                            not member_path.startswith(root + os.sep):
+                        raise ValueError(
+                            f"archive member escapes the sandbox: "
+                            f"{member.name}"
+                        )
+                    if member.issym() or member.islnk():
+                        raise ValueError(
+                            f"archive member is a link: {member.name}"
+                        )
+                try:
+                    tar.extractall(target_dir, filter="data")
+                except TypeError:  # pre-3.12: manual checks above apply
+                    tar.extractall(target_dir)
+
+
 def write_templates(sandbox: str, rendered: List[Tuple[str, str]]) -> None:
     """Write rendered templates, confined to the sandbox: ``dest`` is
     remote-controlled (launch request), so absolute paths and ``..``
@@ -353,6 +512,7 @@ class LocalProcessAgent:
         files: Optional[List[dict]] = None,
         secret_env: Optional[Dict[str, str]] = None,
         kill_grace_s: float = 5.0,
+        uris: Optional[List[dict]] = None,
     ) -> None:
         with self._lock:
             if info.task_id in self._tasks:
@@ -379,130 +539,165 @@ class LocalProcessAgent:
                     )
                 )
             return
-        with self._lock:
-            if info.task_id in self._tasks:
-                return  # raced with a duplicate launch
-            sandbox = os.path.join(self._workdir, info.name)
-            os.makedirs(sandbox, exist_ok=True)
-            try:
-                self._attach_volumes(sandbox, info)
-            except OSError as e:
-                self._pending.append(
-                    TaskStatus(
-                        task_id=info.task_id,
-                        state=TaskState.ERROR,
-                        message=f"volume provisioning failed: {e}",
-                        agent_id=info.agent_id,
-                    )
-                )
-                return
-            env = dict(os.environ)
-            env.update(info.env)
-            # secret env values ride the launch request only — merged
-            # here at exec time, never part of the persisted TaskInfo
-            env.update(secret_env or {})
-            env["SANDBOX"] = sandbox
-            try:
-                self._write_secure_files(sandbox, files)
-            except Exception as e:
-                self._pending.append(
-                    TaskStatus(
-                        task_id=info.task_id,
-                        state=TaskState.ERROR,
-                        message=f"secure file provisioning failed: {e}",
-                        agent_id=info.agent_id,
-                    )
-                )
-                return
-            try:
-                write_templates(sandbox, rendered)
-            except Exception as e:
-                self._pending.append(
-                    TaskStatus(
-                        task_id=info.task_id,
-                        state=TaskState.ERROR,
-                        message=f"config template render failed: {e}",
-                        agent_id=info.agent_id,
-                    )
-                )
-                return
-            # durable pre-launch record: a restarted agent rebuilds its
-            # task table from these (+ the supervisor's exit_status)
-            from dcos_commons_tpu.agent.daemon import serialize_check
-
-            native_exe = ""
-            if self._use_native:
-                from dcos_commons_tpu.native import task_exec_path
-
-                native_exe = task_exec_path()
-            try:
-                # lifecycle records are per INCARNATION: a dying
-                # predecessor's exit record must never shadow the new
-                # launch.  Delivered (.done) records of other
-                # incarnations are pruned here.
-                record_dir = os.path.join(sandbox, ".super", info.task_id)
-                os.makedirs(record_dir, exist_ok=True)
-                self._prune_delivered_records(sandbox, keep=info.task_id)
-                if native_exe:
-                    process = subprocess.Popen(
-                        [
-                            native_exe,
-                            "--sandbox", sandbox,
-                            "--record-dir", record_dir,
-                            "--grace", str(kill_grace_s),
-                            "--", info.command,
-                        ],
-                        env=env,
-                        start_new_session=True,
-                    )
-                else:
-                    process = subprocess.Popen(
-                        ["/bin/sh", "-c", info.command],
-                        cwd=sandbox,
-                        env=env,
-                        stdout=open(os.path.join(sandbox, "stdout"), "ab"),
-                        stderr=open(os.path.join(sandbox, "stderr"), "ab"),
-                        start_new_session=True,
-                    )
-            except OSError as e:
-                self._pending.append(
-                    TaskStatus(
-                        task_id=info.task_id,
-                        state=TaskState.ERROR,
-                        message=f"launch failed: {e}",
-                        agent_id=info.agent_id,
-                    )
-                )
-                return
-            # the durable record is best-effort: a failed write only
-            # degrades RESTART recovery — the process is running and
-            # must be tracked regardless, or it leaks untracked
-            pid_identity = _proc_identity(process.pid)
-            try:
-                record = {
-                    "info": info.to_dict(),
-                    "pid": process.pid,
-                    "pid_identity": pid_identity,
-                    "native": bool(native_exe),
-                    "readiness": serialize_check(readiness),
-                    "health": serialize_check(health),
-                }
-                with open(os.path.join(record_dir, "task.json"), "w") as f:
-                    json.dump(record, f)
-            except OSError:
-                pass
-            self._tasks[info.task_id] = _Running(
-                info=info,
-                process=process,
-                sandbox=sandbox,
-                readiness=readiness,
-                health=health,
-                started_at=time.monotonic(),
-                pid=process.pid,
-                pid_identity=pid_identity,
-                native=bool(native_exe),
-                record_dir=record_dir,
+        # artifact downloads too — network work stays off the lock
+        try:
+            staged_uris = stage_uris(
+                uris,
+                cache_dir=os.path.join(self._workdir, ".uri-cache"),
+                ca_file=self._ca_file,
             )
+        except Exception as e:
+            with self._lock:
+                self._pending.append(
+                    TaskStatus(
+                        task_id=info.task_id,
+                        state=TaskState.ERROR,
+                        message=f"artifact fetch failed: {e}",
+                        agent_id=info.agent_id,
+                    )
+                )
+            return
+        try:
+            with self._lock:
+                if info.task_id in self._tasks:
+                    return  # raced with a duplicate launch
+                sandbox = os.path.join(self._workdir, info.name)
+                os.makedirs(sandbox, exist_ok=True)
+                try:
+                    self._attach_volumes(sandbox, info)
+                except OSError as e:
+                    self._pending.append(
+                        TaskStatus(
+                            task_id=info.task_id,
+                            state=TaskState.ERROR,
+                            message=f"volume provisioning failed: {e}",
+                            agent_id=info.agent_id,
+                        )
+                    )
+                    return
+                env = dict(os.environ)
+                env.update(info.env)
+                # secret env values ride the launch request only — merged
+                # here at exec time, never part of the persisted TaskInfo
+                env.update(secret_env or {})
+                env["SANDBOX"] = sandbox
+                try:
+                    self._write_secure_files(sandbox, files)
+                except Exception as e:
+                    self._pending.append(
+                        TaskStatus(
+                            task_id=info.task_id,
+                            state=TaskState.ERROR,
+                            message=f"secure file provisioning failed: {e}",
+                            agent_id=info.agent_id,
+                        )
+                    )
+                    return
+                try:
+                    write_templates(sandbox, rendered)
+                except Exception as e:
+                    self._pending.append(
+                        TaskStatus(
+                            task_id=info.task_id,
+                            state=TaskState.ERROR,
+                            message=f"config template render failed: {e}",
+                            agent_id=info.agent_id,
+                        )
+                    )
+                    return
+                try:
+                    install_uris(sandbox, staged_uris)
+                except Exception as e:
+                    self._pending.append(
+                        TaskStatus(
+                            task_id=info.task_id,
+                            state=TaskState.ERROR,
+                            message=f"artifact install failed: {e}",
+                            agent_id=info.agent_id,
+                        )
+                    )
+                    return
+                # durable pre-launch record: a restarted agent rebuilds its
+                # task table from these (+ the supervisor's exit_status)
+                from dcos_commons_tpu.agent.daemon import serialize_check
+
+                native_exe = ""
+                if self._use_native:
+                    from dcos_commons_tpu.native import task_exec_path
+
+                    native_exe = task_exec_path()
+                try:
+                    # lifecycle records are per INCARNATION: a dying
+                    # predecessor's exit record must never shadow the new
+                    # launch.  Delivered (.done) records of other
+                    # incarnations are pruned here.
+                    record_dir = os.path.join(sandbox, ".super", info.task_id)
+                    os.makedirs(record_dir, exist_ok=True)
+                    self._prune_delivered_records(sandbox, keep=info.task_id)
+                    if native_exe:
+                        process = subprocess.Popen(
+                            [
+                                native_exe,
+                                "--sandbox", sandbox,
+                                "--record-dir", record_dir,
+                                "--grace", str(kill_grace_s),
+                                "--", info.command,
+                            ],
+                            env=env,
+                            start_new_session=True,
+                        )
+                    else:
+                        process = subprocess.Popen(
+                            ["/bin/sh", "-c", info.command],
+                            cwd=sandbox,
+                            env=env,
+                            stdout=open(os.path.join(sandbox, "stdout"), "ab"),
+                            stderr=open(os.path.join(sandbox, "stderr"), "ab"),
+                            start_new_session=True,
+                        )
+                except OSError as e:
+                    self._pending.append(
+                        TaskStatus(
+                            task_id=info.task_id,
+                            state=TaskState.ERROR,
+                            message=f"launch failed: {e}",
+                            agent_id=info.agent_id,
+                        )
+                    )
+                    return
+                # the durable record is best-effort: a failed write only
+                # degrades RESTART recovery — the process is running and
+                # must be tracked regardless, or it leaks untracked
+                pid_identity = _proc_identity(process.pid)
+                try:
+                    record = {
+                        "info": info.to_dict(),
+                        "pid": process.pid,
+                        "pid_identity": pid_identity,
+                        "native": bool(native_exe),
+                        "readiness": serialize_check(readiness),
+                        "health": serialize_check(health),
+                    }
+                    with open(os.path.join(record_dir, "task.json"), "w") as f:
+                        json.dump(record, f)
+                except OSError:
+                    pass
+                self._tasks[info.task_id] = _Running(
+                    info=info,
+                    process=process,
+                    sandbox=sandbox,
+                    readiness=readiness,
+                    health=health,
+                    started_at=time.monotonic(),
+                    pid=process.pid,
+                    pid_identity=pid_identity,
+                    native=bool(native_exe),
+                    record_dir=record_dir,
+                )
+        finally:
+            # unpinned staged artifacts not consumed by install_uris
+            # (any aborted launch path above) must not pile up on disk
+            discard_staged(staged_uris)
 
     def _prune_delivered_records(self, sandbox: str, keep: str) -> None:
         import shutil as _shutil

@@ -84,11 +84,16 @@ class RemoteAgentClient:
         # (agent/local.py prepare_templates); size the RPC timeout to
         # the request or a false timeout here double-books the task
         n_templates = sum(len(e.get("templates") or []) for e in entries)
+        # artifact downloads can be big (corpus/tokenizer staging);
+        # digest-cached relaunches return fast but the first fetch
+        # must not be declared dead mid-download
+        n_uris = sum(len(e.get("uris") or []) for e in entries)
         return self._request(
             "POST",
             "/v1/agent/launch",
             {"tasks": entries},
-            timeout_s=self.launch_timeout_s + 12.0 * n_templates,
+            timeout_s=self.launch_timeout_s + 12.0 * n_templates
+            + 130.0 * n_uris,
         )["launched"]
 
     def kill(self, task_id: str, grace_period_s: float) -> None:
@@ -209,6 +214,7 @@ class RemoteFleet(Agent):
         files: Optional[List[dict]] = None,
         secret_env: Optional[Dict[str, str]] = None,
         kill_grace_s: float = 5.0,
+        uris: Optional[List[dict]] = None,
     ) -> None:
         client = self._clients.get(info.agent_id)
         if client is None:
@@ -222,6 +228,7 @@ class RemoteFleet(Agent):
             "files": files or [],
             "secret_env": secret_env or {},
             "kill_grace_s": kill_grace_s,
+            "uris": uris or [],
         }
         try:
             client.launch([entry])

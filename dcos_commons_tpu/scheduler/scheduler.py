@@ -341,6 +341,21 @@ class DefaultScheduler:
                 kwargs = {}
                 if files or secret_env:
                     kwargs = {"files": files, "secret_env": secret_env}
+                if task_spec.uris:
+                    # artifact entries ride the launch request; the
+                    # agent fetches before the command runs (reference:
+                    # Mesos fetcher on TaskInfo URIs,
+                    # YAMLToInternalMappers.java:397)
+                    kwargs["uris"] = [
+                        {
+                            "uri": u.uri,
+                            "dest": u.effective_dest(),
+                            "sha256": u.sha256,
+                            "extract": u.extract,
+                            "executable": u.executable,
+                        }
+                        for u in task_spec.uris
+                    ]
                 launch_one(
                     info,
                     readiness=None if paused else task_spec.readiness_check,

@@ -24,6 +24,7 @@ from dcos_commons_tpu.specification.specs import (
     SpecError,
     TaskSpec,
     TpuSpec,
+    UriSpec,
     VolumeSpec,
 )
 from dcos_commons_tpu.specification.yaml_spec import (
@@ -50,6 +51,7 @@ __all__ = [
     "SpecError",
     "TaskSpec",
     "TpuSpec",
+    "UriSpec",
     "VolumeSpec",
     "default_validators",
     "from_yaml",

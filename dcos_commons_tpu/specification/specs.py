@@ -161,6 +161,35 @@ class TransportEncryptionSpec:
 
 
 @dataclass(frozen=True)
+class UriSpec:
+    """A sandbox artifact the agent downloads before launch.
+
+    Reference: the ``uris:`` list in service YAML
+    (frameworks/helloworld/src/main/dist/uri.yml:8,37), mapped at
+    specification/yaml/YAMLToInternalMappers.java:397 and fetched by
+    the Mesos fetcher before the task command runs.  TPU additions
+    over the reference: ``sha256`` pins the artifact (a corpus or
+    tokenizer staged per host must be the bytes the operator vetted,
+    and pinning enables the per-host cache), ``extract`` unpacks
+    tar archives, ``executable`` sets +x.
+    """
+
+    uri: str
+    dest: str = ""            # sandbox-relative; default: URI basename
+    sha256: str = ""          # hex digest pin; also the cache key
+    extract: bool = False     # tar/tgz: unpack into dirname(dest)
+    executable: bool = False
+
+    def effective_dest(self) -> str:
+        if self.dest:
+            return self.dest
+        name = self.uri.rstrip("/").rsplit("/", 1)[-1].split("?")[0]
+        if not name:
+            raise SpecError(f"cannot derive a dest from uri {self.uri!r}")
+        return name
+
+
+@dataclass(frozen=True)
 class TaskSpec:
     """Reference: specification/TaskSpec.java."""
 
@@ -178,6 +207,9 @@ class TaskSpec:
     kill_grace_period_s: float = 3.0
     essential: bool = True           # reference: TaskSpec.isEssential
     transport_encryption: Tuple[TransportEncryptionSpec, ...] = ()
+    # sandbox artifacts fetched before launch (pod-level uris merge in
+    # here, task-level declarations winning on dest clashes)
+    uris: Tuple[UriSpec, ...] = ()
 
     def __post_init__(self) -> None:
         if isinstance(self.goal, str):
@@ -202,6 +234,7 @@ class PodSpec:
     networks: Tuple[str, ...] = ()
     placement: str = ""              # placement DSL (offer/placement.py)
     volumes: Tuple[VolumeSpec, ...] = ()   # pod-level shared volumes
+    uris: Tuple[UriSpec, ...] = ()   # pod-level artifacts (all tasks)
     pre_reserved_role: str = ""
     allow_decommission: bool = False
     share_pid_namespace: bool = False
@@ -311,17 +344,44 @@ def merge_pod_volumes(tasks, pod_volumes):
     )
 
 
+def merge_pod_uris(tasks, pod_uris):
+    """Pod-level ``uris:`` apply to every task of the pod (reference:
+    YAMLToInternalMappers.java:397 builder.uris(podUris)); task-level
+    declarations win on dest clashes.  Applied by BOTH the YAML mapper
+    and from_dict so stored configs normalize identically."""
+    import dataclasses as _dc
+
+    if not pod_uris:
+        return tuple(tasks)
+    return tuple(
+        _dc.replace(
+            t,
+            uris=tuple(
+                u for u in pod_uris
+                if u.effective_dest() not in {
+                    tu.effective_dest() for tu in t.uris
+                }
+            ) + t.uris,
+        )
+        for t in tasks
+    )
+
+
 def _decode_pod(data: Dict[str, Any]) -> PodSpec:
     tpu = data.get("tpu")
     pod_volumes = tuple(
         VolumeSpec(**_vol(v)) for v in data.get("volumes", [])
     )
+    pod_uris = tuple(UriSpec(**u) for u in data.get("uris", []))
     return PodSpec(
         type=data["type"],
         count=data.get("count", 1),
-        tasks=merge_pod_volumes(
-            tuple(_decode_task(t) for t in data.get("tasks", [])),
-            pod_volumes,
+        tasks=merge_pod_uris(
+            merge_pod_volumes(
+                tuple(_decode_task(t) for t in data.get("tasks", [])),
+                pod_volumes,
+            ),
+            pod_uris,
         ),
         tpu=TpuSpec(**tpu) if tpu else None,
         gang=data.get("gang", False),
@@ -329,6 +389,7 @@ def _decode_pod(data: Dict[str, Any]) -> PodSpec:
         networks=tuple(data.get("networks", ())),
         placement=data.get("placement", ""),
         volumes=pod_volumes,
+        uris=pod_uris,
         pre_reserved_role=data.get("pre_reserved_role", ""),
         allow_decommission=data.get("allow_decommission", False),
         share_pid_namespace=data.get("share_pid_namespace", False),
@@ -371,6 +432,7 @@ def _decode_task(data: Dict[str, Any]) -> TaskSpec:
             TransportEncryptionSpec(**t)
             for t in data.get("transport_encryption", [])
         ),
+        uris=tuple(UriSpec(**u) for u in data.get("uris", [])),
     )
 
 

