@@ -229,14 +229,37 @@ class ApiServer:
                     ctype = self.headers.get("Content-Type", "")
                     upgrade = (query.get("upgrade") or ["false"])[0] \
                         .lower() in ("1", "true", "yes")
+                    # operator options ride a header (the body is the
+                    # tarball): base64 of the Cosmos-style options JSON
+                    options = None
+                    opts_header = self.headers.get("X-Service-Options")
+                    if opts_header:
+                        import base64 as _b64
+
+                        try:
+                            options = json.loads(
+                                _b64.b64decode(opts_header)
+                            )
+                        except (ValueError, TypeError) as e:
+                            return 400, {
+                                "message": f"bad X-Service-Options: {e}"
+                            }
                     try:
                         if "gzip" in ctype or body[:2] == b"\x1f\x8b":
                             multi_scheduler.install_package(
-                                name, body, upgrade=upgrade
+                                name, body, upgrade=upgrade,
+                                options=options,
                             )
                             return 200, {
                                 "message": f"package {name} "
                                 f"{'upgraded' if upgrade else 'installed'}"
+                            }
+                        if options is not None:
+                            # silently ignoring operator options would
+                            # contradict the plane's whole point
+                            return 400, {
+                                "message": "options apply to package "
+                                           "installs (gzip body) only",
                             }
                         from dcos_commons_tpu.specification.yaml_spec import (
                             from_yaml,

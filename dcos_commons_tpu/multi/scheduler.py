@@ -136,14 +136,20 @@ class MultiServiceScheduler:
             else:
                 self._services[name] = self._build(spec)
 
-    def add_service(self, spec: ServiceSpec) -> None:
+    def add_service(self, spec: ServiceSpec,
+                    options: Optional[dict] = None) -> None:
         with self._lock:
             if spec.name in self._services:
                 raise ValueError(f"service {spec.name!r} already exists")
             # build BEFORE persisting: a spec that cannot build must
-            # not be stored, or _reload poisons every restart
+            # not be stored, or _reload poisons every restart.  ONE
+            # store: options must never be persisted separately from
+            # the spec they rendered (a crash between two stores would
+            # silently drop them).
             built = self._build(spec)
-            self.service_store.store(spec.name, spec.to_dict())
+            self.service_store.store(
+                spec.name, spec.to_dict(), options=options
+            )
             self._services[spec.name] = built
 
     @property
@@ -163,7 +169,8 @@ class MultiServiceScheduler:
                 )
 
     def install_package(
-        self, name: str, payload: bytes, upgrade: bool = False
+        self, name: str, payload: bytes, upgrade: bool = False,
+        options: Optional[dict] = None,
     ) -> None:
         """Install a framework package tarball (the Cosmos flow): the
         bundle is extracted into this scheduler's packages dir, its
@@ -217,8 +224,52 @@ class MultiServiceScheduler:
             _shutil.rmtree(staging, ignore_errors=True)
             try:
                 manifest = extract_package(payload, staging)
+                # the Cosmos options plane: validate the operator's
+                # options against the NEW package's options.json and
+                # render them to env for the YAML interpolation.
+                # Upgrades keep prior options and overlay new ones
+                # (`dcos package update` semantics).
+                from dcos_commons_tpu.tools.options import (
+                    OptionsError,
+                    load_schema,
+                    merge_options,
+                    prune_unknown,
+                    render_options,
+                )
+
+                try:
+                    schema = load_schema(staging)
+                except OptionsError as e:
+                    raise SpecError(
+                        "options rejected: " + "; ".join(e.errors)
+                    )
+                prior_options = {}
+                if existing is not None:
+                    prior_entry = self.service_store.fetch(name) or {}
+                    prior_options = prior_entry.get("options") or {}
+                    # a new package version may DROP options: stored
+                    # values for them must not brick every future
+                    # upgrade (freshly-passed unknowns below still
+                    # reject — there, unknown = typo)
+                    prior_options, dropped = prune_unknown(
+                        schema, prior_options
+                    )
+                    if dropped:
+                        LOG.warning(
+                            "%s: dropping stored options the new "
+                            "package no longer defines: %s",
+                            name, ", ".join(dropped),
+                        )
+                effective_options = merge_options(prior_options, options)
+                try:
+                    options_env = render_options(schema, effective_options)
+                except OptionsError as e:
+                    raise SpecError(
+                        "options rejected: " + "; ".join(e.errors)
+                    )
+                render_env = {**_os.environ, **options_env}
                 spec = from_yaml_file(
-                    _os.path.join(staging, "svc.yml"), env=dict(_os.environ)
+                    _os.path.join(staging, "svc.yml"), env=render_env
                 )
                 if spec.name != name:
                     raise SpecError(
@@ -245,7 +296,7 @@ class MultiServiceScheduler:
                 _shutil.rmtree(staging, ignore_errors=True)
             # re-anchor template paths in the final location
             spec = from_yaml_file(
-                _os.path.join(target, "svc.yml"), env=dict(_os.environ)
+                _os.path.join(target, "svc.yml"), env=render_env
             )
             if existing is not None:
                 # rebuild over the SAME namespace/state: the builder's
@@ -255,7 +306,9 @@ class MultiServiceScheduler:
                 # persisting a spec that cannot build would poison
                 # every restart's _reload
                 rebuilt = self._build(spec)
-                self.service_store.store(name, spec.to_dict())
+                self.service_store.store(
+                    name, spec.to_dict(), options=effective_options
+                )
                 self._services[name] = rebuilt
                 # prune superseded version dirs: repeated upgrades
                 # otherwise grow state_dir without bound.  Keep the new
@@ -285,7 +338,7 @@ class MultiServiceScheduler:
                         ignore_errors=True,
                     )
             else:
-                self.add_service(spec)
+                self.add_service(spec, options=effective_options)
 
     def uninstall_service(self, name: str) -> None:
         """Flip the service to teardown; it is dropped from the set

@@ -24,9 +24,18 @@ class ServiceStore:
         validate_key(name, "service name")
         return f"{ROOT}/{name}"
 
-    def store(self, name: str, spec_dict: dict, uninstalling: bool = False) -> None:
+    def store(self, name: str, spec_dict: dict, uninstalling: bool = False,
+              options: Optional[dict] = None) -> None:
+        # options = the operator's raw user-options JSON (the Cosmos
+        # plane): kept so upgrades re-render with prior choices when
+        # none are passed, exactly like `dcos package update`
         payload = json.dumps(
-            {"spec": spec_dict, "uninstalling": uninstalling}, sort_keys=True
+            {
+                "spec": spec_dict,
+                "uninstalling": uninstalling,
+                "options": options or {},
+            },
+            sort_keys=True,
         ).encode("utf-8")
         self._persister.set(self._path(name), payload)
 

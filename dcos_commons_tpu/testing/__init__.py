@@ -30,7 +30,11 @@ def drive_until(scheduler, predicate, timeout_s: float = 30.0,
             return True
         _time.sleep(interval_s)
     return False
-from dcos_commons_tpu.testing.runner import ServiceTestRunner, SimulationWorld
+from dcos_commons_tpu.testing.runner import (
+    ServiceTestRunner,
+    SimulationWorld,
+    cosmos_render,
+)
 from dcos_commons_tpu.testing.ticks import (
     AddHost,
     AdvanceCycles,
@@ -70,6 +74,7 @@ __all__ = [
     "FakeAgent",
     "drive_until",
     "ServiceTestRunner",
+    "cosmos_render",
     "SimulationWorld",
     "SimulationTick",
     "Send",

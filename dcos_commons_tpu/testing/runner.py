@@ -48,6 +48,26 @@ class SimulationWorld:
         return self.agent.kills[self.kill_watermark:]
 
 
+def cosmos_render(
+    framework_dir: str,
+    options: Optional[Dict] = None,
+) -> Dict[str, str]:
+    """CosmosRenderer analogue (sdk/testing/.../CosmosRenderer.java:24):
+    render a framework's options.json defaults + user options into the
+    env map its svc.yml interpolates, exactly as a package install
+    would.  ServiceTest-style tests run from package options::
+
+        env = cosmos_render("frameworks/helloworld",
+                            {"world": {"count": 3}})
+        runner = ServiceTestRunner(yaml_text, env=env)
+
+    Raises tools.options.OptionsError on bad options — so a test can
+    also assert that an invalid option set is rejected."""
+    from dcos_commons_tpu.tools.options import load_schema, render_options
+
+    return render_options(load_schema(framework_dir), options)
+
+
 class ServiceTestRunner:
     """Builds a scheduler from YAML/spec over a (shared) persister and
     runs scripted ticks against it synchronously."""

@@ -37,6 +37,15 @@ def build_package(
     svc = os.path.join(framework_dir, "svc.yml")
     if not os.path.isfile(svc):
         raise PackageError(f"{framework_dir} has no svc.yml")
+    # a package with a self-inconsistent options schema must never
+    # ship (reference: config.json is validated by universe tooling)
+    from dcos_commons_tpu.tools.options import options_findings
+
+    schema_findings = options_findings(framework_dir)
+    if schema_findings:
+        raise PackageError(
+            "options.json is inconsistent: " + "; ".join(schema_findings)
+        )
     if not name:
         name = os.path.basename(framework_dir.rstrip(os.sep))
     # read each file ONCE: content and digest must come from the same
@@ -239,6 +248,12 @@ def main(argv: Optional[list] = None) -> int:
              "(Cosmos `update --package-version` analogue): validated "
              "config diff, rolling update over live state",
     )
+    p.add_argument(
+        "--options", default="",
+        help="user options JSON file validated against the package's "
+             "options.json (Cosmos `--options` analogue); on upgrade, "
+             "prior options are kept and these overlay them",
+    )
     args = parser.parse_args(argv)
 
     try:
@@ -269,23 +284,42 @@ def _run_verb(args) -> int:
         return 0
     if args.verb == "lint":
         findings = lint_airgap(args.framework_dir)
+        # the options schema lints with the same verb: a package whose
+        # defaults violate their own constraints must not ship
+        from dcos_commons_tpu.tools.options import options_findings
+
+        findings += options_findings(args.framework_dir)
         for finding in findings:
             print(finding)
         if findings:
-            print(f"{len(findings)} air-gap finding(s)", file=sys.stderr)
+            print(f"{len(findings)} finding(s)", file=sys.stderr)
             return 1
-        print("air-gap clean")
+        print("lint clean")
         return 0
     # install: the tarball travels to the scheduler (Cosmos analogue)
     with open(args.package, "rb") as f:
         payload = f.read()
     name = args.name or read_manifest(args.package)["name"]
     suffix = "?upgrade=true" if getattr(args, "upgrade", False) else ""
+    headers = {"Content-Type": "application/gzip"}
+    if getattr(args, "options", ""):
+        import base64 as _b64
+
+        with open(args.options, "r", encoding="utf-8") as f:
+            try:
+                options = json.load(f)
+            except ValueError as e:
+                print(f"bad options file {args.options}: {e}",
+                      file=sys.stderr)
+                return 1
+        headers["X-Service-Options"] = _b64.b64encode(
+            json.dumps(options).encode("utf-8")
+        ).decode("ascii")
     req = urllib.request.Request(
         f"{args.url.rstrip('/')}/v1/multi/{name}{suffix}",
         data=payload,
         method="PUT",
-        headers={"Content-Type": "application/gzip"},
+        headers=headers,
     )
     try:
         with urllib.request.urlopen(req, timeout=30) as resp:

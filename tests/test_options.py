@@ -1,0 +1,465 @@
+"""Per-package options schema: the config.json / Cosmos plane.
+
+Reference: frameworks/helloworld/universe/config.json (typed operator
+options with defaults/enums/constraints), rendered by Cosmos into
+scheduler env, faked in tests by CosmosRenderer
+(sdk/testing/.../CosmosRenderer.java:24).  Here: options.json beside
+svc.yml; `package install --options` validates + renders; the sim
+harness's cosmos_render drives ServiceTest-style flows from options;
+`package build`/`lint` refuse a self-inconsistent schema.
+"""
+
+import json
+import os
+import time
+
+import pytest
+
+from dcos_commons_tpu.tools.options import (
+    OptionsError,
+    default_env_name,
+    load_schema,
+    merge_options,
+    render_options,
+    validate_schema,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SCHEMA = {
+    "properties": {
+        "hello": {
+            "properties": {
+                "count": {"type": "integer", "default": 2, "minimum": 1,
+                          "env": "HELLO_COUNT"},
+                "mode": {"type": "string", "default": "blue",
+                         "enum": ["blue", "green"]},
+                "rate": {"type": "number", "default": 1.5,
+                         "maximum": 10},
+                "debug": {"type": "boolean", "default": False},
+            },
+        },
+        "auth": {
+            "properties": {
+                "token": {"type": "string", "required": True},
+            },
+        },
+    },
+}
+
+
+def test_defaults_render_to_env():
+    env = render_options(SCHEMA, {"auth": {"token": "s3cret"}})
+    assert env == {
+        "HELLO_COUNT": "2",
+        "HELLO_MODE": "blue",
+        "HELLO_RATE": "1.5",
+        "HELLO_DEBUG": "false",
+        "AUTH_TOKEN": "s3cret",
+    }
+
+
+def test_overrides_and_bool_rendering():
+    env = render_options(SCHEMA, {
+        "hello": {"count": 5, "debug": True, "mode": "green"},
+        "auth": {"token": "t"},
+    })
+    assert env["HELLO_COUNT"] == "5"
+    assert env["HELLO_DEBUG"] == "true"
+    assert env["HELLO_MODE"] == "green"
+
+
+def test_pointed_errors_all_at_once():
+    """Every violation reported in one pass, each naming the option."""
+    with pytest.raises(OptionsError) as err:
+        render_options(SCHEMA, {
+            "hello": {"count": 0, "mode": "purple", "rate": 99,
+                      "debug": "yes", "typo_opt": 1},
+            "unknown_section": {"x": 1},
+            # auth.token missing (required)
+        })
+    text = "; ".join(err.value.errors)
+    assert "hello.count: 0 below minimum 1" in text
+    assert "hello.mode: 'purple' not one of ['blue', 'green']" in text
+    assert "hello.rate: 99 above maximum 10" in text
+    assert "hello.debug: expected boolean" in text
+    assert "no such option hello.typo_opt" in text
+    assert "no such options section 'unknown_section'" in text
+    assert "auth.token is required" in text
+
+
+def test_type_confusions_rejected():
+    with pytest.raises(OptionsError, match="expected integer"):
+        render_options(SCHEMA, {"hello": {"count": "3"},
+                                "auth": {"token": "t"}})
+    # bool is an int subclass in Python: must still be rejected
+    with pytest.raises(OptionsError, match="got boolean"):
+        render_options(SCHEMA, {"hello": {"count": True},
+                                "auth": {"token": "t"}})
+
+
+def test_no_schema_means_no_options():
+    assert render_options(None, None) == {}
+    with pytest.raises(OptionsError, match="ships no options.json"):
+        render_options(None, {"hello": {"count": 1}})
+
+
+def test_schema_self_validation():
+    assert validate_schema(SCHEMA) == []
+    bad = {
+        "properties": {
+            "s": {
+                "properties": {
+                    "no_default": {"type": "string"},
+                    "bad_type": {"type": "blob", "default": 1},
+                    "bad_default": {"type": "integer", "default": "x"},
+                    "bad_range": {"type": "integer", "default": 5,
+                                  "minimum": 9, "maximum": 3},
+                    "dup_env": {"type": "string", "default": "",
+                                "env": "S_NO_DEFAULT"},
+                },
+            },
+        },
+    }
+    findings = "; ".join(validate_schema(bad))
+    assert "s.no_default: needs a 'default'" in findings
+    assert "s.bad_type: type must be one of" in findings
+    assert "expected integer" in findings  # bad_default
+    assert "minimum > maximum" in findings
+    assert "collides" in findings
+
+
+def test_merge_options_per_section():
+    prior = {"hello": {"count": 5, "mode": "green"}, "auth": {"token": "t"}}
+    new = {"hello": {"count": 7}}
+    merged = merge_options(prior, new)
+    assert merged["hello"] == {"count": 7, "mode": "green"}
+    assert merged["auth"] == {"token": "t"}
+    assert prior["hello"]["count"] == 5  # no aliasing
+
+
+def test_prune_unknown_prior_options():
+    """A new package version that DROPS an option must not be bricked
+    by the stored value — pruned with the dropped list reported."""
+    from dcos_commons_tpu.tools.options import prune_unknown
+
+    kept, dropped = prune_unknown(SCHEMA, {
+        "hello": {"count": 3, "legacy_opt": "x"},
+        "gone_section": {"y": 1},
+        "auth": {"token": "t"},
+    })
+    assert kept == {"hello": {"count": 3}, "auth": {"token": "t"}}
+    assert dropped == ["gone_section.y", "hello.legacy_opt"]
+    kept, dropped = prune_unknown(None, {"a": {"b": 1}})
+    assert kept == {} and dropped == ["a.b"]
+
+
+def test_non_object_schema_is_a_finding_not_a_crash(tmp_path):
+    from dcos_commons_tpu.tools import PackageError, build_package
+    from dcos_commons_tpu.tools.options import options_findings
+
+    d = tmp_path / "fw"
+    d.mkdir()
+    (d / "svc.yml").write_text(
+        "name: fw\npods:\n  a:\n    count: 1\n    tasks:\n"
+        "      t:\n        goal: RUNNING\n        cmd: sleep 1\n"
+        "        cpus: 0.1\n        memory: 32\n"
+    )
+    (d / "options.json").write_text("[]")
+    findings = options_findings(str(d))
+    assert findings and "JSON object" in findings[0]
+    with pytest.raises(PackageError, match="JSON object"):
+        build_package(str(d), str(tmp_path / "fw.tgz"))
+
+
+def test_default_env_name():
+    assert default_env_name("hello-pod", "max.per_host") == \
+        "HELLO_POD_MAX_PER_HOST"
+
+
+def test_shipped_framework_schemas_are_clean():
+    """helloworld + jax ship schemas that lint clean and whose env
+    names actually appear in their svc.yml templates."""
+    for framework in ("helloworld", "jax"):
+        framework_dir = os.path.join(REPO, "frameworks", framework)
+        schema = load_schema(framework_dir)
+        assert schema is not None, f"{framework} ships no options.json"
+        assert validate_schema(schema) == [], framework
+        env = render_options(schema, {})
+        with open(os.path.join(framework_dir, "svc.yml")) as f:
+            yaml_text = f.read()
+        for env_name in env:
+            assert f"{{{{{env_name}" in yaml_text, (
+                f"{framework} option env {env_name} unused in svc.yml"
+            )
+
+
+def test_cosmos_render_drives_sim_harness():
+    """ServiceTest-style flow from package options: world.count=3
+    deploys three world pods (reference: CosmosRenderer + ServiceTest
+    option-bump flows)."""
+    from dcos_commons_tpu.testing import (
+        AdvanceCycles,
+        ExpectLaunchedTasks,
+        SendTaskRunning,
+        ServiceTestRunner,
+        cosmos_render,
+    )
+
+    framework_dir = os.path.join(REPO, "frameworks", "helloworld")
+    env = cosmos_render(framework_dir, {"world": {"count": 3}})
+    assert env["WORLD_COUNT"] == "3"
+    with open(os.path.join(framework_dir, "svc.yml")) as f:
+        runner = ServiceTestRunner(f.read(), env=env)
+    runner.run([
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("hello-0-server"),
+        SendTaskRunning("hello-0-server"),
+        AdvanceCycles(1),
+        SendTaskRunning("world-0-server"),
+        AdvanceCycles(1),
+        SendTaskRunning("world-1-server"),
+        AdvanceCycles(2),
+    ])
+    # the third world pod exists ONLY because the rendered option said
+    # count=3 (the YAML default is 2)
+    assert runner.world.agent.task_id_of("world-2-server") is not None
+    # and bad options are rejected with a pointed error
+    with pytest.raises(OptionsError, match="world.count: 0 below minimum"):
+        cosmos_render(framework_dir, {"world": {"count": 0}})
+
+
+def test_package_build_and_lint_refuse_bad_schema(tmp_path):
+    from dcos_commons_tpu.tools import PackageError, build_package
+    from dcos_commons_tpu.tools.packaging import main as package_main
+
+    d = tmp_path / "fw"
+    d.mkdir()
+    (d / "svc.yml").write_text(
+        "name: fw\npods:\n  a:\n    count: 1\n    tasks:\n"
+        "      t:\n        goal: RUNNING\n        cmd: sleep 1\n"
+        "        cpus: 0.1\n        memory: 32\n"
+    )
+    (d / "options.json").write_text(json.dumps({
+        "properties": {
+            "a": {"properties": {
+                "count": {"type": "integer", "default": "oops"},
+            }},
+        },
+    }))
+    with pytest.raises(PackageError, match="options.json is inconsistent"):
+        build_package(str(d), str(tmp_path / "fw.tgz"))
+    assert package_main(["lint", str(d)]) == 1
+    # fix the schema: build + lint pass
+    (d / "options.json").write_text(json.dumps({
+        "properties": {
+            "a": {"properties": {
+                "count": {"type": "integer", "default": 1, "minimum": 1},
+            }},
+        },
+    }))
+    build_package(str(d), str(tmp_path / "fw.tgz"))
+    assert package_main(["lint", str(d)]) == 0
+
+
+def _drive_install(multi, agent, name, count):
+    deadline = time.monotonic() + 20
+    from dcos_commons_tpu.common import TaskState, TaskStatus
+
+    while time.monotonic() < deadline:
+        multi.run_cycle()
+        for i in range(count):
+            task_id = agent.task_id_of(f"app-{i}-main")
+            if task_id is not None and task_id in agent.active_task_ids():
+                agent.send(TaskStatus(
+                    task_id=task_id, state=TaskState.RUNNING, ready=True,
+                ))
+        svc = multi.get_service(name)
+        plans = svc.plans()
+        rollout = plans.get("update") or plans.get("deploy")
+        if rollout.is_complete:
+            return svc
+    raise AssertionError("rollout did not complete")
+
+
+def test_cli_install_with_options_through_served_scheduler(tmp_path):
+    """`package install --options file.json` end to end: the options
+    ride the X-Service-Options header, the served multi scheduler
+    validates + renders them, and a bad options file is refused with
+    the pointed error on stderr."""
+    import subprocess
+    import sys
+    import urllib.request
+
+    d = tmp_path / "optsvc"
+    d.mkdir()
+    (d / "svc.yml").write_text(
+        "name: optsvc\npods:\n  app:\n    count: {{APP_COUNT:-1}}\n"
+        "    tasks:\n      main:\n        goal: RUNNING\n"
+        "        cmd: \"sleep 100\"\n"
+        "        cpus: 0.1\n        memory: 32\n"
+    )
+    (d / "options.json").write_text(json.dumps({
+        "properties": {
+            "app": {"properties": {
+                "count": {"type": "integer", "default": 1, "minimum": 1,
+                          "maximum": 4, "env": "APP_COUNT"},
+            }},
+        },
+    }))
+    out = str(tmp_path / "optsvc.tgz")
+    from dcos_commons_tpu.tools import build_package
+
+    build_package(str(d), out)
+    topology = tmp_path / "topology.yml"
+    topology.write_text(
+        "hosts:\n  - host_id: h0\n    cpus: 8\n    memory_mb: 8192\n"
+    )
+    announce = tmp_path / "announce"
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "dcos_commons_tpu", "serve", "--multi",
+            "--topology", str(topology),
+            "--port", "0",
+            "--state-dir", str(tmp_path / "state"),
+            "--sandbox-root", str(tmp_path / "sbx"),
+            "--announce-file", str(announce),
+        ],
+        cwd=REPO,
+    )
+    try:
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not announce.exists():
+            time.sleep(0.1)
+        url = announce.read_text().strip()
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps({"app": {"count": 9}}))
+        refused = subprocess.run(
+            [sys.executable, "-m", "dcos_commons_tpu", "package",
+             "install", out, "--url", url, "--options", str(bad)],
+            cwd=REPO, capture_output=True, text=True, timeout=60,
+        )
+        assert refused.returncode == 1
+        assert "app.count: 9 above maximum 4" in refused.stderr
+        good = tmp_path / "good.json"
+        good.write_text(json.dumps({"app": {"count": 2}}))
+        installed = subprocess.run(
+            [sys.executable, "-m", "dcos_commons_tpu", "package",
+             "install", out, "--url", url, "--options", str(good)],
+            cwd=REPO, capture_output=True, text=True, timeout=60,
+        )
+        assert installed.returncode == 0, installed.stderr
+
+        def get(path):
+            with urllib.request.urlopen(url + path, timeout=5) as r:
+                return json.loads(r.read())
+
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            try:
+                pods = get("/v1/multi/optsvc/v1/pod/status")["pods"]
+                tasks = [
+                    t for p in pods for i in p["instances"]
+                    for t in i["tasks"]
+                ]
+                if len(tasks) == 2:  # count=2 from the options
+                    break
+            except Exception:
+                pass
+            time.sleep(0.3)
+        else:
+            raise AssertionError("optioned pod count never appeared")
+    finally:
+        proc.terminate()
+        proc.wait(timeout=20)
+
+
+def test_install_package_with_options_and_upgrade_keeps_them(tmp_path):
+    """The full Cosmos flow: install with options renders them into
+    the spec; a bad option is refused with a pointed error; an
+    upgrade WITHOUT options re-renders with the prior ones."""
+    from dcos_commons_tpu.multi import MultiServiceScheduler
+    from dcos_commons_tpu.offer.inventory import SliceInventory, TpuHost
+    from dcos_commons_tpu.scheduler import SchedulerConfig
+    from dcos_commons_tpu.specification.specs import SpecError
+    from dcos_commons_tpu.storage import MemPersister
+    from dcos_commons_tpu.testing import FakeAgent
+    from dcos_commons_tpu.tools import build_package
+
+    d = tmp_path / "optsvc"
+    d.mkdir()
+    (d / "svc.yml").write_text(
+        "name: optsvc\npods:\n  app:\n    count: {{APP_COUNT:-1}}\n"
+        "    tasks:\n      main:\n        goal: RUNNING\n"
+        "        cmd: \"echo {{GREETING:-hi}} && sleep 100\"\n"
+        "        cpus: 0.1\n        memory: 32\n"
+    )
+    (d / "options.json").write_text(json.dumps({
+        "properties": {
+            "app": {"properties": {
+                "count": {"type": "integer", "default": 1, "minimum": 1,
+                          "maximum": 4, "env": "APP_COUNT"},
+                "greeting": {"type": "string", "default": "hi",
+                             "env": "GREETING"},
+            }},
+        },
+    }))
+    v1 = str(tmp_path / "v1.tgz")
+    build_package(str(d), v1, version="0.1.0")
+    multi = MultiServiceScheduler(
+        persister=MemPersister(),
+        inventory=SliceInventory([TpuHost(host_id="h0")]),
+        agent=FakeAgent(),
+        scheduler_config=SchedulerConfig(
+            backoff_enabled=False,
+            revive_capacity=1_000_000,
+            state_dir=str(tmp_path / "state"),
+        ),
+    )
+    payload = open(v1, "rb").read()
+    # bad option: pointed refusal, nothing installed
+    with pytest.raises(SpecError, match="app.count: 9 above maximum 4"):
+        multi.install_package(
+            "optsvc", payload, options={"app": {"count": 9}}
+        )
+    assert multi.get_service("optsvc") is None
+    multi.install_package(
+        "optsvc", payload,
+        options={"app": {"count": 2, "greeting": "bonjour"}},
+    )
+    svc = _drive_install(multi, multi.agent, "optsvc", 2)
+    assert svc.spec.pod("app").count == 2
+    assert "bonjour" in svc.spec.pod("app").task("main").cmd
+    # upgrade with NO options: prior options re-render into v2
+    (d / "svc.yml").write_text(
+        open(d / "svc.yml").read().replace("sleep 100", "sleep 200")
+    )
+    v2 = str(tmp_path / "v2.tgz")
+    build_package(str(d), v2, version="0.2.0")
+    multi.install_package("optsvc", open(v2, "rb").read(), upgrade=True)
+    svc = _drive_install(multi, multi.agent, "optsvc", 2)
+    assert svc.spec.pod("app").count == 2, "prior options lost on upgrade"
+    assert "bonjour" in svc.spec.pod("app").task("main").cmd
+    # upgrade overlaying one option keeps the other
+    multi.install_package(
+        "optsvc", open(v2, "rb").read(), upgrade=True,
+        options={"app": {"count": 3}},
+    )
+    svc = _drive_install(multi, multi.agent, "optsvc", 3)
+    assert svc.spec.pod("app").count == 3
+    assert "bonjour" in svc.spec.pod("app").task("main").cmd
+    # v3 DROPS the greeting option entirely: stored greeting must not
+    # brick the upgrade — it is pruned, the rest survive
+    (d / "options.json").write_text(json.dumps({
+        "properties": {
+            "app": {"properties": {
+                "count": {"type": "integer", "default": 1, "minimum": 1,
+                          "maximum": 4, "env": "APP_COUNT"},
+            }},
+        },
+    }))
+    v3 = str(tmp_path / "v3.tgz")
+    build_package(str(d), v3, version="0.3.0")
+    multi.install_package("optsvc", open(v3, "rb").read(), upgrade=True)
+    svc = _drive_install(multi, multi.agent, "optsvc", 3)
+    assert svc.spec.pod("app").count == 3  # count option survived
