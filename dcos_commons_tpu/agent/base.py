@@ -15,6 +15,11 @@ class Agent:
     reconciliation query (ImplicitReconciler / ExplicitReconciler).
     """
 
+    # True when launch payloads cross a network (per-host daemons):
+    # security validators demand an authed channel only then — a
+    # local/sim agent writes cert material straight to disk
+    is_remote = False
+
     def launch(self, task_infos: List[TaskInfo]) -> None:
         """Start the given tasks.  Must be idempotent per task_id."""
         raise NotImplementedError

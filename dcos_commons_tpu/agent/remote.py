@@ -134,6 +134,8 @@ class RemoteFleet(Agent):
     A successful poll afterwards fires ``on_host_up``.
     """
 
+    is_remote = True
+
     def __init__(
         self,
         timeout_s: float = 5.0,

@@ -81,10 +81,18 @@ class TaskReservationsTracker:
 
 
 def serialize_plan(plan) -> Dict[str, Any]:
+    # plan-level errors aggregate every element's (reference:
+    # PlansQueries surfacing step errors in the plan body — the
+    # operator must see WHY a step is ERROR without spelunking)
+    errors = list(plan.errors)
+    for phase in plan.phases:
+        errors.extend(phase.errors)
+        for step in phase.steps:
+            errors.extend(step.errors)
     return {
         "name": plan.name,
         "status": plan.get_status().value,
-        "errors": list(plan.errors),
+        "errors": errors,
         "phases": [
             {
                 "id": phase.id,

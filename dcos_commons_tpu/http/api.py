@@ -310,6 +310,58 @@ class SchedulerApi:
         except UnicodeDecodeError:
             return 200, value.hex()
 
+    _FILE_PREFIX = "file."
+
+    def state_files(self) -> Response:
+        """Reference: StateQueries.java:78 — operator-managed files in
+        the state store (small configs/keytabs an operator stages for
+        tasks or tooling to read back)."""
+        keys = self._scheduler.state_store.fetch_property_keys()
+        return 200, sorted(
+            k[len(self._FILE_PREFIX):] for k in keys
+            if k.startswith(self._FILE_PREFIX)
+        )
+
+    def state_file_get(self, name: str) -> Response:
+        import base64 as _b64
+
+        value = self._scheduler.state_store.fetch_property(
+            self._FILE_PREFIX + name
+        )
+        if value is None:
+            return 404, {"message": f"no file {name}"}
+        return 200, {
+            "name": name,
+            "content": _b64.b64encode(value).decode("ascii"),
+        }
+
+    def state_file_put(self, name: str, body: dict) -> Response:
+        import base64 as _b64
+
+        content = (body or {}).get("content")
+        if not isinstance(content, str):
+            return 400, {"message": "body must be {\"content\": b64}"}
+        try:
+            value = _b64.b64decode(content, validate=True)
+        except Exception:
+            return 400, {"message": "content is not valid base64"}
+        if len(value) > 1 << 20:
+            # the state tree is replicated + snapshotted: it is for
+            # small operator files, not artifact storage (uris: is)
+            return 413, {"message": "file too large (1 MiB cap)"}
+        from dcos_commons_tpu.state.state_store import StateStoreException
+
+        try:
+            self._scheduler.state_store.store_property(
+                self._FILE_PREFIX + name, value
+            )
+        except StateStoreException as e:
+            # key validation: the CLIENT's name is bad.  Persister/IO
+            # failures propagate to the dispatcher's 500 path — a
+            # store outage is not a malformed request.
+            return 400, {"message": str(e)}
+        return 200, {"name": name, "bytes": len(value)}
+
     def state_framework_id(self) -> Response:
         store = self._scheduler.framework_store
         if store is None:
@@ -343,8 +395,16 @@ class SchedulerApi:
                     pod = p
             if pod is None:
                 continue
+            # full names are <pod>-<index>-<task> and TASK names may
+            # themselves contain dashes (server-a): strip the known
+            # prefix instead of splitting on the last dash
+            prefix = f"{info.pod_type}-{info.pod_index}-"
             try:
-                task_spec = pod.task(info.name.rsplit("-", 1)[-1])
+                task_spec = pod.task(
+                    info.name[len(prefix):]
+                    if info.name.startswith(prefix)
+                    else info.name.rsplit("-", 1)[-1]
+                )
             except Exception:
                 task_spec = None
             for reservation in ledger.for_task(info.name):
@@ -364,11 +424,43 @@ class SchedulerApi:
                         out.setdefault(f"vip:{vip_name}", []).append(
                             f"{hostname}:{port}"
                         )
+            # stable DNS-style names (reference: DiscoveryInfo +
+            # EndpointUtils listing <task>.<svc>.<tld> names; the
+            # `discovery: prefix:` override renames the task part, and
+            # `service-tld:` the suffix — custom_tld.yml analogue).
+            # Wiring the names into a resolver is the fleet's job; the
+            # listing is the contract.
+            tld = self._scheduler.spec.service_tld
+            if tld and task_spec is not None:
+                if task_spec.discovery_prefix:
+                    disc_name = (
+                        f"{task_spec.discovery_prefix}-{info.pod_index}"
+                    )
+                else:
+                    disc_name = info.name
+                dns_name = (
+                    f"{disc_name}.{self._scheduler.spec.name}.{tld}"
+                )
+                entries = out.setdefault("dns", [])
+                for reservation in ledger.for_task(info.name):
+                    for port in reservation.ports:
+                        entry = f"{dns_name}:{port}"
+                        if entry not in entries:
+                            entries.append(entry)
+                if not any(
+                    e.startswith(dns_name + ":") for e in entries
+                ):
+                    if dns_name not in entries:
+                        entries.append(dns_name)
             coord = info.env.get("COORDINATOR_ADDRESS")
             if coord:
                 entries = out.setdefault("coordinator", [])
                 if coord not in entries:
                     entries.append(coord)
+        if self._scheduler.spec.web_url:
+            # web-url.yml analogue: the service's UI advertised with
+            # its endpoints (reference: webui_url in FrameworkInfo)
+            out.setdefault("web", []).append(self._scheduler.spec.web_url)
         return out
 
     def list_endpoints(self) -> Response:

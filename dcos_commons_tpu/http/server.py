@@ -83,6 +83,13 @@ def build_routes(api: SchedulerApi) -> List[Route]:
         r("GET", r"/v1/state/frameworkId",
           lambda m, q: api.state_framework_id()),
         r("GET", r"/v1/state/zones", lambda m, q: api.state_zones()),
+        # operator files in the state store (StateQueries.java:78)
+        r("GET", r"/v1/state/files", lambda m, q: api.state_files()),
+        r("GET", r"/v1/state/files/([^/]+)",
+          lambda m, q: api.state_file_get(m.group(1))),
+        r("PUT", r"/v1/state/files/([^/]+)",
+          lambda m, q, body: api.state_file_put(m.group(1), body),
+          True),
         # endpoints
         r("GET", r"/v1/endpoints", lambda m, q: api.list_endpoints()),
         r("GET", r"/v1/endpoints/([^/]+)",

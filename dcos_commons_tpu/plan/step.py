@@ -254,6 +254,24 @@ class DeploymentStep(Step):
                 # DeploymentStep stays COMPLETE; recovery manager owns
                 # keep-alive, DefaultRecoveryPlanManager.java:164)
                 return
+            if status.state is TaskState.ERROR:
+                # NON-recoverable: provisioning failed before the
+                # command ever ran (missing template/artifact, bad
+                # secret) — a retry fails identically, so surface as
+                # plan ERROR instead of crash-looping (reference:
+                # TASK_ERROR -> step ERROR, DeploymentStep.java:163-193;
+                # exits are `plan restart`/forceComplete or a config
+                # fix rolling a new target)
+                # accumulate per task (a gang can have SEVERAL distinct
+                # provisioning failures; hiding all but the last costs
+                # the operator one full rollout per hidden error)
+                message = f"{name}: {status.message or 'task ERROR'}"
+                self.errors[:] = [
+                    e for e in self.errors
+                    if not e.startswith(f"{name}: ")
+                ] + [message]
+                self._task_states[name] = status.state
+                return
             self._task_states[name] = status.state
             if status.ready:
                 self._task_ready[name] = True
@@ -335,17 +353,21 @@ class DeploymentStep(Step):
         return self._interrupted
 
     def restart(self) -> None:
-        """Reference: PlansQueries restart verb — back to PENDING."""
+        """Reference: PlansQueries restart verb — back to PENDING.
+        Clears recorded ERRORs: restart is one of the operator's two
+        exits from a non-recoverable step."""
         with self._lock:
             self._status = Status.PENDING
             self._expected = {}
             self._task_states = {}
             self._task_ready = {}
             self._delay_until = 0.0
+            self.errors.clear()
 
     def force_complete(self) -> None:
         with self._lock:
             self._status = Status.COMPLETE
+            self.errors.clear()
 
     def get_asset_names(self) -> Set[str]:
         return self.requirement.asset_names

@@ -369,6 +369,10 @@ class FrameworkRunner:
                         or getattr(scheduler, "secrets_provider", None)
                         is not None
                     ),
+                    auth_token_present=(
+                        bool(self.config.auth_token)
+                        if self.agent_urls else None
+                    ),
                 ),
             )
         except ConfigValidationError as e:

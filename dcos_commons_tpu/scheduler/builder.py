@@ -329,6 +329,13 @@ class SchedulerBuilder:
                 self._secrets_provider is not None
                 or bool(self._config.secrets_dir)
             ),
+            # only meaningful when launches cross a network: a local
+            # agent writes cert material straight to disk, so TLS
+            # without a token is fine there (None = skip the check)
+            auth_token_present=(
+                bool(self._config.auth_token)
+                if getattr(self._agent, "is_remote", False) else None
+            ),
         )
         try:
             validate_spec_change(old_spec, self._spec, context=context)

@@ -210,6 +210,10 @@ class TaskSpec:
     # sandbox artifacts fetched before launch (pod-level uris merge in
     # here, task-level declarations winning on dest clashes)
     uris: Tuple[UriSpec, ...] = ()
+    # custom discovery name prefix (reference: discovery.yml `discovery:
+    # prefix:` -> DiscoveryInfo; tasks advertise as <prefix>-<index>
+    # instead of <pod>-<index>-<task> in the endpoint/DNS listing)
+    discovery_prefix: str = ""
 
     def __post_init__(self) -> None:
         if isinstance(self.goal, str):
@@ -268,6 +272,10 @@ class ServiceSpec:
     region: str = ""
     zone: str = ""
     web_url: str = ""
+    # DNS suffix tasks advertise under in /v1/endpoints (reference:
+    # custom_tld.yml + bootstrap's custom-TLD resolution; wiring the
+    # names into a resolver is the fleet operator's job)
+    service_tld: str = "fleet.local"
     pods: Tuple[PodSpec, ...] = ()
     replacement_failure_policy: Optional[ReplacementFailurePolicy] = None
     # raw plans section from YAML; compiled by plan.PlanGenerator
@@ -310,6 +318,7 @@ def _decode_service(data: Dict[str, Any]) -> ServiceSpec:
         region=data.get("region", ""),
         zone=data.get("zone", ""),
         web_url=data.get("web_url", ""),
+        service_tld=data.get("service_tld", "fleet.local"),
         pods=pods,
         replacement_failure_policy=(
             ReplacementFailurePolicy(**rfp) if rfp else None
@@ -433,6 +442,7 @@ def _decode_task(data: Dict[str, Any]) -> TaskSpec:
             for t in data.get("transport_encryption", [])
         ),
         uris=tuple(UriSpec(**u) for u in data.get("uris", [])),
+        discovery_prefix=data.get("discovery_prefix", ""),
     )
 
 

@@ -42,6 +42,8 @@ class ValidationContext:
     deployment_completed: Optional[bool] = None
     # is a secrets provider wired (SECRETS_DIR / set_secrets_provider)?
     secrets_provider_present: Optional[bool] = None
+    # is the control plane authenticated (cluster bearer token set)?
+    auth_token_present: Optional[bool] = None
 
 
 def service_name_cannot_change(old, new):
@@ -428,6 +430,30 @@ def secrets_require_provider(old, new, context=None):
     return errs
 
 
+def tls_requires_credentials(old, new, context=None):
+    """Reference: config/validate/TLSRequiresServiceAccount.java — a
+    spec requesting transport encryption needs the credential plane
+    behind it: per-task certs are only trustworthy when the control
+    plane itself is authenticated (agents pull cert material over it),
+    so TLS without a cluster auth token is a misconfiguration caught
+    at CONFIGURATION time, not at launch."""
+    present = context.auth_token_present if context else None
+    if present is None or present:
+        return []
+    errs = []
+    for pod in new.pods:
+        for task in pod.tasks:
+            if task.transport_encryption:
+                errs.append(
+                    f"task {pod.type}/{task.name} requests "
+                    "transport-encryption but the control plane has no "
+                    "auth token (set AUTH_TOKEN/--auth-token-file; the "
+                    "reference requires a service account for TLS the "
+                    "same way)"
+                )
+    return errs
+
+
 def default_validators() -> List[Validator]:
     return [
         service_name_cannot_change,
@@ -443,6 +469,7 @@ def default_validators() -> List[Validator]:
         pre_reserved_role_cannot_change,
         role_cannot_change_on_incomplete_deployment,
         secrets_require_provider,
+        tls_requires_credentials,
         tpu_generation_supported,
         gang_flag_cannot_change,
         tpu_topology_cannot_change,

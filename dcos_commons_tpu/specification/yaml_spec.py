@@ -39,14 +39,42 @@ from dcos_commons_tpu.specification.specs import (
 _TEMPLATE_RE = re.compile(r"\{\{([A-Za-z_][A-Za-z0-9_]*)(?::-([^}]*))?\}\}")
 
 
+_SECTION_RE = re.compile(
+    r"\{\{([#^])([A-Za-z0-9_]+)\}\}\n?(.*?)\{\{/\2\}\}\n?", re.DOTALL
+)
+
+
+def _truthy(value) -> bool:
+    """Mustache truthiness for section tags: unset/empty/false/0 hide
+    a ``{{#VAR}}`` block (and show a ``{{^VAR}}`` one)."""
+    return str(value).strip().lower() not in ("", "false", "0", "no")
+
+
 def render_template(text: str, env: Mapping[str, str]) -> str:
-    """Mustache-style ``{{VAR}}`` substitution from an env map.
+    """Mustache-style ``{{VAR}}`` substitution from an env map, plus
+    boolean sections ``{{#VAR}}...{{/VAR}}`` (kept when VAR is truthy)
+    and ``{{^VAR}}...{{/VAR}}`` (kept when falsy).
 
     Reference: specification/yaml/TemplateUtils.java — missing values
     are an error (listing every missing variable), so a bad install
     fails loudly at spec-render time rather than at task runtime.
-    ``{{VAR:-default}}`` supplies a default.
+    ``{{VAR:-default}}`` supplies a default.  Sections are the
+    enable-disable plane (enable-disable.yml): a plan can include or
+    exclude whole steps from one boolean option, and flipping it via
+    a config update adds/removes the tasks with a rolling update.
     """
+    # sections first (innermost-out via repeated passes), so variables
+    # inside a hidden block are never "missing"
+    def section_sub(match: re.Match) -> str:
+        kind, var, body = match.groups()
+        show = _truthy(env.get(var, ""))
+        if kind == "^":
+            show = not show
+        return body if show else ""
+
+    prev = None
+    while prev != text:
+        prev, text = text, _SECTION_RE.sub(section_sub, text)
     missing = []
 
     def sub(match: re.Match) -> str:
@@ -154,6 +182,7 @@ def _map_service(
         region=str(raw.get("region", "")),
         zone=str(raw.get("zone", "")),
         web_url=str(raw.get("web-url", "")),
+        service_tld=str(raw.get("service-tld", "fleet.local")),
         pods=pods,
         replacement_failure_policy=rfp,
         plans=raw.get("plans") or {},
@@ -300,6 +329,9 @@ def _map_task(
         readiness_check=rc,
         config_templates=tuple(templates),
         uris=_map_uris(raw),
+        discovery_prefix=str(
+            (raw.get("discovery") or {}).get("prefix", "")
+        ),
         kill_grace_period_s=float(raw.get("kill-grace-period", 3)),
         essential=bool(raw.get("essential", True)),
         transport_encryption=tuple(

@@ -103,6 +103,8 @@ def default_env_name(section: str, option: str) -> str:
 
 def _iter_options(schema: Dict[str, Any]):
     for section, sect_raw in (schema.get("properties") or {}).items():
+        if not isinstance(sect_raw, dict):
+            continue  # validate_schema reports it as a finding
         for option, opt_raw in (sect_raw.get("properties") or {}).items():
             yield section, option, (opt_raw or {})
 
@@ -113,6 +115,14 @@ def validate_schema(schema: Dict[str, Any]) -> List[str]:
     if not isinstance(schema, dict) or \
             not isinstance(schema.get("properties"), dict):
         return ["top-level 'properties' object required"]
+    for section, sect_raw in schema["properties"].items():
+        if not isinstance(sect_raw, dict) or \
+                not isinstance(sect_raw.get("properties"), dict):
+            # a misspelled/missing 'properties' would otherwise pass
+            # lint and then reject every operator option at install
+            findings.append(
+                f"section {section!r}: needs a 'properties' object"
+            )
     seen_env: Dict[str, str] = {}
     for section, option, opt in _iter_options(schema):
         where = f"{section}.{option}"
@@ -166,10 +176,22 @@ def _check_value(
     enum = opt.get("enum")
     if enum and value not in enum:
         errors.append(f"{where}: {value!r} not one of {enum}")
-    if "minimum" in opt and value < opt["minimum"]:
-        errors.append(f"{where}: {value!r} below minimum {opt['minimum']}")
-    if "maximum" in opt and value > opt["maximum"]:
-        errors.append(f"{where}: {value!r} above maximum {opt['maximum']}")
+    try:
+        if "minimum" in opt and value < opt["minimum"]:
+            errors.append(
+                f"{where}: {value!r} below minimum {opt['minimum']}"
+            )
+        if "maximum" in opt and value > opt["maximum"]:
+            errors.append(
+                f"{where}: {value!r} above maximum {opt['maximum']}"
+            )
+    except TypeError:
+        # the CONSTRAINT doesn't fit the type (e.g. minimum on a
+        # string): a schema bug, reported as a finding not a crash
+        errors.append(
+            f"{where}: minimum/maximum not comparable with "
+            f"{opt['type']} values"
+        )
 
 
 def _render_value(value: Any) -> str:

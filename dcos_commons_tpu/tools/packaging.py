@@ -191,6 +191,10 @@ def lint_airgap(framework_dir: str) -> list:
                 # `*) curl https://...` must be flagged
                 if stripped.startswith(("#", "//")):
                     continue
+                if stripped.startswith("web-url:"):
+                    # an ADVERTISED operator-browser URL, not a task
+                    # fetch: air-gap egress rules don't apply to it
+                    continue
                 for url in url_re.findall(stripped):
                     host = url.split("//", 1)[1].split("/", 1)[0]
                     if host.startswith("["):  # bracketed IPv6

@@ -1,0 +1,359 @@
+"""Feature-matrix remainder (VERDICT r3 #7): discovery/custom-TLD
+naming, enable-disable boolean sections, non-recoverable plan-ERROR
+surfacing, web-url advertisement, /v1/state/files, and the
+TLS-requires-credentials validator.
+
+Reference: frameworks/helloworld/src/main/dist/{discovery,custom_tld,
+enable-disable,non_recoverable_state,web-url}.yml and their tests;
+http/queries/StateQueries.java:78; config/validate/
+TLSRequiresServiceAccount.java.
+"""
+
+import base64
+import json
+import os
+
+import pytest
+
+from dcos_commons_tpu.common import TaskState
+from dcos_commons_tpu.plan.status import Status
+from dcos_commons_tpu.specification import from_yaml
+from dcos_commons_tpu.specification.yaml_spec import render_template
+from dcos_commons_tpu.testing import (
+    AdvanceCycles,
+    ExpectDeploymentComplete,
+    ExpectLaunchedTasks,
+    ExpectPlanStatus,
+    SendTaskRunning,
+    ServiceTestRunner,
+)
+
+HELLOWORLD = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "frameworks",
+    "helloworld",
+)
+
+
+def load(yaml_name: str) -> str:
+    with open(os.path.join(HELLOWORLD, yaml_name), encoding="utf-8") as f:
+        return f.read()
+
+
+# -- mustache boolean sections (enable-disable plane) -----------------
+
+
+def test_render_template_boolean_sections():
+    text = "a\n{{#FLAG}}\non\n{{/FLAG}}\n{{^FLAG}}\noff\n{{/FLAG}}\nz\n"
+    assert render_template(text, {"FLAG": "true"}) == "a\non\nz\n"
+    assert render_template(text, {"FLAG": "false"}) == "a\noff\nz\n"
+    assert render_template(text, {}) == "a\noff\nz\n"
+    # falsy spellings
+    for falsy in ("", "0", "no", "False"):
+        assert "off" in render_template(text, {"FLAG": falsy})
+    # vars inside a hidden block are never "missing"
+    hidden = "{{#FLAG}}{{UNDEFINED_VAR}}{{/FLAG}}ok"
+    assert render_template(hidden, {}) == "ok"
+
+
+def test_enable_disable_yaml_flips_task_set():
+    """TEST_BOOLEAN=false deploys only server-b; true deploys both
+    (reference: test_enable_disable.py flows)."""
+    spec_off = from_yaml(load("enable-disable.yml"),
+                         env={"TEST_BOOLEAN": "false"})
+    steps_off = json.dumps(spec_off.plans)
+    assert "server-a" not in steps_off
+    runner = ServiceTestRunner(
+        load("enable-disable.yml"),
+        env={"TEST_BOOLEAN": "false", "HELLO_COUNT": "1"},
+    )
+    runner.run([
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("hello-0-server-b"),
+        SendTaskRunning("hello-0-server-b"),
+        ExpectDeploymentComplete(),
+    ])
+    assert runner.world.agent.task_id_of("hello-0-server-a") is None
+
+    enabled = ServiceTestRunner(
+        load("enable-disable.yml"),
+        env={"TEST_BOOLEAN": "true", "HELLO_COUNT": "1"},
+    )
+    enabled.run([
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("hello-0-server-a"),
+        SendTaskRunning("hello-0-server-a"),
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("hello-0-server-b"),
+        SendTaskRunning("hello-0-server-b"),
+        ExpectDeploymentComplete(),
+    ])
+
+
+# -- non-recoverable plan-ERROR surfacing -----------------------------
+
+
+def test_task_error_surfaces_as_plan_error_and_restart_clears():
+    """A TASK_ERROR (provisioning can never succeed) parks the step at
+    ERROR instead of crash-looping; `plan restart` clears it
+    (reference: non_recoverable_state.yml + fast-failure semantics)."""
+    from dcos_commons_tpu.common import TaskStatus
+
+    runner = ServiceTestRunner(load("simple.yml"))
+    runner.run([AdvanceCycles(1), ExpectLaunchedTasks("hello-0-server")])
+    agent = runner.world.agent
+    task_id = agent.task_id_of("hello-0-server")
+    agent.send(TaskStatus(
+        task_id=task_id, state=TaskState.ERROR,
+        message="config template render failed: no such template",
+    ))
+    runner.run([
+        AdvanceCycles(2),
+        ExpectPlanStatus("deploy", Status.ERROR),
+    ])
+    from dcos_commons_tpu.http.api import SchedulerApi
+
+    plan = runner.world.scheduler.plans()["deploy"]
+    code, body = SchedulerApi(runner.world.scheduler).get_plan("deploy")
+    assert code in (200, 202, 503)
+    assert "no such template" in json.dumps(body)
+    # no relaunch while parked at ERROR (not a crash loop)
+    before = len(agent.launched)
+    runner.run([AdvanceCycles(3)])
+    assert len(agent.launched) == before
+    # operator exit: restart the plan -> step re-runs
+    for phase in plan.phases:
+        for step in phase.steps:
+            step.restart()
+    runner.run([AdvanceCycles(1)])
+    assert len(agent.launched) == before + 1
+
+
+def test_e2e_missing_template_is_plan_error(tmp_path):
+    """non-recoverable.yml through a REAL agent: the missing template
+    ERRORs the launch and the deploy plan shows ERROR over HTTP."""
+    from dcos_commons_tpu.testing.integration import (
+        AgentProcess,
+        SchedulerProcess,
+        reap_orphan_tasks,
+        wait_for,
+    )
+
+    repo = os.path.dirname(HELLOWORLD.rstrip(os.sep))
+    repo = os.path.dirname(repo)
+    agents = [AgentProcess("h0", str(tmp_path / "agent-0"), repo)]
+    sched = None
+    try:
+        topology = tmp_path / "topology.yml"
+        topology.write_text(
+            "hosts:\n  - host_id: h0\n"
+            f"    agent_url: {agents[0].url}\n"
+            "    cpus: 4.0\n    memory_mb: 8192\n"
+        )
+        sched = SchedulerProcess(
+            os.path.join(HELLOWORLD, "non-recoverable.yml"),
+            str(topology), str(tmp_path / "sched"),
+            env={"ENABLE_BACKOFF": "false"}, repo_root=repo,
+        )
+        client = sched.client()
+        wait_for(
+            lambda: client.plan_status("deploy") == "ERROR" or None,
+            timeout_s=60, what="deploy plan ERROR",
+        )
+        body = client.get("/v1/plans/deploy")
+        assert body["status"] == "ERROR"
+        assert any("template" in e for e in body.get("errors", [])), body
+    finally:
+        if sched is not None:
+            sched.terminate()
+        reap_orphan_tasks(agents)
+        for agent in agents:
+            agent.stop()
+
+
+# -- discovery / custom TLD / web-url ---------------------------------
+
+
+def _endpoint(runner, name):
+    from dcos_commons_tpu.http.api import SchedulerApi
+
+    api = SchedulerApi(runner.world.scheduler)
+    code, body = api.get_endpoint(name)
+    assert code == 200, body
+    return body["address"]
+
+
+def test_discovery_prefix_names_endpoints():
+    runner = ServiceTestRunner(load("discovery.yml"))
+    runner.run([
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("hello-0-server"),
+        SendTaskRunning("hello-0-server"),
+        ExpectDeploymentComplete(),
+    ])
+    dns = _endpoint(runner, "dns")
+    assert any(
+        entry.startswith("hello-0.helloworld.fleet.local:")
+        for entry in dns
+    ), dns
+
+
+def test_custom_tld_renames_dns_suffix():
+    runner = ServiceTestRunner(
+        load("custom-tld.yml"), env={"SERVICE_TLD": "corp.internal"}
+    )
+    runner.run([
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("hello-0-server"),
+        SendTaskRunning("hello-0-server"),
+        ExpectDeploymentComplete(),
+    ])
+    dns = _endpoint(runner, "dns")
+    assert any(
+        entry.startswith("hello-0.helloworld.corp.internal:")
+        for entry in dns
+    ), dns
+
+
+def test_web_url_advertised_under_web_endpoint():
+    runner = ServiceTestRunner(
+        load("web-url.yml"), env={"WEB_URL": "http://ui.example:9090"}
+    )
+    runner.run([
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("hello-0-server"),
+        SendTaskRunning("hello-0-server"),
+        ExpectDeploymentComplete(),
+    ])
+    assert _endpoint(runner, "web") == ["http://ui.example:9090"]
+    assert runner.spec.web_url == "http://ui.example:9090"
+
+
+def test_dash_named_tasks_keep_their_endpoints():
+    """Task names containing dashes (server-a) must still resolve to
+    their spec for ports/vip/dns listing (prefix-strip, not
+    last-dash split)."""
+    yaml_text = """
+name: dashed
+pods:
+  hello:
+    count: 1
+    tasks:
+      server-a:
+        goal: RUNNING
+        cmd: "sleep 100"
+        cpus: 0.1
+        memory: 32
+        discovery:
+          prefix: hello
+        ports:
+          rpc:
+            port: 0
+"""
+    runner = ServiceTestRunner(yaml_text)
+    runner.run([
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("hello-0-server-a"),
+        SendTaskRunning("hello-0-server-a"),
+        ExpectDeploymentComplete(),
+    ])
+    assert any(
+        e.startswith("hello-0.dashed.fleet.local:")
+        for e in _endpoint(runner, "dns")
+    )
+    assert _endpoint(runner, "rpc")  # the port listing survives too
+
+
+def test_gang_step_accumulates_multiple_task_errors():
+    """Two distinct provisioning errors in one step both surface —
+    fixing one must not hide the other for a whole rollout."""
+    from dcos_commons_tpu.common import TaskStatus
+
+    yaml_text = """
+name: multi
+pods:
+  app:
+    count: 1
+    tasks:
+      alpha:
+        goal: RUNNING
+        cmd: "sleep 100"
+        cpus: 0.1
+        memory: 32
+      beta:
+        goal: RUNNING
+        cmd: "sleep 100"
+        cpus: 0.1
+        memory: 32
+"""
+    runner = ServiceTestRunner(yaml_text)
+    runner.run([AdvanceCycles(1)])
+    agent = runner.world.agent
+    for task, message in (
+        ("app-0-alpha", "missing template"),
+        ("app-0-beta", "bad secret"),
+    ):
+        agent.send(TaskStatus(
+            task_id=agent.task_id_of(task),
+            state=TaskState.ERROR, message=message,
+        ))
+    runner.run([AdvanceCycles(1)])
+    from dcos_commons_tpu.http.api import SchedulerApi
+
+    _code, body = SchedulerApi(runner.world.scheduler).get_plan("deploy")
+    flat = json.dumps(body)
+    assert "missing template" in flat and "bad secret" in flat
+
+
+# -- /v1/state/files --------------------------------------------------
+
+
+def test_state_files_roundtrip():
+    from dcos_commons_tpu.http.api import SchedulerApi
+
+    runner = ServiceTestRunner(load("simple.yml"))
+    runner.run([AdvanceCycles(1)])
+    api = SchedulerApi(runner.world.scheduler)
+    assert api.state_files() == (200, [])
+    payload = base64.b64encode(b"keytab-bytes").decode()
+    code, body = api.state_file_put("svc.keytab", {"content": payload})
+    assert code == 200 and body["bytes"] == 12
+    assert api.state_files() == (200, ["svc.keytab"])
+    code, body = api.state_file_get("svc.keytab")
+    assert code == 200
+    assert base64.b64decode(body["content"]) == b"keytab-bytes"
+    # bad requests are pointed
+    assert api.state_file_put("x", {})[0] == 400
+    assert api.state_file_put("x", {"content": "!!!"})[0] == 400
+    big = base64.b64encode(b"x" * ((1 << 20) + 1)).decode()
+    assert api.state_file_put("x", {"content": big})[0] == 413
+    assert api.state_file_get("missing")[0] == 404
+
+
+# -- TLS requires credentials validator -------------------------------
+
+
+def test_tls_requires_credentials_validator():
+    from dcos_commons_tpu.specification.validation import (
+        ValidationContext,
+        tls_requires_credentials,
+    )
+
+    spec = from_yaml(load("tls.yml"))
+    # remote agents, no token: rejected
+    errs = tls_requires_credentials(
+        None, spec, ValidationContext(auth_token_present=False)
+    )
+    assert errs and "transport-encryption" in errs[0]
+    # token present, or local agents (None = not applicable): clean
+    assert tls_requires_credentials(
+        None, spec, ValidationContext(auth_token_present=True)
+    ) == []
+    assert tls_requires_credentials(
+        None, spec, ValidationContext(auth_token_present=None)
+    ) == []
+    # a spec without TLS never triggers it
+    plain = from_yaml(load("simple.yml"))
+    assert tls_requires_credentials(
+        None, plain, ValidationContext(auth_token_present=False)
+    ) == []

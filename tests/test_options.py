@@ -154,6 +154,26 @@ def test_prune_unknown_prior_options():
     assert kept == {} and dropped == ["a.b"]
 
 
+def test_schema_bugs_are_findings_not_crashes():
+    # constraint type mismatch: minimum on a string
+    findings = "; ".join(validate_schema({
+        "properties": {"s": {"properties": {
+            "x": {"type": "string", "default": "hi", "minimum": 1},
+        }}},
+    }))
+    assert "not comparable" in findings
+    # misspelled 'properties' in a section
+    findings = "; ".join(validate_schema({
+        "properties": {"s": {"propertes": {
+            "x": {"type": "string", "default": ""},
+        }}},
+    }))
+    assert "needs a 'properties' object" in findings
+    # non-dict section
+    findings = "; ".join(validate_schema({"properties": {"s": "oops"}}))
+    assert "needs a 'properties' object" in findings
+
+
 def test_non_object_schema_is_a_finding_not_a_crash(tmp_path):
     from dcos_commons_tpu.tools import PackageError, build_package
     from dcos_commons_tpu.tools.options import options_findings

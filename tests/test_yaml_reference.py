@@ -1,0 +1,90 @@
+"""docs/yaml-reference.md cannot rot: its canonical example parses,
+and every key the parser accepts appears in the doc.
+
+Reference: docs/pages/yaml-reference.md (567 lines) is the original
+dialect's contract; here the contract is enforced by CI.
+"""
+
+import os
+import re
+
+from dcos_commons_tpu.specification import GoalState, from_yaml
+
+DOC = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "docs",
+    "yaml-reference.md",
+)
+
+
+def doc_text() -> str:
+    with open(DOC, encoding="utf-8") as f:
+        return f.read()
+
+
+def canonical_yaml() -> str:
+    match = re.search(r"```yaml\n(.*?)```", doc_text(), re.DOTALL)
+    assert match, "yaml-reference.md lost its canonical example"
+    return match.group(1)
+
+
+def test_canonical_example_parses_and_round_trips():
+    spec = from_yaml(
+        canonical_yaml(),
+        env={"DEBUG_MODE": "true", "CORPUS_SHA256": "aa" * 32},
+    )
+    assert spec.name == "example"
+    assert spec.service_tld == "corp.internal"
+    assert spec.web_url.startswith("http://example.ui")
+    assert spec.replacement_failure_policy.min_replace_delay_s == 120
+    worker = spec.pod("worker")
+    assert worker.gang and worker.tpu.topology == "4x4"
+    assert worker.count == 4
+    assert worker.allow_decommission
+    assert worker.secrets[0].env_key == "HUB_TOKEN"
+    node = worker.task("node")
+    assert "--verbose" in node.cmd  # boolean section rendered
+    assert node.resources.ports[0].vip == "node:7077"
+    assert node.resources.ports[0].env_key == "RPC_PORT"
+    assert node.health_check.max_consecutive_failures == 3
+    assert node.readiness_check.interval_s == 2
+    assert node.discovery_prefix == "node"
+    assert node.kill_grace_period_s == 30
+    assert node.transport_encryption[0].name == "node-tls"
+    dests = {u.effective_dest() for u in node.uris}
+    assert "data/corpus.tar" in dests
+    assert "tokenizer.model" in dests  # pod-level uri merged in
+    assert {v.container_path for v in node.volumes} == {
+        "shared-scratch", "node-data",
+    }
+    sidecar = worker.task("sidecar")
+    assert sidecar.goal is GoalState.FINISH and not sidecar.essential
+    assert set(spec.plans) == {"deploy", "snapshot"}
+    # the custom plan compiles too (generator path)
+    from dcos_commons_tpu.testing import AdvanceCycles, ServiceTestRunner
+
+    from dcos_commons_tpu.scheduler import SchedulerConfig
+
+    runner = ServiceTestRunner(spec=spec, scheduler_config=SchedulerConfig(
+        backoff_enabled=False, revive_capacity=1_000_000,
+        secrets_dir="/tmp",
+    ))
+    runner.run([AdvanceCycles(1)])
+    assert set(runner.world.scheduler.plans()) >= {"deploy", "snapshot"}
+
+
+def test_every_documented_key_is_used_by_the_example():
+    """The doc's tables and its example stay in sync: each table key
+    appears in the canonical YAML (so a renamed/removed key breaks
+    this test, forcing a doc update)."""
+    yaml_text = canonical_yaml()
+    table_keys = re.findall(r"^\| `([a-z0-9-]+)`", doc_text(), re.M)
+    assert len(table_keys) > 30
+    # keys that legitimately appear under a different spelling in the
+    # example (volume vs volumes are alternates)
+    alternates = {"volumes": ("volume", "volumes")}
+    for key in table_keys:
+        spellings = alternates.get(key, (key,))
+        assert any(f"{s}:" in yaml_text for s in spellings), (
+            f"documented key {key!r} missing from the canonical example"
+        )
