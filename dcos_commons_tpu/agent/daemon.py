@@ -303,6 +303,21 @@ def main(argv: Optional[list] = None) -> int:
         help="CA bundle for verifying the scheduler's HTTPS artifact "
              "endpoint; also $TLS_CA_FILE",
     )
+    parser.add_argument(
+        "--provision-cmd", default="",
+        help="host provisioning command run ONCE before serving "
+             "(shell): e.g. seed the XLA compile cache "
+             "(frameworks/jax/warm_cache.py) so a fresh host's first "
+             "deploy pays cache-hit time, not a full compile.  A "
+             "nonzero exit aborts the daemon — a half-provisioned "
+             "host must not take tasks.",
+    )
+    parser.add_argument(
+        "--provision-timeout-s", type=float, default=600.0,
+        help="hard cap on --provision-cmd: a wedged provisioning "
+             "compile must abort LOUDLY, not leave a host that "
+             "silently never joins the fleet",
+    )
     args = parser.parse_args(argv)
     from dcos_commons_tpu.security.auth import load_token
 
@@ -315,6 +330,45 @@ def main(argv: Optional[list] = None) -> int:
             "token — anyone who can reach this port can run commands. "
             "Pass --auth-token-file (see security/auth.py trust model).",
             file=sys.stderr,
+        )
+    if args.provision_cmd:
+        import signal as _signal
+        import subprocess
+        import sys
+        import time as _time
+
+        t0 = _time.time()
+        # own session + group kill on timeout: the provisioning
+        # command's typical job is an XLA compile, a known wedge shape
+        # on relay-backed fleets — a hung grandchild must die with it
+        proc = subprocess.Popen(
+            ["/bin/sh", "-c", args.provision_cmd],
+            start_new_session=True,
+        )
+        try:
+            rc = proc.wait(timeout=args.provision_timeout_s)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(proc.pid, _signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            proc.wait(timeout=10)
+            print(
+                f"provisioning timed out after "
+                f"{args.provision_timeout_s:.0f}s: {args.provision_cmd}",
+                file=sys.stderr,
+            )
+            return 1
+        if rc != 0:
+            print(
+                f"provisioning failed (rc={rc}): {args.provision_cmd}",
+                file=sys.stderr,
+            )
+            return rc
+        print(
+            f"provisioned in {_time.time() - t0:.1f}s: "
+            f"{args.provision_cmd}",
+            flush=True,
         )
     daemon = AgentDaemon(
         args.host_id,

@@ -407,7 +407,8 @@ class SchedulerApi:
                 )
             except Exception:
                 task_spec = None
-            for reservation in ledger.for_task(info.name):
+            reservations = list(ledger.for_task(info.name))
+            for reservation in reservations:
                 port_specs = (
                     task_spec.resources.ports if task_spec is not None else []
                 )
@@ -442,7 +443,7 @@ class SchedulerApi:
                     f"{disc_name}.{self._scheduler.spec.name}.{tld}"
                 )
                 entries = out.setdefault("dns", [])
-                for reservation in ledger.for_task(info.name):
+                for reservation in reservations:
                     for port in reservation.ports:
                         entry = f"{dns_name}:{port}"
                         if entry not in entries:

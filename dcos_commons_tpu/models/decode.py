@@ -40,16 +40,39 @@ _NEG = -1e30
 
 
 def init_kv_cache(
-    config: TransformerConfig, batch: int, max_len: int
+    config: TransformerConfig, batch: int, max_len: int,
+    kv_dtype: str = "native",
 ) -> Dict[str, jax.Array]:
     shape = (
         config.n_layers, batch, max_len, config.n_kv_heads,
         config.head_dim,
     )
+    if kv_dtype == "int8":
+        scale_shape = shape[:-1] + (1,)
+        return {
+            "k": jnp.zeros(shape, jnp.int8),
+            "v": jnp.zeros(shape, jnp.int8),
+            "k_scale": jnp.zeros(scale_shape, jnp.float32),
+            "v_scale": jnp.zeros(scale_shape, jnp.float32),
+        }
     return {
         "k": jnp.zeros(shape, config.dtype),
         "v": jnp.zeros(shape, config.dtype),
     }
+
+
+def _quantize_kv(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Per-vector symmetric int8: each [head_dim] slice gets its own
+    max-abs scale.  Decode is HBM-bound on streaming the cache, so
+    halving its bytes roughly doubles the throughput roofline; the
+    f32 scale adds 4/(head_dim) overhead (~3% at hd=128)."""
+    scale = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1,
+                    keepdims=True) / 127.0
+    scale = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(
+        jnp.round(x.astype(jnp.float32) / scale), -127, 127
+    ).astype(jnp.int8)
+    return q, scale
 
 
 def _project_kv(config, layer, normed, positions):
@@ -70,6 +93,7 @@ def prefill(
     tokens: jax.Array,
     max_len: int,
     true_len: Optional[jax.Array] = None,
+    kv_dtype: str = "native",
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
     """Run the prompt through the trunk, capturing per-layer K/V.
 
@@ -113,9 +137,22 @@ def prefill(
         x, _moe_aux = _ffn_block(config, layer, x, decode=True)
         # pad the captured K/V out to the static cache length
         pad = [(0, 0), (0, max_len - s), (0, 0), (0, 0)]
+        if kv_dtype == "int8":
+            kq, ks = _quantize_kv(k)
+            vq, vs = _quantize_kv(v)
+            # scales share the pad spec: same axes, trailing dim 1
+            return x, (
+                jnp.pad(kq, pad), jnp.pad(vq, pad),
+                jnp.pad(ks, pad), jnp.pad(vs, pad),
+            )
         return x, (jnp.pad(k, pad), jnp.pad(v, pad))
 
-    x, (ck, cv) = lax.scan(layer_fn, x, params["layers"])
+    if kv_dtype == "int8":
+        x, (ck, cv, cks, cvs) = lax.scan(layer_fn, x, params["layers"])
+        cache = {"k": ck, "v": cv, "k_scale": cks, "v_scale": cvs}
+    else:
+        x, (ck, cv) = lax.scan(layer_fn, x, params["layers"])
+        cache = {"k": ck, "v": cv}
     x = rms_norm(x, params["final_norm"])
     last = (
         jnp.asarray(true_len, jnp.int32) - 1 if true_len is not None
@@ -126,7 +163,7 @@ def prefill(
         "bd,vd->bv", x_last.astype(jnp.float32),
         params["embed"].astype(jnp.float32),
     )
-    return logits, {"k": ck, "v": cv}
+    return logits, cache
 
 
 def decode_step(
@@ -148,39 +185,76 @@ def decode_step(
         lax.broadcasted_iota(jnp.int32, (1, 1, max_len), 2) <= pos
     )  # [1, 1, max_len], broadcast over batch and heads
 
-    def layer_fn(x, inputs):
-        layer, ck, cv = inputs  # ck/cv [b, max_len, kv, hd]
-        normed = rms_norm(x, layer["attn_norm"])
-        q, k_new, v_new = _project_kv(config, layer, normed, positions)
-        ck = lax.dynamic_update_slice(ck, k_new, (0, pos, 0, 0))
-        cv = lax.dynamic_update_slice(cv, v_new, (0, pos, 0, 0))
+    quantized = "k_scale" in cache
+    reps = h // kv
+
+    def _attend(q, ck, cv, ks=None, vs=None):
         # grouped GQA contraction against the UNEXPANDED cache: a
         # jnp.repeat to full heads would multiply the cache bytes
         # streamed per step by h/kv in an HBM-bound loop.
         # q [b, 1, kv, reps, hd] x K [b, L, kv, hd] -> [b, kv, reps, L]
-        reps = h // kv
         qg = (q.astype(jnp.float32) * hd ** -0.5).reshape(
             b, kv, reps, hd
         )
         scores = jnp.einsum("bkrd,blkd->bkrl", qg, ck.astype(jnp.float32))
+        if ks is not None:
+            # int8 cache: fold the per-vector K scale into the scores
+            # ([b, L, kv, 1] -> [b, kv, 1, L]) and the V scale into
+            # the probabilities — the dequantize costs one multiply,
+            # never a second pass over the cache bytes
+            scores = scores * ks[..., 0].transpose(0, 2, 1)[:, :, None, :]
         scores = jnp.where(valid[:, :, None, :], scores, _NEG)
         probs = jax.nn.softmax(scores, axis=-1)
-        attn = jnp.einsum(
+        if vs is not None:
+            probs = probs * vs[..., 0].transpose(0, 2, 1)[:, :, None, :]
+        return jnp.einsum(
             "bkrl,blkd->bkrd", probs, cv.astype(jnp.float32)
         ).astype(config.dtype)
+
+    def layer_fn(x, inputs):
+        if quantized:
+            layer, ck, cv, cks, cvs = inputs
+        else:
+            layer, ck, cv = inputs
+            cks = cvs = None
+        normed = rms_norm(x, layer["attn_norm"])
+        q, k_new, v_new = _project_kv(config, layer, normed, positions)
+        if quantized:
+            kq, ks_new = _quantize_kv(k_new)
+            vq, vs_new = _quantize_kv(v_new)
+            ck = lax.dynamic_update_slice(ck, kq, (0, pos, 0, 0))
+            cv = lax.dynamic_update_slice(cv, vq, (0, pos, 0, 0))
+            cks = lax.dynamic_update_slice(cks, ks_new, (0, pos, 0, 0))
+            cvs = lax.dynamic_update_slice(cvs, vs_new, (0, pos, 0, 0))
+        else:
+            ck = lax.dynamic_update_slice(ck, k_new, (0, pos, 0, 0))
+            cv = lax.dynamic_update_slice(cv, v_new, (0, pos, 0, 0))
+        attn = _attend(q, ck, cv, cks, cvs)
         x = x + attn.reshape(b, 1, h * hd) @ layer["wo"]
         x, _moe_aux = _ffn_block(config, layer, x, decode=True)
+        if quantized:
+            return x, (ck, cv, cks, cvs)
         return x, (ck, cv)
 
-    x, (ck, cv) = lax.scan(
-        layer_fn, x, (params["layers"], cache["k"], cache["v"])
-    )
+    if quantized:
+        x, (ck, cv, cks, cvs) = lax.scan(
+            layer_fn,
+            x,
+            (params["layers"], cache["k"], cache["v"],
+             cache["k_scale"], cache["v_scale"]),
+        )
+        new_cache = {"k": ck, "v": cv, "k_scale": cks, "v_scale": cvs}
+    else:
+        x, (ck, cv) = lax.scan(
+            layer_fn, x, (params["layers"], cache["k"], cache["v"])
+        )
+        new_cache = {"k": ck, "v": cv}
     x = rms_norm(x, params["final_norm"])
     logits = jnp.einsum(
         "bd,vd->bv", x[:, 0].astype(jnp.float32),
         params["embed"].astype(jnp.float32),
     )
-    return logits, {"k": ck, "v": cv}
+    return logits, new_cache
 
 
 def generate(
@@ -192,13 +266,19 @@ def generate(
     key: Optional[jax.Array] = None,
     max_len: Optional[int] = None,
     true_len: Optional[jax.Array] = None,
+    kv_dtype: str = "native",
 ) -> jax.Array:
     """Autoregressive continuation: prompt [b, s] -> tokens
     [b, max_new_tokens].  temperature 0 = greedy; otherwise softmax
     sampling with ``key``.  Jit-friendly end to end, ONE compile
     covering every prompt CONTENT, LENGTH (``true_len``: right-padded
     prompts, traced), and TEMPERATURE (traced operand — a server must
-    not recompile per requested temperature)."""
+    not recompile per requested temperature).
+
+    ``kv_dtype="int8"`` stores the cache quantized per vector:
+    decode streams half the cache bytes per step, roughly doubling
+    the HBM-bound throughput ceiling, at ~0.4%/element quantization
+    error (tests/test_decode.py holds logits agreement)."""
     b, s = prompt.shape
     total = max_len if max_len is not None else s + max_new_tokens
     if total < s + max_new_tokens:
@@ -218,7 +298,9 @@ def generate(
             raise ValueError("a traced temperature needs a PRNG key")
         if float(temperature) > 0.0:  # concrete scalars/arrays coerce
             raise ValueError("sampling (temperature > 0) needs a PRNG key")
-    logits, cache = prefill(config, params, prompt, total, true_len)
+    logits, cache = prefill(
+        config, params, prompt, total, true_len, kv_dtype=kv_dtype
+    )
     key = key if key is not None else jax.random.key(0)
     temp = jnp.asarray(temperature, jnp.float32)
 

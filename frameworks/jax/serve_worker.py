@@ -15,6 +15,8 @@ import json
 import os
 import sys
 import threading
+
+import numpy as np
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 sys.path.insert(0, os.environ.get("REPO_ROOT", "/root/repo"))
@@ -71,9 +73,12 @@ def main() -> int:
     # decode overwrites/masks the pad slots); temperature is a traced
     # operand too — novel temperatures must not recompile
     prompt_len = max_len - new_tokens
+    # KV_DTYPE=int8 halves the cache bytes per decode step: the lever
+    # for large serving batches on a full chip (models/decode.py)
+    kv_dtype = os.environ.get("KV_DTYPE", "native")
     gen = jax.jit(lambda p, t, key, temp, n: generate(
         config, p, t, max_new_tokens=new_tokens, max_len=max_len,
-        temperature=temp, key=key, true_len=n,
+        temperature=temp, key=key, true_len=n, kv_dtype=kv_dtype,
     ))
     lock = threading.Lock()
 
@@ -134,9 +139,14 @@ def main() -> int:
                         jnp.float32(temp),
                         jnp.int32(true_len),
                     )
+                # ONE bulk device->host fetch, then slice in numpy:
+                # per-element int(out[i, j]) would be a separate
+                # transfer each (~100ms over a TPU relay — 256 of
+                # them turned a 1.5s generate into a 36s reply)
+                host_out = np.asarray(jax.device_get(out))
                 reply = {
                     "tokens": [
-                        [int(t) for t in out[i, :n]]
+                        [int(t) for t in host_out[i, :n]]
                         for i in range(len(rows))
                     ]
                 }
