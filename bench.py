@@ -905,7 +905,8 @@ def main() -> None:
             break
         except Exception as e:
             extras["roofline_error"] = repr(e)[:200]
-            time.sleep(5)
+            if attempt == 1:
+                time.sleep(5)
     try:
         extras.update(bench_transformer())
     except Exception as e:  # deploy result still stands alone

@@ -198,9 +198,9 @@ def test_default_env_name():
 
 
 def test_shipped_framework_schemas_are_clean():
-    """helloworld + jax ship schemas that lint clean and whose env
-    names actually appear in their svc.yml templates."""
-    for framework in ("helloworld", "jax"):
+    """helloworld, jax, and hdfs ship schemas that lint clean and
+    whose env names actually appear in their svc.yml templates."""
+    for framework in ("helloworld", "jax", "hdfs"):
         framework_dir = os.path.join(REPO, "frameworks", framework)
         schema = load_schema(framework_dir)
         assert schema is not None, f"{framework} ships no options.json"

@@ -31,6 +31,10 @@ TINY_ENV = {
     "SEQ_LEN": "64",
     "MAX_LEN": "48",
     "MAX_NEW_TOKENS": "8",
+    # exactness assertions below need batch 1 (the overflow 400) and
+    # the exact cache; int8/batched serving has its own coverage
+    "SERVE_BATCH": "1",
+    "KV_DTYPE": "native",
 }
 
 
