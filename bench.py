@@ -598,11 +598,28 @@ def bench_serve() -> dict:
             _latency, n = one_request(serve_batch)
             tokens_total += n
         wall = time.monotonic() - t_start
+        # concurrent single-prompt CLIENTS: the worker's micro-batcher
+        # merges them into shared generate calls — the multi-client
+        # number, vs the single-client full-batch number above
+        import concurrent.futures as _fut
+
+        conc_total = (requests // serve_batch + 1) * serve_batch
+        conc_tokens = 0
+        t_conc = time.monotonic()
+        # ONE map, no per-round barrier: max_workers bounds the
+        # in-flight clients and the worker's batcher does the merging
+        with _fut.ThreadPoolExecutor(max_workers=serve_batch) as pool:
+            for _latency, n in pool.map(one_request, [1] * conc_total):
+                conc_tokens += n
+        conc_wall = time.monotonic() - t_conc
         latencies.sort()
         result.update({
             "serve_requests": requests,
             "serve_batch": serve_batch,
             "serve_tokens_per_s": round(tokens_total / wall, 1),
+            "serve_concurrent_clients_tokens_per_s": round(
+                conc_tokens / conc_wall, 1
+            ),
             "serve_p50_ms": round(
                 statistics.median(latencies) * 1e3, 1
             ),

@@ -9,17 +9,119 @@ when the server can actually answer.
 
 Request:  {"tokens": [[...]], "max_new_tokens": N, "temperature": T}
 Response: {"tokens": [[...]]} — the continuations only.
+
+Concurrency: with SERVE_BATCH > 1 the server MICRO-BATCHES — a decode
+step costs nearly the same wall time for 1 or 64 rows, so concurrent
+single-prompt clients that would otherwise serialize behind the chip
+are collected for MICROBATCH_WINDOW_MS and answered by ONE generate
+(grouped by (prompt length, temperature), which the compiled function
+shares across the batch).
 """
 
 import json
 import os
 import sys
 import threading
+import time
 
 import numpy as np
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 sys.path.insert(0, os.environ.get("REPO_ROOT", "/root/repo"))
+
+
+class _WorkItem:
+    __slots__ = ("rows", "true_len", "n", "temp", "done", "result", "error")
+
+    def __init__(self, rows, true_len, n, temp):
+        self.rows = rows          # list[list[int]], already validated
+        self.true_len = true_len
+        self.n = n                # per-item reply slice length
+        self.temp = temp
+        self.done = threading.Event()
+        self.result = None        # list[list[int]] once served
+        self.error = None
+
+
+class _MicroBatcher:
+    """Collect concurrent requests into one generate call.
+
+    Groupable = same (true_len, temperature): the compiled function
+    takes ONE traced length/temperature for the whole batch.  Items
+    keep FIFO order; a window (ms) after the first arrival lets
+    concurrent clients join the batch — the latency cost is the
+    window, the win is that N clients share one chip dispatch.
+    """
+
+    def __init__(self, run_group, capacity: int, window_s: float):
+        self._run_group = run_group   # fn(items) -> None (fills results)
+        self._capacity = capacity
+        self._window_s = window_s
+        self._cv = threading.Condition()
+        self._pending = []
+        self._thread = threading.Thread(
+            target=self._loop, name="microbatch", daemon=True
+        )
+        self._thread.start()
+
+    def submit(self, item: _WorkItem):
+        with self._cv:
+            self._pending.append(item)
+            self._cv.notify()
+        if not item.done.wait(timeout=600):
+            with self._cv:
+                # abandoned work must not reach the chip later: a
+                # wedged generate would otherwise leave a backlog of
+                # dead requests ahead of live ones on recovery
+                try:
+                    self._pending.remove(item)
+                except ValueError:
+                    pass  # already grouped: the result will be dropped
+            raise RuntimeError("generate timed out in the batch queue")
+        if item.error is not None:
+            raise item.error
+        return item.result
+
+    def _rows_pending(self) -> int:
+        return sum(len(item.rows) for item in self._pending)
+
+    def _loop(self):
+        while True:
+            with self._cv:
+                while not self._pending:
+                    self._cv.wait()
+                if self._window_s > 0:
+                    # recruit peers for up to the window — but a FULL
+                    # batch dispatches immediately (the window is only
+                    # paid when it can still buy merging)
+                    deadline = time.monotonic() + self._window_s
+                    while self._rows_pending() < self._capacity:
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            break
+                        self._cv.wait(timeout=remaining)
+                if not self._pending:
+                    continue  # sole item timed out and removed itself
+                head = self._pending[0]
+                key = (head.true_len, head.temp)
+                group, rest, used = [], [], 0
+                for item in self._pending:
+                    if (
+                        (item.true_len, item.temp) == key
+                        and used + len(item.rows) <= self._capacity
+                    ):
+                        group.append(item)
+                        used += len(item.rows)
+                    else:
+                        rest.append(item)
+                self._pending = rest
+            try:
+                self._run_group(group)
+            except Exception as e:  # noqa: BLE001 — fan the error out
+                for item in group:
+                    item.error = e
+            for item in group:
+                item.done.set()
 
 
 def main() -> int:
@@ -82,6 +184,54 @@ def main() -> int:
     ))
     lock = threading.Lock()
 
+    def run_group(items):
+        """ONE generate for a compatible group of requests."""
+        if len(items) > 1:
+            print(
+                f"microbatch: {len(items)} requests / "
+                f"{sum(len(i.rows) for i in items)} rows in one generate",
+                flush=True,
+            )
+        true_len, temp = items[0].true_len, items[0].temp
+        padded = jnp.zeros((batch, prompt_len), jnp.int32)
+        i = 0
+        for item in items:
+            for row in item.rows:
+                padded = padded.at[i, : len(row)].set(
+                    jnp.asarray(row, jnp.int32)
+                )
+                i += 1
+        # fresh entropy per batch: hashing only the prompt made
+        # temperature>0 replies deterministic per process
+        seed = int.from_bytes(os.urandom(4), "little")
+        with lock:  # one generate at a time per chip
+            out = gen(
+                params, padded,
+                jax.random.key(seed),
+                jnp.float32(temp),
+                jnp.int32(true_len),
+            )
+        # ONE bulk device->host fetch, then slice in numpy: per-element
+        # int(out[i, j]) would be a separate transfer each (~100ms over
+        # a TPU relay — 256 of them turned a 1.5s generate into a 36s
+        # reply)
+        host_out = np.asarray(jax.device_get(out))
+        i = 0
+        for item in items:
+            item.result = [
+                [int(t) for t in host_out[i + r, : item.n]]
+                for r in range(len(item.rows))
+            ]
+            i += len(item.rows)
+
+    window_s = float(os.environ.get("MICROBATCH_WINDOW_MS", "5")) / 1e3
+    # with a 1-row server there is nothing to batch: the direct path
+    # keeps zero added latency (and bit-identical single-client flow)
+    batcher = (
+        _MicroBatcher(run_group, capacity=batch, window_s=window_s)
+        if batch > 1 else None
+    )
+
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, fmt, *args):
             pass
@@ -121,36 +271,16 @@ def main() -> int:
                         f"max_new_tokens must be >= 1, got {n}"
                     )
                 n = min(n, new_tokens)
-                padded = jnp.zeros((batch, prompt_len), jnp.int32)
-                for i, row in enumerate(rows):
-                    row = [int(t) % config.vocab for t in row]
-                    # RIGHT-pad: real tokens first, pads after (causal
-                    # attention never lets real positions see them)
-                    padded = padded.at[i, : len(row)].set(
-                        jnp.asarray(row, jnp.int32)
-                    )
-                # fresh entropy per request: hashing only the prompt
-                # made temperature>0 replies deterministic per process
-                seed = int.from_bytes(os.urandom(4), "little")
-                with lock:  # one generate at a time per chip
-                    out = gen(
-                        params, padded,
-                        jax.random.key(seed),
-                        jnp.float32(temp),
-                        jnp.int32(true_len),
-                    )
-                # ONE bulk device->host fetch, then slice in numpy:
-                # per-element int(out[i, j]) would be a separate
-                # transfer each (~100ms over a TPU relay — 256 of
-                # them turned a 1.5s generate into a 36s reply)
-                host_out = np.asarray(jax.device_get(out))
-                reply = {
-                    "tokens": [
-                        [int(t) for t in host_out[i, :n]]
-                        for i in range(len(rows))
-                    ]
-                }
-                payload = json.dumps(reply).encode()
+                clean_rows = [
+                    [int(t) % config.vocab for t in row] for row in rows
+                ]
+                item = _WorkItem(clean_rows, true_len, n, temp)
+                if batcher is not None:
+                    result = batcher.submit(item)
+                else:
+                    run_group([item])
+                    result = item.result
+                payload = json.dumps({"tokens": result}).encode()
                 self.send_response(200)
             except Exception as e:  # noqa: BLE001 — surface to client
                 payload = json.dumps({"error": str(e)}).encode()

@@ -137,3 +137,94 @@ def test_inference_pod_serves_generate(tmp_path):
         assert any(str(port) in addr for addr in body["address"])
     finally:
         agent.shutdown()
+
+
+def test_microbatching_merges_concurrent_clients(tmp_path):
+    """SERVE_BATCH > 1: concurrent single-prompt clients are answered
+    by ONE generate call (grouped by prompt length + temperature) with
+    each client's own correct greedy continuation — concurrency must
+    not change any answer."""
+    import threading
+
+    env = {**TINY_ENV, "SERVE_BATCH": "4", "MICROBATCH_WINDOW_MS": "60"}
+    spec = from_yaml_file(
+        os.path.join(REPO, "frameworks", "jax", "svc_serve.yml"), env
+    )
+    builder = SchedulerBuilder(
+        spec,
+        SchedulerConfig(
+            sandbox_root=str(tmp_path / "sbx"), backoff_enabled=False
+        ),
+        MemPersister(),
+    )
+    from dcos_commons_tpu.offer.inventory import SliceInventory
+
+    builder.set_inventory(SliceInventory([TpuHost(
+        host_id="h0", hostname="127.0.0.1", generation="v5e",
+        grid=(0, 0), chip_block=(1, 1), cpus=8.0, memory_mb=16384,
+        ports=((23100, 23200),),
+    )]))
+    agent = LocalProcessAgent(str(tmp_path / "sbx"))
+    builder.set_agent(agent)
+    scheduler = builder.build()
+    try:
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            scheduler.run_cycle()
+            if scheduler.deploy_manager.get_plan().is_complete:
+                break
+            time.sleep(0.2)
+        assert scheduler.deploy_manager.get_plan().is_complete
+        info = scheduler.state_store.fetch_task("server-0-api")
+        port = int(info.env["PORT_HTTP"])
+
+        def post(payload):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/generate",
+                data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                return json.loads(resp.read())
+
+        # sequential oracle answers, one per distinct prompt
+        prompts = [[1, 2, 3], [4, 5, 6], [7, 8, 9], [3, 1, 2]]
+        expected = [
+            post({"tokens": [p], "max_new_tokens": 6})["tokens"][0]
+            for p in prompts
+        ]
+        # now the same four prompts CONCURRENTLY: same answers
+        results = [None] * len(prompts)
+        errors = []
+
+        def client(i):
+            try:
+                results[i] = post(
+                    {"tokens": [prompts[i]], "max_new_tokens": 6}
+                )["tokens"][0]
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=client, args=(i,))
+            for i in range(len(prompts))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, errors
+        assert results == expected
+        # the worker's log shows at least one merged batch
+        stdout_path = tmp_path / "sbx" / "server-0-api" / "stdout"
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if "microbatch:" in stdout_path.read_text():
+                break
+            time.sleep(0.2)
+        assert "microbatch:" in stdout_path.read_text(), (
+            "concurrent clients were never merged into one generate"
+        )
+    finally:
+        agent.shutdown()
