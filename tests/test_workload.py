@@ -409,6 +409,26 @@ def test_moe_generate_matches_forward_chain():
             seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
 
 
+def test_moe_generate_with_int8_kv_cache():
+    """The int8 KV cache composes with the MoE variant (the quantized
+    path is FFN-agnostic): generation runs and closely tracks the
+    exact cache."""
+    from dcos_commons_tpu.models import generate
+
+    cfg = TransformerConfig(
+        **{**MOE_CFG.__dict__, "moe_capacity_factor": 8.0}
+    )
+    params = init_params(cfg, jax.random.key(0))
+    prompt, _ = synthetic_tokens(jax.random.key(7), 2, 6, cfg.vocab)
+    exact = generate(cfg, params, prompt, max_new_tokens=8)
+    quant = generate(
+        cfg, params, prompt, max_new_tokens=8, kv_dtype="int8"
+    )
+    assert quant.shape == exact.shape
+    agree = float(jnp.mean((exact == quant).astype(jnp.float32)))
+    assert agree >= 0.75, f"only {agree:.0%} of greedy tokens agree"
+
+
 def test_moe_rejected_in_pipeline_path():
     from dcos_commons_tpu.models import pipeline_forward
 
