@@ -117,8 +117,11 @@ class DefaultRecoveryPlanManager(PlanManager):
             if recovery_type is RecoveryType.PERMANENT:
                 # PERMANENT is whole-pod destroy+replace: a subset of a
                 # pod re-placed from scratch would split colocation
-                # (fresh host, fresh volumes) from its live siblings
-                tasks = None
+                # (fresh host, fresh volumes) from its live siblings.
+                # "Whole pod" = its LAUNCHED footprint: launched FINISH
+                # init tasks rerun on the fresh volumes, but sidecars
+                # whose plan never ran must not be resurrected.
+                tasks = self._launched_tasks(pod_type, instances)
             existing = self._phases.get(key)
             if existing is not None:
                 if key in self._custom_keys:
@@ -139,12 +142,16 @@ class DefaultRecoveryPlanManager(PlanManager):
                     and s.requirement.recovery_type is RecoveryType.PERMANENT
                     for s in existing.steps
                 ):
-                    # escalate by REBUILDING at whole-pod scope — an
-                    # in-place flip of a subset phase would permanently
-                    # re-place only part of the pod.  The rebuild is a
-                    # replace, so it counts against the rate limit.
+                    # escalate by REBUILDING at the scoped task set —
+                    # an in-place flip of a subset phase would
+                    # permanently re-place only part of the pod, and a
+                    # None (all-tasks) rebuild would resurrect
+                    # completed FINISH tasks and never-launched
+                    # sidecars the scoping in _find_failed_pods
+                    # deliberately excludes.  The rebuild is a replace,
+                    # so it counts against the rate limit.
                     phase = self._make_phase(
-                        pod_type, list(instances), recovery_type, None
+                        pod_type, list(instances), recovery_type, tasks
                     )
                     if phase is not None:
                         self._phases[key] = phase
@@ -152,9 +159,10 @@ class DefaultRecoveryPlanManager(PlanManager):
                 elif covered is not None and not required <= covered:
                     # a wider failure (an essential task died) arrived
                     # while a subset phase was in flight: rebuild so the
-                    # new casualties are not deferred behind it
+                    # new casualties are not deferred behind it —
+                    # again at the SCOPED task set
                     phase = self._make_phase(
-                        pod_type, list(instances), recovery_type, None
+                        pod_type, list(instances), recovery_type, tasks
                     )
                     if phase is not None:
                         self._phases[key] = phase
@@ -166,6 +174,24 @@ class DefaultRecoveryPlanManager(PlanManager):
                 self._phases[key] = phase
                 if recovery_type is RecoveryType.PERMANENT:
                     self._record_replace(pod_type, instances)
+
+    def _launched_tasks(
+        self, pod_type: str, instances
+    ) -> Optional[List[str]]:
+        """Union of task names with stored TaskInfos across the
+        instances; None when every spec task has launched (the
+        all-tasks fast path)."""
+        pod = self._spec.pod(pod_type)
+        launched = set()
+        for task_spec in pod.tasks:
+            for index in instances:
+                full = task_full_name(pod_type, index, task_spec.name)
+                if self._state_store.fetch_task(full) is not None:
+                    launched.add(task_spec.name)
+                    break
+        if len(launched) == len(pod.tasks):
+            return None
+        return sorted(launched)
 
     def _phase_tasks(self, phase: Phase) -> Optional[Set[str]]:
         """Full task names a recovery phase covers; None when the phase
@@ -207,10 +233,13 @@ class DefaultRecoveryPlanManager(PlanManager):
             for index in range(pod.count):
                 failed_tasks: Dict[str, RecoveryType] = {}
                 essential_failed = False
+                launched: Set[str] = set()
                 for task_spec in pod.tasks:
                     full = task_full_name(pod.type, index, task_spec.name)
                     info = self._state_store.fetch_task(full)
                     status = self._state_store.fetch_status(full)
+                    if info is not None:
+                        launched.add(task_spec.name)
                     if info is None or status is None:
                         continue
                     needs, rtype = self._needs_recovery(
@@ -233,7 +262,24 @@ class DefaultRecoveryPlanManager(PlanManager):
                     if rtype is RecoveryType.PERMANENT:
                         gang_type = RecoveryType.PERMANENT
                 elif essential_failed:
-                    out[(pod.type, (index,))] = (rtype, None)  # whole pod
+                    # "whole pod" = the instance's LAUNCHED footprint:
+                    # the failed tasks plus running-goal siblings.
+                    # Tasks that never launched (sidecars whose plan
+                    # hasn't run) and FINISH/ONCE tasks that already
+                    # completed must NOT (re)run — pods whose replace
+                    # needs init choreography use a RecoveryPlanOverrider
+                    # (reference: DefaultRecoveryPlanManager recovering
+                    # stored tasks; HDFS/Cassandra overriders exist
+                    # precisely because default recovery does not rerun
+                    # bootstrap/format).
+                    relaunch = []
+                    for task_spec in pod.tasks:
+                        if task_spec.name not in launched:
+                            continue  # never launched
+                        if task_spec.name in failed_tasks or \
+                                task_spec.goal is GoalState.RUNNING:
+                            relaunch.append(task_spec.name)
+                    out[(pod.type, (index,))] = (rtype, sorted(relaunch))
                 else:
                     out[(pod.type, (index,))] = (
                         rtype, sorted(failed_tasks)

@@ -1,0 +1,282 @@
+"""frameworks/cassandra: the second standalone stateful service.
+
+Reference: frameworks/cassandra — seed computation + SeedsResource
+(Main.java:60-89), CassandraRecoveryPlanOverrider (:38-67, the
+replace_address relaunch), and parameterized backup/restore sidecar
+plans.  The sim flows here mirror the reference's ServiceTest +
+test_backup_and_restore.py shapes.
+"""
+
+import os
+
+
+from dcos_commons_tpu.plan.status import Status
+from dcos_commons_tpu.recovery.monitor import TestingFailureMonitor
+from dcos_commons_tpu.testing import (
+    AdvanceCycles,
+    ExpectDeploymentComplete,
+    ExpectLaunchedTasks,
+    ExpectPlanStatus,
+    SendTaskFailed,
+    SendTaskFinished,
+    SendTaskRunning,
+    ServiceTestRunner,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CASSANDRA_DIR = os.path.join(REPO, "frameworks", "cassandra")
+
+# load under a UNIQUE module name: test_hdfs imports ITS framework's
+# scheduler.py as `scheduler`, and a shared name would collide in
+# sys.modules when both test files run in one session
+import importlib.util  # noqa: E402
+
+_spec = importlib.util.spec_from_file_location(
+    "cassandra_scheduler", os.path.join(CASSANDRA_DIR, "scheduler.py")
+)
+_mod = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_mod)
+make_node_replace_overrider = _mod.make_node_replace_overrider
+make_seeds_routes = _mod.make_seeds_routes
+ring_name = _mod.ring_name
+
+
+def load_svc() -> str:
+    with open(os.path.join(CASSANDRA_DIR, "svc.yml")) as f:
+        return f.read()
+
+
+def deploy_ticks():
+    ticks = []
+    for i in range(3):
+        ticks += [
+            AdvanceCycles(1),
+            ExpectLaunchedTasks(f"node-{i}-server"),
+            SendTaskRunning(f"node-{i}-server"),
+        ]
+    ticks.append(ExpectDeploymentComplete())
+    return ticks
+
+
+def test_ring_deploys_serially():
+    runner = ServiceTestRunner(load_svc())
+    runner.run(deploy_ticks())
+    agent = runner.world.agent
+    # the durable ring volume is attached to every node
+    info = agent.task_info_of("node-1-server")
+    assert "cassandra-data" in info.volumes
+
+
+def test_seeds_endpoint_lists_first_two_nodes(monkeypatch):
+    """/v1/seeds = the SeedsResource analogue: first min(2, count)
+    instances with liveness, plus TASKCFG_ALL_REMOTE_SEEDS."""
+    runner = ServiceTestRunner(load_svc())
+    runner.run(deploy_ticks())
+    monkeypatch.setenv(
+        "TASKCFG_ALL_REMOTE_SEEDS",
+        "node-0.dc2.fleet.local,node-1.dc2.fleet.local",
+    )
+    ((method, pattern, handler),) = make_seeds_routes(
+        runner.world.scheduler
+    )
+    assert (method, pattern) == ("GET", r"/v1/seeds")
+    code, body = handler(None, None)
+    assert code == 200
+    assert [s["seed"] for s in body["seeds"]] == [
+        "node-0.cassandra.fleet.local",
+        "node-1.cassandra.fleet.local",
+    ]
+    assert all(s["state"] == "TASK_RUNNING" for s in body["seeds"])
+    assert body["remote_seeds"] == [
+        "node-0.dc2.fleet.local", "node-1.dc2.fleet.local",
+    ]
+
+
+def test_permanent_replace_carries_replace_address():
+    """The overrider's replacement launch injects REPLACE_ADDRESS so
+    the new node takes over the dead node's ring position (reference:
+    CassandraRecoveryPlanOverrider appending replace_address)."""
+    runner = ServiceTestRunner(load_svc())
+    spec = runner.spec
+
+    def hook(builder):
+        builder.add_recovery_overrider(make_node_replace_overrider(spec))
+        builder.set_failure_monitor(
+            TestingFailureMonitor(permanent_tasks=["node-1-server"])
+        )
+
+    runner._builder_hook = hook
+    runner.run(deploy_ticks())
+    first_id = runner.world.agent.task_id_of("node-1-server")
+    runner.run([
+        SendTaskFailed("node-1-server"),
+        AdvanceCycles(1),
+    ])
+    recovery = runner.world.scheduler.plan("recovery")
+    assert [s.name for s in recovery.phases[0].steps] == [
+        "replace-node-1"
+    ]
+    runner.run([
+        ExpectLaunchedTasks("node-1-server"),
+        SendTaskRunning("node-1-server"),
+        ExpectPlanStatus("recovery", Status.COMPLETE),
+    ])
+    agent = runner.world.agent
+    info = agent.task_info_of("node-1-server")
+    assert info.task_id != first_id
+    assert info.env["REPLACE_ADDRESS"] == ring_name(spec, 1)
+    assert info.env["REPLACE_ADDRESS"] == \
+        "node-1.cassandra.fleet.local"
+
+
+def test_transient_failure_keeps_default_recovery():
+    """Only PERMANENT replaces get the overrider: a transient crash
+    relaunches in place with NO replace_address (a live ring position
+    must not be taken over)."""
+    runner = ServiceTestRunner(load_svc())
+    spec = runner.spec
+    runner._builder_hook = lambda b: b.add_recovery_overrider(
+        make_node_replace_overrider(spec)
+    )
+    runner.run(deploy_ticks())
+    runner.run([
+        SendTaskFailed("node-2-server"),
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("node-2-server"),
+        SendTaskRunning("node-2-server"),
+        ExpectPlanStatus("recovery", Status.COMPLETE),
+    ])
+    info = runner.world.agent.task_info_of("node-2-server")
+    assert info.env.get("REPLACE_ADDRESS", "") == ""
+
+
+def test_backup_plan_parameterized():
+    """`plan start backup -p BACKUP_DIR=...` runs the backup sidecar
+    on every node inside the existing footprint (reference: cassandra
+    backup plans)."""
+    runner = ServiceTestRunner(load_svc())
+    runner.run(deploy_ticks())
+    scheduler = runner.world.scheduler
+    from dcos_commons_tpu.http.api import SchedulerApi
+
+    api = SchedulerApi(scheduler)
+    code, _body = api.plan_start(
+        "backup", {"BACKUP_DIR": "/mnt/backups/snap-1"}
+    )
+    assert code == 200
+    runner.run([AdvanceCycles(2)])
+    agent = runner.world.agent
+    for i in range(3):
+        info = agent.task_info_of(f"node-{i}-backup")
+        assert info is not None, f"backup sidecar {i} never launched"
+        assert info.env["BACKUP_DIR"] == "/mnt/backups/snap-1"
+        # sidecars join the node's existing footprint (same host)
+        server = agent.task_info_of(f"node-{i}-server")
+        assert info.agent_id == server.agent_id
+    runner.run([
+        SendTaskFinished("node-0-backup"),
+        SendTaskFinished("node-1-backup"),
+        SendTaskFinished("node-2-backup"),
+        ExpectPlanStatus("backup", Status.COMPLETE),
+    ])
+
+
+def test_cassandra_options_schema_clean():
+    from dcos_commons_tpu.tools.options import (
+        load_schema,
+        render_options,
+        validate_schema,
+    )
+
+    schema = load_schema(CASSANDRA_DIR)
+    assert schema is not None
+    assert validate_schema(schema) == []
+    env = render_options(schema, {"node": {"count": 5}})
+    assert env["NODE_COUNT"] == "5"
+    with open(os.path.join(CASSANDRA_DIR, "svc.yml")) as f:
+        yaml_text = f.read()
+    for env_name in env:
+        assert f"{{{{{env_name}" in yaml_text, env_name
+
+
+def test_default_permanent_replace_skips_never_launched_sidecars():
+    """WITHOUT the overrider: a default PERMANENT replace re-places
+    the pod's LAUNCHED footprint — the server (and any launched FINISH
+    init tasks), never the backup/restore sidecars whose plan hasn't
+    run (a spurious backup on replace would be an operator incident)."""
+    runner = ServiceTestRunner(load_svc())
+    runner._builder_hook = lambda b: b.set_failure_monitor(
+        TestingFailureMonitor(permanent_tasks=["node-0-server"])
+    )
+    runner.run(deploy_ticks())
+    runner.run([
+        SendTaskFailed("node-0-server"),
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("node-0-server"),
+        SendTaskRunning("node-0-server"),
+        ExpectPlanStatus("recovery", Status.COMPLETE),
+    ])
+    agent = runner.world.agent
+    assert agent.task_id_of("node-0-backup") is None
+    assert agent.task_id_of("node-0-restore") is None
+
+
+def test_widened_transient_recovery_stays_scoped():
+    """An essential failure arriving while a non-essential subset
+    phase is in flight widens the recovery — to the LAUNCHED
+    running-goal footprint, never to completed FINISH sidecars (r4
+    review finding: the widening rebuild used all-tasks scope)."""
+    yaml_text = """
+name: widen
+pods:
+  app:
+    count: 1
+    tasks:
+      server:
+        goal: RUNNING
+        cmd: "sleep 100"
+        cpus: 0.1
+        memory: 32
+      metrics:
+        goal: RUNNING
+        cmd: "sleep 100"
+        cpus: 0.1
+        memory: 32
+        essential: false
+      initjob:
+        goal: FINISH
+        cmd: "echo init"
+        cpus: 0.1
+        memory: 32
+"""
+    runner = ServiceTestRunner(yaml_text)
+    runner.run([
+        AdvanceCycles(1),
+        SendTaskRunning("app-0-server"),
+        SendTaskRunning("app-0-metrics"),
+        SendTaskFinished("app-0-initjob"),
+        ExpectDeploymentComplete(),
+    ])
+    init_id = runner.world.agent.task_id_of("app-0-initjob")
+    # non-essential fails -> subset recovery in flight; then the
+    # essential server fails -> recovery widens
+    runner.run([
+        SendTaskFailed("app-0-metrics"),
+        AdvanceCycles(1),
+        SendTaskFailed("app-0-server"),
+        AdvanceCycles(2),
+    ])
+    recovery = runner.world.scheduler.plan("recovery")
+    step_tasks = {
+        t for s in recovery.phases[0].steps
+        for t in s.requirement.tasks_to_launch
+    }
+    assert step_tasks == {"server", "metrics"}, step_tasks
+    runner.run([
+        SendTaskRunning("app-0-server"),
+        SendTaskRunning("app-0-metrics"),
+        AdvanceCycles(1),
+        ExpectPlanStatus("recovery", Status.COMPLETE),
+    ])
+    # the completed FINISH task was never relaunched
+    assert runner.world.agent.task_id_of("app-0-initjob") == init_id
