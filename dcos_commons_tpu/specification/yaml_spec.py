@@ -75,6 +75,20 @@ def render_template(text: str, env: Mapping[str, str]) -> str:
     prev = None
     while prev != text:
         prev, text = text, _SECTION_RE.sub(section_sub, text)
+    # an unbalanced or malformed tag ({{#VAR}} missing its {{/VAR}},
+    # a stray closer, a typo like {{#MY-FLAG}} or {{# FLAG}}) never
+    # matched _SECTION_RE and would otherwise pass through SILENTLY
+    # into the rendered YAML — fail loudly like missing variables do
+    # (TemplateUtils-style).  The detector is deliberately wider than
+    # the section grammar: anything section-shaped that survived
+    # expansion is an error.
+    leftover = re.findall(r"\{\{\s*[#^/][^}]*\}\}", text)
+    if leftover:
+        raise SpecError(
+            f"unbalanced or malformed section tags: "
+            f"{sorted(set(leftover))} — every {{{{#VAR}}}}/{{{{^VAR}}}} "
+            f"needs a matching {{{{/VAR}}}} and names are [A-Za-z0-9_]"
+        )
     missing = []
 
     def sub(match: re.Match) -> str:

@@ -403,13 +403,20 @@ class StateServer:
                 status = self._log.status()
                 return {
                     "seq": status["seq"],
+                    "stream_id": self._log.stream_id,
                     "nodes": dump_tree(self._backend),
                 }
         if route == "/v1/repl/pull":
+            standby_id = str(body.get("standby_id", ""))
+            if not standby_id:
+                # anonymous pullers would collide as "" and bypass the
+                # single-puller guard entirely
+                raise PersisterError("pull requires a standby_id")
             # long-poll OUTSIDE the kv lock: the log has its own
             return self._log.pull(
                 int(body.get("from_seq", 1)),
                 float(body.get("wait_s", 0.0)),
+                standby_id,
             )
         raise PersisterError(f"no route {route}")
 
@@ -453,12 +460,25 @@ class StateServer:
             base_seq = tail.applied_seq if tail is not None else 0
             self._role = ROLE_PRIMARY
             self._set_epoch(new_epoch)
-            try:
-                # a stale fenced marker (pre-reseed life) must not
-                # re-fence this server on its next restart
-                self._backend.recursive_delete(FENCED_NODE)
-            except PersisterError:
-                pass
+            from dcos_commons_tpu.storage.replication import StandbyTail
+
+            # best-effort cleanup of stale cluster markers: the fenced
+            # marker must not re-fence this server on restart, and the
+            # applied-seq/stream markers describe a standby life that
+            # primary-life writes will never update — if this server is
+            # later fenced and rejoins with --standby-of, a surviving
+            # stale applied value could line up with the new primary's
+            # ring and skip snapshot repair, silently keeping divergent
+            # unreplicated writes.
+            for node in (
+                FENCED_NODE,
+                StandbyTail.APPLIED_NODE,
+                StandbyTail.STREAM_NODE,
+            ):
+                try:
+                    self._backend.recursive_delete(node)
+                except PersisterError:
+                    pass
             self._log.reset(base_seq)
             self._leases = self._load_leases()
         if tail is not None:

@@ -44,6 +44,7 @@ from typing import Dict, List, Optional, Tuple
 from dcos_commons_tpu.storage.persister import (
     DeleteOp,
     Persister,
+    PersisterError,
     SetOp,
     TransactionOp,
 )
@@ -130,14 +131,22 @@ class ReplicationLog:
 
     def __init__(self, max_entries: int = 8192,
                  sync_timeout_s: float = 2.0):
+        import uuid
+
         self._entries: deque = deque()  # (seq, [op dicts])
         self._cv = threading.Condition()
         self._next_seq = 1
         self._acked = 0
         self._last_pull = 0.0  # monotonic; 0 = never
         self._lagging = False
+        self._puller_id: Optional[str] = None
         self._max_entries = max_entries
         self.sync_timeout_s = sync_timeout_s
+        # identifies THIS ring of seq numbers: seqs are only comparable
+        # within one stream.  A standby whose persisted applied seq came
+        # from a DIFFERENT stream (old primary, pre-promotion life) must
+        # re-snapshot even when the raw numbers happen to line up.
+        self.stream_id = uuid.uuid4().hex
 
     # -- primary write path -------------------------------------------
 
@@ -175,13 +184,40 @@ class ReplicationLog:
 
     # -- standby pull path --------------------------------------------
 
-    def pull(self, from_seq: int, wait_s: float) -> dict:
+    def pull(self, from_seq: int, wait_s: float,
+             puller_id: str = "") -> dict:
         """Entries at/after ``from_seq``; pulling acks ``from_seq-1``.
         ``snapshot_needed`` when continuity from ``from_seq`` cannot
-        be proven (ring trimmed, or a fresh/restarted primary)."""
+        be proven (ring trimmed, or a fresh/restarted primary).
+
+        One standby at a time: the single _acked watermark means a
+        second concurrent puller would advance the ack past writes the
+        slower standby never copied — promoting the slower one would
+        then lose writes the primary acked as replicated.  A pull from
+        a different ``puller_id`` while the current one is attached is
+        rejected; after the attach window lapses the new puller takes
+        over and the stale watermark is voided."""
         wait_s = max(0.0, min(wait_s, MAX_PULL_WAIT_S))
         deadline = time.monotonic() + wait_s
         with self._cv:
+            now = time.monotonic()
+            if (
+                self._puller_id is not None
+                and puller_id != self._puller_id
+                and self._last_pull > 0.0
+                and now - self._last_pull <= ATTACH_WINDOW_S
+            ):
+                raise PersisterError(
+                    f"a standby ({self._puller_id}) is already "
+                    "attached; one standby per primary"
+                )
+            if puller_id != self._puller_id:
+                # takeover (first attach, or the old standby is gone):
+                # the previous watermark says nothing about THIS
+                # standby's tree — it must re-earn every ack
+                self._puller_id = puller_id
+                self._acked = 0
+                self._lagging = False
             self._last_pull = time.monotonic()
             first = self._entries[0][0] if self._entries else self._next_seq
             if not (first <= from_seq <= self._next_seq):
@@ -193,7 +229,11 @@ class ReplicationLog:
                 # behind: mark lagging so writers don't block on it
                 # while it snapshots.
                 self._lagging = True
-                return {"snapshot_needed": True, "seq": self._next_seq - 1}
+                return {
+                    "snapshot_needed": True,
+                    "seq": self._next_seq - 1,
+                    "stream_id": self.stream_id,
+                }
             ack = min(from_seq - 1, self._next_seq - 1)
             if ack > self._acked:
                 self._acked = ack
@@ -210,7 +250,7 @@ class ReplicationLog:
                 {"seq": seq, "ops": ops}
                 for seq, ops in self._entries if seq >= from_seq
             ]
-            return {"entries": entries}
+            return {"entries": entries, "stream_id": self.stream_id}
 
     # -- introspection ------------------------------------------------
 
@@ -231,12 +271,18 @@ class ReplicationLog:
     def reset(self, base_seq: int) -> None:
         """Adopt a seq base after promotion: the new primary's log
         continues where its replica stream left off."""
+        import uuid
+
         with self._cv:
             self._entries.clear()
             self._next_seq = base_seq + 1
             self._acked = 0
             self._last_pull = 0.0
             self._lagging = False
+            self._puller_id = None
+            # a NEW stream: the promoted server's ring is not the old
+            # primary's, even though the seq numbering continues
+            self.stream_id = uuid.uuid4().hex
 
 
 class StandbyTail:
@@ -250,6 +296,10 @@ class StandbyTail:
     """
 
     APPLIED_NODE = "/__cluster__/repl_applied"
+    # the stream the applied seq belongs to: seqs from one primary's
+    # ring say nothing about another's, so a stream mismatch on pull
+    # forces snapshot repair even when the numbers line up
+    STREAM_NODE = "/__cluster__/repl_stream"
 
     def __init__(
         self,
@@ -260,10 +310,14 @@ class StandbyTail:
         ca_file: str = "",
         on_epoch=None,
     ):
+        import uuid
+
         from dcos_commons_tpu.storage.remote import RemotePersister
 
         self._backend = backend
         self._lock = backend_lock
+        # identifies THIS standby to the primary's single-puller guard
+        self._standby_id = uuid.uuid4().hex
         # reuse the HTTP plumbing; repl endpoints are server-to-server
         self._client = RemotePersister(
             primary_url, timeout_s=MAX_PULL_WAIT_S + 5.0,
@@ -274,6 +328,18 @@ class StandbyTail:
         self._thread: Optional[threading.Thread] = None
         self.last_error: str = ""
         self.applied_seq = self._load_applied()
+        self.stream_id = (
+            self._backend.get_or_none(self.STREAM_NODE) or b""
+        ).decode()
+        from dcos_commons_tpu.storage.remote import FENCED_NODE
+
+        if self.applied_seq and backend.exists(FENCED_NODE):
+            # belt-and-braces vs promote()'s applied-seq reset: a tree
+            # that carries a fenced marker lived a primary (or fenced-
+            # primary) life after this applied seq was written, so the
+            # value no longer describes the tree — bootstrap from a
+            # full snapshot instead of resuming the tail
+            self.applied_seq = 0
 
     def _load_applied(self) -> int:
         raw = self._backend.get_or_none(self.APPLIED_NODE)
@@ -314,10 +380,19 @@ class StandbyTail:
                 out = self._client._call("/v1/repl/pull", {
                     "from_seq": self.applied_seq + 1,
                     "wait_s": MAX_PULL_WAIT_S,
+                    "standby_id": self._standby_id,
                 })
                 if self._stop.is_set():
                     return  # promoted mid-pull: nothing more applies
                 self._note_epoch(out)
+                stream = out.get("stream_id", "")
+                if stream and stream != self.stream_id:
+                    # a DIFFERENT ring (repointed standby, restarted
+                    # or promoted primary): our applied seq is from
+                    # another stream and proves nothing even when the
+                    # primary's continuity check happens to pass
+                    need_snapshot = True
+                    continue
                 if out.get("snapshot_needed"):
                     need_snapshot = True
                     continue
@@ -338,6 +413,7 @@ class StandbyTail:
                 tuple(node) for node in out.get("nodes", [])
             ])
             self.applied_seq = int(out["seq"])
+            self.stream_id = out.get("stream_id", "")
             self._store_applied()
 
     def _apply_entries(self, entries: List[dict]) -> bool:
@@ -366,9 +442,10 @@ class StandbyTail:
         return True
 
     def _store_applied(self) -> None:
-        self._backend.set(
-            self.APPLIED_NODE, str(self.applied_seq).encode()
-        )
+        self._backend.apply([
+            SetOp(self.APPLIED_NODE, str(self.applied_seq).encode()),
+            SetOp(self.STREAM_NODE, self.stream_id.encode()),
+        ])
 
     def _note_epoch(self, out: dict) -> None:
         epoch = out.get("epoch")

@@ -19,6 +19,7 @@ shares across the batch).
 """
 
 import json
+import math
 import os
 import sys
 import threading
@@ -53,10 +54,14 @@ class _MicroBatcher:
     window, the win is that N clients share one chip dispatch.
     """
 
-    def __init__(self, run_group, capacity: int, window_s: float):
+    def __init__(
+        self, run_group, capacity: int, window_s: float,
+        queue_timeout_s: float = 600.0,
+    ):
         self._run_group = run_group   # fn(items) -> None (fills results)
         self._capacity = capacity
         self._window_s = window_s
+        self._queue_timeout_s = queue_timeout_s
         self._cv = threading.Condition()
         self._pending = []
         self._thread = threading.Thread(
@@ -68,7 +73,7 @@ class _MicroBatcher:
         with self._cv:
             self._pending.append(item)
             self._cv.notify()
-        if not item.done.wait(timeout=600):
+        if not item.done.wait(timeout=self._queue_timeout_s):
             with self._cv:
                 # abandoned work must not reach the chip later: a
                 # wedged generate would otherwise leave a backlog of
@@ -102,10 +107,14 @@ class _MicroBatcher:
                         self._cv.wait(timeout=remaining)
                 if not self._pending:
                     continue  # sole item timed out and removed itself
+                # the head ALWAYS dispatches: grouping by key equality
+                # alone would starve a head whose key never equals
+                # itself (e.g. a NaN temperature that slipped past
+                # validation) and stall every request queued behind it
                 head = self._pending[0]
                 key = (head.true_len, head.temp)
-                group, rest, used = [], [], 0
-                for item in self._pending:
+                group, rest, used = [head], [], len(head.rows)
+                for item in self._pending[1:]:
                     if (
                         (item.true_len, item.temp) == key
                         and used + len(item.rows) <= self._capacity
@@ -227,8 +236,12 @@ def main() -> int:
     window_s = float(os.environ.get("MICROBATCH_WINDOW_MS", "5")) / 1e3
     # with a 1-row server there is nothing to batch: the direct path
     # keeps zero added latency (and bit-identical single-client flow)
+    queue_timeout_s = float(os.environ.get("SERVE_QUEUE_TIMEOUT_S", "600"))
     batcher = (
-        _MicroBatcher(run_group, capacity=batch, window_s=window_s)
+        _MicroBatcher(
+            run_group, capacity=batch, window_s=window_s,
+            queue_timeout_s=queue_timeout_s,
+        )
         if batch > 1 else None
     )
 
@@ -265,6 +278,13 @@ def main() -> int:
                         f"context {prompt_len}"
                     )
                 temp = float(body.get("temperature", 0.0))
+                if not math.isfinite(temp) or temp < 0.0:
+                    # json.loads accepts NaN/Infinity: a NaN group key
+                    # is never equal to itself and must not reach the
+                    # batcher (or the chip, where it poisons sampling)
+                    raise ValueError(
+                        f"temperature must be finite and >= 0, got {temp}"
+                    )
                 n = int(body.get("max_new_tokens", new_tokens))
                 if n < 1:
                     raise ValueError(

@@ -56,6 +56,29 @@ def test_render_template_boolean_sections():
     assert render_template(hidden, {}) == "ok"
 
 
+def test_render_template_unbalanced_sections_fail_loudly():
+    """A {{#VAR}} with a missing/mistyped closer never matches the
+    section regex and would pass through SILENTLY into the rendered
+    YAML (advisor r4) — it must fail like missing variables do."""
+    import pytest
+
+    from dcos_commons_tpu.specification.specs import SpecError
+
+    for bad in (
+        "a\n{{#FLAG}}\non\nz\n",          # no closer
+        "a\n{{#FLAG}}\non\n{{/FLGA}}\n",  # mistyped closer
+        "a\non\n{{/FLAG}}\nz\n",          # stray closer
+        "{{^FLAG}}off",                   # inverted, no closer
+        "{{#MY-FLAG}}x{{/MY-FLAG}}",      # hyphen: not section grammar
+        "{{# FLAG}}x{{/FLAG}}",           # stray space in the tag
+    ):
+        with pytest.raises(SpecError, match="section tags"):
+            render_template(bad, {"FLAG": "true"})
+    # balanced nesting still renders fine
+    nested = "{{#A}}x{{#B}}y{{/B}}z{{/A}}"
+    assert render_template(nested, {"A": "1", "B": "1"}) == "xyz"
+
+
 def test_enable_disable_yaml_flips_task_set():
     """TEST_BOOLEAN=false deploys only server-b; true deploys both
     (reference: test_enable_disable.py flows)."""

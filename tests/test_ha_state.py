@@ -352,6 +352,209 @@ def test_standby_restart_resumes_from_persisted_seq(tmp_path):
         primary.stop()
 
 
+def test_second_standby_puller_rejected_until_window_lapses():
+    """One standby per primary: the single ack watermark means a
+    second concurrent puller would advance the ack past writes the
+    slower standby never copied (advisor r4).  After the attach window
+    the new puller takes over and the stale watermark is voided."""
+    from dcos_commons_tpu.storage.replication import ATTACH_WINDOW_S
+
+    log = ReplicationLog(sync_timeout_s=0.2)
+    log.append([{"op": "set", "path": "/a", "value": ""}])
+    out = log.pull(from_seq=1, wait_s=0, puller_id="standby-a")
+    assert [e["seq"] for e in out["entries"]] == [1]
+    with pytest.raises(PersisterError, match="already attached"):
+        log.pull(from_seq=1, wait_s=0, puller_id="standby-b")
+    # standby-a acks seq 1
+    log.pull(from_seq=2, wait_s=0, puller_id="standby-a")
+    assert log.status()["acked_seq"] == 1
+    # standby-a dies; after the window, standby-b may take over — and
+    # a's watermark says nothing about b's tree, so it is voided
+    log._last_pull -= ATTACH_WINDOW_S + 1.0
+    out = log.pull(from_seq=1, wait_s=0, puller_id="standby-b")
+    assert [e["seq"] for e in out["entries"]] == [1]
+    assert log.status()["acked_seq"] == 0
+    seq = log.append([{"op": "set", "path": "/b", "value": ""}])
+    assert log.wait_replicated(seq) is False  # b has not copied it
+
+
+def test_two_live_standbys_only_one_attaches():
+    """E2e form: a second --standby-of server keeps retrying but never
+    corrupts the first one's replication stream."""
+    primary = StateServer(MemPersister()).start()
+    first = StateServer(MemPersister(), replicate_from=primary.url).start()
+    second = StateServer(MemPersister(), replicate_from=primary.url).start()
+    try:
+        client = RemotePersister(primary.url)
+        client.set("/svc/a", b"1")
+        # exactly ONE standby wins the attach (which one is a race);
+        # the other parks on the rejection, retrying
+        def rejected(server):
+            return "already attached" in server._tail.last_error
+
+        wait_until(
+            lambda: rejected(first) != rejected(second),
+            what="one standby rejected",
+        )
+        attached = second if rejected(first) else first
+        # and the attached standby keeps streaming normally
+        client.set("/svc/b", b"2")
+        wait_until(
+            lambda: attached._backend.get_or_none("/svc/b") == b"2",
+            what="attached standby still streams",
+        )
+    finally:
+        second.stop()
+        first.stop()
+        primary.stop()
+
+
+def test_ex_primary_rejoins_via_full_snapshot(tmp_path):
+    """A promoted standby's primary-life writes never advance its
+    applied seq: if it is later fenced and rejoins as a standby, a
+    surviving stale applied value could line up with the new primary's
+    ring and resume the tail WITHOUT snapshot repair — silently
+    keeping divergent unreplicated writes (advisor r4).  promote()
+    deletes the applied marker, so the rejoin always bootstraps from a
+    full snapshot and the divergent write is gone."""
+    from dcos_commons_tpu.storage.file_persister import FileWalPersister
+    from dcos_commons_tpu.storage.replication import StandbyTail
+
+    a = StateServer(MemPersister()).start()
+    b_dir = str(tmp_path / "b")
+    try:
+        RemotePersister(a.url).set("/svc/a", b"1")
+        b = StateServer(
+            FileWalPersister(b_dir), replicate_from=a.url
+        ).start()
+        wait_until(
+            lambda: b._backend.get_or_none("/svc/a") == b"1",
+            what="standby sync",
+        )
+        assert b._backend.exists(StandbyTail.APPLIED_NODE)
+        RemotePersister(b.url)._call("/v1/repl/promote", {})
+        # the applied marker is reset at promotion: primary-life
+        # writes would never update it
+        assert b._backend.get_or_none(StandbyTail.APPLIED_NODE) is None
+        # divergent primary-life write on b, then b is superseded
+        RemotePersister(b.url).set("/svc/divergent", b"x")
+        b.check_fence(9)
+        b.stop()
+    finally:
+        a.stop()
+    # a NEW primary with its own history; b rejoins as its standby
+    c = StateServer(MemPersister()).start()
+    try:
+        client = RemotePersister(c.url)
+        client.set("/svc/a", b"1")
+        client.set("/svc/c", b"3")
+        b2 = StateServer(
+            FileWalPersister(b_dir), replicate_from=c.url
+        ).start()
+        try:
+            # bootstrap was a FULL snapshot: trees equal, divergent gone
+            from dcos_commons_tpu.storage.replication import dump_tree
+
+            def user_tree(persister):
+                return {
+                    path: value for path, value in dump_tree(persister)
+                    if not path.startswith("/__cluster__")
+                }
+
+            wait_until(
+                lambda: user_tree(b2._backend) == user_tree(c._backend),
+                what="full-snapshot rejoin",
+            )
+            assert b2._backend.get_or_none("/svc/divergent") is None
+        finally:
+            b2.stop()
+    finally:
+        c.stop()
+
+
+def test_repointed_standby_forces_snapshot_on_stream_mismatch(tmp_path):
+    """Seq numbers are only comparable within ONE primary's stream: a
+    standby of X repointed at Y (whose ring happens to cover the
+    standby's next seq) must NOT resume the tail — Y's entries would
+    apply onto X's divergent tree silently.  The persisted stream id
+    catches what the numeric continuity check cannot."""
+    from dcos_commons_tpu.storage.file_persister import FileWalPersister
+    from dcos_commons_tpu.storage.replication import dump_tree
+
+    def user_tree(persister):
+        return {
+            path: value for path, value in dump_tree(persister)
+            if not path.startswith("/__cluster__")
+        }
+
+    s_dir = str(tmp_path / "standby")
+    x = StateServer(MemPersister()).start()
+    try:
+        RemotePersister(x.url).set("/svc/from-x", b"1")
+        s = StateServer(
+            FileWalPersister(s_dir), replicate_from=x.url
+        ).start()
+        wait_until(
+            lambda: s._backend.get_or_none("/svc/from-x") == b"1",
+            what="sync from X",
+        )
+        applied = s._tail.applied_seq
+        s.stop()
+    finally:
+        x.stop()
+    # Y's ring covers seq applied+1: numeric continuity would pass
+    y = StateServer(MemPersister()).start()
+    try:
+        client = RemotePersister(y.url)
+        for i in range(applied + 2):
+            client.set(f"/svc/from-y{i}", b"y")
+        s2 = StateServer(
+            FileWalPersister(s_dir), replicate_from=y.url
+        ).start()
+        try:
+            wait_until(
+                lambda: user_tree(s2._backend) == user_tree(y._backend),
+                what="snapshot repair on stream mismatch",
+            )
+            # X's write is GONE — the tail did not graft Y onto X
+            assert s2._backend.get_or_none("/svc/from-x") is None
+        finally:
+            s2.stop()
+    finally:
+        y.stop()
+
+
+def test_pull_route_requires_standby_id():
+    """Anonymous pullers would collide as "" and bypass the
+    single-puller guard entirely."""
+    primary = StateServer(MemPersister()).start()
+    try:
+        with pytest.raises(PersisterError, match="standby_id"):
+            RemotePersister(primary.url)._call(
+                "/v1/repl/pull", {"from_seq": 1, "wait_s": 0}
+            )
+    finally:
+        primary.stop()
+
+
+def test_standby_tail_distrusts_applied_seq_on_fenced_tree(tmp_path):
+    """Belt-and-braces for the same hazard: a tree carrying a fenced
+    marker lived a primary life after its applied seq was written, so
+    the tail must bootstrap from snapshot even if the marker-delete in
+    promote() was lost (e.g. crash between role flip and delete)."""
+    from dcos_commons_tpu.storage.file_persister import FileWalPersister
+    from dcos_commons_tpu.storage.remote import FENCED_NODE
+    from dcos_commons_tpu.storage.replication import StandbyTail
+
+    backend = FileWalPersister(str(tmp_path / "tree"))
+    backend.set(StandbyTail.APPLIED_NODE, b"17")
+    backend.set(FENCED_NODE, b"9")
+    import threading
+
+    tail = StandbyTail(backend, threading.Lock(), "http://127.0.0.1:9")
+    assert tail.applied_seq == 0  # forces snapshot bootstrap
+
+
 # -- process-level failover e2e ---------------------------------------
 
 

@@ -123,6 +123,11 @@ def test_inference_pod_serves_generate(tmp_path):
             {"tokens": [[1], [2]]},                 # > server batch
             {"tokens": [list(range(41))]},          # > context (40)
             {"tokens": [[]]},                       # empty prompt
+            # json.dumps emits bare NaN and the server's json.loads
+            # accepts it: a NaN group key would stall the batcher
+            {"tokens": [[1, 2]], "temperature": float("nan")},
+            {"tokens": [[1, 2]], "temperature": float("inf")},
+            {"tokens": [[1, 2]], "temperature": -1.0},
         ):
             try:
                 post(bad)
@@ -228,3 +233,79 @@ def test_microbatching_merges_concurrent_clients(tmp_path):
         )
     finally:
         agent.shutdown()
+
+
+def _load_serve_worker_module():
+    """Import serve_worker WITHOUT running main() (no jax needed:
+    model imports live inside main)."""
+    import importlib.util
+
+    path = os.path.join(REPO, "frameworks", "jax", "serve_worker.py")
+    spec = importlib.util.spec_from_file_location("serve_worker_ut", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_microbatcher_head_always_dispatches():
+    """A head whose group key never equals itself (NaN temperature)
+    must still dispatch — grouping by key equality alone would starve
+    it AND every request queued behind it until the queue timeout
+    (advisor r4).  The handler rejects NaN, so this guards the batcher
+    itself against any future non-self-equal key."""
+    import threading
+
+    sw = _load_serve_worker_module()
+    groups = []
+
+    def run_group(items):
+        groups.append(items)
+        for item in items:
+            item.result = [[0] * item.n for _ in item.rows]
+
+    batcher = sw._MicroBatcher(
+        run_group, capacity=4, window_s=0.0, queue_timeout_s=5.0
+    )
+    poison = sw._WorkItem([[1, 2]], 2, 4, float("nan"))
+    normal = sw._WorkItem([[3, 4]], 2, 4, 0.0)
+    threads = [
+        threading.Thread(target=batcher.submit, args=(item,))
+        for item in (poison, normal)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert poison.done.is_set(), "NaN-keyed head never dispatched"
+    assert normal.done.is_set(), "request behind the NaN head starved"
+    # the NaN item formed its own group; it never merged with normal
+    assert all(
+        len({id(i) for i in g} & {id(poison), id(normal)}) <= 1
+        or len(g) == 1
+        for g in groups
+    )
+
+
+def test_microbatcher_queue_timeout_configurable():
+    """SERVE_QUEUE_TIMEOUT_S plumbs through: a submit against a
+    wedged run_group raises after the configured timeout, not 600s."""
+    import threading
+
+    sw = _load_serve_worker_module()
+    wedge = threading.Event()
+
+    def run_group(items):
+        wedge.wait(30)  # simulate a wedged generate
+
+    batcher = sw._MicroBatcher(
+        run_group, capacity=2, window_s=0.0, queue_timeout_s=0.3
+    )
+    item = sw._WorkItem([[1]], 1, 2, 0.0)
+    t0 = time.monotonic()
+    try:
+        batcher.submit(item)
+        raise AssertionError("submit should have timed out")
+    except RuntimeError as e:
+        assert "timed out" in str(e)
+    assert time.monotonic() - t0 < 5.0
+    wedge.set()
