@@ -210,6 +210,7 @@ class AgentDaemon:
                 secret_env=entry.get("secret_env"),
                 kill_grace_s=float(entry.get("kill_grace_s", 5.0)),
                 uris=entry.get("uris"),
+                rlimits=entry.get("rlimits"),
             )
             launched.append(info.task_id)
         return launched

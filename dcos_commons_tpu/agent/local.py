@@ -299,6 +299,32 @@ class _Running:
         return None
 
 
+def _rlimit_preexec(rlimits: List[dict]):
+    """Child-side hook applying per-task resource limits between fork
+    and exec (reference: RLimitSpec -> Mesos RLimitInfo, enforced by
+    the containerizer; here setrlimit(2) directly).  A limit that
+    cannot be applied fails the launch — silently running without the
+    isolation the spec demanded is worse than not running."""
+    import resource
+
+    pairs = []
+    for rl in rlimits:
+        res = getattr(resource, str(rl["name"]))
+        soft = int(rl.get("soft", -1))
+        hard = int(rl.get("hard", -1))
+        pairs.append((
+            res,
+            resource.RLIM_INFINITY if soft < 0 else soft,
+            resource.RLIM_INFINITY if hard < 0 else hard,
+        ))
+
+    def apply():
+        for res, soft, hard in pairs:
+            resource.setrlimit(res, (soft, hard))
+
+    return apply
+
+
 def _proc_identity(pid: int) -> str:
     """Process start time from /proc — distinguishes a live pid from a
     recycled one.  Empty string when unavailable (non-Linux)."""
@@ -513,6 +539,7 @@ class LocalProcessAgent:
         secret_env: Optional[Dict[str, str]] = None,
         kill_grace_s: float = 5.0,
         uris: Optional[List[dict]] = None,
+        rlimits: Optional[List[dict]] = None,
     ) -> None:
         with self._lock:
             if info.task_id in self._tasks:
@@ -635,14 +662,23 @@ class LocalProcessAgent:
                     os.makedirs(record_dir, exist_ok=True)
                     self._prune_delivered_records(sandbox, keep=info.task_id)
                     if native_exe:
+                        argv = [
+                            native_exe,
+                            "--sandbox", sandbox,
+                            "--record-dir", record_dir,
+                            "--grace", str(kill_grace_s),
+                        ]
+                        for rl in rlimits or []:
+                            # applied by the supervisor in the child
+                            # between fork and exec (setrlimit(2))
+                            argv += [
+                                "--rlimit",
+                                f"{rl['name']}="
+                                f"{rl.get('soft', -1)}:{rl.get('hard', -1)}",
+                            ]
+                        argv += ["--", info.command]
                         process = subprocess.Popen(
-                            [
-                                native_exe,
-                                "--sandbox", sandbox,
-                                "--record-dir", record_dir,
-                                "--grace", str(kill_grace_s),
-                                "--", info.command,
-                            ],
+                            argv,
                             env=env,
                             start_new_session=True,
                         )
@@ -654,8 +690,18 @@ class LocalProcessAgent:
                             stdout=open(os.path.join(sandbox, "stdout"), "ab"),
                             stderr=open(os.path.join(sandbox, "stderr"), "ab"),
                             start_new_session=True,
+                            preexec_fn=(
+                                _rlimit_preexec(rlimits) if rlimits
+                                else None
+                            ),
                         )
-                except OSError as e:
+                except (OSError, ValueError,
+                        subprocess.SubprocessError) as e:
+                    # ValueError covers preexec_fn setrlimit failures:
+                    # CPython re-raises EPERM/EINVAL from the child as
+                    # ValueError in the parent — it must fail THIS
+                    # launch with an ERROR status, not escape into the
+                    # scheduler's plan loop
                     self._pending.append(
                         TaskStatus(
                             task_id=info.task_id,

@@ -217,6 +217,7 @@ class RemoteFleet(Agent):
         secret_env: Optional[Dict[str, str]] = None,
         kill_grace_s: float = 5.0,
         uris: Optional[List[dict]] = None,
+        rlimits: Optional[List[dict]] = None,
     ) -> None:
         client = self._clients.get(info.agent_id)
         if client is None:
@@ -231,6 +232,7 @@ class RemoteFleet(Agent):
             "secret_env": secret_env or {},
             "kill_grace_s": kill_grace_s,
             "uris": uris or [],
+            "rlimits": rlimits or [],
         }
         try:
             client.launch([entry])

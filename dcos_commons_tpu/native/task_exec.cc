@@ -18,7 +18,13 @@
 //
 // Usage:
 //   task_exec --sandbox DIR [--record-dir RD] [--grace SECONDS] \
-//             -- <shell command...>
+//             [--rlimit NAME=SOFT:HARD]... -- <shell command...>
+//
+// --rlimit applies a setrlimit(2) in the child between fork and exec
+// (reference: specification/RLimitSpec.java -> Mesos RLimitInfo on
+// the ContainerInfo); -1 means RLIM_INFINITY.  A limit that cannot
+// be applied fails the task before its command runs — running
+// without the isolation the spec demanded would defeat the point.
 //
 // Records (task.pid/child.pid/exit_status) go to --record-dir, which
 // the agent keys by task INCARNATION — two incarnations of one task
@@ -32,6 +38,7 @@
 #include <stdio.h>
 #include <stdlib.h>
 #include <string.h>
+#include <sys/resource.h>
 #include <sys/stat.h>
 #include <sys/types.h>
 #include <sys/wait.h>
@@ -39,6 +46,7 @@
 #include <unistd.h>
 
 #include <string>
+#include <vector>
 
 namespace {
 
@@ -72,6 +80,57 @@ double now_s() {
   return ts.tv_sec + ts.tv_nsec / 1e9;
 }
 
+struct RLimitArg {
+  int resource;
+  rlim_t soft;
+  rlim_t hard;
+};
+
+int rlimit_by_name(const char* name) {
+  struct Entry { const char* name; int resource; };
+  static const Entry kTable[] = {
+      {"RLIMIT_AS", RLIMIT_AS},           {"RLIMIT_CORE", RLIMIT_CORE},
+      {"RLIMIT_CPU", RLIMIT_CPU},         {"RLIMIT_DATA", RLIMIT_DATA},
+      {"RLIMIT_FSIZE", RLIMIT_FSIZE},     {"RLIMIT_MEMLOCK", RLIMIT_MEMLOCK},
+      {"RLIMIT_NOFILE", RLIMIT_NOFILE},   {"RLIMIT_NPROC", RLIMIT_NPROC},
+      {"RLIMIT_RSS", RLIMIT_RSS},         {"RLIMIT_STACK", RLIMIT_STACK},
+#ifdef RLIMIT_MSGQUEUE
+      {"RLIMIT_MSGQUEUE", RLIMIT_MSGQUEUE},
+#endif
+#ifdef RLIMIT_NICE
+      {"RLIMIT_NICE", RLIMIT_NICE},
+#endif
+#ifdef RLIMIT_RTPRIO
+      {"RLIMIT_RTPRIO", RLIMIT_RTPRIO},
+#endif
+#ifdef RLIMIT_RTTIME
+      {"RLIMIT_RTTIME", RLIMIT_RTTIME},
+#endif
+#ifdef RLIMIT_SIGPENDING
+      {"RLIMIT_SIGPENDING", RLIMIT_SIGPENDING},
+#endif
+  };
+  for (const Entry& e : kTable) {
+    if (strcmp(name, e.name) == 0) return e.resource;
+  }
+  return -1;
+}
+
+// "NAME=SOFT:HARD" (-1 = infinity) -> RLimitArg; false on parse error
+bool parse_rlimit(const char* arg, RLimitArg* out) {
+  const char* eq = strchr(arg, '=');
+  const char* colon = eq ? strchr(eq, ':') : nullptr;
+  if (!eq || !colon) return false;
+  std::string name(arg, eq - arg);
+  out->resource = rlimit_by_name(name.c_str());
+  if (out->resource < 0) return false;
+  long long soft = atoll(eq + 1);
+  long long hard = atoll(colon + 1);
+  out->soft = soft < 0 ? RLIM_INFINITY : static_cast<rlim_t>(soft);
+  out->hard = hard < 0 ? RLIM_INFINITY : static_cast<rlim_t>(hard);
+  return true;
+}
+
 }  // namespace
 
 int main(int argc, char** argv) {
@@ -79,6 +138,7 @@ int main(int argc, char** argv) {
   std::string record_dir;
   double grace_s = 5.0;
   int cmd_start = -1;
+  std::vector<RLimitArg> rlimits;
   for (int i = 1; i < argc; ++i) {
     if (strcmp(argv[i], "--sandbox") == 0 && i + 1 < argc) {
       sandbox = argv[++i];
@@ -86,6 +146,14 @@ int main(int argc, char** argv) {
       record_dir = argv[++i];
     } else if (strcmp(argv[i], "--grace") == 0 && i + 1 < argc) {
       grace_s = atof(argv[++i]);
+    } else if (strcmp(argv[i], "--rlimit") == 0 && i + 1 < argc) {
+      RLimitArg rl;
+      if (!parse_rlimit(argv[i + 1], &rl)) {
+        fprintf(stderr, "task_exec: bad --rlimit %s\n", argv[i + 1]);
+        return 64;
+      }
+      rlimits.push_back(rl);
+      ++i;
     } else if (strcmp(argv[i], "--") == 0) {
       cmd_start = i + 1;
       break;
@@ -131,6 +199,13 @@ int main(int argc, char** argv) {
     if (out >= 0) dup2(out, STDOUT_FILENO);
     if (err >= 0) dup2(err, STDERR_FILENO);
     if (chdir(sandbox.c_str()) != 0) _exit(71);
+    for (const RLimitArg& rl : rlimits) {
+      struct rlimit lim = {rl.soft, rl.hard};
+      if (setrlimit(rl.resource, &lim) != 0) {
+        perror("task_exec: setrlimit");
+        _exit(72);
+      }
+    }
     execl("/bin/sh", "sh", "-c", command.c_str(), (char*)nullptr);
     perror("task_exec: exec");
     _exit(127);

@@ -356,6 +356,14 @@ class DefaultScheduler:
                         }
                         for u in task_spec.uris
                     ]
+                if pod.rlimits:
+                    # the agent applies these via setrlimit(2) in the
+                    # task's exec path (reference: RLimitSpec ->
+                    # Mesos RLimitInfo on the ContainerInfo)
+                    kwargs["rlimits"] = [
+                        {"name": r.name, "soft": r.soft, "hard": r.hard}
+                        for r in pod.rlimits
+                    ]
                 launch_one(
                     info,
                     readiness=None if paused else task_spec.readiness_check,

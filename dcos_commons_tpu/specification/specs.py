@@ -150,6 +150,72 @@ class SecretSpec:
         return _re.sub(r"[^A-Z0-9]", "_", self.secret.upper())
 
 
+# "unlimited" sentinel for rlimit values (reference:
+# RLimitSpec.RLIMIT_INFINITY)
+RLIMIT_INFINITY = -1
+
+
+def valid_rlimit_names() -> frozenset:
+    """The rlimits this host can enforce (``man setrlimit(2)``).
+
+    Derived from the stdlib ``resource`` module so the set matches
+    what the agent can actually apply; a static POSIX core is the
+    fallback for exotic platforms."""
+    try:
+        import resource
+
+        return frozenset(
+            n for n in dir(resource) if n.startswith("RLIMIT_")
+        )
+    except ImportError:  # pragma: no cover — non-POSIX dev box
+        return frozenset({
+            "RLIMIT_AS", "RLIMIT_CORE", "RLIMIT_CPU", "RLIMIT_DATA",
+            "RLIMIT_FSIZE", "RLIMIT_MEMLOCK", "RLIMIT_NOFILE",
+            "RLIMIT_NPROC", "RLIMIT_RSS", "RLIMIT_STACK",
+        })
+
+
+@dataclass(frozen=True)
+class RLimitSpec:
+    """One per-task resource limit (reference:
+    specification/RLimitSpec.java — name plus optional soft/hard,
+    both-or-neither, soft <= hard; enforced at task exec time by the
+    agent via ``setrlimit(2)``).
+
+    On a shared TPU-VM host this is a real isolation feature: an fd
+    or nproc leak in one service's task must not take out the
+    co-scheduled services on the same host.  ``-1`` means unlimited
+    (RLIMIT_INFINITY)."""
+
+    name: str
+    soft: int = RLIMIT_INFINITY
+    hard: int = RLIMIT_INFINITY
+
+    def __post_init__(self) -> None:
+        if self.name not in valid_rlimit_names():
+            raise SpecError(
+                f"{self.name!r} is not a valid rlimit; expected one of "
+                f"{sorted(valid_rlimit_names())} (man setrlimit(2))"
+            )
+        soft_set = self.soft != RLIMIT_INFINITY
+        hard_set = self.hard != RLIMIT_INFINITY
+        if soft_set != hard_set:
+            raise SpecError(
+                f"rlimit {self.name}: soft and hard limits must be "
+                "set together (or both left unlimited)"
+            )
+        if self.soft < RLIMIT_INFINITY or self.hard < RLIMIT_INFINITY:
+            raise SpecError(
+                f"rlimit {self.name}: limits must be >= 0 "
+                f"(or -1 for unlimited)"
+            )
+        if soft_set and self.soft > self.hard:
+            raise SpecError(
+                f"rlimit {self.name}: soft limit {self.soft} exceeds "
+                f"hard limit {self.hard}"
+            )
+
+
 @dataclass(frozen=True)
 class TransportEncryptionSpec:
     """Reference: specification/TransportEncryptionSpec (tls.yml
@@ -245,6 +311,9 @@ class PodSpec:
     # pod-level secret refs applied to every task of the pod
     # (reference: RawPod secrets block, secrets.yml)
     secrets: Tuple[SecretSpec, ...] = ()
+    # per-task resource limits applied to every task of the pod at
+    # exec time (reference: RawPod rlimits block, svc.yml:9-13)
+    rlimits: Tuple[RLimitSpec, ...] = ()
 
     def task(self, name: str) -> TaskSpec:
         for t in self.tasks:
@@ -403,6 +472,7 @@ def _decode_pod(data: Dict[str, Any]) -> PodSpec:
         allow_decommission=data.get("allow_decommission", False),
         share_pid_namespace=data.get("share_pid_namespace", False),
         secrets=tuple(SecretSpec(**s) for s in data.get("secrets", [])),
+        rlimits=tuple(RLimitSpec(**r) for r in data.get("rlimits", [])),
     )
 
 

@@ -256,7 +256,40 @@ def _map_pod(
         allow_decommission=bool(raw.get("allow-decommission", False)),
         share_pid_namespace=bool(raw.get("share-pid-namespace", False)),
         secrets=_map_secrets(pod_name, raw),
+        rlimits=_map_rlimits(pod_name, raw),
     )
+
+
+def _map_rlimits(pod_name: str, raw: Dict[str, Any]):
+    """Reference dialect (svc.yml:9-13): a map of rlimit name ->
+    {soft, hard}; both omitted means "named but unlimited"."""
+    from dcos_commons_tpu.specification.specs import (
+        RLIMIT_INFINITY,
+        RLimitSpec,
+    )
+
+    rlimits = []
+    for rl_name, rl_raw in (raw.get("rlimits") or {}).items():
+        rl_raw = rl_raw or {}
+        if not isinstance(rl_raw, dict):
+            raise SpecError(
+                f"pod {pod_name!r}: rlimit {rl_name} must be a "
+                f"{{soft, hard}} mapping, got {rl_raw!r}"
+            )
+        try:
+            rlimits.append(RLimitSpec(
+                name=str(rl_name),
+                soft=int(rl_raw.get("soft", RLIMIT_INFINITY)),
+                hard=int(rl_raw.get("hard", RLIMIT_INFINITY)),
+            ))
+        except SpecError as e:
+            raise SpecError(f"pod {pod_name!r}: {e}")
+        except (TypeError, ValueError) as e:
+            raise SpecError(
+                f"pod {pod_name!r}: rlimit {rl_name} has a non-integer "
+                f"limit: {e}"
+            )
+    return tuple(rlimits)
 
 
 def _map_secrets(pod_name: str, raw: Dict[str, Any]):

@@ -417,6 +417,7 @@ class StateServer:
                 int(body.get("from_seq", 1)),
                 float(body.get("wait_s", 0.0)),
                 standby_id,
+                str(body.get("stream_id", "")),
             )
         raise PersisterError(f"no route {route}")
 

@@ -185,7 +185,7 @@ class ReplicationLog:
     # -- standby pull path --------------------------------------------
 
     def pull(self, from_seq: int, wait_s: float,
-             puller_id: str = "") -> dict:
+             puller_id: str = "", stream_id: str = "") -> dict:
         """Entries at/after ``from_seq``; pulling acks ``from_seq-1``.
         ``snapshot_needed`` when continuity from ``from_seq`` cannot
         be proven (ring trimmed, or a fresh/restarted primary).
@@ -219,6 +219,20 @@ class ReplicationLog:
                 self._acked = 0
                 self._lagging = False
             self._last_pull = time.monotonic()
+            if stream_id and stream_id != self.stream_id:
+                # the standby's applied seq is from a DIFFERENT ring:
+                # acking from it would falsely mark this stream's
+                # writes replicated even when the raw numbers line up
+                # (a reattaching ex-standby after promotion).  Verified
+                # HERE, before the ack — the standby-side check alone
+                # runs after the primary has already released
+                # wait_replicated() waiters.
+                self._lagging = True
+                return {
+                    "snapshot_needed": True,
+                    "seq": self._next_seq - 1,
+                    "stream_id": self.stream_id,
+                }
             first = self._entries[0][0] if self._entries else self._next_seq
             if not (first <= from_seq <= self._next_seq):
                 # continuity unproven: the standby is behind this ring
@@ -381,6 +395,9 @@ class StandbyTail:
                     "from_seq": self.applied_seq + 1,
                     "wait_s": MAX_PULL_WAIT_S,
                     "standby_id": self._standby_id,
+                    # lets the PRIMARY refuse (and not ack) a seq from
+                    # another ring before wait_replicated() passes it
+                    "stream_id": self.stream_id,
                 })
                 if self._stop.is_set():
                     return  # promoted mid-pull: nothing more applies

@@ -33,6 +33,7 @@ class FakeAgent:
         self._active: Dict[str, TaskInfo] = {}
         self._queue: List[TaskStatus] = []
         self._acked_kills: Set[str] = set()
+        self.launch_rlimits: Dict[str, list] = {}
         self._lock = threading.RLock()
 
     # -- Agent interface ---------------------------------------------
@@ -43,13 +44,15 @@ class FakeAgent:
 
     def launch_one(self, info: TaskInfo, readiness=None, health=None,
                    templates=None, files=None, secret_env=None,
-                   kill_grace_s: float = 5.0, uris=None) -> None:
+                   kill_grace_s: float = 5.0, uris=None,
+                   rlimits=None) -> None:
         with self._lock:
             if info.task_id in self._active:
                 return  # idempotent, like the real agent
             self._active[info.task_id] = info
             self.launched.append(info)
             self.launch_uris[info.task_id] = list(uris or [])
+            self.launch_rlimits[info.task_id] = list(rlimits or [])
             self.checks[info.task_id] = {
                 "readiness": readiness,
                 "health": health,

@@ -707,3 +707,26 @@ def test_promote_cli_verb(tmp_path):
                 proc.wait(timeout=10)
             if log is not None:
                 log.close()
+
+
+def test_primary_refuses_ack_on_stream_mismatch():
+    """The PRIMARY must verify the puller's stream before acking: a
+    cross-stream from_seq that lands in this ring would otherwise
+    falsely release wait_replicated() for writes the standby is about
+    to discard (advisor follow-up on the stream-id fix)."""
+    log = ReplicationLog(sync_timeout_s=0.2)
+    for i in range(3):
+        log.append([{"op": "set", "path": f"/k{i}", "value": ""}])
+    out = log.pull(
+        from_seq=3, wait_s=0, puller_id="s", stream_id="other-ring"
+    )
+    assert out["snapshot_needed"] is True
+    assert log.status()["acked_seq"] == 0  # nothing acked
+    seq = log.append([{"op": "set", "path": "/k3", "value": ""}])
+    assert log.wait_replicated(seq) is False
+    # the SAME seq from the right stream acks normally
+    out = log.pull(
+        from_seq=3, wait_s=0, puller_id="s", stream_id=log.stream_id
+    )
+    assert "entries" in out
+    assert log.status()["acked_seq"] == 2

@@ -777,3 +777,310 @@ def test_graceful_shutdown_honors_kill_grace(tmp_path):
         assert cleanup.read_text().strip() == "cleaned-up"
     finally:
         agent.shutdown()
+
+
+def test_rlimits_enforced_in_real_task(tmp_path):
+    """rlimits.yml through a REAL agent: the task's own `ulimit`
+    reports the spec's soft/hard NOFILE limits and a zero core limit —
+    enforcement at exec time, not just spec plumbing (reference:
+    svc.yml:9-13 rlimits -> RLimitSpec.java -> containerizer
+    RLimitInfo)."""
+    from dcos_commons_tpu.agent.local import LocalProcessAgent
+    from dcos_commons_tpu.offer.inventory import SliceInventory
+    from dcos_commons_tpu.scheduler import SchedulerBuilder
+    from dcos_commons_tpu.specification import from_yaml
+    from dcos_commons_tpu.storage import MemPersister
+    from dcos_commons_tpu.testing import drive_until
+
+    spec = from_yaml(load("rlimits.yml"))
+    assert spec.pod("hello").rlimits[0].name == "RLIMIT_NOFILE"
+    builder = SchedulerBuilder(
+        spec,
+        SchedulerConfig(
+            sandbox_root=str(tmp_path / "sbx"),
+            backoff_enabled=False,
+            revive_capacity=1_000_000,
+        ),
+        MemPersister(),
+    )
+    builder.set_inventory(SliceInventory([TpuHost(host_id="h0")]))
+    agent = LocalProcessAgent(str(tmp_path / "sbx"))
+    builder.set_agent(agent)
+    scheduler = builder.build()
+    try:
+        assert drive_until(
+            scheduler,
+            lambda: scheduler.deploy_manager.get_plan().is_complete,
+        )
+        sandbox = tmp_path / "sbx" / "hello-0-server"
+        assert (sandbox / "nofile_soft").read_text().strip() == "64"
+        assert (sandbox / "nofile_hard").read_text().strip() == "128"
+        assert (sandbox / "core_soft").read_text().strip() == "0"
+    finally:
+        agent.shutdown()
+
+
+def test_custom_steps_serial_strategy_serial_steps():
+    """custom_steps.yml: operator-chosen step groupings — serial
+    strategy with serial per-task steps deploys first -> second ->
+    third per instance, instance by instance (reference:
+    CustomStepsTest.testSerialStrategySerialSteps)."""
+    runner = ServiceTestRunner(
+        load("custom_steps.yml"),
+        env={
+            "HELLO_COUNT": "2",
+            "DEPLOY_STRATEGY": "serial",
+            "DEPLOY_STEPS": '[["first"], ["second"]]',
+        },
+    )
+    runner.run([
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("hello-0-first"),
+        AdvanceCycles(2),
+        ExpectNoLaunches(),  # second waits for first to RUN
+        SendTaskRunning("hello-0-first"),
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("hello-0-second"),
+        SendTaskRunning("hello-0-second"),
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("hello-1-first"),
+        SendTaskRunning("hello-1-first"),
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("hello-1-second"),
+        SendTaskRunning("hello-1-second"),
+        ExpectDeploymentComplete(),
+    ])
+    # 'third' was not in the chosen steps: never launched
+    assert runner.world.agent.task_id_of("hello-0-third") is None
+
+
+def test_custom_steps_parallel_strategy_mixed_steps():
+    """custom_steps.yml: parallel strategy with a MIXED grouping —
+    [first, second] launch together, third gates on them (reference:
+    CustomStepsTest parallel/mixed permutations)."""
+    runner = ServiceTestRunner(
+        load("custom_steps.yml"),
+        env={
+            "HELLO_COUNT": "1",
+            "DEPLOY_STRATEGY": "parallel",
+            "DEPLOY_STEPS": '[["first", "second"], ["third"]]',
+        },
+    )
+    runner.run([
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("hello-0-first", "hello-0-second"),
+        SendTaskRunning("hello-0-first"),
+        AdvanceCycles(2),
+        ExpectNoLaunches(),  # third needs BOTH peers running
+        SendTaskRunning("hello-0-second"),
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("hello-0-third"),
+        SendTaskRunning("hello-0-third"),
+        ExpectDeploymentComplete(),
+    ])
+
+
+def test_executor_volume_shared_across_resource_set():
+    """executor_volume.yml: pod-level volumes (both the single
+    `volume:` and the `volumes:` map dialects) give every task of the
+    pod — servers and ONCE sidecars alike — ONE durable volume key;
+    the sidecar plan reuses it (reference: executor_volume.yml)."""
+    runner = ServiceTestRunner(
+        load("executor_volume.yml"),
+        env={"HELLO_COUNT": "1", "WORLD_COUNT": "1"},
+    )
+    runner.run([
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("hello-0-server"),
+        SendTaskRunning("hello-0-server"),
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("world-0-server"),
+        SendTaskRunning("world-0-server"),
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("world-0-once"),
+        SendTaskFinished("world-0-once"),
+        ExpectDeploymentComplete(),
+    ])
+    ledger = runner.world.scheduler.ledger
+
+    def volume_key(task: str, path: str) -> str:
+        vols = {}
+        for res in ledger.for_task(task):
+            vols.update(res.volumes or {})
+        assert path in vols, f"no {path} volume on {task}"
+        return vols[path]
+
+    # world's server and ONCE task share the pod volume
+    assert volume_key("world-0-server", "world-container-path") == \
+        volume_key("world-0-once", "world-container-path")
+    # the operator-run sidecar plan launches the hello sidecar on the
+    # SAME pod volume as the running server
+    runner.run([
+        PlanStart("sidecar"),
+        AdvanceCycles(2),
+        ExpectLaunchedTasks("hello-0-sidecar"),
+        SendTaskFinished("hello-0-sidecar"),
+        ExpectPlanStatus("sidecar", Status.COMPLETE),
+    ])
+    assert volume_key("hello-0-server", "hello-container-path") == \
+        volume_key("hello-0-sidecar", "hello-container-path")
+
+
+def test_pre_reserved_sidecar_carveout_and_rerun():
+    """pre-reserved-sidecar.yml: the role carve-out and the sidecar
+    plan COMPOSE — the pod (server + ONCE sidecar on a shared pod
+    volume) lands only on reserved hosts, and the sidecar re-runs via
+    the sidecar plan on the same reservation (reference:
+    pre-reserved-sidecar.yml)."""
+    hosts = [
+        TpuHost(host_id="plain-0"),
+        TpuHost(host_id="res-0", attributes={"reserved_role": "dedicated"}),
+    ]
+    runner = ServiceTestRunner(
+        load("pre-reserved-sidecar.yml"), hosts=hosts,
+        env={"HELLO_COUNT": "1"},
+    )
+    runner.run([
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("hello-0-server"),
+        SendTaskRunning("hello-0-server"),
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("hello-0-sidecar"),
+        SendTaskFinished("hello-0-sidecar"),
+        ExpectDeploymentComplete(),
+    ])
+    for name in ("hello-0-server", "hello-0-sidecar"):
+        info = runner.agent.task_info_of(name)
+        assert info.agent_id == "res-0", (
+            f"{name} placed on unreserved host {info.agent_id}"
+        )
+    ledger = runner.world.scheduler.ledger
+
+    def volume_key(task: str) -> str:
+        vols = {}
+        for res in ledger.for_task(task):
+            vols.update(res.volumes or {})
+        return vols.get("pod-container-path")
+
+    assert volume_key("hello-0-server") == volume_key("hello-0-sidecar")
+    first_sidecar_id = runner.agent.task_id_of("hello-0-sidecar")
+    runner.run([
+        PlanStart("sidecar"),
+        AdvanceCycles(2),
+        ExpectLaunchedTasks("hello-0-sidecar"),
+        SendTaskFinished("hello-0-sidecar"),
+        ExpectPlanStatus("sidecar", Status.COMPLETE),
+    ])
+    assert runner.agent.task_id_of("hello-0-sidecar") != first_sidecar_id
+
+
+def test_foobar_service_name_naming_flows():
+    """foobar_service_name.yml: a service name unrelated to pod/task
+    names — ids, endpoints, and TASKCFG routing key off the YAML's own
+    names (reference: foobar_service_name.yml)."""
+    runner = ServiceTestRunner(
+        load("foobar_service_name.yml"),
+        env={"HELLO_COUNT": "1", "TASKCFG_ALL_EXTRA_FLAG": "on"},
+    )
+    runner.run([
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("foo-0-bar"),
+        SendTaskRunning("foo-0-bar"),
+        ExpectDeploymentComplete(),
+    ])
+    assert runner.world.scheduler.spec.name == "foobar"
+    info = runner.agent.task_info_of("foo-0-bar")
+    assert info.env.get("EXTRA_FLAG") == "on"  # TASKCFG_ALL_* routed
+
+
+def test_marathon_constraint_yaml_end_to_end():
+    """marathon_constraint.yml: operator-supplied Marathon-JSON
+    placement — hello UNIQUE spreads across hosts, world CLUSTER pins
+    to one named host (reference: marathon_constraint.yml through the
+    PlacementUtils-style JSON parser)."""
+    hosts = [TpuHost(host_id=f"h{i}") for i in range(3)]
+    runner = ServiceTestRunner(
+        load("marathon_constraint.yml"), hosts=hosts,
+        env={
+            "HELLO_COUNT": "2",
+            "WORLD_COUNT": "2",
+            "WORLD_PLACEMENT": '[["hostname", "CLUSTER", "h2"]]',
+        },
+    )
+    runner.run([
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("hello-0-server"),
+        SendTaskRunning("hello-0-server"),
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("hello-1-server"),
+        SendTaskRunning("hello-1-server"),
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("world-0-server"),
+        SendTaskRunning("world-0-server"),
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("world-1-server"),
+        SendTaskRunning("world-1-server"),
+        ExpectDeploymentComplete(),
+        ExpectDistinctHosts("hello-0-server", "hello-1-server"),
+    ])
+    for name in ("world-0-server", "world-1-server"):
+        assert runner.agent.task_info_of(name).agent_id == "h2"
+
+
+def test_pause_yaml_task_level_pause_resume():
+    """pause.yml: pause ONE health-checked task of a two-task pod —
+    the paused task relaunches on the idle command with checks
+    suspended; its essential companion rides the pod relaunch but
+    keeps its REAL command (reference semantics: an essential task's
+    recovery relaunches every launched task of the pod,
+    TaskUtils.java:454-462); resume restores the real command."""
+    from dcos_commons_tpu.state import GoalStateOverride
+
+    runner = ServiceTestRunner(load("pause.yml"), env={"HELLO_COUNT": "1"})
+    runner.run([
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("hello-0-server"),
+        SendTaskRunning("hello-0-server"),
+        AdvanceCycles(1),
+        ExpectLaunchedTasks("hello-0-companion"),
+        SendTaskRunning("hello-0-companion"),
+        ExpectDeploymentComplete(),
+    ])
+    scheduler = runner.world.scheduler
+    touched = scheduler.pause_pod("hello", 0, tasks=["server"])
+    assert touched == ["hello-0-server"]
+    runner.run([
+        AdvanceCycles(3),
+        SendTaskRunning("hello-0-server"),
+        SendTaskRunning("hello-0-companion"),
+        AdvanceCycles(1),
+    ])
+    # the paused relaunch idles; the companion (relaunched with the
+    # pod, reference essential semantics) keeps its real command
+    info = runner.agent.task_info_of("hello-0-server")
+    assert "sleep" in info.command and "output" not in info.command
+    assert scheduler.state_store.fetch_goal_override(
+        "hello-0-server"
+    )[0] is GoalStateOverride.PAUSED
+    assert "output" in runner.agent.task_info_of(
+        "hello-0-companion"
+    ).command
+    assert scheduler.state_store.fetch_goal_override(
+        "hello-0-companion"
+    )[0] is GoalStateOverride.NONE
+    checks = runner.agent.checks.get(
+        runner.agent.task_id_of("hello-0-server")
+    )
+    assert checks["health"] is None, "paused task kept its health check"
+    scheduler.resume_pod("hello", 0, tasks=["server"])
+    runner.run([
+        AdvanceCycles(3),
+        SendTaskRunning("hello-0-server"),
+        SendTaskRunning("hello-0-companion"),
+        AdvanceCycles(1),
+    ])
+    info = runner.agent.task_info_of("hello-0-server")
+    assert "output" in info.command  # real command restored
+    assert scheduler.state_store.fetch_goal_override(
+        "hello-0-server"
+    )[0] is GoalStateOverride.NONE

@@ -156,3 +156,39 @@ def test_multi_wedged_service_flags_fatal_and_health_503():
             assert "store corrupted" in body["fatal_error"]
     finally:
         server.stop()
+
+
+def test_unappliable_rlimit_fails_launch_with_error_status(tmp_path):
+    """A setrlimit failure in the pure-Python preexec path surfaces as
+    ValueError in the parent (CPython re-raises child errno as the
+    builtin type): it must fail THE LAUNCH with an ERROR status, not
+    escape into the scheduler loop (advisor follow-up; the native
+    path's _exit(72) contract, mirrored)."""
+    import time as _time
+
+    from dcos_commons_tpu.agent.local import LocalProcessAgent
+    from dcos_commons_tpu.common import TaskInfo, TaskState
+
+    agent = LocalProcessAgent(str(tmp_path), use_native=False)
+    try:
+        agent.launch_one(
+            TaskInfo(name="p-0-t", task_id="tid-bad-rlimit",
+                     agent_id="h0", command="echo never-runs"),
+            # soft > hard is rejected by setrlimit itself -> ValueError
+            rlimits=[{"name": "RLIMIT_NOFILE", "soft": 100, "hard": 50}],
+        )
+        deadline = _time.monotonic() + 10
+        statuses = []
+        while _time.monotonic() < deadline:
+            statuses = [
+                s for s in agent.poll()
+                if s.task_id == "tid-bad-rlimit"
+            ]
+            if statuses:
+                break
+            _time.sleep(0.05)
+        assert statuses, "no status surfaced for the failed launch"
+        assert statuses[0].state is TaskState.ERROR
+        assert "launch failed" in statuses[0].message
+    finally:
+        agent.shutdown()

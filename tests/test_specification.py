@@ -429,3 +429,77 @@ def test_taskcfg_overrides_yaml_env():
     # non-TASKCFG env vars never leak into task envs
     spec2 = from_yaml(TASKCFG_YAML, env={"RANDOM_HOST_VAR": "x"})
     assert "RANDOM_HOST_VAR" not in spec2.pod("index").tasks[0].env
+
+
+def test_rlimit_spec_validation_and_roundtrip():
+    """Reference: specification/RLimitSpec.java — valid names only,
+    soft/hard both-or-neither, soft <= hard; -1 = RLIMIT_INFINITY."""
+    import pytest as _pytest
+
+    from dcos_commons_tpu.specification.specs import (
+        RLimitSpec,
+        ServiceSpec,
+        SpecError,
+    )
+    from dcos_commons_tpu.specification.yaml_spec import from_yaml
+
+    # valid forms
+    RLimitSpec(name="RLIMIT_NOFILE", soft=64, hard=128)
+    RLimitSpec(name="RLIMIT_CORE", soft=0, hard=0)
+    RLimitSpec(name="RLIMIT_CPU")  # named, unlimited
+    with _pytest.raises(SpecError, match="not a valid rlimit"):
+        RLimitSpec(name="RLIMIT_BOGUS", soft=1, hard=1)
+    with _pytest.raises(SpecError, match="set together"):
+        RLimitSpec(name="RLIMIT_NOFILE", soft=64)
+    with _pytest.raises(SpecError, match="exceeds"):
+        RLimitSpec(name="RLIMIT_NOFILE", soft=256, hard=128)
+    with _pytest.raises(SpecError, match=">= 0"):
+        RLimitSpec(name="RLIMIT_NOFILE", soft=-5, hard=-5)
+    # YAML dialect (reference svc.yml:9-13) + serde roundtrip through
+    # the ConfigStore path
+    spec = from_yaml(
+        "name: svc\n"
+        "pods:\n"
+        "  web:\n"
+        "    rlimits:\n"
+        "      RLIMIT_NOFILE:\n"
+        "        soft: 1024\n"
+        "        hard: 2048\n"
+        "      RLIMIT_CORE: {}\n"
+        "    tasks:\n"
+        "      server:\n"
+        "        goal: RUNNING\n"
+        "        cmd: sleep 1\n"
+    )
+    pod = spec.pod("web")
+    assert pod.rlimits == (
+        RLimitSpec(name="RLIMIT_NOFILE", soft=1024, hard=2048),
+        RLimitSpec(name="RLIMIT_CORE"),
+    )
+    assert ServiceSpec.from_dict(spec.to_dict()) == spec
+
+
+def test_rlimit_yaml_malformed_values_raise_spec_error():
+    """Malformed rlimit YAML fails as SpecError WITH pod context, like
+    every other spec error — not a bare ValueError/AttributeError."""
+    import pytest as _pytest
+
+    from dcos_commons_tpu.specification.specs import SpecError
+    from dcos_commons_tpu.specification.yaml_spec import from_yaml
+
+    base = (
+        "name: svc\n"
+        "pods:\n"
+        "  web:\n"
+        "    rlimits:\n"
+        "{rl}"
+        "    tasks:\n"
+        "      server: {{goal: RUNNING, cmd: sleep 1}}\n"
+    )
+    for bad_rl, match in (
+        ("      RLIMIT_NOFILE: {soft: 1k, hard: 2048}\n", "non-integer"),
+        ("      RLIMIT_CORE: 5\n", "mapping"),
+    ):
+        with _pytest.raises(SpecError, match=match) as err:
+            from_yaml(base.format(rl=bad_rl))
+        assert "web" in str(err.value)
