@@ -1,0 +1,311 @@
+"""Sharded serving gang worker: tp-sharded generate over a multi-host
+jax.distributed gang, fronted by rank 0's HTTP server.
+
+The serving half of the flagship at GANG scale: the model's parameters
+are tensor-parallel-sharded across every chip of the gang (a model too
+big for one host serves from the whole slice), and every request is
+executed by ONE pjit'd generate that all ranks enter together.  SPMD
+serving needs every process in the collective, but requests arrive
+only at the VIP'd rank — so rank 0 broadcasts each request (or an
+idle tick) to the gang, everyone steps the same program, and rank 0
+replies.  This is the standard multihost serving driver loop; the
+single-chip path (serve_worker.py) stays dispatch-free.
+
+Failover comes from GANG recovery, not from this file: kill any host
+and the scheduler replaces the whole gang (tests/test_gang_serve.py
+semantics); the replacement re-rendezvouses, rebuilds the identical
+tp-sharded params, and greedy replies are token-identical
+(tests/test_gang_serve_sharded.py proves it end to end).
+
+Reference: the reference never serves models — its analogue is any
+multi-task service behind a VIP (sdk/scheduler
+offer/evaluate/PodInfoBuilder VIP labels); the gang/SPMD shape is the
+TPU-first addition.
+"""
+
+import json
+import math
+import os
+import queue
+import sys
+import threading
+
+import numpy as np
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+sys.path.insert(0, os.environ.get("REPO_ROOT", "/root/repo"))
+
+# how often idle ranks meet in a noop collective: the gang must stay
+# in lockstep even with no traffic, or a request would wait on ranks
+# parked in a stale program
+IDLE_TICK_S = 0.05
+
+OP_NOOP = 0
+OP_GENERATE = 1
+
+
+class _Request:
+    __slots__ = ("rows", "true_len", "n", "temp", "done", "result", "error")
+
+    def __init__(self, rows, true_len, n, temp):
+        self.rows = rows
+        self.true_len = true_len
+        self.n = n
+        self.temp = temp
+        self.done = threading.Event()
+        self.result = None
+        self.error = None
+
+
+def main() -> int:
+    from dcos_commons_tpu.parallel.distributed import initialize_from_env
+
+    contract = initialize_from_env()
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from dcos_commons_tpu.models import (
+        TransformerConfig,
+        generate,
+        init_params,
+    )
+    from dcos_commons_tpu.models.transformer import param_shardings
+    from dcos_commons_tpu.parallel.mesh import MeshSpec, make_mesh
+    from dcos_commons_tpu.utils import (
+        enable_compilation_cache,
+        restore_checkpoint,
+    )
+
+    enable_compilation_cache()
+    rank = contract["worker_id"]
+    # a RELAUNCH reuses the sandbox: a stale ready file from the
+    # previous incarnation must not pass readiness while we are cold
+    try:
+        os.remove("ready")
+    except OSError:
+        pass
+    config = TransformerConfig(
+        vocab=int(os.environ.get("VOCAB", "8192")),
+        d_model=int(os.environ.get("D_MODEL", "512")),
+        n_layers=int(os.environ.get("N_LAYERS", "4")),
+        n_heads=8,
+        n_kv_heads=8,
+        d_ff=int(os.environ.get("D_FF", "1408")),
+        max_seq=int(os.environ.get("SEQ_LEN", "1024")),
+        dtype=jnp.bfloat16 if os.environ.get(
+            "JAX_PLATFORMS"
+        ) != "cpu" else jnp.float32,
+        remat=False,
+    )
+    max_len = int(os.environ.get("MAX_LEN", "256"))
+    batch = int(os.environ.get("SERVE_BATCH", "1"))
+    new_tokens = int(os.environ.get("MAX_NEW_TOKENS", "32"))
+    prompt_len = max_len - new_tokens
+
+    # the WHOLE gang is one tp axis: the model lives sharded across
+    # every chip (ICI within hosts, DCN across under a dcn axis would
+    # slot in here for multi-slice; the test gang is one slice)
+    n_devices = len(jax.devices())
+    mesh = make_mesh(MeshSpec(tp=n_devices))
+    with mesh:
+        params = init_params(config, jax.random.key(0))
+        ckpt_dir = os.environ.get("CHECKPOINT_DIR", "")
+        if ckpt_dir:
+            state, step = restore_checkpoint(ckpt_dir, {"params": params})
+            if step is not None:
+                params = state["params"]
+                print(f"restored checkpoint step {step}", flush=True)
+        params = jax.tree.map(
+            jax.device_put, params, param_shardings(config, mesh)
+        )
+        replicated = NamedSharding(mesh, P())
+
+        def to_global(arr):
+            """Identical host-local array on every rank -> one global
+            replicated jax array the sharded generate accepts."""
+            return multihost_utils.host_local_array_to_global_array(
+                arr, mesh, P()
+            )
+
+        kv_dtype = os.environ.get("KV_DTYPE", "native")
+        gen = jax.jit(
+            lambda p, t, seed, temp, n: generate(
+                config, p, t, max_new_tokens=new_tokens, max_len=max_len,
+                temperature=temp, key=jax.random.key(seed),
+                true_len=n, kv_dtype=kv_dtype,
+            ),
+            out_shardings=replicated,
+        )
+
+        def run_from_head(head, prompt_np):
+            """Execute the broadcast program: EVERY rank decodes the
+            identical head, so traced operands are byte-identical
+            across the gang (diverging scalars would make each rank
+            compute a different program's shard)."""
+            out = gen(
+                params,
+                to_global(prompt_np.astype(np.int32)),
+                np.int64(int(head[3])),
+                np.float32(int(head[4]) / 1e6),
+                np.int32(int(head[1])),
+            )
+            # replicated output: every rank holds the full answer;
+            # ONE bulk fetch (per-element reads are ~100ms each over a
+            # TPU relay)
+            return np.asarray(jax.device_get(out))
+
+        # warm the compiled path as a GANG before readiness: the first
+        # request must not pay the compile, and a rank that cannot
+        # compile must fail deploy, not the first client
+        warm_head = np.asarray(
+            [OP_GENERATE, prompt_len, new_tokens, 0, 0], np.int64
+        )
+        run_from_head(warm_head, np.zeros((batch, prompt_len), np.int32))
+
+        if rank != 0:
+            # follower loop: meet rank 0 in every broadcast tick and
+            # execute whatever it scheduled
+            with open("ready", "w") as f:
+                f.write("warm\n")
+            print(f"rank {rank}: following gang broadcasts", flush=True)
+            while True:
+                head, prompt = _broadcast_tick(
+                    multihost_utils, None, batch, prompt_len
+                )
+                if int(head[0]) == OP_GENERATE:
+                    run_from_head(head, prompt)
+
+        # ---- rank 0: HTTP front end + gang driver loop --------------
+        requests: "queue.Queue[_Request]" = queue.Queue()
+
+        def driver():
+            while True:
+                try:
+                    item = requests.get(timeout=IDLE_TICK_S)
+                except queue.Empty:
+                    _broadcast_tick(
+                        multihost_utils,
+                        (np.zeros(5, np.int64),
+                         np.zeros((batch, prompt_len), np.int32)),
+                        batch, prompt_len,
+                    )
+                    continue
+                try:
+                    seed = int.from_bytes(os.urandom(4), "little")
+                    prompt = np.zeros((batch, prompt_len), np.int32)
+                    for i, row in enumerate(item.rows):
+                        prompt[i, : len(row)] = row
+                    head = np.asarray([
+                        OP_GENERATE, item.true_len, item.n, seed,
+                        int(item.temp * 1e6),
+                    ], np.int64)
+                    head, prompt = _broadcast_tick(
+                        multihost_utils, (head, prompt), batch, prompt_len
+                    )
+                    out = run_from_head(head, prompt)
+                    item.result = [
+                        [int(t) for t in out[i, : item.n]]
+                        for i in range(len(item.rows))
+                    ]
+                except Exception as e:  # noqa: BLE001 — surface to client
+                    item.error = e
+                item.done.set()
+
+        threading.Thread(target=driver, daemon=True).start()
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_POST(self):
+                if self.path != "/generate":
+                    self.send_error(404)
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    body = json.loads(self.rfile.read(length))
+                    rows = body["tokens"]
+                    if len(rows) > batch:
+                        raise ValueError(
+                            f"{len(rows)} prompts > server batch {batch}"
+                        )
+                    lens = {len(row) for row in rows}
+                    if len(lens) > 1:
+                        raise ValueError(
+                            "all prompts in one request must share a length"
+                        )
+                    true_len = max(lens, default=0)
+                    if not 1 <= true_len <= prompt_len:
+                        raise ValueError(
+                            f"prompt length must be in [1, {prompt_len}]"
+                        )
+                    temp = float(body.get("temperature", 0.0))
+                    if not math.isfinite(temp) or not 0.0 <= temp <= 1e4:
+                        # bounded: the broadcast head carries the value
+                        # as micro-units in an int64 — and a six-digit
+                        # temperature is an input error anyway
+                        raise ValueError(
+                            f"temperature must be in [0, 10000], got {temp}"
+                        )
+                    n = min(
+                        int(body.get("max_new_tokens", new_tokens)),
+                        new_tokens,
+                    )
+                    if n < 1:
+                        raise ValueError("max_new_tokens must be >= 1")
+                    item = _Request(
+                        [[int(t) % config.vocab for t in row]
+                         for row in rows],
+                        true_len, n, temp,
+                    )
+                    requests.put(item)
+                    if not item.done.wait(timeout=float(
+                        os.environ.get("SERVE_QUEUE_TIMEOUT_S", "600")
+                    )):
+                        raise RuntimeError("generate timed out in queue")
+                    if item.error is not None:
+                        raise item.error
+                    payload = json.dumps({"tokens": item.result}).encode()
+                    self.send_response(200)
+                except Exception as e:  # noqa: BLE001
+                    payload = json.dumps({"error": str(e)}).encode()
+                    self.send_response(400)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+        port = int(os.environ.get("PORT_HTTP", "0"))
+        server = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+        with open("ready", "w") as f:
+            f.write("warm\n")
+        print(
+            f"rank 0: serving sharded generate({batch}x{prompt_len}->"
+            f"{new_tokens}) tp={n_devices} on {server.server_address[1]}",
+            flush=True,
+        )
+        server.serve_forever()
+    return 0
+
+
+def _broadcast_tick(multihost_utils, payload, batch, prompt_len):
+    """One gang-wide broadcast: rank 0 passes (head, prompt), the
+    followers pass None and receive rank 0's payload."""
+    if payload is None:
+        payload = (
+            np.zeros(5, np.int64),
+            np.zeros((batch, prompt_len), np.int32),
+        )
+    head, prompt = multihost_utils.broadcast_one_to_all(payload)
+    return np.asarray(head), np.asarray(prompt)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
