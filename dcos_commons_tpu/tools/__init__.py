@@ -15,10 +15,20 @@ from dcos_commons_tpu.tools.packaging import (
     extract_package,
     read_manifest,
 )
+from dcos_commons_tpu.tools.registry import (
+    RegistryServer,
+    fetch_package,
+    publish_package,
+    registry_index,
+)
 
 __all__ = [
     "PackageError",
+    "RegistryServer",
     "build_package",
     "extract_package",
+    "fetch_package",
+    "publish_package",
     "read_manifest",
+    "registry_index",
 ]

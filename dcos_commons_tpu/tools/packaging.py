@@ -237,11 +237,45 @@ def main(argv: Optional[list] = None) -> int:
     p.add_argument("package")
     p = sub.add_parser("lint")
     p.add_argument("framework_dir")
-    p = sub.add_parser("install")
+    p = sub.add_parser(
+        "publish",
+        help="publish a built package into a registry "
+             "(tools/publish_http.py + release_builder.py analogue)",
+    )
     p.add_argument("package")
+    p.add_argument(
+        "--registry", required=True,
+        help="registry directory path or HTTP URL",
+    )
+    p.add_argument("--token", default="", help="registry publish token")
+    p = sub.add_parser(
+        "registry-serve",
+        help="serve a registry directory over HTTP",
+    )
+    p.add_argument("--dir", required=True)
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--bind", default="127.0.0.1")
+    p.add_argument("--token", default="",
+                   help="bearer token required to publish")
+    p.add_argument("--announce-file", default="")
+    p = sub.add_parser("install")
+    p.add_argument(
+        "package",
+        help="package tarball path, or a package NAME with --registry",
+    )
     p.add_argument(
         "--url", required=True, help="multi scheduler API URL"
     )
+    p.add_argument(
+        "--registry", default="",
+        help="resolve the package by name from this registry "
+             "(dir path or HTTP URL) instead of a local tarball",
+    )
+    p.add_argument(
+        "--package-version", default="",
+        help="with --registry: install this version (default latest)",
+    )
+    p.add_argument("--token", default="", help="registry read token")
     p.add_argument(
         "--name", default="",
         help="service name (default: manifest name)",
@@ -300,10 +334,53 @@ def _run_verb(args) -> int:
             return 1
         print("lint clean")
         return 0
-    # install: the tarball travels to the scheduler (Cosmos analogue)
-    with open(args.package, "rb") as f:
-        payload = f.read()
-    name = args.name or read_manifest(args.package)["name"]
+    if args.verb == "publish":
+        from dcos_commons_tpu.tools.registry import publish_package
+
+        out = publish_package(
+            args.package, args.registry, token=args.token
+        )
+        print(json.dumps(out))
+        return 0
+    if args.verb == "registry-serve":
+        from dcos_commons_tpu.tools.registry import RegistryServer
+
+        server = RegistryServer(
+            args.dir, port=args.port, bind=args.bind,
+            auth_token=args.token,
+        ).start()
+        print(f"registry serving {args.dir} at {server.url}", flush=True)
+        if args.announce_file:
+            tmp = args.announce_file + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(server.url)
+            os.replace(tmp, args.announce_file)
+        import signal
+        import threading as _threading
+
+        stop = _threading.Event()
+        signal.signal(signal.SIGTERM, lambda *_: stop.set())
+        signal.signal(signal.SIGINT, lambda *_: stop.set())
+        stop.wait()
+        server.stop()
+        return 0
+    # install: the tarball travels to the scheduler (Cosmos analogue),
+    # from a local build or resolved + digest-verified out of a registry
+    if getattr(args, "registry", ""):
+        from dcos_commons_tpu.tools.registry import fetch_package
+
+        version, payload = fetch_package(
+            args.registry, args.package,
+            version=getattr(args, "package_version", ""),
+            token=args.token,
+        )
+        name = args.name or args.package
+        print(f"resolved {args.package} {version} from registry",
+              file=sys.stderr)
+    else:
+        with open(args.package, "rb") as f:
+            payload = f.read()
+        name = args.name or read_manifest(args.package)["name"]
     suffix = "?upgrade=true" if getattr(args, "upgrade", False) else ""
     headers = {"Content-Type": "application/gzip"}
     if getattr(args, "options", ""):

@@ -411,3 +411,161 @@ def test_airgap_lint(tmp_path):
     for name in ("helloworld", "hdfs", "jax"):
         clean = lint_airgap(os.path.join(repo, "frameworks", name))
         assert clean == [], f"{name}: {clean}"
+
+
+# -- registry: publish + install-from-registry ------------------------
+# (reference: tools/publish_http.py + release_builder.py in spirit)
+
+
+def test_registry_publish_resolve_and_immutability(tmp_path):
+    from dcos_commons_tpu.tools import (
+        fetch_package,
+        publish_package,
+        registry_index,
+    )
+
+    framework = make_framework(tmp_path)
+    registry = str(tmp_path / "registry")
+    v1 = str(tmp_path / "pkgsvc-1.tgz")
+    build_package(framework, v1, version="1.0.0")
+    out = publish_package(v1, registry)
+    assert out["version"] == "1.0.0"
+    # re-publishing identical bytes is idempotent...
+    assert publish_package(v1, registry)["sha256"] == out["sha256"]
+    # ...but different bytes under the same version are REJECTED
+    # (immutable releases, release_builder's stable-artifact rule)
+    (tmp_path / "pkgsvc" / "extra.txt").write_text("changed\n")
+    mutated = str(tmp_path / "pkgsvc-1b.tgz")
+    build_package(framework, mutated, version="1.0.0")
+    with pytest.raises(PackageError, match="immutable"):
+        publish_package(mutated, registry)
+    # a version bump publishes fine and becomes "latest"
+    v2 = str(tmp_path / "pkgsvc-2.tgz")
+    build_package(framework, v2, version="1.10.0")  # > 1.9 numerically
+    publish_package(v2, registry)
+    index = registry_index(registry)
+    assert set(index["packages"]["pkgsvc"]) == {"1.0.0", "1.10.0"}
+    version, payload = fetch_package(registry, "pkgsvc")
+    assert version == "1.10.0"  # numeric ordering, not lexicographic
+    assert payload == open(v2, "rb").read()
+    version, _ = fetch_package(registry, "pkgsvc", version="1.0.0")
+    assert version == "1.0.0"
+    with pytest.raises(PackageError, match="not in registry"):
+        fetch_package(registry, "nope")
+
+
+def test_registry_http_server_and_digest_verification(tmp_path):
+    from dcos_commons_tpu.tools import (
+        RegistryServer,
+        fetch_package,
+        publish_package,
+    )
+
+    framework = make_framework(tmp_path)
+    pkg = str(tmp_path / "pkgsvc.tgz")
+    build_package(framework, pkg, version="2.0.0")
+    root = str(tmp_path / "registry")
+    server = RegistryServer(root, auth_token="hunter2").start()
+    try:
+        # publish over HTTP requires the token
+        with pytest.raises(PackageError, match="token"):
+            publish_package(pkg, server.url)
+        out = publish_package(pkg, server.url, token="hunter2")
+        assert out["version"] == "2.0.0"
+        # reads are open; the payload digest-verifies against the index
+        version, payload = fetch_package(server.url, "pkgsvc")
+        assert version == "2.0.0"
+        assert payload == open(pkg, "rb").read()
+        # a tampered artifact on disk is CAUGHT at fetch time
+        artifact = os.path.join(root, "artifacts", "pkgsvc-2.0.0.tar.gz")
+        with open(artifact, "ab") as f:
+            f.write(b"tamper")
+        with pytest.raises(PackageError, match="digest mismatch"):
+            fetch_package(server.url, "pkgsvc")
+    finally:
+        server.stop()
+
+
+def test_cli_publish_and_install_from_registry(tmp_path):
+    """The full operator flow over real processes: build -> publish
+    to a served registry -> install BY NAME from the registry into a
+    --multi scheduler -> deploy completes with the packaged template
+    rendered (reference: publish_http.py + Cosmos install-by-name)."""
+    framework = make_framework(tmp_path)
+    pkg = str(tmp_path / "pkgsvc.tgz")
+    built = subprocess.run(
+        [sys.executable, "-m", "dcos_commons_tpu", "package", "build",
+         framework, "-o", pkg, "--version", "3.1.0"],
+        cwd=REPO, capture_output=True, text=True, timeout=60,
+    )
+    assert built.returncode == 0, built.stderr
+    registry_announce = tmp_path / "registry.announce"
+    registry_proc = subprocess.Popen(
+        [sys.executable, "-m", "dcos_commons_tpu", "package",
+         "registry-serve", "--dir", str(tmp_path / "registry"),
+         "--announce-file", str(registry_announce)],
+        cwd=REPO,
+    )
+    topology = tmp_path / "topology.yml"
+    topology.write_text(
+        "hosts:\n  - host_id: h0\n    cpus: 8\n    memory_mb: 8192\n"
+    )
+    announce = tmp_path / "announce"
+    sched_proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "dcos_commons_tpu", "serve", "--multi",
+            "--topology", str(topology),
+            "--port", "0",
+            "--state-dir", str(tmp_path / "state"),
+            "--sandbox-root", str(tmp_path / "sbx"),
+            "--announce-file", str(announce),
+        ],
+        cwd=REPO,
+    )
+    try:
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not (
+            announce.exists() and registry_announce.exists()
+        ):
+            time.sleep(0.1)
+        registry_url = registry_announce.read_text().strip()
+        url = announce.read_text().strip()
+        published = subprocess.run(
+            [sys.executable, "-m", "dcos_commons_tpu", "package",
+             "publish", pkg, "--registry", registry_url],
+            cwd=REPO, capture_output=True, text=True, timeout=60,
+        )
+        assert published.returncode == 0, published.stderr
+        # install BY NAME: the tarball never touches this client's disk
+        installed = subprocess.run(
+            [sys.executable, "-m", "dcos_commons_tpu", "package",
+             "install", "pkgsvc", "--registry", registry_url,
+             "--url", url],
+            cwd=REPO, capture_output=True, text=True, timeout=60,
+        )
+        assert installed.returncode == 0, installed.stderr
+        assert "3.1.0" in installed.stderr  # resolved version reported
+
+        def get(path):
+            with urllib.request.urlopen(url + path, timeout=5) as r:
+                return json.loads(r.read())
+
+        deadline = time.monotonic() + 60
+        done = False
+        while time.monotonic() < deadline:
+            try:
+                if get("/v1/multi/pkgsvc/v1/plans/deploy")["status"] == \
+                        "COMPLETE":
+                    done = True
+                    break
+            except Exception:
+                pass
+            time.sleep(0.3)
+        assert done
+        rendered = tmp_path / "sbx" / "app-0-main" / "app.cfg"
+        assert rendered.read_text().strip() == "task=app-0-main"
+    finally:
+        sched_proc.terminate()
+        registry_proc.terminate()
+        sched_proc.wait(timeout=20)
+        registry_proc.wait(timeout=20)
