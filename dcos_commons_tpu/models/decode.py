@@ -100,12 +100,13 @@ def prefill(
     tokens [b, s] (s <= max_len) -> (logits of the LAST REAL position
     [b, vocab] in f32, cache filled for positions [0, s)).
 
-    ``true_len`` (a TRACED scalar <= s, same for all rows) supports
-    RIGHT-padded prompts with one compile for every length: causal
-    attention means positions < true_len never see the padding, the
-    logits are read at true_len - 1, and decode overwrites/masks the
-    pad slots — so a server can pad to a static width without
-    changing any real token's computation.
+    ``true_len`` (TRACED, <= s; a scalar for a shared length or a
+    [b] vector for PER-ROW lengths) supports RIGHT-padded prompts
+    with one compile for every length: causal attention means
+    positions < true_len never see the padding, the logits are read
+    at true_len - 1 per row, and decode overwrites/masks the pad
+    slots — so a server can pad MIXED-length requests to a static
+    width without changing any real token's computation.
     """
     b, s = tokens.shape
     if s > max_len:
@@ -156,9 +157,15 @@ def prefill(
     x = rms_norm(x, params["final_norm"])
     last = (
         jnp.asarray(true_len, jnp.int32) - 1 if true_len is not None
-        else s - 1
+        else jnp.int32(s - 1)
     )
-    x_last = lax.dynamic_index_in_dim(x, last, axis=1, keepdims=False)
+    if last.ndim == 0:
+        x_last = lax.dynamic_index_in_dim(x, last, axis=1, keepdims=False)
+    else:
+        # per-row last REAL position (mixed-length right-padded batch)
+        x_last = jnp.take_along_axis(
+            x, last[:, None, None], axis=1
+        )[:, 0]
     logits = jnp.einsum(
         "bd,vd->bv", x_last.astype(jnp.float32),
         params["embed"].astype(jnp.float32),
@@ -173,17 +180,40 @@ def decode_step(
     token: jax.Array,
     pos: jax.Array,
 ) -> Tuple[jax.Array, Dict[str, jax.Array]]:
-    """One autoregressive step: token [b] at position ``pos`` (scalar
-    int32, same for the whole batch) -> (logits [b, vocab] f32,
-    updated cache)."""
+    """One autoregressive step: token [b] at position ``pos`` (int32
+    scalar shared by the batch, or a [b] vector for per-row positions
+    in a mixed-length batch) -> (logits [b, vocab] f32, updated
+    cache).
+
+    The scalar path writes the cache with ONE dynamic_update_slice
+    (the HBM-cheapest form); the per-row path scatters b slots via
+    ``.at[arange(b), pos]`` — still b slots of bytes, not a full-cache
+    rewrite."""
     b = token.shape[0]
     h, kv, hd = config.n_heads, config.n_kv_heads, config.head_dim
     max_len = cache["k"].shape[2]
     x = params["embed"][token][:, None, :].astype(config.dtype)
-    positions = jnp.broadcast_to(pos.astype(jnp.int32), (b, 1))
-    valid = (
-        lax.broadcasted_iota(jnp.int32, (1, 1, max_len), 2) <= pos
-    )  # [1, 1, max_len], broadcast over batch and heads
+    pos = jnp.asarray(pos, jnp.int32)
+    per_row = pos.ndim == 1
+    if per_row:
+        positions = pos[:, None]
+        valid = (
+            lax.broadcasted_iota(jnp.int32, (1, 1, max_len), 2)
+            <= pos[:, None, None]
+        )  # [b, 1, max_len]
+    else:
+        positions = jnp.broadcast_to(pos, (b, 1))
+        valid = (
+            lax.broadcasted_iota(jnp.int32, (1, 1, max_len), 2) <= pos
+        )  # [1, 1, max_len], broadcast over batch and heads
+
+    rows = jnp.arange(b) if per_row else None
+
+    def _cache_write(buf, new):
+        """buf [b, L, heads, hd], new [b, 1, heads, hd] at pos."""
+        if per_row:
+            return buf.at[rows, pos].set(new[:, 0])
+        return lax.dynamic_update_slice(buf, new, (0, pos, 0, 0))
 
     quantized = "k_scale" in cache
     reps = h // kv
@@ -222,13 +252,13 @@ def decode_step(
         if quantized:
             kq, ks_new = _quantize_kv(k_new)
             vq, vs_new = _quantize_kv(v_new)
-            ck = lax.dynamic_update_slice(ck, kq, (0, pos, 0, 0))
-            cv = lax.dynamic_update_slice(cv, vq, (0, pos, 0, 0))
-            cks = lax.dynamic_update_slice(cks, ks_new, (0, pos, 0, 0))
-            cvs = lax.dynamic_update_slice(cvs, vs_new, (0, pos, 0, 0))
+            ck = _cache_write(ck, kq)
+            cv = _cache_write(cv, vq)
+            cks = _cache_write(cks, ks_new)
+            cvs = _cache_write(cvs, vs_new)
         else:
-            ck = lax.dynamic_update_slice(ck, k_new, (0, pos, 0, 0))
-            cv = lax.dynamic_update_slice(cv, v_new, (0, pos, 0, 0))
+            ck = _cache_write(ck, k_new)
+            cv = _cache_write(cv, v_new)
         attn = _attend(q, ck, cv, cks, cvs)
         x = x + attn.reshape(b, 1, h * hd) @ layer["wo"]
         x, _moe_aux = _ffn_block(config, layer, x, decode=True)
@@ -272,8 +302,10 @@ def generate(
     [b, max_new_tokens].  temperature 0 = greedy; otherwise softmax
     sampling with ``key``.  Jit-friendly end to end, ONE compile
     covering every prompt CONTENT, LENGTH (``true_len``: right-padded
-    prompts, traced), and TEMPERATURE (traced operand — a server must
-    not recompile per requested temperature).
+    prompts, traced — a scalar, or a [b] vector for MIXED per-row
+    lengths so one dispatch serves heterogeneous requests), and
+    TEMPERATURE (traced operand — a server must not recompile per
+    requested temperature).
 
     ``kv_dtype="int8"`` stores the cache quantized per vector:
     decode streams half the cache bytes per step, roughly doubling

@@ -101,6 +101,95 @@ def _routing(
     return dispatch, combine, aux
 
 
+def _routing_sorted(
+    config: MoEConfig, params: MoEParams, x: jax.Array, capacity: int
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Sort-based token->slot assignment: no [t,E,C] one-hot tensors.
+
+    Returns (slot [t*k], token [t*k], weight [t*k], keep [t*k], aux).
+    The (t*k) routing entries are sorted by expert CHOICE-MAJOR (every
+    token's 1st choice outranks any 2nd choice), positions within an
+    expert come from the sorted order, and entries past the capacity
+    are dropped — identical drop semantics to the one-hot path.  The
+    per-entry work is O(t*k log(t*k)) sort + O(t*k) bookkeeping vs the
+    one-hot path's O(t*E*C) tensor construction; dispatch becomes a
+    row gather/scatter instead of a [t,E*C] matmul."""
+    t = x.shape[0]
+    e, k = config.n_experts, config.top_k
+    logits = x.astype(jnp.float32) @ params["router"]
+    probs = jax.nn.softmax(logits, axis=-1)                    # [t, E]
+    gate_vals, expert_idx = lax.top_k(probs, k)                # [t, k]
+    gate_vals = gate_vals / jnp.maximum(
+        gate_vals.sum(-1, keepdims=True), 1e-9
+    )
+    top1_hot = jax.nn.one_hot(expert_idx[:, 0], e, dtype=jnp.float32)
+    aux = e * jnp.mean(top1_hot.mean(0) * probs.mean(0))
+    # choice-major flatten: stable argsort then gives 1st choices
+    # priority over 2nd choices for the last slots of a hot expert
+    flat_expert = expert_idx.T.reshape(-1)                     # [k*t]
+    flat_token = jnp.tile(jnp.arange(t, dtype=jnp.int32), k)
+    flat_gate = gate_vals.T.reshape(-1)
+    order = jnp.argsort(flat_expert, stable=True)
+    se = flat_expert[order]
+    st = flat_token[order]
+    sg = flat_gate[order]
+    counts = jnp.bincount(flat_expert, length=e)
+    offsets = jnp.concatenate(
+        [jnp.zeros((1,), counts.dtype), jnp.cumsum(counts)[:-1]]
+    )
+    pos = jnp.arange(k * t) - offsets[se]
+    keep = pos < capacity
+    slot = se * capacity + jnp.clip(pos, 0, capacity - 1)
+    return slot, st, sg, keep, aux
+
+
+def _moe_sorted(
+    config: MoEConfig,
+    params: MoEParams,
+    x: jax.Array,
+    capacity: int,
+    axis_name: Optional[str] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """moe_ffn body over sorted dispatch (see _routing_sorted)."""
+    t, d = x.shape
+    e = config.n_experts
+    if axis_name is not None:
+        ep = lax.axis_size(axis_name)
+        if (e // ep) * ep != e:
+            # fail like the one-hot path does — not with an opaque
+            # all_to_all split-axis shape error
+            raise ValueError(
+                f"n_experts {e} not divisible by ep={ep}"
+            )
+    slot, st, sg, keep, aux = _routing_sorted(config, params, x, capacity)
+    rows = x[st].astype(config.dtype) * keep[:, None].astype(config.dtype)
+    # dropped entries are zeroed BEFORE the scatter-add, so the
+    # clipped slot they alias contributes nothing
+    expert_in = jnp.zeros(
+        (e * capacity, d), config.dtype
+    ).at[slot].add(rows).reshape(e, capacity, d)
+    if axis_name is None:
+        expert_out = _expert_ffn(config, params, expert_in)
+    else:
+        aux = lax.pmean(aux, axis_name)
+        # same wire pattern as the one-hot path: ship slots to the
+        # expert owners, compute, ship back (tokens ride ICI while
+        # the expert matmuls run)
+        expert_in = lax.all_to_all(
+            expert_in, axis_name, split_axis=0, concat_axis=1, tiled=True
+        )
+        expert_out = _expert_ffn(config, params, expert_in)
+        expert_out = lax.all_to_all(
+            expert_out, axis_name, split_axis=1, concat_axis=0, tiled=True
+        )
+    out_rows = expert_out.reshape(e * capacity, d)[slot]
+    weight = (sg * keep).astype(jnp.float32)[:, None]
+    y = jnp.zeros((t, d), jnp.float32).at[st].add(
+        out_rows.astype(jnp.float32) * weight
+    )
+    return y.astype(x.dtype), aux
+
+
 def _expert_ffn(config: MoEConfig, params: MoEParams, h: jax.Array) -> jax.Array:
     """h [E_local, slots, d] -> [E_local, slots, d]: batched SwiGLU."""
     h = h.astype(config.dtype)
@@ -115,6 +204,7 @@ def moe_ffn(
     x: jax.Array,
     axis_name: Optional[str] = None,
     capacity: Optional[int] = None,
+    impl: str = "onehot",
 ) -> Tuple[jax.Array, jax.Array]:
     """MoE FFN on x [tokens, d_model] -> (y, aux_loss).
 
@@ -127,15 +217,32 @@ def moe_ffn(
     decode passes capacity = tokens so NO token is ever dropped (slot
     competition is a training-time load-balancing pressure, not a
     serving behavior).
+
+    ``impl`` picks the dispatch: "onehot" (dense [t,E,C] one-hot
+    einsums — every op a matmul) or "sorted" (argsort + row
+    gather/scatter — no O(t*E*C) tensors, preferred for large token
+    groups).  Drop semantics are identical (choice-major priority);
+    tests hold numeric agreement in the drop-free regime.
     """
     t, d = x.shape
     capacity = capacity if capacity is not None else config.capacity(t)
+    if impl == "sorted":
+        return _moe_sorted(config, params, x, capacity, axis_name)
     if axis_name is None:
         dispatch, combine, aux = _routing(config, params, x, capacity)
-        expert_in = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
+        # dispatch/combine matmuls run in the COMPUTE dtype: the
+        # one-hot dispatch is exactly representable in bf16 and the
+        # expert FFN consumes bf16 anyway.  Measured MFU-neutral on
+        # v5e (XLA already folds the f32 convert into the matmul) —
+        # kept for dtype consistency with the expert FFN, NOT as a
+        # perf lever (r5 sweep notes in bench.py bench_moe).
+        dt = config.dtype
+        expert_in = jnp.einsum(
+            "tec,td->ecd", dispatch.astype(dt), x.astype(dt)
+        )
         expert_out = _expert_ffn(config, params, expert_in)
         y = jnp.einsum(
-            "tec,ecd->td", combine, expert_out.astype(jnp.float32)
+            "tec,ecd->td", combine.astype(dt), expert_out.astype(dt)
         )
         return y.astype(x.dtype), aux
 
@@ -149,7 +256,10 @@ def moe_ffn(
     # (router weights replicated), then ships slots to expert owners
     dispatch, combine, aux = _routing(config, params, x, capacity)
     aux = lax.pmean(aux, axis_name)
-    expert_in = jnp.einsum("tec,td->ecd", dispatch, x.astype(jnp.float32))
+    # same compute dtype as the single-device branch: the two paths
+    # must not silently differ in precision
+    dt = config.dtype
+    expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(dt), x.astype(dt))
     # [E, C, d] -> [E/ep, ep*C, d]: each rank receives every other
     # rank's slots for the experts it owns
     expert_in = lax.all_to_all(
@@ -160,7 +270,9 @@ def moe_ffn(
     expert_out = lax.all_to_all(
         expert_out, axis_name, split_axis=1, concat_axis=0, tiled=True
     )
-    y = jnp.einsum("tec,ecd->td", combine, expert_out.astype(jnp.float32))
+    y = jnp.einsum(
+        "tec,ecd->td", combine.astype(dt), expert_out.astype(dt)
+    )
     return y.astype(x.dtype), aux
 
 

@@ -87,6 +87,10 @@ class TransformerConfig:
     # group * E * C (C scales with the GROUP, not the global batch —
     # an ungrouped b*s routing would be O(tokens^2) memory)
     moe_group_size: int = 1024
+    # dispatch implementation: "onehot" (dense [t,E,C] einsums) or
+    # "sorted" (argsort + row gather/scatter, no O(t*E*C) tensors —
+    # the pick for large groups); see models/moe.py moe_ffn
+    moe_impl: str = "onehot"
     # sequence-chunked cross entropy: the [b, s, vocab] f32 logits are
     # never materialized — each chunk's logits are computed, reduced to
     # a scalar, and rematerialized in backward.  0 = unchunked.
@@ -299,7 +303,10 @@ def _ffn_block(config: TransformerConfig, layer, x, decode: bool = False):
     # dispatch collectives — the shard_map path stays available for
     # explicit all_to_all control (dryrun's ep section)
     y, aux = jax.vmap(
-        lambda g: moe_ffn(moe_config, moe_params, g, capacity=capacity)
+        lambda g: moe_ffn(
+            moe_config, moe_params, g, capacity=capacity,
+            impl=config.moe_impl,
+        )
     )(tokens)
     return x + y.reshape(b, s, d), aux.mean()
 

@@ -94,7 +94,11 @@ class DefaultRecoveryPlanManager(PlanManager):
         with self._lock:
             for phase in self._phases.values():
                 phase.update(status)
-            self._refresh()
+            # plan synthesis happens once per cycle in get_candidates,
+            # NOT per status: _refresh scans every pod's stored state,
+            # and a fleet-scale status burst (100 pods reporting in one
+            # intake) would turn that into an O(statuses x pods) sweep
+            # with identical end-of-cycle behavior
 
     # -- plan synthesis ----------------------------------------------
 

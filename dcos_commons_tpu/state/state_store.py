@@ -50,6 +50,16 @@ class StateStore:
         # scheduler/multi/).
         self._root = namespace_root(namespace)
         self._lock = threading.RLock()
+        # decode cache: path -> (raw bytes, decoded object).  The
+        # persister read stays authoritative (correct under external
+        # writers — HA failover, multi mode); only the JSON decode is
+        # skipped, and only when the fetched bytes are EQUAL to the
+        # cached ones.  Fetched objects are treated as immutable
+        # everywhere (with_label copies), so sharing is safe.  At
+        # fleet scale the recovery scan fetches every task each cycle:
+        # decoding ~1000 identical JSON blobs per cycle was the
+        # scheduler loop's single largest cost.
+        self._decode_cache: Dict[str, tuple] = {}
 
     @property
     def persister(self) -> Persister:
@@ -76,9 +86,22 @@ class StateStore:
             ]
             self._persister.apply(ops)
 
+    def _decode(self, path: str, raw: bytes, decoder):
+        with self._lock:
+            hit = self._decode_cache.get(path)
+            if hit is not None and hit[0] == raw:
+                return hit[1]
+        obj = decoder(raw)
+        with self._lock:
+            self._decode_cache[path] = (raw, obj)
+        return obj
+
     def fetch_task(self, task_name: str) -> Optional[TaskInfo]:
-        raw = self._persister.get_or_none(self._task_path(task_name, "info"))
-        return TaskInfo.from_bytes(raw) if raw is not None else None
+        path = self._task_path(task_name, "info")
+        raw = self._persister.get_or_none(path)
+        if raw is None:
+            return None
+        return self._decode(path, raw, TaskInfo.from_bytes)
 
     def fetch_task_names(self) -> List[str]:
         return self._persister.get_children_or_empty(f"{self._root}/tasks")
@@ -111,8 +134,11 @@ class StateStore:
             return True
 
     def fetch_status(self, task_name: str) -> Optional[TaskStatus]:
-        raw = self._persister.get_or_none(self._task_path(task_name, "status"))
-        return TaskStatus.from_bytes(raw) if raw is not None else None
+        path = self._task_path(task_name, "status")
+        raw = self._persister.get_or_none(path)
+        if raw is None:
+            return None
+        return self._decode(path, raw, TaskStatus.from_bytes)
 
     def fetch_statuses(self) -> Dict[str, TaskStatus]:
         out: Dict[str, TaskStatus] = {}
@@ -149,6 +175,13 @@ class StateStore:
             self._persister.recursive_delete(self._task_path(task_name))
         except PersisterError:
             pass
+        with self._lock:
+            # keep the decode cache bounded: removed tasks never
+            # come back under the same bytes-validated entries
+            for leaf in ("info", "status"):
+                self._decode_cache.pop(
+                    self._task_path(task_name, leaf), None
+                )
 
     # -- goal-state overrides (pod pause/resume) ----------------------
 

@@ -56,11 +56,21 @@ def _artifact_name(name: str, version: str) -> str:
 
 def _version_key(version: str):
     """Order '0.10.2' above '0.9.9' (numeric segments compare as
-    ints, everything else lexicographically after numbers)."""
-    parts = []
-    for piece in re.split(r"[.\-+]", version):
-        parts.append((0, int(piece)) if piece.isdigit() else (1, piece))
-    return parts
+    ints) and a RELEASE above its own prereleases ('1.0.0' outranks
+    '1.0.0-rc1' — semver's prerelease rule; naive list comparison
+    would resolve the rc as "latest")."""
+    pieces = re.split(r"[.\-+]", version)
+    core = []
+    i = 0
+    while i < len(pieces) and pieces[i].isdigit():
+        core.append(int(pieces[i]))
+        i += 1
+    pre = pieces[i:]
+    return (
+        core,
+        1 if not pre else 0,  # release > any prerelease of same core
+        [(0, int(p)) if p.isdigit() else (1, p) for p in pre],
+    )
 
 
 def _load_index(path: str) -> Dict:
@@ -134,9 +144,35 @@ def publish_package(
 def _publish_local(
     root: str, artifact: str, payload: bytes, manifest: Dict, digest: str
 ) -> Dict:
+    import contextlib
+
     name, version = manifest["name"], manifest.get("version", "0.0.0")
     os.makedirs(os.path.join(root, ARTIFACT_DIR), exist_ok=True)
     index_path = os.path.join(root, INDEX_NAME)
+    with contextlib.ExitStack() as stack:
+        # the documented shared-filesystem mode means CONCURRENT
+        # publishers: the index read-modify-write must hold an
+        # advisory lock or the second os.replace erases the first
+        # publish's entry (the HTTP path serializes in-process)
+        try:
+            import fcntl
+
+            lock = stack.enter_context(
+                open(os.path.join(root, ".index.lock"), "a+")
+            )
+            fcntl.flock(lock.fileno(), fcntl.LOCK_EX)
+        except ImportError:  # pragma: no cover — non-POSIX
+            pass
+        return _publish_local_locked(
+            root, index_path, artifact, payload, manifest, digest
+        )
+
+
+def _publish_local_locked(
+    root: str, index_path: str, artifact: str, payload: bytes,
+    manifest: Dict, digest: str,
+) -> Dict:
+    name, version = manifest["name"], manifest.get("version", "0.0.0")
     index = _load_index(index_path)
     existing = index["packages"].get(name, {}).get(version)
     if existing is not None:
@@ -180,6 +216,13 @@ def registry_index(registry: str, token: str = "") -> Dict:
                 f"{registry.rstrip('/')}/v1/registry/index", token=token
             ) as resp:
                 return json.loads(resp.read().decode("utf-8"))
+        except urllib.error.HTTPError as e:
+            # HTTPError IS-A URLError: without this arm a reachable
+            # server's 404/500 would read as "unreachable"
+            raise PackageError(
+                f"registry error {e.code} at {registry}: "
+                f"{e.read().decode('utf-8', 'replace')[:200]}"
+            )
         except urllib.error.URLError as e:
             raise PackageError(f"registry unreachable at {registry}: {e}")
     return _load_index(os.path.join(registry, INDEX_NAME))
@@ -216,6 +259,11 @@ def fetch_package(
                 token=token,
             ) as resp:
                 payload = resp.read()
+        except urllib.error.HTTPError as e:
+            raise PackageError(
+                f"registry error {e.code} fetching {entry['artifact']} "
+                f"from {registry}"
+            )
         except urllib.error.URLError as e:
             raise PackageError(f"registry unreachable at {registry}: {e}")
     else:

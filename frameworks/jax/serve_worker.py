@@ -13,9 +13,11 @@ Response: {"tokens": [[...]]} — the continuations only.
 Concurrency: with SERVE_BATCH > 1 the server MICRO-BATCHES — a decode
 step costs nearly the same wall time for 1 or 64 rows, so concurrent
 single-prompt clients that would otherwise serialize behind the chip
-are collected for MICROBATCH_WINDOW_MS and answered by ONE generate
-(grouped by (prompt length, temperature), which the compiled function
-shares across the batch).
+are collected for MICROBATCH_WINDOW_MS and answered by ONE generate.
+MIXED prompt lengths merge too: the compiled function takes a traced
+PER-ROW true_len vector (models/decode.py), so heterogeneous clients
+share one dispatch — only the temperature groups requests (it is one
+traced scalar for the whole batch).
 """
 
 import json
@@ -32,11 +34,10 @@ sys.path.insert(0, os.environ.get("REPO_ROOT", "/root/repo"))
 
 
 class _WorkItem:
-    __slots__ = ("rows", "true_len", "n", "temp", "done", "result", "error")
+    __slots__ = ("rows", "n", "temp", "done", "result", "error")
 
-    def __init__(self, rows, true_len, n, temp):
+    def __init__(self, rows, n, temp):
         self.rows = rows          # list[list[int]], already validated
-        self.true_len = true_len
         self.n = n                # per-item reply slice length
         self.temp = temp
         self.done = threading.Event()
@@ -47,11 +48,12 @@ class _WorkItem:
 class _MicroBatcher:
     """Collect concurrent requests into one generate call.
 
-    Groupable = same (true_len, temperature): the compiled function
-    takes ONE traced length/temperature for the whole batch.  Items
-    keep FIFO order; a window (ms) after the first arrival lets
-    concurrent clients join the batch — the latency cost is the
-    window, the win is that N clients share one chip dispatch.
+    Groupable = same temperature (ONE traced scalar for the whole
+    batch); prompt LENGTHS mix freely — the compiled function takes a
+    per-row true_len vector.  Items keep FIFO order; a window (ms)
+    after the first arrival lets concurrent clients join the batch —
+    the latency cost is the window, the win is that N clients share
+    one chip dispatch.
     """
 
     def __init__(
@@ -112,11 +114,10 @@ class _MicroBatcher:
                 # itself (e.g. a NaN temperature that slipped past
                 # validation) and stall every request queued behind it
                 head = self._pending[0]
-                key = (head.true_len, head.temp)
                 group, rest, used = [head], [], len(head.rows)
                 for item in self._pending[1:]:
                     if (
-                        (item.true_len, item.temp) == key
+                        item.temp == head.temp
                         and used + len(item.rows) <= self._capacity
                     ):
                         group.append(item)
@@ -194,31 +195,34 @@ def main() -> int:
     lock = threading.Lock()
 
     def run_group(items):
-        """ONE generate for a compatible group of requests."""
+        """ONE generate for a compatible group of requests — mixed
+        prompt lengths ride the per-row true_len vector."""
         if len(items) > 1:
             print(
                 f"microbatch: {len(items)} requests / "
                 f"{sum(len(i.rows) for i in items)} rows in one generate",
                 flush=True,
             )
-        true_len, temp = items[0].true_len, items[0].temp
-        padded = jnp.zeros((batch, prompt_len), jnp.int32)
+        temp = items[0].temp
+        padded = np.zeros((batch, prompt_len), np.int32)
+        # unused batch slots still flow through the compiled fn: a
+        # length of 1 keeps their (discarded) computation well-formed
+        lens = np.ones((batch,), np.int32)
         i = 0
         for item in items:
             for row in item.rows:
-                padded = padded.at[i, : len(row)].set(
-                    jnp.asarray(row, jnp.int32)
-                )
+                padded[i, : len(row)] = row
+                lens[i] = len(row)
                 i += 1
         # fresh entropy per batch: hashing only the prompt made
         # temperature>0 replies deterministic per process
         seed = int.from_bytes(os.urandom(4), "little")
         with lock:  # one generate at a time per chip
             out = gen(
-                params, padded,
+                params, jnp.asarray(padded),
                 jax.random.key(seed),
                 jnp.float32(temp),
-                jnp.int32(true_len),
+                jnp.asarray(lens),
             )
         # ONE bulk device->host fetch, then slice in numpy: per-element
         # int(out[i, j]) would be a separate transfer each (~100ms over
@@ -294,7 +298,7 @@ def main() -> int:
                 clean_rows = [
                     [int(t) % config.vocab for t in row] for row in rows
                 ]
-                item = _WorkItem(clean_rows, true_len, n, temp)
+                item = _WorkItem(clean_rows, n, temp)
                 if batcher is not None:
                     result = batcher.submit(item)
                 else:
@@ -323,7 +327,7 @@ def main() -> int:
     warm = jnp.zeros((batch, prompt_len), jnp.int32)
     out = gen(
         params, warm, jax.random.key(0), jnp.float32(0.0),
-        jnp.int32(prompt_len),
+        jnp.full((batch,), prompt_len, jnp.int32),
     )
     jax.block_until_ready(out)
     with open("ready", "w") as f:

@@ -28,5 +28,29 @@ jax.config.update("jax_platforms", "cpu")
 def pytest_configure(config):
     config.addinivalue_line(
         "markers",
-        "slow: long multi-process e2e (several minutes wall clock)",
+        "slow: jax-compiling or multi-process e2e (seconds to minutes); "
+        "run the fast tier with -m 'not slow' (docs/testing.md)",
     )
+
+
+# whole modules that are inherently heavy: every test either compiles
+# a jax model or spawns scheduler/agent processes.  Mixed files mark
+# their heavy tests individually with @pytest.mark.slow.
+_SLOW_FILES = {
+    "test_serve.py",            # process-level scheduler e2e
+    "test_workload.py",         # model training (jax compiles)
+    "test_decode.py",           # KV-cache inference (jax compiles)
+    "test_soak.py",             # event-loop churn soak
+    "test_parallel_pp_ep.py",   # sharded training (jax compiles)
+    "test_serve_inference.py",  # real serve_worker processes
+    "test_data.py",             # device prefetch (jax)
+    "test_provisioning.py",     # warm-cache subprocesses
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    import pytest
+
+    for item in items:
+        if os.path.basename(str(item.fspath)) in _SLOW_FILES:
+            item.add_marker(pytest.mark.slow)

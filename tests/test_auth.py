@@ -238,6 +238,7 @@ def test_state_server_tls_roundtrip(tmp_path):
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow
 def test_serve_e2e_authenticated_control_plane(tmp_path):
     """Scheduler + agents + state server all require the token;
     anonymous launch/kill/kv-set/plan verbs are rejected while the

@@ -247,3 +247,52 @@ def test_prompt_longer_than_cache_rejected(params):
     # error, not silent cache corruption (dynamic_update_slice clamps)
     with pytest.raises(ValueError, match="cannot hold"):
         generate(CFG, params, prompt, max_new_tokens=16, max_len=16)
+
+
+@pytest.mark.parametrize("kv_dtype", ["native", "int8"])
+def test_per_row_true_len_matches_individual_generates(params, kv_dtype):
+    """A MIXED-length right-padded batch with a [b] true_len vector
+    produces, row for row, exactly what each prompt gets on its own —
+    one dispatch serves heterogeneous requests (the serving
+    micro-batcher's mixed-traffic path)."""
+    lens = [3, 7, 5, 1]
+    width, new = 8, 6
+    rng = np.random.default_rng(7)
+    rows = [rng.integers(1, CFG.vocab, n).tolist() for n in lens]
+    padded = np.zeros((len(rows), width), np.int32)
+    for i, row in enumerate(rows):
+        padded[i, : len(row)] = row
+    batched = generate(
+        CFG, params, jnp.asarray(padded), max_new_tokens=new,
+        max_len=width + new,
+        true_len=jnp.asarray(lens, jnp.int32), kv_dtype=kv_dtype,
+    )
+    for i, row in enumerate(rows):
+        solo = generate(
+            CFG, params, jnp.asarray([row], jnp.int32),
+            max_new_tokens=new, max_len=width + new,
+            kv_dtype=kv_dtype,
+        )
+        assert np.asarray(batched)[i].tolist() == \
+            np.asarray(solo)[0].tolist(), f"row {i} (len {row}) diverged"
+
+
+def test_per_row_true_len_one_compile_for_any_mix(params):
+    """The per-row path compiles ONCE for every length mix."""
+    compiles = 0
+    width, new = 8, 4
+
+    @jax.jit
+    def gen(p, t, lens):
+        nonlocal compiles
+        compiles += 1
+        return generate(
+            CFG, p, t, max_new_tokens=new, max_len=width + new,
+            true_len=lens,
+        )
+
+    tokens = jnp.ones((3, width), jnp.int32)
+    gen(params, tokens, jnp.asarray([2, 5, 8], jnp.int32))
+    gen(params, tokens, jnp.asarray([8, 1, 3], jnp.int32))
+    gen(params, tokens, jnp.asarray([4, 4, 4], jnp.int32))
+    assert compiles == 1

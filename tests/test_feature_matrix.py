@@ -152,6 +152,7 @@ def test_task_error_surfaces_as_plan_error_and_restart_clears():
     assert len(agent.launched) == before + 1
 
 
+@pytest.mark.slow
 def test_e2e_missing_template_is_plan_error(tmp_path):
     """non-recoverable.yml through a REAL agent: the missing template
     ERRORs the launch and the deploy plan shows ERROR over HTTP."""

@@ -96,6 +96,7 @@ def test_standby_replicates_and_promote_serves_identical_tree():
         primary.stop()
 
 
+@pytest.mark.slow
 def test_standby_rejects_kv_and_clients_rotate():
     """A standby answers kv with 503; a multi-URL client finds the
     primary regardless of list order."""
@@ -282,6 +283,7 @@ def test_divergence_triggers_snapshot_repair():
         primary.stop()
 
 
+@pytest.mark.slow
 def test_lease_survives_failover(tmp_path):
     """The scheduler instance lease lives IN the replicated tree: the
     holder keeps renewing against the promoted standby, and a rival
@@ -314,6 +316,7 @@ def test_lease_survives_failover(tmp_path):
         standby.stop()
 
 
+@pytest.mark.slow
 def test_standby_restart_resumes_from_persisted_seq(tmp_path):
     """A standby's applied seq is durable: after a standby restart it
     tails from where it left off (same primary ring) and converges."""
@@ -378,6 +381,7 @@ def test_second_standby_puller_rejected_until_window_lapses():
     assert log.wait_replicated(seq) is False  # b has not copied it
 
 
+@pytest.mark.slow
 def test_two_live_standbys_only_one_attaches():
     """E2e form: a second --standby-of server keeps retrying but never
     corrupts the first one's replication stream."""
@@ -409,6 +413,7 @@ def test_two_live_standbys_only_one_attaches():
         primary.stop()
 
 
+@pytest.mark.slow
 def test_ex_primary_rejoins_via_full_snapshot(tmp_path):
     """A promoted standby's primary-life writes never advance its
     applied seq: if it is later fenced and rejoins as a standby, a
@@ -472,6 +477,7 @@ def test_ex_primary_rejoins_via_full_snapshot(tmp_path):
         c.stop()
 
 
+@pytest.mark.slow
 def test_repointed_standby_forces_snapshot_on_stream_mismatch(tmp_path):
     """Seq numbers are only comparable within ONE primary's stream: a
     standby of X repointed at Y (whose ring happens to cover the
@@ -595,6 +601,7 @@ def _post(url, route, body=None):
         return json.loads(resp.read())
 
 
+@pytest.mark.slow
 def test_primary_death_mid_deploy_promote_plan_completes(tmp_path):
     """THE failover e2e (VERDICT r3 #1): real agent daemons, a real
     primary+standby state-server pair, a real scheduler process on
@@ -668,6 +675,7 @@ def test_primary_death_mid_deploy_promote_plan_completes(tmp_path):
                 log.close()
 
 
+@pytest.mark.slow
 def test_promote_cli_verb(tmp_path):
     """`state-server --promote URL --fence-old URL` drives the same
     failover from a shell; a dead old primary is a warning, not an

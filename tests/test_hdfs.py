@@ -6,6 +6,8 @@ config #5 (hdfs + jax co-scheduled on shared inventory).
 """
 
 import os
+
+import pytest
 import sys
 
 from dcos_commons_tpu.common import TaskState, TaskStatus
@@ -245,6 +247,7 @@ def test_name_volume_shared_between_sibling_tasks(tmp_path):
     agent.shutdown()
 
 
+@pytest.mark.slow
 def test_custom_namenodes_endpoint_served(tmp_path):
     """Framework-specific HTTP resources (reference: SeedsResource)
     register through the runner's routes hook and serve next to the
@@ -300,6 +303,7 @@ def test_custom_namenodes_endpoint_served(tmp_path):
         proc.wait(timeout=20)
 
 
+@pytest.mark.slow
 def test_backup_restore_sidecar_plans_via_cli(tmp_path):
     """Parameterized sidecar plans end to end, all via CLI verbs:
     `plan start backup -p BACKUP_DIR=...` snapshots every data

@@ -302,6 +302,7 @@ def _drive_install(multi, agent, name, count):
     raise AssertionError("rollout did not complete")
 
 
+@pytest.mark.slow
 def test_cli_install_with_options_through_served_scheduler(tmp_path):
     """`package install --options file.json` end to end: the options
     ride the X-Service-Options header, the served multi scheduler

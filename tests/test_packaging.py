@@ -142,6 +142,7 @@ def test_install_package_into_multi_scheduler(tmp_path):
     assert svc.deploy_manager.get_plan().is_complete
 
 
+@pytest.mark.slow
 def test_package_cli_build_and_wire_install(tmp_path):
     """CLI build + install against a served --multi scheduler, with
     the packaged config template rendered into the task sandbox."""
@@ -486,6 +487,7 @@ def test_registry_http_server_and_digest_verification(tmp_path):
         server.stop()
 
 
+@pytest.mark.slow
 def test_cli_publish_and_install_from_registry(tmp_path):
     """The full operator flow over real processes: build -> publish
     to a served registry -> install BY NAME from the registry into a
@@ -569,3 +571,21 @@ def test_cli_publish_and_install_from_registry(tmp_path):
         registry_proc.terminate()
         sched_proc.wait(timeout=20)
         registry_proc.wait(timeout=20)
+
+
+def test_registry_version_ordering_release_beats_prerelease(tmp_path):
+    """'1.0.0' must resolve as latest over '1.0.0-rc1' (semver
+    prerelease rule), and numeric ordering beats lexicographic."""
+    from dcos_commons_tpu.tools import fetch_package, publish_package
+
+    framework = make_framework(tmp_path)
+    registry = str(tmp_path / "registry")
+    for version in ("1.0.0-rc1", "1.0.0", "0.9.9"):
+        out = str(tmp_path / f"p-{version}.tgz")
+        build_package(framework, out, version=version)
+        publish_package(out, registry)
+    version, _ = fetch_package(registry, "pkgsvc")
+    assert version == "1.0.0"
+    # pinned prerelease still fetchable
+    version, _ = fetch_package(registry, "pkgsvc", version="1.0.0-rc1")
+    assert version == "1.0.0-rc1"

@@ -201,3 +201,90 @@ def test_moe_ep_gradients_finite():
         grads = jax.jit(jax.grad(lambda p: sharded(p, x)))(params)
     for leaf in jax.tree.leaves(grads):
         assert bool(jnp.all(jnp.isfinite(leaf)))
+
+
+def test_moe_sorted_dispatch_matches_onehot_dropfree():
+    """impl="sorted" (argsort + row gather/scatter) == impl="onehot"
+    in the drop-free regime — same routing decisions, same gates, no
+    [t,E,C] tensors.  f32 on CPU, so agreement is tight."""
+    params = init_moe_params(MOE, jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (64, 32), jnp.float32)
+    y_ref, aux_ref = moe_ffn(MOE, params, x)
+    y_sorted, aux_sorted = moe_ffn(MOE, params, x, impl="sorted")
+    np.testing.assert_allclose(
+        np.asarray(y_sorted), np.asarray(y_ref), atol=1e-5, rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        float(aux_sorted), float(aux_ref), atol=1e-6
+    )
+    # gradients flow and agree
+    def loss(p, impl):
+        out, aux = moe_ffn(MOE, p, x, impl=impl)
+        return (out ** 2).mean() + 0.01 * aux
+
+    g_ref = jax.grad(lambda p: loss(p, "onehot"))(params)
+    g_sorted = jax.grad(lambda p: loss(p, "sorted"))(params)
+    for a, b in zip(jax.tree.leaves(g_ref), jax.tree.leaves(g_sorted)):
+        np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), atol=1e-5, rtol=1e-4
+        )
+
+
+def test_moe_sorted_capacity_drop_priority_matches_onehot():
+    """Under capacity pressure both impls drop the SAME entries: every
+    token's 1st choice outranks any token's 2nd choice (choice-major
+    priority), ties broken by token order."""
+    tight = MoEConfig(d_model=32, d_ff=64, n_experts=4, top_k=2,
+                      capacity_factor=0.5, dtype=jnp.float32)
+    params = init_moe_params(tight, jax.random.key(0))
+    x = jax.random.normal(jax.random.key(3), (64, 32), jnp.float32)
+    y_ref, _ = moe_ffn(tight, params, x)
+    y_sorted, _ = moe_ffn(tight, params, x, impl="sorted")
+    np.testing.assert_allclose(
+        np.asarray(y_sorted), np.asarray(y_ref), atol=1e-5, rtol=1e-5
+    )
+
+
+def test_moe_sorted_ep_sharded_matches_dense():
+    """The sorted dispatch composes with expert parallelism: the same
+    all_to_all wire pattern around the gather/scatter."""
+    mesh = make_mesh(MeshSpec(ep=8))
+    params = init_moe_params(MOE, jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (64, 32), jnp.float32)
+    y_dense, _ = moe_ffn(MOE, params, x)
+
+    from dcos_commons_tpu.models import expert_shard_spec
+
+    sharded = shard_map(
+        functools.partial(moe_ffn, MOE, axis_name="ep", impl="sorted"),
+        mesh=mesh,
+        in_specs=(expert_shard_spec(), P("ep")),
+        out_specs=(P("ep"), P()),
+        check_vma=False,
+    )
+    with mesh:
+        y_ep, aux = jax.jit(sharded)(params, x)
+    np.testing.assert_allclose(np.asarray(y_ep), np.asarray(y_dense),
+                               atol=1e-5, rtol=1e-5)
+    assert jnp.isfinite(aux)
+
+
+def test_moe_flagship_impl_knob_equivalence():
+    """TransformerConfig.moe_impl flips the whole model's dispatch;
+    drop-free forwards agree."""
+    from dcos_commons_tpu.models import TransformerConfig, forward, init_params
+
+    base = dict(
+        vocab=64, d_model=32, n_layers=2, n_heads=4, n_kv_heads=4,
+        d_ff=64, max_seq=32, dtype=jnp.float32, remat=False,
+        n_experts=4, moe_top_k=2, moe_capacity_factor=4.0,
+    )
+    cfg_a = TransformerConfig(**base, moe_impl="onehot")
+    cfg_b = TransformerConfig(**base, moe_impl="sorted")
+    params = init_params(cfg_a, jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (2, 32), 0, 64)
+    np.testing.assert_allclose(
+        np.asarray(forward(cfg_b, params, tokens)),
+        np.asarray(forward(cfg_a, params, tokens)),
+        atol=1e-5, rtol=1e-5,
+    )

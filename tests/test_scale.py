@@ -6,8 +6,8 @@ the sim-speed analogue over a shared fleet, asserting completion,
 isolation (every service's tasks land and no reservation collides)
 and that the control plane's per-cycle cost stays sane as N grows.
 
-test_scale_distributed_fleet_with_churn crosses real sockets: 16
-agent daemon PROCESSES under one multi scheduler process, 24
+test_scale_distributed_fleet_with_churn crosses real sockets: 32
+agent daemon PROCESSES under one multi scheduler process, 40
 services, daemon-kill churn — the fleet fan-out
 (agent/remote.py concurrent poll) at fleet size.
 """
@@ -16,6 +16,8 @@ import os
 import subprocess
 import sys
 import time
+
+import pytest
 
 from dcos_commons_tpu.common import TaskState, TaskStatus
 from dcos_commons_tpu.multi import MultiServiceScheduler
@@ -161,9 +163,10 @@ def test_scale_uninstall_one_leaves_rest_running():
 # -- distributed-plane scale: real daemons, real sockets --------------
 
 
+@pytest.mark.slow
 def test_scale_distributed_fleet_with_churn(tmp_path):
-    """16 agent daemon processes under one serve --multi scheduler
-    process, 24 services (48 tasks), then daemon-kill churn: the two
+    """32 agent daemon processes under one serve --multi scheduler
+    process, 40 services (80 tasks), then daemon-kill churn: the two
     dead hosts' tasks are replaced on survivors, every unaffected
     service keeps its task ids, and the per-cycle timer stays bounded
     (reference: helloworld/tests/scale/test_scale.py + the
@@ -176,7 +179,7 @@ def test_scale_distributed_fleet_with_churn(tmp_path):
     )
 
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    n_daemons, n_services = 16, 24
+    n_daemons, n_services = 32, 40
     daemons = [
         AgentProcess(f"sh{i:02d}", str(tmp_path / f"agent-{i:02d}"), repo)
         for i in range(n_daemons)
@@ -241,7 +244,7 @@ def test_scale_distributed_fleet_with_churn(tmp_path):
             return True
 
         wait_for(all_deployed, 180.0, interval_s=1.0,
-                 what="24 services deployed over 16 daemons")
+                 what=f"{n_services} services deployed over {n_daemons} daemons")
 
         def ids_of(name):
             infos = [

@@ -145,10 +145,10 @@ def test_inference_pod_serves_generate(tmp_path):
 
 
 def test_microbatching_merges_concurrent_clients(tmp_path):
-    """SERVE_BATCH > 1: concurrent single-prompt clients are answered
-    by ONE generate call (grouped by prompt length + temperature) with
-    each client's own correct greedy continuation — concurrency must
-    not change any answer."""
+    """SERVE_BATCH > 1: concurrent single-prompt clients — of MIXED
+    prompt lengths — are answered by ONE generate call (per-row
+    true_len; only temperature groups) with each client's own correct
+    greedy continuation — concurrency must not change any answer."""
     import threading
 
     env = {**TINY_ENV, "SERVE_BATCH": "4", "MICROBATCH_WINDOW_MS": "60"}
@@ -193,8 +193,9 @@ def test_microbatching_merges_concurrent_clients(tmp_path):
             with urllib.request.urlopen(req, timeout=60) as resp:
                 return json.loads(resp.read())
 
-        # sequential oracle answers, one per distinct prompt
-        prompts = [[1, 2, 3], [4, 5, 6], [7, 8, 9], [3, 1, 2]]
+        # sequential oracle answers, one per distinct prompt —
+        # DELIBERATELY mixed lengths: heterogeneous clients must merge
+        prompts = [[1, 2, 3], [4, 5], [7, 8, 9, 6, 2], [3]]
         expected = [
             post({"tokens": [p], "max_new_tokens": 6})["tokens"][0]
             for p in prompts
@@ -266,8 +267,8 @@ def test_microbatcher_head_always_dispatches():
     batcher = sw._MicroBatcher(
         run_group, capacity=4, window_s=0.0, queue_timeout_s=5.0
     )
-    poison = sw._WorkItem([[1, 2]], 2, 4, float("nan"))
-    normal = sw._WorkItem([[3, 4]], 2, 4, 0.0)
+    poison = sw._WorkItem([[1, 2]], 4, float("nan"))
+    normal = sw._WorkItem([[3, 4]], 4, 0.0)
     threads = [
         threading.Thread(target=batcher.submit, args=(item,))
         for item in (poison, normal)
@@ -300,7 +301,7 @@ def test_microbatcher_queue_timeout_configurable():
     batcher = sw._MicroBatcher(
         run_group, capacity=2, window_s=0.0, queue_timeout_s=0.3
     )
-    item = sw._WorkItem([[1]], 1, 2, 0.0)
+    item = sw._WorkItem([[1]], 2, 0.0)
     t0 = time.monotonic()
     try:
         batcher.submit(item)

@@ -241,6 +241,7 @@ def test_effective_dest_derivation():
 # -- e2e: real agent fetches into a real sandbox ----------------------
 
 
+@pytest.mark.slow
 def test_e2e_artifact_lands_in_sandbox(tmp_path):
     """Served scheduler + real agent daemon: the task command READS
     the fetched artifact, so TASK_RUNNING proves the fetch-before-
