@@ -167,12 +167,21 @@ def bench_mfu_frontier() -> dict:
     base = flagship_config()
     peak = _peak_bf16_tflops(jax.devices()[0]) * 1e12
     points = [
-        # (batch, no_remat_layers) — 16/0 (full remat) is the
-        # headline; smaller batches buy stored-activation layers.
-        # Points past the HBM boundary (16/1, 24/0 per r3) report as
-        # infeasible — the boundary is part of the result.
-        (16, 0), (16, 1), (12, 1), (8, 2), (8, 4), (4, 12),
+        # (batch, no_remat_layers): the r5 full sweep measured
+        # b12/nr1 0.540 > b16/nr0 0.530 > b8/nr2 0.528 > b14/nr1
+        # 0.515, with b16/nr1, b12/nr2, b8/nr4, b4/nr12 and b24 past
+        # the HBM boundary.  The recurring bench re-verifies the
+        # three live frontier points (each is a fresh ~2-4 min
+        # compile, so the full boundary scan is not re-paid per run);
+        # override with BENCH_FRONTIER_POINTS="b:k,b:k,..." to rescan.
+        (16, 0), (12, 1), (8, 2),
     ]
+    env_points = os.environ.get("BENCH_FRONTIER_POINTS", "")
+    if env_points:
+        points = [
+            tuple(int(v) for v in p.split(":"))
+            for p in env_points.split(",")
+        ]
     out = {}
     frontier = []
     for batch, k in points:
@@ -1085,18 +1094,79 @@ def _run_subprocess_section(
     )
 
 
+
+def bench_preflight() -> dict:
+    """One trivial jit through the relay, subprocess-guarded: if the
+    TPU relay's compile path is wedged (observed: a 256x256 matmul
+    compile hanging for minutes after heavy OOM probing), every
+    compile-bearing section would burn its full budget — better to
+    KNOW up front and shrink the budgets so the run still prints its
+    JSON line with honest per-section errors."""
+    import jax
+    import jax.numpy as jnp
+
+    t0 = time.monotonic()
+    out = jax.jit(lambda x: (x @ x).sum())(jnp.ones((256, 256)))
+    float(jax.device_get(out))
+    return {"relay_preflight_s": round(time.monotonic() - t0, 1)}
+
+
+def _mark(tag, _state={"t": None}):
+    """Per-section wall-clock to stderr (stdout carries ONLY the JSON
+    line); the driver's bench timeout budget is finite, so the hog
+    must be findable from a single run's log."""
+    now = time.monotonic()
+    if _state["t"] is not None:
+        print(f"[bench-timing] {tag}: {now - _state['t']:.1f}s",
+              file=sys.stderr, flush=True)
+    _state["t"] = now
+
+
 def main() -> None:
     import tempfile
 
     extras = {}
+    _mark(None)
+    # relay health gates the chip sections' budgets (two attempts —
+    # transient wedges recover)
+    relay_ok = False
+    for _attempt in (1, 2):
+        try:
+            extras.update(_run_subprocess_section(
+                "bench_preflight", timeout_s=300
+            ))
+            relay_ok = True
+            break
+        except Exception as e:
+            extras["relay_preflight_error"] = repr(e)[:200]
+    extras["relay_degraded"] = not relay_ok
+    _mark("preflight")
     try:
         extras.update(bench_helloworld())
     except Exception as e:
         extras["helloworld_error"] = repr(e)[:200]
+    _mark("helloworld")
     try:
         extras.update(bench_scheduler_scale())
     except Exception as e:
         extras["sched_scale_error"] = repr(e)[:200]
+    _mark("sched_scale")
+    if not relay_ok:
+        # every remaining section needs the chip's compile path; each
+        # would burn its full timeout against a wedged relay.  Print
+        # the JSON line NOW with the control-plane results and an
+        # honest degraded flag instead of timing out the whole run.
+        print(json.dumps(
+            {
+                "metric": "jax_mnist_deploy_plan_wall_clock",
+                "value": 0.0,
+                "unit": "s",
+                "vs_baseline": 0.0,
+                "extras": extras,
+            },
+            sort_keys=True,
+        ))
+        return
     # persistent XLA compilation cache for the deploy's train task
     # (inherited by the agent-launched subprocess).  Three measurements
     # (VERDICT r3 #8):
@@ -1118,6 +1188,7 @@ def main() -> None:
             true_cold["deploy_completed"]
     except Exception as e:
         extras["deploy_true_cold_error"] = repr(e)[:200]
+    _mark("deploy_true_cold")
     cache_dir = tempfile.mkdtemp(prefix="bench-xla-cache-")
     os.environ["JAX_COMPILATION_CACHE_DIR"] = cache_dir
     provisioned = False
@@ -1136,6 +1207,7 @@ def main() -> None:
         provisioned = rc == 0
     except Exception as e:
         extras["provision_error"] = repr(e)[:200]
+    _mark("provision")
     # measurement honesty: the headline deploy is only "provisioned"
     # when the seeding actually succeeded
     extras["deploy_provisioned"] = provisioned
@@ -1147,6 +1219,7 @@ def main() -> None:
         extras["deploy_warm_completed"] = warm["deploy_completed"]
     except Exception as e:
         extras["deploy_warm_error"] = repr(e)[:200]
+    _mark("deploys_provisioned_and_warm")
     for attempt in (1, 2):
         # one retry: the relay's compile helper occasionally drops a
         # request right after the deploy phase's task churn
@@ -1158,14 +1231,17 @@ def main() -> None:
             extras["roofline_error"] = repr(e)[:200]
             if attempt == 1:
                 time.sleep(5)
+    _mark("rooflines")
     try:
         extras.update(bench_transformer())
     except Exception as e:  # deploy result still stands alone
         extras["transformer_error"] = repr(e)[:200]
+    _mark("transformer")
     try:
         extras.update(bench_profile())
     except Exception as e:
         extras["profile_error"] = repr(e)[:200]
+    _mark("profile")
     try:
         # the (batch, no_remat_layers) frontier — each point is a
         # fresh compile with an OOM boundary, so subprocess-guarded
@@ -1174,10 +1250,12 @@ def main() -> None:
         ))
     except Exception as e:
         extras["frontier_error"] = repr(e)[:200]
+    _mark("frontier")
     try:
         extras.update(_run_subprocess_section("bench_decode", timeout_s=420))
     except Exception as e:
         extras["decode_error"] = repr(e)[:200]
+    _mark("decode_b16")
     # decode on this relay is DISPATCH-latency-bound per step (~23
     # steps/s regardless of bytes), so tokens/s scales with batch
     # until HBM bites; bf16 tops out around b=64-128 (cache bytes),
@@ -1196,12 +1274,14 @@ def main() -> None:
         ))
     except Exception as e:
         extras["decode_b64_error"] = repr(e)[:200]
+    _mark("decode_b64")
     try:
         extras.update(_run_subprocess_section(
             "bench_decode_int8", timeout_s=420
         ))
     except Exception as e:
         extras["decode_int8_error"] = repr(e)[:200]
+    _mark("decode_int8_b16")
     try:
         extras.update(_run_subprocess_section(
             "bench_decode_int8", timeout_s=480,
@@ -1219,14 +1299,17 @@ def main() -> None:
         ))
     except Exception as e:
         extras["decode_int8_b64_error"] = repr(e)[:200]
+    _mark("decode_int8_b64")
     try:
         extras.update(_run_subprocess_section("bench_serve", timeout_s=540))
     except Exception as e:
         extras["serve_error"] = repr(e)[:200]
+    _mark("serve")
     try:
         extras.update(_run_subprocess_section("bench_moe", timeout_s=540))
     except Exception as e:
         extras["moe_error"] = repr(e)[:200]
+    _mark("moe")
     # 8-expert point: same total params at finer expert granularity
     # (8 x d_ff 1024 top-2) — higher tok/s, lower activated-MFU (the
     # sparser the activation, the less of the step activated FLOPs
@@ -1253,6 +1336,7 @@ def main() -> None:
         ))
     except Exception as e:
         extras["moe8_error"] = repr(e)[:200]
+    _mark("moe8")
     value = deploy["deploy_wall_clock_s"]
     print(
         json.dumps(
