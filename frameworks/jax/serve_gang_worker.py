@@ -11,6 +11,12 @@ idle tick) to the gang, everyone steps the same program, and rank 0
 replies.  This is the standard multihost serving driver loop; the
 single-chip path (serve_worker.py) stays dispatch-free.
 
+Concurrent clients MICRO-BATCH like the single-chip server: the
+driver drains same-temperature queued requests into one gang dispatch,
+and mixed prompt LENGTHS merge too — the broadcast carries a per-row
+true_len vector (models/decode.py per-row path), so heterogeneous
+clients share the mesh instead of serializing behind it.
+
 Failover comes from GANG recovery, not from this file: kill any host
 and the scheduler replaces the whole gang (tests/test_gang_serve.py
 semantics); the replacement re-rendezvouses, rebuilds the identical
@@ -45,11 +51,10 @@ OP_GENERATE = 1
 
 
 class _Request:
-    __slots__ = ("rows", "true_len", "n", "temp", "done", "result", "error")
+    __slots__ = ("rows", "n", "temp", "done", "result", "error")
 
-    def __init__(self, rows, true_len, n, temp):
+    def __init__(self, rows, n, temp):
         self.rows = rows
-        self.true_len = true_len
         self.n = n
         self.temp = temp
         self.done = threading.Event()
@@ -136,25 +141,27 @@ def main() -> int:
 
         kv_dtype = os.environ.get("KV_DTYPE", "native")
         gen = jax.jit(
-            lambda p, t, seed, temp, n: generate(
+            lambda p, t, seed, temp, lens: generate(
                 config, p, t, max_new_tokens=new_tokens, max_len=max_len,
                 temperature=temp, key=jax.random.key(seed),
-                true_len=n, kv_dtype=kv_dtype,
+                true_len=lens, kv_dtype=kv_dtype,
             ),
             out_shardings=replicated,
         )
 
-        def run_from_head(head, prompt_np):
+        def run_from_payload(head, lens, prompt_np):
             """Execute the broadcast program: EVERY rank decodes the
-            identical head, so traced operands are byte-identical
+            identical payload, so traced operands are byte-identical
             across the gang (diverging scalars would make each rank
-            compute a different program's shard)."""
+            compute a different program's shard).  ``lens`` is the
+            PER-ROW true_len vector: mixed-length merged requests ride
+            one dispatch (models/decode.py per-row path)."""
             out = gen(
                 params,
                 to_global(prompt_np.astype(np.int32)),
-                np.int64(int(head[3])),
-                np.float32(int(head[4]) / 1e6),
-                np.int32(int(head[1])),
+                np.int64(int(head[2])),
+                np.float32(int(head[3]) / 1e6),
+                to_global(lens.astype(np.int32)),
             )
             # replicated output: every rank holds the full answer;
             # ONE bulk fetch (per-element reads are ~100ms each over a
@@ -164,10 +171,11 @@ def main() -> int:
         # warm the compiled path as a GANG before readiness: the first
         # request must not pay the compile, and a rank that cannot
         # compile must fail deploy, not the first client
-        warm_head = np.asarray(
-            [OP_GENERATE, prompt_len, new_tokens, 0, 0], np.int64
+        run_from_payload(
+            np.asarray([OP_GENERATE, batch, 0, 0], np.int64),
+            np.full((batch,), prompt_len, np.int32),
+            np.zeros((batch, prompt_len), np.int32),
         )
-        run_from_head(warm_head, np.zeros((batch, prompt_len), np.int32))
 
         if rank != 0:
             # follower loop: meet rank 0 in every broadcast tick and
@@ -176,11 +184,11 @@ def main() -> int:
                 f.write("warm\n")
             print(f"rank {rank}: following gang broadcasts", flush=True)
             while True:
-                head, prompt = _broadcast_tick(
+                head, lens, prompt = _broadcast_tick(
                     multihost_utils, None, batch, prompt_len
                 )
                 if int(head[0]) == OP_GENERATE:
-                    run_from_head(head, prompt)
+                    run_from_payload(head, lens, prompt)
 
         # ---- rank 0: HTTP front end + gang driver loop --------------
         requests: "queue.Queue[_Request]" = queue.Queue()
@@ -192,31 +200,69 @@ def main() -> int:
                 except queue.Empty:
                     _broadcast_tick(
                         multihost_utils,
-                        (np.zeros(5, np.int64),
+                        (np.zeros(4, np.int64),
+                         np.zeros((batch,), np.int32),
                          np.zeros((batch, prompt_len), np.int32)),
                         batch, prompt_len,
                     )
                     continue
+                # micro-batch: drain whatever same-temperature work is
+                # ALREADY queued (mixed lengths merge via the per-row
+                # lens vector) — concurrent clients share one gang
+                # dispatch instead of serializing behind the mesh
+                group, used = [item], len(item.rows)
+                leftover = []
+                while used < batch:
+                    try:
+                        peer = requests.get_nowait()
+                    except queue.Empty:
+                        break
+                    if (
+                        peer.temp == item.temp
+                        and used + len(peer.rows) <= batch
+                    ):
+                        group.append(peer)
+                        used += len(peer.rows)
+                    else:
+                        leftover.append(peer)
+                for peer in leftover:  # back of the queue, next tick
+                    requests.put(peer)
+                if len(group) > 1:
+                    print(
+                        f"gangbatch: {len(group)} requests / {used} "
+                        "rows in one gang dispatch",
+                        flush=True,
+                    )
                 try:
                     seed = int.from_bytes(os.urandom(4), "little")
                     prompt = np.zeros((batch, prompt_len), np.int32)
-                    for i, row in enumerate(item.rows):
-                        prompt[i, : len(row)] = row
+                    lens = np.ones((batch,), np.int32)
+                    i = 0
+                    for member in group:
+                        for row in member.rows:
+                            prompt[i, : len(row)] = row
+                            lens[i] = len(row)
+                            i += 1
                     head = np.asarray([
-                        OP_GENERATE, item.true_len, item.n, seed,
-                        int(item.temp * 1e6),
+                        OP_GENERATE, used, seed, int(item.temp * 1e6),
                     ], np.int64)
-                    head, prompt = _broadcast_tick(
-                        multihost_utils, (head, prompt), batch, prompt_len
+                    head, lens, prompt = _broadcast_tick(
+                        multihost_utils, (head, lens, prompt),
+                        batch, prompt_len,
                     )
-                    out = run_from_head(head, prompt)
-                    item.result = [
-                        [int(t) for t in out[i, : item.n]]
-                        for i in range(len(item.rows))
-                    ]
+                    out = run_from_payload(head, lens, prompt)
+                    i = 0
+                    for member in group:
+                        member.result = [
+                            [int(t) for t in out[i + r, : member.n]]
+                            for r in range(len(member.rows))
+                        ]
+                        i += len(member.rows)
                 except Exception as e:  # noqa: BLE001 — surface to client
-                    item.error = e
-                item.done.set()
+                    for member in group:
+                        member.error = e
+                for member in group:
+                    member.done.set()
 
         threading.Thread(target=driver, daemon=True).start()
 
@@ -236,16 +282,16 @@ def main() -> int:
                         raise ValueError(
                             f"{len(rows)} prompts > server batch {batch}"
                         )
-                    lens = {len(row) for row in rows}
-                    if len(lens) > 1:
-                        raise ValueError(
-                            "all prompts in one request must share a length"
-                        )
-                    true_len = max(lens, default=0)
-                    if not 1 <= true_len <= prompt_len:
-                        raise ValueError(
-                            f"prompt length must be in [1, {prompt_len}]"
-                        )
+                    # rows may have MIXED lengths: the gang dispatch
+                    # takes a per-row true_len vector
+                    for row in rows:
+                        if not 1 <= len(row) <= prompt_len:
+                            raise ValueError(
+                                f"prompt length must be in "
+                                f"[1, {prompt_len}]"
+                            )
+                    if not rows:
+                        raise ValueError("tokens must be non-empty")
                     temp = float(body.get("temperature", 0.0))
                     if not math.isfinite(temp) or not 0.0 <= temp <= 1e4:
                         # bounded: the broadcast head carries the value
@@ -263,7 +309,7 @@ def main() -> int:
                     item = _Request(
                         [[int(t) % config.vocab for t in row]
                          for row in rows],
-                        true_len, n, temp,
+                        n, temp,
                     )
                     requests.put(item)
                     if not item.done.wait(timeout=float(
@@ -296,15 +342,18 @@ def main() -> int:
 
 
 def _broadcast_tick(multihost_utils, payload, batch, prompt_len):
-    """One gang-wide broadcast: rank 0 passes (head, prompt), the
-    followers pass None and receive rank 0's payload."""
+    """One gang-wide broadcast: rank 0 passes (head, lens, prompt),
+    the followers pass None and receive rank 0's payload.  head =
+    [op, rows_used, seed, temp_micro]; lens is the per-row true_len
+    vector (mixed-length merging)."""
     if payload is None:
         payload = (
-            np.zeros(5, np.int64),
+            np.zeros(4, np.int64),
+            np.zeros((batch,), np.int32),
             np.zeros((batch, prompt_len), np.int32),
         )
-    head, prompt = multihost_utils.broadcast_one_to_all(payload)
-    return np.asarray(head), np.asarray(prompt)
+    head, lens, prompt = multihost_utils.broadcast_one_to_all(payload)
+    return np.asarray(head), np.asarray(lens), np.asarray(prompt)
 
 
 if __name__ == "__main__":

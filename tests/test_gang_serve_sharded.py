@@ -118,6 +118,37 @@ def test_sharded_serving_gang_failover_token_identical(tmp_path):
         assert first == _post(
             port, {"tokens": [[1, 2, 3, 4]], "max_new_tokens": 8}
         )
+        # concurrent MIXED-length clients: each gets its own correct
+        # greedy continuation (the gang micro-batches them into shared
+        # dispatches via the per-row true_len broadcast)
+        import threading
+
+        prompts = [[1, 2, 3, 4], [9, 8], [5, 6, 7, 2, 1]]
+        sequential = [
+            _post(port, {"tokens": [p], "max_new_tokens": 8})["tokens"][0]
+            for p in prompts
+        ]
+        concurrent = [None] * len(prompts)
+        conc_errors = []
+
+        def one_client(i):
+            try:
+                concurrent[i] = _post(
+                    port, {"tokens": [prompts[i]], "max_new_tokens": 8}
+                )["tokens"][0]
+            except Exception as e:  # noqa: BLE001
+                conc_errors.append(e)
+
+        threads = [
+            threading.Thread(target=one_client, args=(i,))
+            for i in range(len(prompts))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not conc_errors, conc_errors
+        assert concurrent == sequential
         # worker 0's log proves the request ran the GANG path
         rank0_host = infos["server-0-api"]["agent_id"]
         rank0_agent = next(a for a in agents if a.host_id == rank0_host)
