@@ -4,9 +4,19 @@ from dcos_commons_tpu.utils.data import synthetic_tokens, synthetic_mnist
 from dcos_commons_tpu.utils.tree import param_count, param_bytes
 from dcos_commons_tpu.utils.checkpoint import save_checkpoint, restore_checkpoint
 from dcos_commons_tpu.utils.compile_cache import enable_compilation_cache
+from dcos_commons_tpu.utils.microbatch import (
+    MicroBatcher,
+    WorkItem,
+    pack_mixed_rows,
+    unpack_results,
+)
 
 __all__ = [
+    "MicroBatcher",
+    "WorkItem",
     "enable_compilation_cache",
+    "pack_mixed_rows",
+    "unpack_results",
     "param_bytes",
     "param_count",
     "restore_checkpoint",

@@ -32,14 +32,19 @@ TPU-first addition.
 import json
 import math
 import os
-import queue
 import sys
-import threading
 
 import numpy as np
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 sys.path.insert(0, os.environ.get("REPO_ROOT", "/root/repo"))
+
+from dcos_commons_tpu.utils.microbatch import (  # noqa: E402
+    MicroBatcher,
+    WorkItem,
+    pack_mixed_rows,
+    unpack_results,
+)
 
 # how often idle ranks meet in a noop collective: the gang must stay
 # in lockstep even with no traffic, or a request would wait on ranks
@@ -48,18 +53,6 @@ IDLE_TICK_S = 0.05
 
 OP_NOOP = 0
 OP_GENERATE = 1
-
-
-class _Request:
-    __slots__ = ("rows", "n", "temp", "done", "result", "error")
-
-    def __init__(self, rows, n, temp):
-        self.rows = rows
-        self.n = n
-        self.temp = temp
-        self.done = threading.Event()
-        self.result = None
-        self.error = None
 
 
 def main() -> int:
@@ -190,81 +183,42 @@ def main() -> int:
                 if int(head[0]) == OP_GENERATE:
                     run_from_payload(head, lens, prompt)
 
-        # ---- rank 0: HTTP front end + gang driver loop --------------
-        requests: "queue.Queue[_Request]" = queue.Queue()
+        # ---- rank 0: HTTP front end + the shared micro-batcher ------
+        # run_group broadcasts the merged group to the gang (mixed
+        # lengths ride the per-row lens vector); on_idle keeps the
+        # followers meeting in noop collectives between requests.
+        def run_group(group):
+            if len(group) > 1:
+                print(
+                    f"gangbatch: {len(group)} requests / "
+                    f"{sum(len(m.rows) for m in group)} rows in one "
+                    "gang dispatch",
+                    flush=True,
+                )
+            prompt, lens, used = pack_mixed_rows(
+                group, batch, prompt_len
+            )
+            seed = int.from_bytes(os.urandom(4), "little")
+            head = np.asarray([
+                OP_GENERATE, used, seed, int(group[0].temp * 1e6),
+            ], np.int64)
+            head, lens, prompt = _broadcast_tick(
+                multihost_utils, (head, lens, prompt),
+                batch, prompt_len,
+            )
+            out = run_from_payload(head, lens, prompt)
+            unpack_results(group, out)
 
-        def driver():
-            while True:
-                try:
-                    item = requests.get(timeout=IDLE_TICK_S)
-                except queue.Empty:
-                    _broadcast_tick(
-                        multihost_utils,
-                        (np.zeros(4, np.int64),
-                         np.zeros((batch,), np.int32),
-                         np.zeros((batch, prompt_len), np.int32)),
-                        batch, prompt_len,
-                    )
-                    continue
-                # micro-batch: drain whatever same-temperature work is
-                # ALREADY queued (mixed lengths merge via the per-row
-                # lens vector) — concurrent clients share one gang
-                # dispatch instead of serializing behind the mesh
-                group, used = [item], len(item.rows)
-                leftover = []
-                while used < batch:
-                    try:
-                        peer = requests.get_nowait()
-                    except queue.Empty:
-                        break
-                    if (
-                        peer.temp == item.temp
-                        and used + len(peer.rows) <= batch
-                    ):
-                        group.append(peer)
-                        used += len(peer.rows)
-                    else:
-                        leftover.append(peer)
-                for peer in leftover:  # back of the queue, next tick
-                    requests.put(peer)
-                if len(group) > 1:
-                    print(
-                        f"gangbatch: {len(group)} requests / {used} "
-                        "rows in one gang dispatch",
-                        flush=True,
-                    )
-                try:
-                    seed = int.from_bytes(os.urandom(4), "little")
-                    prompt = np.zeros((batch, prompt_len), np.int32)
-                    lens = np.ones((batch,), np.int32)
-                    i = 0
-                    for member in group:
-                        for row in member.rows:
-                            prompt[i, : len(row)] = row
-                            lens[i] = len(row)
-                            i += 1
-                    head = np.asarray([
-                        OP_GENERATE, used, seed, int(item.temp * 1e6),
-                    ], np.int64)
-                    head, lens, prompt = _broadcast_tick(
-                        multihost_utils, (head, lens, prompt),
-                        batch, prompt_len,
-                    )
-                    out = run_from_payload(head, lens, prompt)
-                    i = 0
-                    for member in group:
-                        member.result = [
-                            [int(t) for t in out[i + r, : member.n]]
-                            for r in range(len(member.rows))
-                        ]
-                        i += len(member.rows)
-                except Exception as e:  # noqa: BLE001 — surface to client
-                    for member in group:
-                        member.error = e
-                for member in group:
-                    member.done.set()
+        def idle_tick():
+            _broadcast_tick(multihost_utils, None, batch, prompt_len)
 
-        threading.Thread(target=driver, daemon=True).start()
+        batcher = MicroBatcher(
+            run_group, capacity=batch, window_s=0.0,
+            queue_timeout_s=float(
+                os.environ.get("SERVE_QUEUE_TIMEOUT_S", "600")
+            ),
+            on_idle=idle_tick, idle_every_s=IDLE_TICK_S,
+        )
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, fmt, *args):
@@ -306,19 +260,12 @@ def main() -> int:
                     )
                     if n < 1:
                         raise ValueError("max_new_tokens must be >= 1")
-                    item = _Request(
+                    result = batcher.submit(WorkItem(
                         [[int(t) % config.vocab for t in row]
                          for row in rows],
                         n, temp,
-                    )
-                    requests.put(item)
-                    if not item.done.wait(timeout=float(
-                        os.environ.get("SERVE_QUEUE_TIMEOUT_S", "600")
-                    )):
-                        raise RuntimeError("generate timed out in queue")
-                    if item.error is not None:
-                        raise item.error
-                    payload = json.dumps({"tokens": item.result}).encode()
+                    ))
+                    payload = json.dumps({"tokens": result}).encode()
                     self.send_response(200)
                 except Exception as e:  # noqa: BLE001
                     payload = json.dumps({"error": str(e)}).encode()

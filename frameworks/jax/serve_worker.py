@@ -25,113 +25,23 @@ import math
 import os
 import sys
 import threading
-import time
 
 import numpy as np
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 sys.path.insert(0, os.environ.get("REPO_ROOT", "/root/repo"))
 
+from dcos_commons_tpu.utils.microbatch import (  # noqa: E402
+    MicroBatcher,
+    WorkItem,
+    pack_mixed_rows,
+    unpack_results,
+)
 
-class _WorkItem:
-    __slots__ = ("rows", "n", "temp", "done", "result", "error")
-
-    def __init__(self, rows, n, temp):
-        self.rows = rows          # list[list[int]], already validated
-        self.n = n                # per-item reply slice length
-        self.temp = temp
-        self.done = threading.Event()
-        self.result = None        # list[list[int]] once served
-        self.error = None
-
-
-class _MicroBatcher:
-    """Collect concurrent requests into one generate call.
-
-    Groupable = same temperature (ONE traced scalar for the whole
-    batch); prompt LENGTHS mix freely — the compiled function takes a
-    per-row true_len vector.  Items keep FIFO order; a window (ms)
-    after the first arrival lets concurrent clients join the batch —
-    the latency cost is the window, the win is that N clients share
-    one chip dispatch.
-    """
-
-    def __init__(
-        self, run_group, capacity: int, window_s: float,
-        queue_timeout_s: float = 600.0,
-    ):
-        self._run_group = run_group   # fn(items) -> None (fills results)
-        self._capacity = capacity
-        self._window_s = window_s
-        self._queue_timeout_s = queue_timeout_s
-        self._cv = threading.Condition()
-        self._pending = []
-        self._thread = threading.Thread(
-            target=self._loop, name="microbatch", daemon=True
-        )
-        self._thread.start()
-
-    def submit(self, item: _WorkItem):
-        with self._cv:
-            self._pending.append(item)
-            self._cv.notify()
-        if not item.done.wait(timeout=self._queue_timeout_s):
-            with self._cv:
-                # abandoned work must not reach the chip later: a
-                # wedged generate would otherwise leave a backlog of
-                # dead requests ahead of live ones on recovery
-                try:
-                    self._pending.remove(item)
-                except ValueError:
-                    pass  # already grouped: the result will be dropped
-            raise RuntimeError("generate timed out in the batch queue")
-        if item.error is not None:
-            raise item.error
-        return item.result
-
-    def _rows_pending(self) -> int:
-        return sum(len(item.rows) for item in self._pending)
-
-    def _loop(self):
-        while True:
-            with self._cv:
-                while not self._pending:
-                    self._cv.wait()
-                if self._window_s > 0:
-                    # recruit peers for up to the window — but a FULL
-                    # batch dispatches immediately (the window is only
-                    # paid when it can still buy merging)
-                    deadline = time.monotonic() + self._window_s
-                    while self._rows_pending() < self._capacity:
-                        remaining = deadline - time.monotonic()
-                        if remaining <= 0:
-                            break
-                        self._cv.wait(timeout=remaining)
-                if not self._pending:
-                    continue  # sole item timed out and removed itself
-                # the head ALWAYS dispatches: grouping by key equality
-                # alone would starve a head whose key never equals
-                # itself (e.g. a NaN temperature that slipped past
-                # validation) and stall every request queued behind it
-                head = self._pending[0]
-                group, rest, used = [head], [], len(head.rows)
-                for item in self._pending[1:]:
-                    if (
-                        item.temp == head.temp
-                        and used + len(item.rows) <= self._capacity
-                    ):
-                        group.append(item)
-                        used += len(item.rows)
-                    else:
-                        rest.append(item)
-                self._pending = rest
-            try:
-                self._run_group(group)
-            except Exception as e:  # noqa: BLE001 — fan the error out
-                for item in group:
-                    item.error = e
-            for item in group:
-                item.done.set()
+# back-compat aliases (unit tests drive the batcher through this
+# module's names; the implementation is shared with the gang server)
+_MicroBatcher = MicroBatcher
+_WorkItem = WorkItem
 
 
 def main() -> int:
@@ -203,17 +113,7 @@ def main() -> int:
                 f"{sum(len(i.rows) for i in items)} rows in one generate",
                 flush=True,
             )
-        temp = items[0].temp
-        padded = np.zeros((batch, prompt_len), np.int32)
-        # unused batch slots still flow through the compiled fn: a
-        # length of 1 keeps their (discarded) computation well-formed
-        lens = np.ones((batch,), np.int32)
-        i = 0
-        for item in items:
-            for row in item.rows:
-                padded[i, : len(row)] = row
-                lens[i] = len(row)
-                i += 1
+        padded, lens, _used = pack_mixed_rows(items, batch, prompt_len)
         # fresh entropy per batch: hashing only the prompt made
         # temperature>0 replies deterministic per process
         seed = int.from_bytes(os.urandom(4), "little")
@@ -221,21 +121,14 @@ def main() -> int:
             out = gen(
                 params, jnp.asarray(padded),
                 jax.random.key(seed),
-                jnp.float32(temp),
+                jnp.float32(items[0].temp),
                 jnp.asarray(lens),
             )
         # ONE bulk device->host fetch, then slice in numpy: per-element
         # int(out[i, j]) would be a separate transfer each (~100ms over
         # a TPU relay — 256 of them turned a 1.5s generate into a 36s
         # reply)
-        host_out = np.asarray(jax.device_get(out))
-        i = 0
-        for item in items:
-            item.result = [
-                [int(t) for t in host_out[i + r, : item.n]]
-                for r in range(len(item.rows))
-            ]
-            i += len(item.rows)
+        unpack_results(items, np.asarray(jax.device_get(out)))
 
     window_s = float(os.environ.get("MICROBATCH_WINDOW_MS", "5")) / 1e3
     # with a 1-row server there is nothing to batch: the direct path
@@ -266,21 +159,19 @@ def main() -> int:
                         f"{len(rows)} prompts > server batch {batch}; "
                         "split the request"
                     )
-                lens = {len(row) for row in rows}
-                if len(lens) > 1:
-                    raise ValueError(
-                        "all prompts in one request must share a length"
-                    )
-                true_len = max(lens, default=0)
-                if true_len < 1:
-                    raise ValueError("prompts must be non-empty")
-                if true_len > prompt_len:
-                    # refuse, don't silently continue a DIFFERENT
-                    # (truncated) prompt
-                    raise ValueError(
-                        f"prompt length {true_len} exceeds the server's "
-                        f"context {prompt_len}"
-                    )
+                # rows may have MIXED lengths (per-row true_len); an
+                # over-length prompt is refused, never silently
+                # continued as a DIFFERENT (truncated) prompt
+                if not rows:
+                    raise ValueError("tokens must be non-empty")
+                for row in rows:
+                    if len(row) < 1:
+                        raise ValueError("prompts must be non-empty")
+                    if len(row) > prompt_len:
+                        raise ValueError(
+                            f"prompt length {len(row)} exceeds the "
+                            f"server's context {prompt_len}"
+                        )
                 temp = float(body.get("temperature", 0.0))
                 if not math.isfinite(temp) or temp < 0.0:
                     # json.loads accepts NaN/Infinity: a NaN group key

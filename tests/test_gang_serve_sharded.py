@@ -149,6 +149,14 @@ def test_sharded_serving_gang_failover_token_identical(tmp_path):
             t.join(timeout=120)
         assert not conc_errors, conc_errors
         assert concurrent == sequential
+        # ONE multi-row request with MIXED lengths pins the per-row
+        # lens path deterministically (the concurrent phase above only
+        # merges when thread timing races the requests into one tick)
+        mixed = _post(
+            port,
+            {"tokens": [prompts[0], prompts[1]], "max_new_tokens": 8},
+        )
+        assert mixed["tokens"] == [sequential[0], sequential[1]]
         # worker 0's log proves the request ran the GANG path
         rank0_host = infos["server-0-api"]["agent_id"]
         rank0_agent = next(a for a in agents if a.host_id == rank0_host)

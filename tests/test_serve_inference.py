@@ -222,6 +222,13 @@ def test_microbatching_merges_concurrent_clients(tmp_path):
             t.join(timeout=120)
         assert not errors, errors
         assert results == expected
+        # ONE multi-row MIXED-length request pins the per-row lens
+        # path deterministically (concurrent merging above depends on
+        # thread timing)
+        mixed = post({
+            "tokens": [prompts[0], prompts[1]], "max_new_tokens": 6,
+        })
+        assert mixed["tokens"] == [expected[0], expected[1]]
         # the worker's log shows at least one merged batch
         stdout_path = tmp_path / "sbx" / "server-0-api" / "stdout"
         deadline = time.monotonic() + 10
@@ -310,3 +317,48 @@ def test_microbatcher_queue_timeout_configurable():
         assert "timed out" in str(e)
     assert time.monotonic() - t0 < 5.0
     wedge.set()
+
+
+def test_microbatcher_fifo_and_idle_callback():
+    """Shared-batcher liveness (advisor r5): a temp-mismatched head
+    keeps its queue position and dispatches next (no back-requeue
+    starvation), and on_idle fires between requests without stealing
+    work — the gang server's followers depend on both."""
+    import threading
+    import time as _time
+
+    from dcos_commons_tpu.utils.microbatch import MicroBatcher, WorkItem
+
+    served_groups = []
+    idle_calls = []
+
+    def run_group(items):
+        served_groups.append([item.temp for item in items])
+        for item in items:
+            item.result = [[0] * item.n for _ in item.rows]
+
+    batcher = MicroBatcher(
+        run_group, capacity=4, window_s=0.0, queue_timeout_s=5.0,
+        on_idle=lambda: idle_calls.append(1), idle_every_s=0.01,
+    )
+    deadline = _time.monotonic() + 5
+    while not idle_calls and _time.monotonic() < deadline:
+        _time.sleep(0.01)
+    assert idle_calls, "on_idle never fired while the queue was idle"
+    # an odd-temperature item arriving FIRST is served before a stream
+    # of mergeable peers that arrive behind it
+    odd = WorkItem([[1]], 2, 0.7)
+    peers = [WorkItem([[2]], 2, 0.0) for _ in range(4)]
+    threads = [
+        threading.Thread(target=batcher.submit, args=(item,))
+        for item in [odd] + peers
+    ]
+    for t in threads:
+        t.start()
+        _time.sleep(0.005)  # preserve arrival order
+    for t in threads:
+        t.join(timeout=10)
+    assert odd.done.is_set() and odd.error is None
+    assert served_groups[0][0] == 0.7, (
+        f"head lost its position: {served_groups}"
+    )
