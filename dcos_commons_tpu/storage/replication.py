@@ -4,19 +4,23 @@ Reference: the reference's durability story is a ZooKeeper *ensemble*
 behind CuratorPersister (curator/CuratorPersister.java:43-110 — atomic
 multi-op transactions against a replicated quorum), so the state
 backend itself has no single point of failure.  This module gives the
-TPU fleet's StateServer the same property with a primary/standby pair:
+TPU fleet's StateServer the same property with a primary plus N hot
+standbys:
 
 * every mutation the primary applies is appended to a seq-numbered
-  **replication log**; a standby tails it over long-poll HTTP
+  **replication log**; each standby tails it over long-poll HTTP
   (``/v1/repl/pull``) and applies entries to its own durable backend
   in order — bootstrap (or divergence repair) is a full-tree
-  ``/v1/repl/snapshot``;
-* writes are **bounded-sync**: while a standby is attached and caught
-  up, the primary acks a mutation only after the standby has pulled
-  it (zero-loss failover in the healthy case); if the standby stalls
-  past ``sync_timeout_s`` it is marked lagging and writes continue
-  (availability over strict sync — the lag is repaired by the tail
-  and the scheduler's reconciliation-on-restart covers the window);
+  ``/v1/repl/snapshot``.  Standbys carry independent per-puller
+  watermarks: one standby's acks never stand in for another's;
+* writes are **bounded-sync**: while standbys are attached and caught
+  up, the primary acks a mutation only after EVERY in-sync standby
+  has pulled it (so promotion may pick any of them without losing an
+  acked write — zero-loss failover in the healthy case); a standby
+  that stalls past ``sync_timeout_s`` is marked lagging and writes
+  continue (availability over strict sync — the lag is repaired by
+  the tail and the scheduler's reconciliation-on-restart covers the
+  window);
 * failover is an explicit **promotion** (``/v1/repl/promote``) that
   mints a new fencing **epoch** (monotonic, persisted).  Every client
   request carries the highest epoch its sender has seen; a primary
@@ -127,6 +131,14 @@ class ReplicationLog:
     WAL.  A standby asking for a seq the ring no longer holds (primary
     restarted, or the standby fell too far behind) is told to
     re-snapshot — the same repair path as initial bootstrap.
+
+    N standbys may attach (the ZooKeeper-ensemble analogue is a
+    quorum, not a pair): each puller carries its OWN watermark, and
+    bounded-sync waits on EVERY attached non-lagging standby — so
+    "replicated" means any of them can be promoted without losing an
+    acked write.  A standby that stalls is marked lagging (excluded
+    from the barrier, repaired by its own tail); one that stops
+    pulling past the attach window is pruned entirely.
     """
 
     def __init__(self, max_entries: int = 8192,
@@ -136,10 +148,8 @@ class ReplicationLog:
         self._entries: deque = deque()  # (seq, [op dicts])
         self._cv = threading.Condition()
         self._next_seq = 1
-        self._acked = 0
-        self._last_pull = 0.0  # monotonic; 0 = never
-        self._lagging = False
-        self._puller_id: Optional[str] = None
+        # puller_id -> {"acked": int, "last_pull": float, "lagging": bool}
+        self._pullers: Dict[str, dict] = {}
         self._max_entries = max_entries
         self.sync_timeout_s = sync_timeout_s
         # identifies THIS ring of seq numbers: seqs are only comparable
@@ -147,6 +157,16 @@ class ReplicationLog:
         # from a DIFFERENT stream (old primary, pre-promotion life) must
         # re-snapshot even when the raw numbers happen to line up.
         self.stream_id = uuid.uuid4().hex
+
+    def _attached(self, now: float) -> Dict[str, dict]:
+        """Live pullers; prunes ones silent past the attach window (a
+        dead standby must stop gating the write barrier)."""
+        for pid in [
+            pid for pid, st in self._pullers.items()
+            if now - st["last_pull"] > ATTACH_WINDOW_S
+        ]:
+            del self._pullers[pid]
+        return self._pullers
 
     # -- primary write path -------------------------------------------
 
@@ -161,24 +181,29 @@ class ReplicationLog:
             return seq
 
     def wait_replicated(self, seq: int) -> bool:
-        """Block until an attached standby has acked ``seq`` (the
-        bounded-sync barrier).  Returns immediately when no standby is
-        attached or the standby is already marked lagging; marks it
-        lagging on timeout.  True = replicated."""
+        """Block until EVERY attached, non-lagging standby has acked
+        ``seq`` (the bounded-sync barrier) — all-of, not any-of, so
+        promotion may pick ANY in-sync standby without losing an acked
+        write.  Returns immediately when no standby is in sync; on
+        timeout the stragglers are marked lagging (they repair via
+        their own tails and re-earn the barrier by catching up).
+        True = replicated to every in-sync standby."""
         deadline = time.monotonic() + self.sync_timeout_s
         with self._cv:
             while True:
-                if self._acked >= seq:
-                    return True
                 now = time.monotonic()
-                if (
-                    self._last_pull == 0.0
-                    or now - self._last_pull > ATTACH_WINDOW_S
-                    or self._lagging
-                ):
-                    return False  # nobody attached / already lagging
+                live = [
+                    st for st in self._attached(now).values()
+                    if not st["lagging"]
+                ]
+                if not live:
+                    return False  # nobody in sync to wait for
+                pending = [st for st in live if st["acked"] < seq]
+                if not pending:
+                    return True
                 if now >= deadline:
-                    self._lagging = True
+                    for st in pending:
+                        st["lagging"] = True
                     return False
                 self._cv.wait(timeout=min(0.05, deadline - now))
 
@@ -186,39 +211,31 @@ class ReplicationLog:
 
     def pull(self, from_seq: int, wait_s: float,
              puller_id: str = "", stream_id: str = "") -> dict:
-        """Entries at/after ``from_seq``; pulling acks ``from_seq-1``.
-        ``snapshot_needed`` when continuity from ``from_seq`` cannot
-        be proven (ring trimmed, or a fresh/restarted primary).
+        """Entries at/after ``from_seq``; pulling acks ``from_seq-1``
+        for THIS puller.  ``snapshot_needed`` when continuity from
+        ``from_seq`` cannot be proven (ring trimmed, a fresh/restarted
+        primary, or a seq from another stream).
 
-        One standby at a time: the single _acked watermark means a
-        second concurrent puller would advance the ack past writes the
-        slower standby never copied — promoting the slower one would
-        then lose writes the primary acked as replicated.  A pull from
-        a different ``puller_id`` while the current one is attached is
-        rejected; after the attach window lapses the new puller takes
-        over and the stale watermark is voided."""
+        Each puller_id owns an independent watermark: a fast standby's
+        acks never stand in for a slow one's (promoting the slow one
+        after an any-of ack would lose writes the primary reported
+        replicated).  A RETURNING puller_id restarts at acked 0 — its
+        previous watermark may describe a tree that has since been
+        wiped — and re-earns the barrier by pulling."""
         wait_s = max(0.0, min(wait_s, MAX_PULL_WAIT_S))
         deadline = time.monotonic() + wait_s
         with self._cv:
             now = time.monotonic()
-            if (
-                self._puller_id is not None
-                and puller_id != self._puller_id
-                and self._last_pull > 0.0
-                and now - self._last_pull <= ATTACH_WINDOW_S
-            ):
-                raise PersisterError(
-                    f"a standby ({self._puller_id}) is already "
-                    "attached; one standby per primary"
-                )
-            if puller_id != self._puller_id:
-                # takeover (first attach, or the old standby is gone):
-                # the previous watermark says nothing about THIS
-                # standby's tree — it must re-earn every ack
-                self._puller_id = puller_id
-                self._acked = 0
-                self._lagging = False
-            self._last_pull = time.monotonic()
+            self._attached(now)  # prune the silent
+            st = self._pullers.get(puller_id)
+            if st is None:
+                # fresh attach: no ack history — it earns the barrier
+                # from zero (lagging=False: a standby resuming from its
+                # durable applied-seq proves continuity on this very
+                # pull, or gets marked lagging below)
+                st = {"acked": 0, "last_pull": now, "lagging": False}
+                self._pullers[puller_id] = st
+            st["last_pull"] = now
             if stream_id and stream_id != self.stream_id:
                 # the standby's applied seq is from a DIFFERENT ring:
                 # acking from it would falsely mark this stream's
@@ -227,7 +244,7 @@ class ReplicationLog:
                 # HERE, before the ack — the standby-side check alone
                 # runs after the primary has already released
                 # wait_replicated() waiters.
-                self._lagging = True
+                st["lagging"] = True
                 return {
                     "snapshot_needed": True,
                     "seq": self._next_seq - 1,
@@ -242,24 +259,30 @@ class ReplicationLog:
                 # writes the standby never copied.  It IS attached but
                 # behind: mark lagging so writers don't block on it
                 # while it snapshots.
-                self._lagging = True
+                st["lagging"] = True
                 return {
                     "snapshot_needed": True,
                     "seq": self._next_seq - 1,
                     "stream_id": self.stream_id,
                 }
             ack = min(from_seq - 1, self._next_seq - 1)
-            if ack > self._acked:
-                self._acked = ack
-            if self._lagging and self._acked >= self._next_seq - 1:
-                self._lagging = False
+            if ack < st["acked"]:
+                # the puller moved BACKWARDS (a standby with a stable
+                # id restarted after wiping its tree): its old
+                # watermark no longer describes that tree — drop to
+                # what this pull actually proves and re-earn the rest
+                st["acked"] = max(ack, 0)
+            elif ack > st["acked"]:
+                st["acked"] = ack
+            if st["lagging"] and st["acked"] >= self._next_seq - 1:
+                st["lagging"] = False
             self._cv.notify_all()
             while self._next_seq <= from_seq:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     break
                 self._cv.wait(timeout=remaining)
-                self._last_pull = time.monotonic()
+                st["last_pull"] = time.monotonic()
             entries = [
                 {"seq": seq, "ops": ops}
                 for seq, ops in self._entries if seq >= from_seq
@@ -271,15 +294,29 @@ class ReplicationLog:
     def status(self) -> dict:
         with self._cv:
             now = time.monotonic()
-            attached = (
-                self._last_pull > 0.0
-                and now - self._last_pull <= ATTACH_WINDOW_S
-            )
+            live = self._attached(now)
             return {
                 "seq": self._next_seq - 1,
-                "acked_seq": self._acked,
-                "standby_attached": attached,
-                "standby_lagging": self._lagging,
+                # the conservative watermark: everything at or below
+                # this has reached EVERY attached standby (lagging
+                # ones included — their trees are still behind it)
+                "acked_seq": (
+                    min(st["acked"] for st in live.values())
+                    if live else 0
+                ),
+                "standby_attached": bool(live),
+                "standby_lagging": any(
+                    st["lagging"] for st in live.values()
+                ),
+                "standby_count": len(live),
+                "standbys": {
+                    pid: {
+                        "acked": st["acked"],
+                        "lagging": st["lagging"],
+                        "age_s": round(now - st["last_pull"], 3),
+                    }
+                    for pid, st in live.items()
+                },
             }
 
     def reset(self, base_seq: int) -> None:
@@ -290,10 +327,7 @@ class ReplicationLog:
         with self._cv:
             self._entries.clear()
             self._next_seq = base_seq + 1
-            self._acked = 0
-            self._last_pull = 0.0
-            self._lagging = False
-            self._puller_id = None
+            self._pullers.clear()
             # a NEW stream: the promoted server's ring is not the old
             # primary's, even though the seq numbering continues
             self.stream_id = uuid.uuid4().hex

@@ -355,58 +355,88 @@ def test_standby_restart_resumes_from_persisted_seq(tmp_path):
         primary.stop()
 
 
-def test_second_standby_puller_rejected_until_window_lapses():
-    """One standby per primary: the single ack watermark means a
-    second concurrent puller would advance the ack past writes the
-    slower standby never copied (advisor r4).  After the attach window
-    the new puller takes over and the stale watermark is voided."""
+def test_per_puller_watermarks_never_cross():
+    """N standbys, each with its OWN ack watermark (advisor r4 asked
+    for exactly this): the fast standby's acks must not stand in for
+    the slow one's — bounded-sync passes only when EVERY in-sync
+    standby copied the write, so promoting ANY of them keeps every
+    acked write."""
     from dcos_commons_tpu.storage.replication import ATTACH_WINDOW_S
 
     log = ReplicationLog(sync_timeout_s=0.2)
     log.append([{"op": "set", "path": "/a", "value": ""}])
     out = log.pull(from_seq=1, wait_s=0, puller_id="standby-a")
     assert [e["seq"] for e in out["entries"]] == [1]
-    with pytest.raises(PersisterError, match="already attached"):
-        log.pull(from_seq=1, wait_s=0, puller_id="standby-b")
-    # standby-a acks seq 1
-    log.pull(from_seq=2, wait_s=0, puller_id="standby-a")
-    assert log.status()["acked_seq"] == 1
-    # standby-a dies; after the window, standby-b may take over — and
-    # a's watermark says nothing about b's tree, so it is voided
-    log._last_pull -= ATTACH_WINDOW_S + 1.0
     out = log.pull(from_seq=1, wait_s=0, puller_id="standby-b")
     assert [e["seq"] for e in out["entries"]] == [1]
+    assert log.status()["standby_count"] == 2
+    # only A acks seq 1: the barrier watermark stays at B's 0
+    log.pull(from_seq=2, wait_s=0, puller_id="standby-a")
     assert log.status()["acked_seq"] == 0
+    assert log.status()["standbys"]["standby-a"]["acked"] == 1
     seq = log.append([{"op": "set", "path": "/b", "value": ""}])
-    assert log.wait_replicated(seq) is False  # b has not copied it
+    # a acks BEFORE the barrier; b never does: the barrier still
+    # fails — an any-of ack would lose this write if b were promoted —
+    # and ONLY the straggler is marked lagging (deterministic: no
+    # timer races the sync timeout)
+    log.pull(from_seq=seq + 1, wait_s=0, puller_id="standby-a")
+    assert log.wait_replicated(seq) is False
+    assert log.status()["standbys"]["standby-a"]["lagging"] is False
+    assert log.status()["standbys"]["standby-b"]["lagging"] is True
+    # with b excluded, a's acks alone satisfy the barrier
+    seq2 = log.append([{"op": "set", "path": "/c", "value": ""}])
+    log.pull(from_seq=seq2 + 1, wait_s=0, puller_id="standby-a")
+    assert log.wait_replicated(seq2) is True
+    # b catches up to the tip: lagging clears, barrier includes it again
+    log.pull(from_seq=seq2 + 1, wait_s=0, puller_id="standby-b")
+    assert log.status()["standbys"]["standby-b"]["lagging"] is False
+    assert log.status()["acked_seq"] == seq2
+    # a RESTARTED standby with a STABLE id that wiped its tree pulls
+    # from seq 1 again: its old watermark must drop — promoting it
+    # mid-catch-up must not count old acks (review r5)
+    log.pull(from_seq=1, wait_s=0, puller_id="standby-a")
+    assert log.status()["standbys"]["standby-a"]["acked"] == 0
+    # a dies: pruned after the attach window, b alone gates the barrier
+    log._pullers["standby-a"]["last_pull"] -= ATTACH_WINDOW_S + 1.0
+    assert log.status()["standby_count"] == 1
+    # a RETURNING puller restarts at acked 0 (its tree may have been
+    # wiped since): it re-earns the barrier by pulling
+    log.pull(from_seq=1, wait_s=0, puller_id="standby-a")
+    assert log.status()["standbys"]["standby-a"]["acked"] == 0
 
 
 @pytest.mark.slow
-def test_two_live_standbys_only_one_attaches():
-    """E2e form: a second --standby-of server keeps retrying but never
-    corrupts the first one's replication stream."""
+def test_two_live_standbys_both_replicate_and_either_promotes():
+    """E2e: two --standby-of servers stream the same primary
+    concurrently; each holds the full tree, and promoting one of them
+    serves it (the ensemble property: any replica can take over)."""
     primary = StateServer(MemPersister()).start()
     first = StateServer(MemPersister(), replicate_from=primary.url).start()
     second = StateServer(MemPersister(), replicate_from=primary.url).start()
     try:
         client = RemotePersister(primary.url)
         client.set("/svc/a", b"1")
-        # exactly ONE standby wins the attach (which one is a race);
-        # the other parks on the rejection, retrying
-        def rejected(server):
-            return "already attached" in server._tail.last_error
-
-        wait_until(
-            lambda: rejected(first) != rejected(second),
-            what="one standby rejected",
-        )
-        attached = second if rejected(first) else first
-        # and the attached standby keeps streaming normally
+        for standby in (first, second):
+            wait_until(
+                lambda s=standby: user_dump(s._backend) == user_dump(
+                    primary._backend
+                ),
+                what="both standbys bootstrap",
+            )
+        status = RemotePersister(primary.url)._call("/v1/repl/status", {})
+        assert status["standby_count"] == 2
         client.set("/svc/b", b"2")
-        wait_until(
-            lambda: attached._backend.get_or_none("/svc/b") == b"2",
-            what="attached standby still streams",
-        )
+        for standby in (first, second):
+            wait_until(
+                lambda s=standby: s._backend.get_or_none("/svc/b") == b"2",
+                what="both standbys stream",
+            )
+        # promote the SECOND; the full tree is there
+        out = RemotePersister(second.url)._call("/v1/repl/promote", {})
+        assert out["epoch"] == 2
+        promoted = RemotePersister(second.url)
+        assert promoted.get("/svc/a") == b"1"
+        assert promoted.get("/svc/b") == b"2"
     finally:
         second.stop()
         first.stop()
