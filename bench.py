@@ -593,47 +593,72 @@ def _timed_median_steps(gen, params, prompt, new_tokens,
     return compile_s, statistics.median(rates)
 
 
-def bench_decode() -> dict:
-    """Serving throughput: KV-cache autoregressive generate on the
-    flagship (models/decode.py), one device dispatch for the whole
-    continuation (lax.scan over steps).  Decode is HBM-bound — each
-    step streams the full 1.7 GB bf16 parameter set — so the extras
-    report the HBM roofline next to the measured rate.
-
-    Run in a SUBPROCESS with a hard timeout by main(): the remote
-    compile helper has been observed to wedge on this program shape,
-    and a hung section must never stall the whole bench."""
+def _bench_decode_impl(
+    prefix: str, kv_dtype: str = "native",
+    quantize_weights: bool = False, bf16_roofline_key: str = "",
+) -> dict:
+    """Shared scaffolding for the three decode benches (bf16 /
+    int8-KV / int8-weights+KV): one flagship generate jitted over the
+    requested quantization, timed by _timed_median_steps, with the
+    HBM stream roofline for the AS-STORED bytes.  All three run in a
+    SUBPROCESS with a hard timeout from main(): the remote compile
+    helper has been observed to wedge on this program shape, and a
+    hung section must never stall the whole bench."""
     import jax
-    import jax.numpy as jnp
 
     from dcos_commons_tpu.models import generate, init_params
-    from dcos_commons_tpu.utils import param_bytes, synthetic_tokens
+    from dcos_commons_tpu.utils import synthetic_tokens
 
     config = flagship_config()
     batch = int(os.environ.get("BENCH_DECODE_BATCH", "16"))
     new_tokens = int(os.environ.get("BENCH_DECODE_TOKENS", "64"))
     prompt_len, max_len = 128, 512
     params = init_params(config, jax.random.key(0))
+    hbm = 819.0e9  # v5e
+    out = {}
+    if bf16_roofline_key:
+        # the comparison column, computed on the UNQUANTIZED tree
+        out[bf16_roofline_key] = round(
+            hbm / _decode_stream_bytes(config, params, batch, max_len,
+                                       int8=False), 1
+        )
+    if quantize_weights:
+        from dcos_commons_tpu.models import quantize_params_int8
+
+        qparams = jax.jit(quantize_params_int8)(params)
+        jax.block_until_ready(qparams)
+        del params  # both trees live would double the HBM footprint
+        params = qparams
     prompt, _ = synthetic_tokens(
         jax.random.key(1), batch, prompt_len, config.vocab
     )
     gen = jax.jit(lambda p, t: generate(
-        config, p, t, max_new_tokens=new_tokens, max_len=max_len
+        config, p, t, max_new_tokens=new_tokens, max_len=max_len,
+        kv_dtype=kv_dtype,
     ))
     compile_s, steps_per_s = _timed_median_steps(
         gen, params, prompt, new_tokens
     )
-    hbm = 819.0e9  # v5e
-    return {
-        "decode_batch": batch,
-        "decode_compile_s": round(compile_s, 1),
-        "decode_steps_per_s": round(steps_per_s, 1),
-        "decode_tokens_per_s": round(batch * steps_per_s, 1),
-        "decode_stream_roofline_steps_per_s": round(
+    out.update({
+        f"{prefix}_batch": batch,
+        f"{prefix}_compile_s": round(compile_s, 1),
+        f"{prefix}_steps_per_s": round(steps_per_s, 1),
+        f"{prefix}_tokens_per_s": round(batch * steps_per_s, 1),
+        f"{prefix}_stream_roofline_steps_per_s": round(
             hbm / _decode_stream_bytes(config, params, batch, max_len,
-                                       int8=False), 1
+                                       int8=(kv_dtype == "int8")), 1
         ),
-    }
+    })
+    return out
+
+
+def bench_decode() -> dict:
+    """Serving throughput: KV-cache autoregressive generate on the
+    flagship (models/decode.py), one device dispatch for the whole
+    continuation (lax.scan over steps).  Decode is HBM-bound — each
+    step streams the full 1.7 GB bf16 parameter set — so the extras
+    report the HBM roofline next to the measured rate."""
+    return _bench_decode_impl("decode")
 
 
 def _decode_stream_bytes(config, params, batch, max_len, int8):
@@ -660,43 +685,25 @@ def _decode_stream_bytes(config, params, batch, max_len, int8):
 def bench_decode_int8() -> dict:
     """int8 KV cache decode (VERDICT r3 #4): halving the cache bytes
     raises the HBM-bound ceiling, and the freed HBM admits DOUBLE the
-    batch the bf16 cache could hold — the tokens/s headline.  Same
-    subprocess isolation as bench_decode (wedge-prone shape)."""
-    import jax
-
-    from dcos_commons_tpu.models import generate, init_params
-    from dcos_commons_tpu.utils import synthetic_tokens
-
-    config = flagship_config()
-    batch = int(os.environ.get("BENCH_DECODE_BATCH", "16"))
-    new_tokens = int(os.environ.get("BENCH_DECODE_TOKENS", "64"))
-    prompt_len, max_len = 128, 512
-    params = init_params(config, jax.random.key(0))
-    prompt, _ = synthetic_tokens(
-        jax.random.key(1), batch, prompt_len, config.vocab
+    batch the bf16 cache could hold — the tokens/s headline."""
+    return _bench_decode_impl(
+        "decode_int8", kv_dtype="int8",
+        bf16_roofline_key="decode_bf16_stream_roofline_steps_per_s",
     )
-    gen = jax.jit(lambda p, t: generate(
-        config, p, t, max_new_tokens=new_tokens, max_len=max_len,
-        kv_dtype="int8",
-    ))
-    compile_s, steps_per_s = _timed_median_steps(
-        gen, params, prompt, new_tokens
+
+
+def bench_decode_w8() -> dict:
+    """int8 WEIGHTS + int8 KV cache — the full serving quantization
+    stack (models/quantize.py): decode streams ~half the weight bytes
+    AND half the cache bytes per step, roughly doubling the HBM-bound
+    ceiling (the roofline column).  The weight-bytes win is largest at
+    SMALL batch (weights dominate per-step bytes there: r5 measured
+    b16 2117 tok/s vs 2006 int8-kv-only vs 1533 bf16); at b64 the
+    cache and attention compute dominate and w8 adds ~2% for the
+    3105 tok/s serving headline."""
+    return _bench_decode_impl(
+        "decode_w8", kv_dtype="int8", quantize_weights=True,
     )
-    hbm = 819.0e9
-    return {
-        "decode_int8_batch": batch,
-        "decode_int8_compile_s": round(compile_s, 1),
-        "decode_int8_steps_per_s": round(steps_per_s, 1),
-        "decode_int8_tokens_per_s": round(batch * steps_per_s, 1),
-        "decode_int8_stream_roofline_steps_per_s": round(
-            hbm / _decode_stream_bytes(config, params, batch, max_len,
-                                       int8=True), 1
-        ),
-        "decode_bf16_stream_roofline_steps_per_s": round(
-            hbm / _decode_stream_bytes(config, params, batch, max_len,
-                                       int8=False), 1
-        ),
-    }
 
 
 def bench_serve() -> dict:
@@ -1300,6 +1307,35 @@ def main() -> None:
     except Exception as e:
         extras["decode_int8_b64_error"] = repr(e)[:200]
     _mark("decode_int8_b64")
+    # int8 weights + int8 cache: the full serving quantization stack.
+    # b16 shows the small-batch weight-bytes win (2117 vs 2006 int8-kv
+    # vs 1533 bf16 tok/s, r5 measured); b64 is the serving headline
+    # (3105 tok/s) — b128 was measured SLOWER (2892: attention compute
+    # over the wider batch outgrows the byte savings), so the frontier
+    # stops at 64
+    try:
+        extras.update(_run_subprocess_section(
+            "bench_decode_w8", timeout_s=480
+        ))
+    except Exception as e:
+        extras["decode_w8_error"] = repr(e)[:200]
+    _mark("decode_w8_b16")
+    try:
+        extras.update(_run_subprocess_section(
+            "bench_decode_w8", timeout_s=540,
+            env={"BENCH_DECODE_BATCH": "64"},
+            rename={
+                "decode_w8_batch": "decode_w8_b64_batch",
+                "decode_w8_compile_s": None,
+                "decode_w8_steps_per_s": "decode_w8_b64_steps_per_s",
+                "decode_w8_tokens_per_s": "decode_w8_b64_tokens_per_s",
+                "decode_w8_stream_roofline_steps_per_s":
+                    "decode_w8_b64_stream_roofline_steps_per_s",
+            },
+        ))
+    except Exception as e:
+        extras["decode_w8_b64_error"] = repr(e)[:200]
+    _mark("decode_w8_b64")
     try:
         extras.update(_run_subprocess_section("bench_serve", timeout_s=540))
     except Exception as e:

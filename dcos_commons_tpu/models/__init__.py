@@ -35,12 +35,17 @@ from dcos_commons_tpu.models.moe import (
     moe_ffn,
 )
 from dcos_commons_tpu.models.mlp import MlpConfig, mlp_forward, mlp_init, mlp_train_step
+from dcos_commons_tpu.models.quantize import (
+    dequantize_weight,
+    quantize_params_int8,
+)
 
 __all__ = [
     "MlpConfig",
     "MoEConfig",
     "TransformerConfig",
     "decode_step",
+    "dequantize_weight",
     "expert_shard_spec",
     "forward",
     "generate",
@@ -57,4 +62,5 @@ __all__ = [
     "pipeline_forward",
     "pipeline_loss_fn",
     "pipeline_param_specs",
+    "quantize_params_int8",
 ]

@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from dcos_commons_tpu.models.quantize import dequantize_weight as dq
 from dcos_commons_tpu.models.transformer import (
     TransformerConfig,
     _ffn_block,
@@ -76,12 +77,15 @@ def _quantize_kv(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
 
 
 def _project_kv(config, layer, normed, positions):
-    """normed [b, s, d] -> roped q, k, v in [b, s, heads, hd]."""
+    """normed [b, s, d] -> roped q, k, v in [b, s, heads, hd].
+
+    Weights may be weight-only int8 (models/quantize.py); the dequant
+    fuses into each projection matmul."""
     b, s, _ = normed.shape
     h, kv, hd = config.n_heads, config.n_kv_heads, config.head_dim
-    q = (normed @ layer["wq"]).reshape(b, s, h, hd)
-    k = (normed @ layer["wk"]).reshape(b, s, kv, hd)
-    v = (normed @ layer["wv"]).reshape(b, s, kv, hd)
+    q = (normed @ dq(layer["wq"], normed.dtype)).reshape(b, s, h, hd)
+    k = (normed @ dq(layer["wk"], normed.dtype)).reshape(b, s, kv, hd)
+    v = (normed @ dq(layer["wv"], normed.dtype)).reshape(b, s, kv, hd)
     q = _rope(q, positions, config.rope_theta)
     k = _rope(k, positions, config.rope_theta)
     return q, k, v
@@ -131,7 +135,7 @@ def prefill(
             block_q=config.attn_block_q, block_k=config.attn_block_k,
         )
         attn = attn.transpose(0, 2, 1, 3).reshape(b, s, -1)
-        x = x + attn @ layer["wo"]
+        x = x + attn @ dq(layer["wo"], x.dtype)
         # drop-free MoE routing: serving must not drop prompt tokens
         # (capacity pressure is a training behavior), and the decode
         # steps that continue this cache are drop-free too
@@ -260,7 +264,7 @@ def decode_step(
             ck = _cache_write(ck, k_new)
             cv = _cache_write(cv, v_new)
         attn = _attend(q, ck, cv, cks, cvs)
-        x = x + attn.reshape(b, 1, h * hd) @ layer["wo"]
+        x = x + attn.reshape(b, 1, h * hd) @ dq(layer["wo"], x.dtype)
         x, _moe_aux = _ffn_block(config, layer, x, decode=True)
         if quantized:
             return x, (ck, cv, cks, cvs)

@@ -27,6 +27,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from dcos_commons_tpu.models.quantize import dequantize_weight as dq
+
 
 @dataclass(frozen=True)
 class MoEConfig:
@@ -191,11 +193,19 @@ def _moe_sorted(
 
 
 def _expert_ffn(config: MoEConfig, params: MoEParams, h: jax.Array) -> jax.Array:
-    """h [E_local, slots, d] -> [E_local, slots, d]: batched SwiGLU."""
+    """h [E_local, slots, d] -> [E_local, slots, d]: batched SwiGLU.
+
+    Expert weights may be weight-only int8 (models/quantize.py): the
+    [e, d, f] layout contracts axis -2 exactly like the dense path, so
+    the same per-output-channel dequant fuses into each einsum."""
     h = h.astype(config.dtype)
-    gate = jax.nn.silu(jnp.einsum("ecd,edf->ecf", h, params["w_gate"]))
-    up = jnp.einsum("ecd,edf->ecf", h, params["w_up"])
-    return jnp.einsum("ecf,efd->ecd", gate * up, params["w_down"])
+    gate = jax.nn.silu(
+        jnp.einsum("ecd,edf->ecf", h, dq(params["w_gate"], config.dtype))
+    )
+    up = jnp.einsum("ecd,edf->ecf", h, dq(params["w_up"], config.dtype))
+    return jnp.einsum(
+        "ecf,efd->ecd", gate * up, dq(params["w_down"], config.dtype)
+    )
 
 
 def moe_ffn(

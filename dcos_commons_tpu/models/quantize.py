@@ -1,0 +1,95 @@
+"""Weight-only int8 quantization for the serving path.
+
+Decode at serving batch sizes is HBM-bound on STREAMING THE WEIGHTS:
+every decode step reads the full layer stack once (~2 bytes/param in
+bf16), so the tokens/s ceiling is ``HBM_bw / weight_bytes``.  Storing
+the matmul weights as int8 with a per-output-channel scale halves the
+bytes per step — the same lever the int8 KV cache applies to the
+cache reads (models/decode.py), applied to the other, larger half of
+decode's HBM traffic.
+
+Representation: each big matmul leaf ``W`` in ``params["layers"]`` is
+replaced by ``{"q": int8, "scale": f32}`` where ``scale`` is the
+max-abs over W's CONTRACTION axis (axis -2 in every layer layout:
+``x @ W`` contracts -2, so the scale rides the kept output axis and
+folds in AFTER the matmul algebraically — ``x @ (q*s) == (x @ q) * s``
+for a per-column s).  XLA fuses the dequantize (convert + multiply)
+into the consuming dot's operand load: the bf16 weights are never
+written back to HBM, only the int8 bytes stream.  Quantization error
+is bounded per element by ``max|column| / 254`` (symmetric round to
+127 steps) — tests/test_quantize.py pins the bound and the end-to-end
+logit agreement.
+
+Embeddings and norms stay native: norms are vectors (noise-critical,
+byte-trivial) and the tied embedding is both a gather table and the
+logit head (~2% of flagship weight bytes — not worth the head's
+precision).  The MoE expert stacks quantize the same way (the router
+stays f32: it is byte-trivial and decides argmax routing).
+
+Reference analogue: none — the reference schedules services and has
+no inference plane.  This belongs to the flagship workload the way
+backup/restore plans belong to cassandra: the thing the framework
+exists to run well.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+Params = Dict[str, Any]
+
+# layer leaves eligible for weight-only quantization; everything else
+# (norms, router, biases) stays native
+_QUANT_LEAVES = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def quantize_weight(w: jax.Array) -> Dict[str, jax.Array]:
+    """Symmetric per-output-channel int8: scale over axis -2 (the
+    contraction axis of ``x @ W``), so dequantization commutes with
+    the matmul and the scale multiply runs on the small output."""
+    scale = jnp.max(
+        jnp.abs(w.astype(jnp.float32)), axis=-2, keepdims=True
+    ) / 127.0
+    scale = jnp.maximum(scale, 1e-12)
+    q = jnp.clip(
+        jnp.round(w.astype(jnp.float32) / scale), -127, 127
+    ).astype(jnp.int8)
+    return {"q": q, "scale": scale}
+
+
+def dequantize_weight(w: Any, dtype: Any) -> jax.Array:
+    """Inverse of :func:`quantize_weight`; identity on plain arrays.
+
+    Called at the USE SITE inside the per-layer scan body so the
+    convert+multiply fuses into the consuming matmul — hoisting it
+    out of the layer loop would materialize the full bf16 stack and
+    give the bytes back."""
+    if isinstance(w, dict) and "q" in w:
+        return (w["q"].astype(jnp.float32) * w["scale"]).astype(dtype)
+    return w
+
+
+def quantize_params_int8(params: Params) -> Params:
+    """Return a copy of the flagship param tree with the layer matmul
+    weights stored int8 (``{"q", "scale"}`` leaves).
+
+    The tree SHAPE is preserved (each quantized leaf keeps its leading
+    n_layers axis, scan-compatible: ``lax.scan`` slices ``q`` and
+    ``scale`` together), so decode/prefill/forward consume it
+    unchanged — they route every weight read through
+    :func:`dequantize_weight`."""
+    layers = dict(params["layers"])
+    for name in _QUANT_LEAVES:
+        if name in layers:
+            layers[name] = quantize_weight(layers[name])
+    out = dict(params)
+    out["layers"] = layers
+    return out
+
+
+# NOTE: "bytes of the quantized tree" is utils.param_bytes — it sums
+# as-stored leaf bytes over any pytree, int8 + scale leaves included
+# (bench.py's decode rooflines use it directly).

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dcos_commons_tpu.models.quantize import dequantize_weight as dq
 from dcos_commons_tpu.ops.attention import flash_attention
 from dcos_commons_tpu.ops.rmsnorm import rms_norm
 from dcos_commons_tpu.parallel.pipeline import (
@@ -223,9 +224,9 @@ def _attention_block(config: TransformerConfig, layer, x, positions):
     b, s, d = x.shape
     h, kv, hd = config.n_heads, config.n_kv_heads, config.head_dim
     normed = rms_norm(x, layer["attn_norm"])
-    q = (normed @ layer["wq"]).reshape(b, s, h, hd)
-    k = (normed @ layer["wk"]).reshape(b, s, kv, hd)
-    v = (normed @ layer["wv"]).reshape(b, s, kv, hd)
+    q = (normed @ dq(layer["wq"], x.dtype)).reshape(b, s, h, hd)
+    k = (normed @ dq(layer["wk"], x.dtype)).reshape(b, s, kv, hd)
+    v = (normed @ dq(layer["wv"], x.dtype)).reshape(b, s, kv, hd)
     q = _rope(q, positions, config.rope_theta)
     k = _rope(k, positions, config.rope_theta)
     if kv != h:
@@ -255,14 +256,14 @@ def _attention_block(config: TransformerConfig, layer, x, positions):
 
         attn = checkpoint_name(attn, "attn_out")
     attn = attn.transpose(0, 2, 1, 3).reshape(b, s, h * hd)
-    return x + attn @ layer["wo"]
+    return x + attn @ dq(layer["wo"], x.dtype)
 
 
 def _mlp_block(layer, x):
     normed = rms_norm(x, layer["mlp_norm"])
-    gate = jax.nn.silu(normed @ layer["w_gate"])
-    up = normed @ layer["w_up"]
-    return x + (gate * up) @ layer["w_down"]
+    gate = jax.nn.silu(normed @ dq(layer["w_gate"], x.dtype))
+    up = normed @ dq(layer["w_up"], x.dtype)
+    return x + (gate * up) @ dq(layer["w_down"], x.dtype)
 
 
 def _ffn_block(config: TransformerConfig, layer, x, decode: bool = False):

@@ -123,6 +123,15 @@ def main() -> int:
         params = jax.tree.map(
             jax.device_put, params, param_shardings(config, mesh)
         )
+        if os.environ.get("WEIGHT_DTYPE", "native") == "int8":
+            # quantize AFTER placement: GSPMD derives the int8/scale
+            # shardings from the already-sharded weights, so the
+            # {"q","scale"} leaves need no new sharding rules
+            from dcos_commons_tpu.models import quantize_params_int8
+
+            params = jax.jit(quantize_params_int8)(params)
+            if rank == 0:
+                print("weights quantized to int8 (per-channel)", flush=True)
         replicated = NamedSharding(mesh, P())
 
         def to_global(arr):
@@ -213,7 +222,13 @@ def main() -> int:
             _broadcast_tick(multihost_utils, None, batch, prompt_len)
 
         batcher = MicroBatcher(
-            run_group, capacity=batch, window_s=0.0,
+            run_group, capacity=batch,
+            # default 0: the gang driver loop already paces dispatches
+            # (followers meet rank 0 in broadcast ticks), so waiting
+            # for joiners only adds latency unless an operator asks
+            window_s=float(
+                os.environ.get("MICROBATCH_WINDOW_MS", "0")
+            ) / 1e3,
             queue_timeout_s=float(
                 os.environ.get("SERVE_QUEUE_TIMEOUT_S", "600")
             ),

@@ -89,6 +89,15 @@ def main() -> int:
             params = state["params"]
             print(f"restored checkpoint step {step}", flush=True)
 
+    # WEIGHT_DTYPE=int8 stores the layer matmul weights quantized
+    # (models/quantize.py): decode streams half the weight bytes per
+    # step — the dominant HBM term at small serving batches
+    if os.environ.get("WEIGHT_DTYPE", "native") == "int8":
+        from dcos_commons_tpu.models import quantize_params_int8
+
+        params = jax.device_put(quantize_params_int8(params))
+        print("weights quantized to int8 (per-channel)", flush=True)
+
     # ONE compile covers every request: static (batch, prompt_len)
     # shapes with prompts RIGHT-padded and the true length TRACED
     # (causal attention means real tokens never see the padding, and

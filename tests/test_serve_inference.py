@@ -148,10 +148,17 @@ def test_microbatching_merges_concurrent_clients(tmp_path):
     """SERVE_BATCH > 1: concurrent single-prompt clients — of MIXED
     prompt lengths — are answered by ONE generate call (per-row
     true_len; only temperature groups) with each client's own correct
-    greedy continuation — concurrency must not change any answer."""
+    greedy continuation — concurrency must not change any answer.
+
+    Runs the FULL serving quantization stack (int8 weights + int8 KV,
+    models/quantize.py): every assertion here is served-vs-served
+    self-consistency, so the quantized pod must hold them all."""
     import threading
 
-    env = {**TINY_ENV, "SERVE_BATCH": "4", "MICROBATCH_WINDOW_MS": "60"}
+    env = {
+        **TINY_ENV, "SERVE_BATCH": "4", "MICROBATCH_WINDOW_MS": "60",
+        "WEIGHT_DTYPE": "int8", "KV_DTYPE": "int8",
+    }
     spec = from_yaml_file(
         os.path.join(REPO, "frameworks", "jax", "svc_serve.yml"), env
     )
