@@ -12,9 +12,7 @@ from __future__ import annotations
 import os
 from typing import Any, Dict, List, Optional, Tuple
 
-from dcos_commons_tpu.common import Label
 from dcos_commons_tpu.debug.trackers import serialize_plan
-from dcos_commons_tpu.plan.status import Status
 from dcos_commons_tpu.specification.specs import task_full_name
 from dcos_commons_tpu.state.state_store import (
     GoalStateOverride,

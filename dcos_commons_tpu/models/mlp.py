@@ -8,7 +8,7 @@ clever.  bf16 matmuls, f32 loss.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Dict, Tuple
+from typing import Any, Dict
 
 import jax
 import jax.numpy as jnp

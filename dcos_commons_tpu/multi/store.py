@@ -8,7 +8,7 @@ re-creates every service, including ones mid-uninstall.
 from __future__ import annotations
 
 import json
-from typing import Dict, List, Optional
+from typing import List, Optional
 
 from dcos_commons_tpu.storage import Persister, PersisterError
 from dcos_commons_tpu.storage.persister import validate_key

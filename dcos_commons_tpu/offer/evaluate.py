@@ -32,7 +32,6 @@ from dcos_commons_tpu.offer.outcome import EvaluationOutcome
 from dcos_commons_tpu.offer.placement import (
     PlacementContext,
     PlacementRule,
-    SameSliceRule,
     parse_placement,
 )
 from dcos_commons_tpu.offer.torus import find_subslice

@@ -21,7 +21,7 @@ import json
 import math
 import re
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Sequence
 
 from dcos_commons_tpu.common import TaskInfo
 from dcos_commons_tpu.offer.inventory import ResourceSnapshot, TpuHost

@@ -15,8 +15,7 @@ Axes vocabulary (scaling-book conventions):
 
 from __future__ import annotations
 
-import math
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, Optional, Sequence, Tuple
 
 import jax

@@ -15,7 +15,6 @@ comparing global positions (chunk_index * chunk_len + offset).
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax

@@ -12,14 +12,13 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
-from dcos_commons_tpu.common import Label, TaskState
+from dcos_commons_tpu.common import Label
 from dcos_commons_tpu.plan.backoff import Backoff
 from dcos_commons_tpu.plan.phase import Phase
 from dcos_commons_tpu.plan.plan import DEPLOY_PLAN_NAME, Plan
 from dcos_commons_tpu.plan.step import DeploymentStep, PodInstanceRequirement
 from dcos_commons_tpu.plan.strategy import strategy_for_name
 from dcos_commons_tpu.specification.specs import (
-    GoalState,
     PodSpec,
     ServiceSpec,
     task_full_name,

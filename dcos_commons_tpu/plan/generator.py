@@ -28,7 +28,7 @@ from dcos_commons_tpu.plan.phase import Phase
 from dcos_commons_tpu.plan.plan import Plan
 from dcos_commons_tpu.plan.step import DeploymentStep, PodInstanceRequirement
 from dcos_commons_tpu.plan.strategy import strategy_for_name
-from dcos_commons_tpu.specification.specs import ServiceSpec, SpecError, task_full_name
+from dcos_commons_tpu.specification.specs import ServiceSpec, SpecError
 from dcos_commons_tpu.state.state_store import StateStore
 
 

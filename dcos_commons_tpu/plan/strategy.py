@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import random
 import threading
-from typing import Dict, Iterable, List, Optional, Sequence, Set
+from typing import Dict, List, Optional, Sequence, Set
 
 from dcos_commons_tpu.plan.element import Element
 

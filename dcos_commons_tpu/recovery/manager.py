@@ -15,12 +15,11 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Set
 
-from dcos_commons_tpu.common import Label, TaskState, TaskStatus, task_name_of
+from dcos_commons_tpu.common import Label, TaskState, TaskStatus
 from dcos_commons_tpu.plan.backoff import Backoff
 from dcos_commons_tpu.plan.phase import Phase
 from dcos_commons_tpu.plan.plan import RECOVERY_PLAN_NAME, Plan
 from dcos_commons_tpu.plan.plan_manager import PlanManager
-from dcos_commons_tpu.plan.status import Status
 from dcos_commons_tpu.plan.step import (
     DeploymentStep,
     PodInstanceRequirement,

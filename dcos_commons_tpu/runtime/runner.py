@@ -28,7 +28,6 @@ import os
 import signal
 import sys
 import threading
-import time
 from typing import Dict, List, Optional, Tuple
 
 import yaml

@@ -10,12 +10,9 @@ the final DefaultScheduler assembly (DefaultScheduler.java:147).
 from __future__ import annotations
 
 import logging
-from dataclasses import dataclass, field
 from typing import List, Optional
 
 from dcos_commons_tpu.agent.base import Agent
-from dcos_commons_tpu.debug.trackers import OfferOutcomeTracker
-from dcos_commons_tpu.metrics.registry import Metrics
 from dcos_commons_tpu.offer.evaluate import OfferEvaluator
 from dcos_commons_tpu.offer.inventory import SliceInventory
 from dcos_commons_tpu.offer.ledger import ReservationLedger
@@ -50,7 +47,6 @@ from dcos_commons_tpu.state.schema import SchemaVersionStore
 from dcos_commons_tpu.state.state_store import StateStore
 from dcos_commons_tpu.storage import (
     FileWalPersister,
-    MemPersister,
     Persister,
     PersisterCache,
 )

@@ -21,18 +21,17 @@ from __future__ import annotations
 
 import logging
 import threading
-import time
 from typing import Dict, List, Optional, Set
 
 from dcos_commons_tpu.agent.base import Agent
-from dcos_commons_tpu.common import Label, TaskState, TaskStatus, task_name_of
+from dcos_commons_tpu.common import Label, TaskStatus, task_name_of
 from dcos_commons_tpu.debug.trackers import OfferOutcomeTracker
 from dcos_commons_tpu.metrics.registry import Metrics
 from dcos_commons_tpu.offer.evaluate import OfferEvaluator
 from dcos_commons_tpu.offer.inventory import SliceInventory
 from dcos_commons_tpu.offer.ledger import ReservationLedger
 from dcos_commons_tpu.plan.coordinator import DefaultPlanCoordinator
-from dcos_commons_tpu.plan.plan import DEPLOY_PLAN_NAME, Plan
+from dcos_commons_tpu.plan.plan import Plan
 from dcos_commons_tpu.plan.plan_manager import DefaultPlanManager, PlanManager
 from dcos_commons_tpu.plan.step import ActionStep, DeploymentStep
 from dcos_commons_tpu.recovery.manager import DefaultRecoveryPlanManager

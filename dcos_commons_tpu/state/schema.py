@@ -6,7 +6,7 @@ startup; an unsupported version aborts before any writes happen.
 
 from __future__ import annotations
 
-from dcos_commons_tpu.storage import Persister, PersisterError
+from dcos_commons_tpu.storage import Persister
 
 
 class SchemaVersionStore:

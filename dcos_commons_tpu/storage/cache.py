@@ -13,10 +13,8 @@ import threading
 from typing import Iterable, List, Optional
 
 from dcos_commons_tpu.storage.persister import (
-    DeleteOp,
     MemPersister,
     Persister,
-    SetOp,
     TransactionOp,
 )
 

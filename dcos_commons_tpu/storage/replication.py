@@ -48,7 +48,6 @@ from typing import Dict, List, Optional, Tuple
 from dcos_commons_tpu.storage.persister import (
     DeleteOp,
     Persister,
-    PersisterError,
     SetOp,
     TransactionOp,
 )

@@ -10,7 +10,7 @@ the offer thread); Expect ticks assert and never advance the clock.
 
 from __future__ import annotations
 
-from typing import List, Optional, Sequence
+from typing import Optional
 
 from dcos_commons_tpu.common import TaskState, TaskStatus
 from dcos_commons_tpu.offer.inventory import TpuHost

@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import logging
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, Optional
 
 from dcos_commons_tpu.common import task_name_of
 from dcos_commons_tpu.debug.trackers import OfferOutcomeTracker

@@ -7,7 +7,7 @@ host-side IO in the measured loop.
 
 from __future__ import annotations
 
-from typing import Iterator, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp

@@ -63,3 +63,109 @@ def _library_modules():
 @pytest.mark.parametrize("module", sorted(set(_library_modules())))
 def test_library_module_imports(module):
     importlib.import_module(module)
+
+
+# -- AST lint: the checkstyle/findbugs-class checks ---------------------
+#
+# Byte-compile catches syntax; import catches wiring.  These catch the
+# static-analysis class the reference gates on (gradle/checkstyle/,
+# findbugs): dead imports, always-true asserts, duplicated dict keys,
+# mutable default arguments, bare excepts.
+
+import ast
+import re
+
+
+def _used_names(tree: ast.AST) -> set:
+    used = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+            # quoted annotations ("StandbyTail", "Optional[Foo]"): a
+            # CLASS-LIKE (capitalized) word inside a string counts as
+            # used.  Lowercase words stay excluded — otherwise any
+            # docstring mentioning "time" or "os" would mask a dead
+            # stdlib import, the most common kind.
+            used.update(
+                w for w in re.findall(r"[A-Za-z_][A-Za-z0-9_]*", node.value)
+                if w[:1].isupper()
+            )
+    return used
+
+
+def _lint_file(path: str) -> list:
+    with open(path) as f:
+        source = f.read()
+    tree = ast.parse(source, filename=path)
+    findings = []
+    rel = os.path.relpath(path, REPO)
+    lines = source.splitlines()
+
+    def noqa(node) -> bool:
+        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
+        return "noqa" in line
+
+    # unused imports (module-level only: function-local imports are
+    # this repo's lazy-loading idiom and always immediately used);
+    # __init__.py re-export surfaces are exempt
+    if os.path.basename(path) != "__init__.py":
+        used = _used_names(tree)
+        for node in tree.body:
+            names = []
+            if isinstance(node, ast.Import):
+                names = [
+                    (a.asname or a.name.split(".")[0], node) for a in node.names
+                ]
+            elif isinstance(node, ast.ImportFrom) and node.module != "__future__":
+                names = [(a.asname or a.name, node) for a in node.names]
+            for name, imp in names:
+                if name not in used and not noqa(imp):
+                    findings.append(
+                        f"{rel}:{imp.lineno}: unused import {name!r}"
+                    )
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assert) and isinstance(
+            node.test, ast.Tuple
+        ) and node.test.elts and not noqa(node):
+            findings.append(
+                f"{rel}:{node.lineno}: assert on a non-empty tuple is "
+                "always true (missing parentheses split?)"
+            )
+        elif isinstance(node, ast.ExceptHandler) and node.type is None:
+            if not noqa(node):
+                findings.append(
+                    f"{rel}:{node.lineno}: bare except: catches "
+                    "SystemExit/KeyboardInterrupt"
+                )
+        elif isinstance(node, ast.Dict):
+            keys = [
+                ast.dump(k) for k in node.keys
+                if isinstance(k, ast.Constant)
+            ]
+            dupes = {k for k in keys if keys.count(k) > 1}
+            if dupes and not noqa(node):
+                findings.append(
+                    f"{rel}:{node.lineno}: duplicate literal dict "
+                    f"key(s): earlier values are silently dropped"
+                )
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for default in node.args.defaults + node.args.kw_defaults:
+                if isinstance(
+                    default, (ast.List, ast.Dict, ast.Set)
+                ) and not noqa(node):
+                    findings.append(
+                        f"{rel}:{node.lineno}: mutable default "
+                        f"argument in {node.name}() is shared between "
+                        "calls"
+                    )
+    return findings
+
+
+def test_ast_lint_gate():
+    failures = []
+    for path in _source_files():
+        failures += _lint_file(path)
+    assert not failures, (
+        f"{len(failures)} lint finding(s):\n" + "\n".join(failures)
+    )

@@ -10,7 +10,6 @@ GB/s/chip).
 import os
 
 import jax
-import pytest
 from jax.sharding import Mesh
 
 from dcos_commons_tpu.offer.inventory import make_test_fleet

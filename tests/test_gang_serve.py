@@ -12,7 +12,6 @@ restore is PERMANENT recovery's workload half).
 """
 
 import os
-import time
 
 import pytest
 

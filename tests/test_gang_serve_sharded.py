@@ -65,7 +65,8 @@ def _post(port, payload, timeout=90):
 
 
 @pytest.mark.slow
-def test_sharded_serving_gang_failover_token_identical(tmp_path):
+@pytest.mark.parametrize("quant", ["native", "int8"])
+def test_sharded_serving_gang_failover_token_identical(tmp_path, quant):
     agents = [
         AgentProcess(f"s{i}", str(tmp_path / f"agent-{i}"), REPO)
         for i in range(4)
@@ -94,6 +95,14 @@ def test_sharded_serving_gang_failover_token_identical(tmp_path):
             "MAX_LEN": "48",
             "MAX_NEW_TOKENS": "8",
             "SERVE_BATCH": "2",
+            # parametrized: "native" covers the operator-default gang;
+            # "int8" runs the FULL serving quantization stack sharded
+            # (weights quantize AFTER placement — GSPMD-derived int8 +
+            # scale shardings — and the cache stores int8).  Every
+            # assertion below is served-vs-served self-consistency, so
+            # both gangs must hold them all, across failover
+            "WEIGHT_DTYPE": quant,
+            "KV_DTYPE": quant,
         },
         repo_root=REPO,
     )

@@ -19,7 +19,6 @@ import urllib.request
 import pytest
 
 from dcos_commons_tpu.storage.persister import (
-    DeleteOp,
     MemPersister,
     PersisterError,
     SetOp,

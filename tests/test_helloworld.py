@@ -12,7 +12,6 @@ import os
 
 import pytest
 
-from dcos_commons_tpu.common import TaskState
 from dcos_commons_tpu.offer.inventory import TpuHost
 from dcos_commons_tpu.plan.status import Status
 from dcos_commons_tpu.scheduler.config import SchedulerConfig

@@ -8,11 +8,8 @@ crashed-and-restarted agent daemon reconstructs every task instead of
 losing them with its heap.
 """
 
-import os
-import signal
 import time
 
-import pytest
 
 from dcos_commons_tpu.agent.local import LocalProcessAgent
 from dcos_commons_tpu.common import TaskInfo, TaskState

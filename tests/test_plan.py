@@ -1,6 +1,5 @@
 """Plan engine tests (mirrors reference plan/ + strategy/ test suites)."""
 
-import time
 
 import pytest
 
@@ -14,7 +13,6 @@ from dcos_commons_tpu.plan import (
     DeploymentStep,
     ExponentialBackoff,
     ParallelStrategy,
-    Phase,
     Plan,
     PlanGenerator,
     PodInstanceRequirement,

@@ -10,12 +10,10 @@ task kill -> recovery, scheduler restart).
 import os
 import time
 
-import pytest
 
 from dcos_commons_tpu.agent import LocalProcessAgent
 from dcos_commons_tpu.common import TaskState
-from dcos_commons_tpu.offer.inventory import SliceInventory, TpuHost, make_test_fleet
-from dcos_commons_tpu.plan.status import Status
+from dcos_commons_tpu.offer.inventory import SliceInventory, TpuHost
 from dcos_commons_tpu.recovery.monitor import TestingFailureMonitor
 from dcos_commons_tpu.scheduler import SchedulerBuilder, SchedulerConfig
 from dcos_commons_tpu.specification import from_yaml

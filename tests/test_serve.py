@@ -21,7 +21,6 @@ from dcos_commons_tpu.runtime.runner import EXIT_LOCKED, load_topology
 from dcos_commons_tpu.testing.integration import (
     AgentProcess,
     SchedulerProcess,
-    ServiceClient,
     reap_orphan_tasks,
     wait_for,
 )

@@ -10,7 +10,6 @@ subprocesses, no sleeps.
 from dcos_commons_tpu.common import TaskState
 from dcos_commons_tpu.offer.inventory import TpuHost
 from dcos_commons_tpu.plan.status import Status
-from dcos_commons_tpu.specification.yaml_spec import from_yaml
 from dcos_commons_tpu.testing import (
     AdvanceCycles,
     ExpectDeclined,

@@ -7,10 +7,8 @@ import pytest
 from dcos_commons_tpu.specification import (
     ConfigValidationError,
     GoalState,
-    PodSpec,
     ServiceSpec,
     SpecError,
-    TaskSpec,
     TpuSpec,
     from_yaml,
     render_template,

@@ -6,7 +6,6 @@ The torus search is the scheduler's hardest pure logic (VERDICT round
 hypothesis drives it through shapes unit tests won't think of.
 """
 
-import pytest
 from hypothesis import given, settings, strategies as st
 
 from dcos_commons_tpu.offer.inventory import (

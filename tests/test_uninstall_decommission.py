@@ -7,8 +7,7 @@ skeleton on restart).
 """
 
 from dcos_commons_tpu.plan.status import Status
-from dcos_commons_tpu.scheduler import SchedulerBuilder, SchedulerConfig
-from dcos_commons_tpu.specification.yaml_spec import from_yaml
+from dcos_commons_tpu.scheduler import SchedulerConfig
 from dcos_commons_tpu.testing import (
     AdvanceCycles,
     ExpectDeploymentComplete,
