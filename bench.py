@@ -1227,11 +1227,13 @@ def main() -> None:
     provisioned = False
     try:
         t0 = time.monotonic()
+        # route the child's prints to STDERR: bench stdout must carry
+        # ONLY the one JSON line (the child inherits stdout otherwise)
         rc = _sp.run(
             [sys.executable,
              os.path.join(REPO, "frameworks/jax/warm_cache.py")],
             env={**os.environ, "REPO_ROOT": REPO},
-            timeout=300,
+            timeout=300, stdout=sys.stderr, stderr=sys.stderr,
         ).returncode
         extras["provision_warm_cache_s"] = round(
             time.monotonic() - t0, 1

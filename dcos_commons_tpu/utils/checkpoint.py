@@ -16,9 +16,24 @@ from __future__ import annotations
 
 import json
 import os
-from typing import Any, Optional, Tuple
+import re
+from typing import Any, List, Optional, Tuple
 
 import numpy as np
+
+_STEP_RE = re.compile(r"^step_(\d+)\.npz$")
+
+
+def _step_files(directory: str) -> List[Tuple[int, str]]:
+    """[(step, filename)] sorted by step.  Only exact step_<digits>.npz
+    names count — a stray operator file (step_best.npz, a .tmp) must
+    never crash saves/restores or be pruned."""
+    out = []
+    for name in os.listdir(directory):
+        m = _STEP_RE.match(name)
+        if m:
+            out.append((int(m.group(1)), name))
+    return sorted(out)
 
 
 def _host_array(leaf: Any) -> np.ndarray:
@@ -36,11 +51,20 @@ def _host_array(leaf: Any) -> np.ndarray:
     return arr
 
 
-def save_checkpoint(directory: str, step: int, tree: Any) -> str:
+def save_checkpoint(
+    directory: str, step: int, tree: Any, keep: int = 0,
+) -> str:
     """Atomic save of a pytree; ``step`` = next step to run on resume.
 
     In a multi-process mesh call this from every process (the gather is
     collective) but only process 0 writes.
+
+    ``keep`` > 0 prunes older checkpoints down to the newest ``keep``
+    AFTER the new one is durably in place (write + fsync + rename
+    first, delete after — a crash mid-save can orphan an extra file
+    but never leaves fewer than ``keep`` restorable steps).  A long
+    training run would otherwise grow the directory by ~3 bytes/param
+    per save until the disk fills.
     """
     import jax
 
@@ -67,18 +91,23 @@ def save_checkpoint(directory: str, step: int, tree: Any) -> str:
         f.flush()
         os.fsync(f.fileno())
     os.replace(tmp, path)
+    if keep > 0:
+        # prune by the LISTED names (not reconstructed ones): a
+        # hand-named step_5.npz must actually be removed, and a
+        # non-matching stray file must never crash the save
+        for _old, name in _step_files(directory)[:-keep]:
+            try:
+                os.remove(os.path.join(directory, name))
+            except OSError:
+                pass  # already gone (concurrent pruner) — harmless
     return path
 
 
 def latest_step(directory: str) -> Optional[int]:
     if not os.path.isdir(directory):
         return None
-    steps = [
-        int(name[len("step_"):-len(".npz")])
-        for name in os.listdir(directory)
-        if name.startswith("step_") and name.endswith(".npz")
-    ]
-    return max(steps) if steps else None
+    files = _step_files(directory)
+    return files[-1][0] if files else None
 
 
 def restore_checkpoint(
@@ -93,8 +122,20 @@ def restore_checkpoint(
     target = step if step is not None else latest_step(directory)
     if target is None:
         return like, None
-    path = os.path.join(directory, f"step_{target:010d}.npz")
-    data = np.load(path)
+    # open the LISTED filename for the step: a hand-named step_5.npz
+    # (unpadded) must restore, not 404 on a reconstructed name
+    names = [
+        name for s, name in _step_files(directory) if s == target
+    ] if os.path.isdir(directory) else []
+    if not names:
+        if step is not None:
+            # an EXPLICITLY requested step that is absent is an error,
+            # not a silent fresh-start
+            raise FileNotFoundError(
+                f"no checkpoint for step {step} in {directory}"
+            )
+        return like, None
+    data = np.load(os.path.join(directory, names[-1]))
     leaves, treedef = jax.tree.flatten(like)
     restored = []
     for i, leaf in enumerate(leaves):

@@ -111,6 +111,9 @@ def main() -> int:
                 save_checkpoint(
                     ckpt_dir, i + 1,
                     {"params": params, "opt_state": opt_state},
+                    # bound the directory: a long run would otherwise
+                    # grow it by ~3 bytes/param per save forever
+                    keep=int(os.environ.get("CHECKPOINT_KEEP", "3")),
                 )
         if batches is not None:
             batches.close()

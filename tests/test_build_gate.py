@@ -103,8 +103,14 @@ def _lint_file(path: str) -> list:
     lines = source.splitlines()
 
     def noqa(node) -> bool:
-        line = lines[node.lineno - 1] if node.lineno <= len(lines) else ""
-        return "noqa" in line
+        """# noqa anywhere on the construct's line SPAN suppresses —
+        the offending member of a multi-line def/dict may not be on
+        the construct's first line (docs promise 'on the line')."""
+        end = getattr(node, "end_lineno", node.lineno) or node.lineno
+        return any(
+            "noqa" in lines[i - 1]
+            for i in range(node.lineno, min(end, len(lines)) + 1)
+        )
 
     # unused imports (module-level only: function-local imports are
     # this repo's lazy-loading idiom and always immediately used);
@@ -151,9 +157,12 @@ def _lint_file(path: str) -> list:
                 )
         elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             for default in node.args.defaults + node.args.kw_defaults:
+                # noqa is checked on the DEFAULT's own span, not the
+                # whole function (an unrelated noqa deep in the body
+                # must not suppress this)
                 if isinstance(
                     default, (ast.List, ast.Dict, ast.Set)
-                ) and not noqa(node):
+                ) and not noqa(default):
                     findings.append(
                         f"{rel}:{node.lineno}: mutable default "
                         f"argument in {node.name}() is shared between "
@@ -169,3 +178,44 @@ def test_ast_lint_gate():
     assert not failures, (
         f"{len(failures)} lint finding(s):\n" + "\n".join(failures)
     )
+
+
+def test_lint_rules_and_noqa_contract(tmp_path):
+    """The documented contract: each rule fires on its pattern, and
+    '# noqa' ON THE OFFENDING LINE suppresses it — including when the
+    construct spans multiple lines."""
+    flagged = tmp_path / "flagged.py"
+    flagged.write_text(
+        "import os\n"                                # unused
+        "def f(\n"
+        "    cache={},\n"                            # mutable default
+        "):\n"
+        "    try:\n"
+        "        pass\n"
+        "    except:\n"                              # bare except
+        "        pass\n"
+        "    assert (True,\n"
+        "            'oops')\n"                      # tuple assert
+        "    return {'k': 1,\n"
+        "            'k': 2}\n"                      # duplicate key
+    )
+    findings = "\n".join(_lint_file(str(flagged)))
+    for token in ("unused import", "mutable default", "bare except",
+                  "tuple", "duplicate"):
+        assert token in findings, (token, findings)
+    clean = tmp_path / "clean.py"
+    clean.write_text(
+        "import os  # noqa\n"
+        "def f(\n"
+        "    cache={},  # noqa — deliberate static state\n"
+        "):\n"
+        "    try:\n"
+        "        pass\n"
+        "    except:  # noqa\n"
+        "        pass\n"
+        "    assert (True,\n"
+        "            'oops')  # noqa\n"
+        "    return {'k': 1,\n"
+        "            'k': 2}  # noqa\n"
+    )
+    assert _lint_file(str(clean)) == []

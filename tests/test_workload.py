@@ -470,6 +470,43 @@ def test_checkpoint_roundtrip(tmp_path):
     assert none_step is None
 
 
+def test_checkpoint_retention(tmp_path):
+    """keep=K prunes to the newest K AFTER the new save is durable;
+    keep=0 keeps everything; the latest step always restores."""
+    tree = {"w": jnp.ones((2, 2), jnp.float32)}
+    # a stray operator file in the directory must neither crash the
+    # pruner nor be pruned (review r5)
+    (tmp_path / "step_best.npz").write_bytes(b"not a checkpoint")
+    for step in (1, 2, 3, 4):
+        save_checkpoint(str(tmp_path), step, tree, keep=2)
+    names = sorted(p.name for p in tmp_path.glob("step_*.npz"))
+    assert names == [
+        "step_0000000003.npz", "step_0000000004.npz", "step_best.npz",
+    ]
+    restored, step = restore_checkpoint(str(tmp_path), tree)
+    assert step == 4
+    # keep=0 (default): nothing pruned
+    save_checkpoint(str(tmp_path), 5, tree)
+    assert len(list(tmp_path.glob("step_0*.npz"))) == 3
+    # an explicitly requested absent step errors, never silent-fresh
+    import pytest
+
+    with pytest.raises(FileNotFoundError):
+        restore_checkpoint(str(tmp_path), tree, step=99)
+    # a hand-named (unpadded) checkpoint restores and prunes by its
+    # LISTED name
+    import shutil
+
+    shutil.copy(
+        tmp_path / "step_0000000005.npz", tmp_path / "step_7.npz"
+    )
+    restored, step = restore_checkpoint(str(tmp_path), tree)
+    assert step == 7
+    save_checkpoint(str(tmp_path), 8, tree, keep=1)
+    names = sorted(p.name for p in tmp_path.glob("step_*.npz"))
+    assert names == ["step_0000000008.npz", "step_best.npz"]
+
+
 def test_checkpoint_bf16_roundtrip(tmp_path):
     """bf16 leaves must survive the npz round-trip (review regression)."""
     tree = {"w": jnp.ones((4, 4), jnp.bfloat16) * 1.5,
