@@ -94,8 +94,16 @@ def save_checkpoint(
     if keep > 0:
         # prune by the LISTED names (not reconstructed ones): a
         # hand-named step_5.npz must actually be removed, and a
-        # non-matching stray file must never crash the save
-        for _old, name in _step_files(directory)[:-keep]:
+        # non-matching stray file must never crash the save.  Only
+        # steps AT OR BELOW the one just saved are candidates: after
+        # an operator rolls back (restore step=N, retrain), files
+        # NEWER than the just-saved step must not make the pruner
+        # delete the very checkpoint this call wrote (review r5).
+        candidates = [
+            (s, name) for s, name in _step_files(directory)
+            if s <= step
+        ]
+        for _old, name in candidates[:-keep]:
             try:
                 os.remove(os.path.join(directory, name))
             except OSError:
@@ -119,22 +127,22 @@ def restore_checkpoint(
     import jax
     import jax.numpy as jnp
 
-    target = step if step is not None else latest_step(directory)
+    files = _step_files(directory) if os.path.isdir(directory) else []
+    target = step if step is not None else (
+        files[-1][0] if files else None
+    )
     if target is None:
         return like, None
     # open the LISTED filename for the step: a hand-named step_5.npz
     # (unpadded) must restore, not 404 on a reconstructed name
-    names = [
-        name for s, name in _step_files(directory) if s == target
-    ] if os.path.isdir(directory) else []
+    names = [name for s, name in files if s == target]
     if not names:
-        if step is not None:
-            # an EXPLICITLY requested step that is absent is an error,
-            # not a silent fresh-start
-            raise FileNotFoundError(
-                f"no checkpoint for step {step} in {directory}"
-            )
-        return like, None
+        # an EXPLICITLY requested step that is absent is an error,
+        # not a silent fresh-start (step is not None here: the
+        # latest-step path only yields steps that exist)
+        raise FileNotFoundError(
+            f"no checkpoint for step {step} in {directory}"
+        )
     data = np.load(os.path.join(directory, names[-1]))
     leaves, treedef = jax.tree.flatten(like)
     restored = []

@@ -767,3 +767,78 @@ def test_primary_refuses_ack_on_stream_mismatch():
     )
     assert "entries" in out
     assert log.status()["acked_seq"] == 2
+
+
+@pytest.mark.slow
+def test_ensemble_promotion_chain_no_replicated_write_lost(tmp_path):
+    """The ensemble property over GENERATIONS: a 3-server ensemble
+    (durable FileWal backends) survives a chain of primary deaths —
+    each round writes, waits for full bounded-sync, hard-kills the
+    primary (HTTP gone, backend abandoned un-closed = kill -9), and
+    promotes a standby; every replicated write of every previous
+    generation must be served by every new primary.  The dead
+    ex-primary rejoins each round by reopening its WAL dir with
+    --standby-of semantics (the stream-id check forces snapshot
+    repair), and epochs stay strictly monotonic through all three
+    promotions."""
+    from dcos_commons_tpu.storage.file_persister import FileWalPersister
+
+    def boot(name, standby_of=""):
+        return StateServer(
+            FileWalPersister(str(tmp_path / name)),
+            replicate_from=standby_of,
+        ).start()
+
+    servers = {"a": boot("a")}
+    servers["b"] = boot("b", servers["a"].url)
+    servers["c"] = boot("c", servers["a"].url)
+    primary = "a"
+    expect = {}
+    last_epoch = 1
+    try:
+        for gen, nxt in enumerate(["b", "c", "a"]):
+            client = RemotePersister(servers[primary].url)
+            for i in range(5):
+                key = f"/svc/g{gen}k{i}"
+                value = f"v{gen}.{i}".encode()
+                client.set(key, value)
+                expect[key] = value
+
+            def synced():
+                st = RemotePersister(
+                    servers[primary].url
+                )._call("/v1/repl/status", {})
+                return (
+                    st["standby_count"] == 2
+                    and not st["standby_lagging"]
+                    and st["acked_seq"] == st["seq"]
+                )
+
+            wait_until(synced, timeout_s=30, what=f"gen {gen} full sync")
+            # primary dies hard: HTTP torn down, backend NOT closed
+            dead = primary
+            servers[dead]._server.shutdown()
+            servers[dead]._server.server_close()
+            out = RemotePersister(
+                servers[nxt].url
+            )._call("/v1/repl/promote", {})
+            assert out["epoch"] > last_epoch, (gen, out)
+            last_epoch = out["epoch"]
+            primary = nxt
+            promoted = RemotePersister(servers[primary].url)
+            for key, value in expect.items():
+                assert promoted.get(key) == value, (gen, key)
+            # survivors re-point at the new primary; the dead one
+            # rejoins by reopening its OWN WAL dir as a fresh standby
+            for name in servers:
+                if name == primary:
+                    continue
+                if name != dead:
+                    servers[name].stop()
+                servers[name] = boot(name, servers[primary].url)
+    finally:
+        for server in servers.values():
+            try:
+                server.stop()
+            except OSError:
+                pass  # the hard-killed generation's socket is gone
