@@ -794,10 +794,32 @@ def main(argv: Optional[list] = None) -> int:
         help="with --promote: also demote the old primary if it is "
              "still reachable (closes the partition window)",
     )
+    parser.add_argument(
+        "--repl-status", default="", metavar="SERVER_URL",
+        help="operator verb: print the server's replication status "
+             "(role, epoch, seq, per-standby acks) as JSON and exit — "
+             "on a primary this is what to alert on; with several "
+             "standbys, promote the one whose applied_seq is highest",
+    )
     args = parser.parse_args(argv)
     from dcos_commons_tpu.security.auth import load_token
 
     token = load_token(token_file=args.auth_token_file)
+    if args.repl_status:
+        import sys
+
+        try:
+            out = RemotePersister(
+                args.repl_status, timeout_s=5.0,
+                auth_token=token, ca_file=args.ca_file,
+            )._call("/v1/repl/status", {})
+        except (PersisterError, ValueError) as e:
+            # ValueError: a scheme-less URL ("host:port") from a
+            # hand-typed command — an error message, not a traceback
+            print(f"repl-status failed: {e}", file=sys.stderr)
+            return 1
+        print(json.dumps(out, indent=2, sort_keys=True))
+        return 0
     if args.promote:
         import sys
 
@@ -806,7 +828,8 @@ def main(argv: Optional[list] = None) -> int:
         )
         try:
             out = client._call("/v1/repl/promote", {})
-        except PersisterError as e:
+        except (PersisterError, ValueError) as e:
+            # ValueError: scheme-less URL — message, not traceback
             print(f"promote failed: {e}", file=sys.stderr)
             return 1
         epoch = out.get("epoch")

@@ -59,12 +59,17 @@ def save_checkpoint(
     In a multi-process mesh call this from every process (the gather is
     collective) but only process 0 writes.
 
-    ``keep`` > 0 prunes older checkpoints down to the newest ``keep``
-    AFTER the new one is durably in place (write + fsync + rename
-    first, delete after — a crash mid-save can orphan an extra file
-    but never leaves fewer than ``keep`` restorable steps).  A long
-    training run would otherwise grow the directory by ~3 bytes/param
-    per save until the disk fills.
+    ``keep`` > 0 prunes AFTER the new file is durably in place (write
+    + fsync + rename first, delete after — a crash mid-save can
+    orphan an extra file but never leaves fewer than ``keep``
+    restorable steps).  Two kinds of files go: steps older than the
+    newest ``keep`` at-or-below the one just saved (a long run would
+    otherwise grow the directory by ~3 bytes/param per save until the
+    disk fills), and ANY step newer than the one just saved — the
+    caller that just produced step N is authoritative about the
+    frontier, so newer files are an abandoned future (operator rolled
+    back and retrained) that would otherwise poison the default
+    latest-step resume.  ``keep=0`` prunes nothing.
     """
     import jax
 
@@ -94,16 +99,17 @@ def save_checkpoint(
     if keep > 0:
         # prune by the LISTED names (not reconstructed ones): a
         # hand-named step_5.npz must actually be removed, and a
-        # non-matching stray file must never crash the save.  Only
-        # steps AT OR BELOW the one just saved are candidates: after
-        # an operator rolls back (restore step=N, retrain), files
-        # NEWER than the just-saved step must not make the pruner
-        # delete the very checkpoint this call wrote (review r5).
-        candidates = [
-            (s, name) for s, name in _step_files(directory)
-            if s <= step
-        ]
-        for _old, name in candidates[:-keep]:
+        # non-matching stray file must never crash the save.  The
+        # just-saved step anchors the frontier: retention counts the
+        # newest `keep` AT OR BELOW it (so this call's own file is
+        # never deleted — review r5), and anything ABOVE it is an
+        # abandoned future from a rollback, pruned so the default
+        # latest-step resume cannot restore the state the rollback
+        # was meant to undo (review r5, follow-up).
+        files = _step_files(directory)
+        older = [(s, n) for s, n in files if s <= step]
+        stale_future = [(s, n) for s, n in files if s > step]
+        for _s, name in older[:-keep] + stale_future:
             try:
                 os.remove(os.path.join(directory, name))
             except OSError:

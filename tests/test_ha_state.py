@@ -842,3 +842,35 @@ def test_ensemble_promotion_chain_no_replicated_write_lost(tmp_path):
                 server.stop()
             except OSError:
                 pass  # the hard-killed generation's socket is gone
+
+
+def test_repl_status_cli_verb(capsys):
+    """`state-server --repl-status URL` prints the monitoring JSON an
+    operator alerts on (role, epoch, seq, per-standby map) and exits
+    0; an unreachable server is an error, not a traceback."""
+    from dcos_commons_tpu.storage.remote import main as state_server_main
+
+    primary = StateServer(MemPersister()).start()
+    standby = StateServer(MemPersister(), replicate_from=primary.url).start()
+    try:
+        RemotePersister(primary.url).set("/k", b"v")
+        wait_until(
+            lambda: RemotePersister(primary.url)._call(
+                "/v1/repl/status", {}
+            )["standby_attached"],
+            what="standby attach",
+        )
+        assert state_server_main(["--repl-status", primary.url]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["role"] == "primary"
+        assert out["standby_count"] == 1
+        assert out["seq"] >= 1
+        assert len(out["standbys"]) == 1
+    finally:
+        standby.stop()
+        primary.stop()
+    assert state_server_main(["--repl-status", primary.url]) == 1
+    assert "repl-status failed" in capsys.readouterr().err
+    # a hand-typed scheme-less URL: error message, never a traceback
+    assert state_server_main(["--repl-status", "host:1234"]) == 1
+    assert "repl-status failed" in capsys.readouterr().err

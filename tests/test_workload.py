@@ -506,13 +506,16 @@ def test_checkpoint_retention(tmp_path):
     names = sorted(p.name for p in tmp_path.glob("step_*.npz"))
     assert names == ["step_0000000008.npz", "step_best.npz"]
     # ROLLBACK + retrain: saving a step OLDER than existing files
-    # must never delete the checkpoint just written (review r5) —
-    # newer files are not prune candidates
+    # keeps the checkpoint just written AND prunes the abandoned
+    # future (review r5 x2) — the default latest-step resume must
+    # find the retrain, not the state the rollback undid
     save_checkpoint(str(tmp_path), 2, tree, keep=1)
     names = sorted(p.name for p in tmp_path.glob("step_0*.npz"))
-    assert names == ["step_0000000002.npz", "step_0000000008.npz"]
-    restored, step = restore_checkpoint(str(tmp_path), tree, step=2)
+    assert names == ["step_0000000002.npz"]
+    restored, step = restore_checkpoint(str(tmp_path), tree)
     assert step == 2
+    # the operator's non-step snapshot survives every prune
+    assert (tmp_path / "step_best.npz").exists()
 
 
 def test_checkpoint_bf16_roundtrip(tmp_path):
