@@ -18,6 +18,7 @@ from dcos_commons_tpu.tools.packaging import (
 from dcos_commons_tpu.tools.registry import (
     RegistryServer,
     fetch_package,
+    prune_registry,
     publish_package,
     registry_index,
 )
@@ -28,6 +29,7 @@ __all__ = [
     "build_package",
     "extract_package",
     "fetch_package",
+    "prune_registry",
     "publish_package",
     "read_manifest",
     "registry_index",

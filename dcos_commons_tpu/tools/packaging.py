@@ -249,6 +249,21 @@ def main(argv: Optional[list] = None) -> int:
     )
     p.add_argument("--token", default="", help="registry publish token")
     p = sub.add_parser(
+        "registry-prune",
+        help="retire old releases from a registry DIRECTORY: keep "
+             "the newest K versions per package (release_builder "
+             "lifecycle cleanup; runs on the registry host)",
+    )
+    p.add_argument("--dir", required=True, help="registry directory")
+    p.add_argument(
+        "--keep", type=int, required=True,
+        help="newest versions to retain per package (>= 1)",
+    )
+    p.add_argument(
+        "--name", default="",
+        help="prune only this package (default: every package)",
+    )
+    p = sub.add_parser(
         "registry-serve",
         help="serve a registry directory over HTTP",
     )
@@ -341,6 +356,12 @@ def _run_verb(args) -> int:
             args.package, args.registry, token=args.token
         )
         print(json.dumps(out))
+        return 0
+    if args.verb == "registry-prune":
+        from dcos_commons_tpu.tools.registry import prune_registry
+
+        pruned = prune_registry(args.dir, args.keep, name=args.name)
+        print(json.dumps({"pruned": pruned}))
         return 0
     if args.verb == "registry-serve":
         from dcos_commons_tpu.tools.registry import RegistryServer

@@ -78,9 +78,36 @@ def _load_index(path: str) -> Dict:
         return {"packages": {}}
     with open(path, "r", encoding="utf-8") as f:
         try:
-            return json.load(f)
+            index = json.load(f)
         except ValueError as e:
             raise PackageError(f"corrupt registry index {path}: {e}")
+    index.setdefault("packages", {})
+    return index
+
+
+def _registry_lock(root: str):
+    """Context manager: the registry's advisory index lock.  Every
+    index read-modify-write (publish, prune) must hold it — in the
+    documented shared-filesystem mode a concurrent writer's
+    os.replace would otherwise erase this writer's entry.  (The HTTP
+    path serializes in-process on top of this.)"""
+    import contextlib
+
+    @contextlib.contextmanager
+    def _held():
+        with contextlib.ExitStack() as stack:
+            try:
+                import fcntl
+
+                lock = stack.enter_context(
+                    open(os.path.join(root, ".index.lock"), "a+")
+                )
+                fcntl.flock(lock.fileno(), fcntl.LOCK_EX)
+            except ImportError:  # pragma: no cover — non-POSIX
+                pass
+            yield
+
+    return _held()
 
 
 def _store_index(path: str, index: Dict) -> None:
@@ -144,25 +171,9 @@ def publish_package(
 def _publish_local(
     root: str, artifact: str, payload: bytes, manifest: Dict, digest: str
 ) -> Dict:
-    import contextlib
-
-    name, version = manifest["name"], manifest.get("version", "0.0.0")
     os.makedirs(os.path.join(root, ARTIFACT_DIR), exist_ok=True)
     index_path = os.path.join(root, INDEX_NAME)
-    with contextlib.ExitStack() as stack:
-        # the documented shared-filesystem mode means CONCURRENT
-        # publishers: the index read-modify-write must hold an
-        # advisory lock or the second os.replace erases the first
-        # publish's entry (the HTTP path serializes in-process)
-        try:
-            import fcntl
-
-            lock = stack.enter_context(
-                open(os.path.join(root, ".index.lock"), "a+")
-            )
-            fcntl.flock(lock.fileno(), fcntl.LOCK_EX)
-        except ImportError:  # pragma: no cover — non-POSIX
-            pass
+    with _registry_lock(root):
         return _publish_local_locked(
             root, index_path, artifact, payload, manifest, digest
         )
@@ -185,6 +196,16 @@ def _publish_local_locked(
             f"{name} {version} is already published with different "
             "bytes — releases are immutable, bump the version"
         )
+    tombstone = index.get("tombstones", {}).get(name, {}).get(version)
+    if tombstone is not None and tombstone != digest:
+        # a PRUNED version stays burned: clients that pinned it must
+        # never see different bytes under the same (name, version);
+        # republishing the original bytes restores it
+        raise PackageError(
+            f"{name} {version} was pruned from this registry and its "
+            "digest is tombstoned — releases are immutable even after "
+            "pruning; bump the version"
+        )
     artifact_path = os.path.join(root, ARTIFACT_DIR, artifact)
     tmp = artifact_path + ".tmp"
     with open(tmp, "wb") as f:
@@ -202,6 +223,69 @@ def _publish_local_locked(
         "name": name, "version": version,
         "sha256": digest, "artifact": artifact,
     }
+
+
+def prune_registry(registry: str, keep: int, name: str = "") -> Dict:
+    """Retire old releases (release_builder's lifecycle cleanup): for
+    each package — or just ``name`` — keep the newest ``keep``
+    versions by the semver ordering and drop the rest from the index,
+    deleting artifact files no retained release references.  Runs on
+    the registry HOST directory (the same place publishes land in
+    shared-filesystem mode); an HTTP URL is refused — pruning is a
+    registry-admin operation, not a client verb.  Returns
+    {package: [pruned versions]}.  Immutability SURVIVES the prune:
+    each pruned (name, version) leaves a digest TOMBSTONE in the
+    index, so republishing different bytes under it is still
+    rejected (republishing the original bytes restores it)."""
+    if _is_http(registry):
+        raise PackageError(
+            "prune runs on the registry host's directory, not over "
+            "HTTP — ssh to the registry and pass its --dir path"
+        )
+    if keep < 1:
+        raise PackageError(f"--keep must be >= 1, got {keep}")
+    if not os.path.isdir(registry):
+        raise PackageError(
+            f"registry directory {registry!r} not found"
+        )
+    index_path = os.path.join(registry, INDEX_NAME)
+    with _registry_lock(registry):
+        index = _load_index(index_path)
+        if name and name not in index["packages"]:
+            raise PackageError(f"package {name!r} not in the registry")
+        pruned: Dict = {}
+        for pkg, versions in index["packages"].items():
+            if name and pkg != name:
+                continue
+            ordered = sorted(versions, key=_version_key)
+            for version in ordered[:-keep]:
+                pruned.setdefault(pkg, []).append(version)
+                # the tombstone carries the digest forward: pruning
+                # must not reopen the (name, version) namespace to
+                # different bytes
+                index.setdefault("tombstones", {}).setdefault(
+                    pkg, {}
+                )[version] = versions[version]["sha256"]
+                del versions[version]
+        if not pruned:
+            return {}
+        _store_index(index_path, index)
+        # delete artifacts nothing retained references (a file can be
+        # shared only by index entries; recompute the live set)
+        live = {
+            entry["artifact"]
+            for versions in index["packages"].values()
+            for entry in versions.values()
+        }
+        artifact_dir = os.path.join(registry, ARTIFACT_DIR)
+        if os.path.isdir(artifact_dir):
+            for fname in os.listdir(artifact_dir):
+                if fname not in live and not fname.endswith(".tmp"):
+                    try:
+                        os.remove(os.path.join(artifact_dir, fname))
+                    except OSError:
+                        pass
+        return pruned
 
 
 # -- resolve / fetch --------------------------------------------------

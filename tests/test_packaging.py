@@ -589,3 +589,82 @@ def test_registry_version_ordering_release_beats_prerelease(tmp_path):
     # pinned prerelease still fetchable
     version, _ = fetch_package(registry, "pkgsvc", version="1.0.0-rc1")
     assert version == "1.0.0-rc1"
+
+
+def test_registry_prune_retires_old_releases(tmp_path):
+    """`package registry-prune --keep K` (release_builder lifecycle
+    cleanup): old versions leave the index AND their artifact files;
+    the newest K stay installable; HTTP registries refuse the verb;
+    other packages are untouched when --name scopes the prune."""
+    from dcos_commons_tpu.tools import (
+        fetch_package,
+        publish_package,
+        registry_index,
+    )
+    from dcos_commons_tpu.tools.registry import prune_registry
+
+    framework = make_framework(tmp_path)
+    other = make_framework(tmp_path, name="othersvc")
+    registry = str(tmp_path / "registry")
+    for version in ("1.0.0", "1.1.0", "1.2.0", "1.10.0"):
+        artifact = str(tmp_path / f"p-{version}.tgz")
+        build_package(framework, artifact, version=version)
+        publish_package(artifact, registry)
+    artifact = str(tmp_path / "other-1.tgz")
+    build_package(other, artifact, version="0.1.0")
+    publish_package(artifact, registry)
+
+    pruned = prune_registry(registry, keep=2, name="pkgsvc")
+    assert pruned == {"pkgsvc": ["1.0.0", "1.1.0"]}
+    index = registry_index(registry)
+    assert set(index["packages"]["pkgsvc"]) == {"1.2.0", "1.10.0"}
+    assert set(index["packages"]["othersvc"]) == {"0.1.0"}  # untouched
+    # artifacts of pruned releases are gone; retained ones remain
+    artifacts = set(os.listdir(os.path.join(registry, "artifacts")))
+    assert artifacts == {
+        "pkgsvc-1.2.0.tar.gz", "pkgsvc-1.10.0.tar.gz",
+        "othersvc-0.1.0.tar.gz",
+    }
+    # latest still resolves and verifies after the prune
+    version, _payload = fetch_package(registry, "pkgsvc")
+    assert version == "1.10.0"
+    # idempotent: nothing more to prune
+    assert prune_registry(registry, keep=2) == {}
+    # guardrails
+    with pytest.raises(PackageError, match="host"):
+        prune_registry("http://reg:8081", keep=2)
+    with pytest.raises(PackageError, match="keep"):
+        prune_registry(registry, keep=0)
+    with pytest.raises(PackageError, match="not in the registry"):
+        prune_registry(registry, keep=1, name="ghost")
+    with pytest.raises(PackageError, match="not found"):
+        prune_registry(str(tmp_path / "typo"), keep=1)
+    # IMMUTABILITY SURVIVES THE PRUNE: a pruned version is digest-
+    # tombstoned — different bytes under it stay rejected, the
+    # original bytes restore it
+    (tmp_path / "pkgsvc" / "mutated.txt").write_text("different\n")
+    remut = str(tmp_path / "p-1.0.0-mut.tgz")
+    build_package(framework, remut, version="1.0.0")
+    with pytest.raises(PackageError, match="tombstoned"):
+        publish_package(remut, registry)
+    assert publish_package(
+        str(tmp_path / "p-1.0.0.tgz"), registry
+    )["version"] == "1.0.0"  # original bytes restore the release
+    # CLI verb prints the pruned map as JSON
+    import io
+    from contextlib import redirect_stdout
+
+    from dcos_commons_tpu.tools.packaging import main as package_main
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = package_main([
+            "registry-prune", "--dir", registry, "--keep", "1",
+        ])
+    assert rc == 0
+    out = json.loads(buf.getvalue())
+    # 1.0.0 was restored above, so keep=1 retires it again plus 1.2.0
+    assert out["pruned"] == {"pkgsvc": ["1.0.0", "1.2.0"]}
+    assert set(registry_index(registry)["packages"]["pkgsvc"]) == {
+        "1.10.0"
+    }
