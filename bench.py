@@ -322,6 +322,114 @@ def bench_scheduler_scale() -> dict:
     }
 
 
+def bench_offer_cycle() -> dict:
+    """Offer-cycle fast path microbench (ISSUE 1): a 16-step serial
+    deploy over a 64-host TPU fleet through run_forever with the
+    production 0.5 s fallback interval.  Two numbers are fenced:
+
+    * snapshot rebuild reduction — the generation-stamped cache must
+      cut per-host snapshot rebuilds >= 5x vs the rebuild-every-
+      request baseline (requests / misses);
+    * event-driven wall-clock — statuses nudge the loop, so the
+      deploy must complete in well under steps x interval_s (the old
+      loop paid >= one 0.5 s sleep per step)."""
+    import threading
+
+    from dcos_commons_tpu.common import TaskState, TaskStatus
+    from dcos_commons_tpu.offer.inventory import (
+        SliceInventory,
+        make_test_fleet,
+    )
+    from dcos_commons_tpu.scheduler import SchedulerBuilder, SchedulerConfig
+    from dcos_commons_tpu.specification import from_yaml
+    from dcos_commons_tpu.storage import MemPersister
+    from dcos_commons_tpu.testing import FakeAgent
+
+    n_steps, interval_s = 16, 0.5
+    hosts = []
+    for s in range(4):  # 4 slices x 16 hosts = 64 TPU hosts
+        hosts.extend(make_test_fleet(
+            slice_id=f"pod-{s}", host_grid=(4, 4), chip_block=(2, 2),
+            cpus=32.0, memory_mb=131072,
+        ))
+    spec = from_yaml(
+        "name: offercycle\n"
+        "pods:\n"
+        "  app:\n"
+        f"    count: {n_steps}\n"
+        "    placement: 'max-per-host:1'\n"
+        "    tasks:\n"
+        "      server:\n"
+        "        goal: RUNNING\n"
+        "        cmd: sleep 1000\n"
+        "        cpus: 2\n"
+        "        memory: 1024\n"
+        "plans:\n"
+        "  deploy:\n"
+        "    strategy: serial\n"
+        "    phases:\n"
+        "      app:\n"
+        "        strategy: serial\n"
+        "        pod: app\n"
+    )
+    builder = SchedulerBuilder(
+        spec,
+        SchedulerConfig(backoff_enabled=False, revive_capacity=10**9),
+        MemPersister(),
+    )
+    inventory = SliceInventory(hosts)
+    builder.set_inventory(inventory)
+    agent = FakeAgent()
+    builder.set_agent(agent)
+    scheduler = builder.build()
+
+    acked = set()
+    stop = threading.Event()
+
+    def responder():  # the fleet's agents acking RUNNING
+        while not stop.is_set():
+            for info in list(agent.launched):
+                if info.task_id not in acked:
+                    acked.add(info.task_id)
+                    agent.send(TaskStatus(
+                        task_id=info.task_id, state=TaskState.RUNNING,
+                        ready=True, agent_id=info.agent_id,
+                    ))
+            time.sleep(0.002)
+
+    responder_thread = threading.Thread(target=responder, daemon=True)
+    responder_thread.start()
+    t0 = time.monotonic()
+    loop_thread = scheduler.run_forever(interval_s=interval_s)
+    deadline = t0 + 60.0
+    completed = False
+    while time.monotonic() < deadline:
+        if scheduler.deploy_manager.get_plan().is_complete:
+            completed = True
+            break
+        time.sleep(0.01)
+    elapsed = time.monotonic() - t0
+    scheduler.stop()
+    loop_thread.join(timeout=5)
+    stop.set()
+    responder_thread.join(timeout=5)
+    requests = inventory.cache_hits + inventory.cache_misses
+    rebuild_reduction = requests / max(1, inventory.cache_misses)
+    return {
+        "offer_cycle_hosts": len(hosts),
+        "offer_cycle_steps": n_steps,
+        "offer_cycle_completed": completed,
+        "offer_cycle_deploy_s": round(elapsed, 3),
+        "offer_cycle_serial_budget_s": round(n_steps * interval_s, 1),
+        "offer_cycle_snapshot_requests": requests,
+        "offer_cycle_snapshot_rebuilds": inventory.cache_misses,
+        "offer_cycle_rebuild_reduction_x": round(rebuild_reduction, 1),
+        "offer_cycle_nudges": int(
+            scheduler.metrics.counters().get("cycle.nudges", 0)
+        ),
+    }
+
+
 def bench_deploy() -> dict:
     """Control-plane deploy of the single-chip MNIST service."""
     import shutil
@@ -1184,6 +1292,11 @@ def main() -> None:
     except Exception as e:
         extras["sched_scale_error"] = repr(e)[:200]
     _mark("sched_scale")
+    try:
+        extras.update(bench_offer_cycle())
+    except Exception as e:
+        extras["offer_cycle_error"] = repr(e)[:200]
+    _mark("offer_cycle")
     if not relay_ok:
         # every remaining section needs the chip's compile path; each
         # would burn its full timeout against a wedged relay.  Print

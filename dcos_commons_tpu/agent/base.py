@@ -36,3 +36,26 @@ class Agent:
     def poll(self) -> List[TaskStatus]:
         """Drain pending status transitions (RUNNING, FINISHED, ...)."""
         raise NotImplementedError
+
+    # -- status listeners (event-driven scheduling) -------------------
+    #
+    # Agents that learn of a status asynchronously (monitor threads,
+    # test fixtures injecting statuses) call _notify_status so the
+    # scheduler loop can wake for an immediate poll instead of waiting
+    # out its fallback heartbeat.  Purely advisory: an agent that only
+    # discovers transitions inside poll() never notifies, and the
+    # heartbeat still delivers everything.
+
+    def add_status_listener(self, listener) -> None:
+        """Register a no-arg callable invoked when a new status may be
+        available.  Called from arbitrary threads; must not block."""
+        if not hasattr(self, "_status_listeners"):
+            self._status_listeners = []
+        self._status_listeners.append(listener)
+
+    def _notify_status(self) -> None:
+        for listener in getattr(self, "_status_listeners", []):
+            try:
+                listener()
+            except Exception:  # a broken listener must not break intake
+                pass

@@ -31,6 +31,13 @@ class SchedulerApi:
         in-process; the HTTP server and its routes stay up)."""
         self._scheduler = scheduler
 
+    def _nudge(self) -> None:
+        """Wake an event-driven scheduler loop after a mutation so the
+        verb takes effect at evaluation speed, not heartbeat speed."""
+        nudge = getattr(self._scheduler, "nudge", None)
+        if callable(nudge):
+            nudge()
+
     # -- health (reference: http/endpoints/HealthResource.java) -------
 
     def health(self) -> Response:
@@ -96,6 +103,7 @@ class SchedulerApi:
         if error is not None:
             return error
         getattr(element, verb)()
+        self._nudge()
         return 200, {"message": f"{verb} invoked", "plan": plan_name}
 
     def plan_interrupt(self, plan_name, phase=None, step=None) -> Response:
@@ -133,6 +141,7 @@ class SchedulerApi:
             setter(env)
         element.restart()
         element.proceed()
+        self._nudge()
         return 200, {
             "message": "started", "plan": plan_name,
             "env": sorted(env) if env else [],
@@ -145,6 +154,7 @@ class SchedulerApi:
             return error
         element.interrupt()
         element.restart()
+        self._nudge()
         return 200, {"message": "stopped", "plan": plan_name}
 
     # -- pods (reference: http/queries/PodQueries.java:69-263) --------

@@ -73,8 +73,18 @@ class Metrics:
             gauges = dict(self._gauges)
             for name, samples in self._timers.items():
                 if samples:
-                    out[f"{name}.avg_s"] = sum(samples) / len(samples)
-                    out[f"{name}.max_s"] = max(samples)
+                    ordered = sorted(samples)
+                    n = len(ordered)
+                    mean = sum(ordered) / n
+                    out[f"{name}.count"] = float(n)
+                    out[f"{name}.min_s"] = ordered[0]
+                    out[f"{name}.mean_s"] = mean
+                    out[f"{name}.avg_s"] = mean  # legacy alias
+                    out[f"{name}.max_s"] = ordered[-1]
+                    # nearest-rank p95 over the ring buffer window
+                    out[f"{name}.p95_s"] = ordered[
+                        min(n - 1, max(0, -(-95 * n // 100) - 1))
+                    ]
         for name, fn in gauges.items():
             try:
                 out[name] = float(fn())

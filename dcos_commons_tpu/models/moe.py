@@ -27,6 +27,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from dcos_commons_tpu.parallel.compat import axis_size
+
 from dcos_commons_tpu.models.quantize import dequantize_weight as dq
 
 
@@ -156,7 +158,7 @@ def _moe_sorted(
     t, d = x.shape
     e = config.n_experts
     if axis_name is not None:
-        ep = lax.axis_size(axis_name)
+        ep = axis_size(axis_name)
         if (e // ep) * ep != e:
             # fail like the one-hot path does — not with an opaque
             # all_to_all split-axis shape error
@@ -256,7 +258,7 @@ def moe_ffn(
         )
         return y.astype(x.dtype), aux
 
-    ep = lax.axis_size(axis_name)
+    ep = axis_size(axis_name)
     e_local = config.n_experts // ep
     if e_local * ep != config.n_experts:
         raise ValueError(

@@ -27,6 +27,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from dcos_commons_tpu.parallel.compat import axis_size
+
 from dcos_commons_tpu.models.quantize import dequantize_weight as dq
 from dcos_commons_tpu.ops.attention import flash_attention
 from dcos_commons_tpu.ops.rmsnorm import rms_norm
@@ -506,7 +508,7 @@ def pipeline_loss_fn(
     out = _pipeline_trunk(config, params, tokens, n_micro, axis_name)
     x = merge_microbatches(out)
     idx = lax.axis_index(axis_name)
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
 
     def last_rank_loss(operands):
         params, x, targets = operands

@@ -81,12 +81,36 @@ class _MergedLedgerView:
 
     def __init__(self, multi: "MultiServiceScheduler"):
         self._multi = multi
+        self._pass_items = None
+
+    def _items(self):
+        # a snapshots() pass calls host_generation once per host; the
+        # per-pass snapshot (prepare_pass) avoids paying the services()
+        # lock/copy per host on the hot path
+        if self._pass_items is not None:
+            return self._pass_items
+        return sorted(self._multi.services().items())
+
+    def prepare_pass(self) -> None:
+        """Called by SliceInventory.snapshots at the start of a pass:
+        capture the service set once for all per-host token reads."""
+        self._pass_items = sorted(self._multi.services().items())
 
     def reserved_on(self, host_id: str):
         out = []
-        for service in self._multi.services().values():
+        for _name, service in self._items():
             out.extend(service.ledger.reserved_on(host_id))
         return out
+
+    def host_generation(self, host_id: str):
+        """Composite change token for the snapshot cache: the set of
+        (service, per-host ledger generation) pairs.  Any service's
+        commit/GC on the host — or a service appearing/disappearing —
+        changes the token; compared only by equality."""
+        return tuple(
+            (name, service.ledger.host_generation(host_id))
+            for name, service in self._items()
+        )
 
 
 class MultiServiceScheduler:
@@ -119,6 +143,17 @@ class MultiServiceScheduler:
         self._fatal_error: Optional[str] = None
         self._lock = threading.RLock()
         self._stop = threading.Event()
+        # event-driven wake (mirrors DefaultScheduler): service
+        # add/remove and agent status arrival cut the fallback wait
+        self._wake = threading.Event()
+        add_listener = getattr(agent, "add_status_listener", None)
+        if callable(add_listener):
+            add_listener(self.nudge)
+        # ONE merged view shared by every service's evaluator: the
+        # shared inventory keys its snapshot cache on the view object,
+        # so per-service view instances would clear it on every
+        # service switch within a cycle
+        self._merged_view = _MergedLedgerView(self)
         self._reload()
 
     # -- add/remove/lookup (reference: MultiServiceManager) -----------
@@ -151,6 +186,7 @@ class MultiServiceScheduler:
                 spec.name, spec.to_dict(), options=options
             )
             self._services[spec.name] = built
+        self.nudge()  # deploy work just became pending
 
     @property
     def artifact_base(self):
@@ -355,6 +391,7 @@ class MultiServiceScheduler:
             self._services[name] = self._make_uninstaller(
                 ServiceSpec.from_dict(entry["spec"])
             )
+        self.nudge()  # teardown work just became pending
 
     def get_service(self, name: str):
         with self._lock:
@@ -391,7 +428,7 @@ class MultiServiceScheduler:
         )
         # snapshots must subtract EVERY service's reservations, not
         # just this service's own namespaced ledger
-        scheduler.evaluator.set_snapshot_view(_MergedLedgerView(self))
+        scheduler.evaluator.set_snapshot_view(self._merged_view)
         # the shared agent's task set spans every service: per-service
         # orphan sweeps would kill siblings' tasks, so the multi loop
         # runs ONE merged sweep instead (_kill_merged_orphans)
@@ -538,6 +575,7 @@ class MultiServiceScheduler:
         def loop():
             failures = 0
             while not self._stop.is_set():
+                self._wake.clear()
                 try:
                     self.run_cycle()
                     failures = 0
@@ -555,11 +593,29 @@ class MultiServiceScheduler:
                     )
                     self._stop.set()
                     break
-                self._stop.wait(interval_s)
+                timeout = interval_s
+                if self._work_in_flight():
+                    timeout = min(interval_s, 0.05)
+                self._wake.wait(timeout)
 
         thread = threading.Thread(target=loop, name="multi-loop", daemon=True)
         thread.start()
         return thread
+
+    def nudge(self) -> None:
+        """Wake run_forever for an immediate merged cycle (status
+        arrival, service add/remove, HTTP mutation)."""
+        self._wake.set()
+
+    def _work_in_flight(self) -> bool:
+        """True while any service's plan step awaits task statuses."""
+        for service in self.services().values():
+            managers = getattr(
+                getattr(service, "coordinator", None), "plan_managers", []
+            )
+            if any(m.in_progress_assets() for m in managers):
+                return True
+        return False
 
     @property
     def fatal_error(self) -> Optional[str]:
@@ -567,3 +623,4 @@ class MultiServiceScheduler:
 
     def stop(self) -> None:
         self._stop.set()
+        self._wake.set()

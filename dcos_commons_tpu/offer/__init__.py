@@ -27,6 +27,7 @@ from dcos_commons_tpu.offer.ledger import Reservation, ReservationLedger
 from dcos_commons_tpu.offer.outcome import EvaluationOutcome
 from dcos_commons_tpu.offer.placement import PlacementRule, parse_placement
 from dcos_commons_tpu.offer.evaluate import (
+    EvaluationContext,
     EvaluationResult,
     LaunchRecommendation,
     OfferEvaluator,
@@ -34,6 +35,7 @@ from dcos_commons_tpu.offer.evaluate import (
 )
 
 __all__ = [
+    "EvaluationContext",
     "EvaluationOutcome",
     "EvaluationResult",
     "LaunchRecommendation",

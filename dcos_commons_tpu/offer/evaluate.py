@@ -17,6 +17,7 @@ reference's bootstrap DNS-wait, sdk/bootstrap/main.go:218-289).
 
 from __future__ import annotations
 
+import contextlib
 import uuid
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
@@ -69,6 +70,58 @@ ENV_TPU_NUM_SLICES = "TPU_NUM_SLICES"
 COORDINATOR_PORT_NAME = "coordinator"
 
 
+class EvaluationContext:
+    """Shared per-cycle evaluation state (the offer-cycle fast path).
+
+    ``run_cycle`` constructs ONE of these and threads it through every
+    candidate evaluation, so the state-store task scan and the hosts
+    dict are computed once per cycle instead of once per step.  Both
+    are lazy — an idle cycle (no candidates) pays nothing.
+
+    Correctness contract: the scheduler must call ``note_launched``
+    after recording a launch, so the next candidate in the SAME cycle
+    sees the just-launched tasks exactly as a fresh ``fetch_tasks``
+    would (max-per/colocate rules count them).
+    """
+
+    def __init__(self, state_store: StateStore, inventory: SliceInventory):
+        self._state_store = state_store
+        self._inventory = inventory
+        self._tasks: Optional[List[TaskInfo]] = None
+        self._hosts: Optional[Dict[str, object]] = None
+        self._hosts_token: Optional[int] = None
+
+    def tasks(self) -> List[TaskInfo]:
+        if self._tasks is None:
+            self._tasks = list(self._state_store.fetch_tasks())
+        return self._tasks
+
+    def hosts(self) -> Dict[str, object]:
+        token = self._inventory.topology_generation
+        if self._hosts is None or self._hosts_token != token:
+            self._hosts = {
+                h.host_id: h for h in self._inventory.hosts()
+            }
+            self._hosts_token = token
+        return self._hosts
+
+    def note_launched(self, infos: List[TaskInfo]) -> None:
+        """Mirror ``StateStore.store_tasks`` semantics on the cached
+        task list: a relaunch replaces the same-named entry."""
+        if self._tasks is None or not infos:
+            return
+        names = {i.name for i in infos}
+        self._tasks = [
+            t for t in self._tasks if t.name not in names
+        ] + list(infos)
+
+    def invalidate_tasks(self) -> None:
+        """Drop the cached task scan after a mid-cycle state mutation
+        this context cannot mirror (e.g. an ActionStep erasing tasks);
+        the next evaluation re-fetches."""
+        self._tasks = None
+
+
 @dataclass
 class ReserveRecommendation:
     reservation: Reservation
@@ -110,6 +163,9 @@ class OfferEvaluator:
         # (reference: one Mesos master arbitrates all frameworks; here
         # the merged ledger view is the arbiter)
         self._snapshot_view = ledger
+        # set by the scheduler so snapshot synthesis shows up under
+        # the cycle.* timers; None when wired by hand in tests
+        self.metrics = None
 
     def set_target_config(self, config_id: str) -> None:
         self._target_config_id = config_id
@@ -123,18 +179,31 @@ class OfferEvaluator:
         self,
         requirement: PodInstanceRequirement,
         inventory: SliceInventory,
+        context: Optional[EvaluationContext] = None,
     ) -> EvaluationResult:
-        """Match one requirement against the current inventory."""
-        snapshots = inventory.snapshots(self._snapshot_view)
+        """Match one requirement against the current inventory.
+
+        ``context`` shares the task scan and hosts dict across every
+        candidate of one scheduler cycle; omitted (direct callers,
+        tests), a private one is built — same results, less reuse."""
+        if context is None:
+            context = EvaluationContext(self._state_store, inventory)
+        timer = (
+            self.metrics.time("cycle.snapshot")
+            if self.metrics is not None else contextlib.nullcontext()
+        )
+        with timer:
+            snapshots = inventory.snapshots(self._snapshot_view)
+        excluded = set(requirement.task_names())
         ctx = PlacementContext(
             pod_type=requirement.pod.type,
             existing_tasks=[
                 t
-                for t in self._state_store.fetch_tasks()
+                for t in context.tasks()
                 # tasks being relaunched must not block their own placement
-                if t.name not in set(requirement.task_names())
+                if t.name not in excluded
             ],
-            hosts={h.host_id: h for h in inventory.hosts()},
+            hosts=context.hosts(),
         )
 
         # In-place relaunch: reuse committed reservations when they are
@@ -559,7 +628,7 @@ class OfferEvaluator:
                 claimed_hosts[snap.host.host_id] = work
                 # placement context must see this instance for max-per
                 # rules on subsequent instances in the same requirement
-                ctx.existing_tasks.extend(infos)
+                ctx.record_tasks(infos)
                 placed = True
                 root.children.append(EvaluationOutcome.ok(
                     f"host:{snap.host.host_id}",

@@ -52,14 +52,22 @@ class TpuHost:
         return self.chip_block[0] * self.chip_block[1]
 
     def chip_ids(self) -> List[str]:
-        """Global chip ids "slice/x,y" for every chip this host owns."""
-        w, h = self.chip_block
-        ox, oy = self.grid[0] * w, self.grid[1] * h
-        return [
-            f"{self.slice_id}/{ox + dx},{oy + dy}"
-            for dy in range(h)
-            for dx in range(w)
-        ]
+        """Global chip ids "slice/x,y" for every chip this host owns.
+
+        Memoized: the dataclass is frozen, so the id list is a pure
+        function of the host — snapshot synthesis used to re-format
+        these strings for every host on every cycle."""
+        cached = self.__dict__.get("_chip_ids")
+        if cached is None:
+            w, h = self.chip_block
+            ox, oy = self.grid[0] * w, self.grid[1] * h
+            cached = tuple(
+                f"{self.slice_id}/{ox + dx},{oy + dy}"
+                for dy in range(h)
+                for dx in range(w)
+            )
+            object.__setattr__(self, "_chip_ids", cached)
+        return list(cached)
 
 
 class ResourceSnapshot:
@@ -137,24 +145,48 @@ class SliceInventory:
     def __init__(self, hosts: Optional[List[TpuHost]] = None):
         self._hosts: Dict[str, TpuHost] = {}
         self._down: Set[str] = set()
+        # snapshot cache (offer-cycle fast path): host_id -> (host
+        # object, ledger host-generation token, built snapshot).  An
+        # entry is valid while the exact host object is registered and
+        # the view reports the same per-host generation; callers get a
+        # copy, so the cached master is never mutated by evaluation.
+        self._snap_cache: Dict[str, tuple] = {}
+        # the view object itself is held (not its id()): id reuse
+        # after GC must never validate a stale cache
+        self._snap_view = None
+        self.cache_hits = 0
+        self.cache_misses = 0
+        # bumped on any host add/remove/up/down so per-cycle consumers
+        # (EvaluationContext's hosts dict) know when to rebuild
+        self._topology_gen = 0
         for host in hosts or []:
             self.add_host(host)
 
+    @property
+    def topology_generation(self) -> int:
+        return self._topology_gen
+
     def add_host(self, host: TpuHost) -> None:
         self._hosts[host.host_id] = host
+        self._snap_cache.pop(host.host_id, None)
+        self._topology_gen += 1
 
     def remove_host(self, host_id: str) -> None:
         self._hosts.pop(host_id, None)
         self._down.discard(host_id)
+        self._snap_cache.pop(host_id, None)
+        self._topology_gen += 1
 
     def mark_down(self, host_id: str) -> None:
         """Host lost/maintenance: excluded from snapshots (the TASK_LOST
         / PARTITION_AWARE analogue, SURVEY.md section 5.3)."""
         if host_id in self._hosts:
             self._down.add(host_id)
+            self._topology_gen += 1
 
     def mark_up(self, host_id: str) -> None:
         self._down.discard(host_id)
+        self._topology_gen += 1
 
     def is_up(self, host_id: str) -> bool:
         return host_id in self._hosts and host_id not in self._down
@@ -169,22 +201,57 @@ class SliceInventory:
         return [h for h in self._hosts.values() if h.host_id not in self._down]
 
     def snapshots(self, ledger: "ReservationLedgerView") -> List[ResourceSnapshot]:
+        """Synthesize the current offers, reusing cached per-host
+        snapshots while the ledger view's per-host generation is
+        unchanged.  A view without ``host_generation`` (or returning
+        None) disables caching for that host — correctness never
+        depends on the view being generation-aware."""
+        gen_of = getattr(ledger, "host_generation", None)
+        prepare = getattr(ledger, "prepare_pass", None)
+        if prepare is not None:
+            # composite views capture their member set once per pass
+            # instead of once per host
+            prepare()
+        if ledger is not self._snap_view:
+            # a different view object arbitrates now (e.g. the merged
+            # multi-service view replacing the bare ledger): its
+            # generations are not comparable with the cached tokens
+            self._snap_cache.clear()
+            self._snap_view = ledger
         out = []
         for host in self.up_hosts():
-            reserved = ledger.reserved_on(host.host_id)
-            free_chips = set(host.chip_ids())
-            used_ports: Set[int] = set()
-            cpus, mem, disk = host.cpus, host.memory_mb, host.disk_mb
-            for res in reserved:
-                cpus -= res.cpus
-                mem -= res.memory_mb
-                disk -= res.disk_mb
-                free_chips -= set(res.chip_ids)
-                used_ports |= set(res.ports)
-            out.append(
-                ResourceSnapshot(host, cpus, mem, disk, free_chips, used_ports)
-            )
+            token = gen_of(host.host_id) if gen_of is not None else None
+            cached = self._snap_cache.get(host.host_id)
+            if (
+                token is not None
+                and cached is not None
+                and cached[0] is host
+                and cached[1] == token
+            ):
+                self.cache_hits += 1
+                out.append(cached[2].copy())
+                continue
+            self.cache_misses += 1
+            snap = self._build_snapshot(host, ledger)
+            if token is not None:
+                self._snap_cache[host.host_id] = (host, token, snap)
+                snap = snap.copy()
+            out.append(snap)
         return out
+
+    def _build_snapshot(
+        self, host: TpuHost, ledger: "ReservationLedgerView"
+    ) -> ResourceSnapshot:
+        free_chips = set(host.chip_ids())
+        used_ports: Set[int] = set()
+        cpus, mem, disk = host.cpus, host.memory_mb, host.disk_mb
+        for res in ledger.reserved_on(host.host_id):
+            cpus -= res.cpus
+            mem -= res.memory_mb
+            disk -= res.disk_mb
+            free_chips -= set(res.chip_ids)
+            used_ports |= set(res.ports)
+        return ResourceSnapshot(host, cpus, mem, disk, free_chips, used_ports)
 
 
 class ReservationLedgerView:
@@ -192,6 +259,12 @@ class ReservationLedgerView:
 
     def reserved_on(self, host_id: str):  # pragma: no cover - interface
         raise NotImplementedError
+
+    def host_generation(self, host_id: str):
+        """Change token for ``reserved_on(host_id)``; snapshots cached
+        against it are reused while it compares equal.  None (the
+        default) means "unknown — never cache"."""
+        return None
 
 
 def make_test_fleet(

@@ -49,12 +49,25 @@ def new_reservation_id() -> str:
 
 
 class ReservationLedger(ReservationLedgerView):
-    """Persisted under /reservations/<id>; cached in RAM for scans."""
+    """Persisted under /reservations/<id>; cached in RAM for scans.
+
+    The RAM cache carries two scan accelerators for the offer cycle's
+    hot path: by-host and by-task indexes (``reserved_on``/``for_task``
+    are O(claims on that host/task) instead of O(all claims)), and a
+    monotonic generation counter bumped on every commit/release.  Each
+    host records the generation of its last mutation, so
+    ``SliceInventory.snapshots`` can reuse a cached per-host snapshot
+    whenever ``host_generation`` is unchanged.
+    """
 
     def __init__(self, persister: Persister, namespace: str = "") -> None:
         self._persister = persister
         self._root = namespace_root(namespace)
         self._cache: Dict[str, Reservation] = {}
+        self._by_host: Dict[str, Dict[str, Reservation]] = {}
+        self._by_task: Dict[str, Dict[str, Reservation]] = {}
+        self._generation = 1
+        self._host_gen: Dict[str, int] = {}
         self._load()
 
     def _path(self, reservation_id: str) -> str:
@@ -67,7 +80,27 @@ class ReservationLedger(ReservationLedgerView):
         ):
             raw = self._persister.get_or_none(self._path(rid))
             if raw is not None:
-                self._cache[rid] = Reservation.from_bytes(raw)
+                self._index(Reservation.from_bytes(raw))
+
+    def _index(self, r: Reservation) -> None:
+        old = self._cache.get(r.reservation_id)
+        if old is not None:
+            self._unindex(old)
+        self._cache[r.reservation_id] = r
+        self._by_host.setdefault(r.host_id, {})[r.reservation_id] = r
+        self._by_task.setdefault(r.task_name, {})[r.reservation_id] = r
+        self._host_gen[r.host_id] = self._generation
+
+    def _unindex(self, r: Reservation) -> None:
+        self._cache.pop(r.reservation_id, None)
+        for index, key in ((self._by_host, r.host_id),
+                           (self._by_task, r.task_name)):
+            bucket = index.get(key)
+            if bucket is not None:
+                bucket.pop(r.reservation_id, None)
+                if not bucket:
+                    del index[key]
+        self._host_gen[r.host_id] = self._generation
 
     # -- commit / release --------------------------------------------
 
@@ -78,8 +111,9 @@ class ReservationLedger(ReservationLedgerView):
             for r in reservations
         ]
         self._persister.apply(ops)
+        self._generation += 1
         for r in reservations:
-            self._cache[r.reservation_id] = r
+            self._index(r)
 
     def release(self, reservation_id: str) -> None:
         from dcos_commons_tpu.storage import PersisterError
@@ -89,9 +123,22 @@ class ReservationLedger(ReservationLedgerView):
             self._persister.recursive_delete(path)
         except PersisterError:
             pass
-        self._cache.pop(reservation_id, None)
+        old = self._cache.get(reservation_id)
+        if old is not None:
+            self._generation += 1
+            self._unindex(old)
 
     # -- queries ------------------------------------------------------
+
+    @property
+    def generation(self) -> int:
+        """Monotonic mutation counter (bumped per commit/release)."""
+        return self._generation
+
+    def host_generation(self, host_id: str) -> int:
+        """Generation of the last mutation touching ``host_id`` (0 =
+        never touched).  Snapshot caches key on this value."""
+        return self._host_gen.get(host_id, 0)
 
     def get(self, reservation_id: str) -> Optional[Reservation]:
         return self._cache.get(reservation_id)
@@ -100,10 +147,10 @@ class ReservationLedger(ReservationLedgerView):
         return list(self._cache.values())
 
     def reserved_on(self, host_id: str) -> List[Reservation]:
-        return [r for r in self._cache.values() if r.host_id == host_id]
+        return list(self._by_host.get(host_id, {}).values())
 
     def for_task(self, task_name: str) -> List[Reservation]:
-        return [r for r in self._cache.values() if r.task_name == task_name]
+        return list(self._by_task.get(task_name, {}).values())
 
     def unexpected_reservations(self, expected_task_names: Set[str]) -> List[Reservation]:
         """Claims owned by no live task — candidates for UNRESERVE GC

@@ -34,11 +34,34 @@ class PlacementContext:
 
     Reference: PlacementRule.filter(offer, allTasks) — rules see every
     launched task so they can count/colocate/avoid.
+
+    Rules call ``tasks_of_pod``/``count_on``/``field_values`` once per
+    candidate host per instance, so all three memoize their scans
+    (they are pure in ``existing_tasks``/``hosts``).  Task additions
+    mid-evaluation MUST go through ``record_tasks`` — it invalidates
+    the task-derived memos; mutating ``existing_tasks`` in place after
+    the first rule ran would serve stale counts.
     """
 
     pod_type: str
     existing_tasks: List[TaskInfo] = field(default_factory=list)
     hosts: Dict[str, TpuHost] = field(default_factory=dict)
+    _instances_memo: Dict[str, List[TaskInfo]] = field(
+        default_factory=dict, init=False, repr=False, compare=False
+    )
+    _counts_memo: Dict[tuple, Dict[str, int]] = field(
+        default_factory=dict, init=False, repr=False, compare=False
+    )
+    _values_memo: Dict[str, set] = field(
+        default_factory=dict, init=False, repr=False, compare=False
+    )
+
+    def record_tasks(self, infos: List[TaskInfo]) -> None:
+        """Append just-placed tasks so max-per/group-by rules count
+        them for subsequent instances of the same requirement."""
+        self.existing_tasks.extend(infos)
+        self._instances_memo.clear()
+        self._counts_memo.clear()
 
     def host_field(self, host: TpuHost, field_name: str) -> str:
         if field_name == "hostname":
@@ -53,21 +76,40 @@ class PlacementContext:
             return host.slice_id
         return host.attributes.get(field_name, "")
 
+    def field_values(self, field_name: str) -> set:
+        """Every distinct value of ``field_name`` across the fleet."""
+        values = self._values_memo.get(field_name)
+        if values is None:
+            values = {
+                self.host_field(h, field_name) for h in self.hosts.values()
+            }
+            self._values_memo[field_name] = values
+        return values
+
     def tasks_of_pod(self, pod_type: str) -> List[TaskInfo]:
         # one counted entry per pod instance (not per task)
-        seen = {}
-        for info in self.existing_tasks:
-            if info.pod_type == pod_type:
-                seen[f"{info.pod_type}-{info.pod_index}"] = info
-        return list(seen.values())
+        cached = self._instances_memo.get(pod_type)
+        if cached is None:
+            seen = {}
+            for info in self.existing_tasks:
+                if info.pod_type == pod_type:
+                    seen[f"{info.pod_type}-{info.pod_index}"] = info
+            cached = list(seen.values())
+            self._instances_memo[pod_type] = cached
+        return cached
 
     def count_on(self, field_name: str, value: str, pod_type: str) -> int:
-        count = 0
-        for info in self.tasks_of_pod(pod_type):
-            host = self.hosts.get(info.agent_id)
-            if host is not None and self.host_field(host, field_name) == value:
-                count += 1
-        return count
+        key = (field_name, pod_type)
+        counts = self._counts_memo.get(key)
+        if counts is None:
+            counts = {}
+            for info in self.tasks_of_pod(pod_type):
+                host = self.hosts.get(info.agent_id)
+                if host is not None:
+                    actual = self.host_field(host, field_name)
+                    counts[actual] = counts.get(actual, 0) + 1
+            self._counts_memo[key] = counts
+        return counts.get(value, 0)
 
 
 class PlacementRule:
@@ -192,9 +234,7 @@ class GroupByRule(PlacementRule):
 
     def filter(self, snapshot, ctx):
         value = ctx.host_field(snapshot.host, self.field_name)
-        values = {
-            ctx.host_field(h, self.field_name) for h in ctx.hosts.values()
-        } | {value}
+        values = ctx.field_values(self.field_name) | {value}
         divisor = self.expected_values or len(values) or 1
         total = len(ctx.tasks_of_pod(ctx.pod_type)) + 1  # incl. this one
         ceiling = math.ceil(total / divisor)
@@ -287,9 +327,7 @@ class RoundRobinByRule(PlacementRule):
 
     def filter(self, snapshot, ctx):
         value = ctx.host_field(snapshot.host, self.field_name)
-        values = {
-            ctx.host_field(h, self.field_name) for h in ctx.hosts.values()
-        } | {value}
+        values = ctx.field_values(self.field_name) | {value}
         counts = {
             v: ctx.count_on(self.field_name, v, ctx.pod_type) for v in values
         }

@@ -18,6 +18,7 @@ from dcos_commons_tpu.parallel.collectives import (
     collective_bandwidth,
     single_chip_rooflines,
 )
+from dcos_commons_tpu.parallel.compat import shard_map
 from dcos_commons_tpu.parallel.mesh import (
     MeshSpec,
     make_mesh,
@@ -33,5 +34,6 @@ __all__ = [
     "make_mesh",
     "mesh_from_env",
     "ring_attention",
+    "shard_map",
     "single_chip_rooflines",
 ]

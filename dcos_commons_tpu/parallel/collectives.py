@@ -27,6 +27,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from dcos_commons_tpu.parallel.compat import axis_size
+
 
 def _sync(x) -> float:
     """Force completion INCLUDING a device->host readback.
@@ -64,17 +66,17 @@ def _bench_fn(collective: str, axis: str, iters: int):
             if collective == "psum":
                 out = lax.psum(carry, axis)
                 # renormalize so values stay finite across iterations
-                out = out / lax.axis_size(axis)
+                out = out / axis_size(axis)
             elif collective == "all_gather":
                 gathered = lax.all_gather(carry, axis)
                 out = gathered.mean(axis=0) + carry * 0.0
             elif collective == "reduce_scatter":
                 out = lax.psum_scatter(
-                    jnp.tile(carry, (lax.axis_size(axis), 1)),
+                    jnp.tile(carry, (axis_size(axis), 1)),
                     axis, scatter_dimension=0, tiled=True,
-                ) / lax.axis_size(axis)
+                ) / axis_size(axis)
             elif collective == "ppermute":
-                n = lax.axis_size(axis)
+                n = axis_size(axis)
                 perm = [(i, (i + 1) % n) for i in range(n)]
                 out = lax.ppermute(carry, axis, perm)
             else:
@@ -99,7 +101,7 @@ def collective_bandwidth(
     Payload is the per-chip shard size.  Returns
     {collective: algorithmic GB/s/chip} plus bookkeeping keys.
     """
-    from jax import shard_map
+    from dcos_commons_tpu.parallel.compat import shard_map
 
     n = mesh.shape[axis]
     bytes_per_elem = jnp.dtype(dtype).itemsize

@@ -1,0 +1,57 @@
+"""JAX version compatibility for the parallelism layer.
+
+``shard_map`` moved from ``jax.experimental.shard_map`` into the
+top-level ``jax`` namespace (jax 0.6+), and its replication-checker
+kwarg was renamed ``check_rep`` -> ``check_vma`` in the same era.
+Everything in this repo (and its tests) imports it from here so one
+shim tracks both moves: prefer the top-level export, fall back to the
+experimental path on the older jax the container ships, translating
+``check_vma`` to the old spelling.
+"""
+
+from __future__ import annotations
+
+import functools
+
+try:  # jax >= 0.6: top-level export, check_vma spelling
+    from jax import shard_map
+except ImportError:  # older jax: experimental home, check_rep spelling
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    @functools.wraps(_shard_map)
+    def shard_map(*args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map(*args, **kwargs)
+
+try:  # jax >= 0.5: static mesh-axis size as a lax primitive helper
+    from jax.lax import axis_size
+except ImportError:
+    def axis_size(axis_name):
+        """Static size of a bound mesh axis (``lax.axis_size``
+        backport).  On old jax ``jax.core.axis_frame`` returns the
+        size directly (an int); newer intermediates return a frame
+        object carrying ``.size``."""
+        import jax.core as core
+
+        frame = core.axis_frame(axis_name)
+        return getattr(frame, "size", frame)
+
+def pvary(x, axis_names):
+    """Mark ``x`` device-varying over ``axis_names`` for shard_map's
+    replication (vma) checker.  The primitive has gone through three
+    spellings — ``lax.pcast(..., to="varying")``, ``lax.pvary`` — and
+    does not exist at all on old jax, where no vma checker runs and
+    identity is correct."""
+    from jax import lax
+
+    pcast = getattr(lax, "pcast", None)
+    if pcast is not None:
+        return pcast(x, tuple(axis_names), to="varying")
+    fn = getattr(lax, "pvary", None)
+    if fn is not None:
+        return fn(x, tuple(axis_names))
+    return x
+
+
+__all__ = ["axis_size", "pvary", "shard_map"]

@@ -26,6 +26,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from dcos_commons_tpu.parallel.compat import axis_size
+
 
 def pipeline_apply(
     stage_fn: Callable[[Any, jax.Array], jax.Array],
@@ -49,7 +51,7 @@ def pipeline_apply(
         (other ranks hold zeros).  Use :func:`last_stage_value` to
         broadcast to all ranks when the loss is computed replicated.
     """
-    n_stages = lax.axis_size(axis_name)
+    n_stages = axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     n_micro = microbatches.shape[0]
     ticks = n_micro + n_stages - 1
@@ -58,10 +60,9 @@ def pipeline_apply(
     perm = [(s, (s + 1) % n_stages) for s in range(n_stages)]
 
     def vary(x):
-        pcast = getattr(lax, "pcast", None)
-        if pcast is not None:
-            return pcast(x, (axis_name,), to="varying")
-        return lax.pvary(x, (axis_name,))
+        from dcos_commons_tpu.parallel.compat import pvary
+
+        return pvary(x, (axis_name,))
 
     state = vary(jnp.zeros_like(microbatches[0]))
     out = vary(jnp.zeros_like(microbatches))
@@ -89,7 +90,7 @@ def last_stage_value(x: jax.Array, axis_name: str = "pp") -> jax.Array:
     """Broadcast the last pp rank's value to every rank (psum of a
     one-hot mask — one collective, keeps the loss replicated)."""
     idx = lax.axis_index(axis_name)
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     mask = (idx == n - 1).astype(x.dtype)
     return lax.psum(x * mask, axis_name)
 

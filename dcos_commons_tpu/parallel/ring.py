@@ -21,6 +21,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from dcos_commons_tpu.parallel.compat import axis_size as _mesh_axis_size
+
 _NEG = -1e30
 
 
@@ -38,7 +40,7 @@ def ring_attention(
     (per device): q/k/v [batch, heads, chunk, head_dim].
     """
     if axis_size is None:
-        axis_size = lax.axis_size(axis_name)
+        axis_size = _mesh_axis_size(axis_name)
     chunk = q.shape[-2]
     scale = q.shape[-1] ** -0.5
     my_idx = lax.axis_index(axis_name)
@@ -47,10 +49,9 @@ def ring_attention(
     # accumulators start as constants but become device-varying inside
     # the loop; mark them varying up front for shard_map's vma checker
     def _vary(x):
-        pcast = getattr(lax, "pcast", None)
-        if pcast is not None:
-            return pcast(x, (axis_name,), to="varying")
-        return lax.pvary(x, (axis_name,))
+        from dcos_commons_tpu.parallel.compat import pvary
+
+        return pvary(x, (axis_name,))
 
     o = _vary(jnp.zeros(q.shape[:-1] + (v.shape[-1],), jnp.float32))
     m = _vary(jnp.full(q.shape[:-1], _NEG, jnp.float32))

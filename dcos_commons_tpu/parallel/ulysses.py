@@ -31,6 +31,8 @@ from typing import Optional
 import jax
 from jax import lax
 
+from dcos_commons_tpu.parallel.compat import axis_size as _mesh_axis_size
+
 
 def ulysses_attention(
     q: jax.Array,
@@ -51,7 +53,7 @@ def ulysses_attention(
     from dcos_commons_tpu.ops.attention import flash_attention
 
     if axis_size is None:
-        axis_size = lax.axis_size(axis_name)
+        axis_size = _mesh_axis_size(axis_name)
     if axis_size == 1:
         return flash_attention(
             q, k, v, causal=causal, block_q=block_q, block_k=block_k

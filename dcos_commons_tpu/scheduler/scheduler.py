@@ -27,7 +27,7 @@ from dcos_commons_tpu.agent.base import Agent
 from dcos_commons_tpu.common import Label, TaskStatus, task_name_of
 from dcos_commons_tpu.debug.trackers import OfferOutcomeTracker
 from dcos_commons_tpu.metrics.registry import Metrics
-from dcos_commons_tpu.offer.evaluate import OfferEvaluator
+from dcos_commons_tpu.offer.evaluate import EvaluationContext, OfferEvaluator
 from dcos_commons_tpu.offer.inventory import SliceInventory
 from dcos_commons_tpu.offer.ledger import ReservationLedger
 from dcos_commons_tpu.plan.coordinator import DefaultPlanCoordinator
@@ -126,7 +126,28 @@ class DefaultScheduler:
         self._suppressed = False
         self._fatal_error: Optional[str] = None
         self._stop = threading.Event()
+        # event-driven wake-up (offer-cycle fast path): status arrival
+        # and HTTP mutations set this, so run_forever cycles at event
+        # speed and the interval is only a fallback heartbeat
+        self._wake = threading.Event()
         self._lock = threading.RLock()
+        # snapshot-cache observability, surfaced through the existing
+        # /v1 metrics routes (gauges ride the Metrics snapshot)
+        self.metrics.gauge(
+            "offers.snapshot_cache.hit",
+            lambda: float(getattr(inventory, "cache_hits", 0)),
+        )
+        self.metrics.gauge(
+            "offers.snapshot_cache.miss",
+            lambda: float(getattr(inventory, "cache_misses", 0)),
+        )
+        self.evaluator.metrics = self.metrics
+        # agents that learn of statuses asynchronously (readiness
+        # monitors, test fixtures) nudge the loop instead of waiting
+        # out the heartbeat
+        add_listener = getattr(agent, "add_status_listener", None)
+        if callable(add_listener):
+            add_listener(self.nudge)
 
     # -- the loop -----------------------------------------------------
 
@@ -161,16 +182,26 @@ class DefaultScheduler:
         self,
         interval_s: float = 0.5,
         max_consecutive_failures: int = 5,
+        busy_poll_s: float = 0.05,
     ) -> threading.Thread:
         """A transient cycle failure is logged and retried; after
         ``max_consecutive_failures`` in a row the loop declares itself
         wedged, records ``fatal_error`` and stops, so the serving
         process can exit and be restarted by its supervisor (reference:
         deliberate crash-to-restart on deadlock, SchedulerConfig.java
-        DISABLE_DEADLOCK_EXIT semantics — exit is the default)."""
+        DISABLE_DEADLOCK_EXIT semantics — exit is the default).
+
+        The wait between cycles is event-driven: ``nudge()`` (status
+        arrival, HTTP mutations) wakes the loop immediately, and while
+        launched work awaits its statuses the wait shortens to
+        ``busy_poll_s`` (poll-only agents surface transitions only
+        inside a cycle).  ``interval_s`` is the idle fallback
+        heartbeat, so an N-step deploy no longer pays N x interval_s
+        of pure sleep."""
         def loop():
             failures = 0
             while not self._stop.is_set():
+                self._wake.clear()
                 try:
                     self.run_cycle()
                     failures = 0
@@ -188,11 +219,31 @@ class DefaultScheduler:
                         )
                         self._stop.set()
                         break
-                self._stop.wait(interval_s)
+                timeout = interval_s
+                if self._work_in_flight():
+                    timeout = min(interval_s, busy_poll_s)
+                with self.metrics.time("cycle.wait"):
+                    self._wake.wait(timeout)
 
         thread = threading.Thread(target=loop, name="scheduler-loop", daemon=True)
         thread.start()
         return thread
+
+    def nudge(self) -> None:
+        """Wake run_forever for an immediate cycle (status arrival,
+        plan work made pending, HTTP mutation).  Safe from any thread;
+        a nudge during a cycle makes the next wait return at once."""
+        self.metrics.incr("cycle.nudges")
+        self._wake.set()
+
+    def _work_in_flight(self) -> bool:
+        """True while any plan step holds launched-but-unconfirmed
+        work (PREPARED/STARTING) — the statuses that complete it are
+        only observable by polling the agent inside a cycle."""
+        return any(
+            manager.in_progress_assets()
+            for manager in self.coordinator.plan_managers
+        )
 
     @property
     def fatal_error(self) -> Optional[str]:
@@ -202,6 +253,7 @@ class DefaultScheduler:
 
     def stop(self) -> None:
         self._stop.set()
+        self._wake.set()  # release a loop parked in its fallback wait
 
     # -- status intake ------------------------------------------------
 
@@ -254,10 +306,16 @@ class DefaultScheduler:
                 return
             self._suppressed = False
             self.metrics.incr("revives")
+        # one shared evaluation context for the whole cycle: the task
+        # scan and hosts dict are computed once, not once per step
+        context = EvaluationContext(self.state_store, self.inventory)
         for step in candidates:
             if isinstance(step, ActionStep):
                 # scheduler-side work (decommission/uninstall/custom)
                 step.execute(self)
+                # it may have killed/erased tasks: the shared context
+                # must not serve the pre-action scan to later steps
+                context.invalidate_tasks()
                 continue
             if not isinstance(step, DeploymentStep):
                 continue
@@ -267,7 +325,10 @@ class DefaultScheduler:
             if not allow_footprint_growth and \
                     not self._has_full_footprint(requirement):
                 continue  # needs new reservations: wait for selection
-            result = self.evaluator.evaluate(requirement, self.inventory)
+            with self.metrics.time("cycle.evaluate"):
+                result = self.evaluator.evaluate(
+                    requirement, self.inventory, context
+                )
             self.outcome_tracker.record(requirement.name, result.outcome)
             self.metrics.incr("offers.evaluated")
             if not result.passed:
@@ -279,6 +340,7 @@ class DefaultScheduler:
             # BEFORE the agent sees a launch (DefaultScheduler.java:454)
             self.ledger.commit(result.reservations)
             self.launch_recorder.record(result.task_infos)
+            context.note_launched(result.task_infos)
             for info in result.task_infos:
                 override, progress = self.state_store.fetch_goal_override(
                     info.name
@@ -526,6 +588,7 @@ class DefaultScheduler:
                         info.task_id, task_spec.kill_grace_period_s
                     )
                     killed.append(full)
+            self.nudge()  # recovery work just became pending
             return killed
 
     def pause_pod(
@@ -582,6 +645,7 @@ class DefaultScheduler:
                         self.task_killer.kill(
                             info.task_id, task_spec.kill_grace_period_s
                         )
+            self.nudge()  # override relaunch work just became pending
             return touched
 
     def plans(self) -> Dict[str, Plan]:

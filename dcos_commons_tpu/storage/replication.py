@@ -228,11 +228,15 @@ class ReplicationLog:
             self._attached(now)  # prune the silent
             st = self._pullers.get(puller_id)
             if st is None:
-                # fresh attach: no ack history — it earns the barrier
-                # from zero (lagging=False: a standby resuming from its
-                # durable applied-seq proves continuity on this very
-                # pull, or gets marked lagging below)
-                st = {"acked": 0, "last_pull": now, "lagging": False}
+                # fresh attach (new standby, or one returning after a
+                # silence prune): LAGGING until its ack reaches the tip
+                # — otherwise a newcomer whose from_seq still proves
+                # continuity (young primary, log replay from 1) joins
+                # the bounded-sync barrier at acked 0 and every live
+                # write stalls up to sync_timeout_s while it replays.
+                # The standard lagging-clear below flips it in-sync the
+                # moment it catches up (same pull, if already at tip).
+                st = {"acked": 0, "last_pull": now, "lagging": True}
                 self._pullers[puller_id] = st
             st["last_pull"] = now
             if stream_id and stream_id != self.stream_id:
@@ -269,8 +273,11 @@ class ReplicationLog:
                 # the puller moved BACKWARDS (a standby with a stable
                 # id restarted after wiping its tree): its old
                 # watermark no longer describes that tree — drop to
-                # what this pull actually proves and re-earn the rest
+                # what this pull actually proves, re-earn the rest,
+                # and leave the barrier while replaying (same rule as
+                # a fresh attach: bootstrap never gates live writes)
                 st["acked"] = max(ack, 0)
+                st["lagging"] = True
             elif ack > st["acked"]:
                 st["acked"] = ack
             if st["lagging"] and st["acked"] >= self._next_seq - 1:

@@ -96,11 +96,25 @@ class FakeAgent:
     def send(self, status: TaskStatus) -> None:
         """Queue a status for the scheduler's next poll; terminal
         statuses also remove the task from the active set (the process
-        is gone)."""
+        is gone).  Registered status listeners are notified so an
+        event-driven scheduler loop wakes immediately."""
         with self._lock:
             self._queue.append(status)
             if status.state.is_terminal:
                 self._active.pop(status.task_id, None)
+            listeners = list(getattr(self, "_status_listeners", []))
+        for listener in listeners:
+            try:
+                listener()
+            except Exception:
+                pass
+
+    def add_status_listener(self, listener) -> None:
+        """Event-driven wake hook (same contract as Agent's)."""
+        with self._lock:
+            if not hasattr(self, "_status_listeners"):
+                self._status_listeners = []
+            self._status_listeners.append(listener)
 
     def task_id_of(self, task_name: str) -> Optional[str]:
         """Most recent launched task id for a task full-name."""

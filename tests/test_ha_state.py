@@ -114,36 +114,48 @@ def test_standby_rejects_kv_and_clients_rotate():
 
 
 def test_bounded_sync_log_semantics():
-    """No standby -> writes don't block; a stalled standby is marked
+    """No standby -> writes don't block; a FRESH attach is lagging
+    until its ack first reaches the tip (bootstrap replay never gates
+    live writes, advisor r5); an in-sync standby that stalls is marked
     lagging after the sync timeout; catching up clears it."""
     log = ReplicationLog(sync_timeout_s=0.2)
     seq = log.append([{"op": "set", "path": "/a", "value": ""}])
     t0 = time.monotonic()
     assert log.wait_replicated(seq) is False  # nobody attached: no wait
     assert time.monotonic() - t0 < 0.1
-    # a standby attaches by pulling
+    # a standby attaches by pulling: lagging (excluded from the
+    # barrier) until it proves it reached the tip
     out = log.pull(from_seq=1, wait_s=0)
     assert [e["seq"] for e in out["entries"]] == [1]
+    assert log.status()["standby_lagging"] is True
     seq2 = log.append([{"op": "set", "path": "/b", "value": ""}])
-    # attached but not acking: blocks for the timeout, then lagging
+    # attached mid-bootstrap: writes do NOT block on its replay
     t0 = time.monotonic()
     assert log.wait_replicated(seq2) is False
-    assert 0.15 <= time.monotonic() - t0 < 1.0
-    assert log.status()["standby_lagging"] is True
-    # lagging: subsequent writes do NOT block
+    assert time.monotonic() - t0 < 0.1
+    # catch-up (pull acking the tip) earns the barrier
+    log.pull(from_seq=seq2 + 1, wait_s=0)
+    assert log.status()["standby_lagging"] is False
+    # in-sync but not acking: blocks for the timeout, then lagging
     seq3 = log.append([{"op": "set", "path": "/c", "value": ""}])
     t0 = time.monotonic()
     assert log.wait_replicated(seq3) is False
+    assert 0.15 <= time.monotonic() - t0 < 1.0
+    assert log.status()["standby_lagging"] is True
+    # lagging: subsequent writes do NOT block
+    seq4 = log.append([{"op": "set", "path": "/d", "value": ""}])
+    t0 = time.monotonic()
+    assert log.wait_replicated(seq4) is False
     assert time.monotonic() - t0 < 0.1
     # catch-up (pull acking the tip) clears the flag
-    log.pull(from_seq=seq3 + 1, wait_s=0)
+    log.pull(from_seq=seq4 + 1, wait_s=0)
     assert log.status()["standby_lagging"] is False
-    seq4 = log.append([{"op": "set", "path": "/d", "value": ""}])
+    seq5 = log.append([{"op": "set", "path": "/e", "value": ""}])
     # acked promptly -> wait_replicated returns True
     import threading
 
-    threading.Timer(0.05, lambda: log.pull(seq4 + 1, 0)).start()
-    assert log.wait_replicated(seq4) is True
+    threading.Timer(0.05, lambda: log.pull(seq5 + 1, 0)).start()
+    assert log.wait_replicated(seq5) is True
 
 
 def test_ring_trim_and_fresh_primary_force_resnapshot():
@@ -369,15 +381,24 @@ def test_per_puller_watermarks_never_cross():
     out = log.pull(from_seq=1, wait_s=0, puller_id="standby-b")
     assert [e["seq"] for e in out["entries"]] == [1]
     assert log.status()["standby_count"] == 2
-    # only A acks seq 1: the barrier watermark stays at B's 0
+    # both fresh attaches are lagging (bootstrap, advisor r5): neither
+    # has earned the barrier, so neither gates writes yet
+    assert log.status()["standbys"]["standby-a"]["lagging"] is True
+    assert log.status()["standbys"]["standby-b"]["lagging"] is True
+    # A acks seq 1 (the tip) and earns the barrier; the conservative
+    # watermark (min over EVERY attached standby) stays at B's 0
     log.pull(from_seq=2, wait_s=0, puller_id="standby-a")
     assert log.status()["acked_seq"] == 0
     assert log.status()["standbys"]["standby-a"]["acked"] == 1
+    assert log.status()["standbys"]["standby-a"]["lagging"] is False
+    # B acks the tip too: in-sync, the barrier now includes it
+    log.pull(from_seq=2, wait_s=0, puller_id="standby-b")
+    assert log.status()["standbys"]["standby-b"]["lagging"] is False
     seq = log.append([{"op": "set", "path": "/b", "value": ""}])
-    # a acks BEFORE the barrier; b never does: the barrier still
-    # fails — an any-of ack would lose this write if b were promoted —
-    # and ONLY the straggler is marked lagging (deterministic: no
-    # timer races the sync timeout)
+    # a acks BEFORE the barrier; b (in-sync) never does: the barrier
+    # still fails — an any-of ack would lose this write if b were
+    # promoted — and ONLY the straggler is marked lagging
+    # (deterministic: no timer races the sync timeout)
     log.pull(from_seq=seq + 1, wait_s=0, puller_id="standby-a")
     assert log.wait_replicated(seq) is False
     assert log.status()["standbys"]["standby-a"]["lagging"] is False
@@ -392,16 +413,19 @@ def test_per_puller_watermarks_never_cross():
     assert log.status()["acked_seq"] == seq2
     # a RESTARTED standby with a STABLE id that wiped its tree pulls
     # from seq 1 again: its old watermark must drop — promoting it
-    # mid-catch-up must not count old acks (review r5)
+    # mid-catch-up must not count old acks (review r5) — and it leaves
+    # the barrier while replaying (its replay must not stall writes)
     log.pull(from_seq=1, wait_s=0, puller_id="standby-a")
     assert log.status()["standbys"]["standby-a"]["acked"] == 0
+    assert log.status()["standbys"]["standby-a"]["lagging"] is True
     # a dies: pruned after the attach window, b alone gates the barrier
     log._pullers["standby-a"]["last_pull"] -= ATTACH_WINDOW_S + 1.0
     assert log.status()["standby_count"] == 1
     # a RETURNING puller restarts at acked 0 (its tree may have been
-    # wiped since): it re-earns the barrier by pulling
+    # wiped since) and lagging: it re-earns the barrier by pulling
     log.pull(from_seq=1, wait_s=0, puller_id="standby-a")
     assert log.status()["standbys"]["standby-a"]["acked"] == 0
+    assert log.status()["standbys"]["standby-a"]["lagging"] is True
 
 
 @pytest.mark.slow

@@ -1,0 +1,315 @@
+"""Offer-cycle fast path tests (ISSUE 1 tentpole).
+
+Three properties are load-bearing:
+
+1. snapshot-cache EQUIVALENCE: cached ``SliceInventory.snapshots``
+   must be bit-identical to a from-scratch rebuild under randomized
+   reservation commit/GC/host up-down interleavings — the cache is a
+   pure memo, never a semantic change.
+2. event-driven scheduling: a multi-step deploy completes in well
+   under ``steps x interval_s`` when statuses nudge the loop, with the
+   interval demoted to a fallback heartbeat.
+3. cycle observability: the new timer aggregates and cache counters
+   surface through the existing metrics snapshot.
+"""
+
+import random
+import threading
+import time
+
+from dcos_commons_tpu.common import TaskState, TaskStatus
+from dcos_commons_tpu.metrics.registry import Metrics
+from dcos_commons_tpu.offer import (
+    Reservation,
+    ReservationLedger,
+    SliceInventory,
+    TpuHost,
+)
+from dcos_commons_tpu.offer.inventory import make_test_fleet
+from dcos_commons_tpu.offer.ledger import new_reservation_id
+from dcos_commons_tpu.scheduler import SchedulerBuilder, SchedulerConfig
+from dcos_commons_tpu.specification import from_yaml
+from dcos_commons_tpu.storage import MemPersister
+from dcos_commons_tpu.testing import FakeAgent
+
+# -- snapshot cache ---------------------------------------------------
+
+
+def canonical(snapshots):
+    """Order-insensitive, content-complete form of a snapshot list."""
+    return sorted(
+        (
+            s.host.host_id,
+            round(s.cpus, 9),
+            s.memory_mb,
+            s.disk_mb,
+            tuple(sorted(s.free_chips)),
+            tuple(sorted(s.used_ports)),
+        )
+        for s in snapshots
+    )
+
+
+def random_reservation(rng, hosts):
+    host = rng.choice(hosts)
+    chips = host.chip_ids()
+    return Reservation(
+        reservation_id=new_reservation_id(),
+        host_id=host.host_id,
+        task_name=f"pod-{rng.randrange(64)}-server",
+        cpus=rng.choice([0.5, 1.0, 2.0]),
+        memory_mb=rng.choice([256, 1024]),
+        disk_mb=rng.choice([0, 512]),
+        chip_ids=rng.sample(chips, rng.randrange(len(chips) + 1)) if chips else [],
+        ports=rng.sample(range(10000, 10050), rng.randrange(3)),
+    )
+
+
+def test_snapshot_cache_equivalence_randomized():
+    """Cached vs from-scratch snapshots stay identical across 400
+    randomized ledger/host mutations (the tentpole correctness bar)."""
+    rng = random.Random(20260803)
+    hosts = make_test_fleet(host_grid=(4, 2), chip_block=(2, 2))
+    hosts += [TpuHost(host_id=f"cpu-{i}") for i in range(4)]
+    ledger = ReservationLedger(MemPersister())
+    inv = SliceInventory(hosts)  # cached across the whole interleaving
+    down = set()
+
+    for step in range(400):
+        op = rng.random()
+        if op < 0.45:
+            ledger.commit([
+                random_reservation(rng, hosts)
+                for _ in range(rng.randrange(1, 4))
+            ])
+        elif op < 0.75:
+            live = ledger.all()
+            if live:
+                ledger.release(rng.choice(live).reservation_id)
+        elif op < 0.9:
+            host = rng.choice(hosts)
+            inv.mark_down(host.host_id)
+            down.add(host.host_id)
+        else:
+            if down:
+                host_id = down.pop()
+                inv.mark_up(host_id)
+        # a fresh inventory has an empty cache: its snapshots are the
+        # from-scratch oracle for the SAME hosts/down-set/ledger
+        oracle = SliceInventory(hosts)
+        for host_id in down:
+            oracle.mark_down(host_id)
+        assert canonical(inv.snapshots(ledger)) == canonical(
+            oracle.snapshots(ledger)
+        ), f"cached snapshots diverged at step {step}"
+    assert inv.cache_hits > 0  # the interleaving actually exercised reuse
+
+
+def test_snapshot_cache_hits_when_ledger_quiet():
+    ledger = ReservationLedger(MemPersister())
+    inv = SliceInventory(make_test_fleet(host_grid=(2, 2)))
+    inv.snapshots(ledger)
+    assert inv.cache_misses == 4 and inv.cache_hits == 0
+    inv.snapshots(ledger)
+    assert inv.cache_hits == 4
+    # a commit dirties exactly the touched host
+    fleet_host = inv.hosts()[0]
+    ledger.commit([
+        Reservation(
+            reservation_id=new_reservation_id(),
+            host_id=fleet_host.host_id,
+            task_name="t-0-x",
+            cpus=1.0,
+        )
+    ])
+    inv.snapshots(ledger)
+    assert inv.cache_misses == 5  # one rebuild, three reuses
+    assert inv.cache_hits == 7
+
+
+def test_snapshot_cache_returns_copies():
+    """Callers may mutate returned snapshots freely — the cached
+    master must not be poisoned."""
+    ledger = ReservationLedger(MemPersister())
+    inv = SliceInventory(make_test_fleet(host_grid=(1, 1)))
+    first = inv.snapshots(ledger)[0]
+    first.try_consume_scalar(10.0, 1000, 0)
+    first.free_chips.clear()
+    first.allocate_port()
+    again = inv.snapshots(ledger)[0]
+    assert again.cpus == 16.0
+    assert len(again.free_chips) == 4
+    assert not again.used_ports
+
+
+def test_chip_ids_memoized_and_stable():
+    host = make_test_fleet(host_grid=(2, 2), chip_block=(2, 2))[3]
+    first = host.chip_ids()
+    assert first == ["pod-0/2,2", "pod-0/3,2", "pod-0/2,3", "pod-0/3,3"]
+    first.append("tampered")  # callers get an independent list
+    assert host.chip_ids() == ["pod-0/2,2", "pod-0/3,2", "pod-0/2,3",
+                               "pod-0/3,3"]
+
+
+def test_ledger_generation_tracking():
+    ledger = ReservationLedger(MemPersister())
+    assert ledger.host_generation("h1") == 0
+    r = Reservation(
+        reservation_id=new_reservation_id(), host_id="h1",
+        task_name="p-0-t", cpus=1.0,
+    )
+    ledger.commit([r])
+    g1 = ledger.host_generation("h1")
+    assert g1 > 0 and ledger.host_generation("h2") == 0
+    ledger.release(r.reservation_id)
+    assert ledger.host_generation("h1") > g1
+    assert ledger.reserved_on("h1") == []
+    assert ledger.for_task("p-0-t") == []
+
+
+# -- event-driven scheduling ------------------------------------------
+
+SERIAL_YAML = """
+name: steps
+pods:
+  app:
+    count: 3
+    tasks:
+      server:
+        goal: RUNNING
+        cmd: sleep 1000
+        cpus: 1.0
+        memory: 256
+plans:
+  deploy:
+    strategy: serial
+    phases:
+      app:
+        strategy: serial
+        pod: app
+"""
+
+
+def _build_serial_scheduler():
+    builder = SchedulerBuilder(
+        from_yaml(SERIAL_YAML),
+        SchedulerConfig(backoff_enabled=False),
+        MemPersister(),
+    )
+    builder.set_inventory(SliceInventory(
+        [TpuHost(host_id=f"h{i}") for i in range(3)]
+    ))
+    agent = FakeAgent()
+    builder.set_agent(agent)
+    return builder.build(), agent
+
+
+def test_event_driven_wake_beats_fallback_interval():
+    """A 3-step serial plan with a 5 s fallback heartbeat completes in
+    well under 3 x 5 s because status arrival nudges the loop: the
+    interval is a heartbeat, not a pace."""
+    scheduler, agent = _build_serial_scheduler()
+    interval_s = 5.0
+    acked = set()
+    stop = threading.Event()
+
+    def responder():
+        while not stop.is_set():
+            for info in list(agent.launched):
+                if info.task_id not in acked:
+                    acked.add(info.task_id)
+                    agent.send(TaskStatus(
+                        task_id=info.task_id, state=TaskState.RUNNING,
+                        ready=True, agent_id=info.agent_id,
+                    ))
+            time.sleep(0.005)
+
+    responder_thread = threading.Thread(target=responder, daemon=True)
+    responder_thread.start()
+    t0 = time.monotonic()
+    loop_thread = scheduler.run_forever(interval_s=interval_s)
+    try:
+        deadline = t0 + 10.0
+        while time.monotonic() < deadline and \
+                not scheduler.deploy_manager.get_plan().is_complete:
+            time.sleep(0.01)
+        elapsed = time.monotonic() - t0
+        assert scheduler.deploy_manager.get_plan().is_complete, \
+            "serial deploy did not complete"
+        # << 3 x 5 s; generous bound for slow CI boxes
+        assert elapsed < interval_s, (
+            f"3-step plan took {elapsed:.2f}s — the loop slept through "
+            "its fallback interval instead of waking on events"
+        )
+        assert scheduler.metrics.counters().get("cycle.nudges", 0) > 0
+    finally:
+        stop.set()
+        scheduler.stop()
+        loop_thread.join(timeout=2)
+        responder_thread.join(timeout=2)
+
+
+def test_nudge_wakes_idle_loop():
+    """An idle (suppressed) loop parked in a long fallback wait runs a
+    cycle promptly after nudge() — the HTTP-mutation wake path."""
+    scheduler, agent = _build_serial_scheduler()
+    # complete the deploy synchronously first
+    for _ in range(6):
+        scheduler.run_cycle()
+        for info in list(agent.launched):
+            agent.send(TaskStatus(
+                task_id=info.task_id, state=TaskState.RUNNING, ready=True,
+                agent_id=info.agent_id,
+            ))
+    scheduler.run_cycle()
+    assert scheduler.deploy_manager.get_plan().is_complete
+    baseline = scheduler.metrics.counters().get("task_status.TASK_KILLED", 0)
+    loop_thread = scheduler.run_forever(interval_s=30.0)
+    try:
+        time.sleep(0.2)  # the loop is now parked in its 30 s wait
+        t0 = time.monotonic()
+        scheduler.restart_pod("app", 0)  # kills + nudges
+        while time.monotonic() - t0 < 5.0:
+            if scheduler.metrics.counters().get(
+                "task_status.TASK_KILLED", 0
+            ) > baseline:
+                break
+            time.sleep(0.01)
+        waited = time.monotonic() - t0
+        assert waited < 5.0, "nudge did not wake the parked loop"
+    finally:
+        scheduler.stop()
+        loop_thread.join(timeout=2)
+
+
+# -- metrics aggregation ----------------------------------------------
+
+
+def test_timer_aggregates_min_mean_max_p95():
+    metrics = Metrics()
+    with metrics.time("cycle.process"):
+        pass
+    # deterministic samples through the same ring buffer the context
+    # manager feeds
+    with metrics._lock:
+        metrics._timers["cycle.process"] = [0.010, 0.020, 0.030, 0.040]
+    snap = metrics.snapshot()
+    assert snap["cycle.process.count"] == 4.0
+    assert abs(snap["cycle.process.min_s"] - 0.010) < 1e-9
+    assert abs(snap["cycle.process.mean_s"] - 0.025) < 1e-9
+    assert snap["cycle.process.avg_s"] == snap["cycle.process.mean_s"]
+    assert abs(snap["cycle.process.max_s"] - 0.040) < 1e-9
+    # nearest-rank p95 of 4 samples = the max
+    assert abs(snap["cycle.process.p95_s"] - 0.040) < 1e-9
+
+
+def test_cycle_metrics_surface_in_snapshot():
+    scheduler, agent = _build_serial_scheduler()
+    scheduler.run_cycle()
+    snap = scheduler.metrics.snapshot()
+    assert "offers.snapshot_cache.hit" in snap
+    assert "offers.snapshot_cache.miss" in snap
+    assert snap["offers.snapshot_cache.miss"] > 0
+    assert "cycle.process.p95_s" in snap
+    assert "cycle.evaluate.mean_s" in snap
+    assert "cycle.snapshot.mean_s" in snap

@@ -11,7 +11,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
+from dcos_commons_tpu.parallel.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from dcos_commons_tpu.models import (

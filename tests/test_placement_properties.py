@@ -7,7 +7,12 @@ provide — under arrangements unit tests don't enumerate.
 
 import math
 
-from hypothesis import given, settings, strategies as st
+import pytest
+
+pytest.importorskip(
+    "hypothesis", reason="property tests need the hypothesis package"
+)
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from dcos_commons_tpu.common import TaskInfo
 from dcos_commons_tpu.offer.inventory import ResourceSnapshot, TpuHost

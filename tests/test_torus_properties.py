@@ -6,7 +6,12 @@ The torus search is the scheduler's hardest pure logic (VERDICT round
 hypothesis drives it through shapes unit tests won't think of.
 """
 
-from hypothesis import given, settings, strategies as st
+import pytest
+
+pytest.importorskip(
+    "hypothesis", reason="property tests need the hypothesis package"
+)
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from dcos_commons_tpu.offer.inventory import (
     ResourceSnapshot,

@@ -14,7 +14,7 @@ import numpy as np
 import optax
 import pytest
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+from dcos_commons_tpu.parallel.compat import shard_map
 
 from dcos_commons_tpu.models import (
     MlpConfig,
