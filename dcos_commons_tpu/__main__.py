@@ -4,6 +4,7 @@
     python -m dcos_commons_tpu agent --host-id h0 --workdir ./sandbox
     python -m dcos_commons_tpu cli  <verb> ...
     python -m dcos_commons_tpu state-server --data-dir ./cluster-state
+    python -m dcos_commons_tpu analyze --all      # sdklint static analysis
 
 Reference: the pair of process mains the reference ships — the
 scheduler process (SchedulerRunner.java:82 via each framework's
@@ -46,9 +47,16 @@ def main(argv=None) -> int:
         from dcos_commons_tpu.security.auth import certs_main
 
         return certs_main(rest)
+    if command in ("analyze", "lint"):
+        # sdklint: framework lint + spec analyzer (same entry point as
+        # `python -m dcos_commons_tpu.analysis`)
+        from dcos_commons_tpu.analysis.__main__ import main as analysis_main
+
+        return analysis_main(rest)
     print(
         f"unknown command {command!r}; "
-        "try serve | agent | cli | state-server | package | certs",
+        "try serve | agent | cli | state-server | package | certs "
+        "| analyze",
         file=sys.stderr,
     )
     return 1

@@ -57,5 +57,5 @@ class Agent:
         for listener in getattr(self, "_status_listeners", []):
             try:
                 listener()
-            except Exception:  # a broken listener must not break intake
+            except Exception:  # sdklint: disable=swallowed-exception — a broken listener must not break intake
                 pass

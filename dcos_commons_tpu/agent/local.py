@@ -380,9 +380,10 @@ class LocalProcessAgent:
 
             task_exec_path()
         os.makedirs(workdir, exist_ok=True)
-        self._recover_tasks()
+        with self._lock:
+            self._recover_tasks_locked()
 
-    def _recover_tasks(self) -> None:
+    def _recover_tasks_locked(self) -> None:
         """Rebuild task state from sandbox records after an agent
         restart: the C++ supervisor persisted task.json at launch and
         exit_status at exit, so a daemon crash loses no task fates.
@@ -964,12 +965,17 @@ class LocalProcessAgent:
                         self._force_kill(running)
                 elif running.pid:
                     # recovered task: give the supervisor a moment to
-                    # run its grace escalation, then force
+                    # run its grace escalation, then force.  Polling is
+                    # correct here: the pid is a FOREIGN process
+                    # (adopted across an agent restart, not our child),
+                    # so there is no waitable handle — kill(pid, 0) is
+                    # the only portable liveness probe, and this runs
+                    # once at shutdown, never in the offer/status path.
                     deadline = time.monotonic() + 5
                     while time.monotonic() < deadline and _pid_alive(
                         running.pid
                     ):
-                        time.sleep(0.05)
+                        time.sleep(0.05)  # sdklint: disable=no-blocking-sleep — see above: no child handle to wait on
                     if _pid_alive(running.pid):
                         self._force_kill(running)
             self._tasks.clear()

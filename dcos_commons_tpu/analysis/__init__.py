@@ -1,0 +1,34 @@
+"""Static analysis: the build-gate tooling the reference runs first.
+
+Reference: the root build gates every module on checkstyle/findbugs
+before a single test runs (build.gradle's lint plugins — see
+tests/test_build_gate.py), and DefaultConfigurationUpdater runs 19
+config validators before a target config may go live.  This package
+is the code-level analogue for OUR invariants, three halves behind
+one CLI (``python -m dcos_commons_tpu.analysis``):
+
+- **Framework lint** (`linter`, `rules`, `baseline`): AST rules over
+  the whole package — event-loop discipline (no ``time.sleep`` in
+  scheduler hot paths), ledger/inventory generation-bump discipline,
+  lock discipline, resource vocabulary (no ``gpus``), exception
+  swallowing, and JAX tracer safety.  Violations are suppressible
+  in-line (``# sdklint: disable=<rule>``) and pre-existing debt is
+  tracked in a repo-level baseline file instead of hidden.
+- **Lock-order checker** (`lockcheck`): an opt-in instrumented lock
+  wrapper that records per-thread acquisition stacks at runtime,
+  builds the lock-order graph, and reports cycles (deadlock risk)
+  and cross-thread unguarded attribute writes.
+- **Spec analyzer** (`speccheck`): a dry-run pass over every
+  ``frameworks/*/svc*.yml`` + ``options.json`` that reports
+  deploy-time failures at lint time — config-validator errors,
+  unsatisfiable placement against the declared torus, conflicting
+  ports, plan dependency cycles, and per-host resource overcommit.
+"""
+
+from dcos_commons_tpu.analysis.linter import (  # noqa: F401
+    Finding,
+    LintContext,
+    lint_paths,
+    lint_tree,
+)
+from dcos_commons_tpu.analysis.rules import all_rules  # noqa: F401

@@ -1,0 +1,112 @@
+"""sdklint CLI: ``python -m dcos_commons_tpu.analysis``.
+
+    --lint              framework lint (AST rules + baseline)
+    --specs             ahead-of-time spec analyzer (frameworks/*)
+    --all               both (the CI gate; default when no mode given)
+    --update-baseline   rewrite the baseline from current lint findings
+    --catalog           print the rule catalog and exit
+    --root DIR          repo root (default: auto-detect from this file)
+
+Exit code 0 = no non-baselined findings; 1 = findings; 2 = bad usage.
+The gate test (tests/test_lint_gate.py) runs the same entry points
+in-process.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List
+
+
+def _default_root() -> str:
+    """The repo root: the directory holding the ``dcos_commons_tpu``
+    package this module was imported from."""
+    package_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.dirname(package_dir)
+
+
+def main(argv: List[str] = None) -> int:
+    from dcos_commons_tpu.analysis import baseline as baseline_mod
+    from dcos_commons_tpu.analysis import speccheck
+    from dcos_commons_tpu.analysis.linter import lint_tree
+    from dcos_commons_tpu.analysis.rules import rule_catalog
+
+    parser = argparse.ArgumentParser(
+        prog="python -m dcos_commons_tpu.analysis",
+        description=__doc__.splitlines()[0],
+    )
+    parser.add_argument("--lint", action="store_true")
+    parser.add_argument("--specs", action="store_true")
+    parser.add_argument("--all", action="store_true")
+    parser.add_argument("--update-baseline", action="store_true")
+    parser.add_argument("--catalog", action="store_true")
+    parser.add_argument("--root", default=_default_root())
+    parser.add_argument("--baseline", default="")
+    parser.add_argument("--host-cpus", type=float, default=8.0)
+    parser.add_argument("--host-mem", type=int, default=16384)
+    parser.add_argument("--host-disk", type=int, default=102400)
+    parser.add_argument(
+        "--verbose", "-v", action="store_true",
+        help="also list suppressed and baselined findings",
+    )
+    args = parser.parse_args(argv)
+
+    if args.catalog:
+        print(rule_catalog())
+        return 0
+
+    run_lint = args.lint or args.all or not (args.lint or args.specs)
+    run_specs = args.specs or args.all or not (args.lint or args.specs)
+    root = os.path.abspath(args.root)
+    baseline_path = args.baseline or baseline_mod.baseline_path(root)
+    failed = False
+
+    if run_lint:
+        result = lint_tree(root)
+        if args.update_baseline:
+            counts = baseline_mod.save_baseline(
+                baseline_path, result.findings
+            )
+            print(
+                f"baseline: {sum(counts.values())} finding(s) across "
+                f"{len(counts)} file/rule pair(s) -> {baseline_path}"
+            )
+            fresh, absorbed = [], result.findings
+        else:
+            known = baseline_mod.load_baseline(baseline_path)
+            fresh, absorbed = baseline_mod.apply_baseline(
+                result.findings, known
+            )
+        for finding in fresh:
+            print(finding.render())
+        if args.verbose:
+            for finding in absorbed:
+                print(f"{finding.render()}  [baselined]")
+            for finding in result.suppressed:
+                print(f"{finding.render()}  [suppressed]")
+        print(
+            f"lint: {result.files_checked} files, "
+            f"{len(fresh)} new finding(s), {len(absorbed)} baselined, "
+            f"{len(result.suppressed)} suppressed"
+        )
+        failed |= bool(fresh)
+
+    if run_specs:
+        host_model = speccheck.HostModel(
+            cpus=args.host_cpus,
+            memory_mb=args.host_mem,
+            disk_mb=args.host_disk,
+        )
+        findings = speccheck.analyze_all(root, host_model)
+        for finding in findings:
+            print(finding.render())
+        print(f"specs: {len(findings)} finding(s)")
+        failed |= bool(findings)
+
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,526 @@
+# sdklint: disable-file=no-gpus-resource — the rule definitions below
+# necessarily name the banned token to detect it
+"""The sdklint rule catalog.
+
+Each rule is a class with an ``id`` (the suppression token), a
+docstring (rendered by ``--catalog``), ``applies_to`` (path scoping)
+and ``check`` (AST pass -> findings).  Rules encode invariants this
+codebase actually relies on — the PR-1 offer-cycle fast path's
+generation stamps and event-driven loop, the BASELINE resource
+vocabulary, and the lock discipline the runtime's 20+ ``_lock``
+owners promise — not generic style nits (those live in the build
+gate, tests/test_build_gate.py).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Set, Tuple
+
+from dcos_commons_tpu.analysis.linter import Finding, LintContext
+
+_MUTATOR_METHODS = {
+    "append", "add", "extend", "insert", "pop", "popitem", "clear",
+    "update", "setdefault", "discard", "remove", "appendleft",
+}
+
+
+def _self_attr_writes(node: ast.AST) -> Iterator[Tuple[str, ast.AST]]:
+    """Yield (attr_name, node) for every write/mutation of a ``self``
+    attribute inside ``node``: plain/aug/ann assignment, subscript
+    stores and deletes (``self.x[k] = v``), and calls to mutating
+    container methods (``self.x.pop(...)``)."""
+    for sub in ast.walk(node):
+        targets: List[ast.AST] = []
+        if isinstance(sub, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            # copy: the tuple-unpacking expansion below appends to this
+            # list, which must never mutate the AST node itself
+            targets = (
+                list(sub.targets) if isinstance(sub, ast.Assign)
+                else [sub.target]
+            )
+        elif isinstance(sub, ast.Delete):
+            targets = list(sub.targets)
+        for target in targets:
+            if isinstance(target, ast.Tuple):
+                targets.extend(target.elts)
+                continue
+            base = target
+            if isinstance(base, ast.Subscript):
+                base = base.value
+            if (
+                isinstance(base, ast.Attribute)
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "self"
+            ):
+                yield base.attr, sub
+        if (
+            isinstance(sub, ast.Call)
+            and isinstance(sub.func, ast.Attribute)
+            and sub.func.attr in _MUTATOR_METHODS
+        ):
+            owner = sub.func.value
+            if (
+                isinstance(owner, ast.Attribute)
+                and isinstance(owner.value, ast.Name)
+                and owner.value.id == "self"
+            ):
+                yield owner.attr, sub
+
+
+def _is_self_attr(node: ast.AST, names: Set[str]) -> bool:
+    return (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+        and node.attr in names
+    )
+
+
+class Rule:
+    id = ""
+    description = ""
+
+    def applies_to(self, ctx: LintContext) -> bool:
+        return ctx.tree is not None
+
+    def check(self, ctx: LintContext) -> List[Finding]:
+        raise NotImplementedError
+
+
+class NoBlockingSleepRule(Rule):
+    """``time.sleep`` in library code busy-waits what the event-driven
+    scheduler loop already signals: status arrival and HTTP mutations
+    ``nudge()`` the loop awake (scheduler/scheduler.py:232), so hot
+    paths must park on ``Event.wait``/``Condition.wait`` instead of
+    sleeping.  Scope: all of ``dcos_commons_tpu/`` except ``testing/``
+    (tick harnesses legitimately pace fake time).  Polling a resource
+    no event covers (e.g. a foreign pid you cannot ``wait()`` on)
+    belongs under an explaining ``# sdklint: disable``."""
+
+    id = "no-blocking-sleep"
+    description = "time.sleep in scheduler/plan/offer hot paths"
+
+    def applies_to(self, ctx: LintContext) -> bool:
+        return (
+            ctx.tree is not None
+            and ctx.rel.startswith("dcos_commons_tpu/")
+            and not ctx.rel.startswith("dcos_commons_tpu/testing/")
+        )
+
+    def check(self, ctx: LintContext) -> List[Finding]:
+        out = []
+        sleep_aliases = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ImportFrom) and node.module == "time":
+                sleep_aliases |= {
+                    a.asname or a.name for a in node.names if a.name == "sleep"
+                }
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            hit = (
+                isinstance(func, ast.Attribute)
+                and func.attr == "sleep"
+                and isinstance(func.value, ast.Name)
+                and func.value.id in ("time", "_time")
+            ) or (
+                isinstance(func, ast.Name) and func.id in sleep_aliases
+            )
+            if hit:
+                out.append(ctx.finding(
+                    node, self.id,
+                    "time.sleep blocks the event-driven loop; wake on "
+                    "nudge()/Event.wait (or document why polling is "
+                    "correct here)",
+                ))
+        return out
+
+
+class LedgerMutationRule(Rule):
+    """``SliceInventory.snapshots`` reuses cached per-host snapshots
+    while ``ReservationLedger.host_generation`` is unchanged (the PR-1
+    fast path), so host state may only change through methods that
+    bump the generation counter — a mutation that skips the bump
+    serves stale offers forever.  Two checks: public methods of the
+    two classes that mutate tracked host state must write the
+    generation attribute in the same method, and no code anywhere may
+    write those internals through a non-``self`` receiver."""
+
+    id = "ledger-mutation"
+    description = "ledger/inventory host state mutated without a generation bump"
+
+    _TRACKED = {
+        "ReservationLedger": (
+            {"_cache", "_by_host", "_by_task", "_host_gen"}, "_generation",
+        ),
+        "SliceInventory": ({"_hosts", "_down"}, "_topology_gen"),
+    }
+    # every tracked attr plus the generation counters and the snapshot
+    # cache: writable through `self` inside the owning class only
+    _INTERNALS = (
+        {attr for attrs, _ in _TRACKED.values() for attr in attrs}
+        | {gen for _, gen in _TRACKED.values()}
+        | {"_snap_cache"}
+    )
+
+    def check(self, ctx: LintContext) -> List[Finding]:
+        out = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef) and node.name in self._TRACKED:
+                out += self._check_class(ctx, node)
+        out += self._check_reach_in(ctx)
+        return out
+
+    def _check_class(self, ctx, cls) -> List[Finding]:
+        tracked, gen_attr = self._TRACKED[cls.name]
+        out = []
+        for method in cls.body:
+            if not isinstance(method, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if method.name.startswith("_"):
+                # underscore helpers (_index/_unindex/_load/__init__)
+                # run under a bumping public caller; the public surface
+                # is where the discipline is enforced
+                continue
+            touched = [
+                (attr, sub) for attr, sub in _self_attr_writes(method)
+                if attr in tracked
+            ]
+            if not touched:
+                continue
+            bumps = any(
+                attr == gen_attr for attr, _ in _self_attr_writes(method)
+            )
+            if not bumps:
+                for attr, sub in touched:
+                    out.append(ctx.finding(
+                        sub, self.id,
+                        f"{cls.name}.{method.name} mutates self.{attr} "
+                        f"without bumping self.{gen_attr}: snapshot "
+                        "caches keyed on the generation go stale",
+                    ))
+        return out
+
+    def _check_reach_in(self, ctx) -> List[Finding]:
+        out = []
+        for node in ast.walk(ctx.tree):
+            for attr, sub in self._external_writes(node):
+                out.append(ctx.finding(
+                    sub, self.id,
+                    f"external write to ledger/inventory internal "
+                    f".{attr}: go through the generation-bumping API",
+                ))
+        return out
+
+    def _external_writes(self, node) -> Iterator[Tuple[str, ast.AST]]:
+        targets: List[ast.AST] = []
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+        for target in targets:
+            base = target
+            if isinstance(base, ast.Subscript):
+                base = base.value
+            if (
+                isinstance(base, ast.Attribute)
+                and base.attr in self._INTERNALS
+                and not (
+                    isinstance(base.value, ast.Name)
+                    and base.value.id == "self"
+                )
+            ):
+                yield base.attr, node
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _MUTATOR_METHODS
+        ):
+            owner = node.func.value
+            if (
+                isinstance(owner, ast.Attribute)
+                and owner.attr in self._INTERNALS
+                and not (
+                    isinstance(owner.value, ast.Name)
+                    and owner.value.id == "self"
+                )
+            ):
+                yield owner.attr, node
+
+
+class LockDisciplineRule(Rule):
+    """A class that creates a ``threading.Lock``/``RLock``/``Condition``
+    in ``__init__`` promises its shared mutable state is written under
+    that lock.  The guarded set is inferred: any ``self`` attribute
+    written inside a ``with self.<lock>:`` block (outside ``__init__``)
+    is shared state, and every other write to it must also hold the
+    lock.  Methods named ``*_locked`` declare "caller holds the lock"
+    (the runtime/runner.py convention) and count as guarded.  Reads
+    stay un-flagged (lock-free reads of snapshots are a deliberate
+    idiom here); a genuinely single-threaded write path carries an
+    explaining ``# sdklint: disable``."""
+
+    id = "lock-discipline"
+    description = "guarded attribute written outside `with self._lock`"
+
+    def check(self, ctx: LintContext) -> List[Finding]:
+        out = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                out += self._check_class(ctx, node)
+        return out
+
+    @staticmethod
+    def _lock_attrs(cls: ast.ClassDef) -> Set[str]:
+        """Names of self attrs assigned a threading lock in __init__."""
+        locks: Set[str] = set()
+        for method in cls.body:
+            if not isinstance(method, ast.FunctionDef) or \
+                    method.name != "__init__":
+                continue
+            for sub in ast.walk(method):
+                if not isinstance(sub, ast.Assign):
+                    continue
+                value = sub.value
+                if not (
+                    isinstance(value, ast.Call)
+                    and isinstance(value.func, ast.Attribute)
+                    and value.func.attr in ("Lock", "RLock", "Condition")
+                    and isinstance(value.func.value, ast.Name)
+                    and value.func.value.id == "threading"
+                ):
+                    continue
+                for target in sub.targets:
+                    if isinstance(target, ast.Attribute) and \
+                            isinstance(target.value, ast.Name) and \
+                            target.value.id == "self":
+                        locks.add(target.attr)
+        return locks
+
+    def _method_writes(
+        self, method: ast.AST, lock_attrs: Set[str]
+    ) -> List[Tuple[str, ast.AST, bool]]:
+        """(attr, node, under_lock) for every self-attr write, walking
+        the statement tree with a with-lock depth counter."""
+        writes: List[Tuple[str, ast.AST, bool]] = []
+
+        def visit(node: ast.AST, held: bool) -> None:
+            if isinstance(node, ast.With):
+                holds = held or any(
+                    _is_self_attr(item.context_expr, lock_attrs)
+                    for item in node.items
+                )
+                for child in node.body:
+                    visit(child, holds)
+                return
+            for attr, sub in _direct_writes(node):
+                writes.append((attr, sub, held))
+            for child in ast.iter_child_nodes(node):
+                # excepthandler is not an ast.stmt but carries a
+                # statement body — error-recovery paths are exactly
+                # where forgotten locking hides
+                if isinstance(child, (ast.stmt, ast.excepthandler)):
+                    visit(child, held)
+
+        def _direct_writes(node):
+            """Writes attributable to THIS statement (not recursing
+            into compound bodies, which visit() handles)."""
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign,
+                                 ast.Delete, ast.Expr)):
+                yield from _self_attr_writes(node)
+
+        for stmt in method.body:
+            visit(stmt, False)
+        return writes
+
+    def _check_class(self, ctx, cls) -> List[Finding]:
+        lock_attrs = self._lock_attrs(cls)
+        if not lock_attrs:
+            return []
+        per_method: Dict[str, List[Tuple[str, ast.AST, bool]]] = {}
+        for method in cls.body:
+            if not isinstance(method, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    or method.name == "__init__":
+                continue
+            writes = self._method_writes(method, lock_attrs)
+            if method.name.endswith("_locked"):
+                writes = [(attr, node, True) for attr, node, _ in writes]
+            per_method[method.name] = writes
+        guarded: Set[str] = {
+            attr
+            for writes in per_method.values()
+            for attr, _, held in writes
+            if held
+        } - lock_attrs
+        out = []
+        for name, writes in per_method.items():
+            for attr, node, held in writes:
+                if attr in guarded and not held:
+                    out.append(ctx.finding(
+                        node, self.id,
+                        f"{cls.name}.{name} writes self.{attr} outside "
+                        f"`with self.{sorted(lock_attrs)[0]}` but other "
+                        "methods guard it — racy write",
+                    ))
+        return out
+
+
+class NoGpusVocabularyRule(Rule):
+    """BASELINE invariant: the TPU-first resource model has no ``gpus``
+    scalar — accelerators are the pod-level ``tpu:`` block
+    (specification/specs.py:9).  Any identifier, dict key, or exact
+    string ``"gpus"`` reintroduces the vocabulary this rebuild
+    deliberately removed (prose in docstrings is fine)."""
+
+    id = "no-gpus-resource"
+    description = "`gpus` resource vocabulary (BASELINE bans it)"
+
+    def check(self, ctx: LintContext) -> List[Finding]:
+        out = []
+        for node in ast.walk(ctx.tree):
+            hit = None
+            if isinstance(node, ast.Name) and node.id == "gpus":
+                hit = "identifier"
+            elif isinstance(node, ast.Attribute) and node.attr == "gpus":
+                hit = "attribute"
+            elif isinstance(node, ast.arg) and node.arg == "gpus":
+                hit = "argument"
+            elif isinstance(node, ast.keyword) and node.arg == "gpus":
+                hit = "keyword"
+            elif isinstance(node, ast.Constant) and node.value == "gpus":
+                hit = "string"
+            if hit is not None:
+                out.append(ctx.finding(
+                    node, self.id,
+                    f"{hit} 'gpus': accelerators are the pod-level "
+                    "tpu: block, not a gpus scalar",
+                ))
+        return out
+
+
+class SwallowedExceptionRule(Rule):
+    """``except Exception: pass`` hides the stack trace the on-call
+    engineer needed.  A broad handler must do *something* — log,
+    count, return a fallback, or re-raise; a handler whose body is
+    only ``pass``/``continue`` is flagged.  Where drop-and-continue
+    is genuinely correct (a broken listener must not break intake),
+    say so next to a ``# sdklint: disable``."""
+
+    id = "swallowed-exception"
+    description = "except Exception/bare except with a pass-only body"
+
+    _BROAD = ("Exception", "BaseException")
+
+    def _is_broad(self, type_node) -> bool:
+        if type_node is None:
+            return True
+        if isinstance(type_node, ast.Name):
+            return type_node.id in self._BROAD
+        if isinstance(type_node, ast.Attribute):
+            return type_node.attr in self._BROAD
+        if isinstance(type_node, ast.Tuple):
+            return any(self._is_broad(e) for e in type_node.elts)
+        return False
+
+    def check(self, ctx: LintContext) -> List[Finding]:
+        out = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not self._is_broad(node.type):
+                continue
+            swallows = all(
+                isinstance(stmt, (ast.Pass, ast.Continue)) or (
+                    isinstance(stmt, ast.Expr)
+                    and isinstance(stmt.value, ast.Constant)
+                )
+                for stmt in node.body
+            )
+            if swallows:
+                out.append(ctx.finding(
+                    node, self.id,
+                    "broad except with a pass-only body swallows the "
+                    "error; log it, narrow the type, or re-raise",
+                ))
+        return out
+
+
+class TracerUnsafeCastRule(Rule):
+    """Inside a ``jit``/``shard_map``/``pmap``-decorated function the
+    arguments are tracers; ``float()``/``int()``/``bool()`` and
+    ``np.asarray``/``np.array`` force host materialization and raise
+    ``TracerConversionError`` at trace time — or worse, silently
+    constant-fold a value that should have stayed symbolic.  Use
+    ``jnp`` ops and let values stay on device."""
+
+    id = "jit-tracer-cast"
+    description = "host-side cast (float/int/np.asarray) under jit/shard_map"
+
+    _DECORATOR_NAMES = {"jit", "shard_map", "pmap"}
+    _CAST_NAMES = {"float", "int", "bool"}
+    _NP_MODULES = {"np", "numpy", "onp"}
+    _NP_FUNCS = {"asarray", "array"}
+
+    def _is_traced_decorator(self, decorator: ast.AST) -> bool:
+        for sub in ast.walk(decorator):
+            if isinstance(sub, ast.Name) and sub.id in self._DECORATOR_NAMES:
+                return True
+            if isinstance(sub, ast.Attribute) and \
+                    sub.attr in self._DECORATOR_NAMES:
+                return True
+        return False
+
+    def check(self, ctx: LintContext) -> List[Finding]:
+        out = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not any(
+                self._is_traced_decorator(d) for d in node.decorator_list
+            ):
+                continue
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                func = sub.func
+                bad = None
+                if isinstance(func, ast.Name) and \
+                        func.id in self._CAST_NAMES and sub.args:
+                    bad = f"{func.id}()"
+                elif (
+                    isinstance(func, ast.Attribute)
+                    and func.attr in self._NP_FUNCS
+                    and isinstance(func.value, ast.Name)
+                    and func.value.id in self._NP_MODULES
+                ):
+                    bad = f"{func.value.id}.{func.attr}()"
+                if bad:
+                    out.append(ctx.finding(
+                        sub, self.id,
+                        f"{bad} inside jit/shard_map-traced "
+                        f"{node.name}() materializes a tracer on host; "
+                        "keep it in jnp",
+                    ))
+        return out
+
+
+def all_rules() -> List[Rule]:
+    return [
+        NoBlockingSleepRule(),
+        LedgerMutationRule(),
+        LockDisciplineRule(),
+        NoGpusVocabularyRule(),
+        SwallowedExceptionRule(),
+        TracerUnsafeCastRule(),
+    ]
+
+
+def rule_catalog() -> str:
+    """Human-readable rule list for ``--catalog`` and the docs."""
+    blocks = []
+    for rule in all_rules():
+        doc = " ".join((rule.__doc__ or "").split())
+        blocks.append(f"{rule.id}: {rule.description}\n    {doc}")
+    return "\n\n".join(blocks)

@@ -88,7 +88,7 @@ class Metrics:
         for name, fn in gauges.items():
             try:
                 out[name] = float(fn())
-            except Exception:
+            except Exception:  # sdklint: disable=swallowed-exception — one broken gauge must not break the whole snapshot/scrape
                 pass
         return out
 

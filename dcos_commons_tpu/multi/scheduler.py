@@ -154,11 +154,12 @@ class MultiServiceScheduler:
         # so per-service view instances would clear it on every
         # service switch within a cycle
         self._merged_view = _MergedLedgerView(self)
-        self._reload()
+        with self._lock:
+            self._reload_locked()
 
     # -- add/remove/lookup (reference: MultiServiceManager) -----------
 
-    def _reload(self) -> None:
+    def _reload_locked(self) -> None:
         """Restart resume: rebuild every persisted service, including
         those mid-uninstall."""
         for name in self.service_store.list_names():
@@ -585,7 +586,8 @@ class MultiServiceScheduler:
                         "multi cycle failed (%d consecutive)", failures
                     )
                     if failures >= max_consecutive_failures:
-                        self._fatal_error = repr(exc)
+                        with self._lock:
+                            self._fatal_error = repr(exc)
                 if self._fatal_error is not None:
                     LOG.critical(
                         "multi scheduler wedged (%s); stopping loop for "

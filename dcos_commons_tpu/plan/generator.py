@@ -32,6 +32,35 @@ from dcos_commons_tpu.specification.specs import ServiceSpec, SpecError
 from dcos_commons_tpu.state.state_store import StateStore
 
 
+def dependency_cycle(edges: Dict[str, List[str]]) -> Optional[List[str]]:
+    """First cycle in a name -> prerequisites graph (as a closed node
+    list), or None.  Shared by plan generation and the spec analyzer."""
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color = {name: WHITE for name in edges}
+    path: List[str] = []
+
+    def visit(name: str) -> Optional[List[str]]:
+        color[name] = GRAY
+        path.append(name)
+        for dep in edges.get(name, ()):
+            if color.get(dep, WHITE) == GRAY:
+                return path[path.index(dep):] + [dep]
+            if color.get(dep, WHITE) == WHITE and dep in edges:
+                found = visit(dep)
+                if found:
+                    return found
+        path.pop()
+        color[name] = BLACK
+        return None
+
+    for name in sorted(edges):
+        if color[name] == WHITE:
+            found = visit(name)
+            if found:
+                return found
+    return None
+
+
 class PlanGenerator:
     def __init__(self, backoff: Optional[Backoff] = None):
         self._factory = DeployPlanFactory(backoff)
@@ -46,12 +75,52 @@ class PlanGenerator:
         target_config_id: str,
     ) -> Plan:
         phases: List[Phase] = []
-        for phase_name, raw_phase in (raw_plan.get("phases") or {}).items():
+        phases_raw = raw_plan.get("phases") or {}
+        for phase_name, raw_phase in phases_raw.items():
             phases.append(
                 self._generate_phase(
                     spec, phase_name, raw_phase or {}, state_store, target_config_id
                 )
             )
+        # phase-level `dependencies: [other-phase, ...]` builds a DAG
+        # plan (reference: DependencyStrategy/DependencyStrategyHelper)
+        # instead of the flat serial/parallel strategies.  Unknown
+        # names and cycles are CONFIG errors caught here (and by the
+        # spec analyzer at lint time), never a silently-stuck plan.
+        edges: Dict[str, List[str]] = {}
+        for phase_name, raw_phase in phases_raw.items():
+            deps = [str(d) for d in (raw_phase or {}).get("dependencies") or []]
+            if deps:
+                edges[str(phase_name)] = deps
+        if edges:
+            if "strategy" in raw_plan:
+                # an explicit plan strategy AND a dependency DAG both
+                # claim to order the phases; silently preferring one
+                # would break whichever the YAML author believed in
+                raise SpecError(
+                    f"plan {plan_name!r}: 'strategy: "
+                    f"{raw_plan['strategy']}' cannot be combined with "
+                    "phase 'dependencies' (the DAG defines the order; "
+                    "drop one)"
+                )
+            known = set(map(str, phases_raw))
+            unknown = sorted(
+                {d for deps in edges.values() for d in deps} - known
+            )
+            if unknown:
+                raise SpecError(
+                    f"plan {plan_name!r}: dependencies name unknown "
+                    f"phase(s) {unknown} (have: {sorted(known)})"
+                )
+            cycle = dependency_cycle(edges)
+            if cycle:
+                raise SpecError(
+                    f"plan {plan_name!r}: phase dependency cycle "
+                    + " -> ".join(cycle)
+                )
+            from dcos_commons_tpu.plan.strategy import DependencyStrategy
+
+            return Plan(plan_name, phases, DependencyStrategy(edges))
         return Plan(
             plan_name,
             phases,

@@ -494,13 +494,25 @@ def validate_spec_change(
     """Run all validators; raise ConfigValidationError on any failure.
 
     Reference: DefaultConfigurationUpdater.updateConfiguration flow —
-    validation errors keep the previous target config active.
+    validation errors keep the previous target config active.  A
+    validator that RAISES (instead of returning errors) must not let
+    a candidate config slip past the other 18 checks or crash the
+    update endpoint: a raised ConfigValidationError's entries are
+    folded in, and any other exception becomes a validation error
+    naming the broken validator (the config is rejected, the operator
+    sees which check to fix).
     """
     errors: List[str] = []
     for validator in validators if validators is not None else default_validators():
-        if _takes_context(validator):
-            errors.extend(validator(old, new, context))
-        else:
-            errors.extend(validator(old, new))
+        try:
+            if _takes_context(validator):
+                errors.extend(validator(old, new, context))
+            else:
+                errors.extend(validator(old, new))
+        except ConfigValidationError as e:
+            errors.extend(e.errors)
+        except Exception as e:
+            name = getattr(validator, "__name__", repr(validator))
+            errors.append(f"validator {name} crashed: {e!r}")
     if errors:
         raise ConfigValidationError(errors)

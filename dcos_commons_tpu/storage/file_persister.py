@@ -53,7 +53,9 @@ class FileWalPersister(Persister):
         self._mem = MemPersister()  # authoritative in-RAM image
         self._records_since_compact = 0
         os.makedirs(directory, exist_ok=True)
-        self._replay()  # sets _records_since_compact to replayed count
+        with self._lock:
+            # sets _records_since_compact to the replayed count
+            self._replay_locked()
         self._wal = open(self._wal_path, "ab")
         # a crash-restart loop must not defer compaction forever: if the
         # replayed WAL already exceeds the threshold, compact at boot
@@ -69,7 +71,7 @@ class FileWalPersister(Persister):
 
     # recovery --------------------------------------------------------
 
-    def _replay(self) -> None:
+    def _replay_locked(self) -> None:
         if os.path.exists(self._snap_path):
             with open(self._snap_path, "rb") as f:
                 snap = json.loads(f.read().decode("utf-8"))
@@ -114,7 +116,7 @@ class FileWalPersister(Persister):
 
     # write path ------------------------------------------------------
 
-    def _append(self, ops: List[TransactionOp]) -> None:
+    def _append_locked(self, ops: List[TransactionOp]) -> None:
         payload = _encode_txn(ops)
         self._wal.write(_HEADER.pack(len(payload), zlib.crc32(payload)))
         self._wal.write(payload)
@@ -167,7 +169,7 @@ class FileWalPersister(Persister):
         with self._lock:
             if normalize_path(path) == "/":
                 raise PersisterError("cannot store a value at '/'", path)
-            self._append([SetOp(path, value)])
+            self._append_locked([SetOp(path, value)])
             self._mem.set(path, value)
             self._maybe_compact()
 
@@ -178,7 +180,7 @@ class FileWalPersister(Persister):
     def recursive_delete(self, path: str) -> None:
         with self._lock:
             self._mem.get_children(path)  # raise if absent, before logging
-            self._append([DeleteOp(path)])
+            self._append_locked([DeleteOp(path)])
             self._mem.recursive_delete(path)
             self._maybe_compact()
 
@@ -192,7 +194,7 @@ class FileWalPersister(Persister):
                     raise PersisterError(f"path not found: {op.path}", op.path)
                 if isinstance(op, SetOp) and normalize_path(op.path) == "/":
                     raise PersisterError("cannot store a value at '/'", op.path)
-            self._append(ops)
+            self._append_locked(ops)
             self._mem.apply(ops)
             self._maybe_compact()
 

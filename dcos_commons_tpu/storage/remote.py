@@ -341,15 +341,15 @@ class StateServer:
                 seq = self._mutate(ops)
                 return {"ok": True, "applied": len(ops), "_sync_seq": seq}
             if route == "/v1/lock/acquire":
-                return self._acquire(
+                return self._acquire_locked(
                     body["name"], body["owner"],
                     float(body.get("ttl_s", 15.0)),
                 )
             if route == "/v1/lock/release":
-                return self._release(body["name"], body["owner"])
+                return self._release_locked(body["name"], body["owner"])
             raise PersisterError(f"no route {route}")
 
-    def _acquire(self, name: str, owner: str, ttl_s: float) -> dict:
+    def _acquire_locked(self, name: str, owner: str, ttl_s: float) -> dict:
         # wall-clock expiry (not monotonic): leases must survive a
         # state-server restart via the backend, and monotonic clocks
         # don't cross processes
@@ -366,7 +366,7 @@ class StateServer:
         seq = self._store_lease(name, owner, now + ttl_s)
         return {"acquired": True, "owner": owner, "_sync_seq": seq}
 
-    def _release(self, name: str, owner: str) -> dict:
+    def _release_locked(self, name: str, owner: str) -> dict:
         held = self._leases.get(name)
         if held is not None and held[0] == owner:
             del self._leases[name]

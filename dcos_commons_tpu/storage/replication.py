@@ -157,7 +157,7 @@ class ReplicationLog:
         # re-snapshot even when the raw numbers happen to line up.
         self.stream_id = uuid.uuid4().hex
 
-    def _attached(self, now: float) -> Dict[str, dict]:
+    def _attached_locked(self, now: float) -> Dict[str, dict]:
         """Live pullers; prunes ones silent past the attach window (a
         dead standby must stop gating the write barrier)."""
         for pid in [
@@ -192,7 +192,7 @@ class ReplicationLog:
             while True:
                 now = time.monotonic()
                 live = [
-                    st for st in self._attached(now).values()
+                    st for st in self._attached_locked(now).values()
                     if not st["lagging"]
                 ]
                 if not live:
@@ -225,7 +225,7 @@ class ReplicationLog:
         deadline = time.monotonic() + wait_s
         with self._cv:
             now = time.monotonic()
-            self._attached(now)  # prune the silent
+            self._attached_locked(now)  # prune the silent
             st = self._pullers.get(puller_id)
             if st is None:
                 # fresh attach (new standby, or one returning after a
@@ -300,7 +300,7 @@ class ReplicationLog:
     def status(self) -> dict:
         with self._cv:
             now = time.monotonic()
-            live = self._attached(now)
+            live = self._attached_locked(now)
             return {
                 "seq": self._next_seq - 1,
                 # the conservative watermark: everything at or below

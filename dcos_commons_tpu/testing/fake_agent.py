@@ -106,7 +106,7 @@ class FakeAgent:
         for listener in listeners:
             try:
                 listener()
-            except Exception:
+            except Exception:  # sdklint: disable=swallowed-exception — same contract as Agent._notify_status: a broken listener must not break intake
                 pass
 
     def add_status_listener(self, listener) -> None:

@@ -145,7 +145,7 @@ class MicroBatcher:
             if idle:
                 try:
                     self._on_idle()
-                except Exception:  # noqa: BLE001 — idle must not kill serving
+                except Exception:  # noqa: BLE001, sdklint: disable=swallowed-exception — idle hook must not kill serving
                     pass
                 continue
             try:

@@ -54,3 +54,47 @@ def pytest_collection_modifyitems(config, items):
     for item in items:
         if os.path.basename(str(item.fspath)) in _SLOW_FILES:
             item.add_marker(pytest.mark.slow)
+
+
+# -- sdklint lock-order checker (opt-in, SDKLINT_LOCKCHECK=1) ---------
+#
+# Instruments threading.Lock/RLock for the whole session and fails the
+# run if the observed lock-nesting graph contains a cycle (deadlock
+# risk).  tests/test_scheduler_e2e.py and tests/test_multi_service.py
+# additionally enable it per-test regardless of the env var.
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _sdklint_lockcheck_session():
+    from dcos_commons_tpu.analysis import lockcheck
+
+    if not lockcheck.env_requested():
+        yield
+        return
+    lockcheck.install()
+    yield
+    report = lockcheck.report()
+    lockcheck.uninstall()
+    assert not report.cycles, report.describe()
+
+
+def lockcheck_guard():
+    """Shared body for the per-test lock-order fixtures in
+    tests/test_scheduler_e2e.py and tests/test_multi_service.py
+    (``yield from lockcheck_guard()``): install, run the test, fail it
+    on any lock-order cycle.  Coexists with the session checker above
+    — when that is active, the accumulated cross-test graph is left
+    intact (no reset/uninstall)."""
+    from dcos_commons_tpu.analysis import lockcheck
+
+    already = lockcheck.is_enabled()
+    lockcheck.install()
+    if not already:
+        lockcheck.reset()
+    yield
+    report = lockcheck.report()
+    if not already:
+        lockcheck.uninstall()
+    assert not report.cycles, report.describe()

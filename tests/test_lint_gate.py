@@ -1,0 +1,816 @@
+"""sdklint gate: the repo must satisfy its own static analysis.
+
+The sibling of tests/test_build_gate.py (syntax/imports/style): this
+gate runs the FRAMEWORK-INVARIANT linter and the ahead-of-time spec
+analyzer over the whole repo and fails on any non-baselined finding,
+plus one unit test per rule demonstrating a caught violation and a
+suppressed one (the documented ``# sdklint: disable`` contract).
+
+Reference: the root build gates on checkstyle/findbugs before any
+test runs; this is the analogue for OUR invariants (event-driven
+loop, generation-bumped caches, lock discipline, TPU-first resource
+vocabulary, tracer safety).
+"""
+
+import json
+import os
+import textwrap
+import threading
+
+from dcos_commons_tpu.analysis import baseline as baseline_mod
+from dcos_commons_tpu.analysis import lockcheck, speccheck
+from dcos_commons_tpu.analysis.__main__ import main as analysis_main
+from dcos_commons_tpu.analysis.linter import lint_paths, lint_tree
+from dcos_commons_tpu.analysis.rules import all_rules, rule_catalog
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- the repo-wide gates ----------------------------------------------
+
+
+def test_repo_lint_gate():
+    """Zero non-baselined lint findings across the package."""
+    result = lint_tree(REPO)
+    known = baseline_mod.load_baseline(baseline_mod.baseline_path(REPO))
+    fresh, _ = baseline_mod.apply_baseline(result.findings, known)
+    assert not fresh, "\n".join(f.render() for f in fresh)
+
+
+def test_repo_spec_analyzer_gate():
+    """Every packaged framework's YAMLs deploy-check clean."""
+    findings = speccheck.analyze_all(REPO)
+    assert not findings, "\n".join(f.render() for f in findings)
+
+
+def test_cli_all_exits_zero(capsys):
+    """The CI entry point: `python -m dcos_commons_tpu.analysis --all`."""
+    rc = analysis_main(["--all", "--root", REPO])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "lint:" in out and "specs:" in out
+
+
+def test_rule_catalog_lists_every_rule():
+    catalog = rule_catalog()
+    for rule in all_rules():
+        assert rule.id in catalog
+
+
+# -- per-rule fixtures: violation caught, suppression honored ---------
+
+
+def _lint_fixture(tmp_path, source, rel="dcos_commons_tpu/mod.py",
+                  rule_id=None):
+    """Lint one fixture file placed at ``rel`` under a fake repo root;
+    returns (findings, suppressed) filtered to ``rule_id``."""
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(source))
+    result = lint_paths([str(path)], str(tmp_path))
+    pick = lambda fs: [f for f in fs if rule_id is None or f.rule == rule_id]  # noqa: E731
+    return pick(result.findings), pick(result.suppressed)
+
+
+def test_rule_no_blocking_sleep(tmp_path):
+    src = """
+    import time
+
+    def poll():
+        time.sleep(0.1)
+    """
+    findings, _ = _lint_fixture(tmp_path, src, rule_id="no-blocking-sleep")
+    assert len(findings) == 1 and findings[0].line == 5
+    suppressed_src = src.replace(
+        "time.sleep(0.1)",
+        "time.sleep(0.1)  # sdklint: disable=no-blocking-sleep — poll a foreign pid",
+    )
+    findings, suppressed = _lint_fixture(
+        tmp_path, suppressed_src, rule_id="no-blocking-sleep"
+    )
+    assert not findings and len(suppressed) == 1
+    # testing/ harnesses are allowlisted wholesale
+    findings, _ = _lint_fixture(
+        tmp_path, src, rel="dcos_commons_tpu/testing/ticks.py",
+        rule_id="no-blocking-sleep",
+    )
+    assert not findings
+    # `from time import sleep` does not dodge the rule
+    findings, _ = _lint_fixture(
+        tmp_path,
+        "from time import sleep\n\ndef f():\n    sleep(1)\n",
+        rule_id="no-blocking-sleep",
+    )
+    assert len(findings) == 1
+
+
+def test_rule_ledger_mutation(tmp_path):
+    src = """
+    class ReservationLedger:
+        def evil(self, r):
+            self._cache[r.reservation_id] = r
+
+        def good(self, r):
+            self._generation += 1
+            self._cache[r.reservation_id] = r
+    """
+    findings, _ = _lint_fixture(tmp_path, src, rule_id="ledger-mutation")
+    assert len(findings) == 1 and "evil" in findings[0].message
+    # external reach-in is flagged anywhere, any class
+    findings, _ = _lint_fixture(
+        tmp_path,
+        "def gc(ledger):\n    ledger._by_host.clear()\n",
+        rule_id="ledger-mutation",
+    )
+    assert len(findings) == 1 and "reach" not in findings[0].message
+    suppressed_src = src.replace(
+        "self._cache[r.reservation_id] = r\n\n",
+        "self._cache[r.reservation_id] = r  "
+        "# sdklint: disable=ledger-mutation — rebuilt below\n\n",
+    )
+    findings, suppressed = _lint_fixture(
+        tmp_path, suppressed_src, rule_id="ledger-mutation"
+    )
+    assert not findings and len(suppressed) == 1
+
+
+def test_rule_lock_discipline(tmp_path):
+    src = """
+    import threading
+
+    class Counter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0
+
+        def incr(self):
+            with self._lock:
+                self.count += 1
+
+        def reset(self):
+            self.count = 0
+    """
+    findings, _ = _lint_fixture(tmp_path, src, rule_id="lock-discipline")
+    assert len(findings) == 1 and "reset" in findings[0].message
+    # the *_locked convention declares "caller holds the lock"
+    convention_src = src.replace("def reset(self):", "def reset_locked(self):")
+    findings, _ = _lint_fixture(
+        tmp_path, convention_src, rule_id="lock-discipline"
+    )
+    assert not findings
+    suppressed_src = src.replace(
+        "self.count = 0\n",
+        "self.count = 0  # sdklint: disable=lock-discipline — "
+        "called pre-thread only\n",
+        1,
+    )
+    # the first "self.count = 0" is __init__ (never flagged); suppress
+    # the reset() write instead
+    suppressed_src = src.replace(
+        "def reset(self):\n            self.count = 0",
+        "def reset(self):\n            self.count = 0  "
+        "# sdklint: disable=lock-discipline — single-threaded test hook",
+    )
+    findings, suppressed = _lint_fixture(
+        tmp_path, suppressed_src, rule_id="lock-discipline"
+    )
+    assert not findings and len(suppressed) == 1
+
+
+def test_rule_no_gpus_resource(tmp_path):
+    src = 'RESOURCES = {"cpus": 1, "gpus": 2}\n'
+    findings, _ = _lint_fixture(tmp_path, src, rule_id="no-gpus-resource")
+    assert len(findings) == 1
+    findings, suppressed = _lint_fixture(
+        tmp_path,
+        src.rstrip() + "  # sdklint: disable=no-gpus-resource — legacy import shim\n",
+        rule_id="no-gpus-resource",
+    )
+    assert not findings and len(suppressed) == 1
+    # prose mentioning the word is fine; only the exact token trips
+    findings, _ = _lint_fixture(
+        tmp_path,
+        '"""No gpus scalars anywhere — BASELINE."""\n',
+        rule_id="no-gpus-resource",
+    )
+    assert not findings
+
+
+def test_rule_swallowed_exception(tmp_path):
+    src = """
+    def f():
+        try:
+            risky()
+        except Exception:
+            pass
+    """
+    findings, _ = _lint_fixture(tmp_path, src, rule_id="swallowed-exception")
+    assert len(findings) == 1
+    # a handler that DOES something is fine
+    handled = src.replace("pass", "LOG.exception('risky failed')")
+    findings, _ = _lint_fixture(tmp_path, handled,
+                                rule_id="swallowed-exception")
+    assert not findings
+    suppressed_src = src.replace(
+        "except Exception:",
+        "except Exception:  # sdklint: disable=swallowed-exception — "
+        "broken listener must not break intake",
+    )
+    findings, suppressed = _lint_fixture(
+        tmp_path, suppressed_src, rule_id="swallowed-exception"
+    )
+    assert not findings and len(suppressed) == 1
+
+
+def test_rule_jit_tracer_cast(tmp_path):
+    src = """
+    import jax
+    import numpy as np
+
+    @jax.jit
+    def step(x):
+        scale = float(x.mean())
+        return np.asarray(x) * scale
+    """
+    findings, _ = _lint_fixture(tmp_path, src, rule_id="jit-tracer-cast")
+    assert len(findings) == 2
+    # un-decorated host code may cast freely
+    findings, _ = _lint_fixture(
+        tmp_path,
+        "def host(x):\n    return float(x)\n",
+        rule_id="jit-tracer-cast",
+    )
+    assert not findings
+    suppressed_src = src.replace(
+        "scale = float(x.mean())",
+        "scale = float(x.mean())  # sdklint: disable=jit-tracer-cast — "
+        "static arg, never traced",
+    ).replace("return np.asarray(x) * scale", "return x * scale")
+    findings, suppressed = _lint_fixture(
+        tmp_path, suppressed_src, rule_id="jit-tracer-cast"
+    )
+    assert not findings and len(suppressed) == 1
+
+
+def test_file_level_suppression(tmp_path):
+    src = (
+        "# sdklint: disable-file=no-blocking-sleep — tick harness\n"
+        "import time\n"
+        "def a():\n    time.sleep(1)\n"
+        "def b():\n    time.sleep(2)\n"
+    )
+    findings, suppressed = _lint_fixture(
+        tmp_path, src, rule_id="no-blocking-sleep"
+    )
+    assert not findings and len(suppressed) == 2
+
+
+# -- baseline mechanics -----------------------------------------------
+
+
+def test_baseline_absorbs_and_bounds(tmp_path):
+    src = """
+    import time
+
+    def a():
+        time.sleep(1)
+    """
+    path = tmp_path / "dcos_commons_tpu" / "legacy.py"
+    path.parent.mkdir(parents=True)
+    path.write_text(textwrap.dedent(src))
+    result = lint_paths([str(path)], str(tmp_path))
+    bl_path = str(tmp_path / ".sdklint-baseline.json")
+    counts = baseline_mod.save_baseline(bl_path, result.findings)
+    assert sum(counts.values()) == 1
+    # baselined: the same debt passes the gate
+    known = baseline_mod.load_baseline(bl_path)
+    fresh, absorbed = baseline_mod.apply_baseline(result.findings, known)
+    assert not fresh and len(absorbed) == 1
+    # NEW debt of the same rule in the same file exceeds the budget
+    path.write_text(textwrap.dedent(src) + "\n\ndef b():\n    time.sleep(2)\n")
+    result = lint_paths([str(path)], str(tmp_path))
+    fresh, absorbed = baseline_mod.apply_baseline(result.findings, known)
+    assert len(fresh) == 1 and len(absorbed) == 1
+    # baseline entries are line-number free (fingerprint = file::rule)
+    assert all("::" in k and k.count(":") == 2 for k in known)
+
+
+def test_baseline_file_is_committed_and_parseable():
+    path = baseline_mod.baseline_path(REPO)
+    assert os.path.exists(path), "commit .sdklint-baseline.json"
+    with open(path) as f:
+        doc = json.load(f)
+    assert "entries" in doc
+
+
+# -- spec analyzer fixtures -------------------------------------------
+
+
+def _speccheck_fixture(tmp_path, svc_yaml, options=None):
+    framework = tmp_path / "frameworks" / "fix"
+    framework.mkdir(parents=True, exist_ok=True)
+    (framework / "svc.yml").write_text(textwrap.dedent(svc_yaml))
+    if options is not None:
+        (framework / "options.json").write_text(json.dumps(options))
+    return speccheck.analyze_all(str(tmp_path))
+
+
+def test_speccheck_clean_spec_passes(tmp_path):
+    findings = _speccheck_fixture(tmp_path, """
+    name: clean
+    pods:
+      web:
+        count: 2
+        tasks:
+          server:
+            goal: RUNNING
+            cmd: "serve"
+            cpus: 1
+            memory: 1024
+    """)
+    assert findings == []
+
+
+def test_speccheck_validator_errors_surface(tmp_path):
+    findings = _speccheck_fixture(tmp_path, """
+    name: bad__name
+    pods:
+      web:
+        count: 1
+        tasks:
+          server:
+            goal: RUNNING
+            cmd: "serve"
+    """)
+    assert any(f.rule == "spec-validators" and "__" in f.message
+               for f in findings)
+
+
+def test_speccheck_unsatisfiable_placement(tmp_path):
+    base = """
+    name: svc
+    pods:
+      trainer:
+        count: 4
+        gang: true
+        placement: '{placement}'
+        tpu:
+          generation: v5e
+          chips-per-host: 4
+          topology: 4x4
+        tasks:
+          worker:
+            goal: RUNNING
+            cmd: "train"
+    """
+    # 4x4 topology at 4 chips/host = 4 hosts; count 4 can't fit 0/host
+    findings = _speccheck_fixture(
+        tmp_path, base.format(placement="max-per-host:0")
+    )
+    assert any(f.rule == "spec-placement" for f in findings)
+    # generation pin contradicting the pod's own tpu block
+    findings = _speccheck_fixture(
+        tmp_path, base.format(placement="generation:v4")
+    )
+    assert any(f.rule == "spec-placement" and "v4" in f.message
+               for f in findings)
+    # a satisfiable constraint stays quiet
+    findings = _speccheck_fixture(
+        tmp_path, base.format(placement="max-per-host:1")
+    )
+    assert not [f for f in findings if f.rule == "spec-placement"]
+
+
+def test_speccheck_port_conflicts(tmp_path):
+    findings = _speccheck_fixture(tmp_path, """
+    name: svc
+    pods:
+      web:
+        count: 1
+        tasks:
+          a:
+            goal: RUNNING
+            cmd: "a"
+            ports:
+              http:
+                port: 8080
+          b:
+            goal: RUNNING
+            cmd: "b"
+            ports:
+              admin:
+                port: 8080
+    """)
+    assert any(f.rule == "spec-ports" and "8080" in f.message
+               for f in findings)
+    # count > 1 with a fixed port and nothing keeping instances apart
+    findings = _speccheck_fixture(tmp_path, """
+    name: svc
+    pods:
+      web:
+        count: 3
+        tasks:
+          a:
+            goal: RUNNING
+            cmd: "a"
+            ports:
+              http:
+                port: 8080
+    """)
+    assert any(f.rule == "spec-ports" and "max-per-host" in f.message
+               for f in findings)
+
+
+def test_speccheck_plan_findings(tmp_path):
+    findings = _speccheck_fixture(tmp_path, """
+    name: svc
+    pods:
+      web:
+        count: 2
+        tasks:
+          server:
+            goal: RUNNING
+            cmd: "serve"
+    plans:
+      deploy:
+        phases:
+          one:
+            pod: nonexistent
+          two:
+            pod: web
+            dependencies: [three]
+          three:
+            pod: web
+            dependencies: [two]
+          four:
+            pod: web
+            steps:
+              - 7: [[server]]
+              - 0: [[bogus]]
+    """)
+    rules = {f.rule for f in findings}
+    assert rules == {"spec-plan"}
+    text = "\n".join(f.message for f in findings)
+    assert "nonexistent" in text
+    assert "cycle" in text
+    assert "out of range" in text
+    assert "bogus" in text
+
+
+def test_speccheck_resources_exceed_host(tmp_path):
+    findings = _speccheck_fixture(tmp_path, """
+    name: svc
+    pods:
+      web:
+        count: 1
+        tasks:
+          server:
+            goal: RUNNING
+            cmd: "serve"
+            cpus: 64
+            memory: 262144
+    """)
+    assert any(f.rule == "spec-resources" and "cpus" in f.message
+               for f in findings)
+
+
+def test_speccheck_gpus_key_and_file_suppression(tmp_path):
+    yaml = """
+    name: svc
+    pods:
+      web:
+        count: 1
+        tasks:
+          server:
+            goal: RUNNING
+            cmd: "serve"
+            gpus: 2
+    """
+    findings = _speccheck_fixture(tmp_path, yaml)
+    assert any(f.rule == "no-gpus-resource" for f in findings)
+    suppressed = "# sdklint: disable-file=no-gpus-resource — negative example\n" + yaml
+    findings = _speccheck_fixture(tmp_path, suppressed)
+    assert not [f for f in findings if f.rule == "no-gpus-resource"]
+
+
+def test_speccheck_bad_options_schema(tmp_path):
+    findings = _speccheck_fixture(
+        tmp_path,
+        """
+        name: svc
+        pods:
+          web:
+            count: 1
+            tasks:
+              server:
+                goal: RUNNING
+                cmd: "serve"
+        """,
+        options={"properties": {"web": {"properties": {
+            "count": {"type": "integer"}  # no default, not required
+        }}}},
+    )
+    assert any(f.rule == "spec-options" for f in findings)
+
+
+# -- lock-order checker -----------------------------------------------
+
+
+def test_lockcheck_reports_inverse_order_cycle(tmp_path):
+    lockcheck.install()
+    try:
+        lockcheck.reset()
+        a = threading.Lock()
+        b = threading.Lock()
+
+        def order_ab():
+            with a:
+                with b:
+                    pass
+
+        def order_ba():
+            with b:
+                with a:
+                    pass
+
+        # run the two orderings SEQUENTIALLY: the graph records both
+        # nestings without ever actually deadlocking
+        t1 = threading.Thread(target=order_ab, daemon=True)
+        t1.start(); t1.join(timeout=5)
+        t2 = threading.Thread(target=order_ba, daemon=True)
+        t2.start(); t2.join(timeout=5)
+        rep = lockcheck.report()
+        assert len(rep.cycles) == 1, rep.describe()
+        assert len(rep.cycles[0]) == 2
+        assert "DEADLOCK RISK" in rep.describe()
+    finally:
+        lockcheck.uninstall()
+        lockcheck.reset()
+
+
+def test_lockcheck_consistent_order_is_clean():
+    lockcheck.install()
+    try:
+        lockcheck.reset()
+        a = threading.Lock()
+        b = threading.Lock()
+
+        def nested():
+            with a:
+                with b:
+                    pass
+
+        for _ in range(3):
+            t = threading.Thread(target=nested, daemon=True)
+            t.start(); t.join(timeout=5)
+        rep = lockcheck.report()
+        assert rep.cycles == [], rep.describe()
+        assert len(rep.edges) == 1
+    finally:
+        lockcheck.uninstall()
+        lockcheck.reset()
+
+
+def test_lockcheck_rlock_reentry_no_self_edge():
+    lockcheck.install()
+    try:
+        lockcheck.reset()
+        lock = threading.RLock()
+
+        def reenter():
+            with lock:
+                with lock:
+                    pass
+
+        t = threading.Thread(target=reenter, daemon=True)
+        t.start(); t.join(timeout=5)
+        rep = lockcheck.report()
+        assert rep.edges == {} and rep.cycles == []
+    finally:
+        lockcheck.uninstall()
+        lockcheck.reset()
+
+
+def test_lockcheck_watch_flags_cross_thread_unguarded_write():
+    lockcheck.install()
+    try:
+        lockcheck.reset()
+        guard = threading.Lock()
+
+        class Shared:
+            def __init__(self):
+                self.value = 0
+
+        shared = Shared()
+        lockcheck.watch(shared)
+
+        def locked_writer():
+            with guard:
+                shared.value = 1
+
+        def unlocked_writer():
+            shared.value = 2
+
+        for target in (locked_writer, unlocked_writer):
+            t = threading.Thread(target=target, daemon=True)
+            t.start(); t.join(timeout=5)
+        rep = lockcheck.report()
+        assert any("Shared.value" in w for w in rep.unguarded_writes), \
+            rep.describe()
+    finally:
+        lockcheck.uninstall()
+        lockcheck.reset()
+
+
+def test_lockcheck_uninstall_restores_factories():
+    before = threading.Lock
+    lockcheck.install()
+    assert threading.Lock is not before
+    # locks created while installed keep working after uninstall
+    lock = threading.Lock()
+    lockcheck.uninstall()
+    assert threading.Lock is before
+    with lock:
+        assert lock.locked()
+    assert not lock.locked()
+
+
+def test_self_attr_writes_tuple_unpack_does_not_mutate_ast():
+    """Regression: tuple-assignment expansion must not append into the
+    live AST node — repeated passes (multiple rules walk one tree)
+    would otherwise see duplicated targets and duplicate findings."""
+    import ast as ast_mod
+
+    from dcos_commons_tpu.analysis.rules import _self_attr_writes
+
+    tree = ast_mod.parse("class C:\n    def m(self):\n        self.a, self.b = 1, 2\n")
+    assign = tree.body[0].body[0].body[0]
+    before = len(assign.targets)
+    first = sorted(attr for attr, _ in _self_attr_writes(tree))
+    second = sorted(attr for attr, _ in _self_attr_writes(tree))
+    assert first == second == ["a", "b"]
+    assert len(assign.targets) == before
+
+
+def test_speccheck_strategy_conflicts_with_dependencies(tmp_path):
+    findings = _speccheck_fixture(tmp_path, """
+    name: svc
+    pods:
+      web:
+        count: 1
+        tasks:
+          server:
+            goal: RUNNING
+            cmd: "serve"
+    plans:
+      deploy:
+        strategy: serial
+        phases:
+          one:
+            pod: web
+          two:
+            pod: web
+            dependencies: [one]
+    """)
+    assert any(f.rule == "spec-plan" and "conflicts" in f.message
+               for f in findings)
+
+
+def test_speccheck_findings_anchor_to_declaring_line(tmp_path):
+    """Pod/plan findings land on the declaring YAML line, so the
+    on-the-line suppression contract holds for them too."""
+    yaml = """
+    name: svc
+    pods:
+      web:
+        count: 3
+        tasks:
+          a:
+            goal: RUNNING
+            cmd: "a"
+            ports:
+              http:
+                port: 8080
+    """
+    findings = _speccheck_fixture(tmp_path, yaml)
+    ports = [f for f in findings if f.rule == "spec-ports"]
+    assert ports and ports[0].line > 1
+    # line-level suppression on the pod declaration silences it
+    suppressed = yaml.replace(
+        "  web:", "  web:  # sdklint: disable=spec-ports — host-net by design"
+    )
+    findings = _speccheck_fixture(tmp_path, suppressed)
+    assert not [f for f in findings if f.rule == "spec-ports"]
+
+
+def test_speccheck_options_json_escape_hatch(tmp_path):
+    """options.json is JSON (no comments): a top-level
+    x-sdklint-disable list suppresses framework-wide."""
+    schema = {"properties": {"web": {"properties": {
+        "count": {"type": "integer"}  # no default, not required
+    }}}}
+    findings = _speccheck_fixture(
+        tmp_path,
+        """
+        name: svc
+        pods:
+          web:
+            count: 1
+            tasks:
+              server:
+                goal: RUNNING
+                cmd: "serve"
+        """,
+        options=schema,
+    )
+    assert any(f.rule == "spec-options" for f in findings)
+    schema["x-sdklint-disable"] = ["spec-options"]
+    findings = _speccheck_fixture(
+        tmp_path,
+        """
+        name: svc
+        pods:
+          web:
+            count: 1
+            tasks:
+              server:
+                goal: RUNNING
+                cmd: "serve"
+        """,
+        options=schema,
+    )
+    assert not [f for f in findings if f.rule == "spec-options"]
+
+
+def test_suppression_accepts_plain_hyphen_rationale(tmp_path):
+    """Regression: 'disable=rule - reason' (ASCII hyphen, not em-dash)
+    must suppress — the rationale separator grammar accepts '#', EOL,
+    em-dash, '--', and ' - '."""
+    src = (
+        "import time\n\ndef f():\n"
+        "    time.sleep(1)  # sdklint: disable=no-blocking-sleep - foreign pid\n"
+    )
+    findings, suppressed = _lint_fixture(
+        tmp_path, src, rule_id="no-blocking-sleep"
+    )
+    assert not findings and len(suppressed) == 1
+
+
+def test_lock_discipline_sees_except_handler_writes(tmp_path):
+    """Regression: writes inside except-handler bodies (error-recovery
+    paths) must not be invisible to the lock-discipline walker."""
+    src = """
+    import threading
+
+    class Counter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._state = {}
+
+        def incr(self):
+            with self._lock:
+                self._state["n"] = 1
+
+        def recover(self):
+            try:
+                risky()
+            except Exception:
+                self._state = {}
+    """
+    findings, _ = _lint_fixture(tmp_path, src, rule_id="lock-discipline")
+    assert len(findings) == 1 and "recover" in findings[0].message
+
+
+def test_lockcheck_watch_guarded_write_does_not_mask_unguarded():
+    """Regression: a thread that wrote once under the lock and once
+    without must still be reported (AND across writes, not OR)."""
+    lockcheck.install()
+    try:
+        lockcheck.reset()
+        guard = threading.Lock()
+
+        class Shared2:
+            def __init__(self):
+                self.value = 0
+
+        shared = Shared2()
+        lockcheck.watch(shared)
+
+        def mixed_writer():
+            with guard:
+                shared.value = 1   # guarded...
+            shared.value = 2       # ...then unguarded: taints thread
+
+        def guarded_writer():
+            with guard:
+                shared.value = 3
+
+        for target in (mixed_writer, guarded_writer):
+            t = threading.Thread(target=target, daemon=True)
+            t.start(); t.join(timeout=5)
+        rep = lockcheck.report()
+        assert any("Shared2.value" in w for w in rep.unguarded_writes), \
+            rep.describe()
+    finally:
+        lockcheck.uninstall()
+        lockcheck.reset()

@@ -9,6 +9,8 @@ import json
 import urllib.error
 import urllib.request
 
+import pytest
+
 from dcos_commons_tpu.common import TaskState, TaskStatus
 from dcos_commons_tpu.http import ApiServer
 from dcos_commons_tpu.multi import (
@@ -20,6 +22,16 @@ from dcos_commons_tpu.scheduler import SchedulerConfig
 from dcos_commons_tpu.specification.yaml_spec import from_yaml
 from dcos_commons_tpu.storage import MemPersister
 from dcos_commons_tpu.testing import FakeAgent
+
+
+@pytest.fixture(autouse=True)
+def _lock_order_checker():
+    """Multi-service mode nests MultiServiceScheduler._lock over each
+    per-service DefaultScheduler._lock; the lock-order checker fails
+    the test if any cycle (deadlock risk) shows up in that graph."""
+    from conftest import lockcheck_guard
+
+    yield from lockcheck_guard()
 
 
 def svc_yaml(name, count=1):

@@ -444,3 +444,82 @@ plans:
     assert len(plan.phases[0].steps) == 3
     assert isinstance(plan.phases[0].strategy, ParallelStrategy)
     assert plan.phases[1].steps[0].requirement.tasks_to_launch == ["init"]
+
+
+# -- YAML phase dependencies (DAG plans) ------------------------------
+
+
+DEPS_YAML = YAML + """
+plans:
+  deploy:
+    phases:
+      first:
+        pod: once
+      second:
+        pod: hello
+        dependencies: [first]
+"""
+
+
+def test_generator_phase_dependencies_gate_ordering():
+    """`dependencies:` builds a DependencyStrategy plan: a phase is
+    not a candidate until every prerequisite phase completed."""
+    spec = from_yaml(DEPS_YAML)
+    store = StateStore(MemPersister())
+    plan = PlanGenerator().generate(
+        spec, "deploy", spec.plans["deploy"], store, "c"
+    )
+    assert isinstance(plan.strategy, DependencyStrategy)
+    candidates = plan.strategy.candidates(plan.phases, set())
+    assert [p.name for p in candidates] == ["first"]
+    # completing the prerequisite unlocks the dependent phase
+    for step in plan.phases[0].steps:
+        step.force_complete()
+    candidates = plan.strategy.candidates(plan.phases, set())
+    assert [p.name for p in candidates] == ["second"]
+
+
+def test_generator_rejects_unknown_dependency():
+    from dcos_commons_tpu.specification import SpecError
+
+    bad = DEPS_YAML.replace("dependencies: [first]",
+                            "dependencies: [nonexistent]")
+    spec = from_yaml(bad)
+    store = StateStore(MemPersister())
+    with pytest.raises(SpecError) as err:
+        PlanGenerator().generate(
+            spec, "deploy", spec.plans["deploy"], store, "c"
+        )
+    assert "unknown phase" in str(err.value)
+
+
+def test_generator_rejects_dependency_cycle():
+    from dcos_commons_tpu.specification import SpecError
+
+    bad = DEPS_YAML.replace(
+        "      first:\n        pod: once\n",
+        "      first:\n        pod: once\n        dependencies: [second]\n",
+    )
+    assert "dependencies: [second]" in bad  # replacement anchored
+    spec = from_yaml(bad)
+    store = StateStore(MemPersister())
+    with pytest.raises(SpecError) as err:
+        PlanGenerator().generate(
+            spec, "deploy", spec.plans["deploy"], store, "c"
+        )
+    assert "cycle" in str(err.value)
+
+
+def test_generator_rejects_strategy_with_dependencies():
+    from dcos_commons_tpu.specification import SpecError
+
+    bad = DEPS_YAML.replace("plans:\n  deploy:\n",
+                            "plans:\n  deploy:\n    strategy: serial\n")
+    assert "strategy: serial" in bad
+    spec = from_yaml(bad)
+    store = StateStore(MemPersister())
+    with pytest.raises(SpecError) as err:
+        PlanGenerator().generate(
+            spec, "deploy", spec.plans["deploy"], store, "c"
+        )
+    assert "cannot be combined" in str(err.value)

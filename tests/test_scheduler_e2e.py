@@ -10,6 +10,7 @@ task kill -> recovery, scheduler restart).
 import os
 import time
 
+import pytest
 
 from dcos_commons_tpu.agent import LocalProcessAgent
 from dcos_commons_tpu.common import TaskState
@@ -18,6 +19,17 @@ from dcos_commons_tpu.recovery.monitor import TestingFailureMonitor
 from dcos_commons_tpu.scheduler import SchedulerBuilder, SchedulerConfig
 from dcos_commons_tpu.specification import from_yaml
 from dcos_commons_tpu.storage import FileWalPersister, MemPersister
+
+@pytest.fixture(autouse=True)
+def _lock_order_checker():
+    """sdklint's dynamic half rides every e2e test here: the scheduler
+    cycle nests DefaultScheduler._lock over state-store/plan/agent
+    locks, and any cycle observed in that nesting graph is a latent
+    deadlock the static rules cannot see."""
+    from conftest import lockcheck_guard
+
+    yield from lockcheck_guard()
+
 
 HELLO_YAML = """
 name: hello-world

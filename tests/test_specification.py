@@ -501,3 +501,74 @@ def test_rlimit_yaml_malformed_values_raise_spec_error():
         with _pytest.raises(SpecError, match=match) as err:
             from_yaml(base.format(rl=bad_rl))
         assert "web" in str(err.value)
+
+
+# -- validation edge cases (validation.py hardening) -------------------
+
+
+def test_validate_topology_first_deploy_no_previous_spec():
+    """TpuTopologyCannotChange compares against the PREVIOUS target;
+    on first deploy there is none and every topology is acceptable —
+    the validator must not trip over old=None."""
+    from dcos_commons_tpu.specification.validation import (
+        tpu_topology_cannot_change,
+    )
+
+    assert tpu_topology_cannot_change(None, jax_spec()) == []
+    # and through the full default-validator run
+    validate_spec_change(None, jax_spec())
+
+
+def test_validate_multi_error_aggregation():
+    """One update violating several validators reports EVERY error in
+    one ConfigValidationError (reference: the updater collects all 19
+    validators' errors before rejecting) — not just the first."""
+    old = dataclasses.replace(jax_spec(), user="alice", region="us-east1")
+    new = dataclasses.replace(
+        jax_spec(), name="renamed", user="bob", region="eu-west4"
+    )
+    with pytest.raises(ConfigValidationError) as err:
+        validate_spec_change(old, new)
+    errors = err.value.errors
+    assert len(errors) >= 3
+    text = "; ".join(errors)
+    assert "name cannot change" in text
+    assert "user cannot change" in text
+    assert "region cannot change" in text
+    # str(exc) carries all of them too (the HTTP 400 payload path)
+    assert "user cannot change" in str(err.value)
+
+
+def test_validator_that_raises_vs_returns():
+    """A validator returning errors and one RAISING mid-run must both
+    surface — a crashing validator rejects the config naming the
+    broken check instead of aborting the remaining validators."""
+
+    def returns_errors(old, new):
+        return ["returned error"]
+
+    def crashes(old, new):
+        raise RuntimeError("boom")
+
+    def raises_validation_error(old, new):
+        raise ConfigValidationError(["raised-as-exception error"])
+
+    with pytest.raises(ConfigValidationError) as err:
+        validate_spec_change(
+            None,
+            jax_spec(),
+            validators=[returns_errors, crashes, raises_validation_error],
+        )
+    errors = err.value.errors
+    assert "returned error" in errors
+    assert "raised-as-exception error" in errors
+    assert any("crashes" in e and "boom" in e for e in errors)
+
+
+def test_validator_crash_alone_still_rejects():
+    def crashes(old, new):
+        raise ValueError("bad internal state")
+
+    with pytest.raises(ConfigValidationError) as err:
+        validate_spec_change(None, jax_spec(), validators=[crashes])
+    assert "crashed" in str(err.value)
