@@ -4,7 +4,7 @@
     python -m dcos_commons_tpu agent --host-id h0 --workdir ./sandbox
     python -m dcos_commons_tpu cli  <verb> ...
     python -m dcos_commons_tpu state-server --data-dir ./cluster-state
-    python -m dcos_commons_tpu analyze --all      # sdklint static analysis
+    python -m dcos_commons_tpu analyze            # static analysis: lint+specs+spmd+plan
 
 Reference: the pair of process mains the reference ships — the
 scheduler process (SchedulerRunner.java:82 via each framework's
@@ -48,8 +48,10 @@ def main(argv=None) -> int:
 
         return certs_main(rest)
     if command in ("analyze", "lint"):
-        # sdklint: framework lint + spec analyzer (same entry point as
-        # `python -m dcos_commons_tpu.analysis`)
+        # sdklint: framework lint + spec analyzer + spmdcheck +
+        # plancheck (same entry point as
+        # `python -m dcos_commons_tpu.analysis`); `analyze` with no
+        # arguments runs everything
         from dcos_commons_tpu.analysis.__main__ import main as analysis_main
 
         return analysis_main(rest)

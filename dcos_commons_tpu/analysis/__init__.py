@@ -4,7 +4,7 @@ Reference: the root build gates every module on checkstyle/findbugs
 before a single test runs (build.gradle's lint plugins — see
 tests/test_build_gate.py), and DefaultConfigurationUpdater runs 19
 config validators before a target config may go live.  This package
-is the code-level analogue for OUR invariants, three halves behind
+is the code-level analogue for OUR invariants, five analyzers behind
 one CLI (``python -m dcos_commons_tpu.analysis``):
 
 - **Framework lint** (`linter`, `rules`, `baseline`): AST rules over
@@ -23,6 +23,20 @@ one CLI (``python -m dcos_commons_tpu.analysis``):
   deploy-time failures at lint time — config-validator errors,
   unsatisfiable placement against the declared torus, conflicting
   ports, plan dependency cycles, and per-host resource overcommit.
+- **SPMD collective-safety analyzer** (`spmdcheck`): an
+  interprocedural AST pass over the data-plane layers (``parallel/``,
+  ``models/``, ``ops/``, ``utils/``, ``storage/``,
+  ``frameworks/jax``) that builds per-function collective summaries
+  and flags cross-host divergence hazards — collectives under
+  host-identity branches, device-varying control flow, unknown mesh
+  axes, unordered-iteration schedules, per-host loop trip counts.
+- **Plan model checker** (`plancheck`): a bounded explicit-state
+  checker that drives the REAL ``plan/`` objects through exhaustive
+  BFS over status arrivals, restarts, force-completes, interrupts,
+  and dependency unlocks (~10^4 deduped states), verifying
+  no-silent-regression, error-absorption, aggregate consistency,
+  dependency honoring, interrupt visibility, and livelock freedom —
+  violations come back as minimal event traces.
 """
 
 from dcos_commons_tpu.analysis.linter import (  # noqa: F401

@@ -1,23 +1,39 @@
-"""sdklint CLI: ``python -m dcos_commons_tpu.analysis``.
+"""sdklint CLI: ``python -m dcos_commons_tpu.analysis <command>``.
 
-    --lint              framework lint (AST rules + baseline)
-    --specs             ahead-of-time spec analyzer (frameworks/*)
-    --all               both (the CI gate; default when no mode given)
-    --update-baseline   rewrite the baseline from current lint findings
-    --catalog           print the rule catalog and exit
+Commands (also reachable as ``python -m dcos_commons_tpu analyze``):
+
+    lint     framework lint (AST rules + baseline)
+    specs    ahead-of-time spec analyzer (frameworks/*)
+    spmd     SPMD collective-safety analyzer (cross-host divergence)
+    plan     plan state-machine model checker (exhaustive BFS)
+    all      everything — the CI gate; default when no command given
+
+Flag spelling (``--lint``/``--specs``/``--spmd``/``--plan``/``--all``)
+is accepted too, composably: ``--lint --spmd`` runs exactly those two.
+
+Options:
+    --json              one machine-readable JSON document on stdout
+                        (findings per analyzer, plancheck.states_explored)
+    --update-baseline   rewrite the baseline from current lint+spmd findings
+    --catalog           print the rule catalogs and exit
     --root DIR          repo root (default: auto-detect from this file)
+    --plan-max-states N cap per plancheck configuration (default 200000)
+    --verbose/-v        also list suppressed and baselined findings
 
-Exit code 0 = no non-baselined findings; 1 = findings; 2 = bad usage.
-The gate test (tests/test_lint_gate.py) runs the same entry points
-in-process.
+Exit code 0 = no non-baselined findings and no plan violations;
+1 = findings; 2 = bad usage.  The gate test (tests/test_lint_gate.py)
+runs the same entry points in-process.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 from typing import List
+
+_COMMANDS = ("lint", "specs", "spmd", "plan", "all")
 
 
 def _default_root() -> str:
@@ -29,9 +45,15 @@ def _default_root() -> str:
 
 def main(argv: List[str] = None) -> int:
     from dcos_commons_tpu.analysis import baseline as baseline_mod
-    from dcos_commons_tpu.analysis import speccheck
+    from dcos_commons_tpu.analysis import plancheck, spmdcheck, speccheck
     from dcos_commons_tpu.analysis.linter import lint_tree
     from dcos_commons_tpu.analysis.rules import rule_catalog
+    from dcos_commons_tpu.analysis.spmdcheck import spmd_rule_catalog
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # subcommand spelling -> the equivalent mode flag
+    if argv and argv[0] in _COMMANDS:
+        argv[0] = f"--{argv[0]}"
 
     parser = argparse.ArgumentParser(
         prog="python -m dcos_commons_tpu.analysis",
@@ -39,11 +61,15 @@ def main(argv: List[str] = None) -> int:
     )
     parser.add_argument("--lint", action="store_true")
     parser.add_argument("--specs", action="store_true")
+    parser.add_argument("--spmd", action="store_true")
+    parser.add_argument("--plan", action="store_true")
     parser.add_argument("--all", action="store_true")
+    parser.add_argument("--json", action="store_true", dest="as_json")
     parser.add_argument("--update-baseline", action="store_true")
     parser.add_argument("--catalog", action="store_true")
     parser.add_argument("--root", default=_default_root())
     parser.add_argument("--baseline", default="")
+    parser.add_argument("--plan-max-states", type=int, default=200_000)
     parser.add_argument("--host-cpus", type=float, default=8.0)
     parser.add_argument("--host-mem", type=int, default=16384)
     parser.add_argument("--host-disk", type=int, default=102400)
@@ -55,43 +81,88 @@ def main(argv: List[str] = None) -> int:
 
     if args.catalog:
         print(rule_catalog())
+        print()
+        print(spmd_rule_catalog())
         return 0
 
-    run_lint = args.lint or args.all or not (args.lint or args.specs)
-    run_specs = args.specs or args.all or not (args.lint or args.specs)
+    any_mode = args.lint or args.specs or args.spmd or args.plan
+    run_lint = args.lint or args.all or not any_mode
+    run_specs = args.specs or args.all or not any_mode
+    run_spmd = args.spmd or args.all or not any_mode
+    run_plan = args.plan or args.all or not any_mode
     root = os.path.abspath(args.root)
     baseline_path = args.baseline or baseline_mod.baseline_path(root)
+    known = baseline_mod.load_baseline(baseline_path)
+    doc: dict = {}
     failed = False
 
-    if run_lint:
-        result = lint_tree(root)
+    def emit(line: str) -> None:
+        if not args.as_json:
+            print(line)
+
+    # lint + spmd share the baseline file; --update-baseline rewrites
+    # it from BOTH result sets so neither clobbers the other's entries
+    baseline_feed = []
+
+    def run_findings_pass(name: str, result) -> None:
+        nonlocal failed
         if args.update_baseline:
-            counts = baseline_mod.save_baseline(
-                baseline_path, result.findings
-            )
-            print(
-                f"baseline: {sum(counts.values())} finding(s) across "
-                f"{len(counts)} file/rule pair(s) -> {baseline_path}"
-            )
+            baseline_feed.extend(result.findings)
             fresh, absorbed = [], result.findings
         else:
-            known = baseline_mod.load_baseline(baseline_path)
             fresh, absorbed = baseline_mod.apply_baseline(
                 result.findings, known
             )
         for finding in fresh:
-            print(finding.render())
+            emit(finding.render())
         if args.verbose:
             for finding in absorbed:
-                print(f"{finding.render()}  [baselined]")
+                emit(f"{finding.render()}  [baselined]")
             for finding in result.suppressed:
-                print(f"{finding.render()}  [suppressed]")
-        print(
-            f"lint: {result.files_checked} files, "
+                emit(f"{finding.render()}  [suppressed]")
+        emit(
+            f"{name}: {result.files_checked} files, "
             f"{len(fresh)} new finding(s), {len(absorbed)} baselined, "
             f"{len(result.suppressed)} suppressed"
         )
+        doc[name] = {
+            "files_checked": result.files_checked,
+            "findings": [f.to_dict() for f in fresh],
+            "baselined": len(absorbed),
+            "suppressed": len(result.suppressed),
+        }
         failed |= bool(fresh)
+
+    if run_lint:
+        run_findings_pass("lint", lint_tree(root))
+
+    if run_spmd:
+        run_findings_pass("spmd", spmdcheck.analyze_tree(root))
+
+    if args.update_baseline:
+        if not (run_lint or run_spmd):
+            emit(
+                "baseline: nothing to update — only lint and spmd "
+                "feed the baseline; run one of them"
+            )
+        else:
+            # entries of the baseline-feeding pass that did NOT run
+            # survive verbatim: `--lint --update-baseline` must not
+            # erase triaged spmd debt it never recomputed (and vice
+            # versa)
+            retain = {}
+            for fp, count in known.items():
+                owned_by_spmd = fp.rsplit("::", 1)[-1].startswith("spmd-")
+                owner_ran = run_spmd if owned_by_spmd else run_lint
+                if not owner_ran:
+                    retain[fp] = count
+            counts = baseline_mod.save_baseline(
+                baseline_path, baseline_feed, retain=retain
+            )
+            emit(
+                f"baseline: {sum(counts.values())} finding(s) across "
+                f"{len(counts)} file/rule pair(s) -> {baseline_path}"
+            )
 
     if run_specs:
         host_model = speccheck.HostModel(
@@ -101,11 +172,47 @@ def main(argv: List[str] = None) -> int:
         )
         findings = speccheck.analyze_all(root, host_model)
         for finding in findings:
-            print(finding.render())
-        print(f"specs: {len(findings)} finding(s)")
+            emit(finding.render())
+        emit(f"specs: {len(findings)} finding(s)")
+        doc["specs"] = {
+            "findings": [f.to_dict() for f in findings],
+        }
         failed |= bool(findings)
 
-    return 1 if failed else 0
+    if run_plan:
+        summary = plancheck.check_all(max_states=args.plan_max_states)
+        emit(f"plan: {summary.states_explored} states explored")
+        emit(summary.render())
+        doc["plan"] = {
+            "states_explored": summary.states_explored,
+            "transitions": summary.transitions,
+            "configs": {
+                r.config: {
+                    "states": r.states,
+                    "transitions": r.transitions,
+                    "complete_states": r.complete_states,
+                    "truncated": r.truncated,
+                    "livelock_checked": r.livelock_checked,
+                    "violations": len(r.violations),
+                }
+                for r in summary.results
+            },
+            "violations": [
+                {
+                    "invariant": v.invariant,
+                    "detail": v.detail,
+                    "trace": list(v.trace),
+                }
+                for v in summary.violations
+            ],
+        }
+        failed |= not summary.ok
+
+    rc = 1 if failed else 0
+    if args.as_json:
+        doc["exit_code"] = rc
+        print(json.dumps(doc, indent=2, sort_keys=True))
+    return rc
 
 
 if __name__ == "__main__":

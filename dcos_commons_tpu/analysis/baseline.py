@@ -36,8 +36,19 @@ def load_baseline(path: str) -> Dict[str, int]:
     return {str(k): int(v) for k, v in entries.items()}
 
 
-def save_baseline(path: str, findings: Iterable[Finding]) -> Dict[str, int]:
+def save_baseline(
+    path: str,
+    findings: Iterable[Finding],
+    retain: Dict[str, int] = None,
+) -> Dict[str, int]:
+    """Write the baseline from ``findings``; ``retain`` carries
+    fingerprint counts that must survive the rewrite verbatim — the
+    entries of an analyzer that did NOT run this invocation (lint and
+    spmd share this file, and `--lint --update-baseline` must not
+    erase the spmd debt it never recomputed)."""
     counts = Counter(f.fingerprint for f in findings)
+    for fingerprint, count in (retain or {}).items():
+        counts[fingerprint] = max(counts[fingerprint], count)
     doc = {
         "comment": (
             "sdklint baseline: pre-existing violations tracked, not "

@@ -49,6 +49,15 @@ class Finding:
     def render(self) -> str:
         return f"{self.file}:{self.line}: [{self.rule}] {self.message}"
 
+    def to_dict(self) -> Dict[str, object]:
+        """Machine form for the CLI's ``--json`` output."""
+        return {
+            "file": self.file,
+            "line": self.line,
+            "rule": self.rule,
+            "message": self.message,
+        }
+
 
 class LintContext:
     """Everything a rule needs about one source file."""

@@ -1,0 +1,792 @@
+"""plancheck: a bounded explicit-state model checker for the plan tree.
+
+The reference SDK's ``plan/`` layer was hand-audited Java reviewed for
+years; this rebuild's scheduler trusts the same state machines under
+REORDERED status arrivals, operator verbs racing deploys, and gang
+recovery restarts.  Unit tests sample a handful of interleavings;
+this module explores ALL of them, bounded: it drives the *real*
+``Status``/``Step``/``Phase``/``Plan``/``Strategy`` objects (no
+abstract model to drift out of sync) through exhaustive breadth-first
+search over an event alphabet of
+
+- task status arrivals (RUNNING / FINISHED / FAILED / ERROR, plus a
+  stale status from a dead launch),
+- step launches (candidate -> ``start()`` -> ``record_launch``),
+- operator verbs (restart, force-complete, interrupt, proceed at
+  step / phase / plan level),
+
+deduplicating by a canonical snapshot of every mutable field, so the
+search visits each reachable *state* once (10^4–10^5 states per
+configuration).  BFS order means every reported violation comes with
+a MINIMAL event trace from the initial state.
+
+Invariants checked (see docs/developer-guide.md §9 for how to add
+one):
+
+- ``no-silent-regression``: a COMPLETE step only leaves COMPLETE via
+  an explicit restart verb.
+- ``error-absorbs``: an ERROR step stays ERROR until an operator
+  restart/force-complete.
+- ``aggregate-consistent``: ``status.aggregate`` is permutation-
+  insensitive over every child multiset the search actually reaches,
+  ERROR dominates, and all-COMPLETE <=> COMPLETE.
+- ``dependency-honored``: a DependencyStrategy phase never emits a
+  candidate whose dependency is not COMPLETE.
+- ``interrupt-visible``: an interrupted (WAITING) child is never
+  hidden behind IN_PROGRESS/PENDING at the parent while incomplete.
+- ``no-livelock``: every reachable state can still reach a
+  plan-COMPLETE state (checked on the full reachability graph, so
+  only sound when the exploration wasn't truncated by the cap).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from dcos_commons_tpu.common import TaskState, TaskStatus
+from dcos_commons_tpu.plan.backoff import Backoff
+from dcos_commons_tpu.plan.phase import Phase
+from dcos_commons_tpu.plan.plan import Plan
+from dcos_commons_tpu.plan.status import Status, aggregate
+from dcos_commons_tpu.plan.step import (
+    DeploymentStep,
+    PodInstanceRequirement,
+)
+from dcos_commons_tpu.plan.strategy import (
+    CanaryStrategy,
+    DependencyStrategy,
+    ParallelStrategy,
+    SerialStrategy,
+)
+from dcos_commons_tpu.specification.specs import GoalState, PodSpec, TaskSpec
+
+# deterministic task-id scheme: the model always launches the same id
+# per step, and delivers stale statuses under a distinct dead id
+_LIVE = "live"
+_STALE = "stale"
+_FAR_FUTURE = float("inf")
+
+
+class ModelBackoff(Backoff):
+    """DELAYED that never expires on its own: the checker explores the
+    backoff branch symbolically (restart/force-complete are the exits)
+    instead of racing the wall clock."""
+
+    def next_delay(self, key: str) -> float:
+        return _FAR_FUTURE
+
+    def clear(self, key: str) -> None:
+        pass
+
+    def current_delay(self, key: str) -> float:
+        return 0.0
+
+
+# -- snapshots --------------------------------------------------------------
+
+
+def _snap_step(step: DeploymentStep, quotient: bool = False) -> tuple:
+    if quotient and step._status is Status.COMPLETE and not step.errors:
+        # quotient: a COMPLETE step ignores every status (the
+        # is_complete guard) and every exit (restart) wipes the
+        # residue, so the _expected/_task_states left behind by
+        # force-complete are behaviorally dead — collapsing them cuts
+        # the state space several-fold without losing any behavior.
+        # NOT assumed: _quotient_probe() verifies the guard actually
+        # holds for these step objects before the quotient is enabled,
+        # so a step class that DOES react to post-COMPLETE statuses
+        # falls back to exhaustive snapshots and the regression is
+        # caught, not hidden.
+        return (Status.COMPLETE.value, step._interrupted)
+    return (
+        step._status.value,
+        step._interrupted,
+        tuple(sorted(step._expected.items())),
+        tuple(sorted((k, v.value) for k, v in step._task_states.items())),
+        tuple(sorted(step._task_ready.items())),
+        # canonical: the exact deadline is wall-clock noise — only
+        # "parked in backoff" vs "free" distinguishes behaviors
+        step._status is Status.DELAYED and step._delay_until > 0,
+        tuple(step.errors),
+    )
+
+
+def _restore_step(step: DeploymentStep, snap: tuple) -> None:
+    if len(snap) == 2:  # the COMPLETE quotient
+        step._status = Status.COMPLETE
+        step._interrupted = snap[1]
+        step._expected = {}
+        step._task_states = {}
+        step._task_ready = {}
+        step._delay_until = 0.0
+        step.errors.clear()
+        return
+    (status, interrupted, expected, states, ready, delayed,
+     errors) = snap
+    step._status = Status(status)
+    step._interrupted = interrupted
+    step._expected = dict(expected)
+    step._task_states = {k: TaskState(v) for k, v in states}
+    step._task_ready = dict(ready)
+    step._delay_until = _FAR_FUTURE if delayed else 0.0
+    step.errors[:] = list(errors)
+
+
+def _snap_strategy(strategy) -> tuple:
+    if isinstance(strategy, CanaryStrategy):
+        return (strategy._interrupted, strategy._proceeds)
+    return (strategy._interrupted,)
+
+
+def _restore_strategy(strategy, snap: tuple) -> None:
+    if isinstance(strategy, CanaryStrategy):
+        strategy._interrupted, strategy._proceeds = snap
+    else:
+        (strategy._interrupted,) = snap
+
+
+class PlanHarness:
+    """One plan instance + snapshot/restore + the event alphabet.
+
+    ``step_interrupts`` adds per-step interrupt/proceed verbs (doubles
+    each step's state space — worth it in one small configuration, not
+    in all of them; phase/plan interrupts are always in the alphabet).
+    """
+
+    def __init__(self, plan: Plan, step_interrupts: bool = False):
+        self.plan = plan
+        self.step_interrupts = step_interrupts
+        self.quotient = False  # enabled by _quotient_probe() only
+        self.steps: List[DeploymentStep] = [
+            s for p in plan.phases for s in p.steps
+        ]
+        self.strategies = [plan.strategy] + [
+            p.strategy for p in plan.phases
+        ]
+
+    def snapshot(self) -> tuple:
+        return (
+            tuple(_snap_step(s, self.quotient) for s in self.steps),
+            tuple(_snap_strategy(s) for s in self.strategies),
+        )
+
+    def restore(self, snap: tuple) -> None:
+        step_snaps, strat_snaps = snap
+        for step, ssnap in zip(self.steps, step_snaps):
+            _restore_step(step, ssnap)
+        for strategy, tsnap in zip(self.strategies, strat_snaps):
+            _restore_strategy(strategy, tsnap)
+
+    # -- events -------------------------------------------------------
+
+    def events(self) -> List[Tuple[str, Callable[[], None]]]:
+        """The full alphabet.  Enabledness is implicit: an event that
+        does not change the snapshot is a self-loop and is dropped by
+        the dedup, so "disabled" events cost one transition apply."""
+        out: List[Tuple[str, Callable[[], None]]] = []
+        for step in self.steps:
+            name = step.name
+            task, spec = next(iter(step._spec_by_full.items()))
+            out.append((f"launch({name})", self._launcher(step)))
+            statuses = [
+                ("RUNNING", TaskState.RUNNING, False),
+                ("FINISHED", TaskState.FINISHED, False),
+                ("FAILED", TaskState.FAILED, False),
+                ("TASK_ERROR", TaskState.ERROR, False),
+            ]
+            if spec.readiness_check is not None:
+                # only meaningful with a readiness gate; elsewhere it
+                # just doubles RUNNING
+                statuses.insert(1, ("READY", TaskState.RUNNING, True))
+            for label, state, ready in statuses:
+                out.append((
+                    f"status({name},{label})",
+                    self._status_sender(task, state, ready, _LIVE),
+                ))
+            # a status from a launch that no longer exists (reordered
+            # delivery across a restart) — must always be ignored
+            out.append((
+                f"status({name},STALE_FAILED)",
+                self._status_sender(task, TaskState.FAILED, False, _STALE),
+            ))
+            out.append((f"restart({name})", step.restart))
+            out.append((f"force_complete({name})", step.force_complete))
+            if self.step_interrupts:
+                out.append((f"interrupt({name})", step.interrupt))
+                out.append((f"proceed({name})", step.proceed))
+        for i, phase in enumerate(self.plan.phases):
+            out.append((f"interrupt(phase:{phase.name})", phase.interrupt))
+            out.append((f"proceed(phase:{phase.name})", phase.proceed))
+        out.append(("interrupt(plan)", self.plan.interrupt))
+        out.append(("proceed(plan)", self.plan.proceed))
+        return out
+
+    def _launcher(self, step: DeploymentStep) -> Callable[[], None]:
+        def launch() -> None:
+            # the offer cycle only starts CANDIDATES: mutual exclusion
+            # and ordering come from the strategies, exactly as in
+            # PlanCoordinator.process_offers
+            if step not in self.plan.candidates(set()):
+                return
+            requirement = step.start()
+            if requirement is None:
+                return
+            step.record_launch({
+                task: f"{task}__{_LIVE}"
+                for task in requirement.task_names()
+            })
+        return launch
+
+    def _status_sender(
+        self, task: str, state: TaskState, ready: bool, suffix: str
+    ) -> Callable[[], None]:
+        # one immutable TaskStatus per event, built once: update()
+        # never mutates the status, and the dataclass construction is
+        # measurable at ~10^6 transitions
+        status = TaskStatus(
+            task_id=f"{task}__{suffix}",
+            state=state,
+            ready=ready,
+            timestamp=1.0,
+        )
+
+        def send() -> None:
+            self.plan.update(status)
+        return send
+
+
+def _quotient_probe(harness: PlanHarness) -> bool:
+    """Verify the COMPLETE-residue quotient is sound for THESE step
+    objects: craft representative COMPLETE states still carrying
+    launch residue (expected ids, task states), fire every status and
+    launch event at them, and require the step to stay COMPLETE with
+    no errors.  A step class missing the is_complete guard (or a
+    strategy that launches completed steps) fails the probe, the
+    checker falls back to exhaustive snapshots, and the regression is
+    REPORTED by the search instead of being quotiented away.
+
+    Caller must restore the pre-probe snapshot afterwards.
+    """
+    events = harness.events()
+    for step in harness.steps:
+        task = next(iter(step._spec_by_full))
+        live = f"{task}__{_LIVE}"
+        running = TaskState.RUNNING.value
+        residues = [
+            # natural completion: expected + RUNNING (+ready)
+            (Status.COMPLETE.value, False, ((task, live),),
+             ((task, running),), ((task, True),), False, ()),
+            # force-complete mid-launch: expected, no states yet
+            (Status.COMPLETE.value, False, ((task, live),),
+             (), (), False, ()),
+        ]
+        mine = [
+            ev for label, ev in events
+            if label.startswith(f"status({step.name},")
+            or label == f"launch({step.name})"
+        ]
+        for residue in residues:
+            for ev in mine:
+                _restore_step(step, residue)
+                ev()
+                if step._status is not Status.COMPLETE or step.errors:
+                    return False
+    return True
+
+
+# -- invariants -------------------------------------------------------------
+
+
+@dataclass
+class Violation:
+    invariant: str
+    detail: str
+    trace: Tuple[str, ...]
+
+    def render(self) -> str:
+        steps = "\n".join(
+            f"    {i + 1}. {event}" for i, event in enumerate(self.trace)
+        ) or "    (initial state)"
+        return (
+            f"[{self.invariant}] {self.detail}\n"
+            f"  minimal trace ({len(self.trace)} events):\n{steps}"
+        )
+
+
+class Invariant:
+    """Base: override either hook.  ``on_transition`` sees the step
+    statuses before/after one event; ``on_state`` sees each NEW
+    deduplicated state once.  Return a violation detail string, or
+    None."""
+
+    name = ""
+
+    def on_transition(
+        self,
+        harness: PlanHarness,
+        before: Sequence[Status],
+        event: str,
+        after: Sequence[Status],
+    ) -> Optional[str]:
+        return None
+
+    def on_state(self, harness: PlanHarness) -> Optional[str]:
+        return None
+
+
+def _restart_scope(event: str, step_name: str) -> bool:
+    """True when ``event`` is a restart verb covering ``step_name``."""
+    return event.startswith("restart(")
+
+
+class NoSilentRegression(Invariant):
+    """COMPLETE only leaves COMPLETE via an explicit restart."""
+
+    name = "no-silent-regression"
+
+    def on_transition(self, harness, before, event, after):
+        for step, prev, cur in zip(harness.steps, before, after):
+            if prev is Status.COMPLETE and cur is not Status.COMPLETE:
+                if not _restart_scope(event, step.name):
+                    return (
+                        f"step {step.name} regressed COMPLETE -> "
+                        f"{cur.value} on {event} (only restart may do "
+                        "that)"
+                    )
+        return None
+
+
+class ErrorAbsorbs(Invariant):
+    """ERROR is sticky until an operator restart/force-complete."""
+
+    name = "error-absorbs"
+
+    def on_transition(self, harness, before, event, after):
+        for step, prev, cur in zip(harness.steps, before, after):
+            if prev is Status.ERROR and cur is not Status.ERROR:
+                if not (
+                    event.startswith("restart(")
+                    or event.startswith("force_complete(")
+                ):
+                    return (
+                        f"step {step.name} left ERROR -> {cur.value} on "
+                        f"{event} without operator intervention"
+                    )
+        return None
+
+
+class AggregateConsistent(Invariant):
+    """aggregate() is order-insensitive on every reached multiset,
+    ERROR dominates, all-COMPLETE <=> COMPLETE (non-empty)."""
+
+    name = "aggregate-consistent"
+
+    def __init__(self) -> None:
+        self._checked: set = set()
+
+    def on_state(self, harness):
+        groups: List[Tuple[Status, ...]] = [
+            tuple(s.get_status() for s in phase.steps)
+            for phase in harness.plan.phases
+        ]
+        groups.append(tuple(
+            p.get_status() for p in harness.plan.phases
+        ))
+        for statuses in groups:
+            for interrupted in (False, True):
+                key = (tuple(sorted(s.value for s in statuses)),
+                       interrupted)
+                if key in self._checked:
+                    continue
+                self._checked.add(key)
+                detail = self._check_multiset(statuses, interrupted)
+                if detail:
+                    return detail
+        return None
+
+    @staticmethod
+    def _check_multiset(statuses, interrupted):
+        base = aggregate(statuses, interrupted)
+        seq = list(statuses)
+        perms = (
+            itertools.permutations(seq) if len(seq) <= 4
+            else [seq, list(reversed(seq)),
+                  sorted(seq, key=lambda s: s.value)]
+        )
+        for perm in perms:
+            got = aggregate(perm, interrupted)
+            if got is not base:
+                return (
+                    f"aggregate({[s.value for s in seq]}, "
+                    f"interrupted={interrupted}) is order-sensitive: "
+                    f"{base.value} vs {got.value} for "
+                    f"{[s.value for s in perm]}"
+                )
+        if statuses:
+            all_complete = all(s is Status.COMPLETE for s in statuses)
+            if all_complete and base is not Status.COMPLETE:
+                return (
+                    f"aggregate of all-COMPLETE reads {base.value}"
+                )
+            if not all_complete and base is Status.COMPLETE:
+                return (
+                    f"aggregate({[s.value for s in statuses]}) reads "
+                    "COMPLETE with incomplete children"
+                )
+            if any(s is Status.ERROR for s in statuses) and \
+                    base is not Status.ERROR:
+                return (
+                    f"aggregate({[s.value for s in statuses]}) hides a "
+                    f"child ERROR behind {base.value}"
+                )
+        return None
+
+
+class DependencyHonored(Invariant):
+    """DependencyStrategy never emits a candidate whose declared
+    dependency is non-COMPLETE."""
+
+    name = "dependency-honored"
+
+    def on_state(self, harness):
+        for phase in harness.plan.phases:
+            strategy = phase.strategy
+            if not isinstance(strategy, DependencyStrategy):
+                continue
+            by_name = {s.name: s for s in phase.steps}
+            for cand in phase.candidates(set()):
+                for dep in strategy._edges.get(cand.name, ()):
+                    dep_step = by_name.get(dep)
+                    if dep_step is not None and not dep_step.is_complete:
+                        return (
+                            f"{cand.name} emitted as candidate while "
+                            f"dependency {dep} is "
+                            f"{dep_step.get_status().value}"
+                        )
+        return None
+
+
+class InterruptVisible(Invariant):
+    """An incomplete WAITING child surfaces at the parent: the
+    operator who parked a step must see WAITING in `plan show`, not a
+    parent claiming PENDING/IN_PROGRESS while nothing can move."""
+
+    name = "interrupt-visible"
+
+    def on_state(self, harness):
+        for phase in harness.plan.phases:
+            statuses = [s.get_status() for s in phase.steps]
+            parent = phase.get_status()
+            if (
+                Status.WAITING in statuses
+                and parent in (Status.PENDING, Status.IN_PROGRESS)
+                and not any(s.is_running for s in statuses)
+                and not any(
+                    s in (Status.PENDING, Status.DELAYED)
+                    for s in statuses
+                )
+            ):
+                return (
+                    f"phase {phase.name} reads {parent.value} but its "
+                    "only incomplete children are WAITING (interrupt "
+                    "hidden from the operator)"
+                )
+        return None
+
+
+def default_invariants() -> List[Invariant]:
+    return [
+        NoSilentRegression(),
+        ErrorAbsorbs(),
+        AggregateConsistent(),
+        DependencyHonored(),
+        InterruptVisible(),
+    ]
+
+
+# -- the checker ------------------------------------------------------------
+
+
+@dataclass
+class CheckResult:
+    config: str
+    states: int
+    transitions: int
+    complete_states: int
+    truncated: bool
+    violations: List[Violation] = field(default_factory=list)
+    livelock_checked: bool = False
+    # False = the probe found a step reacting to post-COMPLETE events
+    # and the run fell back to exhaustive (un-quotiented) snapshots
+    quotient: bool = True
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+
+def check_plan(
+    factory: Callable[[], Plan],
+    invariants: Optional[Iterable[Invariant]] = None,
+    max_states: int = 200_000,
+    max_violations: int = 5,
+    config_name: str = "plan",
+    check_livelock: bool = True,
+    step_interrupts: bool = False,
+) -> CheckResult:
+    """Exhaustively explore ``factory()``'s plan under the full event
+    alphabet; returns states explored, violations with minimal traces.
+
+    The factory is called once — exploration runs on the live object
+    graph via snapshot/restore, so the checker checks the REAL
+    production classes, not a transcription of them.
+    """
+    harness = PlanHarness(factory(), step_interrupts=step_interrupts)
+    invs = list(invariants) if invariants is not None \
+        else default_invariants()
+    events = harness.events()
+
+    pre_probe = harness.snapshot()
+    harness.quotient = _quotient_probe(harness)
+    harness.restore(pre_probe)
+    init = harness.snapshot()
+    # state -> (parent state, event label) for minimal-trace replay
+    parents: Dict[tuple, Optional[Tuple[tuple, str]]] = {init: None}
+    order: List[tuple] = [init]
+    edges: List[Tuple[int, int]] = []
+    index: Dict[tuple, int] = {init: 0}
+    complete: List[int] = []
+    violations: List[Violation] = []
+    transitions = 0
+    truncated = False
+
+    def trace_of(state: tuple, extra: Optional[str] = None) -> Tuple[str, ...]:
+        out: List[str] = []
+        cur = state
+        while parents[cur] is not None:
+            prev, label = parents[cur]
+            out.append(label)
+            cur = prev
+        out.reverse()
+        if extra:
+            out.append(extra)
+        return tuple(out)
+
+    def statuses_of() -> Tuple[Status, ...]:
+        return tuple(s.get_status() for s in harness.steps)
+
+    head = 0
+    while head < len(order):
+        state = order[head]
+        head += 1
+        harness.restore(state)
+        before = statuses_of()
+        if harness.plan.get_status() is Status.COMPLETE:
+            complete.append(index[state])
+        for label, apply_event in events:
+            harness.restore(state)
+            apply_event()
+            after = harness.snapshot()
+            transitions += 1
+            if after == state:
+                continue  # self-loop: disabled or no-op event
+            after_statuses = statuses_of()
+            for inv in invs:
+                detail = inv.on_transition(
+                    harness, before, label, after_statuses
+                )
+                if detail and len(violations) < max_violations:
+                    violations.append(Violation(
+                        inv.name, detail, trace_of(state, label)
+                    ))
+            if after in parents:
+                edges.append((index[state], index[after]))
+                continue
+            parents[after] = (state, label)
+            index[after] = len(order)
+            edges.append((index[state], index[after]))
+            order.append(after)
+            for inv in invs:
+                detail = inv.on_state(harness)
+                if detail and len(violations) < max_violations:
+                    violations.append(Violation(
+                        inv.name, detail, trace_of(after)
+                    ))
+            if len(order) >= max_states:
+                truncated = True
+                break
+        if truncated:
+            break
+
+    result = CheckResult(
+        config=config_name,
+        states=len(order),
+        transitions=transitions,
+        complete_states=len(complete),
+        truncated=truncated,
+        violations=violations,
+        quotient=harness.quotient,
+    )
+
+    # livelock: backward reachability from every plan-COMPLETE state.
+    # Only sound on the full graph — a truncated frontier could hold
+    # the missing escape path.
+    if check_livelock and not truncated:
+        result.livelock_checked = True
+        reach_complete = set(complete)
+        reverse: Dict[int, List[int]] = {}
+        for src, dst in edges:
+            reverse.setdefault(dst, []).append(src)
+        frontier = list(reach_complete)
+        while frontier:
+            node = frontier.pop()
+            for src in reverse.get(node, ()):
+                if src not in reach_complete:
+                    reach_complete.add(src)
+                    frontier.append(src)
+        if len(reach_complete) < len(order) and \
+                len(violations) < max_violations:
+            trapped = min(
+                i for i in range(len(order)) if i not in reach_complete
+            )
+            violations.append(Violation(
+                "no-livelock",
+                f"{len(order) - len(reach_complete)} reachable state(s) "
+                "can never reach plan COMPLETE; first trapped state "
+                "shown",
+                trace_of(order[trapped]),
+            ))
+    return result
+
+
+# -- built-in configurations ------------------------------------------------
+
+
+def _pod(name: str, readiness: bool = False,
+         goal: GoalState = GoalState.RUNNING) -> PodSpec:
+    from dcos_commons_tpu.specification.specs import ReadinessCheckSpec
+
+    return PodSpec(
+        type=name,
+        count=1,
+        tasks=[TaskSpec(
+            name="server", goal=goal, cmd="run",
+            readiness_check=(
+                ReadinessCheckSpec(cmd="check") if readiness else None
+            ),
+        )],
+    )
+
+
+def _step(name: str, pod_type: str, readiness: bool = False,
+          goal: GoalState = GoalState.RUNNING) -> DeploymentStep:
+    return DeploymentStep(
+        name,
+        PodInstanceRequirement(pod=_pod(pod_type, readiness, goal),
+                               instances=[0]),
+        backoff=ModelBackoff(),
+    )
+
+
+def _serial_plan() -> Plan:
+    phase1 = Phase(
+        "node", [_step("node-0", "na"), _step("node-1", "nb")],
+        SerialStrategy(),
+    )
+    phase2 = Phase("sidecar", [_step("sidecar-0", "sc")], SerialStrategy())
+    return Plan("deploy", [phase1, phase2], SerialStrategy())
+
+
+def _parallel_plan() -> Plan:
+    # readiness-gated task + a FINISH-goal sidecar: exercises the
+    # STARTED -> COMPLETE readiness edge and the FINISHED mapping
+    phase = Phase(
+        "node",
+        [_step("node-0", "pa", readiness=True),
+         _step("node-1", "pb", goal=GoalState.FINISH)],
+        ParallelStrategy(),
+    )
+    return Plan("deploy", [phase], SerialStrategy())
+
+
+def _dependency_plan() -> Plan:
+    phase = Phase(
+        "pipeline",
+        [_step("stage-a", "da"), _step("stage-b", "db"),
+         _step("stage-c", "dc")],
+        DependencyStrategy({"stage-b": ["stage-a"],
+                            "stage-c": ["stage-a"]}),
+    )
+    return Plan("deploy", [phase], SerialStrategy())
+
+
+def _canary_plan() -> Plan:
+    phase = Phase(
+        "node", [_step("canary-0", "ca"), _step("canary-1", "cb")],
+        CanaryStrategy(SerialStrategy(), canary_count=1),
+    )
+    return Plan("update", [phase], SerialStrategy())
+
+
+# name -> (factory, step_interrupts): per-step interrupt verbs only
+# where the extra state-space doubling buys new interleavings
+BUILTIN_CONFIGS: Dict[str, Tuple[Callable[[], Plan], bool]] = {
+    "serial-2phase": (_serial_plan, False),
+    "parallel": (_parallel_plan, True),
+    "dependency-dag": (_dependency_plan, False),
+    "canary": (_canary_plan, True),
+}
+
+
+@dataclass
+class PlanCheckSummary:
+    results: List[CheckResult]
+
+    @property
+    def states_explored(self) -> int:
+        return sum(r.states for r in self.results)
+
+    @property
+    def transitions(self) -> int:
+        return sum(r.transitions for r in self.results)
+
+    @property
+    def violations(self) -> List[Violation]:
+        return [v for r in self.results for v in r.violations]
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+    def render(self) -> str:
+        lines = []
+        for r in self.results:
+            flag = "" if not r.truncated else " (TRUNCATED)"
+            lines.append(
+                f"  {r.config}: {r.states} states, {r.transitions} "
+                f"transitions, {r.complete_states} complete, "
+                f"{len(r.violations)} violation(s){flag}"
+            )
+        for v in self.violations:
+            lines.append(v.render())
+        return "\n".join(lines)
+
+
+def check_all(
+    max_states: int = 200_000,
+    configs: Optional[
+        Dict[str, Tuple[Callable[[], Plan], bool]]
+    ] = None,
+) -> PlanCheckSummary:
+    """Run every built-in configuration; the CI gate entry point."""
+    results = []
+    for name, (factory, step_interrupts) in (
+        configs or BUILTIN_CONFIGS
+    ).items():
+        results.append(check_plan(
+            factory, max_states=max_states, config_name=name,
+            step_interrupts=step_interrupts,
+        ))
+    return PlanCheckSummary(results)

@@ -53,9 +53,19 @@ def aggregate(child_statuses: Iterable[Status], interrupted: bool = False) -> St
     """Roll child statuses up to a parent element.
 
     Reference: the aggregation rules in PlanUtils/Element.getStatus:
-    ERROR dominates; an interrupt shows WAITING while incomplete;
-    all-complete is COMPLETE; untouched is PENDING; otherwise
-    IN_PROGRESS (with DELAYED surfaced when nothing else is moving).
+    ERROR dominates; all-complete is COMPLETE; an interrupt — the
+    parent's own or ANY child's — shows WAITING while incomplete (the
+    operator who parked a step must see it in ``plan show``, not a
+    parent claiming IN_PROGRESS while nothing can move; plancheck's
+    ``interrupt-visible`` invariant found the old child-WAITING-
+    behind-IN_PROGRESS/DELAYED masking with a two-event trace);
+    untouched is PENDING; otherwise IN_PROGRESS, with DELAYED
+    surfaced when nothing else is moving.
+
+    Every clause is an any()/all() over the multiset, so the result
+    is permutation-invariant by construction — plancheck's
+    ``aggregate-consistent`` invariant and the hypothesis property
+    test (tests/test_plan_properties.py) both pin that down.
     """
     statuses = list(child_statuses)
     if not statuses:
@@ -64,13 +74,10 @@ def aggregate(child_statuses: Iterable[Status], interrupted: bool = False) -> St
         return Status.ERROR
     if all(s is Status.COMPLETE for s in statuses):
         return Status.COMPLETE
-    if interrupted:
+    if interrupted or any(s is Status.WAITING for s in statuses):
         return Status.WAITING
-    if all(s in (Status.PENDING, Status.WAITING) for s in statuses):
-        # children individually interrupted still read WAITING
-        return Status.WAITING if any(
-            s is Status.WAITING for s in statuses
-        ) else Status.PENDING
+    if all(s is Status.PENDING for s in statuses):
+        return Status.PENDING
     moving = [s for s in statuses if s.is_running]
     if not moving and any(s is Status.DELAYED for s in statuses):
         return Status.DELAYED

@@ -179,6 +179,12 @@ def main() -> int:
             np.zeros((batch, prompt_len), np.int32),
         )
 
+        # Intentional driver/follower split: BOTH sides of this branch
+        # run the identical collective sequence (one _broadcast_tick per
+        # tick, one gang generate per OP_GENERATE), so the schedules
+        # never diverge; the branch only decides who PRODUCES the
+        # payload that every rank consumes.
+        # sdklint: disable=spmd-host-branch — driver loops meet in the broadcast
         if rank != 0:
             # follower loop: meet rank 0 in every broadcast tick and
             # execute whatever it scheduled

@@ -62,6 +62,24 @@ def main() -> int:
         state, start = restore_checkpoint(ckpt_dir, state)
         params, opt_state = state["params"], state["opt_state"]
         start = start or 0
+        if contract["worker_count"] > 1:
+            # the checkpoint stamp came off LOCAL disk: if one host's
+            # sandbox holds step 80 and another's holds step 100, the
+            # training loops disagree on the trip count and the gang
+            # deadlocks in the shorter host's last allreduce
+            # (spmdcheck: spmd-per-host-trip-count).  Agree up front
+            # and fail the deploy loudly on divergence — recovery
+            # relaunches the gang, which beats a silent hang.
+            from jax.experimental import multihost_utils
+
+            starts = multihost_utils.process_allgather(jnp.int32(start))
+            if int(starts.min()) != int(starts.max()):
+                raise RuntimeError(
+                    "checkpoint step diverges across the gang: "
+                    f"{sorted(int(s) for s in starts)}; wipe the stale "
+                    "sandboxes or restore a shared CHECKPOINT_DIR"
+                )
+            start = int(starts[0])
         step_fn = make_train_step(config, optimizer, mesh=mesh, donate=False)
         batch = max(2, 2 * mesh.devices.size)
         data_dir = os.environ.get("DATA_DIR", "")

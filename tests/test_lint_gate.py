@@ -18,7 +18,12 @@ import textwrap
 import threading
 
 from dcos_commons_tpu.analysis import baseline as baseline_mod
-from dcos_commons_tpu.analysis import lockcheck, speccheck
+from dcos_commons_tpu.analysis import (
+    lockcheck,
+    plancheck,
+    speccheck,
+    spmdcheck,
+)
 from dcos_commons_tpu.analysis.__main__ import main as analysis_main
 from dcos_commons_tpu.analysis.linter import lint_paths, lint_tree
 from dcos_commons_tpu.analysis.rules import all_rules, rule_catalog
@@ -44,11 +49,16 @@ def test_repo_spec_analyzer_gate():
 
 
 def test_cli_all_exits_zero(capsys):
-    """The CI entry point: `python -m dcos_commons_tpu.analysis --all`."""
-    rc = analysis_main(["--all", "--root", REPO])
+    """The CI entry point: `python -m dcos_commons_tpu.analysis --all`
+    (lint + specs + spmd + plan; the plancheck cap is trimmed here —
+    test_plancheck_repo_gate owns the full-depth run)."""
+    rc = analysis_main([
+        "--all", "--root", REPO, "--plan-max-states", "1500",
+    ])
     out = capsys.readouterr().out
     assert rc == 0, out
     assert "lint:" in out and "specs:" in out
+    assert "spmd:" in out and "plan:" in out
 
 
 def test_rule_catalog_lists_every_rule():
@@ -814,3 +824,515 @@ def test_lockcheck_watch_guarded_write_does_not_mask_unguarded():
     finally:
         lockcheck.uninstall()
         lockcheck.reset()
+
+
+# -- spmdcheck: the repo gate -----------------------------------------
+
+
+def test_spmdcheck_repo_gate():
+    """Zero non-baselined SPMD findings across the data-plane layers;
+    the one in-tree suppression (serve_gang_worker's driver/follower
+    split) is annotated as intentional."""
+    result = spmdcheck.analyze_tree(REPO)
+    known = baseline_mod.load_baseline(baseline_mod.baseline_path(REPO))
+    fresh, _ = baseline_mod.apply_baseline(result.findings, known)
+    assert not fresh, "\n".join(f.render() for f in fresh)
+    assert result.files_checked >= 20
+
+
+def test_spmd_rule_catalog_lists_every_rule():
+    catalog = spmdcheck.spmd_rule_catalog()
+    for rule in spmdcheck.all_spmd_rules():
+        assert rule.id in catalog
+
+
+# -- spmdcheck: per-rule fixtures (caught + suppressed) ---------------
+
+
+def _spmd_fixture(source, rule_id, extra_files=()):
+    """Run spmdcheck over one in-memory fixture module (plus optional
+    companions for interprocedural cases); returns (findings,
+    suppressed) filtered to rule_id."""
+    files = [(
+        "/fix/dcos_commons_tpu/parallel/mod.py",
+        "dcos_commons_tpu/parallel/mod.py",
+        textwrap.dedent(source),
+    )]
+    for i, src in enumerate(extra_files):
+        files.append((
+            f"/fix/dcos_commons_tpu/parallel/extra{i}.py",
+            f"dcos_commons_tpu/parallel/extra{i}.py",
+            textwrap.dedent(src),
+        ))
+    result = spmdcheck.analyze_paths(files)
+    pick = lambda fs: [f for f in fs if f.rule == rule_id]  # noqa: E731
+    return pick(result.findings), pick(result.suppressed)
+
+
+def test_spmd_rule_host_branch():
+    src = """
+    import jax
+    from jax import lax
+
+    def f(x):
+        if jax.process_index() == 0:
+            return lax.psum(x, "dp")
+        return x
+    """
+    findings, _ = _spmd_fixture(src, "spmd-host-branch")
+    assert len(findings) == 1 and "psum" in findings[0].message
+    suppressed_src = src.replace(
+        "if jax.process_index() == 0:",
+        "if jax.process_index() == 0:  "
+        "# sdklint: disable=spmd-host-branch — leader-only barrier",
+    )
+    findings, suppressed = _spmd_fixture(suppressed_src, "spmd-host-branch")
+    assert not findings and len(suppressed) == 1
+
+
+def test_spmd_rule_host_branch_interprocedural():
+    """The collective three calls away from the rank branch — the
+    reason spmdcheck is whole-program, not per-file."""
+    helper = """
+    from jax import lax
+
+    def sync_all(x):
+        return lax.all_gather(x, "dp")
+    """
+    src = """
+    from dcos_commons_tpu.parallel.extra0 import sync_all
+
+    def f(x, contract):
+        rank = contract["worker_id"]
+        if rank != 0:
+            return sync_all(x)
+        return x
+    """
+    findings, _ = _spmd_fixture(src, "spmd-host-branch",
+                                extra_files=[helper])
+    assert len(findings) == 1 and "all_gather" in findings[0].message
+    # without the collective in the callee, the same branch is clean
+    findings, _ = _spmd_fixture(
+        src, "spmd-host-branch",
+        extra_files=[helper.replace(
+            'lax.all_gather(x, "dp")', "x + 1"
+        )],
+    )
+    assert not findings
+
+
+def test_spmd_rule_traced_cond():
+    src = """
+    from jax import lax
+
+    def f(x):
+        idx = lax.axis_index("dp")
+        if idx == 0:
+            x = lax.psum(x, "dp")
+        return x
+    """
+    findings, _ = _spmd_fixture(src, "spmd-traced-cond")
+    assert len(findings) == 1
+    # lax.cond spelling with a collective-bearing branch function
+    cond_src = """
+    from jax import lax
+
+    def branch(x):
+        return lax.psum(x, "dp")
+
+    def f(x):
+        idx = lax.axis_index("dp")
+        return lax.cond(idx == 0, branch, lambda y: y, x)
+    """
+    findings, _ = _spmd_fixture(cond_src, "spmd-traced-cond")
+    assert len(findings) == 1 and "cond" in findings[0].message
+    # collective-free branches under a varying predicate are the
+    # CORRECT pattern (pipeline_loss_fn's last-rank loss) — clean
+    clean = cond_src.replace('lax.psum(x, "dp")', "x * 2")
+    findings, _ = _spmd_fixture(clean, "spmd-traced-cond")
+    assert not findings
+    suppressed_src = src.replace(
+        "if idx == 0:",
+        "if idx == 0:  # sdklint: disable=spmd-traced-cond — uniform by construction",
+    )
+    findings, suppressed = _spmd_fixture(suppressed_src, "spmd-traced-cond")
+    assert not findings and len(suppressed) == 1
+
+
+def test_spmd_rule_unknown_axis():
+    src = """
+    from jax import lax
+    from jax.sharding import Mesh
+
+    def build(devices):
+        return Mesh(devices, ("dp", "tp"))
+
+    def f(x):
+        return lax.psum(x, "model")
+    """
+    findings, _ = _spmd_fixture(src, "spmd-unknown-axis")
+    assert len(findings) == 1 and "'model'" in findings[0].message
+    # a declared axis is fine; dynamic axis args are not judged
+    findings, _ = _spmd_fixture(
+        src.replace('lax.psum(x, "model")', 'lax.psum(x, "tp")'),
+        "spmd-unknown-axis",
+    )
+    assert not findings
+    suppressed_src = src.replace(
+        'return lax.psum(x, "model")',
+        'return lax.psum(x, "model")  '
+        "# sdklint: disable=spmd-unknown-axis — bound by the caller's mesh",
+    )
+    findings, suppressed = _spmd_fixture(suppressed_src, "spmd-unknown-axis")
+    assert not findings and len(suppressed) == 1
+
+
+def test_spmd_rule_unordered_iter():
+    src = """
+    from jax import lax
+
+    def f(x, hosts):
+        for h in set(hosts):
+            x = lax.ppermute(x, "dp", [(0, 1)])
+        return x
+    """
+    findings, _ = _spmd_fixture(src, "spmd-unordered-iter")
+    assert len(findings) == 1
+    # a permute table comprehended out of a set, fed to the collective
+    perm_src = """
+    from jax import lax
+
+    def f(x, pairs):
+        perm = [(a, b) for a, b in set(pairs)]
+        return lax.ppermute(x, "dp", perm)
+    """
+    findings, _ = _spmd_fixture(perm_src, "spmd-unordered-iter")
+    assert len(findings) == 1 and "perm" in findings[0].message
+    # sorted() restores a cross-host-deterministic order — clean
+    findings, _ = _spmd_fixture(
+        src.replace("set(hosts)", "sorted(set(hosts))"),
+        "spmd-unordered-iter",
+    )
+    assert not findings
+    suppressed_src = src.replace(
+        "for h in set(hosts):",
+        "for h in set(hosts):  "
+        "# sdklint: disable=spmd-unordered-iter — singleton set",
+    )
+    findings, suppressed = _spmd_fixture(
+        suppressed_src, "spmd-unordered-iter"
+    )
+    assert not findings and len(suppressed) == 1
+
+
+def test_spmd_rule_per_host_trip_count():
+    src = """
+    import jax
+    from jax import lax
+
+    def f(x):
+        steps = len(jax.local_devices())
+        for i in range(steps):
+            x = lax.psum(x, "dp")
+        return x
+    """
+    findings, _ = _spmd_fixture(src, "spmd-per-host-trip-count")
+    assert len(findings) == 1
+    # agreeing on the bound through a uniformizing collective cleanses
+    agreed = """
+    import jax
+    from jax import lax
+    from jax.experimental import multihost_utils
+
+    def f(x):
+        steps = len(jax.local_devices())
+        agreed = multihost_utils.process_allgather(steps)
+        steps = int(agreed[0])
+        for i in range(steps):
+            x = lax.psum(x, "dp")
+        return x
+    """
+    findings, _ = _spmd_fixture(agreed, "spmd-per-host-trip-count")
+    assert not findings
+    # jit-built step functions count as mesh programs (GSPMD inserts
+    # the collectives even when none are spelled out)
+    jit_src = """
+    import jax
+
+    def f(x):
+        start = len(jax.local_devices())
+        step = jax.jit(lambda y: y + 1)
+        for i in range(start):
+            x = step(x)
+        return x
+    """
+    findings, _ = _spmd_fixture(jit_src, "spmd-per-host-trip-count")
+    assert len(findings) == 1
+    suppressed_src = src.replace(
+        "for i in range(steps):",
+        "for i in range(steps):  "
+        "# sdklint: disable=spmd-per-host-trip-count — single-host tool",
+    )
+    findings, suppressed = _spmd_fixture(
+        suppressed_src, "spmd-per-host-trip-count"
+    )
+    assert not findings and len(suppressed) == 1
+
+
+def test_spmd_module_level_driver_analyzed():
+    """A worker script with its collective branch at TOP level (no
+    main() wrapper) is the same divergence hazard — the module body is
+    analyzed as a pseudo-function."""
+    src = """
+    import jax
+    from jax import lax
+
+    x = jax.numpy.ones(4)
+    if jax.process_index() == 0:
+        x = lax.psum(x, "dp")
+    """
+    findings, _ = _spmd_fixture(src, "spmd-host-branch")
+    assert len(findings) == 1 and "<module>" in findings[0].message
+
+
+def test_update_baseline_subset_retains_other_analyzer(tmp_path):
+    """Regression: lint and spmd share the baseline file, so
+    `--lint --update-baseline` (the command the baseline's own comment
+    prescribes) must not erase triaged spmd entries it never
+    recomputed — and vice versa."""
+    pkg = tmp_path / "dcos_commons_tpu" / "parallel"
+    pkg.mkdir(parents=True)
+    # one lint finding (blocking sleep) + one spmd finding (host branch)
+    (tmp_path / "dcos_commons_tpu" / "legacy.py").write_text(
+        "import time\n\ndef poll():\n    time.sleep(1)\n"
+    )
+    (pkg / "driver.py").write_text(textwrap.dedent("""
+        import jax
+        from jax import lax
+
+        def f(x):
+            if jax.process_index() == 0:
+                return lax.psum(x, "dp")
+            return x
+    """))
+    root = str(tmp_path)
+    rc = analysis_main(["--lint", "--spmd", "--update-baseline",
+                        "--root", root])
+    assert rc == 0
+    both = baseline_mod.load_baseline(baseline_mod.baseline_path(root))
+    assert any("spmd-host-branch" in k for k in both)
+    assert any("no-blocking-sleep" in k for k in both)
+    # subset update: lint alone must keep the spmd entry verbatim
+    rc = analysis_main(["--lint", "--update-baseline", "--root", root])
+    assert rc == 0
+    after = baseline_mod.load_baseline(baseline_mod.baseline_path(root))
+    assert after == both
+    # and both passes still gate clean against the retained file
+    rc = analysis_main(["--lint", "--spmd", "--root", root])
+    assert rc == 0
+    # modes that feed no baseline refuse to rewrite it
+    rc = analysis_main(["--specs", "--update-baseline", "--root", root])
+    assert baseline_mod.load_baseline(
+        baseline_mod.baseline_path(root)
+    ) == both
+
+
+# -- plancheck: the repo gate -----------------------------------------
+
+
+def test_plancheck_repo_gate():
+    """The full-depth model-check of the REAL plan machinery: every
+    built-in configuration fully explored (no truncation, so the
+    livelock check is sound), >= 10,000 deduped states total, zero
+    invariant violations."""
+    summary = plancheck.check_all(max_states=120_000)
+    assert summary.ok, summary.render()
+    assert summary.states_explored >= 10_000, summary.render()
+    for result in summary.results:
+        assert not result.truncated, result.config
+        assert result.livelock_checked, result.config
+        assert result.complete_states > 0, result.config
+
+
+# -- plancheck: seeded bugs produce minimal traces --------------------
+
+
+def _model_plan(steps, strategy):
+    from dcos_commons_tpu.plan.phase import Phase
+    from dcos_commons_tpu.plan.plan import Plan
+    from dcos_commons_tpu.plan.strategy import SerialStrategy
+
+    return Plan(
+        "deploy", [Phase("phase", steps, strategy)], SerialStrategy()
+    )
+
+
+def test_plancheck_catches_broken_dependency_strategy():
+    """Seeded bug: a DependencyStrategy that forgets to check deps.
+    plancheck reports dependency-honored with a MINIMAL trace — one
+    event is enough to expose stage-b running before stage-a."""
+    from dcos_commons_tpu.plan.strategy import (
+        DependencyStrategy,
+        _eligible,
+    )
+
+    class BrokenDeps(DependencyStrategy):
+        def _candidates(self, children, dirty_assets):
+            return [c for c in children if _eligible(c, dirty_assets)]
+
+    def factory():
+        return _model_plan(
+            [plancheck._step("stage-a", "da"),
+             plancheck._step("stage-b", "db")],
+            BrokenDeps({"stage-b": ["stage-a"]}),
+        )
+
+    result = plancheck.check_plan(
+        factory, config_name="seeded-deps", max_states=30_000
+    )
+    violations = [
+        v for v in result.violations if v.invariant == "dependency-honored"
+    ]
+    assert violations, result
+    assert len(violations[0].trace) == 1, violations[0]
+    assert violations[0].trace[0] == "launch(stage-a)"
+
+
+def test_plancheck_catches_complete_regression():
+    """Seeded bug: a step missing DeploymentStep's is_complete guard
+    (step.py:251) — a reordered late FAILED yanks a finished step back
+    to DELAYED.  The quotient probe detects the class is unsafe,
+    disables the COMPLETE quotient, and the search reports
+    no-silent-regression with a 3-event minimal trace."""
+    from dcos_commons_tpu.common import TaskState, task_name_of
+    from dcos_commons_tpu.plan.step import (
+        DeploymentStep,
+        PodInstanceRequirement,
+    )
+    from dcos_commons_tpu.plan.strategy import SerialStrategy
+
+    class RegressingStep(DeploymentStep):
+        def update(self, status):
+            with self._lock:
+                try:
+                    name = task_name_of(status.task_id)
+                except ValueError:
+                    return
+                if name not in self._expected:
+                    return
+                # BUG under test: no is_complete guard, no stale check
+                self._task_states[name] = status.state
+                if status.ready:
+                    self._task_ready[name] = True
+                if status.state is not TaskState.ERROR:
+                    self._recompute(failed=status.state.is_failure)
+
+    def factory():
+        step = RegressingStep(
+            "node-0",
+            PodInstanceRequirement(
+                pod=plancheck._pod("na"), instances=[0]
+            ),
+            backoff=plancheck.ModelBackoff(),
+        )
+        return _model_plan([step], SerialStrategy())
+
+    result = plancheck.check_plan(
+        factory, config_name="seeded-regress", max_states=30_000
+    )
+    assert not result.quotient  # the probe caught the unsafe class
+    violations = [
+        v for v in result.violations
+        if v.invariant == "no-silent-regression"
+    ]
+    assert violations, result
+    trace = violations[0].trace
+    assert len(trace) == 3, violations[0].render()
+    assert trace[0] == "launch(node-0)"
+    assert "FAILED" in trace[-1] or "STALE" in trace[-1]
+
+
+def test_plancheck_quotient_probe_passes_for_real_step():
+    """The production DeploymentStep keeps its is_complete guard, so
+    the probe enables the verified COMPLETE quotient."""
+    result = plancheck.check_plan(
+        plancheck._parallel_plan, config_name="probe",
+        max_states=30_000, step_interrupts=True,
+    )
+    assert result.quotient
+    assert result.ok, result.violations
+
+
+def test_plancheck_stale_status_never_mutates():
+    """A status from a dead launch is a no-op in every reachable
+    state: no transition labeled STALE ever produced a new state (the
+    checker's dedup would have recorded it otherwise).  Checked
+    indirectly: exploring WITHOUT the stale event yields the same
+    state count."""
+    base = plancheck.check_plan(
+        plancheck._parallel_plan, config_name="with-stale",
+        max_states=30_000,
+    )
+    harness_events = plancheck.PlanHarness(
+        plancheck._parallel_plan()
+    ).events()
+    assert any("STALE" in label for label, _ in harness_events)
+    assert base.ok
+
+
+# -- CLI: subcommands + --json ----------------------------------------
+
+
+def test_cli_subcommand_spellings(capsys):
+    """`spmd` and `plan` run as positional subcommands."""
+    rc = analysis_main(["spmd", "--root", REPO])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "spmd:" in out and "lint:" not in out
+    rc = analysis_main(["plan", "--plan-max-states", "800"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "plan:" in out and "states explored" in out
+
+
+def test_cli_json_output(capsys):
+    """--json emits one machine-readable document with per-analyzer
+    findings and the plancheck.states_explored metric."""
+    rc = analysis_main([
+        "--all", "--json", "--root", REPO, "--plan-max-states", "800",
+    ])
+    out = capsys.readouterr().out
+    doc = json.loads(out)
+    assert rc == 0
+    assert doc["exit_code"] == 0
+    assert doc["lint"]["findings"] == []
+    assert doc["spmd"]["findings"] == []
+    assert doc["spmd"]["suppressed"] == 1  # the annotated driver split
+    assert doc["specs"]["findings"] == []
+    assert doc["plan"]["states_explored"] >= 800
+    assert doc["plan"]["violations"] == []
+    assert set(doc["plan"]["configs"]) == set(plancheck.BUILTIN_CONFIGS)
+
+
+def test_cli_json_reports_findings(tmp_path, capsys):
+    """Findings surface in the JSON document and flip the exit code."""
+    bad = tmp_path / "dcos_commons_tpu" / "parallel" / "bad.py"
+    bad.parent.mkdir(parents=True)
+    bad.write_text(textwrap.dedent("""
+        import jax
+        from jax import lax
+
+        def f(x):
+            if jax.process_index() == 0:
+                return lax.psum(x, "dp")
+            return x
+    """))
+    rc = analysis_main([
+        "--spmd", "--json", "--root", str(tmp_path),
+    ])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert doc["exit_code"] == 1
+    assert any(
+        f["rule"] == "spmd-host-branch" for f in doc["spmd"]["findings"]
+    )

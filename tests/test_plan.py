@@ -341,6 +341,28 @@ def test_plan_interrupt_waiting():
     assert plan.get_status() == Status.PENDING
 
 
+def test_child_interrupt_surfaces_as_waiting():
+    """A parked child dominates the rollup while incomplete — the
+    aggregate fix plancheck's interrupt-visible invariant forced
+    (minimal trace: force_complete(step-0); interrupt(step-1) used to
+    read IN_PROGRESS, hiding the operator's own interrupt)."""
+    spec = from_yaml(YAML)
+    plan = DeployPlanFactory().build(spec, StateStore(MemPersister()), "c")
+    steps = plan.phases[0].steps
+    steps[0].force_complete()
+    steps[1].interrupt()
+    assert plan.phases[0].get_status() == Status.WAITING
+    assert plan.get_status() == Status.WAITING
+    # the interrupt stays visible even while a sibling is moving
+    drive_to_running(steps[2])
+    assert plan.get_status() == Status.WAITING
+    steps[1].proceed()
+    assert plan.get_status() == Status.IN_PROGRESS
+    for step in plan.all_steps():
+        step.force_complete()
+    assert plan.get_status() == Status.COMPLETE
+
+
 def test_coordinator_dirty_assets():
     spec = from_yaml(YAML)
     store = StateStore(MemPersister())

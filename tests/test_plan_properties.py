@@ -1,0 +1,115 @@
+"""Property-based tests for the plan status algebra.
+
+plancheck (analysis/plancheck.py) verifies ``aggregate`` on the
+multisets the plan state machines actually reach; these properties
+pin the algebra down over EVERY multiset up to size 5 — permutation
+invariance (a scheduler must report the same plan status regardless
+of the order status arrivals interleaved children into the list),
+the COMPLETE/ERROR dominance laws, interrupt visibility, and
+monotonicity along the working chain.
+
+The old ``aggregate`` failed interrupt visibility — a WAITING child
+next to a COMPLETE or DELAYED one was masked behind IN_PROGRESS /
+DELAYED — found by plancheck's ``interrupt-visible`` invariant with a
+two-event trace (``force_complete(node-0); interrupt(node-1)``) and
+fixed in plan/status.py by making WAITING dominate while incomplete.
+"""
+
+import itertools
+
+import pytest
+
+pytest.importorskip(
+    "hypothesis", reason="property tests need the hypothesis package"
+)
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+from dcos_commons_tpu.plan.status import Status, aggregate  # noqa: E402
+
+statuses_up_to_5 = st.lists(
+    st.sampled_from(list(Status)), min_size=0, max_size=5
+)
+interrupted_flag = st.booleans()
+
+# the per-child deployment progression; parents move PENDING ->
+# IN_PROGRESS -> COMPLETE as children advance along it
+WORKING_CHAIN = [
+    Status.PENDING,
+    Status.PREPARED,
+    Status.STARTING,
+    Status.STARTED,
+    Status.COMPLETE,
+]
+_PARENT_RANK = {
+    Status.PENDING: 0,
+    Status.IN_PROGRESS: 1,
+    Status.COMPLETE: 2,
+}
+
+
+@settings(max_examples=400, deadline=None)
+@given(statuses_up_to_5, interrupted_flag)
+def test_aggregate_is_permutation_invariant(children, interrupted):
+    """Order-insensitivity over ALL status multisets up to size 5:
+    reordered status arrivals must never change the rollup."""
+    base = aggregate(children, interrupted)
+    for perm in itertools.permutations(children):
+        assert aggregate(perm, interrupted) is base, (
+            f"aggregate order-sensitive: {children} -> {base}, "
+            f"{list(perm)} -> {aggregate(perm, interrupted)}"
+        )
+
+
+@settings(max_examples=400, deadline=None)
+@given(statuses_up_to_5, interrupted_flag)
+def test_aggregate_dominance_laws(children, interrupted):
+    """ERROR dominates; non-empty all-COMPLETE <=> COMPLETE; an
+    incomplete interrupt (parent or child) reads WAITING."""
+    got = aggregate(children, interrupted)
+    if not children:
+        assert got is Status.COMPLETE
+        return
+    if any(s is Status.ERROR for s in children):
+        assert got is Status.ERROR
+        return
+    if all(s is Status.COMPLETE for s in children):
+        assert got is Status.COMPLETE
+        return
+    assert got is not Status.COMPLETE
+    # interrupt visibility: the regression plancheck found — a parked
+    # child must surface as WAITING, never hide behind IN_PROGRESS
+    if interrupted or any(s is Status.WAITING for s in children):
+        assert got is Status.WAITING
+
+
+@settings(max_examples=400, deadline=None)
+@given(
+    st.lists(st.sampled_from(WORKING_CHAIN), min_size=1, max_size=5),
+    st.integers(min_value=0, max_value=4),
+)
+def test_aggregate_is_monotone_on_working_chain(children, pick):
+    """Advancing one child along PENDING -> ... -> COMPLETE never
+    moves the parent BACKWARDS (deploy progress is monotone)."""
+    pick %= len(children)
+    child = children[pick]
+    idx = WORKING_CHAIN.index(child)
+    before = aggregate(children)
+    for upgrade in WORKING_CHAIN[idx + 1:]:
+        advanced = list(children)
+        advanced[pick] = upgrade
+        after = aggregate(advanced)
+        assert _PARENT_RANK[after] >= _PARENT_RANK[before], (
+            f"aggregate regressed {before} -> {after} when "
+            f"{child} advanced to {upgrade} in {children}"
+        )
+
+
+def test_aggregate_waiting_over_delayed():
+    """The specific mix the old code got wrong: an operator interrupt
+    next to a crash-loop backoff reads WAITING (the interrupt is the
+    operator's own action; the backoff is incidental)."""
+    assert aggregate([Status.WAITING, Status.DELAYED]) is Status.WAITING
+    assert aggregate([Status.DELAYED, Status.WAITING]) is Status.WAITING
+    assert aggregate([Status.WAITING, Status.COMPLETE]) is Status.WAITING
+    # no interrupt anywhere: backoff still surfaces when nothing moves
+    assert aggregate([Status.DELAYED, Status.COMPLETE]) is Status.DELAYED
