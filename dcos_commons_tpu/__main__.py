@@ -4,7 +4,7 @@
     python -m dcos_commons_tpu agent --host-id h0 --workdir ./sandbox
     python -m dcos_commons_tpu cli  <verb> ...
     python -m dcos_commons_tpu state-server --data-dir ./cluster-state
-    python -m dcos_commons_tpu analyze            # static analysis: lint+specs+spmd+plan
+    python -m dcos_commons_tpu analyze            # static analysis: lint+specs+spmd+plan+shard
 
 Reference: the pair of process mains the reference ships — the
 scheduler process (SchedulerRunner.java:82 via each framework's
@@ -49,7 +49,7 @@ def main(argv=None) -> int:
         return certs_main(rest)
     if command in ("analyze", "lint"):
         # sdklint: framework lint + spec analyzer + spmdcheck +
-        # plancheck (same entry point as
+        # plancheck + shardcheck (same entry point as
         # `python -m dcos_commons_tpu.analysis`); `analyze` with no
         # arguments runs everything
         from dcos_commons_tpu.analysis.__main__ import main as analysis_main

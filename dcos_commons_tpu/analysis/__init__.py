@@ -4,7 +4,7 @@ Reference: the root build gates every module on checkstyle/findbugs
 before a single test runs (build.gradle's lint plugins — see
 tests/test_build_gate.py), and DefaultConfigurationUpdater runs 19
 config validators before a target config may go live.  This package
-is the code-level analogue for OUR invariants, five analyzers behind
+is the code-level analogue for OUR invariants, six analyzers behind
 one CLI (``python -m dcos_commons_tpu.analysis``):
 
 - **Framework lint** (`linter`, `rules`, `baseline`): AST rules over
@@ -30,6 +30,13 @@ one CLI (``python -m dcos_commons_tpu.analysis``):
   and flags cross-host divergence hazards — collectives under
   host-identity branches, device-varying control flow, unknown mesh
   axes, unordered-iteration schedules, per-host loop trip counts.
+- **Sharding analyzer** (`shardcheck`): abstract (shape/dtype-only)
+  evaluation of the REAL sharding rules, mesh derivation, and model
+  initializers for every ``frameworks/jax`` YAML rendered with its
+  options defaults — divisibility of mesh axes into sharded dims,
+  unknown PartitionSpec axes, accidentally replicated giant params,
+  per-chip/per-host HBM footprint vs the spec's declared budget, and
+  a ring-vs-all-gather collective-cost estimate per training step.
 - **Plan model checker** (`plancheck`): a bounded explicit-state
   checker that drives the REAL ``plan/`` objects through exhaustive
   BFS over status arrivals, restarts, force-completes, interrupts,

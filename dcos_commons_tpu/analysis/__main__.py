@@ -6,18 +6,23 @@ Commands (also reachable as ``python -m dcos_commons_tpu analyze``):
     specs    ahead-of-time spec analyzer (frameworks/*)
     spmd     SPMD collective-safety analyzer (cross-host divergence)
     plan     plan state-machine model checker (exhaustive BFS)
+    shard    static sharding / HBM-footprint / collective-cost analyzer
     all      everything — the CI gate; default when no command given
 
-Flag spelling (``--lint``/``--specs``/``--spmd``/``--plan``/``--all``)
-is accepted too, composably: ``--lint --spmd`` runs exactly those two.
+Flag spelling (``--lint``/.../``--shard``/``--all``) is accepted too,
+composably: ``--lint --spmd`` runs exactly those two.
 
 Options:
     --json              one machine-readable JSON document on stdout
-                        (findings per analyzer, plancheck.states_explored)
-    --update-baseline   rewrite the baseline from current lint+spmd findings
+                        (findings per analyzer, plancheck.states_explored,
+                        shard.footprint / shard.cost per analyzed pod)
+    --update-baseline   rewrite the baseline from current
+                        lint+spmd+shard findings
     --catalog           print the rule catalogs and exit
     --root DIR          repo root (default: auto-detect from this file)
     --plan-max-states N cap per plancheck configuration (default 200000)
+    --hbm-mb N          per-chip HBM budget override (0 = generation table)
+    --giant-mb N        replicated-param finding threshold (default 256)
     --verbose/-v        also list suppressed and baselined findings
 
 Exit code 0 = no non-baselined findings and no plan violations;
@@ -33,7 +38,7 @@ import os
 import sys
 from typing import List
 
-_COMMANDS = ("lint", "specs", "spmd", "plan", "all")
+_COMMANDS = ("lint", "specs", "spmd", "plan", "shard", "all")
 
 
 def _default_root() -> str:
@@ -45,9 +50,15 @@ def _default_root() -> str:
 
 def main(argv: List[str] = None) -> int:
     from dcos_commons_tpu.analysis import baseline as baseline_mod
-    from dcos_commons_tpu.analysis import plancheck, spmdcheck, speccheck
+    from dcos_commons_tpu.analysis import (
+        plancheck,
+        shardcheck,
+        speccheck,
+        spmdcheck,
+    )
     from dcos_commons_tpu.analysis.linter import lint_tree
     from dcos_commons_tpu.analysis.rules import rule_catalog
+    from dcos_commons_tpu.analysis.shardcheck import shard_rule_catalog
     from dcos_commons_tpu.analysis.spmdcheck import spmd_rule_catalog
 
     argv = list(sys.argv[1:] if argv is None else argv)
@@ -63,6 +74,7 @@ def main(argv: List[str] = None) -> int:
     parser.add_argument("--specs", action="store_true")
     parser.add_argument("--spmd", action="store_true")
     parser.add_argument("--plan", action="store_true")
+    parser.add_argument("--shard", action="store_true")
     parser.add_argument("--all", action="store_true")
     parser.add_argument("--json", action="store_true", dest="as_json")
     parser.add_argument("--update-baseline", action="store_true")
@@ -70,6 +82,8 @@ def main(argv: List[str] = None) -> int:
     parser.add_argument("--root", default=_default_root())
     parser.add_argument("--baseline", default="")
     parser.add_argument("--plan-max-states", type=int, default=200_000)
+    parser.add_argument("--hbm-mb", type=int, default=0)
+    parser.add_argument("--giant-mb", type=float, default=256.0)
     parser.add_argument("--host-cpus", type=float, default=8.0)
     parser.add_argument("--host-mem", type=int, default=16384)
     parser.add_argument("--host-disk", type=int, default=102400)
@@ -83,13 +97,17 @@ def main(argv: List[str] = None) -> int:
         print(rule_catalog())
         print()
         print(spmd_rule_catalog())
+        print()
+        print(shard_rule_catalog())
         return 0
 
-    any_mode = args.lint or args.specs or args.spmd or args.plan
+    any_mode = (args.lint or args.specs or args.spmd or args.plan
+                or args.shard)
     run_lint = args.lint or args.all or not any_mode
     run_specs = args.specs or args.all or not any_mode
     run_spmd = args.spmd or args.all or not any_mode
     run_plan = args.plan or args.all or not any_mode
+    run_shard = args.shard or args.all or not any_mode
     root = os.path.abspath(args.root)
     baseline_path = args.baseline or baseline_mod.baseline_path(root)
     known = baseline_mod.load_baseline(baseline_path)
@@ -139,21 +157,40 @@ def main(argv: List[str] = None) -> int:
     if run_spmd:
         run_findings_pass("spmd", spmdcheck.analyze_tree(root))
 
+    if run_shard:
+        shard_result = shardcheck.analyze_all(
+            root, hbm_mb=args.hbm_mb, giant_mb=args.giant_mb
+        )
+        run_findings_pass("shard", shard_result)
+        doc["shard"]["footprint"] = {
+            r.key: dict(r.footprint, mesh=r.mesh, script=r.script)
+            for r in shard_result.reports
+        }
+        doc["shard"]["cost"] = {
+            r.key: r.cost
+            for r in shard_result.reports if r.cost is not None
+        }
+
     if args.update_baseline:
-        if not (run_lint or run_spmd):
+        if not (run_lint or run_spmd or run_shard):
             emit(
-                "baseline: nothing to update — only lint and spmd "
-                "feed the baseline; run one of them"
+                "baseline: nothing to update — only lint, spmd, and "
+                "shard feed the baseline; run one of them"
             )
         else:
-            # entries of the baseline-feeding pass that did NOT run
+            # entries of a baseline-feeding pass that did NOT run
             # survive verbatim: `--lint --update-baseline` must not
-            # erase triaged spmd debt it never recomputed (and vice
-            # versa)
+            # erase triaged spmd/shard debt it never recomputed (and
+            # vice versa)
             retain = {}
             for fp, count in known.items():
-                owned_by_spmd = fp.rsplit("::", 1)[-1].startswith("spmd-")
-                owner_ran = run_spmd if owned_by_spmd else run_lint
+                rule = fp.rsplit("::", 1)[-1]
+                if rule.startswith("spmd-"):
+                    owner_ran = run_spmd
+                elif rule.startswith("shard-"):
+                    owner_ran = run_shard
+                else:
+                    owner_ran = run_lint
                 if not owner_ran:
                     retain[fp] = count
             counts = baseline_mod.save_baseline(

@@ -14,6 +14,7 @@ the TPU rebuild ships so a user can stand up real training pods:
 
 from dcos_commons_tpu.models.transformer import (
     TransformerConfig,
+    config_from_env,
     init_params,
     loss_fn,
     make_train_step,
@@ -33,6 +34,7 @@ from dcos_commons_tpu.models.moe import (
     expert_shard_spec,
     init_moe_params,
     moe_ffn,
+    moe_sharding_rules,
 )
 from dcos_commons_tpu.models.mlp import MlpConfig, mlp_forward, mlp_init, mlp_train_step
 from dcos_commons_tpu.models.quantize import (
@@ -44,6 +46,7 @@ __all__ = [
     "MlpConfig",
     "MoEConfig",
     "TransformerConfig",
+    "config_from_env",
     "decode_step",
     "dequantize_weight",
     "expert_shard_spec",
@@ -59,6 +62,7 @@ __all__ = [
     "mlp_init",
     "mlp_train_step",
     "moe_ffn",
+    "moe_sharding_rules",
     "pipeline_forward",
     "pipeline_loss_fn",
     "pipeline_param_specs",

@@ -298,3 +298,25 @@ def expert_shard_spec():
         "w_up": P("ep", None, None),
         "w_down": P("ep", None, None),
     }
+
+
+def moe_sharding_rules(prefix: str = "", stacked: bool = False):
+    """Param path -> PartitionSpec for the jit/GSPMD path: experts over
+    ``ep``, then the scaling-book fsdp/tp split within each expert.
+
+    This is the layout transformer.sharding_rules consumes for the MoE
+    flagship (``stacked=True`` prepends the lax.scan layer axis);
+    keeping it beside the dispatch code means a dispatch-layout change
+    and its sharding change land in the same file.  The router stays
+    fully replicated — routing logits are f32 and tiny, and every
+    chip needs them before dispatch.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    lead = (None,) if stacked else ()
+    return {
+        f"{prefix}router": P(*lead, None, None),
+        f"{prefix}w_gate": P(*lead, "ep", "fsdp", "tp"),
+        f"{prefix}w_up": P(*lead, "ep", "fsdp", "tp"),
+        f"{prefix}w_down": P(*lead, "ep", "tp", "fsdp"),
+    }

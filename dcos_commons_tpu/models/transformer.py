@@ -110,6 +110,32 @@ class TransformerConfig:
 Params = Dict[str, Any]
 
 
+def config_from_env(env: Dict[str, str], **overrides) -> TransformerConfig:
+    """The scheduler-env -> TransformerConfig contract, in ONE place.
+
+    Every worker script (frameworks/jax/{train_worker,serve_worker,
+    serve_gang_worker}.py) AND the static sharding analyzer
+    (analysis/shardcheck.py) build their config through this function:
+    if the mapping drifted between a worker and the analyzer, the
+    analyzer would vouch for a model the pod never runs.  ``overrides``
+    are keyword fields applied on top (dtype, remat, ...).
+    """
+    fields = dict(
+        vocab=int(env.get("VOCAB", "8192")),
+        d_model=int(env.get("D_MODEL", "512")),
+        n_layers=int(env.get("N_LAYERS", "4")),
+        n_heads=int(env.get("N_HEADS", "8")),
+        n_kv_heads=int(env.get("N_KV_HEADS", "8")),
+        d_ff=int(env.get("D_FF", "1408")),
+        max_seq=int(env.get("SEQ_LEN", "1024")),
+        # MoE flagship: N_EXPERTS > 0 swaps dense SwiGLU for the
+        # ep-sharded mixture (models/moe.py)
+        n_experts=int(env.get("N_EXPERTS", "0")),
+    )
+    fields.update(overrides)
+    return TransformerConfig(**fields)
+
+
 def init_params(config: TransformerConfig, key: jax.Array) -> Params:
     """Stacked-layer param tree: every per-layer array has a leading
     n_layers axis consumed by lax.scan."""
@@ -176,12 +202,11 @@ def sharding_rules(config: TransformerConfig) -> Dict[str, P]:
         "final_norm": P(None),
     }
     if config.n_experts > 0:
-        rules.update({
-            "layers/router": P(None, None, None),
-            "layers/w_gate": P(None, "ep", "fsdp", "tp"),
-            "layers/w_up": P(None, "ep", "fsdp", "tp"),
-            "layers/w_down": P(None, "ep", "tp", "fsdp"),
-        })
+        # the expert-axis rules live next to the MoE model so the
+        # dispatch layout and its sharding can't drift apart
+        from dcos_commons_tpu.models.moe import moe_sharding_rules
+
+        rules.update(moe_sharding_rules(prefix="layers/", stacked=True))
     else:
         rules.update({
             "layers/w_gate": P(None, "fsdp", "tp"),

@@ -807,8 +807,12 @@ class OfferEvaluator:
             env[ENV_TPU_WORKER_COUNT] = str(
                 pod.count if pod.gang else len(requirement.instances)
             )
-            env[ENV_TPU_CHIPS_PER_HOST] = str(pod.tpu.chips_per_host)
-            env[ENV_TPU_GENERATION] = pod.tpu.generation
+            # the mesh slice of the contract comes from the spec
+            # itself (TpuSpec.mesh_env) — the same dict the static
+            # sharding analyzer evaluates, so launch and analysis
+            # cannot drift.  Claim-time slice vars (extra_env) agree
+            # by construction when both set TPU_NUM_SLICES.
+            env.update(pod.tpu.mesh_env())
             if chips:
                 # callers pass THIS host's chips (claim consumes per
                 # host; reuse gathers per instance); ';'-separated
@@ -821,8 +825,6 @@ class OfferEvaluator:
                     # allocation has no rectangular contract to claim,
                     # and a chip-less sidecar must get NEITHER var)
                     env[ENV_TPU_HOST_BOUNDS] = f"{bx},{by},1"
-            if pod.tpu.topology:
-                env[ENV_TPU_TOPOLOGY] = pod.tpu.topology
             if coordinator:
                 env[ENV_COORDINATOR_ADDRESS] = coordinator
         labels = {

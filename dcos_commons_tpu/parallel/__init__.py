@@ -21,6 +21,7 @@ from dcos_commons_tpu.parallel.collectives import (
 from dcos_commons_tpu.parallel.compat import shard_map
 from dcos_commons_tpu.parallel.mesh import (
     MeshSpec,
+    derive,
     make_mesh,
     mesh_from_env,
 )
@@ -30,6 +31,7 @@ from dcos_commons_tpu.parallel.distributed import initialize_from_env
 __all__ = [
     "MeshSpec",
     "collective_bandwidth",
+    "derive",
     "initialize_from_env",
     "make_mesh",
     "mesh_from_env",

@@ -75,33 +75,80 @@ def make_mesh(spec: MeshSpec, devices: Optional[Sequence] = None) -> Mesh:
     return Mesh(arr, ("dcn", "dp", "fsdp", "ep", "pp", "sp", "tp"))
 
 
-def mesh_from_env(env: Dict[str, str], n_devices: Optional[int] = None) -> Mesh:
-    """Derive a mesh from the scheduler's env contract.
+def derive(env: Dict[str, str], n_devices: Optional[int] = None) -> MeshSpec:
+    """Derive the MeshSpec from the scheduler's env contract — PURE
+    shape math, no device queries, so analyzers (analysis/shardcheck)
+    evaluate it abstractly and :func:`mesh_from_env` builds the real
+    mesh from the same derivation.
 
     TPU_TOPOLOGY "XxY" at TPU_CHIPS_PER_HOST chips/host: default to
     dp over hosts x tp within host — the layout the torus placement
-    guarantees is ICI-contiguous.
+    guarantees is ICI-contiguous.  Multi-slice gangs (TPU_NUM_SLICES)
+    lay a dcn axis over the slice boundary.
+
+    Without ``n_devices`` the chip count comes from the declared
+    topology (times slices), i.e. what the spec promises at deploy.
+    A declared TPU_TOPOLOGY whose per-slice chip count
+    TPU_CHIPS_PER_HOST does not divide raises SpecError: that spec can
+    never lay the promised host-aligned mesh, and silently falling
+    back to a pure-dp layout would train with a layout the operator
+    never asked for.  With no topology declared (ad-hoc envs, local
+    dryruns) the fallback stays graceful.
     """
-    n = n_devices if n_devices is not None else len(jax.devices())
+    from dcos_commons_tpu.specification.specs import SpecError
+
     chips_per_host = int(env.get("TPU_CHIPS_PER_HOST", "0") or 0)
     n_slices = int(env.get("TPU_NUM_SLICES", "1") or 1)
+    topology = env.get("TPU_TOPOLOGY", "")
+    if n_devices is None:
+        if topology:
+            try:
+                dims = [int(d) for d in topology.lower().split("x")]
+            except ValueError:
+                raise SpecError(f"bad topology {topology!r}")
+            if not dims or any(d <= 0 for d in dims):
+                raise SpecError(f"bad topology {topology!r}")
+            per_slice = 1
+            for d in dims:
+                per_slice *= d
+        else:
+            per_slice = max(chips_per_host, 1)
+        n = per_slice * max(n_slices, 1)
+    else:
+        n = n_devices
     if n_slices > 1 and n % n_slices == 0:
         # multi-slice gang: dcn (pure data parallel) over the slice
         # boundary, dp x tp within each slice over ICI
         per_slice = n // n_slices
         if chips_per_host and per_slice % chips_per_host == 0 \
                 and per_slice >= chips_per_host:
-            return make_mesh(MeshSpec(
+            return MeshSpec(
                 dcn=n_slices,
                 dp=per_slice // chips_per_host,
                 tp=chips_per_host,
-            ))
-        return make_mesh(MeshSpec(dcn=n_slices, dp=per_slice))
+            )
+        if chips_per_host and per_slice % chips_per_host and topology:
+            raise SpecError(
+                f"TPU_CHIPS_PER_HOST={chips_per_host} does not divide "
+                f"the {per_slice}-chip slice of topology {topology!r}: "
+                "no host-aligned mesh exists for this spec"
+            )
+        return MeshSpec(dcn=n_slices, dp=per_slice)
     if chips_per_host and n % chips_per_host == 0 and n > chips_per_host:
-        return make_mesh(
-            MeshSpec(dp=n // chips_per_host, tp=chips_per_host)
+        return MeshSpec(dp=n // chips_per_host, tp=chips_per_host)
+    if chips_per_host and n % chips_per_host and topology:
+        raise SpecError(
+            f"TPU_CHIPS_PER_HOST={chips_per_host} does not divide the "
+            f"{n} chips of topology {topology!r}: no host-aligned mesh "
+            "exists for this spec"
         )
-    return make_mesh(MeshSpec(dp=n))
+    return MeshSpec(dp=n)
+
+
+def mesh_from_env(env: Dict[str, str], n_devices: Optional[int] = None) -> Mesh:
+    """Build the Mesh :func:`derive` prescribes for this env contract."""
+    n = n_devices if n_devices is not None else len(jax.devices())
+    return make_mesh(derive(env, n))
 
 
 # -- sharding rules ---------------------------------------------------

@@ -76,6 +76,29 @@ class TpuSpec:
             total *= d
         return total if dims else self.chips_per_host
 
+    def mesh_env(self) -> Dict[str, str]:
+        """The mesh slice of the scheduler's task env contract.
+
+        One source of truth consumed by BOTH the launch path
+        (offer/evaluate.py task-env assembly) and the static sharding
+        analyzer (analysis/shardcheck.py): the worker derives its mesh
+        from exactly these variables (parallel/mesh.py ``derive``), so
+        an analyzer that assembled them independently could approve a
+        mesh the launched task never builds.  Slice-index variables
+        (TPU_NUM_SLICES/TPU_SLICE_INDEX) are claim-time facts and stay
+        with the claim path — here ``slices`` only widens the declared
+        shape for multi-slice pods.
+        """
+        env = {
+            "TPU_CHIPS_PER_HOST": str(self.chips_per_host),
+            "TPU_GENERATION": self.generation,
+        }
+        if self.topology:
+            env["TPU_TOPOLOGY"] = self.topology
+        if self.slices > 1:
+            env["TPU_NUM_SLICES"] = str(self.slices)
+        return env
+
 
 @dataclass(frozen=True)
 class PortSpec:

@@ -70,7 +70,7 @@ def main() -> int:
     from jax.sharding import PartitionSpec as P
 
     from dcos_commons_tpu.models import (
-        TransformerConfig,
+        config_from_env,
         generate,
         init_params,
     )
@@ -89,14 +89,8 @@ def main() -> int:
         os.remove("ready")
     except OSError:
         pass
-    config = TransformerConfig(
-        vocab=int(os.environ.get("VOCAB", "8192")),
-        d_model=int(os.environ.get("D_MODEL", "512")),
-        n_layers=int(os.environ.get("N_LAYERS", "4")),
-        n_heads=8,
-        n_kv_heads=8,
-        d_ff=int(os.environ.get("D_FF", "1408")),
-        max_seq=int(os.environ.get("SEQ_LEN", "1024")),
+    config = config_from_env(
+        os.environ,
         dtype=jnp.bfloat16 if os.environ.get(
             "JAX_PLATFORMS"
         ) != "cpu" else jnp.float32,

@@ -26,7 +26,11 @@ def main() -> int:
     import jax.numpy as jnp
     import optax
 
-    from dcos_commons_tpu.models import TransformerConfig, init_params, make_train_step
+    from dcos_commons_tpu.models import (
+        config_from_env,
+        init_params,
+        make_train_step,
+    )
     from dcos_commons_tpu.parallel.mesh import mesh_from_env
     from dcos_commons_tpu.utils import (
         enable_compilation_cache,
@@ -42,16 +46,9 @@ def main() -> int:
     steps = int(os.environ.get("TRAIN_STEPS", "100"))
     ckpt_dir = os.environ.get("CHECKPOINT_DIR", "checkpoints")
     mesh = mesh_from_env(os.environ)
-    config = TransformerConfig(
-        vocab=int(os.environ.get("VOCAB", "8192")),
-        d_model=int(os.environ.get("D_MODEL", "512")),
-        n_layers=int(os.environ.get("N_LAYERS", "4")),
-        n_heads=8,
-        n_kv_heads=8,
-        d_ff=1408,
-        max_seq=int(os.environ.get("SEQ_LEN", "1024")),
-        dtype=jnp.bfloat16,
-    )
+    # the env->config contract lives in models/transformer.py so
+    # analysis/shardcheck verifies the EXACT model this pod trains
+    config = config_from_env(os.environ, dtype=jnp.bfloat16)
     optimizer = optax.adamw(3e-4)
     with mesh:
         params = init_params(config, jax.random.key(0))

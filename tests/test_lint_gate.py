@@ -21,6 +21,7 @@ from dcos_commons_tpu.analysis import baseline as baseline_mod
 from dcos_commons_tpu.analysis import (
     lockcheck,
     plancheck,
+    shardcheck,
     speccheck,
     spmdcheck,
 )
@@ -50,15 +51,15 @@ def test_repo_spec_analyzer_gate():
 
 def test_cli_all_exits_zero(capsys):
     """The CI entry point: `python -m dcos_commons_tpu.analysis --all`
-    (lint + specs + spmd + plan; the plancheck cap is trimmed here —
-    test_plancheck_repo_gate owns the full-depth run)."""
+    (lint + specs + spmd + plan + shard; the plancheck cap is trimmed
+    here — test_plancheck_repo_gate owns the full-depth run)."""
     rc = analysis_main([
         "--all", "--root", REPO, "--plan-max-states", "1500",
     ])
     out = capsys.readouterr().out
     assert rc == 0, out
     assert "lint:" in out and "specs:" in out
-    assert "spmd:" in out and "plan:" in out
+    assert "spmd:" in out and "plan:" in out and "shard:" in out
 
 
 def test_rule_catalog_lists_every_rule():
@@ -1312,6 +1313,24 @@ def test_cli_json_output(capsys):
     assert doc["plan"]["states_explored"] >= 800
     assert doc["plan"]["violations"] == []
     assert set(doc["plan"]["configs"]) == set(plancheck.BUILTIN_CONFIGS)
+    # the shard document: findings gate PLUS the footprint/cost trend
+    # keys bench tooling consumes
+    assert doc["shard"]["findings"] == []
+    footprint = doc["shard"]["footprint"]
+    assert "frameworks/jax/svc.yml:trainer" in footprint
+    trainer = footprint["frameworks/jax/svc.yml:trainer"]
+    assert trainer["per_chip_mb"] > 0
+    assert {"params", "grads", "opt", "activations"} <= set(
+        trainer["sections_mb"]
+    )
+    assert trainer["mesh"] == {"dp": 4, "tp": 4}
+    cost = doc["shard"]["cost"]["frameworks/jax/svc.yml:trainer"]
+    assert cost["total_ring_us"] > 0
+    for entry in cost["per_step"]:
+        assert {"axis", "ring_us", "allgather_us", "recommend"} <= set(
+            entry
+        )
+        assert entry["ring_mb_per_chip"] <= entry["allgather_mb_per_chip"]
 
 
 def test_cli_json_reports_findings(tmp_path, capsys):
@@ -1336,3 +1355,308 @@ def test_cli_json_reports_findings(tmp_path, capsys):
     assert any(
         f["rule"] == "spmd-host-branch" for f in doc["spmd"]["findings"]
     )
+
+
+# -- shardcheck: the repo gate ----------------------------------------
+
+
+def test_shardcheck_repo_gate():
+    """Every packaged jax YAML's sharding layout checks clean: meshes
+    derive, every PartitionSpec axis divides its dim, and the per-chip
+    footprint fits both the generation HBM and the declared memory."""
+    result = shardcheck.analyze_all(REPO)
+    known = baseline_mod.load_baseline(baseline_mod.baseline_path(REPO))
+    fresh, _ = baseline_mod.apply_baseline(result.findings, known)
+    assert not fresh, "\n".join(f.render() for f in fresh)
+    assert result.files_checked >= 4
+    # all four packaged workloads produced reports
+    scripts = {r.script for r in result.reports}
+    assert {"train_worker.py", "train_mnist.py", "serve_worker.py",
+            "serve_gang_worker.py"} <= scripts
+
+
+def test_shard_rule_catalog_lists_every_rule():
+    catalog = shardcheck.shard_rule_catalog()
+    for rule_id, _ in shardcheck.SHARD_RULES:
+        assert rule_id in catalog
+
+
+# -- shardcheck: per-rule fixtures (caught + suppressed) ---------------
+
+
+_TRAINER_YAML = """
+name: fix
+pods:
+  trainer:{pod_comment}
+    count: {count}
+    gang: true
+    tpu:
+      generation: v5e
+      chips-per-host: {chips}
+      topology: {topology}
+    tasks:
+      worker:
+        goal: RUNNING
+        cmd: "python frameworks/jax/train_worker.py"
+        cpus: 4
+        memory: {memory}
+"""
+
+
+def _shard_fixture(tmp_path, yaml, options=None, **kwargs):
+    framework = tmp_path / "frameworks" / "fix"
+    framework.mkdir(parents=True, exist_ok=True)
+    (framework / "svc.yml").write_text(textwrap.dedent(yaml))
+    if options is not None:
+        (framework / "options.json").write_text(json.dumps(options))
+    return shardcheck.analyze_all(str(tmp_path), **kwargs)
+
+
+def _trainer_yaml(chips=4, topology="4x4", memory=8192, pod_comment=""):
+    return _TRAINER_YAML.format(
+        chips=chips, topology=topology, memory=memory, count=4,
+        pod_comment=pod_comment,
+    )
+
+
+def test_shard_rule_divisibility(tmp_path):
+    """topology 2x3 at 3 chips/host derives dp=2 x tp=3 — and tp=3
+    does not divide the flagship's 512-wide head/ffn dims."""
+    result = _shard_fixture(
+        tmp_path, _trainer_yaml(chips=3, topology="2x3")
+    )
+    found = [f for f in result.findings if f.rule == "shard-divisibility"]
+    assert found and "tp" in found[0].message
+    assert found[0].line > 1  # anchored to the pod's declaring line
+    suppressed = _shard_fixture(tmp_path, _trainer_yaml(
+        chips=3, topology="2x3",
+        pod_comment="  # sdklint: disable=shard-divisibility,"
+        "shard-hbm-overcommit — negative fixture",
+    ))
+    assert not [f for f in suppressed.findings
+                if f.rule == "shard-divisibility"]
+    assert [f for f in suppressed.suppressed
+            if f.rule == "shard-divisibility"]
+
+
+def test_shard_rule_hbm_overcommit(tmp_path):
+    """memory: 64 cannot hold the flagship's per-host state."""
+    result = _shard_fixture(tmp_path, _trainer_yaml(memory=64))
+    found = [f for f in result.findings
+             if f.rule == "shard-hbm-overcommit"]
+    assert found and "declared memory" in found[0].message
+    # the generation-HBM leg: shrink the budget below the footprint
+    result = _shard_fixture(tmp_path, _trainer_yaml(), hbm_mb=8)
+    assert any(f.rule == "shard-hbm-overcommit" and "HBM" in f.message
+               for f in result.findings)
+    suppressed = _shard_fixture(tmp_path, _trainer_yaml(
+        memory=64,
+        pod_comment="  # sdklint: disable=shard-hbm-overcommit — fixture",
+    ))
+    assert not [f for f in suppressed.findings
+                if f.rule == "shard-hbm-overcommit"]
+    assert [f for f in suppressed.suppressed
+            if f.rule == "shard-hbm-overcommit"]
+
+
+def test_shard_rule_mesh_underivable(tmp_path):
+    """3 chips/host cannot tile a 2x2 slice: derive() raises SpecError
+    and the finding lands on the pod's line with the topology string."""
+    result = _shard_fixture(
+        tmp_path, _trainer_yaml(chips=3, topology="2x2")
+    )
+    found = [f for f in result.findings if f.rule == "shard-mesh"]
+    assert found and "'2x2'" in found[0].message
+    assert found[0].line > 1
+    suppressed = _shard_fixture(tmp_path, _trainer_yaml(
+        chips=3, topology="2x2",
+        pod_comment="  # sdklint: disable=shard-mesh — fixture",
+    ))
+    assert not [f for f in suppressed.findings
+                if f.rule == "shard-mesh"]
+
+
+def test_shard_rule_mesh_idle_chips(tmp_path):
+    """A pod reserving more chips than its workload's mesh spans is
+    the svc_mnist.yml bug this analyzer caught in-tree (the options
+    TPU_CHIPS_PER_HOST default leaking into a single-chip job)."""
+    yaml = """
+    name: fix
+    pods:
+      mnist:
+        count: 1
+        tpu:
+          generation: v5e
+          chips-per-host: 4
+        tasks:
+          train:
+            goal: FINISH
+            cmd: "python frameworks/jax/train_mnist.py"
+            cpus: 2
+            memory: 4096
+    """
+    result = _shard_fixture(tmp_path, yaml)
+    found = [f for f in result.findings if f.rule == "shard-mesh"]
+    assert found and "idle" in found[0].message
+    assert "4 chip(s)" in found[0].message
+
+
+def test_shard_rule_replicated_giant(tmp_path):
+    """With the threshold below the flagship's weight size, the
+    dp-replicated (fsdp=1) params trip the rule; the default 256 MB
+    threshold keeps the small flagship quiet."""
+    result = _shard_fixture(tmp_path, _trainer_yaml(), giant_mb=1.0)
+    found = [f for f in result.findings
+             if f.rule == "shard-replicated-giant"]
+    assert found and "replicated" in found[0].message
+    quiet = _shard_fixture(tmp_path, _trainer_yaml())
+    assert not [f for f in quiet.findings
+                if f.rule == "shard-replicated-giant"]
+    suppressed = _shard_fixture(tmp_path, _trainer_yaml(
+        pod_comment="  # sdklint: disable=shard-replicated-giant — dp"
+        " replication is intentional at this size",
+    ), giant_mb=1.0)
+    assert not [f for f in suppressed.findings
+                if f.rule == "shard-replicated-giant"]
+    assert [f for f in suppressed.suppressed
+            if f.rule == "shard-replicated-giant"]
+
+
+def test_shard_rule_unknown_axis(tmp_path):
+    """A profile whose rules name an axis no Mesh/MeshSpec declares is
+    flagged — the extension point is the PROFILES registry, so the
+    fixture registers a synthetic workload."""
+    from dcos_commons_tpu.parallel.mesh import MeshSpec
+
+    def fixture_profile(env, tpu, pod, task):
+        leaf = shardcheck.AbstractLeaf(
+            "params/w", (8, 8), 2, (("model",), ("dp",)), "params"
+        )
+        return shardcheck.Workload(
+            script="fixture_worker.py", mesh=MeshSpec(dp=2),
+            leaves=[leaf],
+        )
+
+    yaml = """
+    name: fix
+    pods:
+      web:{pod_comment}
+        count: 1
+        tpu:
+          generation: v5e
+          chips-per-host: 2
+        tasks:
+          server:
+            goal: RUNNING
+            cmd: "python fixture_worker.py"
+            cpus: 1
+            memory: 1024
+    """
+    shardcheck.PROFILES["fixture_worker.py"] = fixture_profile
+    try:
+        result = _shard_fixture(tmp_path, yaml.format(pod_comment=""))
+        found = [f for f in result.findings
+                 if f.rule == "shard-unknown-axis"]
+        assert found and "'model'" in found[0].message
+        suppressed = _shard_fixture(tmp_path, yaml.format(
+            pod_comment="  # sdklint: disable=shard-unknown-axis — fixture",
+        ))
+        assert not [f for f in suppressed.findings
+                    if f.rule == "shard-unknown-axis"]
+        assert [f for f in suppressed.suppressed
+                if f.rule == "shard-unknown-axis"]
+    finally:
+        del shardcheck.PROFILES["fixture_worker.py"]
+
+
+def test_shard_options_json_escape_hatch(tmp_path):
+    """x-sdklint-disable in options.json silences shard rules
+    framework-wide, like the other YAML analyzers."""
+    result = _shard_fixture(
+        tmp_path, _trainer_yaml(chips=3, topology="2x3"),
+        options={"x-sdklint-disable": ["shard-divisibility",
+                                       "shard-hbm-overcommit"]},
+    )
+    assert not [f for f in result.findings
+                if f.rule == "shard-divisibility"]
+    assert [f for f in result.suppressed
+            if f.rule == "shard-divisibility"]
+
+
+def test_shard_cli_subcommand_and_json(tmp_path, capsys):
+    """`shard` runs as a positional subcommand; a seeded bad YAML
+    surfaces in the --json document and flips the exit code."""
+    rc = analysis_main(["shard", "--root", REPO])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "shard:" in out and "lint:" not in out
+    framework = tmp_path / "frameworks" / "fix"
+    framework.mkdir(parents=True)
+    (framework / "svc.yml").write_text(textwrap.dedent(
+        _trainer_yaml(chips=3, topology="2x3", memory=64)
+    ))
+    rc = analysis_main(["--shard", "--json", "--root", str(tmp_path)])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1 and doc["exit_code"] == 1
+    rules = {f["rule"] for f in doc["shard"]["findings"]}
+    assert "shard-divisibility" in rules
+    assert "shard-hbm-overcommit" in rules
+    # findings are line-anchored in the machine output too
+    assert all(f["line"] > 1 for f in doc["shard"]["findings"])
+    assert "footprint" in doc["shard"] and "cost" in doc["shard"]
+
+
+def test_shard_baseline_ownership(tmp_path):
+    """shard- baseline entries survive a `--lint --update-baseline`
+    that never recomputed them, like the spmd entries do."""
+    framework = tmp_path / "frameworks" / "fix"
+    framework.mkdir(parents=True)
+    (framework / "svc.yml").write_text(textwrap.dedent(
+        _trainer_yaml(chips=3, topology="2x3")
+    ))
+    (tmp_path / "dcos_commons_tpu").mkdir()
+    (tmp_path / "dcos_commons_tpu" / "legacy.py").write_text(
+        "import time\n\ndef poll():\n    time.sleep(1)\n"
+    )
+    root = str(tmp_path)
+    rc = analysis_main(["--lint", "--shard", "--update-baseline",
+                        "--root", root])
+    assert rc == 0
+    both = baseline_mod.load_baseline(baseline_mod.baseline_path(root))
+    assert any("shard-divisibility" in k for k in both)
+    assert any("no-blocking-sleep" in k for k in both)
+    rc = analysis_main(["--lint", "--update-baseline", "--root", root])
+    assert rc == 0
+    after = baseline_mod.load_baseline(baseline_mod.baseline_path(root))
+    assert after == both
+    rc = analysis_main(["--lint", "--shard", "--root", root])
+    assert rc == 0
+
+
+def test_shard_malformed_env_is_a_finding_not_a_crash(tmp_path):
+    """A non-numeric env value the worker would int() must fail THAT
+    pod with an anchored, suppressible finding — one broken framework
+    cannot abort the whole analysis CLI."""
+    yaml = """
+    name: fix
+    pods:
+      trainer:
+        count: 1
+        gang: true
+        tpu:
+          generation: v5e
+          chips-per-host: 4
+          topology: 2x2
+        tasks:
+          worker:
+            goal: RUNNING
+            cmd: "python frameworks/jax/train_worker.py"
+            cpus: 4
+            memory: 8192
+            env:
+              VOCAB: "not-a-number"
+    """
+    result = _shard_fixture(tmp_path, yaml)
+    found = [f for f in result.findings if f.rule == "shard-mesh"]
+    assert found and "not-a-number" in found[0].message
+    assert found[0].line > 1

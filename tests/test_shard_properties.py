@@ -1,0 +1,126 @@
+"""Property-based tests for shardcheck's footprint arithmetic.
+
+The footprint model's load-bearing claim (developer-guide §10) is
+EXACTNESS: for a leaf every sharded dim divides evenly, the bytes
+shardcheck charges each chip times the number of chips equals the
+unsharded bytes times the replication factor — i.e. nothing is lost
+or double-counted by the per-dim division.  These properties pin that
+down over randomly sharded abstract trees on random meshes, the same
+hypothesis-importorskip pattern as tests/test_plan_properties.py.
+"""
+
+import pytest
+
+pytest.importorskip(
+    "hypothesis", reason="property tests need the hypothesis package"
+)
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+from dcos_commons_tpu.analysis.shardcheck import (  # noqa: E402
+    AbstractLeaf,
+    _prod,
+    shard_leaf,
+)
+from dcos_commons_tpu.parallel.mesh import MeshSpec  # noqa: E402
+
+AXES = ("dcn", "dp", "fsdp", "ep", "pp", "sp", "tp")
+
+
+@st.composite
+def mesh_and_leaf(draw, divisible=True):
+    """A random MeshSpec plus one abstract leaf whose PartitionSpec
+    assigns each mesh axis to at most one dim (the JAX rule).  With
+    ``divisible`` every sharded dim is a multiple of its axis product;
+    otherwise one sharded dim is bumped off the multiple."""
+    sizes = {a: draw(st.integers(1, 4)) for a in AXES}
+    mesh = MeshSpec(**sizes)
+    rank = draw(st.integers(1, 4))
+    dim_axes = [[] for _ in range(rank)]
+    for axis in AXES:
+        slot = draw(st.integers(-1, rank - 1))
+        if slot >= 0:
+            dim_axes[slot].append(axis)
+    shape = []
+    spec = []
+    for names in dim_axes:
+        q = _prod(sizes[a] for a in names)
+        shape.append(q * draw(st.integers(1, 3)))
+        spec.append(tuple(names))
+    bumped = None
+    if not divisible:
+        candidates = [
+            i for i, names in enumerate(dim_axes)
+            if _prod(sizes[a] for a in names) > 1
+        ]
+        if candidates:
+            bumped = draw(st.sampled_from(candidates))
+            q = _prod(sizes[a] for a in dim_axes[bumped])
+            shape[bumped] += draw(st.integers(1, q - 1))
+    leaf = AbstractLeaf(
+        path="params/leaf",
+        shape=tuple(shape),
+        dtype_bytes=draw(st.sampled_from([1, 2, 4])),
+        spec=tuple(spec),
+        section="params",
+    )
+    return mesh, leaf, bumped
+
+
+@settings(max_examples=300, deadline=None)
+@given(mesh_and_leaf())
+def test_footprint_is_exact_for_divisible_trees(case):
+    """sum over chips == unsharded bytes x replication factor, and the
+    shard product times the replication factor tiles the mesh."""
+    mesh, leaf, _ = case
+    report = shard_leaf(leaf, mesh.axes())
+    assert not report.problems, report.problems
+    assert report.per_chip_bytes * mesh.total \
+        == leaf.bytes * report.replication
+    assert report.shard_product * report.replication == mesh.total
+    # equivalent spelling: the shards of one replica sum to the leaf
+    assert report.per_chip_bytes * report.shard_product == leaf.bytes
+
+
+@settings(max_examples=300, deadline=None)
+@given(mesh_and_leaf(divisible=False))
+def test_non_divisible_dims_report_and_overcount(case):
+    """A dim its axis product does not divide is REPORTED, and the
+    padded (ceil) accounting can only overcharge, never undercharge —
+    the safe direction for an HBM budget check."""
+    mesh, leaf, bumped = case
+    report = shard_leaf(leaf, mesh.axes())
+    assert report.per_chip_bytes * report.shard_product >= leaf.bytes
+    if bumped is None:
+        assert not report.problems
+        return
+    rules = {rule for rule, _, _ in report.problems}
+    assert rules == {"shard-divisibility"}, report.problems
+    detail = "\n".join(msg for _, _, msg in report.problems)
+    assert f"dim {bumped}" in detail
+
+
+@settings(max_examples=200, deadline=None)
+@given(mesh_and_leaf(), st.integers(0, 6))
+def test_unknown_axis_vocabulary_contract(case, which):
+    """An axis outside BOTH the mesh and the harvested vocabulary is
+    flagged; the same axis inside the vocabulary (declared by some
+    Mesh(...) elsewhere in the tree, just not laid here) acts as
+    size 1 silently."""
+    mesh, leaf, _ = case
+    dim = which % len(leaf.shape)
+    spec = list(leaf.spec)
+    spec[dim] = spec[dim] + ("model",)
+    poked = AbstractLeaf(
+        leaf.path, leaf.shape, leaf.dtype_bytes, tuple(spec),
+        leaf.section,
+    )
+    report = shard_leaf(poked, mesh.axes())
+    assert any(rule == "shard-unknown-axis"
+               for rule, _, _ in report.problems), report.problems
+    allowed = shard_leaf(poked, mesh.axes(), vocab=frozenset({"model"}))
+    assert not [p for p in allowed.problems
+                if p[0] == "shard-unknown-axis"]
+    # unknown axes never change the arithmetic (they shard nothing)
+    base = shard_leaf(leaf, mesh.axes())
+    assert allowed.per_chip_bytes == base.per_chip_bytes
+    assert allowed.replication == base.replication
