@@ -430,6 +430,137 @@ def bench_offer_cycle() -> dict:
     }
 
 
+def bench_trace_overhead() -> dict:
+    """traceview recorder overhead bound (ISSUE 5): the PR 1 offer-
+    cycle scenario (serial deploy over 64 TPU hosts) driven
+    synchronously — run_cycle until complete, FakeAgent acking RUNNING
+    inline — with the flight recorder DISABLED (trace_capacity=0) and
+    ENABLED in LOCKSTEP: two identical worlds alternate cycles, each
+    cycle timed individually, and the overhead is the median of the
+    per-cycle-index enabled/disabled ratios.  Pairing at ~1ms cycle
+    granularity cancels host drift, and the median rejects preemption
+    spikes — a shared CI box cannot fake a systematic ratio.  The
+    assertion enforces the tentpole's bound: per-event spans must cost
+    <5% of the offer-cycle figure."""
+    from dcos_commons_tpu.common import TaskState, TaskStatus
+    from dcos_commons_tpu.offer.inventory import (
+        SliceInventory,
+        make_test_fleet,
+    )
+    from dcos_commons_tpu.scheduler import SchedulerBuilder, SchedulerConfig
+    from dcos_commons_tpu.specification import from_yaml
+    from dcos_commons_tpu.storage import MemPersister
+    from dcos_commons_tpu.testing import FakeAgent
+
+    # 32 serial steps (2x the PR 1 scenario): ~70 busy cycles per
+    # deploy = enough paired samples for a stable median
+    n_steps = 32
+    yaml_text = (
+        "name: traceoverhead\n"
+        "pods:\n"
+        "  app:\n"
+        f"    count: {n_steps}\n"
+        "    placement: 'max-per-host:1'\n"
+        "    tasks:\n"
+        "      server:\n"
+        "        goal: RUNNING\n"
+        "        cmd: sleep 1000\n"
+        "        cpus: 2\n"
+        "        memory: 1024\n"
+        "plans:\n"
+        "  deploy:\n"
+        "    strategy: serial\n"
+        "    phases:\n"
+        "      app:\n"
+        "        strategy: serial\n"
+        "        pod: app\n"
+    )
+
+    def build_world(trace_capacity: int):
+        hosts = []
+        for s in range(4):
+            hosts.extend(make_test_fleet(
+                slice_id=f"pod-{s}", host_grid=(4, 4), chip_block=(2, 2),
+                cpus=32.0, memory_mb=131072,
+            ))
+        builder = SchedulerBuilder(
+            from_yaml(yaml_text),
+            SchedulerConfig(
+                backoff_enabled=False, revive_capacity=10**9,
+                trace_capacity=trace_capacity,
+            ),
+            MemPersister(),
+        )
+        builder.set_inventory(SliceInventory(hosts))
+        agent = FakeAgent()
+        builder.set_agent(agent)
+        return builder.build(), agent, set()
+
+    def tick(scheduler, agent, acked):
+        """One timed cycle + inline RUNNING acks; returns seconds."""
+        t0 = time.monotonic()
+        scheduler.run_cycle()
+        elapsed = time.monotonic() - t0
+        for info in list(agent.launched):
+            if info.task_id not in acked:
+                acked.add(info.task_id)
+                agent.send(TaskStatus(
+                    task_id=info.task_id, state=TaskState.RUNNING,
+                    ready=True, agent_id=info.agent_id,
+                ))
+        return elapsed
+
+    import gc
+
+    # warm both code paths, then run the two worlds in lockstep: the
+    # same cycle index does the same work in both, so per-index
+    # ratios pair ~1ms regions executed back to back.  GC is parked
+    # so a collection landing in one world's cycle doesn't masquerade
+    # as recorder overhead.
+    for warm_capacity in (0, 2048):
+        scheduler, agent, acked = build_world(warm_capacity)
+        for _ in range(10 * n_steps):
+            tick(scheduler, agent, acked)
+            if scheduler.deploy_manager.get_plan().is_complete:
+                break
+    sched_off, agent_off, acked_off = build_world(0)
+    sched_on, agent_on, acked_on = build_world(2048)
+    off_times, on_times = [], []
+    gc.collect()
+    gc.disable()
+    try:
+        for _ in range(10 * n_steps):
+            off_times.append(tick(sched_off, agent_off, acked_off))
+            on_times.append(tick(sched_on, agent_on, acked_on))
+            if sched_off.deploy_manager.get_plan().is_complete and \
+                    sched_on.deploy_manager.get_plan().is_complete:
+                break
+    finally:
+        gc.enable()
+    assert sched_off.deploy_manager.get_plan().is_complete
+    assert sched_on.deploy_manager.get_plan().is_complete
+    ratios = sorted(
+        on / max(off, 1e-9) for off, on in zip(off_times, on_times)
+    )
+    overhead = ratios[len(ratios) // 2] - 1.0
+    # the tentpole's bound: tracing must cost <5% of the offer-cycle
+    # figure
+    assert overhead < 0.05, (
+        f"trace recorder overhead {overhead * 100:.1f}% exceeds the 5% "
+        f"bound (median per-cycle ratio over {len(ratios)} lockstep "
+        f"cycles; totals {sum(on_times):.4f}s traced vs "
+        f"{sum(off_times):.4f}s)"
+    )
+    return {
+        "trace_overhead_deploy_s_disabled": round(sum(off_times), 4),
+        "trace_overhead_deploy_s_enabled": round(sum(on_times), 4),
+        "trace_overhead_pct": round(overhead * 100, 2),
+        "trace_overhead_cycles": len(ratios),
+        "trace_overhead_spans": len(sched_on.tracer.snapshot()),
+        "trace_overhead_dropped": sched_on.tracer.dropped,
+    }
+
+
 def bench_deploy() -> dict:
     """Control-plane deploy of the single-chip MNIST service."""
     import shutil
@@ -1297,6 +1428,11 @@ def main() -> None:
     except Exception as e:
         extras["offer_cycle_error"] = repr(e)[:200]
     _mark("offer_cycle")
+    try:
+        extras.update(bench_trace_overhead())
+    except Exception as e:
+        extras["trace_overhead_error"] = repr(e)[:200]
+    _mark("trace_overhead")
     if not relay_ok:
         # every remaining section needs the chip's compile path; each
         # would burn its full timeout against a wedged relay.  Print

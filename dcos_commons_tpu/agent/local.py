@@ -361,7 +361,11 @@ class LocalProcessAgent:
 
     def __init__(self, workdir: str, use_native: bool = True,
                  auth_token: str = "", ca_file: str = ""):
-        self._workdir = workdir
+        # anchor the sandbox root: the $SANDBOX env contract and the
+        # durable supervisor records are consumed from the TASK's cwd
+        # (the sandbox itself), so a relative --sandbox-root would
+        # hand every task a path that resolves nowhere
+        self._workdir = os.path.abspath(workdir)
         # credentials for pulling templates off the scheduler's
         # bearer-protected /v1/artifacts endpoint
         self._auth_token = auth_token
@@ -952,6 +956,18 @@ class LocalProcessAgent:
 
     def sandbox_of(self, task_name: str) -> str:
         return os.path.join(self._workdir, task_name)
+
+    def steplog_of(self, task_name: str) -> List[dict]:
+        """Worker step telemetry from the task's sandbox
+        (trace/steplog.py JSONL): the scheduler's /v1/debug/trace
+        merges these into the control-plane timeline so gang skew
+        across hosts is visible in one view.  [] when the task never
+        wrote one."""
+        from dcos_commons_tpu.trace.steplog import STEPLOG_NAME, read_steplog
+
+        return read_steplog(
+            os.path.join(self._workdir, task_name, STEPLOG_NAME)
+        )
 
     def shutdown(self) -> None:
         with self._lock:

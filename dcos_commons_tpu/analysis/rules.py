@@ -506,6 +506,114 @@ class TracerUnsafeCastRule(Rule):
         return out
 
 
+class SpanLeakRule(Rule):
+    """A ``.span(...)`` call on a trace recorder returns a LIVE span:
+    it enters the flight recorder only when closed, and its children
+    reference its id — a leaked span silently drops a region of the
+    timeline and leaves orphan children.  Every ``<tracer>.span(...)``
+    result must therefore be closed on all paths: used directly as a
+    ``with`` context, or bound to a name that is ``end()``-ed (or
+    returned/yielded — ownership moves to the caller).  A bare
+    expression statement discards the span and always leaks.
+    ``event()`` closes itself and is exempt.  Receivers are recognized
+    by name (``trace``/``tracer``/``recorder`` variants), so the rule
+    follows the subsystem's own naming convention."""
+
+    id = "span-leak"
+    description = "trace span not closed via `with` or end()"
+
+    _RECEIVERS = {
+        "trace", "tracer", "_tracer", "recorder", "_recorder",
+        "NULL_TRACER",
+    }
+
+    def _is_span_call(self, node: ast.AST) -> bool:
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "span"):
+            return False
+        owner = node.func.value
+        name = None
+        if isinstance(owner, ast.Name):
+            name = owner.id
+        elif isinstance(owner, ast.Attribute):
+            name = owner.attr
+        return name in self._RECEIVERS
+
+    @staticmethod
+    def _iter_scope(node: ast.AST) -> Iterator[ast.AST]:
+        """Walk a function (or module) body without descending into
+        nested function scopes — each scope is analyzed once, against
+        its own end()/return statements."""
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            yield child
+            yield from SpanLeakRule._iter_scope(child)
+
+    def check(self, ctx: LintContext) -> List[Finding]:
+        out: List[Finding] = []
+        scopes: List[ast.AST] = [ctx.tree] + [
+            n for n in ast.walk(ctx.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        for scope in scopes:
+            out += self._check_scope(ctx, scope)
+        return out
+
+    def _check_scope(self, ctx: LintContext, scope: ast.AST) -> List[Finding]:
+        nodes = list(self._iter_scope(scope))
+        # span calls that are a `with` context expression are closed
+        with_exprs = {
+            id(item.context_expr)
+            for node in nodes if isinstance(node, ast.With)
+            for item in node.items
+        }
+        # names with a .end() call, returned, or yielded in this scope
+        closed_names: Set[str] = set()
+        for node in nodes:
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "end"
+                    and isinstance(node.func.value, ast.Name)):
+                closed_names.add(node.func.value.id)
+            if isinstance(node, (ast.Return, ast.Yield)) and \
+                    isinstance(node.value, ast.Name):
+                closed_names.add(node.value.id)
+            if isinstance(node, ast.With):
+                # `s = tracer.span(...)` later entered as `with s:`
+                for item in node.items:
+                    if isinstance(item.context_expr, ast.Name):
+                        closed_names.add(item.context_expr.id)
+        out: List[Finding] = []
+        for node in nodes:
+            # bare `tracer.span(...)` statement: discarded, never closed
+            if isinstance(node, ast.Expr) and self._is_span_call(node.value):
+                out.append(ctx.finding(
+                    node, self.id,
+                    "span discarded unclosed; use `with ....span(...)` "
+                    "(or .event() for instantaneous records)",
+                ))
+                continue
+            if not isinstance(node, ast.Assign) or \
+                    not self._is_span_call(node.value):
+                continue
+            if id(node.value) in with_exprs:
+                continue
+            targets = [
+                t.id for t in node.targets if isinstance(t, ast.Name)
+            ]
+            if targets and not any(t in closed_names for t in targets):
+                out.append(ctx.finding(
+                    node, self.id,
+                    f"span bound to {targets[0]!r} is never end()-ed "
+                    "on this scope's paths; close it with `with` or an "
+                    "explicit end()",
+                ))
+        return out
+
+
 def all_rules() -> List[Rule]:
     return [
         NoBlockingSleepRule(),
@@ -514,6 +622,7 @@ def all_rules() -> List[Rule]:
         NoGpusVocabularyRule(),
         SwallowedExceptionRule(),
         TracerUnsafeCastRule(),
+        SpanLeakRule(),
     ]
 
 

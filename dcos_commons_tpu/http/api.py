@@ -533,6 +533,45 @@ class SchedulerApi:
 
         return 200, TaskReservationsTracker(self._scheduler.ledger).to_json()
 
+    def debug_trace(self, fmt: Optional[str] = None) -> Response:
+        """The traceview flight recorder: one causal timeline from
+        offer intake through launch, status arrival, plan-step
+        transition, and (via sandbox steplogs) the workers' own step
+        loops.  ``?fmt=chrome`` returns Perfetto-loadable trace-event
+        JSON (pid = service, tid lanes per pod); default is a plain-
+        text timeline."""
+        from dcos_commons_tpu.trace.export import to_chrome, to_text
+
+        tracer = getattr(self._scheduler, "tracer", None)
+        if tracer is None:
+            return 503, {"message": "no trace recorder"}
+        steplogs = self._collect_steplogs()
+        service = self._scheduler.spec.name
+        if fmt == "chrome":
+            return 200, to_chrome(tracer, service=service,
+                                  steplogs=steplogs)
+        if fmt not in (None, "", "text"):
+            return 400, {"message": f"unknown trace format {fmt!r} "
+                                    "(expected 'chrome' or 'text')"}
+        return 200, to_text(tracer, service=service, steplogs=steplogs)
+
+    def _collect_steplogs(self) -> Dict[str, List[dict]]:
+        """Worker step telemetry, merged from task sandboxes when the
+        agent surfaces them (LocalProcessAgent.steplog_of); remote
+        fleets return {} until their daemons grow the same surface."""
+        reader = getattr(self._scheduler.agent, "steplog_of", None)
+        if not callable(reader):
+            return {}
+        out: Dict[str, List[dict]] = {}
+        for info in self._scheduler.state_store.fetch_tasks():
+            try:
+                records = reader(info.name)
+            except OSError:
+                continue
+            if records:
+                out[info.name] = records
+        return out
+
     # -- metrics ------------------------------------------------------
 
     def metrics_json(self) -> Response:

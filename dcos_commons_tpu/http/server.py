@@ -105,6 +105,9 @@ def build_routes(api: SchedulerApi) -> List[Route]:
           lambda m, q: api.debug_task_statuses()),
         r("GET", r"/v1/debug/reservations",
           lambda m, q: api.debug_reservations()),
+        # traceview: text timeline, or ?fmt=chrome for Perfetto
+        r("GET", r"/v1/debug/trace",
+          lambda m, q: api.debug_trace(_one(q, "fmt"))),
         # metrics
         r("GET", r"/v1/metrics/prometheus",
           lambda m, q: api.metrics_prometheus()),

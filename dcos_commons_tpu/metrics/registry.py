@@ -59,6 +59,17 @@ class Metrics:
                 with registry._lock:
                     registry._timers.setdefault(name, []).append(elapsed)
                     del registry._timers[name][:-256]  # ring buffer
+                if registry._statsd is not None:
+                    # timers push like counters do (reference:
+                    # Metrics.getTimer — StatsD timing datagrams in
+                    # milliseconds, the `|ms` type)
+                    try:
+                        registry._statsd.sendto(
+                            f"{name}:{elapsed * 1000.0:.3f}|ms".encode(),
+                            registry._statsd_addr,
+                        )
+                    except OSError:
+                        pass
                 return False
 
         return _Timer()
@@ -93,10 +104,17 @@ class Metrics:
         return out
 
     def prometheus(self) -> str:
-        """Prometheus text format (reference: Metrics.java:85-97)."""
+        """Prometheus text format (reference: Metrics.java:85-97).
+
+        ``incr()`` entries are monotonic and expose as ``counter`` (so
+        ``rate()`` works on them downstream); timer aggregates and
+        registered gauges expose as ``gauge``."""
+        with self._lock:
+            counter_names = set(self._counters)
         lines = []
         for name, value in sorted(self.snapshot().items()):
             metric = name.replace(".", "_").replace("-", "_").lower()
-            lines.append(f"# TYPE {metric} gauge")
+            kind = "counter" if name in counter_names else "gauge"
+            lines.append(f"# TYPE {metric} {kind}")
             lines.append(f"{metric} {value}")
         return "\n".join(lines) + "\n"

@@ -43,6 +43,7 @@ from dcos_commons_tpu.specification.specs import (
     task_full_name,
 )
 from dcos_commons_tpu.state.state_store import GoalStateOverride, StateStore
+from dcos_commons_tpu.trace.recorder import NULL_TRACER
 
 # env contract injected into every launched task (reference analogue:
 # offer/taskdata/EnvConstants + PodInfoBuilder env assembly)
@@ -166,6 +167,9 @@ class OfferEvaluator:
         # set by the scheduler so snapshot synthesis shows up under
         # the cycle.* timers; None when wired by hand in tests
         self.metrics = None
+        # traceview flight recorder (set by the scheduler alongside
+        # metrics); hand-wired evaluators default to the no-op recorder
+        self.tracer = None
 
     def set_target_config(self, config_id: str) -> None:
         self._target_config_id = config_id
@@ -180,14 +184,48 @@ class OfferEvaluator:
         requirement: PodInstanceRequirement,
         inventory: SliceInventory,
         context: Optional[EvaluationContext] = None,
+        trace_parent=None,
     ) -> EvaluationResult:
         """Match one requirement against the current inventory.
 
         ``context`` shares the task scan and hosts dict across every
         candidate of one scheduler cycle; omitted (direct callers,
-        tests), a private one is built — same results, less reuse."""
+        tests), a private one is built — same results, less reuse.
+        ``trace_parent`` is the offer-cycle span: the evaluation span
+        and its per-pod outcome events inherit its correlation id."""
         if context is None:
             context = EvaluationContext(self._state_store, inventory)
+        tracer = self.tracer or NULL_TRACER
+        pod = requirement.pod
+        with tracer.span(
+            f"evaluate:{requirement.name}", parent=trace_parent,
+            track="scheduler", pod=pod.type,
+        ) as span:
+            result = self._evaluate_requirement(
+                requirement, inventory, context
+            )
+            span.set_attr("passed", str(result.passed).lower())
+            reason = result.outcome.reason or result.outcome.source
+            if not result.passed:
+                span.set_attr("failing_requirement", reason)
+            # per-pod outcome events: one lane per pod instance, the
+            # failing requirement attached where evaluation refused
+            for index in requirement.instances:
+                attrs = {"outcome": "pass" if result.passed else "fail"}
+                if not result.passed:
+                    attrs["failing_requirement"] = reason
+                tracer.event(
+                    f"evaluate:{pod.type}-{index}", parent=span,
+                    track=f"{pod.type}-{index}", **attrs,
+                )
+            return result
+
+    def _evaluate_requirement(
+        self,
+        requirement: PodInstanceRequirement,
+        inventory: SliceInventory,
+        context: EvaluationContext,
+    ) -> EvaluationResult:
         timer = (
             self.metrics.time("cycle.snapshot")
             if self.metrics is not None else contextlib.nullcontext()

@@ -23,6 +23,14 @@ class PlanManager:
     def update(self, status: TaskStatus) -> None:
         raise NotImplementedError
 
+    def set_transition_listener(self, listener) -> None:
+        """Attach the traceview step-transition hook to every step this
+        manager currently owns.  Managers that mint steps dynamically
+        (recovery) are covered because the scheduler re-wires at the
+        top of every cycle, before statuses route."""
+        for step in self.get_plan().all_steps():
+            step.transition_listener = listener
+
     def in_progress_assets(self) -> Set[str]:
         """Assets of steps currently holding resources mid-transition;
         used by the coordinator for mutual exclusion."""

@@ -78,6 +78,29 @@ class PodInstanceRequirement:
 class Step(Element):
     """Reference: plan/Step.java:15."""
 
+    # traceview hook: callable(step, old_status, new_status, status)
+    # invoked on every state transition; ``status`` is the triggering
+    # TaskStatus (None for launch-time and operator-verb transitions).
+    # Wired by the scheduler via PlanManager.set_transition_listener —
+    # steps never import the tracer, keeping the plan layer inert when
+    # tracing is disabled.
+    transition_listener = None
+
+    def _notify_transition(self, old: Status, new: Status,
+                           status: Optional[TaskStatus] = None) -> None:
+        listener = self.transition_listener
+        if listener is None or old is new:
+            return
+        try:
+            listener(self, old, new, status)
+        except Exception:
+            # a broken trace listener must never wedge the plan machine
+            import logging
+
+            logging.getLogger(__name__).exception(
+                "step transition listener failed for %s", self.name
+            )
+
     def start(self) -> Optional[PodInstanceRequirement]:
         """Called when this step is a candidate; returns the work."""
         raise NotImplementedError
@@ -129,7 +152,9 @@ class ActionStep(Step):
                 self.errors[:] = [f"{self.name}: {e}"]
                 return
             self.errors.clear()
+            old = self._status
             self._status = Status.COMPLETE if done else Status.PENDING
+            self._notify_transition(old, self._status)
 
     def update_offer_status(self, launched: bool) -> None:
         pass
@@ -226,7 +251,9 @@ class DeploymentStep(Step):
             self._expected = dict(task_ids)
             self._task_states = {}
             self._task_ready = {}
+            old = self._status
             self._status = Status.STARTING
+            self._notify_transition(old, self._status)
 
     def update_offer_status(self, launched: bool) -> None:
         with self._lock:
@@ -265,17 +292,24 @@ class DeploymentStep(Step):
                 # accumulate per task (a gang can have SEVERAL distinct
                 # provisioning failures; hiding all but the last costs
                 # the operator one full rollout per hidden error)
+                had_errors = self.has_errors()
                 message = f"{name}: {status.message or 'task ERROR'}"
                 self.errors[:] = [
                     e for e in self.errors
                     if not e.startswith(f"{name}: ")
                 ] + [message]
                 self._task_states[name] = status.state
+                if not had_errors:
+                    self._notify_transition(
+                        self._status, Status.ERROR, status
+                    )
                 return
+            old = self._status
             self._task_states[name] = status.state
             if status.ready:
                 self._task_ready[name] = True
             self._recompute(failed=status.state.is_failure)
+            self._notify_transition(old, self._status, status)
 
     def _goal_of(self, task_full: str) -> GoalState:
         spec = self._spec_by_full.get(task_full)
@@ -357,17 +391,21 @@ class DeploymentStep(Step):
         Clears recorded ERRORs: restart is one of the operator's two
         exits from a non-recoverable step."""
         with self._lock:
+            old = self._status
             self._status = Status.PENDING
             self._expected = {}
             self._task_states = {}
             self._task_ready = {}
             self._delay_until = 0.0
             self.errors.clear()
+            self._notify_transition(old, self._status)
 
     def force_complete(self) -> None:
         with self._lock:
+            old = self._status
             self._status = Status.COMPLETE
             self.errors.clear()
+            self._notify_transition(old, self._status)
 
     def get_asset_names(self) -> Set[str]:
         return self.requirement.asset_names

@@ -481,6 +481,15 @@ class FrameworkRunner:
                 len(self.topology_hosts),
                 "remote" if self.agent_urls else "local",
             )
+            tracer = getattr(self.scheduler, "tracer", None)
+            if tracer is not None and tracer.enabled:
+                # the causal timeline operators join sandbox logs
+                # against: GET /v1/debug/trace (text) or ?fmt=chrome
+                # (Perfetto-loadable)
+                LOG.info(
+                    "flight recorder: %d spans at %s/v1/debug/trace",
+                    tracer.capacity, self.api_server.url,
+                )
             thread = self.scheduler.run_forever()
             try:
                 while not self._stop_requested.is_set():
@@ -756,6 +765,13 @@ def serve_main(
     )
     parser.add_argument("--sandbox-root", default=None)
     parser.add_argument(
+        "--trace-capacity",
+        type=int,
+        default=None,
+        help="flight-recorder span capacity (0 disables tracing; "
+             "also $TRACE_CAPACITY)",
+    )
+    parser.add_argument(
         "--env",
         action="append",
         default=[],
@@ -815,6 +831,8 @@ def serve_main(
         config.secrets_dir = args.secrets_dir
     if args.sandbox_root is not None:
         config.sandbox_root = args.sandbox_root
+    if args.trace_capacity is not None:
+        config.trace_capacity = args.trace_capacity
     if args.auth_token_file:
         from dcos_commons_tpu.security.auth import load_token
 

@@ -286,6 +286,8 @@ class SchedulerBuilder:
 
         from dcos_commons_tpu.runtime.token_bucket import TokenBucket
 
+        from dcos_commons_tpu.trace.recorder import TraceRecorder
+
         scheduler = DefaultScheduler(
             spec=target_spec,
             state_store=state_store,
@@ -301,6 +303,10 @@ class SchedulerBuilder:
             revive_bucket=TokenBucket(
                 capacity=self._config.revive_capacity,
                 refill_interval_s=self._config.revive_refill_s,
+            ),
+            tracer=TraceRecorder(
+                capacity=self._config.trace_capacity,
+                service=target_spec.name,
             ),
         )
         scheduler.secrets_provider = secrets_provider

@@ -41,6 +41,10 @@ class SchedulerConfig:
     revive_refill_s: float = 5.0
     # agent sandbox root
     sandbox_root: str = "./sandboxes"
+    # traceview flight recorder: span ring-buffer capacity per
+    # scheduler (0 disables tracing; drop-oldest above the cap, with
+    # evictions counted in the `trace.dropped` metric)
+    trace_capacity: int = 2048
     # coordinator port range for pjit rendezvous
     coordinator_port_base: int = 8476
     # control-plane credentials (security/auth.py): one cluster bearer
@@ -83,6 +87,7 @@ class SchedulerConfig:
             revive_capacity=int(env.get("REVIVE_CAPACITY", "4")),
             revive_refill_s=float(env.get("REVIVE_REFILL_S", "5.0")),
             sandbox_root=env.get("SANDBOX_ROOT", "./sandboxes"),
+            trace_capacity=int(env.get("TRACE_CAPACITY", "2048")),
             coordinator_port_base=int(env.get("COORDINATOR_PORT_BASE", "8476")),
             auth_token=_load_token(env),
             tls_ca_file=env.get("TLS_CA_FILE", ""),

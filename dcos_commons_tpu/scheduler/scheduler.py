@@ -45,6 +45,7 @@ from dcos_commons_tpu.state.state_store import (
     OverrideProgress,
     StateStore,
 )
+from dcos_commons_tpu.trace.recorder import TraceRecorder
 
 LOG = logging.getLogger(__name__)
 
@@ -67,6 +68,7 @@ class DefaultScheduler:
         framework_store=None,
         kill_orphaned_tasks: bool = True,
         revive_bucket: Optional[TokenBucket] = None,
+        tracer: Optional[TraceRecorder] = None,
     ):
         # stores surfaced to the HTTP API (/v1/configs, /v1/state);
         # None when the scheduler is wired by hand in unit tests
@@ -83,12 +85,28 @@ class DefaultScheduler:
         self.other_managers = list(other_managers or [])
         self.metrics = metrics or Metrics()
         self.outcome_tracker = outcome_tracker or OfferOutcomeTracker()
+        # traceview: the bounded flight recorder every layer of one
+        # offer cycle records into (trace/recorder.py).  One
+        # correlation id is minted per cycle; launches register their
+        # span so later status arrivals and the plan-step transitions
+        # they trigger join the chain.  Surfaced at /v1/debug/trace.
+        self.tracer = tracer or TraceRecorder()
+        if self.tracer.metrics is None:
+            self.tracer.metrics = self.metrics
+        self.tracer.service = self.tracer.service or spec.name
+        # correlation context of the in-flight status/launch: set under
+        # _lock by the cycle's thread, tagged with that thread's id so
+        # a step verb arriving on an HTTP thread (step.restart() is
+        # lock-free) can never borrow an unrelated status's anchor
+        self._trace_ctx: Optional[tuple] = None  # (thread_id, trace, span)
         # deploy before recovery: rollout owns incomplete pods, and the
         # recovery manager defers to them via externally_managed
         self.coordinator = DefaultPlanCoordinator(
             [deploy_manager, recovery_manager, *self.other_managers]
         )
-        self.launch_recorder = PersistentLaunchRecorder(state_store)
+        self.launch_recorder = PersistentLaunchRecorder(
+            state_store, tracer=self.tracer
+        )
         self.task_killer = TaskKiller(agent)
         self.reconciler = Reconciler(state_store, agent)
         # standalone mode sweeps agent tasks the store doesn't own
@@ -142,6 +160,8 @@ class DefaultScheduler:
             lambda: float(getattr(inventory, "cache_misses", 0)),
         )
         self.evaluator.metrics = self.metrics
+        self.evaluator.tracer = self.tracer
+        self._wire_step_tracing()
         # agents that learn of statuses asynchronously (readiness
         # monitors, test fixtures) nudge the loop instead of waiting
         # out the heartbeat
@@ -160,23 +180,41 @@ class DefaultScheduler:
         with self._lock, self.metrics.time("cycle.process"):
             # the reference's offers.process timer (Metrics.java:33):
             # scale tests fence on this staying bounded as the fleet
-            # and service count grow
-            self._intake_statuses()
-            if not self.reconciler.is_reconciled:
-                for status in self.reconciler.reconcile():
-                    self._process_status(status)
-                self.metrics.incr("reconciles")
-            self._process_candidates(allow_footprint_growth)
-            self._gc_reservations()
-            if self.kill_orphaned_tasks:
-                self._kill_orphans()
-            self.task_killer.retry_pending()
-            # first full deployment done: scheduler restarts now build
-            # an *update* plan (reference: StateStoreUtils deployment-
-            # completed bit read by SchedulerBuilder.selectDeployPlan)
-            if not self.state_store.deployment_was_completed() and \
-                    self.deploy_manager.get_plan().is_complete:
-                self.state_store.set_deployment_completed()
+            # and service count grow.  The cycle span mints THE
+            # correlation id: everything this cycle causes (evaluation,
+            # WAL, launch — and, via the launch registry, the statuses
+            # and step transitions that arrive in later cycles) shares
+            # its trace id.
+            with self.tracer.span("cycle", track="scheduler") as cycle:
+                # recovery steps are created dynamically: (re)attach
+                # the transition listener before statuses route
+                self._wire_step_tracing()
+                n_statuses = self._intake_statuses(cycle)
+                if not self.reconciler.is_reconciled:
+                    for status in self.reconciler.reconcile():
+                        self._process_status(status, parent=cycle)
+                        n_statuses += 1
+                    self.metrics.incr("reconciles")
+                n_candidates = self._process_candidates(
+                    allow_footprint_growth, parent=cycle
+                )
+                self._gc_reservations()
+                if self.kill_orphaned_tasks:
+                    self._kill_orphans()
+                self.task_killer.retry_pending()
+                # first full deployment done: scheduler restarts now
+                # build an *update* plan (reference: StateStoreUtils
+                # deployment-completed bit read by selectDeployPlan)
+                if not self.state_store.deployment_was_completed() and \
+                        self.deploy_manager.get_plan().is_complete:
+                    self.state_store.set_deployment_completed()
+                cycle.set_attr("statuses", n_statuses)
+                cycle.set_attr("candidates", n_candidates)
+                if n_statuses == 0 and n_candidates == 0:
+                    # idle heartbeat: keep the bounded flight recorder
+                    # for cycles that did work (busy-polls at 0.05s
+                    # would otherwise evict every interesting trace)
+                    cycle.drop()
 
     def run_forever(
         self,
@@ -257,11 +295,14 @@ class DefaultScheduler:
 
     # -- status intake ------------------------------------------------
 
-    def _intake_statuses(self) -> None:
+    def _intake_statuses(self, parent=None) -> int:
+        n = 0
         for status in self.agent.poll():
-            self._process_status(status)
+            self._process_status(status, parent=parent)
+            n += 1
+        return n
 
-    def _process_status(self, status: TaskStatus) -> None:
+    def _process_status(self, status: TaskStatus, parent=None) -> None:
         """Reference: DefaultScheduler.processStatusUpdate (:541-568)."""
         self.metrics.incr(f"task_status.{status.state.value}")
         try:
@@ -269,8 +310,23 @@ class DefaultScheduler:
         except ValueError:
             LOG.warning("unparseable task id %s", status.task_id)
             return
+        # status span, linked to its LAUNCH span via the task id so the
+        # chain survives across cycles; an unknown launch (pre-restart
+        # task, reconciled orphan) anchors to the current cycle instead
+        ref = self.tracer.launch_ref(status.task_id)
+        event = self.tracer.event(
+            f"status:{status.state.value}",
+            parent=None if ref else parent,
+            trace_id=ref.trace_id if ref else 0,
+            parent_id=ref.span_id if ref else 0,
+            track=ref.track if ref else "scheduler",
+            task=task_name,
+            task_id=status.task_id,
+            **({"message": status.message} if status.message else {}),
+        )
         stored = self.state_store.store_status(task_name, status)
         if not stored:
+            event.attrs["stale"] = "true"
             LOG.info("dropped stale status %s for %s",
                      status.state.value, task_name)
             return
@@ -286,24 +342,62 @@ class DefaultScheduler:
                     task_name, override, OverrideProgress.COMPLETE
                 )
         self.task_killer.handle_status(status)
+        # step transitions triggered by THIS status reference its
+        # correlation id (the listener reads _trace_ctx)
+        self._trace_ctx = (
+            threading.get_ident(), event.trace_id, event.span_id
+        )
+        try:
+            for manager in self.coordinator.plan_managers:
+                manager.update(status)
+        finally:
+            self._trace_ctx = None
+
+    def _wire_step_tracing(self) -> None:
+        """Attach the step-transition listener to every plan step that
+        exists right now (recovery steps are created dynamically, so
+        run_cycle re-wires each pass before routing statuses)."""
         for manager in self.coordinator.plan_managers:
-            manager.update(status)
+            set_listener = getattr(manager, "set_transition_listener", None)
+            if callable(set_listener):
+                set_listener(self._on_step_transition)
+
+    def _on_step_transition(self, step, old, new, status=None) -> None:
+        """Record a plan-step state transition as an instantaneous
+        span.  Anchored to the in-flight status/launch correlation when
+        one is active AND this is the thread that set it; operator
+        verbs firing from HTTP threads record unanchored (they were
+        not caused by the status the cycle thread is processing)."""
+        ctx = self._trace_ctx
+        if ctx is not None and ctx[0] == threading.get_ident():
+            trace_id, parent_id = ctx[1], ctx[2]
+        else:
+            trace_id, parent_id = 0, 0
+        self.tracer.event(
+            f"step:{step.name}",
+            trace_id=trace_id,
+            parent_id=parent_id,
+            track="plan",
+            **{"from": old.value, "to": new.value},
+        )
 
     # -- candidates -> launches ---------------------------------------
 
-    def _process_candidates(self, allow_footprint_growth: bool = True) -> None:
+    def _process_candidates(
+        self, allow_footprint_growth: bool = True, parent=None
+    ) -> int:
         candidates = self.coordinator.get_candidates()
         if not candidates:
             if not self._suppressed:
                 self._suppressed = True
                 self.metrics.incr("suppresses")
-            return
+            return 0
         if self._suppressed:
             # new work while suppressed: revive, rate-limited so a
             # crash-looping task can't force a full rescan every cycle
             if not self.revive_bucket.try_acquire():
                 self.metrics.incr("revives.throttled")
-                return
+                return 0
             self._suppressed = False
             self.metrics.incr("revives")
         # one shared evaluation context for the whole cycle: the task
@@ -327,7 +421,8 @@ class DefaultScheduler:
                 continue  # needs new reservations: wait for selection
             with self.metrics.time("cycle.evaluate"):
                 result = self.evaluator.evaluate(
-                    requirement, self.inventory, context
+                    requirement, self.inventory, context,
+                    trace_parent=parent,
                 )
             self.outcome_tracker.record(requirement.name, result.outcome)
             self.metrics.incr("offers.evaluated")
@@ -336,22 +431,47 @@ class DefaultScheduler:
                 self.metrics.incr("offers.declined")
                 continue
             self._kill_previous_launches(result.task_infos)
-            # WAL discipline: reservations + task infos are durable
-            # BEFORE the agent sees a launch (DefaultScheduler.java:454)
-            self.ledger.commit(result.reservations)
-            self.launch_recorder.record(result.task_infos)
-            context.note_launched(result.task_infos)
-            for info in result.task_infos:
-                override, progress = self.state_store.fetch_goal_override(
-                    info.name
+            with self.tracer.span(
+                f"launch:{requirement.name}", parent=parent,
+                track="scheduler",
+                task_ids=",".join(t.task_id for t in result.task_infos),
+            ) as launch_span:
+                # WAL discipline: reservations + task infos are durable
+                # BEFORE the agent sees a launch
+                # (DefaultScheduler.java:454)
+                self.ledger.commit(result.reservations)
+                self.launch_recorder.record(
+                    result.task_infos, parent=launch_span
                 )
-                if progress is OverrideProgress.PENDING:
-                    self.state_store.store_goal_override(
-                        info.name, override, OverrideProgress.IN_PROGRESS
+                context.note_launched(result.task_infos)
+                for info in result.task_infos:
+                    override, progress = self.state_store.fetch_goal_override(
+                        info.name
                     )
-            step.record_launch({t.name: t.task_id for t in result.task_infos})
-            self._launch(result.task_infos, requirement)
+                    if progress is OverrideProgress.PENDING:
+                        self.state_store.store_goal_override(
+                            info.name, override, OverrideProgress.IN_PROGRESS
+                        )
+                    # statuses for these ids — however many cycles
+                    # later — join this launch's correlation chain
+                    self.tracer.register_launch(
+                        info.task_id, launch_span,
+                        track=f"{info.pod_type}-{info.pod_index}",
+                    )
+                # the PENDING->STARTING transition is launch-caused:
+                # anchor it to the launch span, not a status
+                self._trace_ctx = (threading.get_ident(),
+                                   launch_span.trace_id,
+                                   launch_span.span_id)
+                try:
+                    step.record_launch(
+                        {t.name: t.task_id for t in result.task_infos}
+                    )
+                finally:
+                    self._trace_ctx = None
+                self._launch(result.task_infos, requirement)
             self.metrics.incr("operations.launch", len(result.task_infos))
+        return len(candidates)
 
     def _has_full_footprint(self, requirement) -> bool:
         """True when every task of the requirement already holds

@@ -11,21 +11,34 @@ the control plane crash-restart safe (SURVEY.md section 7 hard part 1).
 
 from __future__ import annotations
 
-from typing import List
+from typing import List, Optional
 
 from dcos_commons_tpu.common import TaskInfo
 from dcos_commons_tpu.state.state_store import StateStore
+from dcos_commons_tpu.trace.recorder import NULL_TRACER
 
 
 class PersistentLaunchRecorder:
-    def __init__(self, state_store: StateStore) -> None:
+    def __init__(self, state_store: StateStore, tracer=None) -> None:
         self._state_store = state_store
+        self._tracer = tracer
 
-    def record(self, infos: List[TaskInfo]) -> None:
+    def record(
+        self, infos: List[TaskInfo], parent: Optional[object] = None
+    ) -> None:
         """Atomically persist the pod's TaskInfos + seeded STAGING statuses.
 
         One persister transaction: a crash can never leave a gang launch
         half-recorded.  The STAGING seed gives reconciliation something
         to reconcile if the actual launch was lost in the crash.
+
+        ``parent`` is the launch span: the WAL write is timed as its
+        child (a slow persister shows up ON the launch it slowed).
         """
-        self._state_store.store_launch(infos)
+        tracer = self._tracer or NULL_TRACER
+        with tracer.span(
+            "launch.wal", parent=parent, track="scheduler",
+            tasks=",".join(i.name for i in infos),
+            task_ids=",".join(i.task_id for i in infos),
+        ):
+            self._state_store.store_launch(infos)

@@ -1,0 +1,81 @@
+"""Worker-side step telemetry: append-only JSONL in the sandbox.
+
+The scheduler's flight recorder sees the control plane; the worker's
+pjit step loop is invisible to it.  ``StepLog`` closes that gap from
+the task side: each training/serving step appends one JSON line
+(step index, wall seconds, tokens, seconds blocked waiting for the
+gang before the step's first collective) to ``steplog.jsonl`` in the
+task sandbox.  The agent's sandbox plumbing (``LocalProcessAgent.
+steplog_of``) surfaces the file and the scheduler's ``/v1/debug/trace``
+exporters merge it into the same timeline — per-host step lanes make
+gang skew directly visible (host 3's ``blocked_s`` IS the skew the
+other hosts imposed on it).
+
+Telemetry must never take a worker down: write failures are counted
+(``errors``) and otherwise ignored.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import List, Optional
+
+STEPLOG_NAME = "steplog.jsonl"
+
+
+class StepLog:
+    """Appends one JSON record per step; flushes per record so a gang
+    worker killed mid-run leaves a readable log."""
+
+    def __init__(self, path: Optional[str] = None):
+        # the scheduler's env contract puts every task in a sandbox
+        # ($SANDBOX, agent/local.py); outside one, log to cwd
+        self.path = path or os.path.join(
+            os.environ.get("SANDBOX", "."), STEPLOG_NAME
+        )
+        self.errors = 0
+        self._fh = None
+
+    def record(self, step: int, **fields) -> None:
+        entry = {"step": int(step), "t": time.time()}
+        entry.update(fields)
+        try:
+            if self._fh is None:
+                self._fh = open(self.path, "a", encoding="utf-8")
+            self._fh.write(json.dumps(entry) + "\n")
+            self._fh.flush()
+        except (OSError, ValueError, TypeError):
+            # telemetry is best-effort: a full disk or closed handle
+            # must not kill the training step that produced the record
+            self.errors += 1
+
+    def close(self) -> None:
+        if self._fh is not None:
+            try:
+                self._fh.close()
+            except OSError:
+                self.errors += 1
+            self._fh = None
+
+
+def read_steplog(path: str) -> List[dict]:
+    """Parse a steplog file; malformed/truncated lines (a worker killed
+    mid-write) are skipped, valid records around them survive."""
+    out: List[dict] = []
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    record = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(record, dict):
+                    out.append(record)
+    except OSError:
+        return []
+    return out

@@ -39,6 +39,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 sys.path.insert(0, os.environ.get("REPO_ROOT", "/root/repo"))
 
+from dcos_commons_tpu.trace.steplog import StepLog  # noqa: E402
 from dcos_commons_tpu.utils.microbatch import (  # noqa: E402
     MicroBatcher,
     WorkItem,
@@ -173,6 +174,16 @@ def main() -> int:
             np.zeros((batch, prompt_len), np.int32),
         )
 
+        # per-dispatch step telemetry ($SANDBOX/steplog.jsonl): every
+        # rank logs each gang generate — wall seconds, rows, and for
+        # followers the time spent parked in the broadcast waiting for
+        # rank 0 (the serving gang's skew/idle signal).  Surfaced by
+        # the scheduler's /v1/debug/trace as one lane per host.
+        import time as _time
+
+        steplog = StepLog()
+        dispatch_count = [0]
+
         # Intentional driver/follower split: BOTH sides of this branch
         # run the identical collective sequence (one _broadcast_tick per
         # tick, one gang generate per OP_GENERATE), so the schedules
@@ -186,11 +197,23 @@ def main() -> int:
                 f.write("warm\n")
             print(f"rank {rank}: following gang broadcasts", flush=True)
             while True:
+                b0 = _time.time()
                 head, lens, prompt = _broadcast_tick(
                     multihost_utils, None, batch, prompt_len
                 )
+                blocked_s = _time.time() - b0
                 if int(head[0]) == OP_GENERATE:
+                    t0 = _time.time()
                     run_from_payload(head, lens, prompt)
+                    steplog.record(
+                        dispatch_count[0],
+                        wall_s=round(_time.time() - t0, 6),
+                        blocked_s=round(blocked_s, 6),
+                        rows=int(head[1]),
+                        tokens=int(head[1]) * new_tokens,
+                        worker=rank,
+                    )
+                    dispatch_count[0] += 1
 
         # ---- rank 0: HTTP front end + the shared micro-batcher ------
         # run_group broadcasts the merged group to the gang (mixed
@@ -215,7 +238,17 @@ def main() -> int:
                 multihost_utils, (head, lens, prompt),
                 batch, prompt_len,
             )
+            t0 = _time.time()
             out = run_from_payload(head, lens, prompt)
+            steplog.record(
+                dispatch_count[0],
+                wall_s=round(_time.time() - t0, 6),
+                blocked_s=0.0,  # rank 0 paces the gang; it never waits
+                rows=used,
+                tokens=used * new_tokens,
+                worker=0,
+            )
+            dispatch_count[0] += 1
             unpack_results(group, out)
 
         def idle_tick():

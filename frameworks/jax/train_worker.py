@@ -32,6 +32,7 @@ def main() -> int:
         make_train_step,
     )
     from dcos_commons_tpu.parallel.mesh import mesh_from_env
+    from dcos_commons_tpu.trace.steplog import StepLog
     from dcos_commons_tpu.utils import (
         enable_compilation_cache,
         restore_checkpoint,
@@ -45,6 +46,17 @@ def main() -> int:
 
     steps = int(os.environ.get("TRAIN_STEPS", "100"))
     ckpt_dir = os.environ.get("CHECKPOINT_DIR", "checkpoints")
+    # per-step telemetry into $SANDBOX/steplog.jsonl: the scheduler's
+    # /v1/debug/trace merges every host's lane into one timeline, so
+    # gang skew (who waited on whom) is read off the blocked_s column.
+    # The barrier probe is a gang-wide sync BEFORE each step's first
+    # collective; its wall time on the fast hosts IS the skew the slow
+    # host imposed.  STEPLOG_BARRIER_PROBE=0 drops the probe (and the
+    # skew column) when even a barrier per step is too much.
+    steplog = StepLog()
+    probe_gang = os.environ.get("STEPLOG_BARRIER_PROBE", "1") not in (
+        "0", "false"
+    )
     mesh = mesh_from_env(os.environ)
     # the env->config contract lives in models/transformer.py so
     # analysis/shardcheck verifies the EXACT model this pod trains
@@ -116,11 +128,35 @@ def main() -> int:
             tokens, targets = synthetic_tokens(
                 jax.random.key(1), batch, config.max_seq, config.vocab
             )
+        gang = contract["worker_count"] > 1
+        if gang and probe_gang:
+            from jax.experimental import multihost_utils
         t0 = time.time()
         for i in range(start, steps):
+            step_t0 = time.time()
+            blocked_s = 0.0
+            if gang and probe_gang:
+                # pre-allreduce barrier probe: meet the gang before
+                # this step's first collective; time spent here is
+                # time BLOCKED on slower hosts, not compute
+                b0 = time.time()
+                multihost_utils.sync_global_devices(f"steplog-{i}")
+                blocked_s = time.time() - b0
             if batches is not None:
                 tokens, targets = next(batches)
             params, opt_state, loss = step_fn(params, opt_state, tokens, targets)
+            # drain the step before stamping: jit dispatch returns
+            # immediately, so an unsynced wall_s would be dispatch
+            # time, and the NEXT step's barrier probe would absorb
+            # this step's compute and report it as gang skew
+            jax.block_until_ready(loss)
+            steplog.record(
+                i,
+                wall_s=round(time.time() - step_t0, 6),
+                tokens=tokens.shape[0] * tokens.shape[1],
+                blocked_s=round(blocked_s, 6),
+                worker=contract["worker_id"],
+            )
             if i % 20 == 0 or i == steps - 1:
                 print(f"step {i} loss={float(loss):.4f}", flush=True)
                 save_checkpoint(
@@ -130,6 +166,7 @@ def main() -> int:
                     # grow it by ~3 bytes/param per save forever
                     keep=int(os.environ.get("CHECKPOINT_KEEP", "3")),
                 )
+        steplog.close()
         if batches is not None:
             batches.close()
         dt = time.time() - t0

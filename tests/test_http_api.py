@@ -156,6 +156,54 @@ def test_configs_state_endpoints_debug_metrics(deployed):
     assert "operations_launch" in prom
 
 
+def test_debug_trace_routes(deployed):
+    """traceview surface: text timeline + Chrome (Perfetto) JSON."""
+    runner, server = deployed
+    text = get(server, "/v1/debug/trace")
+    assert isinstance(text, str) and text.startswith("# trace:")
+    assert "cycle" in text and "status:TASK_RUNNING" in text
+
+    chrome = get(server, "/v1/debug/trace?fmt=chrome")
+    events = chrome["traceEvents"]
+    assert events and all(e["ph"] == "X" for e in events)
+    assert all(e["pid"] == "api-svc" for e in events)
+    tids = {e["tid"] for e in events}
+    assert "web-0" in tids and "web-1" in tids  # pod lanes
+    names = {e["name"] for e in events}
+    assert any(n.startswith("launch:web") for n in names)
+    assert any(n.startswith("evaluate:web") for n in names)
+
+    get(server, "/v1/debug/trace?fmt=bogus", expect_code=400)
+
+
+def test_debug_trace_empty_recorder(deployed):
+    runner, server = deployed
+    from dcos_commons_tpu.trace import TraceRecorder
+
+    runner.world.scheduler.tracer = TraceRecorder(capacity=16)
+    chrome = get(server, "/v1/debug/trace?fmt=chrome")
+    assert chrome["traceEvents"] == []
+    assert chrome["otherData"]["dropped"] == 0
+    text = get(server, "/v1/debug/trace")
+    assert "0 entries" in text
+
+
+def test_debug_trace_truncation_reports_dropped(deployed):
+    runner, server = deployed
+    from dcos_commons_tpu.trace import TraceRecorder
+
+    scheduler = runner.world.scheduler
+    scheduler.tracer = TraceRecorder(capacity=4, metrics=scheduler.metrics)
+    for i in range(10):
+        scheduler.tracer.event(f"overflow-{i}", track="scheduler")
+    chrome = get(server, "/v1/debug/trace?fmt=chrome")
+    assert len(chrome["traceEvents"]) == 4  # ring keeps the newest
+    assert chrome["otherData"]["dropped"] == 6
+    assert "(6 dropped" in get(server, "/v1/debug/trace")
+    # evictions are observable as a metric, too
+    assert get(server, "/v1/metrics")["trace.dropped"] == 6
+
+
 def test_plan_verbs_over_http(deployed):
     runner, server = deployed
     # a COMPLETE plan stays COMPLETE through interrupt/continue

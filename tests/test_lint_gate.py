@@ -263,6 +263,64 @@ def test_rule_jit_tracer_cast(tmp_path):
     assert not findings and len(suppressed) == 1
 
 
+def test_rule_span_leak(tmp_path):
+    src = """
+    class Scheduler:
+        def leaky(self, tracer):
+            span = tracer.span("cycle")
+            span.set_attr("k", "v")
+
+        def discarded(self):
+            self.tracer.span("evaluate")
+    """
+    findings, _ = _lint_fixture(tmp_path, src, rule_id="span-leak")
+    assert len(findings) == 2
+    # `with` closes on all paths
+    ok = """
+    def fine(tracer):
+        with tracer.span("cycle") as span:
+            span.set_attr("k", "v")
+
+    def fine_deferred(tracer):
+        span = tracer.span("cycle")
+        with span:
+            pass
+
+    def fine_explicit(tracer):
+        span = tracer.span("cycle")
+        try:
+            work()
+        finally:
+            span.end()
+
+    def factory(tracer):
+        # ownership transfers to the caller
+        span = tracer.span("cycle")
+        return span
+
+    def events_are_exempt(tracer):
+        tracer.event("status:TASK_RUNNING")
+    """
+    findings, _ = _lint_fixture(tmp_path, ok, rule_id="span-leak")
+    assert not findings
+    # non-tracer .span receivers are out of scope
+    findings, _ = _lint_fixture(
+        tmp_path,
+        "def other(doc):\n    doc.span('highlight')\n",
+        rule_id="span-leak",
+    )
+    assert not findings
+    suppressed_src = src.replace(
+        'span = tracer.span("cycle")',
+        'span = tracer.span("cycle")  # sdklint: disable=span-leak — '
+        "closed by the registry on shutdown",
+    ).replace('self.tracer.span("evaluate")', "pass")
+    findings, suppressed = _lint_fixture(
+        tmp_path, suppressed_src, rule_id="span-leak"
+    )
+    assert not findings and len(suppressed) == 1
+
+
 def test_file_level_suppression(tmp_path):
     src = (
         "# sdklint: disable-file=no-blocking-sleep — tick harness\n"

@@ -1,0 +1,78 @@
+"""Metrics registry: exposition typing and StatsD push.
+
+Reference: metrics/Metrics.java — counters AND timers push to StatsD
+when STATSD_UDP_HOST/PORT are set (Metrics.java:74-79), and the
+Prometheus exposition types monotonic counters as ``counter`` so
+downstream ``rate()`` works.
+"""
+
+import socket
+
+from dcos_commons_tpu.metrics.registry import Metrics
+
+
+def test_prometheus_types_counters_as_counter():
+    m = Metrics()
+    m.incr("operations.launch", 3)
+    m.incr("task_status.TASK_RUNNING")
+    m.gauge("offers.snapshot_cache.hit", lambda: 5.0)
+    with m.time("cycle.process"):
+        pass
+    text = m.prometheus()
+    lines = text.splitlines()
+
+    # monotonic incr() entries expose as counter
+    assert "# TYPE operations_launch counter" in lines
+    assert "operations_launch 3.0" in lines
+    assert "# TYPE task_status_task_running counter" in lines
+    # registered gauges stay gauges
+    assert "# TYPE offers_snapshot_cache_hit gauge" in lines
+    # every timer aggregate (count/min/mean/max/p95) is a gauge: the
+    # window re-aggregates, so none of them is monotonic
+    timer_types = [
+        line for line in lines
+        if line.startswith("# TYPE cycle_process")
+    ]
+    assert timer_types and all(t.endswith("gauge") for t in timer_types)
+    # exposition shape: every TYPE line is followed by its sample
+    for i, line in enumerate(lines):
+        if line.startswith("# TYPE "):
+            metric = line.split()[2]
+            assert lines[i + 1].startswith(metric + " ")
+
+
+def test_statsd_receives_counter_and_timing_datagrams(monkeypatch):
+    sink = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sink.bind(("127.0.0.1", 0))
+    sink.settimeout(5)
+    port = sink.getsockname()[1]
+    monkeypatch.setenv("STATSD_UDP_HOST", "127.0.0.1")
+    monkeypatch.setenv("STATSD_UDP_PORT", str(port))
+    try:
+        m = Metrics()
+        m.incr("offers.evaluated")
+        datagram = sink.recv(1024).decode()
+        assert datagram == "offers.evaluated:1.0|c"
+
+        # timers push |ms datagrams too (the satellite fix: time()
+        # used to record locally and never push)
+        with m.time("cycle.evaluate"):
+            pass
+        datagram = sink.recv(1024).decode()
+        name, _, payload = datagram.partition(":")
+        assert name == "cycle.evaluate"
+        value, _, kind = payload.partition("|")
+        assert kind == "ms"
+        assert float(value) >= 0.0
+    finally:
+        sink.close()
+
+
+def test_no_statsd_configured_is_silent(monkeypatch):
+    monkeypatch.delenv("STATSD_UDP_HOST", raising=False)
+    monkeypatch.delenv("STATSD_UDP_PORT", raising=False)
+    m = Metrics()
+    m.incr("x")
+    with m.time("y"):
+        pass
+    assert m.snapshot()["x"] == 1.0

@@ -258,7 +258,20 @@ def test_config_update_rolls_changed_pods(tmp_path):
             new_infos[name].task_id != old_ids[name] for name in old_ids
         )
         out = os.path.join(agent.sandbox_of("hello-1-server"), "out.txt")
-        assert open(out).read().strip() == "updated-1"
+        # the relaunched task reports RUNNING at exec time and writes
+        # out.txt asynchronously: poll briefly instead of racing the
+        # subprocess on a loaded host
+        deadline = time.monotonic() + 10
+        content = ""
+        while time.monotonic() < deadline:
+            try:
+                content = open(out).read().strip()
+            except OSError:
+                content = ""
+            if content == "updated-1":
+                break
+            time.sleep(0.05)
+        assert content == "updated-1"
     finally:
         agent.shutdown()
 
