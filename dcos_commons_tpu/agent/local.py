@@ -969,6 +969,21 @@ class LocalProcessAgent:
             os.path.join(self._workdir, task_name, STEPLOG_NAME)
         )
 
+    def serving_stats_of(self, task_name: str) -> dict:
+        """Serving-load gauges from the task's sandbox (serve/engine.py
+        servestats.json): queue depth, active slots, KV occupancy,
+        tokens/s.  The scheduler's /v1/debug/serving merges these per
+        pod — the load signal scale-out decisions read.  {} when the
+        task is not a serving worker (never wrote one)."""
+        from dcos_commons_tpu.serve.engine import (
+            SERVESTATS_NAME,
+            read_servestats,
+        )
+
+        return read_servestats(
+            os.path.join(self._workdir, task_name, SERVESTATS_NAME)
+        )
+
     def shutdown(self) -> None:
         with self._lock:
             for task_id in list(self._tasks):

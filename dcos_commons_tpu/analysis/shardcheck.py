@@ -411,30 +411,45 @@ def _serve_leaves(env, mesh_total_tp: int) -> Tuple[Any, List[AbstractLeaf]]:
         shapes, rules,
         quantized=env.get("WEIGHT_DTYPE", "native") == "int8",
     )
-    batch = int(env.get("SERVE_BATCH", "1"))
+    # the serving KV footprint is the continuous-batching SLOT POOL
+    # (serve/engine.py): allocated ONCE at max-concurrent-slots x
+    # max_len — SERVE_SLOTS when the operator decouples residency
+    # from the per-request row cap, else SERVE_BATCH — honoring
+    # KV_DTYPE (int8 halves the pool bytes).  A managed budget, not a
+    # per-request guess: occupancy within this allocation is the
+    # runtime gauge (kv_occupancy), the allocation itself is what HBM
+    # must hold.
+    slots = int(env.get("SERVE_SLOTS") or 0) or int(
+        env.get("SERVE_BATCH", "1")
+    )
     max_len = int(env.get("MAX_LEN", "256"))
     kv_dtype = env.get("KV_DTYPE", "native")
     cache_shapes = jax.eval_shape(functools.partial(
-        init_kv_cache, config, batch, max_len, kv_dtype
+        init_kv_cache, config, slots, max_len, kv_dtype
     ))
-    # cache dims (layers, batch, len, kv_heads, head_dim): heads ride
-    # tp like the attention weights; batch replicates across the gang
-    # (every rank steps the same broadcast batch)
+    # pool dims (layers, slots, len, kv_heads, head_dim): heads ride
+    # tp like the attention weights when divisible (the gang worker's
+    # cache_sharding), else the pool replicates; slots replicate
+    # across the gang (every rank steps the same broadcast pool)
+    kv_sharded = (
+        mesh_total_tp > 1 and config.n_kv_heads % mesh_total_tp == 0
+    )
     kv_spec = {
-        name: ((), (), (), ("tp",) if mesh_total_tp > 1 else (), ())
+        name: ((), (), (), ("tp",) if kv_sharded else (), ())
         for name in cache_shapes
     }
     leaves += _walk_shapes(cache_shapes, kv_spec, "kv")
     import numpy as np
 
-    # decode-step residual + final logits: small next to params+cache
+    # pool decode-step residual + final logits: every slot computes
+    # each step (static shapes); small next to params + the pool
     leaves.append(AbstractLeaf(
-        "act/decode-step", (batch, 1, config.d_model),
+        "act/decode-step", (slots, 1, config.d_model),
         int(np.dtype(config.dtype).itemsize),
         ((), (), ()), "activations",
     ))
     leaves.append(AbstractLeaf(
-        "act/logits", (batch, 1, config.vocab), 4,
+        "act/logits", (slots, 1, config.vocab), 4,
         ((), (), ()), "activations",
     ))
     return config, leaves

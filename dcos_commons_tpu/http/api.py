@@ -555,6 +555,26 @@ class SchedulerApi:
                                     "(expected 'chrome' or 'text')"}
         return 200, to_text(tracer, service=service, steplogs=steplogs)
 
+    def debug_serving(self) -> Response:
+        """Per-pod serving load: each serve worker mirrors its engine
+        gauges (queue depth, active slots, KV occupancy, tokens/s,
+        TTFT percentiles) to its sandbox; this merges them per task —
+        the signal a load-driven scale-out plan reads (ROADMAP item
+        2), and the place an operator checks which pod is saturating
+        before the 503s start."""
+        reader = getattr(self._scheduler.agent, "serving_stats_of", None)
+        if not callable(reader):
+            return 200, {"serving": {}}
+        out: Dict[str, dict] = {}
+        for info in self._scheduler.state_store.fetch_tasks():
+            try:
+                stats = reader(info.name)
+            except OSError:
+                continue
+            if stats:
+                out[info.name] = stats
+        return 200, {"serving": out}
+
     def _collect_steplogs(self) -> Dict[str, List[dict]]:
         """Worker step telemetry, merged from task sandboxes when the
         agent surfaces them (LocalProcessAgent.steplog_of); remote

@@ -108,6 +108,10 @@ def build_routes(api: SchedulerApi) -> List[Route]:
         # traceview: text timeline, or ?fmt=chrome for Perfetto
         r("GET", r"/v1/debug/trace",
           lambda m, q: api.debug_trace(_one(q, "fmt"))),
+        # serving load: per-pod slot-engine gauges (queue depth,
+        # active slots, KV occupancy, tokens/s) merged from sandboxes
+        r("GET", r"/v1/debug/serving",
+          lambda m, q: api.debug_serving()),
         # metrics
         r("GET", r"/v1/metrics/prometheus",
           lambda m, q: api.metrics_prometheus()),

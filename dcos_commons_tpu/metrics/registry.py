@@ -16,6 +16,15 @@ import time
 from typing import Callable, Dict, Optional
 
 
+def percentile(ordered, q: int) -> float:
+    """Nearest-rank percentile over an ASCENDING-sorted sequence —
+    the one convention shared by the registry's timer aggregates, the
+    serve engine's TTFT gauges, and bench percentiles (three copies
+    of this formula once disagreed off-by-one at small counts)."""
+    n = len(ordered)
+    return ordered[min(n - 1, max(0, -(-q * n // 100) - 1))]
+
+
 class Metrics:
     def __init__(self) -> None:
         self._counters: Dict[str, float] = {}
@@ -93,9 +102,7 @@ class Metrics:
                     out[f"{name}.avg_s"] = mean  # legacy alias
                     out[f"{name}.max_s"] = ordered[-1]
                     # nearest-rank p95 over the ring buffer window
-                    out[f"{name}.p95_s"] = ordered[
-                        min(n - 1, max(0, -(-95 * n // 100) - 1))
-                    ]
+                    out[f"{name}.p95_s"] = percentile(ordered, 95)
         for name, fn in gauges.items():
             try:
                 out[name] = float(fn())

@@ -28,6 +28,8 @@ from dcos_commons_tpu.models.decode import (
     generate,
     init_kv_cache,
     prefill,
+    prefill_into_slot,
+    sample_token,
 )
 from dcos_commons_tpu.models.moe import (
     MoEConfig,
@@ -56,6 +58,8 @@ __all__ = [
     "init_moe_params",
     "init_params",
     "prefill",
+    "prefill_into_slot",
+    "sample_token",
     "loss_fn",
     "make_train_step",
     "mlp_forward",

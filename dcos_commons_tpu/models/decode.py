@@ -177,6 +177,65 @@ def prefill(
     return logits, cache
 
 
+def prefill_into_slot(
+    config: TransformerConfig,
+    params: Params,
+    cache: Dict[str, jax.Array],
+    tokens: jax.Array,
+    slot: jax.Array,
+    true_len: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Prefill prompt(s) into rows of a PERSISTENT slot-pool cache.
+
+    The continuous-batching form of ``prefill``: the pool cache
+    (``init_kv_cache(config, SLOTS, max_len)``) is allocated once and
+    lives across requests; this runs ``tokens [nb, s]`` through the
+    trunk and scatters the captured per-layer K/V into the pool rows
+    at ``slot`` (a TRACED int32 scalar — ``nb`` consecutive rows, the
+    nb=1 fast path is ONE dynamic_update_slice per leaf — or a [nb]
+    vector of arbitrary rows).  Returns (last-real-position logits
+    [nb, vocab] f32, updated cache).
+
+    The whole row is overwritten (``prefill`` pads its capture out to
+    the static ``max_len``), so a freed slot needs no scrubbing before
+    reuse: nothing of the previous occupant survives admission, and
+    ``decode_step``'s per-row valid mask (``<= pos``) never reads past
+    what this prefill + subsequent decode writes wrote.  Shapes stay
+    static — one compile serves every (slot, prompt content, length)
+    the server admits.
+    """
+    kv_dtype = "int8" if "k_scale" in cache else "native"
+    max_len = cache["k"].shape[2]
+    logits, row_cache = prefill(
+        config, params, tokens, max_len, true_len, kv_dtype=kv_dtype
+    )
+    slot = jnp.asarray(slot, jnp.int32)
+    out = {}
+    for name, buf in cache.items():
+        new = row_cache[name].astype(buf.dtype)
+        if slot.ndim == 0:
+            out[name] = lax.dynamic_update_slice(
+                buf, new, (0, slot, 0, 0, 0)
+            )
+        else:
+            out[name] = buf.at[:, slot].set(new)
+    return logits, out
+
+
+def sample_token(
+    logits: jax.Array, temperature: jax.Array, key: jax.Array
+) -> jax.Array:
+    """Greedy when ``temperature`` == 0, else softmax sampling — both
+    operands TRACED so one compile covers every request.  Works on a
+    single row [vocab] or a batch [b, vocab] (one shared key)."""
+    temp = jnp.asarray(temperature, jnp.float32)
+    sampled = jax.random.categorical(
+        key, logits / jnp.maximum(temp, 1e-6), axis=-1
+    )
+    greedy = jnp.argmax(logits, axis=-1)
+    return jnp.where(temp > 0.0, sampled, greedy).astype(jnp.int32)
+
+
 def decode_step(
     config: TransformerConfig,
     params: Params,

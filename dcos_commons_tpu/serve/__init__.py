@@ -1,0 +1,20 @@
+"""Continuous-batching serving engine (the serve data plane's core).
+
+``engine.py`` is the model-agnostic half: a slot-pool admission loop
+that admits waiting requests into free KV slots at EVERY decode step
+and retires finished rows immediately (per-row EOS / max-token), so a
+batch never pads out to its longest row and a new request's time-to-
+first-token is one decode tick + its own prefill instead of a whole
+preceding generation.  ``pool.py`` is the device half: the jitted
+prefill-into-slot / decode-step pair over a persistent static-shape
+slot-pool cache (models/decode.py), shared by the single-chip server
+and the multi-host gang driver.
+"""
+
+from dcos_commons_tpu.serve.engine import (
+    SERVESTATS_NAME,
+    SlotEngine,
+    read_servestats,
+)
+
+__all__ = ["SERVESTATS_NAME", "SlotEngine", "read_servestats"]

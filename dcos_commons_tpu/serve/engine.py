@@ -1,0 +1,537 @@
+"""Slot-pool continuous-batching engine (model-agnostic half).
+
+The dispatch-per-group serve loop ran one whole ``generate`` per
+micro-batch: a request arriving one step after a dispatch started
+waited the FULL previous generation before its prefill even began,
+and every row padded out to the group's longest generation.  This
+engine replaces that loop with per-step scheduling over a persistent
+slot pool:
+
+* the KV cache is allocated ONCE at ``SLOTS x max_len`` (static
+  shapes — XLA never recompiles as occupancy changes);
+* waiting requests are admitted into free slots at EVERY decode step
+  (prefill-into-slot, models/decode.py), so p95 time-to-first-token is
+  O(one decode tick + own prefill) instead of O(a whole generation);
+* finished rows (per-row EOS / max-token / cache-exhausted) retire
+  their slot IMMEDIATELY — the pool never pads a short answer out to
+  the longest row, which is where the mean-to-max generation-length
+  throughput win comes from (bench.py bench_continuous_serve).
+
+The engine is model-agnostic and jax-free: the device half is two
+injected callables (the single-chip server binds them straight to a
+``serve.pool.PoolModel``; the gang driver wraps them in ADMIT/DECODE
+broadcast ticks so every rank steps the same program).  Liveness
+rules inherited from ``utils/microbatch.py`` (which this subsumes for
+both servers): FIFO admission order, queue-timeout removal (abandoned
+work never reaches the chip — an active abandoned row retires at the
+next tick, freeing its slot early), and an ``on_idle`` hook so an
+SPMD gang keeps meeting in collectives with no traffic.
+
+Serving load telemetry: ``stats()`` reports queue depth, active
+slots, KV occupancy, tokens/s and TTFT percentiles; ``
+register_metrics`` exports the gauges through a metrics registry
+(StatsD/Prometheus), and ``stats_path`` mirrors them to
+``servestats.json`` in the task sandbox, where the scheduler's
+``GET /v1/debug/serving`` collects them per pod — the load signal
+ROADMAP item 2 names for scale-out decisions.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from dcos_commons_tpu.utils.microbatch import QueueTimeoutError
+
+SERVESTATS_NAME = "servestats.json"
+_TTFT_WINDOW = 512      # TTFT samples kept for the percentile gauges
+_RATE_WINDOW_S = 10.0   # tokens/s sliding window
+
+
+class _Group:
+    """One ``submit()`` call: N rows answered together."""
+
+    __slots__ = ("rows", "remaining", "done", "error", "abandoned")
+
+    def __init__(self, rows: List["_Row"]):
+        self.rows = rows
+        self.remaining = len(rows)
+        self.done = threading.Event()
+        self.error: Optional[BaseException] = None
+        self.abandoned = False
+
+
+class _Row:
+    """One prompt riding one KV slot."""
+
+    __slots__ = (
+        "tokens", "n", "temp", "eos", "seed", "out", "group",
+        "arrival", "slot",
+    )
+
+    def __init__(self, tokens, n, temp, eos, seed, group):
+        self.tokens = tokens
+        self.n = n
+        self.temp = temp
+        self.eos = eos
+        self.seed = seed
+        self.out: List[int] = []
+        self.group = group
+        self.arrival = time.monotonic()
+        self.slot = -1
+
+
+class SlotEngine:
+    """Admission loop over a persistent slot-pool KV cache.
+
+    ``prefill_fn(padded [1, prompt_len] i32, slot=, true_len=, temp=,
+    seed=) -> first token`` runs one prompt into a pool row (the
+    scalars are passed by KEYWORD — transposing slot and true_len is
+    a silent cache corruption);
+    ``decode_fn(tok [S] i32, pos [S] i32, temps [S] f32, seeds [S]
+    i32, n_active) -> next tokens [S] i32`` advances EVERY row one
+    step (inactive rows are parked at slot state (0, 0) — their
+    computation is discarded and their cache row is fully overwritten
+    by the next admission's prefill).  Both run OUTSIDE the engine
+    lock; only host-side bookkeeping holds it.
+    """
+
+    def __init__(
+        self,
+        prefill_fn: Callable,
+        decode_fn: Callable,
+        slots: int,
+        max_len: int,
+        prompt_len: int,
+        queue_timeout_s: float = 600.0,
+        on_idle: Optional[Callable[[], None]] = None,
+        idle_every_s: float = 0.05,
+        stats_path: Optional[str] = None,
+        stats_every_s: float = 1.0,
+        log: Optional[Callable[[str], None]] = None,
+    ):
+        if slots < 1:
+            raise ValueError(f"slot pool needs >= 1 slot, got {slots}")
+        self._prefill_fn = prefill_fn
+        self._decode_fn = decode_fn
+        self._slots = slots
+        self._max_len = max_len
+        self._prompt_len = prompt_len
+        self._queue_timeout_s = queue_timeout_s
+        self._on_idle = on_idle
+        self._idle_every_s = idle_every_s
+        self._stats_path = stats_path
+        self._stats_every_s = stats_every_s
+        self._log = log
+
+        self._cv = threading.Condition()
+        self._queue: deque = deque()
+        self._rows: List[Optional[_Row]] = [None] * slots
+        self._free = list(range(slots - 1, -1, -1))  # pop() -> slot 0 first
+        self._active = 0
+        self._tok = np.zeros(slots, np.int32)
+        self._pos = np.zeros(slots, np.int32)
+        self._temps = np.zeros(slots, np.float32)
+        self._seeds = np.zeros(slots, np.int32)
+        self._stopped = False
+        # telemetry (counters under the cv; deques pruned on append)
+        self._admitted = 0
+        self._completed = 0
+        self._timeouts = 0
+        self._tokens_out = 0
+        self._ttft: deque = deque(maxlen=_TTFT_WINDOW)
+        self._rate: deque = deque()  # (monotonic, tokens) per tick
+        self._merge_logged = False
+        self._stats_written = 0.0  # loop-thread only
+        self._thread = threading.Thread(
+            target=self._loop, name="slot-engine", daemon=True
+        )
+        self._thread.start()
+
+    # -- client surface ----------------------------------------------
+
+    def submit(
+        self,
+        rows: Sequence[Sequence[int]],
+        max_new_tokens: int,
+        temperature: float = 0.0,
+        eos_id: Optional[int] = None,
+    ) -> List[List[int]]:
+        """Queue ``rows`` (each its own slot, admitted independently
+        as slots free up — a multi-row request may overlap several
+        pool generations) and block until every row finished.  Raises
+        ``QueueTimeoutError`` on saturation (handlers map it to 503),
+        ``ValueError`` on caller error (400)."""
+        if not rows:
+            raise ValueError("tokens must be non-empty")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}"
+            )
+        for row in rows:
+            if len(row) < 1:
+                raise ValueError("prompts must be non-empty")
+            if len(row) > self._prompt_len:
+                raise ValueError(
+                    f"prompt length {len(row)} exceeds the server's "
+                    f"context {self._prompt_len}"
+                )
+            if len(row) + max_new_tokens > self._max_len:
+                raise ValueError(
+                    f"prompt {len(row)} + {max_new_tokens} new tokens "
+                    f"cannot fit the {self._max_len}-position slot"
+                )
+        group = _Group([])
+        group.rows = [
+            _Row(
+                [int(t) for t in row], max_new_tokens, float(temperature),
+                eos_id,
+                int.from_bytes(os.urandom(4), "little") % (2 ** 31),
+                group,
+            )
+            for row in rows
+        ]
+        group.remaining = len(group.rows)
+        with self._cv:
+            self._queue.extend(group.rows)
+            self._cv.notify_all()
+        # the timeout bounds SATURATION, not a healthy generation: a
+        # window with no row admitted (starved for a slot) or no new
+        # token across the whole group (the pool stalled) abandons;
+        # an admitted group that keeps producing is never cut off
+        # mid-generation just for being long
+        last_progress = -1
+        while not group.done.wait(timeout=self._queue_timeout_s):
+            with self._cv:
+                admitted = any(r.slot >= 0 for r in group.rows)
+                progress = sum(len(r.out) for r in group.rows)
+                if admitted and progress > last_progress:
+                    last_progress = progress
+                    continue
+                # abandoned work never reaches the chip: queued rows
+                # leave the queue NOW; already-active rows retire at
+                # the next tick, freeing their slots early instead of
+                # decoding a dead request to completion
+                group.abandoned = True
+                self._queue = deque(
+                    r for r in self._queue if r.group is not group
+                )
+                self._timeouts += 1
+                reason = (
+                    "request timed out waiting for a KV slot"
+                    if not admitted else
+                    f"no decode progress in {self._queue_timeout_s}s"
+                )
+            raise QueueTimeoutError(reason)
+        if group.error is not None:
+            raise group.error
+        return [list(r.out) for r in group.rows]
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stopped = True
+            self._cv.notify_all()
+        self._thread.join(timeout=10)
+
+    # -- telemetry ---------------------------------------------------
+
+    def stats(self) -> dict:
+        """Serving-load snapshot (the per-pod gauges ROADMAP item 2
+        names as the scale-out signal)."""
+        now = time.monotonic()
+        with self._cv:
+            live_tokens = int(sum(
+                int(self._pos[s])
+                for s, row in enumerate(self._rows) if row is not None
+            ))
+            window = [n for (t, n) in self._rate
+                      if t > now - _RATE_WINDOW_S]
+            ttft = sorted(self._ttft)
+            out = {
+                "slots": self._slots,
+                "max_len": self._max_len,
+                "queue_depth": len(self._queue),
+                "active_slots": self._active,
+                "free_slots": len(self._free),
+                "kv_live_tokens": live_tokens,
+                "kv_occupancy": round(
+                    live_tokens / float(self._slots * self._max_len), 4
+                ),
+                "tokens_per_s": round(
+                    sum(window) / _RATE_WINDOW_S, 2
+                ),
+                "requests_admitted": self._admitted,
+                "requests_completed": self._completed,
+                "requests_timed_out": self._timeouts,
+                "tokens_out": self._tokens_out,
+            }
+        if ttft:
+            from dcos_commons_tpu.metrics.registry import percentile
+
+            out["ttft_p50_s"] = round(percentile(ttft, 50), 4)
+            out["ttft_p95_s"] = round(percentile(ttft, 95), 4)
+        out["t"] = time.time()
+        return out
+
+    def register_metrics(self, metrics, prefix: str = "serving") -> None:
+        """Export the load gauges through a metrics registry
+        (metrics/registry.py): queue depth, active slots, KV
+        occupancy, tokens/s — scraped as gauges / pushed via StatsD."""
+        for key in ("queue_depth", "active_slots", "kv_occupancy",
+                    "tokens_per_s"):
+            metrics.gauge(
+                f"{prefix}.{key}",
+                lambda key=key: self.stats()[key],
+            )
+
+    # -- the loop ----------------------------------------------------
+
+    def _loop(self) -> None:
+        # persists across iterations: the on_idle servers (gang) pass
+        # through the outer loop once per idle TICK, and the terminal
+        # flush must happen once per idle PERIOD, not at 20 Hz forever
+        flushed_idle = False
+        while True:
+            idle = False
+            flush_now = False
+            admits: List[_Row] = []
+            with self._cv:
+                while (not self._queue and self._active == 0
+                       and not self._stopped):
+                    if not flushed_idle:
+                        # flush the terminal snapshot before parking:
+                        # an idle server's LAST burst must be visible
+                        # to /v1/debug/serving, not its second-to-last.
+                        # The write itself happens OUTSIDE the lock —
+                        # file IO on a slow sandbox must not block
+                        # submit() callers needing the cv
+                        flushed_idle = True
+                        flush_now = True
+                        break
+                    if self._on_idle is None:
+                        self._cv.wait()
+                    else:
+                        self._cv.wait(timeout=self._idle_every_s)
+                        if not self._queue and self._active == 0:
+                            break  # fire on_idle OUTSIDE the lock
+                if self._stopped:
+                    return
+                idle = not self._queue and self._active == 0
+                if not idle:
+                    flushed_idle = False  # work resumed: re-arm
+                    admits = self._pop_admits_locked()
+            if flush_now:
+                self._write_stats(force=True)
+                continue
+            if idle:
+                self._safe_idle()
+                continue
+            try:
+                self._admit_all(admits)
+                if self._active:  # loop thread is the only writer
+                    self._decode_tick()
+                self._write_stats()
+            except Exception as e:  # noqa: BLE001 — fail FAST, not silent
+                # a bookkeeping bug (bad decode shape, broken stats
+                # path) must not kill this thread silently: every
+                # client would then block its full timeout and the
+                # gang's followers would wedge in a stale collective.
+                # Fan the error out and keep the loop alive.
+                with self._cv:
+                    self._fail_all_locked(e)
+
+    def _pop_admits_locked(self) -> List[_Row]:
+        """FIFO admission: oldest waiting rows take the free slots —
+        a row can never starve behind later arrivals."""
+        admits: List[_Row] = []
+        while self._queue and self._free:
+            row = self._queue.popleft()
+            if row.group.abandoned:
+                continue
+            row.slot = self._free.pop()
+            admits.append(row)
+        return admits
+
+    def _admit_all(self, admits: List[_Row]) -> None:
+        for i, row in enumerate(admits):
+            padded = np.zeros((1, self._prompt_len), np.int32)
+            padded[0, : len(row.tokens)] = row.tokens
+            try:
+                first = int(self._prefill_fn(
+                    padded, slot=row.slot, true_len=len(row.tokens),
+                    temp=row.temp, seed=row.seed,
+                ))
+            except Exception as e:  # noqa: BLE001 — fan out, keep serving
+                with self._cv:
+                    # the popped-but-not-installed rows (this one and
+                    # the rest of the batch) are invisible to both the
+                    # queue and the active set: return their slots and
+                    # fail their groups explicitly, or each failure
+                    # would leak a slot and leave its client waiting
+                    # out the full timeout for a model error
+                    for r in admits[i:]:
+                        self._free.append(r.slot)
+                        r.slot = -1
+                    self._fail_all_locked(
+                        e, extra_groups={r.group for r in admits[i:]}
+                    )
+                return
+            now = time.monotonic()
+            with self._cv:
+                self._apply_admit_locked(row, first, now)
+
+    def _apply_admit_locked(self, row: _Row, first: int, now: float):
+        self._admitted += 1
+        self._ttft.append(now - row.arrival)
+        row.out.append(first)
+        self._count_tokens_locked(1, now)
+        if self._row_finished(row, first, int(len(row.tokens))):
+            self._retire_locked(row)
+            return
+        slot = row.slot
+        self._rows[slot] = row
+        self._active += 1
+        self._tok[slot] = first
+        self._pos[slot] = len(row.tokens)  # next cache write position
+        self._temps[slot] = row.temp
+        self._seeds[slot] = row.seed
+
+    def _decode_tick(self) -> None:
+        active = self._active
+        try:
+            nxt = np.asarray(self._decode_fn(
+                self._tok.copy(), self._pos.copy(),
+                self._temps.copy(), self._seeds.copy(), active,
+            ))
+        except Exception as e:  # noqa: BLE001 — fan out, keep serving
+            with self._cv:
+                self._fail_all_locked(e)
+            return
+        now = time.monotonic()
+        merged = None
+        with self._cv:
+            self._apply_decode_locked(nxt, now)
+            if self._active >= 2 and not self._merge_logged:
+                self._merge_logged = True
+                merged = self._active
+            elif self._active <= 1:
+                self._merge_logged = False
+        if merged is not None and self._log is not None:
+            self._log(
+                f"continuous-batch: {merged} rows sharing one decode "
+                "step over the slot pool"
+            )
+
+    def _apply_decode_locked(self, nxt: np.ndarray, now: float) -> None:
+        produced = 0
+        for slot in range(self._slots):
+            row = self._rows[slot]
+            if row is None:
+                continue
+            if row.group.abandoned:
+                self._retire_locked(row)
+                continue
+            token = int(nxt[slot])
+            row.out.append(token)
+            produced += 1
+            self._pos[slot] += 1
+            self._tok[slot] = token
+            if (self._row_finished(row, token, int(self._pos[slot]))):
+                self._retire_locked(row)
+        self._count_tokens_locked(produced, now)
+
+    def _row_finished(self, row: _Row, token: int, pos: int) -> bool:
+        return (
+            len(row.out) >= row.n
+            or (row.eos is not None and token == row.eos)
+            or pos >= self._max_len  # slot cache exhausted
+        )
+
+    def _retire_locked(self, row: _Row) -> None:
+        slot = row.slot
+        if self._rows[slot] is row:
+            self._rows[slot] = None
+            self._active -= 1
+            self._tok[slot] = 0
+            self._pos[slot] = 0
+            self._temps[slot] = 0.0
+            self._seeds[slot] = 0
+        self._free.append(slot)
+        group = row.group
+        group.remaining -= 1
+        if group.remaining <= 0 and not group.abandoned:
+            self._completed += 1
+            group.done.set()
+
+    def _fail_all_locked(
+        self, error: BaseException, extra_groups=(),
+    ) -> None:
+        """A model-call failure fans out to every waiting and active
+        request (the MicroBatcher contract) and clears the pool.
+        ``extra_groups``: groups of rows in admission limbo (popped
+        from the queue, not yet installed in the pool) — the caller
+        has already returned their slots."""
+        groups = {r.group for r in self._queue}
+        groups |= {r.group for r in self._rows if r is not None}
+        groups |= set(extra_groups)
+        self._queue.clear()
+        for slot, row in enumerate(self._rows):
+            if row is not None:
+                self._rows[slot] = None
+                self._active -= 1
+                self._free.append(slot)
+        self._tok[:] = 0
+        self._pos[:] = 0
+        self._temps[:] = 0.0
+        self._seeds[:] = 0
+        for group in groups:
+            group.error = error
+            group.done.set()
+
+    def _count_tokens_locked(self, n: int, now: float) -> None:
+        if n <= 0:
+            return
+        self._tokens_out += n
+        self._rate.append((now, n))
+        while self._rate and self._rate[0][0] < now - _RATE_WINDOW_S:
+            self._rate.popleft()
+
+    def _safe_idle(self) -> None:
+        try:
+            self._on_idle()
+        except Exception:  # noqa: BLE001, sdklint: disable=swallowed-exception — idle hook must not kill serving
+            pass
+
+    def _write_stats(self, force: bool = False) -> None:
+        """Mirror the gauges to the sandbox (loop thread only): the
+        scheduler's /v1/debug/serving reads this per task."""
+        if self._stats_path is None:
+            return
+        now = time.monotonic()
+        if not force and now - self._stats_written < self._stats_every_s:
+            return
+        self._stats_written = now
+        try:
+            tmp = self._stats_path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(self.stats(), f)
+            os.replace(tmp, self._stats_path)
+        except OSError:
+            pass  # sdklint: disable=swallowed-exception — telemetry must never take the server down
+
+
+def read_servestats(path: str) -> dict:
+    """Parse a worker's servestats.json; {} when absent/corrupt (a
+    worker killed mid-replace leaves the previous snapshot or none)."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    return data if isinstance(data, dict) else {}

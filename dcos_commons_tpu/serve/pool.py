@@ -1,0 +1,162 @@
+"""Device half of the continuous-batching engine: the jitted
+prefill-into-slot / decode-step pair over a persistent slot-pool KV
+cache (models/decode.py).
+
+TWO compiles cover the server's whole life: ``prefill`` admits one
+right-padded prompt (traced true_len/slot/temperature/seed — no
+recompile per request) into a pool row, ``decode`` advances EVERY
+row one step with per-row positions, temperatures and PRNG seeds (a
+mixed greedy/sampling pool shares one dispatch).  The cache is
+allocated ONCE at ``slots x max_len`` with static shapes and threaded
+through both functions; on non-CPU backends the cache argument is
+DONATED so XLA updates it in place instead of holding two pool-sized
+buffers live across the call.
+
+Per-row sampling keys: each request carries its own 31-bit seed and
+every step folds the row's current position into it
+(``fold_in(key(seed), pos)``) — rows never share randomness, a row's
+stream does not depend on which slot it landed in or who its pool
+neighbors are, and no key is ever reused across steps (the prefill
+pick folds ``true_len - 1``, the first decode folds ``true_len``).
+Greedy rows (temperature 0) ignore the keys entirely and argmax —
+token-identical to whole-batch ``generate`` on the same prompts
+(tests/test_continuous_batching.py holds the equivalence under
+arbitrary admission orders).
+
+The gang driver reuses this class unchanged: ``put`` lifts host
+arrays to global (broadcast_one_to_all hands every rank identical
+numpy), ``constrain_out`` pins token outputs replicated so rank 0
+can bulk-fetch them, and ``cache_sharding`` lays the pool's KV heads
+over the tp axis when divisible.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+
+class PoolModel:
+    """Owns the slot-pool cache and the two compiled entry points.
+
+    Not thread-safe by itself: exactly one thread (the engine loop, or
+    a gang rank's tick executor) may call ``prefill``/``decode`` —
+    both advance ``self.cache``.
+    """
+
+    def __init__(
+        self,
+        config,
+        params,
+        slots: int,
+        max_len: int,
+        kv_dtype: str = "native",
+        cache_sharding: Optional[Any] = None,
+        put: Optional[Callable] = None,
+        constrain_out: Optional[Callable] = None,
+    ):
+        import jax
+        import jax.numpy as jnp
+
+        from dcos_commons_tpu.models.decode import (
+            decode_step,
+            init_kv_cache,
+            prefill_into_slot,
+            sample_token,
+        )
+
+        self._jax = jax
+        self._np = np
+        self.config = config
+        self.params = params
+        self.slots = slots
+        self.max_len = max_len
+        self._put = put if put is not None else (lambda x: x)
+        con = constrain_out if constrain_out is not None else (lambda x: x)
+
+        init = functools.partial(
+            init_kv_cache, config, slots, max_len, kv_dtype
+        )
+        if cache_sharding is not None:
+            self.cache = jax.jit(init, out_shardings=cache_sharding)()
+        else:
+            self.cache = jax.jit(init)()
+
+        def _prefill(params, cache, tokens, slot, true_len, temp, seed):
+            logits, cache = prefill_into_slot(
+                config, params, cache, tokens, slot, true_len
+            )
+            key = jax.random.fold_in(jax.random.key(seed), true_len - 1)
+            return con(sample_token(logits[0], temp, key)), cache
+
+        def _decode(params, cache, tok, pos, temps, seeds):
+            logits, cache = decode_step(config, params, cache, tok, pos)
+
+            def pick_row(lg, temp, seed, p):
+                key = jax.random.fold_in(jax.random.key(seed), p)
+                return sample_token(lg, temp, key)
+
+            nxt = jax.vmap(pick_row)(logits, temps, seeds, pos)
+            return con(nxt), cache
+
+        # donate the pool cache (argnums 1): decode streams it every
+        # step — holding input AND output pools live would double the
+        # dominant HBM term.  CPU has no donation; skip the warning.
+        donate = {}
+        if jax.default_backend() != "cpu":
+            donate = {"donate_argnums": (1,)}
+        self._prefill_c = jax.jit(_prefill, **donate)
+        self._decode_c = jax.jit(_decode, **donate)
+        self._jnp = jnp
+
+    def prefill(
+        self, tokens: np.ndarray, slot: int, true_len: int,
+        temp: float, seed: int,
+    ) -> int:
+        """Admit one right-padded [1, prompt_len] prompt into pool row
+        ``slot``; returns the first generated token."""
+        first, self.cache = self._prefill_c(
+            self.params, self.cache,
+            self._put(np.asarray(tokens, np.int32)),
+            np.int32(slot), np.int32(true_len),
+            np.float32(temp), np.int32(seed),
+        )
+        return int(self._jax.device_get(first))
+
+    def decode(
+        self, tok: np.ndarray, pos: np.ndarray,
+        temps: np.ndarray, seeds: np.ndarray,
+        n_active: Optional[int] = None,
+    ) -> np.ndarray:
+        """One decode step over the WHOLE pool; returns next tokens
+        [slots] (inactive rows' outputs are discarded by the engine).
+        ``n_active`` is the engine's bookkeeping rider (the gang
+        driver stamps it into the broadcast head); the computation
+        always covers every slot — static shapes.  ONE bulk device
+        fetch — per-element reads are a transfer each."""
+        nxt, self.cache = self._decode_c(
+            self.params, self.cache,
+            self._put(np.asarray(tok, np.int32)),
+            self._put(np.asarray(pos, np.int32)),
+            self._put(np.asarray(temps, np.float32)),
+            self._put(np.asarray(seeds, np.int32)),
+        )
+        return np.asarray(self._jax.device_get(nxt))
+
+    def warm(self, prompt_len: int) -> None:
+        """Compile + execute both entry points before readiness: the
+        first request must not pay the compile, and a rank that cannot
+        compile must fail deploy, not the first client."""
+        self.prefill(
+            np.zeros((1, prompt_len), np.int32),
+            slot=0, true_len=prompt_len, temp=0.0, seed=0,
+        )
+        out = self.decode(
+            np.zeros(self.slots, np.int32),
+            np.full(self.slots, prompt_len, np.int32),
+            np.zeros(self.slots, np.float32),
+            np.zeros(self.slots, np.int32),
+        )
+        self._jax.block_until_ready(out)

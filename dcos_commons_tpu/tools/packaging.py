@@ -263,6 +263,15 @@ def main(argv: Optional[list] = None) -> int:
         "--name", default="",
         help="prune only this package (default: every package)",
     )
+    p.add_argument(
+        "--grace-s", type=float, default=0.0,
+        help="seconds to keep a pruned artifact's bytes on disk "
+             "(parked as .trash-<epoch>, out of the index) before a "
+             "later prune unlinks it.  0 deletes immediately — on NFS "
+             "a registry-serve client mid-fetch then gets truncated "
+             "reads/stale handles, so either quiesce fetches or set a "
+             "grace covering your slowest fetch",
+    )
     p = sub.add_parser(
         "registry-serve",
         help="serve a registry directory over HTTP",
@@ -360,7 +369,9 @@ def _run_verb(args) -> int:
     if args.verb == "registry-prune":
         from dcos_commons_tpu.tools.registry import prune_registry
 
-        pruned = prune_registry(args.dir, args.keep, name=args.name)
+        pruned = prune_registry(
+            args.dir, args.keep, name=args.name, grace_s=args.grace_s
+        )
         print(json.dumps({"pruned": pruned}))
         return 0
     if args.verb == "registry-serve":

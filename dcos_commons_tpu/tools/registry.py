@@ -225,7 +225,9 @@ def _publish_local_locked(
     }
 
 
-def prune_registry(registry: str, keep: int, name: str = "") -> Dict:
+def prune_registry(
+    registry: str, keep: int, name: str = "", grace_s: float = 0.0,
+) -> Dict:
     """Retire old releases (release_builder's lifecycle cleanup): for
     each package — or just ``name`` — keep the newest ``keep``
     versions by the semver ordering and drop the rest from the index,
@@ -236,7 +238,21 @@ def prune_registry(registry: str, keep: int, name: str = "") -> Dict:
     {package: [pruned versions]}.  Immutability SURVIVES the prune:
     each pruned (name, version) leaves a digest TOMBSTONE in the
     index, so republishing different bytes under it is still
-    rejected (republishing the original bytes restores it)."""
+    rejected (republishing the original bytes restores it).
+
+    CONCURRENT-READER CAVEAT: a ``RegistryServer`` (or a shared-
+    filesystem client) may be mid-fetch of an artifact this prune
+    just unreferenced.  On POSIX local disk the open stream survives
+    the unlink, but in the documented NFS mode deleting the file a
+    client is streaming yields TRUNCATED reads or stale-handle
+    errors, not a clean 404.  Either quiesce fetches around the
+    prune, or pass ``grace_s`` > 0: unreferenced artifacts are then
+    RENAMED to ``<file>.trash-<epoch>-<grace>`` (dropping them from
+    the index and from fetch immediately) and only unlinked by a
+    LATER prune once the window RECORDED IN THE NAME has elapsed (a
+    later prune with a smaller ``grace_s`` cannot shorten an earlier
+    prune's promise) — any fetch that resolved the old index entry
+    before the prune has ``grace_s`` seconds to finish streaming."""
     if _is_http(registry):
         raise PackageError(
             "prune runs on the registry host's directory, not over "
@@ -267,11 +283,20 @@ def prune_registry(registry: str, keep: int, name: str = "") -> Dict:
                     pkg, {}
                 )[version] = versions[version]["sha256"]
                 del versions[version]
-        if not pruned:
+        if pruned:
+            _store_index(index_path, index)
+        elif grace_s <= 0:
             return {}
-        _store_index(index_path, index)
         # delete artifacts nothing retained references (a file can be
-        # shared only by index entries; recompute the live set)
+        # shared only by index entries; recompute the live set).  With
+        # a grace window, dead artifacts are parked as .trash-<epoch>
+        # first (invisible to fetch, bytes intact for in-flight
+        # readers) and reaped by whichever prune runs after the
+        # window — so this block also runs when nothing was pruned,
+        # to reap earlier prunes' leavings.
+        import time
+
+        now = time.time()
         live = {
             entry["artifact"]
             for versions in index["packages"].values()
@@ -280,9 +305,34 @@ def prune_registry(registry: str, keep: int, name: str = "") -> Dict:
         artifact_dir = os.path.join(registry, ARTIFACT_DIR)
         if os.path.isdir(artifact_dir):
             for fname in os.listdir(artifact_dir):
+                path = os.path.join(artifact_dir, fname)
+                if ".trash-" in fname:
+                    # the window a parked file was PROMISED rides in
+                    # its name (.trash-<epoch>-<grace>): a later prune
+                    # run with a smaller --grace-s must not break the
+                    # promise an earlier one made to in-flight readers
+                    try:
+                        parts = fname.rsplit(".trash-", 1)[1].split("-")
+                        parked = float(parts[0])
+                        promised = float(parts[1]) if len(parts) > 1 \
+                            else 0.0
+                    except (ValueError, IndexError):
+                        parked = promised = 0.0
+                    if now - parked >= promised:
+                        try:
+                            os.remove(path)
+                        except OSError:
+                            pass
+                    continue
                 if fname not in live and not fname.endswith(".tmp"):
                     try:
-                        os.remove(os.path.join(artifact_dir, fname))
+                        if grace_s > 0:
+                            os.rename(
+                                path,
+                                f"{path}.trash-{int(now)}-{int(grace_s)}",
+                            )
+                        else:
+                            os.remove(path)
                     except OSError:
                         pass
         return pruned

@@ -1,4 +1,4 @@
-"""Serving micro-batcher shared by the single-chip and gang servers.
+"""Dispatch-per-group serving micro-batcher (LEGACY for serving).
 
 One decode step costs nearly the same wall time for 1 or N rows, so
 concurrent clients that would otherwise serialize behind the chip are
@@ -7,8 +7,18 @@ only (one traced scalar per batch); prompt LENGTHS mix freely because
 the compiled function takes a per-row true_len vector
 (models/decode.py).
 
-Liveness rules this class guarantees (both servers inherit them —
-they previously diverged and each copy had its own bug):
+The serve workers no longer use this path: the continuous-batching
+slot engine (dcos_commons_tpu/serve/) subsumed it — per-step
+admission into a persistent KV slot pool instead of whole-generate
+dispatches — and inherits the liveness rules below (FIFO admission,
+queue-timeout removal, idle callback).  This class remains as the
+honest baseline ``bench_continuous_serve`` measures against, the
+generic micro-batching utility, and the home of ``QueueTimeoutError``
+(the saturation signal both paths raise and HTTP handlers map to
+503).
+
+Liveness rules this class guarantees (the engine inherits them —
+the two servers previously diverged and each copy had its own bug):
 
 * FIFO with head-always-dispatches: the oldest pending item is ALWAYS
   in the dispatched group, so a request whose key matches nothing
@@ -29,6 +39,14 @@ import threading
 from typing import Callable, List, Optional
 
 import numpy as np
+
+
+class QueueTimeoutError(RuntimeError):
+    """A request expired waiting for chip capacity (the batcher's
+    queue timeout, or the slot-pool engine's admission queue).  This
+    is server SATURATION, not caller error: HTTP handlers map it to
+    503 so load generators and clients can tell overload apart from a
+    400 bad request."""
 
 
 class WorkItem:
@@ -87,7 +105,9 @@ class MicroBatcher:
                     self._pending.remove(item)
                 except ValueError:
                     pass  # already grouped: the result will be dropped
-            raise RuntimeError("generate timed out in the batch queue")
+            raise QueueTimeoutError(
+                "generate timed out in the batch queue"
+            )
         if item.error is not None:
             raise item.error
         return item.result

@@ -1,21 +1,25 @@
-"""Sharded serving gang worker: tp-sharded generate over a multi-host
+"""Sharded serving gang worker: continuous batching over a multi-host
 jax.distributed gang, fronted by rank 0's HTTP server.
 
 The serving half of the flagship at GANG scale: the model's parameters
 are tensor-parallel-sharded across every chip of the gang (a model too
-big for one host serves from the whole slice), and every request is
-executed by ONE pjit'd generate that all ranks enter together.  SPMD
-serving needs every process in the collective, but requests arrive
-only at the VIP'd rank — so rank 0 broadcasts each request (or an
-idle tick) to the gang, everyone steps the same program, and rank 0
-replies.  This is the standard multihost serving driver loop; the
-single-chip path (serve_worker.py) stays dispatch-free.
+big for one host serves from the whole slice), and the slot-pool KV
+cache (dcos_commons_tpu/serve/) is laid over the same mesh.  SPMD
+serving needs every process in every collective, but requests arrive
+only at the VIP'd rank — so rank 0 drives the gang with PER-TICK
+broadcast ops and every rank executes the identical payload:
 
-Concurrent clients MICRO-BATCH like the single-chip server: the
-driver drains same-temperature queued requests into one gang dispatch,
-and mixed prompt LENGTHS merge too — the broadcast carries a per-row
-true_len vector (models/decode.py per-row path), so heterogeneous
-clients share the mesh instead of serializing behind it.
+    NOOP    keep the gang meeting in a collective while idle
+    ADMIT   prefill ONE waiting request into a free pool slot
+    DECODE  advance EVERY pool row one step (per-row pos/temp/seed)
+
+Requests therefore join and leave MID-FLIGHT: a request arriving
+while others decode is admitted at the next tick (TTFT = one tick +
+its own prefill, not a whole preceding generation), and a row hitting
+its EOS/max-token retires its slot immediately while the rest keep
+stepping.  The driver/follower shape is unchanged from the
+dispatch-per-group protocol this replaces (spmdcheck-clean: followers
+just execute the broadcast payload), only the op vocabulary grew.
 
 Failover comes from GANG recovery, not from this file: kill any host
 and the scheduler replaces the whole gang (tests/test_gang_serve.py
@@ -39,21 +43,71 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 sys.path.insert(0, os.environ.get("REPO_ROOT", "/root/repo"))
 
+from dcos_commons_tpu.serve import SERVESTATS_NAME, SlotEngine  # noqa: E402
 from dcos_commons_tpu.trace.steplog import StepLog  # noqa: E402
-from dcos_commons_tpu.utils.microbatch import (  # noqa: E402
-    MicroBatcher,
-    WorkItem,
-    pack_mixed_rows,
-    unpack_results,
-)
+from dcos_commons_tpu.utils.microbatch import QueueTimeoutError  # noqa: E402
 
 # how often idle ranks meet in a noop collective: the gang must stay
 # in lockstep even with no traffic, or a request would wait on ranks
 # parked in a stale program
 IDLE_TICK_S = 0.05
 
+# per-tick broadcast ops (the old one-shot OP_GENERATE grew into the
+# ADMIT/DECODE pair so requests join and leave mid-flight)
 OP_NOOP = 0
-OP_GENERATE = 1
+OP_ADMIT = 1
+OP_DECODE = 2
+
+# steplog sampling: continuous batching ticks once per TOKEN, not per
+# request — record the first few ticks then every Nth so the skew
+# signal survives without an unbounded file
+_STEPLOG_EVERY = 64
+
+
+def _zero_payload(slots, prompt_len):
+    return (
+        np.zeros(6, np.int64),                # head [op, a, b, c, d, e]
+        np.zeros((slots, 4), np.int64),       # rows [tok, pos, temp_u, seed]
+        np.zeros((1, prompt_len), np.int32),  # ADMIT prompt
+    )
+
+
+def _broadcast_tick(multihost_utils, payload, slots, prompt_len):
+    """One gang-wide broadcast: rank 0 passes (head, rows, prompt),
+    the followers pass None and receive rank 0's payload.  Every
+    tick's payload has the same byte shape regardless of op, so the
+    broadcast cost is flat and the follower loop is shape-stable.
+
+    head by op: ADMIT = [op, slot, true_len, seed, temp_micro, 0];
+    DECODE = [op, n_active, 0, 0, 0, 0]; NOOP = zeros.  ``rows``
+    carries the DECODE pool state (token, position, temperature in
+    micro-units, per-row PRNG seed)."""
+    if payload is None:
+        payload = _zero_payload(slots, prompt_len)
+    head, rows, prompt = multihost_utils.broadcast_one_to_all(payload)
+    return np.asarray(head), np.asarray(rows), np.asarray(prompt)
+
+
+def _execute_tick(pool, head, rows, prompt):
+    """Run the broadcast op — EVERY rank (driver included) executes
+    the identical payload, so traced operands are byte-identical
+    across the gang and the collective schedules never diverge.
+    Returns the op's result (first token for ADMIT, next-token vector
+    for DECODE, None for NOOP)."""
+    op = int(head[0])
+    if op == OP_ADMIT:
+        return pool.prefill(
+            prompt, slot=int(head[1]), true_len=int(head[2]),
+            temp=int(head[4]) / 1e6, seed=int(head[3]),
+        )
+    if op == OP_DECODE:
+        return pool.decode(
+            rows[:, 0].astype(np.int32),
+            rows[:, 1].astype(np.int32),
+            (rows[:, 2] / 1e6).astype(np.float32),
+            rows[:, 3].astype(np.int32),
+        )
+    return None
 
 
 def main() -> int:
@@ -70,13 +124,11 @@ def main() -> int:
     from jax.sharding import NamedSharding
     from jax.sharding import PartitionSpec as P
 
-    from dcos_commons_tpu.models import (
-        config_from_env,
-        generate,
-        init_params,
-    )
+    from dcos_commons_tpu.metrics.registry import Metrics
+    from dcos_commons_tpu.models import config_from_env, init_params
     from dcos_commons_tpu.models.transformer import param_shardings
     from dcos_commons_tpu.parallel.mesh import MeshSpec, make_mesh
+    from dcos_commons_tpu.serve.pool import PoolModel
     from dcos_commons_tpu.utils import (
         enable_compilation_cache,
         restore_checkpoint,
@@ -99,6 +151,8 @@ def main() -> int:
     )
     max_len = int(os.environ.get("MAX_LEN", "256"))
     batch = int(os.environ.get("SERVE_BATCH", "1"))
+    # "" and 0 both mean "use SERVE_BATCH" (the options.json default)
+    slots = int(os.environ.get("SERVE_SLOTS") or 0) or batch
     new_tokens = int(os.environ.get("MAX_NEW_TOKENS", "32"))
     prompt_len = max_len - new_tokens
 
@@ -131,64 +185,63 @@ def main() -> int:
 
         def to_global(arr):
             """Identical host-local array on every rank -> one global
-            replicated jax array the sharded generate accepts."""
+            replicated jax array the sharded pool accepts."""
             return multihost_utils.host_local_array_to_global_array(
                 arr, mesh, P()
             )
 
         kv_dtype = os.environ.get("KV_DTYPE", "native")
-        gen = jax.jit(
-            lambda p, t, seed, temp, lens: generate(
-                config, p, t, max_new_tokens=new_tokens, max_len=max_len,
-                temperature=temp, key=jax.random.key(seed),
-                true_len=lens, kv_dtype=kv_dtype,
+        # the pool's KV heads ride the tp axis like the attention
+        # weights when they divide it; otherwise the cache replicates
+        # (tiny-head test configs on wide meshes)
+        kv_spec = (
+            P(None, None, None, "tp", None)
+            if config.n_kv_heads % n_devices == 0 else P()
+        )
+        pool = PoolModel(
+            config, params, slots, max_len, kv_dtype=kv_dtype,
+            cache_sharding=NamedSharding(mesh, kv_spec),
+            put=to_global,
+            constrain_out=lambda x: jax.lax.with_sharding_constraint(
+                x, replicated
             ),
-            out_shardings=replicated,
         )
 
-        def run_from_payload(head, lens, prompt_np):
-            """Execute the broadcast program: EVERY rank decodes the
-            identical payload, so traced operands are byte-identical
-            across the gang (diverging scalars would make each rank
-            compute a different program's shard).  ``lens`` is the
-            PER-ROW true_len vector: mixed-length merged requests ride
-            one dispatch (models/decode.py per-row path)."""
-            out = gen(
-                params,
-                to_global(prompt_np.astype(np.int32)),
-                np.int64(int(head[2])),
-                np.float32(int(head[3]) / 1e6),
-                to_global(lens.astype(np.int32)),
-            )
-            # replicated output: every rank holds the full answer;
-            # ONE bulk fetch (per-element reads are ~100ms each over a
-            # TPU relay)
-            return np.asarray(jax.device_get(out))
+        # warm the compiled pool as a GANG before readiness: the first
+        # request must not pay the compiles, and a rank that cannot
+        # compile must fail deploy, not the first client.  Every rank
+        # reaches this call at the same program point (pre-loop).
+        pool.warm(prompt_len)
 
-        # warm the compiled path as a GANG before readiness: the first
-        # request must not pay the compile, and a rank that cannot
-        # compile must fail deploy, not the first client
-        run_from_payload(
-            np.asarray([OP_GENERATE, batch, 0, 0], np.int64),
-            np.full((batch,), prompt_len, np.int32),
-            np.zeros((batch, prompt_len), np.int32),
-        )
-
-        # per-dispatch step telemetry ($SANDBOX/steplog.jsonl): every
-        # rank logs each gang generate — wall seconds, rows, and for
-        # followers the time spent parked in the broadcast waiting for
-        # rank 0 (the serving gang's skew/idle signal).  Surfaced by
-        # the scheduler's /v1/debug/trace as one lane per host.
+        # per-tick step telemetry ($SANDBOX/steplog.jsonl): sampled
+        # decode ticks on every rank — wall seconds, active rows, and
+        # for followers the time spent parked in the broadcast waiting
+        # for rank 0 (the serving gang's skew/idle signal).  Surfaced
+        # by the scheduler's /v1/debug/trace as one lane per host.
         import time as _time
 
         steplog = StepLog()
-        dispatch_count = [0]
+        tick_count = [0]
+
+        def _log_tick(wall_s, blocked_s, active):
+            n = tick_count[0]
+            tick_count[0] += 1
+            if n >= 4 and n % _STEPLOG_EVERY:
+                return
+            steplog.record(
+                n,
+                wall_s=round(wall_s, 6),
+                blocked_s=round(blocked_s, 6),
+                rows=active,
+                tokens=active,
+                worker=rank,
+            )
 
         # Intentional driver/follower split: BOTH sides of this branch
-        # run the identical collective sequence (one _broadcast_tick per
-        # tick, one gang generate per OP_GENERATE), so the schedules
-        # never diverge; the branch only decides who PRODUCES the
-        # payload that every rank consumes.
+        # run the identical collective sequence (one _broadcast_tick
+        # per tick; _execute_tick runs the same op payload on every
+        # rank), so the schedules never diverge; the branch only
+        # decides who PRODUCES the payload that every rank consumes.
         # sdklint: disable=spmd-host-branch — driver loops meet in the broadcast
         if rank != 0:
             # follower loop: meet rank 0 in every broadcast tick and
@@ -198,79 +251,88 @@ def main() -> int:
             print(f"rank {rank}: following gang broadcasts", flush=True)
             while True:
                 b0 = _time.time()
-                head, lens, prompt = _broadcast_tick(
-                    multihost_utils, None, batch, prompt_len
+                head, rows, prompt = _broadcast_tick(
+                    multihost_utils, None, slots, prompt_len
                 )
                 blocked_s = _time.time() - b0
-                if int(head[0]) == OP_GENERATE:
-                    t0 = _time.time()
-                    run_from_payload(head, lens, prompt)
-                    steplog.record(
-                        dispatch_count[0],
-                        wall_s=round(_time.time() - t0, 6),
-                        blocked_s=round(blocked_s, 6),
-                        rows=int(head[1]),
-                        tokens=int(head[1]) * new_tokens,
-                        worker=rank,
-                    )
-                    dispatch_count[0] += 1
+                t0 = _time.time()
+                _execute_tick(pool, head, rows, prompt)
+                if int(head[0]) == OP_DECODE:
+                    _log_tick(_time.time() - t0, blocked_s, int(head[1]))
 
-        # ---- rank 0: HTTP front end + the shared micro-batcher ------
-        # run_group broadcasts the merged group to the gang (mixed
-        # lengths ride the per-row lens vector); on_idle keeps the
-        # followers meeting in noop collectives between requests.
-        def run_group(group):
-            if len(group) > 1:
-                print(
-                    f"gangbatch: {len(group)} requests / "
-                    f"{sum(len(m.rows) for m in group)} rows in one "
-                    "gang dispatch",
-                    flush=True,
-                )
-            prompt, lens, used = pack_mixed_rows(
-                group, batch, prompt_len
+        # ---- rank 0: HTTP front end + the slot engine ---------------
+        # engine callbacks broadcast the op, then execute it exactly
+        # like a follower would (one code path = no divergence);
+        # on_idle keeps the followers meeting in noop collectives.
+        def prefill_fn(padded, slot, true_len, temp, seed):
+            # round() like decode_fn does: truncation would give a
+            # request's FIRST token a different temperature than its
+            # later tokens (0.07*1e6 truncates to 69999)
+            head = np.asarray(
+                [OP_ADMIT, slot, true_len, seed, round(temp * 1e6), 0],
+                np.int64,
             )
-            seed = int.from_bytes(os.urandom(4), "little")
-            head = np.asarray([
-                OP_GENERATE, used, seed, int(group[0].temp * 1e6),
-            ], np.int64)
-            head, lens, prompt = _broadcast_tick(
-                multihost_utils, (head, lens, prompt),
-                batch, prompt_len,
+            _, zero_rows, _ = _zero_payload(slots, prompt_len)
+            head, rows, prompt = _broadcast_tick(
+                multihost_utils,
+                (head, zero_rows, padded.astype(np.int32)),
+                slots, prompt_len,
+            )
+            return _execute_tick(pool, head, rows, prompt)
+
+        def decode_fn(tok, pos, temps, seeds, n_active):
+            head = np.asarray(
+                [OP_DECODE, n_active, 0, 0, 0, 0], np.int64
+            )
+            rows = np.stack([
+                tok.astype(np.int64),
+                pos.astype(np.int64),
+                np.round(temps.astype(np.float64) * 1e6).astype(np.int64),
+                seeds.astype(np.int64),
+            ], axis=1)
+            zero_prompt = np.zeros((1, prompt_len), np.int32)
+            head, rows, prompt = _broadcast_tick(
+                multihost_utils, (head, rows, zero_prompt),
+                slots, prompt_len,
             )
             t0 = _time.time()
-            out = run_from_payload(head, lens, prompt)
-            steplog.record(
-                dispatch_count[0],
-                wall_s=round(_time.time() - t0, 6),
-                blocked_s=0.0,  # rank 0 paces the gang; it never waits
-                rows=used,
-                tokens=used * new_tokens,
-                worker=0,
-            )
-            dispatch_count[0] += 1
-            unpack_results(group, out)
+            out = _execute_tick(pool, head, rows, prompt)
+            # rank 0 paces the gang; it never waits in the broadcast
+            _log_tick(_time.time() - t0, 0.0, n_active)
+            return out
 
         def idle_tick():
-            _broadcast_tick(multihost_utils, None, batch, prompt_len)
+            _broadcast_tick(multihost_utils, None, slots, prompt_len)
 
-        batcher = MicroBatcher(
-            run_group, capacity=batch,
-            # default 0: the gang driver loop already paces dispatches
-            # (followers meet rank 0 in broadcast ticks), so waiting
-            # for joiners only adds latency unless an operator asks
-            window_s=float(
-                os.environ.get("MICROBATCH_WINDOW_MS", "0")
-            ) / 1e3,
-            queue_timeout_s=float(
-                os.environ.get("SERVE_QUEUE_TIMEOUT_S", "600")
-            ),
-            on_idle=idle_tick, idle_every_s=IDLE_TICK_S,
+        queue_timeout_s = float(
+            os.environ.get("SERVE_QUEUE_TIMEOUT_S", "600")
         )
+        metrics = Metrics()
+        engine = SlotEngine(
+            prefill_fn, decode_fn, slots, max_len, prompt_len,
+            queue_timeout_s=queue_timeout_s,
+            on_idle=idle_tick, idle_every_s=IDLE_TICK_S,
+            stats_path=os.path.join(
+                os.environ.get("SANDBOX", "."), SERVESTATS_NAME
+            ),
+            log=lambda msg: print(msg, flush=True),
+        )
+        engine.register_metrics(metrics)
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, fmt, *args):
                 pass
+
+            def do_GET(self):
+                if self.path.split("?")[0] != "/stats":
+                    self.send_error(404)
+                    return
+                payload = json.dumps(engine.stats()).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
 
             def do_POST(self):
                 if self.path != "/generate":
@@ -284,8 +346,8 @@ def main() -> int:
                         raise ValueError(
                             f"{len(rows)} prompts > server batch {batch}"
                         )
-                    # rows may have MIXED lengths: the gang dispatch
-                    # takes a per-row true_len vector
+                    # rows may have MIXED lengths: each rides its own
+                    # pool slot with its own true_len
                     for row in rows:
                         if not 1 <= len(row) <= prompt_len:
                             raise ValueError(
@@ -308,13 +370,25 @@ def main() -> int:
                     )
                     if n < 1:
                         raise ValueError("max_new_tokens must be >= 1")
-                    result = batcher.submit(WorkItem(
+                    eos = body.get("eos")
+                    if eos is not None:
+                        eos = int(eos)
+                        if not 0 <= eos < config.vocab:
+                            raise ValueError(
+                                f"eos must be in [0, {config.vocab})"
+                            )
+                    result = engine.submit(
                         [[int(t) % config.vocab for t in row]
                          for row in rows],
-                        n, temp,
-                    ))
+                        n, temperature=temp, eos_id=eos,
+                    )
                     payload = json.dumps({"tokens": result}).encode()
                     self.send_response(200)
+                except QueueTimeoutError as e:
+                    # saturation, NOT caller error: no KV slot freed
+                    # in time — clients/load generators back off
+                    payload = json.dumps({"error": str(e)}).encode()
+                    self.send_response(503)
                 except Exception as e:  # noqa: BLE001
                     payload = json.dumps({"error": str(e)}).encode()
                     self.send_response(400)
@@ -328,27 +402,13 @@ def main() -> int:
         with open("ready", "w") as f:
             f.write("warm\n")
         print(
-            f"rank 0: serving sharded generate({batch}x{prompt_len}->"
-            f"{new_tokens}) tp={n_devices} on {server.server_address[1]}",
+            f"rank 0: serving sharded generate over a {slots}-slot "
+            f"pool (prompts<={prompt_len}->{new_tokens}) tp={n_devices} "
+            f"on {server.server_address[1]}",
             flush=True,
         )
         server.serve_forever()
     return 0
-
-
-def _broadcast_tick(multihost_utils, payload, batch, prompt_len):
-    """One gang-wide broadcast: rank 0 passes (head, lens, prompt),
-    the followers pass None and receive rank 0's payload.  head =
-    [op, rows_used, seed, temp_micro]; lens is the per-row true_len
-    vector (mixed-length merging)."""
-    if payload is None:
-        payload = (
-            np.zeros(4, np.int64),
-            np.zeros((batch,), np.int32),
-            np.zeros((batch, prompt_len), np.int32),
-        )
-    head, lens, prompt = multihost_utils.broadcast_one_to_all(payload)
-    return np.asarray(head), np.asarray(lens), np.asarray(prompt)
 
 
 if __name__ == "__main__":

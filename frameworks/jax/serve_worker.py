@@ -1,45 +1,53 @@
 """Inference serving task: the flagship behind an HTTP endpoint.
 
 The scheduler deploys this like any other task (svc_serve.yml): it
-builds the model, warms the KV-cache generate path (one compile), then
-serves POST /generate on the scheduler-assigned port — discoverable
-via /v1/endpoints and the VIP.  Readiness: the task's readiness check
+builds the model, warms the slot-pool decode path (two compiles —
+prefill-into-slot and one pool decode step), then serves POST
+/generate on the scheduler-assigned port — discoverable via
+/v1/endpoints and the VIP.  Readiness: the task's readiness check
 passes once the warmup file exists, so the deploy plan completes only
 when the server can actually answer.
 
-Request:  {"tokens": [[...]], "max_new_tokens": N, "temperature": T}
-Response: {"tokens": [[...]]} — the continuations only.
+Request:  {"tokens": [[...]], "max_new_tokens": N, "temperature": T,
+           "eos": E?}
+Response: {"tokens": [[...]]} — the continuations only (cut at E when
+          the row produced it).
+Errors:   400 = caller error (bad prompt/params); 503 = server
+          saturation (the request timed out waiting for a KV slot) —
+          load generators must be able to tell these apart.
 
-Concurrency: with SERVE_BATCH > 1 the server MICRO-BATCHES — a decode
-step costs nearly the same wall time for 1 or 64 rows, so concurrent
-single-prompt clients that would otherwise serialize behind the chip
-are collected for MICROBATCH_WINDOW_MS and answered by ONE generate.
-MIXED prompt lengths merge too: the compiled function takes a traced
-PER-ROW true_len vector (models/decode.py), so heterogeneous clients
-share one dispatch — only the temperature groups requests (it is one
-traced scalar for the whole batch).
+Concurrency: CONTINUOUS BATCHING over a persistent slot-pool KV cache
+(dcos_commons_tpu/serve/): the cache is allocated once at
+SERVE_SLOTS x MAX_LEN, waiting requests are admitted into free slots
+at EVERY decode step, and finished rows (per-row EOS / max-token)
+retire their slots immediately — no request waits for a whole
+preceding generation (time-to-first-token is one decode tick + its
+own prefill) and no row pads out to the longest generation in its
+batch.  Mixed prompt lengths, mixed requested lengths AND mixed
+temperatures all share one pool dispatch (per-row positions, temps
+and PRNG seeds are traced).  GET /stats exposes the serving gauges
+(queue depth, active slots, KV occupancy, tokens/s); the same
+snapshot lands in the sandbox for the scheduler's /v1/debug/serving.
 """
 
 import json
 import math
 import os
 import sys
-import threading
 
-import numpy as np
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 sys.path.insert(0, os.environ.get("REPO_ROOT", "/root/repo"))
 
+from dcos_commons_tpu.serve import SERVESTATS_NAME, SlotEngine  # noqa: E402
 from dcos_commons_tpu.utils.microbatch import (  # noqa: E402
     MicroBatcher,
+    QueueTimeoutError,
     WorkItem,
-    pack_mixed_rows,
-    unpack_results,
 )
 
-# back-compat aliases (unit tests drive the batcher through this
-# module's names; the implementation is shared with the gang server)
+# back-compat aliases (unit tests drive the legacy batcher through
+# this module's names; the slot engine subsumed it for serving)
 _MicroBatcher = MicroBatcher
 _WorkItem = WorkItem
 
@@ -51,11 +59,9 @@ def main() -> int:
     if os.environ.get("JAX_PLATFORMS") == "cpu":
         jax.config.update("jax_platforms", "cpu")
 
-    from dcos_commons_tpu.models import (
-        config_from_env,
-        generate,
-        init_params,
-    )
+    from dcos_commons_tpu.metrics.registry import Metrics
+    from dcos_commons_tpu.models import config_from_env, init_params
+    from dcos_commons_tpu.serve.pool import PoolModel
     from dcos_commons_tpu.utils import (
         enable_compilation_cache,
         restore_checkpoint,
@@ -71,6 +77,10 @@ def main() -> int:
     )
     max_len = int(os.environ.get("MAX_LEN", "256"))
     batch = int(os.environ.get("SERVE_BATCH", "1"))
+    # the slot POOL defaults to the request cap; SERVE_SLOTS decouples
+    # them (more concurrent residents than any one request may carry);
+    # "" and 0 both mean "use SERVE_BATCH" (the options.json default)
+    slots = int(os.environ.get("SERVE_SLOTS") or 0) or batch
     new_tokens = int(os.environ.get("MAX_NEW_TOKENS", "32"))
 
     params = init_params(config, jax.random.key(0))
@@ -92,62 +102,42 @@ def main() -> int:
         params = jax.device_put(quantize_params_int8(params))
         print("weights quantized to int8 (per-channel)", flush=True)
 
-    # ONE compile covers every request: static (batch, prompt_len)
-    # shapes with prompts RIGHT-padded and the true length TRACED
-    # (causal attention means real tokens never see the padding, and
-    # decode overwrites/masks the pad slots); temperature is a traced
-    # operand too — novel temperatures must not recompile
+    # TWO compiles cover every request: prefill-into-slot (prompts
+    # RIGHT-padded, true length / slot / temperature / seed traced)
+    # and one decode step over the whole pool (per-row positions,
+    # temps, seeds traced) — novel requests never recompile.
+    # KV_DTYPE=int8 halves the pool bytes per decode step: the lever
+    # for many resident slots on a full chip (models/decode.py)
     prompt_len = max_len - new_tokens
-    # KV_DTYPE=int8 halves the cache bytes per decode step: the lever
-    # for large serving batches on a full chip (models/decode.py)
     kv_dtype = os.environ.get("KV_DTYPE", "native")
-    gen = jax.jit(lambda p, t, key, temp, n: generate(
-        config, p, t, max_new_tokens=new_tokens, max_len=max_len,
-        temperature=temp, key=key, true_len=n, kv_dtype=kv_dtype,
-    ))
-    lock = threading.Lock()
+    pool = PoolModel(config, params, slots, max_len, kv_dtype=kv_dtype)
 
-    def run_group(items):
-        """ONE generate for a compatible group of requests — mixed
-        prompt lengths ride the per-row true_len vector."""
-        if len(items) > 1:
-            print(
-                f"microbatch: {len(items)} requests / "
-                f"{sum(len(i.rows) for i in items)} rows in one generate",
-                flush=True,
-            )
-        padded, lens, _used = pack_mixed_rows(items, batch, prompt_len)
-        # fresh entropy per batch: hashing only the prompt made
-        # temperature>0 replies deterministic per process
-        seed = int.from_bytes(os.urandom(4), "little")
-        with lock:  # one generate at a time per chip
-            out = gen(
-                params, jnp.asarray(padded),
-                jax.random.key(seed),
-                jnp.float32(items[0].temp),
-                jnp.asarray(lens),
-            )
-        # ONE bulk device->host fetch, then slice in numpy: per-element
-        # int(out[i, j]) would be a separate transfer each (~100ms over
-        # a TPU relay — 256 of them turned a 1.5s generate into a 36s
-        # reply)
-        unpack_results(items, np.asarray(jax.device_get(out)))
-
-    window_s = float(os.environ.get("MICROBATCH_WINDOW_MS", "5")) / 1e3
-    # with a 1-row server there is nothing to batch: the direct path
-    # keeps zero added latency (and bit-identical single-client flow)
     queue_timeout_s = float(os.environ.get("SERVE_QUEUE_TIMEOUT_S", "600"))
-    batcher = (
-        _MicroBatcher(
-            run_group, capacity=batch, window_s=window_s,
-            queue_timeout_s=queue_timeout_s,
-        )
-        if batch > 1 else None
+    metrics = Metrics()
+    engine = SlotEngine(
+        pool.prefill, pool.decode, slots, max_len, prompt_len,
+        queue_timeout_s=queue_timeout_s,
+        stats_path=os.path.join(
+            os.environ.get("SANDBOX", "."), SERVESTATS_NAME
+        ),
+        log=lambda msg: print(msg, flush=True),
     )
+    engine.register_metrics(metrics)
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, fmt, *args):
             pass
+
+        def do_GET(self):
+            if self.path.split("?")[0] != "/stats":
+                self.send_error(404)
+                return
+            payload = json.dumps(engine.stats()).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
 
         def do_POST(self):
             if self.path != "/generate":
@@ -177,9 +167,8 @@ def main() -> int:
                         )
                 temp = float(body.get("temperature", 0.0))
                 if not math.isfinite(temp) or temp < 0.0:
-                    # json.loads accepts NaN/Infinity: a NaN group key
-                    # is never equal to itself and must not reach the
-                    # batcher (or the chip, where it poisons sampling)
+                    # json.loads accepts NaN/Infinity: a NaN must not
+                    # reach the chip, where it poisons sampling
                     raise ValueError(
                         f"temperature must be finite and >= 0, got {temp}"
                     )
@@ -189,17 +178,26 @@ def main() -> int:
                         f"max_new_tokens must be >= 1, got {n}"
                     )
                 n = min(n, new_tokens)
+                eos = body.get("eos")
+                if eos is not None:
+                    eos = int(eos)
+                    if not 0 <= eos < config.vocab:
+                        raise ValueError(
+                            f"eos must be in [0, {config.vocab}), got {eos}"
+                        )
                 clean_rows = [
                     [int(t) % config.vocab for t in row] for row in rows
                 ]
-                item = _WorkItem(clean_rows, n, temp)
-                if batcher is not None:
-                    result = batcher.submit(item)
-                else:
-                    run_group([item])
-                    result = item.result
+                result = engine.submit(
+                    clean_rows, n, temperature=temp, eos_id=eos
+                )
                 payload = json.dumps({"tokens": result}).encode()
                 self.send_response(200)
+            except QueueTimeoutError as e:
+                # saturation, NOT caller error: the request never got
+                # a KV slot in time — clients/load generators back off
+                payload = json.dumps({"error": str(e)}).encode()
+                self.send_response(503)
             except Exception as e:  # noqa: BLE001 — surface to client
                 payload = json.dumps({"error": str(e)}).encode()
                 self.send_response(400)
@@ -218,17 +216,13 @@ def main() -> int:
     # bind failure (port collision) must fail readiness, not pass it
     port = int(os.environ.get("PORT_HTTP", "0"))
     server = ThreadingHTTPServer(("0.0.0.0", port), Handler)
-    warm = jnp.zeros((batch, prompt_len), jnp.int32)
-    out = gen(
-        params, warm, jax.random.key(0), jnp.float32(0.0),
-        jnp.full((batch,), prompt_len, jnp.int32),
-    )
-    jax.block_until_ready(out)
+    pool.warm(prompt_len)
     with open("ready", "w") as f:
         f.write("warm\n")
     print(
-        f"warm: serving generate({batch}x{prompt_len}->{new_tokens}) "
-        f"on {server.server_address[1]}",
+        f"warm: continuous batching {slots} slots x {max_len} "
+        f"(prompts<={prompt_len}, <={new_tokens} new) on "
+        f"{server.server_address[1]}",
         flush=True,
     )
     server.serve_forever()

@@ -204,6 +204,33 @@ def test_debug_trace_truncation_reports_dropped(deployed):
     assert get(server, "/v1/metrics")["trace.dropped"] == 6
 
 
+def test_debug_serving_route(deployed):
+    """Serving-load surface: {} when no worker wrote gauges; merged
+    per-task snapshots when the agent surfaces servestats files
+    (serve/engine.py mirrors its gauges to the sandbox)."""
+    runner, server = deployed
+    # the sim harness agent has no sandboxes: empty, not an error
+    assert get(server, "/v1/debug/serving") == {"serving": {}}
+
+    stats = {
+        "slots": 8, "queue_depth": 3, "active_slots": 5,
+        "kv_occupancy": 0.42, "tokens_per_s": 123.4,
+    }
+
+    class _ServingAgent:
+        def serving_stats_of(self, task_name):
+            return dict(stats) if task_name == "web-0-srv" else {}
+
+    scheduler = runner.world.scheduler
+    original = scheduler.agent
+    scheduler.agent = _ServingAgent()
+    try:
+        body = get(server, "/v1/debug/serving")
+        assert body["serving"] == {"web-0-srv": stats}
+    finally:
+        scheduler.agent = original
+
+
 def test_plan_verbs_over_http(deployed):
     runner, server = deployed
     # a COMPLETE plan stays COMPLETE through interrupt/continue

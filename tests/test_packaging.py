@@ -591,6 +591,49 @@ def test_registry_version_ordering_release_beats_prerelease(tmp_path):
     assert version == "1.0.0-rc1"
 
 
+def test_registry_prune_grace_window_parks_artifacts(tmp_path):
+    """--grace-s > 0 (ADVICE r5): a pruned artifact leaves the index
+    immediately but its BYTES are parked as .trash-<epoch> so an NFS
+    client mid-fetch keeps streaming; a LATER prune reaps trash older
+    than the window."""
+    from dcos_commons_tpu.tools import publish_package, registry_index
+    from dcos_commons_tpu.tools.registry import prune_registry
+
+    framework = make_framework(tmp_path)
+    registry = str(tmp_path / "registry")
+    for version in ("1.0.0", "1.1.0"):
+        artifact = str(tmp_path / f"p-{version}.tgz")
+        build_package(framework, artifact, version=version)
+        publish_package(artifact, registry)
+
+    pruned = prune_registry(registry, keep=1, grace_s=3600.0)
+    assert pruned == {"pkgsvc": ["1.0.0"]}
+    assert set(registry_index(registry)["packages"]["pkgsvc"]) == {"1.1.0"}
+    artifact_dir = os.path.join(registry, "artifacts")
+    names = os.listdir(artifact_dir)
+    parked = [n for n in names if n.startswith("pkgsvc-1.0.0") and
+              ".trash-" in n]
+    assert parked, names  # bytes still on disk, out of the index
+    assert "pkgsvc-1.1.0.tar.gz" in names
+    # within the window, a later prune leaves the parked bytes alone
+    assert prune_registry(registry, keep=1, grace_s=3600.0) == {}
+    assert parked[0] in os.listdir(artifact_dir)
+    # ... even a later prune with NO grace: the window an artifact
+    # was parked under rides in its name and cannot be shortened
+    assert prune_registry(registry, keep=1) == {}
+    assert parked[0] in os.listdir(artifact_dir)
+    # age the parked file past its recorded window: the next prune
+    # reaps it (epoch 1000, 60s window, both long elapsed)
+    aged = parked[0].rsplit(".trash-", 1)[0] + ".trash-1000-60"
+    os.rename(
+        os.path.join(artifact_dir, parked[0]),
+        os.path.join(artifact_dir, aged),
+    )
+    assert prune_registry(registry, keep=1, grace_s=3600.0) == {}
+    assert aged not in os.listdir(artifact_dir)
+    assert "pkgsvc-1.1.0.tar.gz" in os.listdir(artifact_dir)
+
+
 def test_registry_prune_retires_old_releases(tmp_path):
     """`package registry-prune --keep K` (release_builder lifecycle
     cleanup): old versions leave the index AND their artifact files;

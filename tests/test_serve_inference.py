@@ -144,11 +144,12 @@ def test_inference_pod_serves_generate(tmp_path):
         agent.shutdown()
 
 
-def test_microbatching_merges_concurrent_clients(tmp_path):
+def test_continuous_batching_merges_concurrent_clients(tmp_path):
     """SERVE_BATCH > 1: concurrent single-prompt clients — of MIXED
-    prompt lengths — are answered by ONE generate call (per-row
-    true_len; only temperature groups) with each client's own correct
-    greedy continuation — concurrency must not change any answer.
+    prompt lengths — share the slot pool (each rides its own slot,
+    admitted mid-flight; per-row true_len/temperature/seed) with each
+    client's own correct greedy continuation — concurrency must not
+    change any answer.
 
     Runs the FULL serving quantization stack (int8 weights + int8 KV,
     models/quantize.py): every assertion here is served-vs-served
@@ -156,7 +157,7 @@ def test_microbatching_merges_concurrent_clients(tmp_path):
     import threading
 
     env = {
-        **TINY_ENV, "SERVE_BATCH": "4", "MICROBATCH_WINDOW_MS": "60",
+        **TINY_ENV, "SERVE_BATCH": "4",
         "WEIGHT_DTYPE": "int8", "KV_DTYPE": "int8",
     }
     spec = from_yaml_file(
@@ -236,16 +237,45 @@ def test_microbatching_merges_concurrent_clients(tmp_path):
             "tokens": [prompts[0], prompts[1]], "max_new_tokens": 6,
         })
         assert mixed["tokens"] == [expected[0], expected[1]]
-        # the worker's log shows at least one merged batch
+        # the worker's log shows concurrent rows sharing the pool
         stdout_path = tmp_path / "sbx" / "server-0-api" / "stdout"
         deadline = time.monotonic() + 10
         while time.monotonic() < deadline:
-            if "microbatch:" in stdout_path.read_text():
+            if "continuous-batch:" in stdout_path.read_text():
                 break
             time.sleep(0.2)
-        assert "microbatch:" in stdout_path.read_text(), (
-            "concurrent clients were never merged into one generate"
+        assert "continuous-batch:" in stdout_path.read_text(), (
+            "concurrent clients never shared a pool decode step"
         )
+        # the serving gauges are live: /stats on the worker reports
+        # the pool shape and the tokens the run produced
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/stats", method="GET"
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            stats = json.loads(resp.read())
+        assert stats["slots"] == 4
+        assert stats["requests_completed"] >= 7
+        assert stats["tokens_out"] >= 7 * 6
+        assert 0.0 <= stats["kv_occupancy"] <= 1.0
+        # and the SCHEDULER sees them: the worker mirrors the gauges
+        # to its sandbox, the agent surfaces the file, and
+        # /v1/debug/serving merges per task
+        from dcos_commons_tpu.http.api import SchedulerApi
+
+        def scheduler_sees():
+            code, body = SchedulerApi(scheduler).debug_serving()
+            assert code == 200
+            return body["serving"].get("server-0-api")
+
+        deadline = time.monotonic() + 15
+        merged = scheduler_sees()
+        while (not merged or merged.get("requests_completed", 0) < 7) \
+                and time.monotonic() < deadline:
+            time.sleep(0.5)  # the worker rewrites servestats ~1/s
+            merged = scheduler_sees()
+        assert merged and merged["slots"] == 4
+        assert merged["requests_completed"] >= 7
     finally:
         agent.shutdown()
 
