@@ -23,6 +23,12 @@ Options:
     --plan-max-states N cap per plancheck configuration (default 200000)
     --hbm-mb N          per-chip HBM budget override (0 = generation table)
     --giant-mb N        replicated-param finding threshold (default 256)
+    --steplog PATH      compare a worker steplog.jsonl against each
+                        train workload's shard.cost wire-time model
+                        (predicted-vs-measured step time; a regression
+                        past --step-slack fails the run)
+    --step-floor-us N   calibrated compute floor added to the wire model
+    --step-slack F      allowed measured-over-floor headroom (default 0.25)
     --verbose/-v        also list suppressed and baselined findings
 
 Exit code 0 = no non-baselined findings and no plan violations;
@@ -84,6 +90,21 @@ def main(argv: List[str] = None) -> int:
     parser.add_argument("--plan-max-states", type=int, default=200_000)
     parser.add_argument("--hbm-mb", type=int, default=0)
     parser.add_argument("--giant-mb", type=float, default=256.0)
+    parser.add_argument(
+        "--steplog", default="",
+        help="worker steplog.jsonl: compare measured step time against "
+             "each train workload's shard.cost wire-time model",
+    )
+    parser.add_argument(
+        "--step-floor-us", type=float, default=0.0,
+        help="calibrated compute floor (us) added to the wire model; "
+             "0 leaves the comparison ungated on collective-free meshes",
+    )
+    parser.add_argument(
+        "--step-slack", type=float, default=0.25,
+        help="allowed measured-over-floor headroom before the steplog "
+             "comparison counts as a regression (0.25 = +25%%)",
+    )
     parser.add_argument("--host-cpus", type=float, default=8.0)
     parser.add_argument("--host-mem", type=int, default=16384)
     parser.add_argument("--host-disk", type=int, default=102400)
@@ -170,6 +191,32 @@ def main(argv: List[str] = None) -> int:
             r.key: r.cost
             for r in shard_result.reports if r.cost is not None
         }
+        if args.steplog:
+            # predicted-vs-measured step time (ISSUE 7): hold each
+            # train workload's wire-time model against the worker's
+            # steplog; an explicit comparison that regresses past the
+            # slack fails the run — the operator asked for the gate
+            # by passing --steplog
+            from dcos_commons_tpu.trace.steplog import read_steplog
+
+            records = read_steplog(args.steplog)
+            doc["shard"]["stepcompare"] = {}
+            for r in shard_result.reports:
+                if r.cost is None:
+                    continue
+                comparison = shardcheck.stepcompare(
+                    r.cost, records, floor_us=args.step_floor_us,
+                    slack=args.step_slack,
+                )
+                doc["shard"]["stepcompare"][r.key] = comparison
+                emit(
+                    f"stepcompare {r.key}: measured p50 "
+                    f"{comparison['measured_p50_us']}us vs floor "
+                    f"{comparison['predicted_floor_us']}us "
+                    f"(wire {comparison['predicted_wire_us']}us), "
+                    f"regression={comparison['regression']}"
+                )
+                failed |= comparison["regression"] is True
 
     if args.update_baseline:
         if not (run_lint or run_spmd or run_shard):

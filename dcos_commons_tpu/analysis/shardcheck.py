@@ -647,6 +647,87 @@ def _cost_model(
     }
 
 
+def stepcompare(
+    cost: Optional[Dict[str, Any]],
+    records: Sequence[Dict[str, Any]],
+    floor_us: float = 0.0,
+    slack: float = 0.25,
+    skip: int = 1,
+) -> Dict[str, Any]:
+    """Predicted-vs-measured step time: the ``shard.cost`` wire-time
+    model held against a worker's steplog JSONL records (ISSUE 7).
+
+    ``cost`` is a :func:`_cost_model` dict (or None when the mesh has
+    no collectives — a single chip); its wire floor is the CHEAPER of
+    the ring and all-gather spellings per step.  ``floor_us`` is the
+    caller's calibrated compute floor (the cost model speaks only for
+    the interconnect; bench_train_step calibrates compute by running
+    the bare device loop).  ``records`` are steplog dicts — ``wall_s``
+    is what each step actually took, ``blocked_s`` what the gang skew
+    cost on top.
+
+    The verdict: ``measured_over_floor_x`` is MEAN measured wall over
+    the combined floor, and ``regression`` trips when it exceeds
+    ``1 + slack`` — the perf gate "measured step time regressed >X%
+    against the cost-model floor".  The mean is the gate statistic
+    (not p50) because the window's billing conserves TOTAL wall —
+    each step is billed ready-to-ready time, so host-side stalls and
+    pipeline-fill land somewhere in the stream even when event
+    clustering skews individual records; p50/p95 are reported for
+    shape.  ``regression`` is None (ungated) when there is nothing to
+    gate against: no records, or a zero combined floor.
+
+    ``skip`` drops the first records in LOG ORDER (default 1): a cold
+    worker's step 0 bills the jit compile plus pipeline fill — one
+    multi-second record that would dominate the mean of a short log
+    and is not a property of the steady-state step.
+    """
+    from dcos_commons_tpu.metrics.registry import percentile
+
+    records = list(records)[max(0, int(skip)):]
+    walls = sorted(
+        float(r["wall_s"]) for r in records
+        if isinstance(r.get("wall_s"), (int, float))
+    )
+    blocked = sorted(
+        float(r["blocked_s"]) for r in records
+        if isinstance(r.get("blocked_s"), (int, float))
+    )
+    wire_us = 0.0
+    if cost and cost.get("per_step"):
+        wire_us = min(
+            float(cost.get("total_ring_us", 0.0)),
+            float(cost.get("total_allgather_us", 0.0)),
+        )
+    predicted_floor_us = wire_us + max(0.0, float(floor_us))
+    out: Dict[str, Any] = {
+        "steps": len(walls),
+        "predicted_wire_us": round(wire_us, 1),
+        "compute_floor_us": round(float(floor_us), 1),
+        "predicted_floor_us": round(predicted_floor_us, 1),
+        "slack": slack,
+        "measured_mean_us": None,
+        "measured_p50_us": None,
+        "measured_p95_us": None,
+        "blocked_p50_us": None,
+        "measured_over_floor_x": None,
+        "regression": None,
+    }
+    if not walls:
+        return out
+    mean_us = sum(walls) / len(walls) * 1e6
+    out["measured_mean_us"] = round(mean_us, 1)
+    out["measured_p50_us"] = round(percentile(walls, 50) * 1e6, 1)
+    out["measured_p95_us"] = round(percentile(walls, 95) * 1e6, 1)
+    if blocked:
+        out["blocked_p50_us"] = round(percentile(blocked, 50) * 1e6, 1)
+    if predicted_floor_us > 0:
+        ratio = mean_us / predicted_floor_us
+        out["measured_over_floor_x"] = round(ratio, 3)
+        out["regression"] = bool(ratio > 1.0 + slack)
+    return out
+
+
 def _yml_files(framework_dir: str) -> List[str]:
     return sorted(
         os.path.join(framework_dir, f)

@@ -569,6 +569,7 @@ def make_train_step(
     optimizer,
     mesh: Optional[Mesh] = None,
     donate: bool = True,
+    grad_accum: int = 1,
 ):
     """Build a jitted (params, opt_state, tokens, targets) ->
     (params, opt_state, loss) step.
@@ -576,12 +577,60 @@ def make_train_step(
     With a mesh, in/out shardings pin params to the rule layout and
     batch to (dp, fsdp) x sp; XLA inserts the dp/fsdp gradient
     reduce-scatters and tp activation collectives.
-    """
 
-    def step(params, opt_state, tokens, targets):
-        loss, grads = jax.value_and_grad(
+    ``grad_accum`` > 1 splits the batch into that many microbatches
+    and accumulates gradients over a ``lax.scan`` before the single
+    optimizer update.  Numerics: equal-size splits make the mean of
+    per-microbatch mean-losses (and gradients) EQUAL to the full-batch
+    mean up to float reassociation — accumulation runs in f32 so k
+    bf16 partial sums don't eat mantissa.  Perf: each microbatch's
+    dp/fsdp reduce-scatter contributions become scan-carried partial
+    sums, so XLA's latency-hiding scheduler can overlap microbatch
+    i's ICI/DCN traffic with microbatch i+1's compute instead of
+    serializing one giant gradient exchange behind the whole backward
+    (megatron/alpa overlap discipline); remat (``config.remat``)
+    composes per microbatch, shrinking live activations by the same
+    factor.
+    """
+    grad_accum = max(1, int(grad_accum))
+
+    def grads_of(params, tokens, targets):
+        return jax.value_and_grad(
             lambda p: loss_fn(config, p, tokens, targets)
         )(params)
+
+    def accumulate(params, tokens, targets):
+        micro = (
+            split_microbatches(tokens, grad_accum),
+            split_microbatches(targets, grad_accum),
+        )
+
+        def one_microbatch(carry, mb):
+            loss_sum, grad_sum = carry
+            loss, grads = grads_of(params, *mb)
+            grad_sum = jax.tree.map(
+                lambda acc, g: acc + g.astype(jnp.float32),
+                grad_sum, grads,
+            )
+            return (loss_sum + loss, grad_sum), None
+
+        zeros = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params
+        )
+        (loss_sum, grad_sum), _ = lax.scan(
+            one_microbatch, (jnp.zeros((), jnp.float32), zeros), micro
+        )
+        grads = jax.tree.map(
+            lambda p, g: (g / grad_accum).astype(p.dtype), params,
+            grad_sum,
+        )
+        return loss_sum / grad_accum, grads
+
+    def step(params, opt_state, tokens, targets):
+        if grad_accum == 1:
+            loss, grads = grads_of(params, tokens, targets)
+        else:
+            loss, grads = accumulate(params, tokens, targets)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = jax.tree.map(
             lambda p, u: (p + u.astype(p.dtype)), params, updates

@@ -25,6 +25,7 @@ from dcos_commons_tpu.parallel.mesh import (
     make_mesh,
     mesh_from_env,
 )
+from dcos_commons_tpu.parallel.overlap import enable_collective_overlap
 from dcos_commons_tpu.parallel.ring import ring_attention
 from dcos_commons_tpu.parallel.distributed import initialize_from_env
 
@@ -32,6 +33,7 @@ __all__ = [
     "MeshSpec",
     "collective_bandwidth",
     "derive",
+    "enable_collective_overlap",
     "initialize_from_env",
     "make_mesh",
     "mesh_from_env",

@@ -20,7 +20,7 @@ from __future__ import annotations
 import json
 import os
 import time
-from typing import List, Optional
+from typing import Any, Callable, List, Optional, Tuple
 
 STEPLOG_NAME = "steplog.jsonl"
 
@@ -58,6 +58,99 @@ class StepLog:
             except OSError:
                 self.errors += 1
             self._fh = None
+
+
+def _default_ready(result: Any) -> Any:
+    """Block until a dispatched jax result is materialized.  Imported
+    lazily: the scheduler-side steplog readers must not pull jax in."""
+    import jax
+
+    return jax.block_until_ready(result)
+
+
+class InflightWindow:
+    """Bounded async-dispatch window with per-step wall accounting.
+
+    Under async dispatch the host runs ahead of the devices: step N's
+    jit call returns in microseconds and the host only blocks when the
+    window is full — on step N−k's result, not step N's.  Naive timing
+    then records dispatch time as ``wall_s`` and the NEXT step's
+    barrier probe absorbs this step's compute and reports it as gang
+    skew (the trap PR 5 already hit once, solved then by blocking
+    every step — which is exactly the serialization this window
+    removes).  The books stay straight by billing each step the wall
+    time between ITS result becoming ready and the previous step's:
+    in a saturated pipeline that is precisely the device time the step
+    added to the run, and during pipeline fill the first step absorbs
+    the fill cost it incurred.  ``blocked_s`` stays whatever the
+    caller measured BEFORE dispatching the step (the barrier probe
+    meets the gang at dispatch order, so its wait is still the skew
+    the slow host imposed at that point).
+
+    ``window=0`` degenerates to the synchronous loop: every ``push``
+    drains immediately and ``wall_s`` spans dispatch start to ready —
+    byte-identical accounting to the pre-overlap worker.
+    """
+
+    def __init__(
+        self,
+        steplog: StepLog,
+        window: int = 2,
+        ready_fn: Callable[[Any], Any] = _default_ready,
+    ):
+        self.steplog = steplog
+        self.window = max(0, int(window))
+        self._ready = ready_fn
+        self._pending: List[Tuple[int, Any, float, float, dict]] = []
+        self._last_ready: Optional[float] = None
+        self.drained = 0
+
+    def push(
+        self, step: int, result: Any, dispatched_t: float,
+        blocked_s: float = 0.0, **fields,
+    ) -> List[Tuple[int, Any]]:
+        """Admit a dispatched step; drains (blocks on) the oldest
+        steps beyond the window.  ``dispatched_t`` is when the step
+        STARTED on the host (before its data fetch + dispatch), so the
+        degenerate window=0 spelling times what the old synchronous
+        loop timed.  Returns the [(step, ready result)] drained now.
+        """
+        self._pending.append(
+            (int(step), result, float(dispatched_t), float(blocked_s),
+             fields)
+        )
+        out: List[Tuple[int, Any]] = []
+        while len(self._pending) > self.window:
+            out.append(self._drain_one())
+        return out
+
+    def drain(self) -> List[Tuple[int, Any]]:
+        """Drain every in-flight step (end of loop, or a fence before
+        an action that must see the loop quiesced)."""
+        out = []
+        while self._pending:
+            out.append(self._drain_one())
+        return out
+
+    def _drain_one(self) -> Tuple[int, Any]:
+        step, result, t0, blocked_s, fields = self._pending.pop(0)
+        self._ready(result)
+        t_ready = time.time()
+        # bill THIS step the wall clock since the previous step's
+        # result was ready (or since its own dispatch, whichever is
+        # later — an idle gap between steps is nobody's device time)
+        since = t0 if self._last_ready is None else max(
+            self._last_ready, t0
+        )
+        self._last_ready = t_ready
+        self.steplog.record(
+            step,
+            wall_s=round(t_ready - since, 6),
+            blocked_s=round(blocked_s, 6),
+            **fields,
+        )
+        self.drained += 1
+        return step, result
 
 
 def read_steplog(path: str) -> List[dict]:

@@ -2,7 +2,13 @@
 
 from dcos_commons_tpu.utils.data import synthetic_tokens, synthetic_mnist
 from dcos_commons_tpu.utils.tree import param_count, param_bytes
-from dcos_commons_tpu.utils.checkpoint import save_checkpoint, restore_checkpoint
+from dcos_commons_tpu.utils.checkpoint import (
+    AsyncCheckpointer,
+    StaleWriterError,
+    claim_incarnation,
+    restore_checkpoint,
+    save_checkpoint,
+)
 from dcos_commons_tpu.utils.compile_cache import enable_compilation_cache
 from dcos_commons_tpu.utils.microbatch import (
     MicroBatcher,
@@ -12,8 +18,11 @@ from dcos_commons_tpu.utils.microbatch import (
 )
 
 __all__ = [
+    "AsyncCheckpointer",
     "MicroBatcher",
+    "StaleWriterError",
     "WorkItem",
+    "claim_incarnation",
     "enable_compilation_cache",
     "pack_mixed_rows",
     "unpack_results",

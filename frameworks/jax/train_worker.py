@@ -16,7 +16,14 @@ sys.path.insert(0, os.environ.get("REPO_ROOT", "/root/repo"))
 
 def main() -> int:
     from dcos_commons_tpu.parallel.distributed import initialize_from_env
+    from dcos_commons_tpu.parallel.overlap import enable_collective_overlap
 
+    # XLA's latency-hiding scheduler flags must land in XLA_FLAGS
+    # before the first jax backend init: without them several libtpu
+    # builds serialize the grad reduce-scatters the microbatched step
+    # was restructured to overlap (TPU-only; TRAIN_XLA_OVERLAP=0
+    # opts out)
+    enable_collective_overlap()
     contract = initialize_from_env()
 
     import jax
@@ -32,8 +39,10 @@ def main() -> int:
         make_train_step,
     )
     from dcos_commons_tpu.parallel.mesh import mesh_from_env
-    from dcos_commons_tpu.trace.steplog import StepLog
+    from dcos_commons_tpu.trace.steplog import InflightWindow, StepLog
     from dcos_commons_tpu.utils import (
+        AsyncCheckpointer,
+        claim_incarnation,
         enable_compilation_cache,
         restore_checkpoint,
         save_checkpoint,
@@ -89,8 +98,39 @@ def main() -> int:
                     "sandboxes or restore a shared CHECKPOINT_DIR"
                 )
             start = int(starts[0])
-        step_fn = make_train_step(config, optimizer, mesh=mesh, donate=False)
+        # the step-time fast path (ISSUE 7): donated buffers (the
+        # params/opt-state update happens in place instead of paying a
+        # full HBM copy per step), optional microbatched gradient
+        # accumulation (per-microbatch collectives overlap the next
+        # microbatch's compute), and a bounded async-dispatch window
+        # below.  Each has an env opt-out because a debugging session
+        # wants the boring synchronous loop back.
+        donate = os.environ.get("TRAIN_DONATE", "1") not in ("0", "false")
+        grad_accum = max(1, int(os.environ.get("TRAIN_GRAD_ACCUM", "1")))
+        # in-flight window: dispatch step N, block on step N-k's loss.
+        # 0 = synchronous (block every step, the pre-overlap loop)
+        inflight = max(0, int(os.environ.get("TRAIN_INFLIGHT_STEPS", "2")))
+        step_fn = make_train_step(
+            config, optimizer, mesh=mesh, donate=donate,
+            grad_accum=grad_accum,
+        )
         batch = max(2, 2 * mesh.devices.size)
+        # microbatches must split evenly AND each batch must still
+        # shard over the mesh's data axes (in_shardings pins tokens to
+        # batch_spec): round up to a multiple of lcm(grad_accum,
+        # batch-axis product) — padding to grad_accum alone could
+        # break dp/fsdp divisibility and kill the first dispatch
+        # (review r7)
+        import math
+
+        from dcos_commons_tpu.parallel.mesh import BATCH_AXES
+
+        batch_shard = 1
+        for axis in BATCH_AXES:
+            batch_shard *= mesh.shape.get(axis, 1)
+        multiple = math.lcm(grad_accum, batch_shard)
+        if batch % multiple:
+            batch += multiple - batch % multiple
         data_dir = os.environ.get("DATA_DIR", "")
         batches = None
         if data_dir:
@@ -131,6 +171,52 @@ def main() -> int:
         gang = contract["worker_count"] > 1
         if gang and probe_gang:
             from jax.experimental import multihost_utils
+        # non-blocking checkpointing: save() costs the loop one async
+        # device-side copy; the gather + npz write + fenced prune run
+        # on a background thread.  The writer incarnation (claimed by
+        # process 0 only — it is the only writer) fences a zombie
+        # trainer out of a relaunched gang's CHECKPOINT_DIR.
+        keep = int(os.environ.get("CHECKPOINT_KEEP", "3"))
+        async_ckpt = os.environ.get("TRAIN_ASYNC_CKPT", "1") not in (
+            "0", "false"
+        )
+        if gang:
+            # process 0 claims (single writer) and BROADCASTS the
+            # token so the whole gang agrees on the incarnation —
+            # spmdcheck: every host must issue the same collective
+            # sequence, so the claim result is made gang-uniform
+            # before anything downstream can branch on it
+            from jax.experimental import multihost_utils
+
+            local = (
+                claim_incarnation(ckpt_dir)
+                if jax.process_index() == 0 else 0
+            )
+            incarnation = int(multihost_utils.broadcast_one_to_all(
+                jnp.int32(local)
+            ))
+        else:
+            incarnation = claim_incarnation(ckpt_dir)
+        checkpointer = (
+            AsyncCheckpointer(ckpt_dir, keep=keep, incarnation=incarnation)
+            if async_ckpt else None
+        )
+        # the bounded in-flight window bills wall_s/blocked_s to the
+        # step that incurred them even though the host runs k steps
+        # ahead of the devices (trace/steplog.py InflightWindow)
+        window = InflightWindow(steplog, inflight)
+
+        def note_drained(drained):
+            for s, ready_loss in drained:
+                if s % 20 == 0 or s == steps - 1:
+                    # the loss is already on host: float() here cannot
+                    # stall the pipeline the way printing the
+                    # just-dispatched step's loss would
+                    print(
+                        f"step {s} loss={float(ready_loss):.4f}",
+                        flush=True,
+                    )
+
         t0 = time.time()
         for i in range(start, steps):
             step_t0 = time.time()
@@ -138,33 +224,42 @@ def main() -> int:
             if gang and probe_gang:
                 # pre-allreduce barrier probe: meet the gang before
                 # this step's first collective; time spent here is
-                # time BLOCKED on slower hosts, not compute
+                # time BLOCKED on slower hosts, not compute.  Under
+                # overlap the probe still runs at DISPATCH order, so
+                # its wait is the skew the slow host imposed at this
+                # step's admission, billed to this step.
                 b0 = time.time()
                 multihost_utils.sync_global_devices(f"steplog-{i}")
                 blocked_s = time.time() - b0
             if batches is not None:
                 tokens, targets = next(batches)
             params, opt_state, loss = step_fn(params, opt_state, tokens, targets)
-            # drain the step before stamping: jit dispatch returns
-            # immediately, so an unsynced wall_s would be dispatch
-            # time, and the NEXT step's barrier probe would absorb
-            # this step's compute and report it as gang skew
-            jax.block_until_ready(loss)
-            steplog.record(
-                i,
-                wall_s=round(time.time() - step_t0, 6),
-                tokens=tokens.shape[0] * tokens.shape[1],
-                blocked_s=round(blocked_s, 6),
-                worker=contract["worker_id"],
-            )
             if i % 20 == 0 or i == steps - 1:
-                print(f"step {i} loss={float(loss):.4f}", flush=True)
-                save_checkpoint(
-                    ckpt_dir, i + 1,
-                    {"params": params, "opt_state": opt_state},
-                    # bound the directory: a long run would otherwise
-                    # grow it by ~3 bytes/param per save forever
-                    keep=int(os.environ.get("CHECKPOINT_KEEP", "3")),
+                state = {"params": params, "opt_state": opt_state}
+                if checkpointer is not None:
+                    # snapshot NOW: the async device copy is enqueued
+                    # before the next dispatch donates these buffers
+                    checkpointer.save(i + 1, state)
+                else:
+                    save_checkpoint(
+                        ckpt_dir, i + 1, state, keep=keep,
+                        incarnation=incarnation,
+                    )
+            # push the dispatched step into the window; it blocks on
+            # step i-k's loss (not step i's) and stamps the steplog
+            # with the wall/blocked time each DRAINED step incurred
+            note_drained(window.push(
+                i, loss, step_t0, blocked_s=blocked_s,
+                tokens=tokens.shape[0] * tokens.shape[1],
+                worker=contract["worker_id"],
+            ))
+        note_drained(window.drain())
+        if checkpointer is not None:
+            ckpt_errors = checkpointer.close()
+            if ckpt_errors:
+                print(
+                    f"checkpoint writer errors: {ckpt_errors[:3]}",
+                    file=sys.stderr, flush=True,
                 )
         steplog.close()
         if batches is not None:

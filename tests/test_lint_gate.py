@@ -1718,3 +1718,107 @@ def test_shard_malformed_env_is_a_finding_not_a_crash(tmp_path):
     found = [f for f in result.findings if f.rule == "shard-mesh"]
     assert found and "not-a-number" in found[0].message
     assert found[0].line > 1
+
+
+# -- stepcompare: predicted-vs-measured step time (ISSUE 7) -----------
+
+
+def test_stepcompare_gates_on_mean_vs_floor():
+    """The gate statistic is the MEAN wall (total-conserving under the
+    in-flight window's ready-to-ready billing); regression trips past
+    floor * (1 + slack)."""
+    records = [{"wall_s": 0.010, "blocked_s": 0.001}] * 20
+    out = shardcheck.stepcompare(
+        None, records, floor_us=9000.0, slack=0.25
+    )
+    assert out["steps"] == 19  # default skip=1 drops the compile step
+    assert abs(out["measured_mean_us"] - 10000.0) < 1.0
+    assert out["predicted_floor_us"] == 9000.0
+    assert out["measured_p95_us"] == out["measured_p50_us"]
+    assert out["blocked_p50_us"] is not None
+    assert out["regression"] is False  # 1.11x < 1.25
+    out = shardcheck.stepcompare(
+        None, records, floor_us=7000.0, slack=0.25
+    )
+    assert out["regression"] is True  # 1.43x > 1.25
+
+
+def test_stepcompare_wire_model_and_malformed_records():
+    """The wire floor is the CHEAPER collective spelling; records a
+    killed worker truncated (non-numeric/missing wall_s) are skipped,
+    not crashed on."""
+    cost = {
+        "per_step": [{"axis": "dp"}],
+        "total_ring_us": 500.0,
+        "total_allgather_us": 800.0,
+    }
+    records = [{"wall_s": 0.0005}]
+    out = shardcheck.stepcompare(cost, records, slack=0.25, skip=0)
+    assert out["predicted_wire_us"] == 500.0
+    assert out["regression"] is False
+    out = shardcheck.stepcompare(
+        cost, records + [{"wall_s": "garbage"}, {}, {"step": 3}],
+        skip=0,
+    )
+    assert out["steps"] == 1
+
+
+def test_stepcompare_skips_the_compile_record():
+    """A cold worker's step 0 bills the jit compile — multi-second on
+    one record.  The default skip keeps it out of the gate; skip=0
+    shows what it would have done to the mean."""
+    records = [{"wall_s": 6.0}] + [{"wall_s": 0.010}] * 9
+    out = shardcheck.stepcompare(
+        None, records, floor_us=10000.0, slack=0.5
+    )
+    assert out["steps"] == 9
+    assert out["regression"] is False
+    out = shardcheck.stepcompare(
+        None, records, floor_us=10000.0, slack=0.5, skip=0
+    )
+    assert out["regression"] is True
+
+
+def test_stepcompare_ungated_without_records_or_floor():
+    """No records, or nothing to gate against -> regression None
+    (never a false trip on a single chip with no calibration)."""
+    out = shardcheck.stepcompare(None, [], floor_us=100.0)
+    assert out["regression"] is None
+    assert out["measured_mean_us"] is None
+    out = shardcheck.stepcompare(None, [{"wall_s": 0.001}], skip=0)
+    assert out["regression"] is None
+    assert out["measured_mean_us"] is not None
+
+
+def test_stepcompare_cli_steplog(tmp_path, capsys):
+    """--steplog attaches a predicted-vs-measured comparison for every
+    train workload to the shard JSON; a regression past --step-slack
+    flips the exit code (the operator asked for the gate)."""
+    steplog = tmp_path / "steplog.jsonl"
+    steplog.write_text("\n".join(
+        json.dumps({"step": i, "wall_s": 0.02, "blocked_s": 0.0})
+        for i in range(8)
+    ))
+    rc = analysis_main([
+        "shard", "--root", REPO, "--json",
+        "--steplog", str(steplog), "--step-floor-us", "19000",
+    ])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    comparisons = doc["shard"]["stepcompare"]
+    assert comparisons, "no train workload produced a cost model"
+    for comparison in comparisons.values():
+        assert abs(comparison["measured_mean_us"] - 20000.0) < 1.0
+        assert comparison["regression"] is False
+    # a tight floor makes the same steplog a regression
+    rc = analysis_main([
+        "shard", "--root", REPO, "--json",
+        "--steplog", str(steplog), "--step-floor-us", "1000",
+        "--step-slack", "0.25",
+    ])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1 and doc["exit_code"] == 1
+    assert any(
+        c["regression"] is True
+        for c in doc["shard"]["stepcompare"].values()
+    )
