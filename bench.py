@@ -561,6 +561,150 @@ def bench_trace_overhead() -> dict:
     }
 
 
+def bench_failover() -> dict:
+    """HA failover latency (ISSUE 8): a 64-host/32-pod deploy is
+    driven halfway by leader scheduler A, which is then hard-killed
+    (renewals simply stop — the SIGKILL analogue).  A hot standby
+    candidates for the lease; the measured numbers are the phases an
+    operator actually waits through:
+
+      failover_lease_wait_s   kill -> standby holds the lease (bounded
+                              by TTL + one candidate poll)
+      failover_rebuild_s      lease -> scheduler rebuilt over the
+                              shared store (config/plan/ledger load)
+      failover_first_cycle_s  rebuild -> first working cycle DONE
+                              (includes the rehydrate.replay pass)
+      failover_total_s        kill -> first new working cycle
+      failover_resume_s       kill -> the interrupted deploy COMPLETE
+
+    The takeover must adopt every in-flight launch (no re-issue storm:
+    failover_reissued == 0 here — A died between cycles, not inside
+    one) and finish the rollout without restarting completed pods."""
+    from dcos_commons_tpu.common import TaskState, TaskStatus
+    from dcos_commons_tpu.ha.election import LeaderLease
+    from dcos_commons_tpu.offer.inventory import (
+        SliceInventory,
+        make_test_fleet,
+    )
+    from dcos_commons_tpu.scheduler import SchedulerBuilder, SchedulerConfig
+    from dcos_commons_tpu.specification import from_yaml
+    from dcos_commons_tpu.storage import MemPersister
+    from dcos_commons_tpu.testing import FakeAgent
+
+    n_pods, ttl_s = 32, 0.6
+    hosts = []
+    for s in range(4):  # 64 TPU hosts
+        hosts.extend(make_test_fleet(
+            slice_id=f"pod-{s}", host_grid=(4, 4), chip_block=(2, 2),
+            cpus=32.0, memory_mb=131072,
+        ))
+    yaml_text = (
+        "name: failover\n"
+        "pods:\n"
+        "  app:\n"
+        f"    count: {n_pods}\n"
+        "    placement: 'max-per-host:1'\n"
+        "    tasks:\n"
+        "      server:\n"
+        "        goal: RUNNING\n"
+        "        cmd: sleep 1000\n"
+        "        cpus: 2\n"
+        "        memory: 1024\n"
+        "plans:\n"
+        "  deploy:\n"
+        "    strategy: serial\n"
+        "    phases:\n"
+        "      app:\n"
+        "        strategy: serial\n"
+        "        pod: app\n"
+    )
+    persister = MemPersister()
+    agent = FakeAgent()
+    acked = set()
+
+    def build(lease):
+        builder = SchedulerBuilder(
+            from_yaml(yaml_text),
+            SchedulerConfig(backoff_enabled=False, revive_capacity=10**9),
+            persister,
+        )
+        builder.set_inventory(SliceInventory(hosts))
+        builder.set_agent(agent)
+        builder.set_leader_lease(lease)
+        return builder.build()
+
+    def ack():
+        for info in list(agent.launched):
+            if info.task_id not in acked:
+                acked.add(info.task_id)
+                agent.send(TaskStatus(
+                    task_id=info.task_id, state=TaskState.RUNNING,
+                    ready=True, agent_id=info.agent_id,
+                ))
+
+    lease_a = LeaderLease(persister, "failover", "sched-a", ttl_s=ttl_s)
+    assert lease_a.try_acquire()
+    sched_a = build(lease_a)
+    deadline = time.monotonic() + 60.0
+    while time.monotonic() < deadline:
+        sched_a.run_cycle()
+        ack()
+        lease_a.renew()
+        if len(agent.launched) >= n_pods // 2:
+            break
+    launched_at_kill = len(agent.launched)
+    running_ids = {
+        info.name: info.task_id
+        for info in sched_a.state_store.fetch_tasks()
+    }
+
+    t_kill = time.monotonic()  # A is gone: no more cycles, no renewals
+    lease_b = LeaderLease(persister, "failover", "sched-b", ttl_s=ttl_s)
+    while not lease_b.try_acquire():
+        time.sleep(ttl_s / 3.0)  # the candidate poll cadence
+    t_lease = time.monotonic()
+    sched_b = build(lease_b)
+    t_built = time.monotonic()
+    sched_b.run_cycle()  # rehydrate.replay + first working cycle
+    t_first_cycle = time.monotonic()
+    completed = False
+    deadline = time.monotonic() + 120.0
+    while time.monotonic() < deadline:
+        ack()
+        sched_b.run_cycle()
+        lease_b.renew()
+        if sched_b.deploy_manager.get_plan().is_complete:
+            completed = True
+            break
+    rehydration = sched_b.last_rehydration or {}
+    # the takeover adopted the running fleet instead of relaunching it
+    survivors = {
+        info.name: info.task_id
+        for info in sched_b.state_store.fetch_tasks()
+        if info.name in running_ids
+    }
+    adoption_clean = all(
+        survivors.get(name) == task_id
+        for name, task_id in running_ids.items()
+    )
+    return {
+        "failover_hosts": len(hosts),
+        "failover_pods": n_pods,
+        "failover_lease_ttl_s": ttl_s,
+        "failover_launched_at_kill": launched_at_kill,
+        "failover_lease_wait_s": round(t_lease - t_kill, 3),
+        "failover_rebuild_s": round(t_built - t_lease, 3),
+        "failover_first_cycle_s": round(t_first_cycle - t_built, 3),
+        "failover_total_s": round(t_first_cycle - t_kill, 3),
+        "failover_resume_s": round(time.monotonic() - t_kill, 3),
+        "failover_completed": completed,
+        "failover_epoch": lease_b.epoch,
+        "failover_adopted": rehydration.get("adopted", 0),
+        "failover_reissued": rehydration.get("reissued", 0),
+        "failover_adoption_clean": adoption_clean,
+    }
+
+
 def bench_continuous_serve() -> dict:
     """Continuous batching vs dispatch-per-group serving (ISSUE 6),
     CPU-runnable: the SAME open-loop load — staggered arrivals, mixed
@@ -1978,6 +2122,13 @@ def main() -> None:
     except Exception as e:
         extras["trace_overhead_error"] = repr(e)[:200]
     _mark("trace_overhead")
+    # HA failover latency (ISSUE 8): standby takeover during a 64-host
+    # deploy — lease wait / rebuild / first-working-cycle breakdown
+    try:
+        extras.update(bench_failover())
+    except Exception as e:
+        extras["failover_error"] = repr(e)[:200]
+    _mark("failover")
     # CPU-runnable serving data-plane trend (ISSUE 6): subprocess so
     # the forced-cpu jax init cannot leak into the chip sections
     try:

@@ -17,6 +17,7 @@ Protocol (JSON over HTTP, scheduler -> agent):
     POST /v1/agent/kill    {task_id, grace_period_s}
     GET  /v1/agent/tasks   {task_ids: [...]}
     POST /v1/agent/drain   -> {statuses: [...]}   (drains pending updates)
+    POST /v1/agent/reconcile  (re-arm current task states for re-delivery)
     GET  /v1/agent/sandbox?task=<name>&file=<rel> -> file text (debugging)
 
 Statuses are *pulled* by the scheduler (drain), matching the poll-based
@@ -159,6 +160,13 @@ class AgentDaemon:
                             s.to_dict() for s in daemon._executor.poll()
                         ]
                         self._reply(200, {"statuses": statuses})
+                    elif parsed.path == "/v1/agent/reconcile":
+                        # explicit reconciliation: a failed-over
+                        # scheduler asks for CURRENT task states —
+                        # transitions a dead predecessor drained are
+                        # re-armed for the next drain
+                        daemon._executor.reconcile()
+                        self._reply(200, {"message": "reconcile armed"})
                     else:
                         self._reply(404, {"message": f"no route {parsed.path}"})
                 except Exception as e:

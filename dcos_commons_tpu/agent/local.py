@@ -825,6 +825,22 @@ class LocalProcessAgent:
         with self._lock:
             return set(self._tasks)
 
+    def reconcile(self) -> None:
+        """Explicit reconciliation (reference: ExplicitReconciler —
+        the master re-sends CURRENT task states on request).  Status
+        transitions here are edge-triggered: once poll() hands a
+        RUNNING out, it is never re-reported — so a scheduler that
+        died between draining poll() and acting on the batch would
+        strand its successor with store-STAGING tasks whose RUNNING
+        can never arrive (found by the chaos harness's
+        mid-status-fan-in/mid-plan-transition kills).  A restarted
+        scheduler calls this to re-arm the current state of every
+        live task for the next poll; terminal fates already
+        re-deliver via the durable task records."""
+        with self._lock:
+            for running in self._tasks.values():
+                running.running_reported = False
+
     def poll(self) -> List[TaskStatus]:
         with self._lock:
             out = list(self._pending)
@@ -896,7 +912,11 @@ class LocalProcessAgent:
                     task_id=task_id,
                     state=TaskState.RUNNING,
                     agent_id=info.agent_id,
-                    ready=running.readiness is None,
+                    # a reconcile()-triggered re-report must carry the
+                    # readiness the task already earned, or the step
+                    # waits forever for a check that won't re-run
+                    ready=running.readiness is None or
+                    running.ready_reported,
                 )
             )
         # readiness: run the check at its declared interval until it

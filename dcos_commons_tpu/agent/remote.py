@@ -106,6 +106,9 @@ class RemoteAgentClient:
     def tasks(self) -> Set[str]:
         return set(self._request("GET", "/v1/agent/tasks")["task_ids"])
 
+    def reconcile(self) -> None:
+        self._request("POST", "/v1/agent/reconcile")
+
     def drain(self) -> List[TaskStatus]:
         raw = self._request("POST", "/v1/agent/drain")
         return [TaskStatus.from_dict(s) for s in raw["statuses"]]
@@ -289,6 +292,19 @@ class RemoteFleet(Agent):
                     self._owners.setdefault(task_id, host_id)
             out |= result
         return out
+
+    def reconcile(self) -> None:
+        """Explicit reconciliation across the fleet (the Reconciler's
+        startup hook): every reachable daemon re-arms its tasks'
+        CURRENT states for the next drain, so statuses a dead
+        scheduler drained but never acted on are re-delivered to its
+        successor.  Best-effort per host — an unreachable daemon's
+        tasks are handled by poll()'s down-host LOST synthesis."""
+        for host_id, result in self._fan_out(
+            lambda _h, c: c.reconcile()
+        ):
+            if isinstance(result, Exception):
+                LOG.info("reconcile skipped on %s: %s", host_id, result)
 
     def poll(self) -> List[TaskStatus]:
         out: List[TaskStatus] = []

@@ -614,6 +614,79 @@ class SpanLeakRule(Rule):
         return out
 
 
+class LeaseGatedMutationRule(Rule):
+    """HA invariant (dcos_commons_tpu/ha/): in scheduler-path modules,
+    every persisted mutation must flow through a store class
+    (StateStore/ConfigStore/ReservationLedger/OptionsStore/...) —
+    store objects are constructed over the wired persister, which in
+    HA mode is the lease-fenced writer, so a raw
+    ``persister.set/apply/recursive_delete`` in scheduler logic is a
+    write that could bypass the failover fence (and definitely
+    bypasses the one place the layering is auditable).  Scope: the
+    scheduler-path packages below; store/fence modules themselves
+    (state/, storage/, multi/store.py, ha/election.py) and testing/
+    are exempt.  A deliberate raw write carries an explaining
+    ``# sdklint: disable``."""
+
+    id = "lease-gated-mutation"
+    description = "raw persister mutation in a scheduler path (bypasses the lease-fenced store layer)"
+
+    _MUTATIONS = {"set", "apply", "recursive_delete", "clear_all_data"}
+    _SCOPED = (
+        "dcos_commons_tpu/scheduler/",
+        "dcos_commons_tpu/runtime/",
+        "dcos_commons_tpu/recovery/",
+        "dcos_commons_tpu/plan/",
+        "dcos_commons_tpu/http/",
+        "dcos_commons_tpu/multi/",
+        "dcos_commons_tpu/decommission/",
+        "dcos_commons_tpu/uninstall/",
+        "dcos_commons_tpu/ha/",
+    )
+    _EXEMPT = (
+        # store classes: the layer raw mutations BELONG in
+        "dcos_commons_tpu/multi/store.py",
+        # the fence itself: lease-record writes run below the fence
+        "dcos_commons_tpu/ha/election.py",
+    )
+
+    def applies_to(self, ctx: LintContext) -> bool:
+        return (
+            ctx.tree is not None
+            and any(ctx.rel.startswith(p) for p in self._SCOPED)
+            and ctx.rel not in self._EXEMPT
+        )
+
+    @staticmethod
+    def _receiver_name(node: ast.AST):
+        if isinstance(node, ast.Name):
+            return node.id
+        if isinstance(node, ast.Attribute):
+            return node.attr
+        return None
+
+    def check(self, ctx: LintContext) -> List[Finding]:
+        out = []
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in self._MUTATIONS):
+                continue
+            name = self._receiver_name(node.func.value)
+            if name is None:
+                continue
+            lowered = name.lower()
+            if "persister" not in lowered and "backend" not in lowered:
+                continue
+            out.append(ctx.finding(
+                node, self.id,
+                f"raw {name}.{node.func.attr}(...) in a scheduler "
+                "path: route the mutation through a store class so it "
+                "flows through the (lease-fenced) wired persister",
+            ))
+        return out
+
+
 def all_rules() -> List[Rule]:
     return [
         NoBlockingSleepRule(),
@@ -623,6 +696,7 @@ def all_rules() -> List[Rule]:
         SwallowedExceptionRule(),
         TracerUnsafeCastRule(),
         SpanLeakRule(),
+        LeaseGatedMutationRule(),
     ]
 
 

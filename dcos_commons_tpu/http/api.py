@@ -555,6 +555,22 @@ class SchedulerApi:
                                     "(expected 'chrome' or 'text')"}
         return 200, to_text(tracer, service=service, steplogs=steplogs)
 
+    def debug_ha(self) -> Response:
+        """HA control-plane state: leader identity + lease expiry (the
+        record in the replicated tree), this scheduler's lease epoch
+        and failover count, fenced-write rejections, per-standby
+        replication watermarks, and the last re-hydration report.
+        The failover runbook (docs/operations-guide.md) reads this
+        before and after a manual promotion."""
+        ha = getattr(self._scheduler, "ha_state", None)
+        if ha is None:
+            body: Dict[str, Any] = {"enabled": False}
+            report = getattr(self._scheduler, "last_rehydration", None)
+            if report is not None:
+                body["last_rehydration"] = report
+            return 200, body
+        return 200, ha.describe(refresh=True)
+
     def debug_serving(self) -> Response:
         """Per-pod serving load: each serve worker mirrors its engine
         gauges (queue depth, active slots, KV occupancy, tokens/s,

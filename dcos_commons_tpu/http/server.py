@@ -108,6 +108,10 @@ def build_routes(api: SchedulerApi) -> List[Route]:
         # traceview: text timeline, or ?fmt=chrome for Perfetto
         r("GET", r"/v1/debug/trace",
           lambda m, q: api.debug_trace(_one(q, "fmt"))),
+        # HA: leader lease, fencing epoch, standby watermarks, the
+        # last re-hydration report (the failover runbook's dashboard)
+        r("GET", r"/v1/debug/ha",
+          lambda m, q: api.debug_ha()),
         # serving load: per-pod slot-engine gauges (queue depth,
         # active slots, KV occupancy, tokens/s) merged from sandboxes
         r("GET", r"/v1/debug/serving",

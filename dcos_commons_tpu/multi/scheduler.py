@@ -122,7 +122,13 @@ class MultiServiceScheduler:
         scheduler_config: Optional[SchedulerConfig] = None,
         discipline=None,
         builder_hook: Optional[Callable[[SchedulerBuilder], None]] = None,
+        ha_state=None,
     ):
+        # HA (dcos_commons_tpu/ha/): one election per PROCESS — the
+        # shared (already lease-fenced) persister carries the fence;
+        # the HAState handle is propagated onto every service scheduler
+        # so each serves GET /v1/debug/ha
+        self.ha_state = ha_state
         self.persister = persister
         self.inventory = inventory
         self.agent = agent
@@ -434,6 +440,10 @@ class MultiServiceScheduler:
         # orphan sweeps would kill siblings' tasks, so the multi loop
         # runs ONE merged sweep instead (_kill_merged_orphans)
         scheduler.kill_orphaned_tasks = False
+        if self.ha_state is not None:
+            # one process-wide election; every service serves it at
+            # its own /v1/debug/ha and exports the ha.* gauges
+            self.ha_state.attach(scheduler)
         return scheduler
 
     def _make_uninstaller(self, spec: ServiceSpec) -> UninstallScheduler:

@@ -32,6 +32,14 @@ class Reconciler:
 
     def reconcile(self) -> List[TaskStatus]:
         """Returns synthesized LOST statuses for vanished tasks."""
+        # explicit reconciliation: agents that report transitions
+        # edge-triggered (LocalProcessAgent) re-arm the CURRENT state
+        # of live tasks for the next poll — without this, statuses a
+        # dead predecessor drained but never acted on are lost, and an
+        # adopted task can sit at store-STAGING forever
+        request = getattr(self._agent, "reconcile", None)
+        if callable(request):
+            request()
         active = self._agent.active_task_ids()
         synthesized: List[TaskStatus] = []
         for name, status in self._state_store.fetch_statuses().items():

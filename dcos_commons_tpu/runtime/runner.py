@@ -76,16 +76,37 @@ class InstanceLock:
 def make_instance_lock(config: SchedulerConfig, name: str):
     """One active scheduler per service: a TTL lease on the state
     server when remote state is configured (failover-capable), else a
-    per-host file lock (reference: CuratorLocker vs local mutex)."""
+    per-host file lock (reference: CuratorLocker vs local mutex).
+
+    With ``SDK_HA`` set (``config.ha_enabled``) the lease upgrades to
+    a LEADER ELECTION: ``acquire`` candidates — blocking until the
+    current leader's lease expires — instead of exiting, and the
+    lease's fencing epoch is wired through the builder so a deposed
+    leader's store writes are rejected (dcos_commons_tpu/ha/)."""
     if config.state_url:
         import socket as _socket
 
+        owner = f"{_socket.gethostname()}-{os.getpid()}"
+        if config.ha_enabled:
+            from dcos_commons_tpu.ha.election import LeaderLock
+            from dcos_commons_tpu.storage.remote import RemotePersister
+
+            return LeaderLock(
+                RemotePersister(
+                    config.state_url,
+                    auth_token=config.auth_token,
+                    ca_file=config.tls_ca_file,
+                ),
+                name=name,
+                owner=owner,
+                ttl_s=config.state_lease_ttl_s,
+            )
         from dcos_commons_tpu.storage.remote import RemoteLocker
 
         return RemoteLocker(
             config.state_url,
             name=name,
-            owner=f"{_socket.gethostname()}-{os.getpid()}",
+            owner=owner,
             ttl_s=config.state_lease_ttl_s,
             auth_token=config.auth_token,
             ca_file=config.tls_ca_file,
@@ -148,7 +169,9 @@ def load_topology(path: str) -> Tuple[List[TpuHost], Dict[str, str]]:
     return hosts, urls
 
 
-OPTIONS_NODE = "service_options"
+from dcos_commons_tpu.state.config_store import OptionsStore
+
+OPTIONS_NODE = OptionsStore.NODE
 
 
 class FrameworkRunner:
@@ -259,20 +282,18 @@ class FrameworkRunner:
 
             self._agent = LocalProcessAgent(self.config.sandbox_root)
         self._persister = make_persister(self.config)
+        lease = getattr(self._lock, "lease", None)
+        if lease is not None:
+            from dcos_commons_tpu.ha.election import FencedPersister
+
+            # the runner's own writes (options update/rollback) must
+            # be lease-fenced too, not just the builder-wired stores —
+            # a deposed leader's in-flight update would otherwise
+            # clobber its successor's options
+            self._persister = FencedPersister(self._persister, lease)
 
     def _stored_options(self) -> Dict[str, str]:
-        import json
-
-        raw = self._persister.get_or_none(OPTIONS_NODE)
-        if not raw:
-            return {}
-        try:
-            data = json.loads(raw.decode("utf-8"))
-        except (ValueError, UnicodeDecodeError):
-            return {}
-        return {
-            str(k): str(v) for k, v in data.items()
-        } if isinstance(data, dict) else {}
+        return OptionsStore(self._persister).fetch()
 
     def _render_spec(self, overrides: Dict[str, str]):
         """Re-render svc.yml with base env + option overrides."""
@@ -298,6 +319,11 @@ class FrameworkRunner:
         )
         builder.set_inventory(self._inventory)
         builder.set_agent(self._agent)
+        lease = getattr(self._lock, "lease", None)
+        if lease is not None:
+            # HA mode: every store mutation is lease-fenced, and the
+            # scheduler carries its HAState (gauges + /v1/debug/ha)
+            builder.set_leader_lease(lease)
         if self.builder_hook is not None:
             self.builder_hook(builder, self.spec)
         self.scheduler = builder.build()
@@ -325,8 +351,6 @@ class FrameworkRunner:
             return self._update_options_locked(env)
 
     def _update_options_locked(self, env: Dict[str, str]):
-        import json
-
         from dcos_commons_tpu.specification.validation import (
             ConfigValidationError,
             ValidationContext,
@@ -382,14 +406,11 @@ class FrameworkRunner:
         # every restart.  Only the FIRST update since the last
         # successful rebuild snapshots: its value is the last one a
         # build actually validated.
+        options_store = OptionsStore(self._persister)
         if not self._options_dirty:
-            self._options_rollback = self._persister.get_or_none(
-                OPTIONS_NODE
-            )
+            self._options_rollback = options_store.snapshot_raw()
             self._options_dirty = True
-        self._persister.set(
-            OPTIONS_NODE, json.dumps(merged, sort_keys=True).encode("utf-8")
-        )
+        options_store.store(merged)
         # stop only the event-loop thread; _run_locked sees the reload
         # flag, rebuilds over the same persister/agent, and swaps the
         # API server's scheduler — the process and socket survive
@@ -420,10 +441,7 @@ class FrameworkRunner:
                 return
             prev = self._options_rollback
             try:
-                if prev is None:
-                    self._persister.recursive_delete(OPTIONS_NODE)
-                else:
-                    self._persister.set(OPTIONS_NODE, prev)
+                OptionsStore(self._persister).restore_raw(prev)
                 LOG.warning(
                     "rolled options back to pre-update value after "
                     "rebuild failure"
@@ -436,6 +454,12 @@ class FrameworkRunner:
     def run(self) -> int:
         """Lock -> build -> serve -> loop.  Returns a process exit code."""
         if not self._lock.acquire():
+            if self._stop_requested.is_set():
+                # an HA standby asked to stop while candidating: a
+                # clean exit, not a lock conflict — a supervisor must
+                # not treat the operator's own stop as a crash
+                LOG.info("stopped while standing by for the lease")
+                return 0
             LOG.error(
                 "another scheduler instance holds the lock for %s",
                 self.config.state_dir,
@@ -481,6 +505,13 @@ class FrameworkRunner:
                 len(self.topology_hosts),
                 "remote" if self.agent_urls else "local",
             )
+            lease = getattr(self._lock, "lease", None)
+            if lease is not None:
+                LOG.info(
+                    "HA leader for %s at lease epoch %d "
+                    "(failover state at %s/v1/debug/ha)",
+                    self.spec.name, lease.epoch, self.api_server.url,
+                )
             tracer = getattr(self.scheduler, "tracer", None)
             if tracer is not None and tracer.enabled:
                 # the causal timeline operators join sandbox logs
@@ -584,6 +615,9 @@ class FrameworkRunner:
 
     def stop(self) -> None:
         self._stop_requested.set()
+        abort = getattr(self._lock, "abort", None)
+        if callable(abort):
+            abort()  # release a candidate parked in acquire()
         if self.scheduler is not None:
             self.scheduler.stop()
 
@@ -643,6 +677,16 @@ class MultiFrameworkRunner:
         from dcos_commons_tpu.scheduler.builder import make_persister
 
         persister = make_persister(self.config)
+        ha_state = None
+        lease = getattr(self._lock, "lease", None)
+        if lease is not None:
+            from dcos_commons_tpu.ha.election import (
+                FencedPersister,
+                HAState,
+            )
+
+            persister = FencedPersister(persister, lease)
+            ha_state = HAState(persister, lease.name, lease=lease)
         self.multi = MultiServiceScheduler(
             persister=persister,
             inventory=inventory,
@@ -652,6 +696,7 @@ class MultiFrameworkRunner:
                 (lambda b: self.builder_hook(b, None))
                 if self.builder_hook else None
             ),
+            ha_state=ha_state,
         )
         for spec in self.specs:
             if self.multi.get_service(spec.name) is None:
@@ -659,6 +704,11 @@ class MultiFrameworkRunner:
 
     def run(self) -> int:
         if not self._lock.acquire():
+            if self._stop_requested.is_set():
+                # see FrameworkRunner.run: an aborted HA candidate is
+                # a clean stop, not a lock conflict
+                LOG.info("stopped while standing by for the lease")
+                return 0
             LOG.error("another scheduler instance holds the lock")
             return EXIT_LOCKED
         try:
@@ -719,6 +769,9 @@ class MultiFrameworkRunner:
 
     def stop(self) -> None:
         self._stop_requested.set()
+        abort = getattr(self._lock, "abort", None)
+        if callable(abort):
+            abort()  # release a candidate parked in acquire()
         if self.multi is not None:
             self.multi.stop()
 
@@ -757,6 +810,14 @@ def serve_main(
         default=None,
         help="cluster state server URL (remote persistence + lease "
              "lock; omit for local file WAL state)",
+    )
+    parser.add_argument(
+        "--ha",
+        action="store_true",
+        help="HA leader election (requires --state-url): extra "
+             "scheduler processes become hot standbys that take over "
+             "on leader death; store writes are lease-epoch fenced "
+             "(also $SDK_HA)",
     )
     parser.add_argument(
         "--secrets-dir",
@@ -827,6 +888,15 @@ def serve_main(
         config.state_dir = args.state_dir
     if args.state_url is not None:
         config.state_url = args.state_url
+    if args.ha:
+        config.ha_enabled = True
+    if config.ha_enabled and not config.state_url:
+        print(
+            "configuration error: --ha requires --state-url (the "
+            "leader lease lives in the replicated state tree)",
+            file=sys.stderr,
+        )
+        return EXIT_BAD_CONFIG
     if args.secrets_dir is not None:
         config.secrets_dir = args.secrets_dir
     if args.sandbox_root is not None:

@@ -90,6 +90,7 @@ class SchedulerBuilder:
         self._failure_monitor: Optional[FailureMonitor] = None
         self._namespace = self._config.service_namespace
         self._secrets_provider = None
+        self._leader_lease = None
 
     # -- fluent wiring (reference: SchedulerBuilder setters) ----------
 
@@ -122,12 +123,31 @@ class SchedulerBuilder:
         self._secrets_provider = provider
         return self
 
+    def set_leader_lease(self, lease) -> "SchedulerBuilder":
+        """HA mode (dcos_commons_tpu/ha/): wrap every store mutation
+        in the lease-fenced writer, so a deposed leader's writes are
+        rejected rather than racing its successor's (reference:
+        CuratorLocker's one-scheduler guarantee, upgraded from mutual
+        exclusion to fencing)."""
+        self._leader_lease = lease
+        return self
+
     # -- build --------------------------------------------------------
 
     def build(self) -> DefaultScheduler:
         persister = self._persister
         if persister is None:
             persister = make_persister(self._config)
+        if self._leader_lease is not None:
+            from dcos_commons_tpu.ha.election import FencedPersister
+
+            # every store below is constructed over the fenced writer:
+            # no scheduler-path mutation can bypass the lease check.
+            # Reuse an already-fenced persister (the HA runner fences
+            # its own handle) so rejection counters stay in one place.
+            if not (isinstance(persister, FencedPersister)
+                    and persister.lease is self._leader_lease):
+                persister = FencedPersister(persister, self._leader_lease)
         SchemaVersionStore(persister).check()
         state_store = StateStore(persister, self._namespace)
         config_store = ConfigStore(persister, self._namespace)
@@ -311,6 +331,13 @@ class SchedulerBuilder:
         )
         scheduler.secrets_provider = secrets_provider
         scheduler.certificate_authority = certificate_authority
+        if self._leader_lease is not None:
+            from dcos_commons_tpu.ha.election import HAState
+
+            HAState(
+                persister, self._leader_lease.name,
+                lease=self._leader_lease,
+            ).attach(scheduler)
         return scheduler
 
     # -- config update (reference: DefaultConfigurationUpdater:159) ---

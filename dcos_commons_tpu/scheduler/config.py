@@ -23,6 +23,11 @@ class SchedulerConfig:
     # scheduler process becomes host-agnostic and failover is real
     state_url: str = ""
     state_lease_ttl_s: float = 15.0
+    # HA leader election (dcos_commons_tpu/ha/): with remote state,
+    # `serve --ha` (or SDK_HA=1) makes extra scheduler processes hot
+    # STANDBYS — they candidate for the leader lease instead of
+    # exiting, and every store mutation is fenced by the lease epoch
+    ha_enabled: bool = False
     # secrets provider root (reference: DC/OS secrets service; here an
     # operator-managed directory tree read by FileSecretsProvider)
     secrets_dir: str = ""
@@ -71,6 +76,7 @@ class SchedulerConfig:
             state_dir=env.get("STATE_DIR", "./state"),
             state_url=env.get("STATE_URL", ""),
             state_lease_ttl_s=float(env.get("STATE_LEASE_TTL_S", "15")),
+            ha_enabled=env.get("SDK_HA", "") not in ("", "0", "false"),
             secrets_dir=env.get("SECRETS_DIR", ""),
             service_namespace=env.get("SERVICE_NAMESPACE", ""),
             uninstall=env.get("SDK_UNINSTALL", "") not in ("", "0", "false"),

@@ -99,6 +99,23 @@ class DefaultScheduler:
         # a step verb arriving on an HTTP thread (step.restart() is
         # lock-free) can never borrow an unrelated status's anchor
         self._trace_ctx: Optional[tuple] = None  # (thread_id, trace, span)
+        # HA (dcos_commons_tpu/ha/): crash-injection hook for the chaos
+        # harness — callable(kind) invoked at every span-boundary kind
+        # (post-evaluate, post-wal, mid-status-fan-in,
+        # mid-plan-transition, mid-checkpoint-prune); None in
+        # production.  ha_state (election.HAState) is attached by the
+        # builder/runner when a leader lease is wired; last_rehydration
+        # is the first cycle's WAL-replay report.
+        self.chaos = None
+        self.ha_state = None
+        self.last_rehydration = None
+        from dcos_commons_tpu.ha.rehydrate import PlanCheckpointer
+
+        self._plan_checkpointer = PlanCheckpointer(state_store)
+        # set by nudge()/step transitions; checkpointing skips clean
+        # cycles so idle heartbeats never serialize the plan tree
+        self._plan_dirty = True
+        self._transition_seq = 0
         # deploy before recovery: rollout owns incomplete pods, and the
         # recovery manager defers to them via externally_managed
         self.coordinator = DefaultPlanCoordinator(
@@ -191,9 +208,12 @@ class DefaultScheduler:
                 self._wire_step_tracing()
                 n_statuses = self._intake_statuses(cycle)
                 if not self.reconciler.is_reconciled:
-                    for status in self.reconciler.reconcile():
-                        self._process_status(status, parent=cycle)
-                        n_statuses += 1
+                    # first cycle of this scheduler incarnation: full
+                    # re-hydration (plan-checkpoint restore + WAL
+                    # replay against agent reality).  Cold start and
+                    # failover take the same path — the only
+                    # difference is what the replay finds.
+                    n_statuses += self._rehydrate_locked(cycle)
                     self.metrics.incr("reconciles")
                 n_candidates = self._process_candidates(
                     allow_footprint_growth, parent=cycle
@@ -208,6 +228,23 @@ class DefaultScheduler:
                 if not self.state_store.deployment_was_completed() and \
                         self.deploy_manager.get_plan().is_complete:
                     self.state_store.set_deployment_completed()
+                if self._plan_dirty:
+                    # persist plan runtime state (interrupts, step
+                    # statuses) so a successor resumes at the exact
+                    # state the operator left — the failover contract.
+                    # Cleared BEFORE serializing (a racing flip costs
+                    # one extra checkpoint, never a lost one) but
+                    # restored on failure: a transient store error
+                    # must not silently drop an operator verb's
+                    # checkpoint until the next plan transition.
+                    self._plan_dirty = False
+                    try:
+                        self._plan_checkpointer.checkpoint(
+                            self.plans(), chaos=self._chaos_point
+                        )
+                    except BaseException:
+                        self._plan_dirty = True
+                        raise
                 cycle.set_attr("statuses", n_statuses)
                 cycle.set_attr("candidates", n_candidates)
                 if n_statuses == 0 and n_candidates == 0:
@@ -272,7 +309,88 @@ class DefaultScheduler:
         plan work made pending, HTTP mutation).  Safe from any thread;
         a nudge during a cycle makes the next wait return at once."""
         self.metrics.incr("cycle.nudges")
+        # anything worth waking for may have changed plan state (HTTP
+        # plan verbs mutate plan objects directly): re-checkpoint on
+        # the next cycle.  Monotonic bool flip from any thread; the
+        # cycle clears it BEFORE serializing, so a racing flip only
+        # costs one extra checkpoint, never a lost one.
+        self._plan_dirty = True  # sdklint: disable=lock-discipline — see above
         self._wake.set()
+
+    def _chaos_point(self, kind: str) -> None:
+        """Crash-injection hook: the chaos harness installs a callable
+        that raises at a chosen span-boundary kind, simulating a
+        scheduler death at exactly that point.  No-op in production."""
+        if self.chaos is not None:
+            self.chaos(kind)
+
+    # -- re-hydration (dcos_commons_tpu/ha/rehydrate.py) --------------
+
+    def _rehydrate_locked(self, cycle) -> int:
+        """First cycle of this incarnation: restore plan checkpoints
+        (operator interrupts / force-completes), then replay the
+        launch WAL against agent reality — adopt live tasks, re-issue
+        launches the crash lost, hand unobserved deaths to recovery —
+        and record it all as one ``rehydrate.replay`` span chained to
+        the election.promote that created this incarnation (when one
+        did).  Returns the number of synthesized statuses routed."""
+        from dcos_commons_tpu.common import TaskState
+        from dcos_commons_tpu.ha import rehydrate as _rehydrate
+
+        promote_ref = (
+            self.ha_state.lease.promote_ref
+            if self.ha_state is not None and self.ha_state.lease is not None
+            else None
+        )
+        kwargs = (
+            {"trace_id": promote_ref[0], "parent_id": promote_ref[1]}
+            if promote_ref is not None else {"parent": cycle}
+        )
+        report = _rehydrate.RehydrationReport()
+        with self.tracer.span(
+            "rehydrate.replay", track="scheduler", **kwargs
+        ) as span:
+            _rehydrate.restore_plans(
+                self.state_store, self.plans(), report
+            )
+            _rehydrate.scan_double_reservations(self.ledger, report)
+            stored = self.state_store.fetch_statuses()
+            stored_ids = {s.task_id for s in stored.values()}
+            active = self.agent.active_task_ids()
+            report.adopted = sum(
+                1 for s in stored.values()
+                if not s.state.is_terminal and s.task_id in active
+            )
+            report.orphans = len(active - stored_ids)
+            n = 0
+            for status in self.reconciler.reconcile():
+                try:
+                    prev = stored.get(task_name_of(status.task_id))
+                except ValueError:
+                    prev = None
+                if prev is not None and prev.state is TaskState.STAGING:
+                    # the WAL seed never progressed and no agent knows
+                    # the task: the crash landed between WAL and
+                    # launch.  The LOST status re-pends the step; the
+                    # evaluator relaunches in place on the committed
+                    # reservations.
+                    report.reissued += 1
+                else:
+                    report.lost += 1
+                self._process_status(status, parent=span)
+                n += 1
+            for attr in ("adopted", "reissued", "lost", "orphans",
+                         "restored_plans", "restored_steps",
+                         "double_reservations"):
+                span.set_attr(attr, getattr(report, attr))
+        self.last_rehydration = report.to_dict()
+        if self.ha_state is not None:
+            self.ha_state.note_rehydration(self.last_rehydration)
+        for key in ("adopted", "reissued", "lost"):
+            value = getattr(report, key)
+            if value:
+                self.metrics.incr(f"ha.rehydrate.{key}", value)
+        return n
 
     def _work_in_flight(self) -> bool:
         """True while any plan step holds launched-but-unconfirmed
@@ -330,6 +448,9 @@ class DefaultScheduler:
             LOG.info("dropped stale status %s for %s",
                      status.state.value, task_name)
             return
+        # chaos: status persisted but NOT yet routed to the plans — a
+        # successor must converge from the stored status alone
+        self._chaos_point("mid-status-fan-in")
         # a pause/resume override completes once the task relaunched
         # UNDER the override (progress IN_PROGRESS, set at launch time)
         # reaches RUNNING; a RUNNING from the pre-override task arrives
@@ -347,11 +468,18 @@ class DefaultScheduler:
         self._trace_ctx = (
             threading.get_ident(), event.trace_id, event.span_id
         )
+        seq_before = self._transition_seq
         try:
             for manager in self.coordinator.plan_managers:
                 manager.update(status)
         finally:
             self._trace_ctx = None
+        if self._transition_seq != seq_before:
+            # chaos: the status moved a plan step, but the cycle's
+            # post-transition work (deployment-completed flip, plan
+            # checkpoint) never ran — a successor must not re-run the
+            # transitioned step
+            self._chaos_point("mid-plan-transition")
 
     def _wire_step_tracing(self) -> None:
         """Attach the step-transition listener to every plan step that
@@ -368,6 +496,10 @@ class DefaultScheduler:
         one is active AND this is the thread that set it; operator
         verbs firing from HTTP threads record unanchored (they were
         not caused by the status the cycle thread is processing)."""
+        self._transition_seq += 1
+        # same monotonic-flip contract as nudge(): operator verbs fire
+        # transitions from HTTP threads without the scheduler lock
+        self._plan_dirty = True  # sdklint: disable=lock-discipline — see nudge()
         ctx = self._trace_ctx
         if ctx is not None and ctx[0] == threading.get_ident():
             trace_id, parent_id = ctx[1], ctx[2]
@@ -430,6 +562,9 @@ class DefaultScheduler:
                 step.update_offer_status(False)
                 self.metrics.incr("offers.declined")
                 continue
+            # chaos: evaluation passed but NOTHING is persisted yet —
+            # a successor re-evaluates from scratch, nothing leaks
+            self._chaos_point("post-evaluate")
             self._kill_previous_launches(result.task_infos)
             with self.tracer.span(
                 f"launch:{requirement.name}", parent=parent,
@@ -469,6 +604,10 @@ class DefaultScheduler:
                     )
                 finally:
                     self._trace_ctx = None
+                # chaos: reservations + WAL are durable but the agent
+                # never hears about the launch — a successor must
+                # re-issue it (the STAGING seed reconciles to LOST)
+                self._chaos_point("post-wal")
                 self._launch(result.task_infos, requirement)
             self.metrics.incr("operations.launch", len(result.task_infos))
         return len(candidates)

@@ -86,3 +86,54 @@ class ConfigStore:
                 self.clear(config_id)
                 removed.append(config_id)
         return removed
+
+
+class OptionsStore:
+    """Persisted operator option overrides (the live `update` flow).
+
+    Reference: the Cosmos options JSON a package `update` pushes onto
+    a running scheduler.  A store class so the runner's option writes
+    flow through the same wired (lease-fenced, in HA mode) persister
+    as every other scheduler-path mutation — sdklint's
+    ``lease-gated-mutation`` rule bans raw persister writes there.
+    """
+
+    NODE = "service_options"
+
+    def __init__(self, persister: Persister) -> None:
+        self._persister = persister
+
+    def fetch(self) -> Dict[str, str]:
+        raw = self._persister.get_or_none(self.NODE)
+        if not raw:
+            return {}
+        try:
+            data = json.loads(raw.decode("utf-8"))
+        except (ValueError, UnicodeDecodeError):
+            return {}
+        return {
+            str(k): str(v) for k, v in data.items()
+        } if isinstance(data, dict) else {}
+
+    def store(self, options: Dict[str, str]) -> None:
+        self._persister.set(
+            self.NODE,
+            json.dumps(options, sort_keys=True).encode("utf-8"),
+        )
+
+    # raw snapshot/restore: the runner's rebuild-failure rollback must
+    # reproduce the EXACT pre-update bytes (or absence)
+
+    def snapshot_raw(self) -> Optional[bytes]:
+        return self._persister.get_or_none(self.NODE)
+
+    def restore_raw(self, raw: Optional[bytes]) -> None:
+        from dcos_commons_tpu.storage import PersisterError
+
+        if raw is None:
+            try:
+                self._persister.recursive_delete(self.NODE)
+            except PersisterError:
+                pass  # nothing to roll back
+        else:
+            self._persister.set(self.NODE, raw)

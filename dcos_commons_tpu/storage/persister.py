@@ -136,6 +136,34 @@ class Persister(ABC):
             self.recursive_delete("/" + child)
 
 
+def wipe_namespace(persister: Persister, namespace: str = "") -> None:
+    """Delete every node a service owns: its namespace subtree, or —
+    for a standalone service — the whole tree MINUS cluster
+    infrastructure.  The storage-layer home for the uninstall
+    teardown's raw mutation (scheduler paths must not mutate
+    persisters directly — sdklint lease-gated-mutation).
+
+    ``/__ha__`` (the leader-lease records) is never wiped: an HA
+    uninstaller writes through the lease-fenced persister, and
+    deleting its own lease mid-wipe would fence every remaining
+    delete — the uninstall could never finish.  The lease expires on
+    its own once the process exits."""
+    root = namespace_root(namespace)
+    if root:
+        try:
+            persister.recursive_delete(root)
+        except PersisterError:
+            pass  # already gone
+    else:
+        for child in persister.get_children_or_empty("/"):
+            if child == "__ha__":
+                continue
+            try:
+                persister.recursive_delete(f"/{child}")
+            except PersisterError:
+                pass  # concurrent cleanup: already gone
+
+
 class _Node:
     __slots__ = ("value", "children")
 

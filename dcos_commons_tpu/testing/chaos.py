@@ -1,0 +1,407 @@
+"""Chaos harness: kill the scheduler at every span boundary, converge.
+
+Reference: the reference proves restart safety with ONE restart test
+(``SchedulerRestartServiceTest``); this harness turns that into a
+kill MATRIX.  A crash injector raises out of ``run_cycle`` at a
+chosen traceview span-boundary kind — the five places a scheduler
+death leaves observably different persisted state:
+
+    post-evaluate        evaluation passed, nothing persisted
+    post-wal             reservations + launch WAL durable, agent
+                         never heard about the launch
+    mid-status-fan-in    a status persisted but not routed to plans
+    mid-plan-transition  a plan step moved, post-transition work lost
+    mid-checkpoint-prune plan checkpoints partially written/pruned
+
+The dead scheduler object is abandoned exactly as SIGKILL would leave
+a process (no cleanup, spans leaked, locks simply released), a
+successor is rebuilt over the same persister + agent + inventory —
+the production failover path — and the harness drives cycles until
+the plan converges, then asserts the invariants split-brain-free
+failover promises: the plan completes, no chip is double-reserved, no
+task is orphaned, and no step that was COMPLETE before the kill runs
+again.
+
+Deterministic: kills fire at exact occurrence counts of exact kinds;
+``ChaosMatrix`` derives its schedule from a seed recorded in every
+report, so a failing combination replays from the log line alone.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from dcos_commons_tpu.common import TaskState, TaskStatus
+from dcos_commons_tpu.offer.inventory import SliceInventory, TpuHost
+from dcos_commons_tpu.scheduler.builder import SchedulerBuilder
+from dcos_commons_tpu.scheduler.config import SchedulerConfig
+from dcos_commons_tpu.scheduler.scheduler import DefaultScheduler
+from dcos_commons_tpu.specification.yaml_spec import from_yaml
+from dcos_commons_tpu.storage import MemPersister
+from dcos_commons_tpu.testing.fake_agent import FakeAgent
+
+# the five span-boundary kinds DefaultScheduler exposes via
+# _chaos_point (keep in lockstep with the call sites there and in
+# ha/rehydrate.PlanCheckpointer)
+CHAOS_KINDS = (
+    "post-evaluate",
+    "post-wal",
+    "mid-status-fan-in",
+    "mid-plan-transition",
+    "mid-checkpoint-prune",
+)
+
+
+class SchedulerKilled(Exception):
+    """Raised by a CrashInjector: the scheduler 'process' died here."""
+
+    def __init__(self, kind: str, occurrence: int):
+        super().__init__(f"chaos kill at {kind} (occurrence {occurrence})")
+        self.kind = kind
+        self.occurrence = occurrence
+
+
+@dataclass(frozen=True)
+class KillPoint:
+    """Die at the Nth time ``kind`` is reached (1-based)."""
+
+    kind: str
+    occurrence: int = 1
+
+    def __post_init__(self):
+        if self.kind not in CHAOS_KINDS:
+            raise ValueError(
+                f"unknown chaos kind {self.kind!r}; expected one of "
+                f"{CHAOS_KINDS}"
+            )
+
+
+class CrashInjector:
+    """Installed as ``scheduler.chaos``; counts hits per kind and
+    raises once at the scheduled point."""
+
+    def __init__(self, point: KillPoint):
+        self.point = point
+        self.hits: Dict[str, int] = {}
+        self.fired = False
+
+    def __call__(self, kind: str) -> None:
+        self.hits[kind] = self.hits.get(kind, 0) + 1
+        if (not self.fired and kind == self.point.kind
+                and self.hits[kind] == self.point.occurrence):
+            self.fired = True
+            raise SchedulerKilled(kind, self.point.occurrence)
+
+
+@dataclass
+class ChaosReport:
+    """One kill-and-converge run's observable outcome."""
+
+    kill: Optional[KillPoint]
+    seed: int = 0
+    killed: bool = False
+    incarnations: int = 1
+    cycles: int = 0
+    converged: bool = False
+    # persisted view at the moment of death
+    prekill_complete_steps: List[Tuple[str, str, str]] = field(
+        default_factory=list
+    )
+    prekill_task_ids: Dict[str, str] = field(default_factory=dict)
+    prekill_staging_ids: Dict[str, str] = field(default_factory=dict)
+    # successor's first-cycle WAL replay
+    rehydration: Optional[dict] = None
+    # converged view
+    final_task_ids: Dict[str, str] = field(default_factory=dict)
+
+    def describe(self) -> str:
+        kill = (f"{self.kill.kind}#{self.kill.occurrence}"
+                if self.kill else "none")
+        return (
+            f"chaos[kill={kill} seed={self.seed} killed={self.killed} "
+            f"incarnations={self.incarnations} cycles={self.cycles} "
+            f"converged={self.converged} rehydration={self.rehydration}]"
+        )
+
+
+# a control pod that deploys (and completes) BEFORE the gang, so every
+# kill during the gang's rollout has a completed step to regress — the
+# no-completed-step-re-run invariant needs one to exist
+CHAOS_GANG_YAML = """
+name: chaossvc
+pods:
+  ctl:
+    count: 1
+    tasks:
+      server:
+        goal: RUNNING
+        cmd: "{cmd}"
+        cpus: 0.5
+        memory: 64
+  trainer:
+    count: 4
+    gang: true
+    tpu:
+      generation: v5e
+      chips-per-host: 4
+      topology: 4x4
+    tasks:
+      worker:
+        goal: RUNNING
+        cmd: "{cmd}"
+        cpus: 1.0
+        memory: 256
+"""
+
+
+def chaos_fleet() -> List[TpuHost]:
+    from dcos_commons_tpu.offer.inventory import make_test_fleet
+
+    return make_test_fleet(host_grid=(2, 2), chip_block=(2, 2),
+                           cpus=16.0, memory_mb=65536)
+
+
+class ChaosHarness:
+    """Drive one service through deploy, killing and restarting the
+    scheduler at a chosen point.
+
+    Two agent modes share every other code path:
+
+    * ``workdir=None`` — a ``FakeAgent``; the harness acks launches
+      RUNNING between cycles.  Fast and fully deterministic: the
+      tier-1 single-kill tests run here.
+    * ``workdir=<dir>`` — a real ``LocalProcessAgent`` launching real
+      task processes that SURVIVE scheduler death (durable-task
+      semantics), exactly like a production failover.  The chaos-tier
+      matrix runs here.
+    """
+
+    def __init__(
+        self,
+        yaml_text: Optional[str] = None,
+        hosts: Optional[List[TpuHost]] = None,
+        workdir: Optional[str] = None,
+        seed: int = 0,
+        task_cmd: str = "sleep 120",
+    ):
+        yaml_text = (yaml_text or CHAOS_GANG_YAML).replace(
+            "{cmd}", task_cmd
+        )
+        self.spec = from_yaml(yaml_text)
+        self.hosts = hosts if hosts is not None else chaos_fleet()
+        self.seed = seed
+        self.persister = MemPersister()
+        self.inventory = SliceInventory(self.hosts)
+        self.config = SchedulerConfig(
+            backoff_enabled=False, revive_capacity=10**9
+        )
+        self.local_mode = workdir is not None
+        if self.local_mode:
+            from dcos_commons_tpu.agent.local import LocalProcessAgent
+
+            self.agent = LocalProcessAgent(workdir)
+        else:
+            self.agent = FakeAgent()
+            self._acked: set = set()
+        self.scheduler: Optional[DefaultScheduler] = None
+
+    # -- lifecycle ----------------------------------------------------
+
+    def build_scheduler(self) -> DefaultScheduler:
+        builder = SchedulerBuilder(self.spec, self.config, self.persister)
+        builder.set_inventory(self.inventory)
+        builder.set_agent(self.agent)
+        self.scheduler = builder.build()
+        return self.scheduler
+
+    def shutdown(self) -> None:
+        """Kill surviving task processes (local mode) — durable tasks
+        outlive every scheduler incarnation by design."""
+        shutdown = getattr(self.agent, "shutdown", None)
+        if callable(shutdown):
+            shutdown()
+
+    # -- the kill-and-converge loop -----------------------------------
+
+    def _ack_fake_launches(self) -> None:
+        for info in list(self.agent.launched):
+            if info.task_id not in self._acked:
+                self._acked.add(info.task_id)
+                self.agent.send(TaskStatus(
+                    task_id=info.task_id, state=TaskState.RUNNING,
+                    ready=True, agent_id=info.agent_id,
+                ))
+
+    def _snapshot_persisted(self, report: ChaosReport) -> None:
+        """The successor's whole world: what the STORE says at death."""
+        from dcos_commons_tpu.state.state_store import StateStore
+
+        store = StateStore(self.persister)
+        statuses = store.fetch_statuses()
+        for name, status in statuses.items():
+            if status.state is TaskState.STAGING:
+                report.prekill_staging_ids[name] = status.task_id
+            elif not status.state.is_terminal:
+                report.prekill_task_ids[name] = status.task_id
+
+    def _snapshot_plans(self, scheduler, report: ChaosReport) -> None:
+        for plan_name, plan in scheduler.plans().items():
+            for phase in plan.phases:
+                for step in phase.steps:
+                    if step.get_status().is_complete:
+                        report.prekill_complete_steps.append(
+                            (plan_name, phase.name, step.name)
+                        )
+
+    def run(
+        self,
+        kill: Optional[KillPoint],
+        timeout_s: float = 60.0,
+        settle_s: float = 0.02,
+    ) -> ChaosReport:
+        """Deploy to completion, dying once at ``kill`` (when given).
+        Raises on non-convergence; requesting a kill that never fires
+        is an error too (a silently-skipped matrix entry would read as
+        coverage)."""
+        report = ChaosReport(kill=kill, seed=self.seed)
+        scheduler = self.scheduler or self.build_scheduler()
+        if kill is not None:
+            scheduler.chaos = CrashInjector(kill)
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            try:
+                scheduler.run_cycle()
+            except SchedulerKilled:
+                # the 'process' died: snapshot the persisted world the
+                # successor inherits, abandon the corpse (no cleanup —
+                # that is the point), and fail over
+                report.killed = True
+                self._snapshot_plans(scheduler, report)
+                self._snapshot_persisted(report)
+                scheduler = self.build_scheduler()  # successor
+                report.incarnations += 1
+                continue
+            report.cycles += 1
+            if report.killed and report.rehydration is None:
+                report.rehydration = scheduler.last_rehydration
+            if not self.local_mode:
+                self._ack_fake_launches()
+            if scheduler.deploy_manager.get_plan().is_complete:
+                report.converged = True
+                break
+            if self.local_mode:
+                time.sleep(settle_s)  # real processes need wall time
+        if kill is not None and not report.killed:
+            raise AssertionError(
+                f"kill point {kill} never fired: {report.describe()}"
+            )
+        for info in scheduler.state_store.fetch_tasks():
+            report.final_task_ids[info.name] = info.task_id
+        self.assert_invariants(scheduler, report)
+        return report
+
+    # -- the failover invariants --------------------------------------
+
+    def assert_invariants(self, scheduler, report: ChaosReport) -> None:
+        describe = report.describe()
+        assert report.converged, f"plan never converged: {describe}"
+
+        # 1. no double reservation: every chip claimed at most once,
+        #    and every reservation is owned by a stored task
+        claimed: Dict[tuple, str] = {}
+        stored_names = {
+            info.name for info in scheduler.state_store.fetch_tasks()
+        }
+        for reservation in scheduler.ledger.all():
+            assert reservation.task_name in stored_names, (
+                f"reservation {reservation.reservation_id} owned by "
+                f"unknown task {reservation.task_name}: {describe}"
+            )
+            for chip in reservation.chip_ids:
+                key = (reservation.host_id, chip)
+                assert key not in claimed or \
+                    claimed[key] == reservation.reservation_id, (
+                        f"chip {key} double-reserved: {describe}"
+                    )
+                claimed[key] = reservation.reservation_id
+        if report.rehydration is not None:
+            assert report.rehydration["double_reservations"] == 0, describe
+
+        # 2. no orphaned task: agent reality == store reality
+        stored_ids = {
+            info.task_id
+            for info in scheduler.state_store.fetch_tasks()
+        }
+        active = scheduler.agent.active_task_ids()
+        assert active <= stored_ids, (
+            f"orphaned agent tasks {active - stored_ids}: {describe}"
+        )
+        # ...and every live stored task is actually running somewhere
+        for name, status in scheduler.state_store.fetch_statuses().items():
+            if status.state is TaskState.RUNNING:
+                assert status.task_id in active, (
+                    f"store believes {name} runs as {status.task_id} "
+                    f"but no agent does: {describe}"
+                )
+
+        # 3. no completed step re-ran: tasks of steps COMPLETE before
+        #    the kill keep their task ids through the failover
+        for plan_name, phase_name, step_name in \
+                report.prekill_complete_steps:
+            plan = scheduler.plan(plan_name)
+            if plan is None:
+                continue  # deploy renamed to update across restart
+            step = plan.step(phase_name, step_name)
+            assert step is not None and step.get_status().is_complete, (
+                f"step {plan_name}/{phase_name}/{step_name} was "
+                f"COMPLETE before the kill but is "
+                f"{step.get_status() if step else 'GONE'} after: "
+                f"{describe}"
+            )
+        for name, task_id in report.prekill_task_ids.items():
+            final = report.final_task_ids.get(name)
+            assert final == task_id, (
+                f"running task {name} was re-launched across the "
+                f"failover ({task_id} -> {final}): {describe}"
+            )
+
+        # 4. WAL consistency: every stored info has a status for ITS id
+        for info in scheduler.state_store.fetch_tasks():
+            status = scheduler.state_store.fetch_status(info.name)
+            assert status is not None and \
+                status.task_id == info.task_id, (
+                    f"WAL'd task {info.name} has no status for its "
+                    f"launch: {describe}"
+                )
+
+
+class ChaosMatrix:
+    """The full kill matrix: every kind x a set of occurrences, run
+    order shuffled by ``seed`` (recorded in every report so failures
+    replay: CHAOS_SEED=<seed> reruns the identical schedule)."""
+
+    def __init__(self, occurrences: Tuple[int, ...] = (1, 2),
+                 seed: int = 0):
+        self.seed = seed
+        schedule = [
+            KillPoint(kind, occurrence)
+            for kind in CHAOS_KINDS
+            for occurrence in occurrences
+        ]
+        random.Random(seed).shuffle(schedule)
+        self.schedule = schedule
+
+    def run(self, harness_factory, timeout_s: float = 60.0) -> List[ChaosReport]:
+        """``harness_factory(seed) -> ChaosHarness`` builds a FRESH
+        world per kill point (kills must not compound)."""
+        reports = []
+        for point in self.schedule:
+            harness = harness_factory(self.seed)
+            try:
+                reports.append(harness.run(point, timeout_s=timeout_s))
+            finally:
+                harness.shutdown()
+        return reports

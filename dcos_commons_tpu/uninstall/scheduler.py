@@ -188,21 +188,9 @@ class UninstallScheduler:
         """Delete every persisted node of this service (the whole tree
         for a standalone service, only the namespace subtree in
         multi-service mode)."""
-        from dcos_commons_tpu.storage import PersisterError
-        from dcos_commons_tpu.storage.persister import namespace_root
+        from dcos_commons_tpu.storage.persister import wipe_namespace
 
-        root = namespace_root(self._namespace)
-        if root:
-            try:
-                self.persister.recursive_delete(root)
-            except PersisterError:
-                pass
-        else:
-            for child in self.persister.get_children_or_empty("/"):
-                try:
-                    self.persister.recursive_delete(f"/{child}")
-                except PersisterError:
-                    pass
+        wipe_namespace(self.persister, self._namespace)
         self._wiped = True
 
     # -- API surface --------------------------------------------------

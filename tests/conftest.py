@@ -31,6 +31,14 @@ def pytest_configure(config):
         "slow: jax-compiling or multi-process e2e (seconds to minutes); "
         "run the fast tier with -m 'not slow' (docs/testing.md)",
     )
+    config.addinivalue_line(
+        "markers",
+        "chaos: scheduler kill-matrix runs (testing/chaos.py) — real "
+        "task processes, one run per kill point; always also marked "
+        "slow so tier-1's -m 'not slow' skips them; select with "
+        "-m chaos, replay failures with CHAOS_SEED=<seed> "
+        "(docs/testing.md)",
+    )
 
 
 # whole modules that are inherently heavy: every test either compiles

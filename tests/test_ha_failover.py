@@ -1,0 +1,708 @@
+"""HA control plane: election, fencing, re-hydration, chaos matrix.
+
+The acceptance criteria of the HA subsystem (ISSUE 8):
+
+* split-brain impossible by construction AND by test — a deposed
+  leader holding a stale lease epoch gets its store mutations
+  rejected (the two-scheduler race test below);
+* the chaos matrix — the scheduler killed at every traceview
+  span-boundary kind during a gang deploy — converges with no
+  double-reservation, no orphaned launch, and no completed step
+  re-run, with WAL/status reconciliation asserted per kill point.
+
+Fast, fully-deterministic FakeAgent variants run in tier-1; the
+real-process (LocalProcessAgent) matrix runs in the chaos/slow tier.
+Replays: CHAOS_SEED=<seed from the failure log> reruns the identical
+schedule.
+"""
+
+import os
+
+import pytest
+
+from dcos_commons_tpu.common import TaskState, TaskStatus
+from dcos_commons_tpu.ha.election import (
+    FencedPersister,
+    HAState,
+    LeaderLease,
+    LeaderLock,
+    LeaseFencedError,
+    read_lease,
+)
+from dcos_commons_tpu.http.api import SchedulerApi
+from dcos_commons_tpu.offer.inventory import SliceInventory, TpuHost
+from dcos_commons_tpu.scheduler.builder import SchedulerBuilder
+from dcos_commons_tpu.scheduler.config import SchedulerConfig
+from dcos_commons_tpu.specification.yaml_spec import from_yaml
+from dcos_commons_tpu.storage import MemPersister
+from dcos_commons_tpu.testing import FakeAgent
+from dcos_commons_tpu.testing.chaos import (
+    CHAOS_KINDS,
+    ChaosHarness,
+    ChaosMatrix,
+    KillPoint,
+)
+
+CHAOS_SEED = int(os.environ.get("CHAOS_SEED", "0"))
+
+
+class FakeClock:
+    def __init__(self, now: float = 1000.0):
+        self.now = now
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, seconds: float) -> None:
+        self.now += seconds
+
+
+# -- election.py unit behavior ----------------------------------------
+
+
+def test_lease_acquire_renew_takeover_epochs():
+    clock = FakeClock()
+    persister = MemPersister()
+    a = LeaderLease(persister, "svc", "sched-a", ttl_s=10, clock=clock)
+    assert a.try_acquire()
+    assert a.is_leader and a.epoch == 1
+    b = LeaderLease(persister, "svc", "sched-b", ttl_s=10, clock=clock)
+    assert not b.try_acquire()  # lease live: candidate waits
+
+    clock.advance(5.0)
+    assert a.renew()
+    assert a.epoch == 1  # renewal by the holder keeps the epoch
+    clock.advance(8.0)
+    assert not b.try_acquire()  # the renewal extended the lease
+
+    clock.advance(8.0)  # now past a's last renewal + TTL
+    assert b.try_acquire()
+    assert b.epoch == 2 and b.takeovers == 1  # takeover mints epoch+1
+
+    lost = []
+    a.on_lost = lost.append
+    assert a.renew() is False  # deposed: never silently re-takes
+    assert lost and not a.is_leader
+
+    # resign keeps the epoch but expires immediately: the successor
+    # takes over without waiting out the TTL, at epoch+1
+    b.resign()
+    assert not b.is_leader
+    c = LeaderLease(persister, "svc", "sched-c", ttl_s=10, clock=clock)
+    assert c.try_acquire()
+    assert c.epoch == 3
+
+    record = read_lease(persister, "svc")
+    assert record.owner == "sched-c" and record.epoch == 3
+
+
+def test_fenced_persister_rejects_deposed_writer():
+    clock = FakeClock()
+    persister = MemPersister()
+    a = LeaderLease(persister, "svc", "sched-a", ttl_s=5, clock=clock)
+    assert a.try_acquire()
+    fenced_a = FencedPersister(persister, a)
+    fenced_a.set("/svc/x", b"from-a")
+    assert fenced_a.get("/svc/x") == b"from-a"
+
+    clock.advance(6.0)  # a stalls past its TTL
+    b = LeaderLease(persister, "svc", "sched-b", ttl_s=5, clock=clock)
+    assert b.try_acquire()
+
+    lost = []
+    a.on_lost = lost.append
+    with pytest.raises(LeaseFencedError):
+        fenced_a.set("/svc/x", b"from-a-after-deposition")
+    assert persister.get("/svc/x") == b"from-a"  # the write never landed
+    assert fenced_a.rejected_writes == 1
+    assert lost  # fencing also fires the loss callback
+    # a deposed leader may still OBSERVE (reads are unfenced)
+    assert fenced_a.get("/svc/x") == b"from-a"
+    # ...and the new leader writes normally
+    fenced_b = FencedPersister(persister, b)
+    fenced_b.set("/svc/x", b"from-b")
+    assert persister.get("/svc/x") == b"from-b"
+    with pytest.raises(LeaseFencedError):
+        fenced_a.apply([])
+    with pytest.raises(LeaseFencedError):
+        fenced_a.recursive_delete("/svc/x")
+
+
+def test_leader_lock_candidates_until_expiry():
+    """LeaderLock.acquire blocks as a CANDIDATE and wins after the
+    holder dies (no resign — the TTL does the work)."""
+    import threading
+
+    persister = MemPersister()
+    holder = LeaderLock(persister, "svc", "sched-a", ttl_s=0.4)
+    assert holder.acquire()
+    candidate = LeaderLock(persister, "svc", "sched-b", ttl_s=0.4)
+    won = threading.Event()
+
+    def run():
+        if candidate.acquire():
+            won.set()
+
+    thread = threading.Thread(target=run, daemon=True)
+    thread.start()
+    assert not won.wait(0.2)  # holder alive: still candidating
+    holder.abort()  # SIGKILL analogue: renewals stop, no resign
+    assert won.wait(5.0), "candidate never took over after TTL expiry"
+    assert candidate.lease.epoch == 2
+    candidate.release()
+    holder_state = read_lease(persister, "svc")
+    assert holder_state.owner == ""  # clean release resigned
+
+
+def test_ha_uninstall_wipe_spares_the_lease():
+    """A standalone uninstall wipes the whole tree THROUGH the fenced
+    persister; deleting its own lease subtree mid-wipe would fence
+    every remaining delete and wedge the uninstall forever —
+    wipe_namespace must spare /__ha__ (the lease expires on its own)."""
+    from dcos_commons_tpu.storage.persister import wipe_namespace
+
+    clock = FakeClock()
+    persister = MemPersister()
+    lease = LeaderLease(persister, "svc", "sched-a", ttl_s=30, clock=clock)
+    assert lease.try_acquire()
+    fenced = FencedPersister(persister, lease)
+    fenced.set("/svc/x", b"1")
+    fenced.set("/other/y", b"2")
+    wipe_namespace(fenced)  # standalone: wipe everything we own
+    assert persister.get_or_none("/svc/x") is None
+    assert persister.get_or_none("/other/y") is None
+    assert read_lease(persister, "svc").owner == "sched-a"
+    fenced.set("/post-wipe", b"1")  # still leader, still writable
+    assert fenced.rejected_writes == 0
+
+
+# -- the two-scheduler split-brain race (acceptance) ------------------
+
+
+SERIAL_YAML = """
+name: hasvc
+pods:
+  app:
+    count: {count}
+    placement: 'max-per-host:1'
+    tasks:
+      server:
+        goal: RUNNING
+        cmd: "sleep 60"
+        cpus: 0.1
+        memory: 32
+"""
+
+
+def _build_world(persister, agent, lease=None, count=2):
+    builder = SchedulerBuilder(
+        from_yaml(SERIAL_YAML.format(count=count)),
+        SchedulerConfig(backoff_enabled=False, revive_capacity=10**9),
+        persister,
+    )
+    builder.set_inventory(SliceInventory([
+        TpuHost(host_id=f"host-{i}") for i in range(count)
+    ]))
+    builder.set_agent(agent)
+    if lease is not None:
+        builder.set_leader_lease(lease)
+    return builder.build()
+
+
+def _ack_running(agent, acked):
+    for info in list(agent.launched):
+        if info.task_id not in acked:
+            acked.add(info.task_id)
+            agent.send(TaskStatus(
+                task_id=info.task_id, state=TaskState.RUNNING,
+                ready=True, agent_id=info.agent_id,
+            ))
+
+
+def test_two_scheduler_race_rejects_deposed_leader_writes():
+    """THE split-brain test: scheduler A deploys as leader, stalls
+    past its TTL; standby B takes the lease (epoch+1) and finishes the
+    rollout.  Every store mutation A attempts after deposition — both
+    a direct store write and a full scheduler cycle — is REJECTED by
+    the fenced write path; the persisted tree is exactly B's."""
+    clock = FakeClock()
+    persister = MemPersister()
+    agent = FakeAgent()
+    acked: set = set()
+
+    lease_a = LeaderLease(persister, "hasvc", "sched-a", ttl_s=10,
+                          clock=clock)
+    assert lease_a.try_acquire()
+    sched_a = _build_world(persister, agent, lease_a)
+
+    # A deploys the first pod, then stalls mid-rollout
+    sched_a.run_cycle()
+    _ack_running(agent, acked)
+    sched_a.run_cycle()
+    assert agent.launched, "A never launched anything"
+    assert not sched_a.deploy_manager.get_plan().is_complete
+
+    clock.advance(11.0)  # A's lease expires un-renewed
+    lease_b = LeaderLease(persister, "hasvc", "sched-b", ttl_s=10,
+                          clock=clock)
+    assert lease_b.try_acquire()
+    assert lease_b.epoch == lease_a.epoch + 1
+    sched_b = _build_world(persister, agent, lease_b)
+
+    # a queued status makes A's next cycle attempt a store mutation:
+    # the fence rejects it and the cycle FAILS (crash-to-restart)
+    _ack_running(agent, acked)
+    with pytest.raises(LeaseFencedError):
+        sched_a.run_cycle()
+    with pytest.raises(LeaseFencedError):
+        sched_a.state_store.store_property("a-was-here", b"1")
+    assert sched_a.ha_state is not None
+    assert not sched_a.ha_state.lease.is_leader
+
+    # the status A consumed never persisted; the FakeAgent is
+    # edge-triggered, so replay it for B (a real agent keeps
+    # reporting until the status is acted on)
+    acked.clear()
+    _ack_running(agent, acked)
+    for _ in range(12):
+        sched_b.run_cycle()
+        _ack_running(agent, acked)
+        if sched_b.deploy_manager.get_plan().is_complete:
+            break
+    assert sched_b.deploy_manager.get_plan().is_complete
+    # B's incarnation adopted A's live launches instead of redoing them
+    assert sched_b.last_rehydration["adopted"] >= 1
+    # the tree carries no deposed-leader writes
+    assert sched_b.state_store.fetch_property("a-was-here") is None
+    assert sched_a.ha_state.describe(refresh=False)[
+        "fenced_writes_rejected"] >= 2
+
+
+# -- re-hydration: operator state survives restart --------------------
+
+
+def test_rehydrate_restores_interrupt_and_force_complete():
+    """A restarted scheduler used to forget operator verbs: an
+    interrupted rollout silently resumed, a forced-complete step went
+    back to PENDING.  The plan checkpoint restores both."""
+    persister = MemPersister()
+    agent = FakeAgent()
+    acked: set = set()
+    # 3 pods so app-2 stays PENDING: the deploy must remain incomplete
+    # across the restart (a completed deploy rebuilds as "update")
+    sched1 = _build_world(persister, agent, count=3)
+    api = SchedulerApi(sched1)
+
+    sched1.run_cycle()
+    _ack_running(agent, acked)
+    sched1.run_cycle()  # app-0 COMPLETE, app-1 launching next
+    api.plan_interrupt("deploy")
+    code, _body = api.plan_force_complete(
+        "deploy", "app", "app-1:[server]"
+    )
+    assert code == 200
+    sched1.run_cycle()  # checkpoint written
+
+    sched2 = _build_world(persister, agent, count=3)
+    sched2.run_cycle()  # rehydration restores the checkpoint
+    plan = sched2.plan("deploy")
+    assert plan.is_interrupted(), "operator interrupt lost in restart"
+    step = plan.step("app", "app-1:[server]")
+    assert step is not None and step.get_status().is_complete, (
+        "force-complete lost in restart"
+    )
+    pending = plan.step("app", "app-2:[server]")
+    assert pending is not None and pending.is_pending
+    assert sched2.last_rehydration["restored_plans"] >= 1
+    assert sched2.last_rehydration["restored_steps"] >= 1
+
+
+def test_rehydrate_never_regresses_completed_steps():
+    """A checkpoint that PREDATES the statuses completing a step must
+    not pull the step back: restore only moves steps forward."""
+    persister = MemPersister()
+    agent = FakeAgent()
+    acked: set = set()
+    sched1 = _build_world(persister, agent)
+    sched1.run_cycle()  # launches app-0; checkpoint says STARTING
+    _ack_running(agent, acked)  # RUNNING persisted, but NO cycle ran:
+    # the checkpoint still says STARTING when the scheduler "dies"
+    sched2 = _build_world(persister, agent)
+    sched2.run_cycle()
+    step = sched2.plan("deploy").step("app", "app-0:[server]")
+    assert step.get_status().is_complete, (
+        "stale checkpoint regressed a completed step"
+    )
+
+
+# -- the chaos kill matrix (fast tier: FakeAgent) ---------------------
+
+
+@pytest.mark.parametrize("kind", CHAOS_KINDS)
+def test_chaos_single_kill_converges(kind):
+    """Kill the scheduler once at each span-boundary kind during the
+    4-host gang deploy; the successor converges and the per-kill-point
+    WAL/status reconciliation is exactly what the persisted state at
+    death implies."""
+    harness = ChaosHarness(seed=CHAOS_SEED)
+    try:
+        report = harness.run(KillPoint(kind, 1), timeout_s=30)
+    finally:
+        harness.shutdown()
+    assert report.killed and report.converged and \
+        report.incarnations == 2, report.describe()
+    rehydration = report.rehydration
+    assert rehydration is not None, report.describe()
+    if kind == "post-evaluate":
+        # nothing was persisted: the successor re-evaluates cleanly
+        assert rehydration["reissued"] == 0 and \
+            rehydration["adopted"] == 0, report.describe()
+    elif kind == "post-wal":
+        # WAL'd but never launched: the successor re-issues it
+        assert rehydration["reissued"] >= 1, report.describe()
+        for name, staging_id in report.prekill_staging_ids.items():
+            assert report.final_task_ids.get(name) != staging_id, (
+                f"{name} kept the never-launched id: "
+                f"{report.describe()}"
+            )
+    else:
+        # the launch reached the agent before death: adopt, never redo
+        assert rehydration["adopted"] >= 1 and \
+            rehydration["reissued"] == 0, report.describe()
+    assert rehydration["double_reservations"] == 0
+
+
+def test_chaos_kill_during_gang_rollout_preserves_completed_ctl():
+    """Occurrence 2 targets the trainer GANG's rollout: the already-
+    COMPLETE ctl step must ride through the failover untouched (no
+    completed-step re-run), while the gang converges."""
+    harness = ChaosHarness(seed=CHAOS_SEED)
+    try:
+        report = harness.run(KillPoint("post-wal", 2), timeout_s=30)
+    finally:
+        harness.shutdown()
+    assert report.killed and report.converged, report.describe()
+    assert ("deploy", "ctl", "ctl-0:[server]") in \
+        report.prekill_complete_steps, report.describe()
+    # the gang's 4 WAL'd-but-unlaunched workers were all re-issued
+    assert report.rehydration["reissued"] == 4, report.describe()
+
+
+def test_chaos_unkilled_baseline():
+    """The harness's invariants hold trivially with no kill (guards
+    against the invariants passing vacuously)."""
+    harness = ChaosHarness(seed=CHAOS_SEED)
+    try:
+        report = harness.run(None, timeout_s=30)
+    finally:
+        harness.shutdown()
+    assert report.converged and not report.killed
+    assert report.incarnations == 1
+
+
+# -- the chaos kill matrix (chaos tier: real processes) ---------------
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_chaos_matrix_gang_deploy_local_processes(tmp_path):
+    """THE acceptance matrix: a 4-host gang deploy through a REAL
+    LocalProcessAgent (task processes survive scheduler death, exactly
+    like production), the scheduler killed at every span-boundary kind
+    x two occurrences (the ctl rollout and the gang rollout), every
+    run converging under the full invariant set.  Failures replay
+    with CHAOS_SEED=<seed> from the report in the assertion message."""
+    matrix = ChaosMatrix(occurrences=(1, 2), seed=CHAOS_SEED)
+
+    run_dirs = iter(range(10_000))
+
+    def factory(seed):
+        return ChaosHarness(
+            workdir=str(tmp_path / f"agent-{next(run_dirs)}"),
+            seed=seed,
+            task_cmd="sleep 120",
+        )
+
+    reports = matrix.run(factory, timeout_s=120)
+    assert len(reports) == len(CHAOS_KINDS) * 2
+    for report in reports:
+        assert report.killed and report.converged, report.describe()
+        rehydration = report.rehydration
+        assert rehydration is not None, report.describe()
+        # WAL/status reconciliation per kill point: only a post-wal
+        # death leaves a WAL'd-but-unlaunched task to re-issue
+        if report.kill.kind == "post-wal":
+            assert rehydration["reissued"] >= 1, report.describe()
+        else:
+            assert rehydration["reissued"] == 0, report.describe()
+        assert rehydration["double_reservations"] == 0, report.describe()
+
+
+# -- process-level failover e2e (serve --ha) --------------------------
+
+
+HA_PROCESS_YAML = """
+name: hasvc
+pods:
+  app:
+    count: 3
+    placement: 'max-per-host:1'
+    tasks:
+      server:
+        goal: RUNNING
+        cmd: "echo serving > out.txt && sleep 180"
+        cpus: 0.1
+        memory: 32
+"""
+
+
+@pytest.mark.slow
+def test_serve_ha_standby_takes_over_on_leader_sigkill(tmp_path):
+    """THE runner-level failover e2e: two real `serve --ha` scheduler
+    processes against a real state server and real agent daemons.
+    The standby BLOCKS as a candidate while the leader lives; the
+    leader is SIGKILLed mid-deploy (with the plan interrupted, so the
+    takeover provably resumes operator state); the standby takes the
+    lease within ~TTL, re-hydrates — adopting the running task, not
+    restarting it — restores the interrupt, and completes the rollout
+    after `plan continue`."""
+    import json as _json
+    import urllib.request
+
+    from dcos_commons_tpu.testing.integration import (
+        AgentProcess,
+        SchedulerProcess,
+        _read_announce,
+        reap_orphan_tasks,
+        start_state_server,
+    )
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    agents = [
+        AgentProcess(f"h{i}", str(tmp_path / f"agent-{i}"), repo)
+        for i in range(3)
+    ]
+    state = sched_a = sched_b = None
+    state_log = None
+    try:
+        svc = tmp_path / "svc.yml"
+        svc.write_text(HA_PROCESS_YAML)
+        topology = tmp_path / "topology.yml"
+        lines = ["hosts:"]
+        for agent in agents:
+            lines += [
+                f"  - host_id: {agent.host_id}",
+                f"    agent_url: {agent.url}",
+                "    cpus: 4.0",
+                "    memory_mb: 8192",
+            ]
+        topology.write_text("\n".join(lines) + "\n")
+        state, state_url, state_log = start_state_server(
+            str(tmp_path / "state"), repo
+        )
+        env = {"ENABLE_BACKOFF": "false", "STATE_LEASE_TTL_S": "2"}
+        sched_a = SchedulerProcess(
+            str(svc), str(topology), str(tmp_path / "sched-a"),
+            env=env, repo_root=repo,
+            extra_args=["--state-url", state_url, "--ha"],
+        )
+        # the standby parks in candidate acquire(): its API (and
+        # announce file) only appear AFTER it wins the lease
+        sched_b = SchedulerProcess(
+            str(svc), str(topology), str(tmp_path / "sched-b"),
+            env=env, repo_root=repo,
+            extra_args=["--state-url", state_url, "--ha"],
+            wait_listening=False,
+        )
+        client_a = sched_a.client()
+        client_a.wait_for_task_state(
+            "app-0-server", "TASK_RUNNING", timeout_s=60
+        )
+        running_ids = client_a.task_ids()
+        client_a.post("/v1/plans/deploy/interrupt")
+        assert client_a.plan_status("deploy") != "COMPLETE"
+        body = client_a.get("/v1/debug/ha")
+        assert body["is_leader"] is True and body["lease_epoch"] == 1
+        assert sched_b.process.poll() is None, "standby exited early"
+
+        sched_a.process.kill()  # SIGKILL: no resign, the TTL does it
+        sched_a.process.wait(timeout=10)
+
+        url_b = _read_announce(
+            os.path.join(sched_b.workdir, "announce"), timeout_s=60
+        )
+        sched_b.url = url_b
+        client_b = sched_b.client()
+        # the API comes up before the loop's first cycle: poll until
+        # the re-hydration pass has run
+        from dcos_commons_tpu.testing.integration import wait_for
+
+        body = wait_for(
+            lambda: (lambda b: b if "last_rehydration" in b else None)(
+                client_b.get("/v1/debug/ha")
+            ),
+            timeout_s=30, what="standby re-hydration",
+        )
+        assert body["is_leader"] is True
+        assert body["lease_epoch"] == 2, body
+        assert body["last_rehydration"]["adopted"] >= 1, body
+        # the operator's interrupt survived the failover
+        assert client_b.plan_status("deploy") in ("WAITING", "IN_PROGRESS")
+        plan = client_b.get("/v1/plans/deploy")
+        assert plan["status"] != "COMPLETE"
+        client_b.post("/v1/plans/deploy/continue")
+        client_b.wait_for_completed_deployment(timeout_s=120)
+        # adopted, not restarted: the pre-failover task kept its id
+        final_ids = client_b.task_ids()
+        for name, task_id in running_ids.items():
+            if task_id:
+                assert final_ids.get(name) == task_id, (name, task_id)
+        # a deposed leader never came back: exactly one claimant
+        with urllib.request.urlopen(url_b + "/v1/metrics",
+                                    timeout=10) as resp:
+            metrics = _json.loads(resp.read())
+        assert metrics["ha.is_leader"] == 1.0
+        assert metrics["ha.failovers_total"] == 1.0
+    finally:
+        for sched in (sched_a, sched_b):
+            if sched is not None:
+                sched.terminate()
+        reap_orphan_tasks(agents)
+        for agent in agents:
+            agent.stop()
+        if state is not None and state.poll() is None:
+            state.terminate()
+            state.wait(timeout=10)
+        if state_log is not None:
+            state_log.close()
+
+
+# -- observability: /v1/debug/ha + gauges + the failover chain --------
+
+
+def test_debug_ha_route_and_gauges():
+    clock = FakeClock()
+    persister = MemPersister()
+    lease = LeaderLease(persister, "hasvc", "sched-a", ttl_s=30,
+                        clock=clock)
+    assert lease.try_acquire()
+    sched = _build_world(persister, FakeAgent(), lease)
+    api = SchedulerApi(sched)
+
+    code, body = api.debug_ha()
+    assert code == 200 and body["enabled"] is True
+    assert body["is_leader"] is True
+    assert body["lease_epoch"] == 1
+    assert body["leader"]["owner"] == "sched-a"
+    assert body["leader"]["live"] is True
+    assert 0 < body["leader"]["expires_in_s"] <= 30
+    assert body["failovers_total"] == 0
+    assert body["fenced_writes_rejected"] == 0
+
+    sched.run_cycle()
+    code, body = api.debug_ha()
+    assert body["last_rehydration"]["adopted"] == 0
+
+    snapshot = sched.metrics.snapshot()
+    assert snapshot["ha.is_leader"] == 1.0
+    assert snapshot["ha.lease_epoch"] == 1.0
+    assert snapshot["ha.failovers_total"] == 0.0
+
+    # the route is wired (not just the query method)
+    from dcos_commons_tpu.http.server import build_routes
+
+    patterns = [route[1].pattern for route in build_routes(api)]
+    assert any("/v1/debug/ha" in p for p in patterns)
+
+    # a scheduler without HA wiring reports disabled (never 500s)
+    plain = _build_world(MemPersister(), FakeAgent())
+    plain.run_cycle()
+    code, body = SchedulerApi(plain).debug_ha()
+    assert code == 200 and body["enabled"] is False
+    assert "last_rehydration" in body
+
+
+def test_failover_reads_as_one_correlation_chain():
+    """election.promote -> rehydrate.replay share one trace id: the
+    operator sees the takeover and what it replayed as ONE chain in
+    /v1/debug/trace, both formats."""
+    clock = FakeClock()
+    persister = MemPersister()
+    agent = FakeAgent()
+    acked: set = set()
+
+    lease_a = LeaderLease(persister, "hasvc", "sched-a", ttl_s=5,
+                          clock=clock)
+    assert lease_a.try_acquire()
+    sched_a = _build_world(persister, agent, lease_a)
+    sched_a.run_cycle()
+    _ack_running(agent, acked)
+    sched_a.run_cycle()
+
+    clock.advance(6.0)
+    lease_b = LeaderLease(persister, "hasvc", "sched-b", ttl_s=5,
+                          clock=clock)
+    assert lease_b.try_acquire()
+    sched_b = _build_world(persister, agent, lease_b)
+    sched_b.run_cycle()
+
+    spans = sched_b.tracer.snapshot()
+    promotes = [s for s in spans if s.name == "election.promote"]
+    assert promotes, [s.name for s in spans]
+    replays = [s for s in spans if s.name == "rehydrate.replay"]
+    assert replays, [s.name for s in spans]
+    assert replays[0].trace_id == promotes[-1].trace_id
+    assert replays[0].parent_id == promotes[-1].span_id
+    assert replays[0].attrs["adopted"] >= 1
+
+    # a clean handover records its resign too
+    lease_b.resign()
+    assert any(
+        s.name == "election.resign" for s in sched_b.tracer.snapshot()
+    )
+
+    # both export formats carry the chain
+    from dcos_commons_tpu.trace.export import to_chrome, to_text
+
+    text = to_text(sched_b.tracer, service="hasvc")
+    assert "election.promote" in text and "rehydrate.replay" in text
+    chrome = to_chrome(sched_b.tracer, service="hasvc")
+    names = {e["name"] for e in chrome["traceEvents"]}
+    assert "election.promote" in names and "rehydrate.replay" in names
+    assert "election.resign" in names
+
+
+def test_ha_state_replication_lag_gauges():
+    """Against a real primary/standby state-server pair, HAState
+    surfaces per-puller replication lag as gauges and standby
+    watermarks in the /v1/debug/ha body."""
+    from dcos_commons_tpu.metrics.registry import Metrics
+    from dcos_commons_tpu.storage.remote import RemotePersister, StateServer
+
+    primary = StateServer(MemPersister()).start()
+    standby = StateServer(
+        MemPersister(), replicate_from=primary.url
+    ).start()
+    try:
+        client = RemotePersister(primary.url)
+        client.set("/svc/a", b"1")
+        import time as _time
+
+        deadline = _time.monotonic() + 10
+        while _time.monotonic() < deadline:
+            status = client._call("/v1/repl/status", {})
+            if status["standby_attached"] and not status["standby_lagging"]:
+                break
+            _time.sleep(0.05)
+        ha = HAState(client, "hasvc")
+        ha._metrics = Metrics()
+        body = ha.describe(refresh=True)
+        assert body["replication"]["role"] == "primary"
+        assert body["replication"]["standbys"], body
+        (puller_id, watermark), = body["replication"]["standbys"].items()
+        assert watermark["lag"] == 0
+        snapshot = ha._metrics.snapshot()
+        assert snapshot[f"ha.replication.lag.{puller_id}"] == 0.0
+    finally:
+        standby.stop()
+        primary.stop()

@@ -321,6 +321,67 @@ def test_rule_span_leak(tmp_path):
     assert not findings and len(suppressed) == 1
 
 
+def test_rule_lease_gated_mutation(tmp_path):
+    src = """
+    class FrameworkRunner:
+        def _store_options(self, payload):
+            self._persister.set("/options", payload)
+
+        def _wipe(self, backend):
+            backend.recursive_delete("/svc")
+            backend.apply([])
+    """
+    findings, _ = _lint_fixture(
+        tmp_path, src, rel="dcos_commons_tpu/runtime/runner.py",
+        rule_id="lease-gated-mutation",
+    )
+    assert len(findings) == 3
+    # reads and non-persister receivers are out of scope
+    ok = """
+    class FrameworkRunner:
+        def read_side(self):
+            self._persister.get("/options")
+            self._persister.get_children("/svc")
+            self._stop.set()          # an Event, not a persister
+
+        def through_the_store(self, options):
+            OptionsStore(self._persister).store(options)
+    """
+    findings, _ = _lint_fixture(
+        tmp_path, ok, rel="dcos_commons_tpu/runtime/runner.py",
+        rule_id="lease-gated-mutation",
+    )
+    assert not findings
+    # store modules, the fence itself, and non-scheduler paths are
+    # exempt (raw mutations are their JOB)
+    for exempt_rel in (
+        "dcos_commons_tpu/multi/store.py",
+        "dcos_commons_tpu/ha/election.py",
+        "dcos_commons_tpu/state/state_store.py",
+        "dcos_commons_tpu/storage/cache.py",
+        "dcos_commons_tpu/testing/chaos.py",
+    ):
+        findings, _ = _lint_fixture(
+            tmp_path, src, rel=exempt_rel,
+            rule_id="lease-gated-mutation",
+        )
+        assert not findings, exempt_rel
+    # a deliberate raw write carries an explaining suppression
+    suppressed_src = src.replace(
+        'self._persister.set("/options", payload)',
+        'self._persister.set("/options", payload)  '
+        "# sdklint: disable=lease-gated-mutation — pre-lease bootstrap",
+    ).replace('backend.recursive_delete("/svc")\n', "").replace(
+        "backend.apply([])", "pass"
+    )
+    findings, suppressed = _lint_fixture(
+        tmp_path, suppressed_src,
+        rel="dcos_commons_tpu/runtime/runner.py",
+        rule_id="lease-gated-mutation",
+    )
+    assert not findings and len(suppressed) == 1
+
+
 def test_file_level_suppression(tmp_path):
     src = (
         "# sdklint: disable-file=no-blocking-sleep — tick harness\n"

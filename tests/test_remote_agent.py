@@ -86,6 +86,46 @@ def test_daemon_launch_drain_roundtrip(daemons):
     assert client.sandbox_file("app-0-server", "out.txt").strip() == "hi"
 
 
+def test_daemon_reconcile_rearms_drained_statuses(daemons):
+    """Explicit reconciliation over the wire (the HA failover hook):
+    a status drained by a dead scheduler is re-delivered — with its
+    earned readiness — after POST /v1/agent/reconcile, via the client
+    AND the fleet fan-out."""
+    daemon = daemons("h0")
+    client = RemoteAgentClient("h0", daemon.url)
+    info = TaskInfo(
+        name="app-0-server",
+        task_id="app-0-server__1",
+        agent_id="h0",
+        command="sleep 30",
+    )
+    client.launch([{"info": info.to_dict()}])
+    states = []
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        states += [s for s in client.drain() if s.state.is_running]
+        if states:
+            break
+        time.sleep(0.05)
+    assert states, "task never reported RUNNING"
+    # drained: a plain re-drain has nothing (edge-triggered)
+    assert not [s for s in client.drain() if s.state.is_running]
+    # the successor scheduler reconciles: RUNNING re-delivers
+    client.reconcile()
+    redelivered = [s for s in client.drain() if s.state.is_running]
+    assert [s.task_id for s in redelivered] == ["app-0-server__1"]
+    assert redelivered[0].ready  # no readiness check: ready rides along
+    # the fleet fan-out reaches every daemon (and the Reconciler's
+    # getattr hook finds it)
+    fleet = RemoteFleet()
+    fleet.add_host("h0", daemon.url)
+    fleet.reconcile()
+    assert [
+        s.task_id for s in fleet.poll() if s.state.is_running
+    ] == ["app-0-server__1"]
+    fleet.kill("app-0-server__1")
+
+
 def test_daemon_renders_templates_before_launch(daemons):
     daemon = daemons("h0")
     client = RemoteAgentClient("h0", daemon.url)
