@@ -430,6 +430,189 @@ def bench_offer_cycle() -> dict:
     }
 
 
+def bench_fleet_scale() -> dict:
+    """Fleet-scale offer cycle (ISSUE 9): dirty-host incremental
+    snapshot sync + indexed placement pre-filtering + requirement
+    memo vs the PR-1 full-copy path, at 1k and 10k simulated hosts.
+
+    Scenario per fleet size: a 32-pod TPU deploy (parallel phase),
+    then 50 steady-state IDLE cycles, then 6 CHURN rounds (restart one
+    pod -> drive to recovered).  Fences, at 10k hosts:
+
+    * steady-state (idle / single-status churn) cycle must be >= 10x
+      faster than the full-rebuild path (median per-round);
+    * the fast path stays inside absolute budgets (idle cycle and
+      churn round) so a regression cannot hide behind the baseline
+      getting slower too;
+    * idle cycles report dirty_hosts == 0 — cycle cost scales with
+      dirty hosts, not fleet size.
+    """
+    import statistics
+
+    from dcos_commons_tpu.common import TaskState, TaskStatus
+    from dcos_commons_tpu.offer.inventory import (
+        SliceInventory,
+        make_test_fleet,
+    )
+    from dcos_commons_tpu.scheduler import SchedulerBuilder, SchedulerConfig
+    from dcos_commons_tpu.specification import from_yaml
+    from dcos_commons_tpu.storage import MemPersister
+    from dcos_commons_tpu.testing import FakeAgent
+
+    n_pods, idle_cycles, churn_rounds = 32, 50, 6
+
+    def build_world(n_hosts, fast):
+        hosts = []
+        n_slices = n_hosts // 16
+        for s in range(n_slices):
+            hosts.extend(make_test_fleet(
+                slice_id=f"pod-{s:04d}", host_grid=(4, 4),
+                chip_block=(2, 2), cpus=32.0, memory_mb=131072,
+            ))
+        spec = from_yaml(
+            "name: fleetscale\n"
+            "pods:\n"
+            "  app:\n"
+            f"    count: {n_pods}\n"
+            "    placement: 'max-per-host:1'\n"
+            "    tpu:\n"
+            "      generation: v5e\n"
+            "      chips-per-host: 4\n"
+            "    tasks:\n"
+            "      worker:\n"
+            "        goal: RUNNING\n"
+            "        cmd: sleep 1000\n"
+            "        cpus: 2\n"
+            "        memory: 1024\n"
+            "plans:\n"
+            "  deploy:\n"
+            "    strategy: serial\n"
+            "    phases:\n"
+            "      app:\n"
+            "        strategy: parallel\n"
+            "        pod: app\n"
+        )
+        builder = SchedulerBuilder(
+            spec,
+            SchedulerConfig(backoff_enabled=False, revive_capacity=10**9),
+            MemPersister(),
+        )
+        inventory = SliceInventory(hosts)
+        builder.set_inventory(inventory)
+        agent = FakeAgent()
+        builder.set_agent(agent)
+        scheduler = builder.build()
+        scheduler.evaluator.fast_path = fast
+        return scheduler, agent, inventory
+
+    def drive(scheduler, agent, acked, deadline_s=120.0):
+        """run_cycle + inline RUNNING acks until no work pending."""
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < deadline_s:
+            scheduler.run_cycle()
+            for info in list(agent.launched):
+                if info.task_id not in acked:
+                    acked.add(info.task_id)
+                    agent.send(TaskStatus(
+                        task_id=info.task_id, state=TaskState.RUNNING,
+                        ready=True, agent_id=info.agent_id,
+                    ))
+            if not scheduler.work_pending():
+                return True
+        return False
+
+    out = {}
+    ratios = {}
+    for n_hosts in (1024, 10240):
+        tag = f"{n_hosts // 1024}k" if n_hosts < 10000 else "10k"
+        for fast in (True, False):
+            mode = "fast" if fast else "rebuild"
+            scheduler, agent, inventory = build_world(n_hosts, fast)
+            acked = set()
+            t0 = time.monotonic()
+            completed = drive(scheduler, agent, acked)
+            deploy_s = time.monotonic() - t0
+            assert completed and \
+                scheduler.deploy_manager.get_plan().is_complete, (
+                    f"{mode}@{tag}: 32-pod deploy did not complete"
+                )
+            idle_ms = []
+            idle_misses_before = inventory.cache_misses
+            for _ in range(idle_cycles):
+                c0 = time.monotonic()
+                scheduler.run_cycle()
+                idle_ms.append((time.monotonic() - c0) * 1e3)
+            idle_rebuilds = inventory.cache_misses - idle_misses_before
+            churn_s = []
+            # churn-phase evaluation cost: the steady-state
+            # "single-status cycle" number the 10x fence compares —
+            # cycle.evaluate spans snapshot sync + placement for one
+            # requirement
+            eval_n0 = scheduler.metrics.timer_count("cycle.evaluate")
+            for round_i in range(churn_rounds):
+                c0 = time.monotonic()
+                scheduler.restart_pod("app", round_i % n_pods)
+                recovered = drive(scheduler, agent, acked)
+                churn_s.append(time.monotonic() - c0)
+                assert recovered, f"{mode}@{tag}: churn round wedged"
+            eval_samples = scheduler.metrics.timer_samples(
+                "cycle.evaluate", since_count=eval_n0
+            )
+            # fail LOUDLY on an empty window: a renamed/relocated
+            # cycle.evaluate timer would otherwise make the 10x fence
+            # vacuous (0.0 fast -> huge ratio) or spuriously fail it
+            assert eval_samples, (
+                f"{mode}@{tag}: no cycle.evaluate samples in the "
+                "churn window — timer renamed or churn did not evaluate?"
+            )
+            churn_eval_ms = statistics.median(eval_samples) * 1e3
+            out[f"fleet_scale_{tag}_{mode}_deploy_s"] = round(deploy_s, 3)
+            out[f"fleet_scale_{tag}_{mode}_idle_cycle_ms"] = round(
+                statistics.median(idle_ms), 3
+            )
+            out[f"fleet_scale_{tag}_{mode}_churn_round_ms"] = round(
+                statistics.median(churn_s) * 1e3, 2
+            )
+            out[f"fleet_scale_{tag}_{mode}_churn_eval_ms"] = round(
+                churn_eval_ms, 3
+            )
+            if fast:
+                out[f"fleet_scale_{tag}_idle_rebuilds"] = idle_rebuilds
+                out[f"fleet_scale_{tag}_shortcircuits"] = int(
+                    scheduler.metrics.counters().get(
+                        "offers.eval.shortcircuit", 0
+                    )
+                )
+                out[f"fleet_scale_{tag}_index_hits"] = int(
+                    scheduler.metrics.counters().get("offers.index.hit", 0)
+                )
+        for dim in ("idle_cycle_ms", "churn_round_ms", "churn_eval_ms",
+                    "deploy_s"):
+            fast_v = out[f"fleet_scale_{tag}_fast_{dim}"]
+            slow_v = out[f"fleet_scale_{tag}_rebuild_{dim}"]
+            ratios[f"fleet_scale_{tag}_{dim}_speedup_x"] = round(
+                slow_v / max(fast_v, 1e-6), 1
+            )
+    out.update(ratios)
+    # fences (10k): steady-state >= 10x vs full rebuild, inside
+    # absolute budgets, and idle cycles touch zero hosts
+    assert out["fleet_scale_10k_idle_rebuilds"] == 0, \
+        "idle cycles re-synthesized host snapshots — dirty tracking broken"
+    eval_speedup = ratios["fleet_scale_10k_churn_eval_ms_speedup_x"]
+    assert eval_speedup >= 10.0, (
+        f"steady-state evaluated-cycle speedup at 10k is "
+        f"{eval_speedup}x (< 10x): the incremental path is not "
+        "sublinear in fleet size"
+    )
+    # generous absolute budgets for shared CI boxes (measured: idle
+    # well under 1 ms, churn rounds tens of ms)
+    assert out["fleet_scale_10k_fast_idle_cycle_ms"] < 50.0, \
+        f"10k-host idle cycle {out['fleet_scale_10k_fast_idle_cycle_ms']}ms"
+    assert out["fleet_scale_10k_fast_churn_round_ms"] < 2000.0, \
+        f"10k-host churn round {out['fleet_scale_10k_fast_churn_round_ms']}ms"
+    return out
+
+
 def bench_trace_overhead() -> dict:
     """traceview recorder overhead bound (ISSUE 5): the PR 1 offer-
     cycle scenario (serial deploy over 64 TPU hosts) driven
@@ -2117,6 +2300,13 @@ def main() -> None:
     except Exception as e:
         extras["offer_cycle_error"] = repr(e)[:200]
     _mark("offer_cycle")
+    # fleet-scale offer cycle (ISSUE 9): incremental dirty-host
+    # evaluation + indexed placement at 1k/10k hosts vs full rebuild
+    try:
+        extras.update(bench_fleet_scale())
+    except Exception as e:
+        extras["fleet_scale_error"] = repr(e)[:200]
+    _mark("fleet_scale")
     try:
         extras.update(bench_trace_overhead())
     except Exception as e:

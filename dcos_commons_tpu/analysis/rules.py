@@ -139,14 +139,19 @@ class NoBlockingSleepRule(Rule):
 
 
 class LedgerMutationRule(Rule):
-    """``SliceInventory.snapshots`` reuses cached per-host snapshots
-    while ``ReservationLedger.host_generation`` is unchanged (the PR-1
-    fast path), so host state may only change through methods that
-    bump the generation counter — a mutation that skips the bump
-    serves stale offers forever.  Two checks: public methods of the
-    two classes that mutate tracked host state must write the
-    generation attribute in the same method, and no code anywhere may
-    write those internals through a non-``self`` receiver."""
+    """``SliceInventory``'s per-view snapshot caches, inverted field
+    indexes, and free-chip buckets are all synced off the generation
+    counters (``ReservationLedger._generation`` / per-host journal,
+    ``SliceInventory._topology_gen``), so host state may only change
+    through methods that bump the generation counter — a mutation
+    that skips the bump serves stale offers AND stale placement
+    candidates forever (an index that silently diverges from the
+    ledger mis-routes every future placement).  Two checks: public
+    methods of the two classes that mutate tracked host state must
+    write the generation attribute in the same method, and no code
+    anywhere may write the cache/index internals through a
+    non-``self`` receiver — index maintenance goes through the
+    generation-bumping mutators, full stop."""
 
     id = "ledger-mutation"
     description = "ledger/inventory host state mutated without a generation bump"
@@ -155,14 +160,20 @@ class LedgerMutationRule(Rule):
         "ReservationLedger": (
             {"_cache", "_by_host", "_by_task", "_host_gen"}, "_generation",
         ),
-        "SliceInventory": ({"_hosts", "_down"}, "_topology_gen"),
+        "SliceInventory": (
+            {"_hosts", "_down", "_host_topo_gen"}, "_topology_gen",
+        ),
     }
     # every tracked attr plus the generation counters and the snapshot
-    # cache: writable through `self` inside the owning class only
+    # cache / index structures: writable through `self` inside the
+    # owning class only
     _INTERNALS = (
         {attr for attrs, _ in _TRACKED.values() for attr in attrs}
         | {gen for _, gen in _TRACKED.values()}
-        | {"_snap_cache"}
+        | {
+            "_view_caches", "_field_indexes", "_ordinal_cache",
+            "_up_ids_cache", "_hosts_by_id",
+        }
     )
 
     def check(self, ctx: LintContext) -> List[Finding]:
@@ -222,7 +233,10 @@ class LedgerMutationRule(Rule):
             )
         for target in targets:
             base = target
-            if isinstance(base, ast.Subscript):
+            # unwrap nested subscripts: index maintenance writes like
+            # inv._field_indexes['zone']['z'] = ... are still writes
+            # to the internal
+            while isinstance(base, ast.Subscript):
                 base = base.value
             if (
                 isinstance(base, ast.Attribute)

@@ -811,6 +811,39 @@ def _make_anchor(lines: Sequence[str]):
     return anchor
 
 
+def pod_task_mesh_env(pod, task) -> dict:
+    """The one env→mesh contract shared with the admission gate
+    (multi/admission.py): the task's env overlaid with the pod's tpu
+    mesh env, exactly what the launch path hands the worker."""
+    env = dict(task.env)
+    env.update(pod.tpu.mesh_env())
+    return env
+
+
+def declared_chips(pod) -> int:
+    """Chips the spec reserves for ONE workload: the whole gang for
+    gang/topology pods, one instance's host chips otherwise.  Shared
+    with the admission gate so the two enforcement points can never
+    drift."""
+    tpu = pod.tpu
+    return (
+        tpu.total_chips * max(tpu.slices, 1)
+        if pod.gang or tpu.topology else tpu.chips_per_host
+    )
+
+
+def mesh_span_message(where: str, declared: int, total: int,
+                      laid_by: str) -> str:
+    """The shard-mesh reserved-vs-laid mismatch text, shared by CI and
+    admission."""
+    return (
+        f"{where}: the spec reserves {declared} chip(s) but "
+        f"{laid_by} spans {total} — "
+        + ("reserved chips sit idle" if declared > total
+           else "the workload cannot get the chips it lays")
+    )
+
+
 def _analyze_pod_task(
     rel: str, pod, task, builder, anchor, vocab,
     hbm_mb: int, giant_mb: float, reports_out: List[ShardReport],
@@ -820,8 +853,7 @@ def _analyze_pod_task(
     tpu = pod.tpu
     line = anchor(pod.type)
     where = f"pod {pod.type!r} task {task.name!r}"
-    env = dict(task.env)
-    env.update(tpu.mesh_env())
+    env = pod_task_mesh_env(pod, task)
     try:
         workload = builder(env, tpu, pod, task)
     except SpecError as e:
@@ -837,20 +869,12 @@ def _analyze_pod_task(
         )]
     findings: List[Finding] = []
 
-    # the chips the spec reserves for ONE workload: the whole gang for
-    # gang/topology pods, one instance's host chips otherwise
-    declared = (
-        tpu.total_chips * max(tpu.slices, 1)
-        if pod.gang or tpu.topology else tpu.chips_per_host
-    )
+    declared = declared_chips(pod)
     if workload.mesh.total != declared:
         findings.append(Finding(
             rel, line, "shard-mesh",
-            f"{where}: the spec reserves {declared} chip(s) but "
-            f"{workload.script}'s mesh spans {workload.mesh.total} — "
-            + ("reserved chips sit idle"
-               if declared > workload.mesh.total
-               else "the workload cannot get the chips it lays"),
+            mesh_span_message(where, declared, workload.mesh.total,
+                              f"{workload.script}'s mesh"),
         ))
 
     leaf_reports, problems = _check_workload(workload, vocab)

@@ -109,31 +109,79 @@ def analyze_framework(
 def _analyze_yaml(
     path: str, root: str, env: Dict[str, str], host_model: HostModel
 ) -> List[Finding]:
-    from dcos_commons_tpu.specification.specs import SpecError
     from dcos_commons_tpu.specification.yaml_spec import from_yaml_file
 
     rel = os.path.relpath(path, root).replace(os.sep, "/")
     with open(path, "r", encoding="utf-8") as f:
         lines = f.read().splitlines()
+    spec, render_error = render_spec(rel, lambda: from_yaml_file(path, env))
+    return check_spec_lines(rel, lines, spec, render_error, host_model)
 
+
+def render_spec(rel: str, render):
+    """Run one spec-render callable, classifying failures into the
+    ``spec-render`` Finding shape BOTH enforcement points share — the
+    CI walker above and the admission gate (multi/admission.py).  One
+    classifier, so a future special-cased exception type cannot give
+    CI and a 422 body divergent wordings for the same failure."""
+    from dcos_commons_tpu.specification.specs import SpecError
+
+    try:
+        return render(), None
+    except SpecError as e:
+        return None, Finding(rel, 1, "spec-render", str(e))
+    except Exception as e:
+        return None, Finding(
+            rel, 1, "spec-render", f"{type(e).__name__}: {e}"
+        )
+
+
+def check_spec_lines(
+    rel: str,
+    lines: Sequence[str],
+    spec,
+    render_error: Optional[Finding] = None,
+    host_model=None,
+    apply_suppressions: bool = True,
+    feasibility_hint: str = " (--host-cpus/--host-mem/--host-disk to raise)",
+) -> List[Finding]:
+    """Every spec-level check over an ALREADY-RENDERED spec + its
+    source lines.  Shared by the CI walker above and the dynamic
+    add-service admission gate (multi/admission.py) — one rule set,
+    two enforcement points.  ``host_model`` may be one HostModel (the
+    CI walker's hypothetical fleet) or a LIST of them (admission's
+    real per-host shapes): a pod is infeasible only when it fits NONE
+    of them — per-dimension maxima across different hosts would admit
+    specs no single host can run.  An EMPTY list means the fleet is
+    unknown (admission with no up hosts): feasibility is skipped
+    entirely rather than judged against the CI default shape.
+    ``apply_suppressions=False`` is the admission gate's setting:
+    suppression comments live in the operator-submitted payload
+    there, so honoring them would let any payload waive its own
+    rejection.  ``feasibility_hint`` tails the spec-resources message
+    so each enforcement point names its own remediation."""
+    if host_model is None:
+        host_models = [HostModel()]
+    elif isinstance(host_model, HostModel):
+        host_models = [host_model]
+    else:
+        host_models = list(host_model)
     raw_findings: List[Finding] = []
     raw_findings += _check_gpus_keys(rel, lines)
-    spec = None
-    try:
-        spec = from_yaml_file(path, env)
-    except SpecError as e:
-        raw_findings.append(Finding(rel, 1, "spec-render", str(e)))
-    except Exception as e:
-        raw_findings.append(Finding(
-            rel, 1, "spec-render", f"{type(e).__name__}: {e}"
-        ))
+    if render_error is not None:
+        raw_findings.append(render_error)
     if spec is not None:
         anchor = _make_anchor(lines)
         raw_findings += _check_validators(rel, spec)
         raw_findings += _check_placement(rel, spec, anchor)
         raw_findings += _check_ports(rel, spec, anchor)
         raw_findings += _check_plans(rel, spec, anchor)
-        raw_findings += _check_resources(rel, spec, host_model, anchor)
+        if host_models:
+            raw_findings += _check_resources(
+                rel, spec, host_models, anchor, feasibility_hint
+            )
+    if not apply_suppressions:
+        return raw_findings
     suppressions = Suppressions(lines)
     return [f for f in raw_findings if not suppressions.covers(f)]
 
@@ -375,7 +423,8 @@ def _check_plans(rel: str, spec, anchor) -> List[Finding]:
 
 
 def _check_resources(
-    rel: str, spec, host_model: HostModel, anchor
+    rel: str, spec, host_models: Sequence[HostModel], anchor,
+    hint: str = "",
 ) -> List[Finding]:
     out = []
     for pod in spec.pods:
@@ -391,19 +440,27 @@ def _check_resources(
                     vol_by_path.get(vol.container_path, 0), vol.size_mb
                 )
         disk += sum(vol_by_path.values())
-        over = []
-        if cpus > host_model.cpus:
-            over.append(f"cpus {cpus} > {host_model.cpus}")
-        if mem > host_model.memory_mb:
-            over.append(f"memory {mem}MB > {host_model.memory_mb}MB")
-        if disk > host_model.disk_mb:
-            over.append(f"disk {disk}MB > {host_model.disk_mb}MB")
-        if over:
+        # feasible iff SOME host shape fits every dimension; report
+        # the closest fit's shortfalls when none does
+        best_over: Optional[List[str]] = None
+        for model in host_models:
+            over = []
+            if cpus > model.cpus:
+                over.append(f"cpus {cpus} > {model.cpus}")
+            if mem > model.memory_mb:
+                over.append(f"memory {mem}MB > {model.memory_mb}MB")
+            if disk > model.disk_mb:
+                over.append(f"disk {disk}MB > {model.disk_mb}MB")
+            if not over:
+                best_over = None
+                break
+            if best_over is None or len(over) < len(best_over):
+                best_over = over
+        if best_over:
             out.append(Finding(
                 rel, anchor(pod.type), "spec-resources",
                 f"pod {pod.type!r}: one instance needs "
-                + ", ".join(over)
-                + " — exceeds any single host "
-                "(--host-cpus/--host-mem/--host-disk to raise)",
+                + ", ".join(best_over)
+                + " — exceeds any single host" + hint,
             ))
     return out

@@ -515,7 +515,34 @@ class SchedulerApi:
     # -- debug (reference: debug/*.java, /v1/debug) -------------------
 
     def debug_offers(self) -> Response:
-        return 200, self._scheduler.outcome_tracker.to_json()
+        """Offer outcomes PLUS the fleet-scale evaluation state: the
+        dirty-set size and cache hit rates of the incremental snapshot
+        sync, index cardinalities, the requirement-memo/index counters
+        and this service's suppress state — the first read in a
+        slow-cycle triage (operations-guide)."""
+        counters = self._scheduler.metrics.counters()
+        evaluation: Dict[str, Any] = {}
+        inventory = getattr(self._scheduler, "inventory", None)
+        if inventory is not None and hasattr(inventory, "debug_stats"):
+            evaluation = inventory.debug_stats()
+        evaluation["counters"] = {
+            key: counters[key]
+            for key in (
+                "offers.index.hit", "offers.index.scan",
+                "offers.eval.shortcircuit", "offers.evaluated",
+                "offers.declined", "suppresses", "revives",
+            )
+            if key in counters
+        }
+        # multi-service offer discipline: which services the fan-out
+        # loop is currently skipping (attached by MultiServiceScheduler)
+        discipline = getattr(self._scheduler, "offer_discipline", None)
+        if callable(discipline):
+            evaluation["discipline"] = discipline()
+        return 200, {
+            "outcomes": self._scheduler.outcome_tracker.to_json(),
+            "evaluation": evaluation,
+        }
 
     def debug_plans(self) -> Response:
         return 200, {

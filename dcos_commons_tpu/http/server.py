@@ -262,6 +262,11 @@ class ApiServer:
                             return 400, {
                                 "message": f"bad X-Service-Options: {e}"
                             }
+                    from dcos_commons_tpu.multi.admission import (
+                        AdmissionError,
+                        validate_service_yaml,
+                    )
+
                     try:
                         if "gzip" in ctype or body[:2] == b"\x1f\x8b":
                             multi_scheduler.install_package(
@@ -279,17 +284,29 @@ class ApiServer:
                                 "message": "options apply to package "
                                            "installs (gzip body) only",
                             }
-                        from dcos_commons_tpu.specification.yaml_spec import (
-                            from_yaml,
+                        # admission control: the CI analyzers run as
+                        # production guardrails BEFORE ServiceStore
+                        # persists anything; a rejected spec returns
+                        # 422 with line-anchored findings, an admitted
+                        # one is stored unchanged
+                        spec, findings = validate_service_yaml(
+                            body.decode("utf-8"), name,
+                            inventory=getattr(
+                                multi_scheduler, "inventory", None
+                            ),
                         )
-
-                        spec = from_yaml(body.decode("utf-8"))
-                        if spec.name != name:
-                            return 400, {
-                                "message": f"spec name {spec.name!r} does "
-                                           f"not match URL {name!r}"
-                            }
+                        if findings:
+                            raise AdmissionError(findings)
                         multi_scheduler.add_service(spec)
+                    except AdmissionError as e:
+                        return 422, {
+                            "message": f"spec rejected by admission "
+                                       f"control ({len(e.findings)} "
+                                       "finding(s))",
+                            "findings": [
+                                f.to_dict() for f in e.findings
+                            ],
+                        }
                     except Exception as e:
                         return 400, {"message": str(e)}
                     return 200, {"message": f"service {name} added"}

@@ -30,6 +30,7 @@ class Metrics:
         self._counters: Dict[str, float] = {}
         self._gauges: Dict[str, Callable[[], float]] = {}
         self._timers: Dict[str, list] = {}
+        self._timer_totals: Dict[str, int] = {}
         self._lock = threading.Lock()
         self._statsd: Optional[socket.socket] = None
         self._statsd_addr = None
@@ -68,6 +69,9 @@ class Metrics:
                 with registry._lock:
                     registry._timers.setdefault(name, []).append(elapsed)
                     del registry._timers[name][:-256]  # ring buffer
+                    registry._timer_totals[name] = (
+                        registry._timer_totals.get(name, 0) + 1
+                    )
                 if registry._statsd is not None:
                     # timers push like counters do (reference:
                     # Metrics.getTimer — StatsD timing datagrams in
@@ -86,6 +90,26 @@ class Metrics:
     def counters(self) -> Dict[str, float]:
         with self._lock:
             return dict(self._counters)
+
+    def timer_count(self, name: str) -> int:
+        """Total recordings of one timer since process start — NOT
+        capped by the 256-sample ring, so callers can window samples
+        across a phase boundary without index drift."""
+        with self._lock:
+            return self._timer_totals.get(name, 0)
+
+    def timer_samples(self, name: str, since_count: int = 0) -> list:
+        """Copy of the retained samples (newest-last, last 256) for
+        one timer, optionally only those recorded after a prior
+        ``timer_count()`` reading.  When the ring has trimmed past the
+        requested boundary, returns what survives — the newest
+        samples, which is what phase-window callers want."""
+        with self._lock:
+            samples = list(self._timers.get(name, ()))
+            fresh = self._timer_totals.get(name, 0) - since_count
+        if fresh <= 0:
+            return []
+        return samples[-fresh:] if fresh < len(samples) else samples
 
     def snapshot(self) -> Dict[str, float]:
         out = self.counters()

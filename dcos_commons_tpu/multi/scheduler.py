@@ -76,25 +76,31 @@ class _ServiceAgentAdapter:
 
 class _MergedLedgerView:
     """Union view over every service's reservation ledger, handed to
-    SliceInventory.snapshots so one service's free-capacity view
-    excludes every other service's claims."""
+    SliceInventory snapshot sync so one service's free-capacity view
+    excludes every other service's claims.
+
+    Implements the incremental-sync protocol (generation_token /
+    changed_hosts_since): any service's commit/GC — or a service
+    appearing/disappearing — changes the composite token, and the
+    dirty set is the union of every member ledger's dirty set, so a
+    10k-host fleet re-synthesizes only the hosts someone touched."""
 
     def __init__(self, multi: "MultiServiceScheduler"):
         self._multi = multi
-        self._pass_items = None
+        self._items_cache = None
+        self._items_version = -1
 
     def _items(self):
-        # a snapshots() pass calls host_generation once per host; the
-        # per-pass snapshot (prepare_pass) avoids paying the services()
-        # lock/copy per host on the hot path
-        if self._pass_items is not None:
-            return self._pass_items
-        return sorted(self._multi.services().items())
-
-    def prepare_pass(self) -> None:
-        """Called by SliceInventory.snapshots at the start of a pass:
-        capture the service set once for all per-host token reads."""
-        self._pass_items = sorted(self._multi.services().items())
+        # memoized on the multi's service-set version: a full sync
+        # pass calls host_generation once per host, and re-taking the
+        # services lock + copy + sort per HOST would be O(hosts x
+        # services) — the version counter keeps it one sort per
+        # service add/remove/rebuild
+        version = self._multi.services_version
+        if self._items_cache is None or self._items_version != version:
+            self._items_cache = sorted(self._multi.services().items())
+            self._items_version = version
+        return self._items_cache
 
     def reserved_on(self, host_id: str):
         out = []
@@ -103,14 +109,45 @@ class _MergedLedgerView:
         return out
 
     def host_generation(self, host_id: str):
-        """Composite change token for the snapshot cache: the set of
-        (service, per-host ledger generation) pairs.  Any service's
-        commit/GC on the host — or a service appearing/disappearing —
-        changes the token; compared only by equality."""
+        """Composite per-host change token (legacy full-pass path):
+        (service, ledger epoch, per-host generation) triples, compared
+        only by equality — the epoch keeps a rebuilt service's rebased
+        generations from aliasing a stale token."""
         return tuple(
-            (name, service.ledger.host_generation(host_id))
+            (
+                name,
+                getattr(service.ledger, "epoch", ""),
+                service.ledger.host_generation(host_id),
+            )
             for name, service in self._items()
         )
+
+    def generation_token(self):
+        """Composite whole-view token: each member ledger's own
+        (epoch, generation) token — any commit/GC anywhere, a service
+        set change, or a service REBUILD (fresh ledger object over
+        the same tree) makes it compare unequal."""
+        return tuple(
+            (name, service.ledger.generation_token())
+            for name, service in self._items()
+        )
+
+    def changed_hosts_since(self, token):
+        if not isinstance(token, tuple):
+            return None
+        items = self._items()
+        old = dict(token)
+        if len(old) != len(token) or set(old) != {n for n, _ in items}:
+            # a service appeared or disappeared: its claims (dis)appear
+            # on hosts no member journal will report — all dirty
+            return None
+        out = set()
+        for name, service in items:
+            changed = service.ledger.changed_hosts_since(old[name])
+            if changed is None:
+                return None
+            out |= changed
+        return out
 
 
 class MultiServiceScheduler:
@@ -138,6 +175,9 @@ class MultiServiceScheduler:
         self.framework_store = FrameworkStore(persister)
         self._builder_hook = builder_hook
         self._services: Dict[str, object] = {}  # name -> scheduler
+        # bumped on every service add/remove/rebuild; the merged
+        # ledger view memoizes its sorted service list on it
+        self._services_version = 0
         # merged orphan sweep goes through a TaskKiller so lost kill
         # requests are retried and acked like every other kill
         self.task_killer = TaskKiller(agent)
@@ -146,6 +186,12 @@ class MultiServiceScheduler:
         # process fatal for supervised restart
         self.max_consecutive_failures = 5
         self._cycle_failures: Dict[str, int] = {}
+        # per-service offer discipline (reference: suppress/revive,
+        # framework/ReviveManager.java): a service whose plans hold no
+        # pending/in-flight work after its cycle is SUPPRESSED —
+        # skipped entirely by run_cycle — until a routed status or a
+        # nudge() (HTTP mutation) revives it
+        self._suppressed_services: set = set()
         self._fatal_error: Optional[str] = None
         self._lock = threading.RLock()
         self._stop = threading.Event()
@@ -177,6 +223,7 @@ class MultiServiceScheduler:
                 self._services[name] = self._make_uninstaller(spec)
             else:
                 self._services[name] = self._build(spec)
+            self._services_version += 1
 
     def add_service(self, spec: ServiceSpec,
                     options: Optional[dict] = None) -> None:
@@ -193,6 +240,8 @@ class MultiServiceScheduler:
                 spec.name, spec.to_dict(), options=options
             )
             self._services[spec.name] = built
+            self._services_version += 1
+            self._suppressed_services.discard(spec.name)
         self.nudge()  # deploy work just became pending
 
     @property
@@ -244,6 +293,48 @@ class MultiServiceScheduler:
         if not _re.fullmatch(r"[A-Za-z0-9][A-Za-z0-9._-]*", name) or \
                 name in (".", ".."):
             raise SpecError(f"invalid service name {name!r}")
+        # admission's mesh derivation imports jax lazily, and a COLD
+        # import under the lock below would freeze every service's
+        # cycles for seconds (run_cycle takes the same lock).  Peek at
+        # the payload's svc.yml straight from the tar stream OUTSIDE
+        # the lock (no throwaway extraction: a CPU-only deployment
+        # would otherwise pay a full double-extract on EVERY install,
+        # since its guard never becomes true) and warm the import
+        # first; malformed payloads fail properly inside the locked
+        # path.
+        import sys as _sys
+
+        if "dcos_commons_tpu.parallel.mesh" not in _sys.modules:
+            try:
+                import io as _io
+                import tarfile as _tarfile
+
+                svc_text = ""
+                with _tarfile.open(
+                    fileobj=_io.BytesIO(payload), mode="r:gz"
+                ) as tar:
+                    for member in tar.getmembers():
+                        if _os.path.basename(member.name) == "svc.yml":
+                            handle = tar.extractfile(member)
+                            if handle is not None:
+                                svc_text = handle.read().decode(
+                                    "utf-8", errors="replace"
+                                )
+                            break
+                from dcos_commons_tpu.multi.admission import _targets_jax
+
+                # warm for ANY tpu: pod, not just recognizably
+                # jax-targeting cmds: the peek reads UNRENDERED
+                # YAML, and a templated cmd ("python {{SCRIPT}}")
+                # would otherwise defeat it — mesh derivation only
+                # runs for tpu pods, so this over-approximates
+                # exactly the set that can need the import
+                if _targets_jax(svc_text) or _re.search(
+                    r"^\s*tpu\s*:", svc_text, _re.M
+                ):
+                    import dcos_commons_tpu.parallel.mesh  # noqa: F401
+            except Exception:  # sdklint: disable=swallowed-exception — warm-up only; the locked path re-raises real failures with their findings
+                pass
         # the whole exists-check -> extract -> commit -> register
         # sequence holds the lock: the API server is threaded, and two
         # concurrent PUTs for one name must not interleave their
@@ -319,6 +410,26 @@ class MultiServiceScheduler:
                         f"package {manifest['name']!r} defines service "
                         f"{spec.name!r}, not {name!r}"
                     )
+                # admission control on the rendered package spec: the
+                # CI analyzers gate the dynamic path too.  Runs while
+                # everything is still STAGED — a rejected package
+                # leaves no trace on disk or in the store.
+                from dcos_commons_tpu.multi.admission import (
+                    AdmissionError,
+                    check_rendered_spec,
+                )
+
+                with open(
+                    _os.path.join(staging, "svc.yml"),
+                    "r", encoding="utf-8",
+                ) as f:
+                    svc_lines = f.read().splitlines()
+                findings = check_rendered_spec(
+                    f"{name}/svc.yml", svc_lines, spec,
+                    inventory=self.inventory,
+                )
+                if findings:
+                    raise AdmissionError(findings)
                 # VERSIONED final location: upgrades never delete the
                 # dir a still-active (or kept-after-rejected-diff)
                 # target config's templates live in — a rejected v2
@@ -353,6 +464,8 @@ class MultiServiceScheduler:
                     name, spec.to_dict(), options=effective_options
                 )
                 self._services[name] = rebuilt
+                self._services_version += 1
+                self._suppressed_services.discard(name)
                 # prune superseded version dirs: repeated upgrades
                 # otherwise grow state_dir without bound.  Keep the new
                 # target plus every dir any STORED config still
@@ -398,15 +511,40 @@ class MultiServiceScheduler:
             self._services[name] = self._make_uninstaller(
                 ServiceSpec.from_dict(entry["spec"])
             )
+            self._services_version += 1
+            self._suppressed_services.discard(name)
         self.nudge()  # teardown work just became pending
 
     def get_service(self, name: str):
         with self._lock:
             return self._services.get(name)
 
+    @property
+    def services_version(self) -> int:
+        """Monotonic counter of service add/remove/rebuild events
+        (merged-view memoization key)."""
+        return self._services_version
+
     def services(self) -> Dict[str, object]:
         with self._lock:
             return dict(self._services)
+
+    def suppress_state(self, name: Optional[str] = None) -> Dict[str, object]:
+        """Per-service offer-discipline state for /v1/debug/offers:
+        which services are currently suppressed (skipped by
+        run_cycle), optionally focused on one service.  Called from
+        HTTP threads while run_cycle mutates the set — the C-level
+        set() copy is atomic under the GIL, so sorting can never see
+        a mid-mutation resize."""
+        snapshot = set(self._suppressed_services)
+        out: Dict[str, object] = {
+            "suppressed_services": sorted(snapshot),
+            "total_services": len(self._services),
+        }
+        if name is not None:
+            out["service"] = name
+            out["suppressed"] = name in snapshot
+        return out
 
     def service_names(self) -> List[str]:
         with self._lock:
@@ -440,6 +578,16 @@ class MultiServiceScheduler:
         # orphan sweeps would kill siblings' tasks, so the multi loop
         # runs ONE merged sweep instead (_kill_merged_orphans)
         scheduler.kill_orphaned_tasks = False
+        # offer-discipline observability: every service's metrics
+        # snapshot and /v1/debug/offers can show the fleet's suppress
+        # state (len() on a set is atomic under the GIL)
+        scheduler.metrics.gauge(
+            "cycle.suppressed_services",
+            lambda: float(len(self._suppressed_services)),
+        )
+        scheduler.offer_discipline = (
+            lambda name=spec.name: self.suppress_state(name)
+        )
         if self.ha_state is not None:
             # one process-wide election; every service serves it at
             # its own /v1/debug/ha and exports the ha.* gauges
@@ -468,14 +616,29 @@ class MultiServiceScheduler:
     def run_cycle(self) -> None:
         with self._lock:
             services = dict(self._services)
-            self._route_statuses(services)
+            revived = self._route_statuses(services)
+            # offer discipline: a suppressed service is skipped
+            # entirely — no status intake (it got none), no candidate
+            # scan, no GC — unless a status arrival or nudge() revived
+            # it.  take_nudge() is only CONSUMED here, so a nudge
+            # racing the post-cycle suppress decision is never lost.
+            runnable: Dict[str, object] = {}
+            for name, service in services.items():
+                if (
+                    isinstance(service, DefaultScheduler)
+                    and name in self._suppressed_services
+                    and name not in revived
+                    and not service.take_nudge()
+                ):
+                    continue
+                runnable[name] = service
             growing = [
                 name
-                for name, s in services.items()
+                for name, s in runnable.items()
                 if isinstance(s, DefaultScheduler) and self._is_growing(s)
             ]
             selected = self.discipline.select(growing)
-            for name, service in services.items():
+            for name, service in runnable.items():
                 try:
                     if isinstance(service, DefaultScheduler):
                         service.run_cycle(
@@ -483,10 +646,21 @@ class MultiServiceScheduler:
                                 name in selected or name not in growing
                             )
                         )
+                        if service.work_pending():
+                            self._suppressed_services.discard(name)
+                        else:
+                            self._suppressed_services.add(name)
                     else:
                         service.run_cycle()
                     self._cycle_failures[name] = 0
                 except Exception as exc:
+                    # a failed cycle must leave the service RUNNABLE:
+                    # its revive trigger (nudge/status) was already
+                    # consumed this cycle, so staying suppressed here
+                    # would skip it forever — silently dropping the
+                    # operator verb and making the wedge detection
+                    # below unreachable
+                    self._suppressed_services.discard(name)
                     failures = self._cycle_failures.get(name, 0) + 1
                     self._cycle_failures[name] = failures
                     LOG.exception(
@@ -508,6 +682,8 @@ class MultiServiceScheduler:
                         service.is_complete:
                     self.service_store.remove(name)
                     del self._services[name]
+                    self._services_version += 1
+                    self._suppressed_services.discard(name)
                     LOG.info("service %s uninstalled and removed", name)
 
     def _kill_merged_orphans(self, services: Dict[str, object]) -> None:
@@ -525,12 +701,16 @@ class MultiServiceScheduler:
             LOG.info("killing orphaned task %s (no owning service)", task_id)
             self.task_killer.kill(task_id)
 
-    def _route_statuses(self, services: Dict[str, object]) -> None:
+    def _route_statuses(self, services: Dict[str, object]) -> set:
         """Poll the shared agent once and deliver each status to the
         service whose stored TaskInfo owns the task id; unroutable
-        statuses go to every service (their stale guards drop them)."""
+        statuses go to every service (their stale guards drop them).
+        Returns the names of services that received a delivery — a
+        status arrival REVIVES a suppressed service (it must never
+        miss work its own tasks caused)."""
         from dcos_commons_tpu.common import task_name_of
 
+        revived: set = set()
         for status in self.agent.poll():
             self.task_killer.handle_status(status)
             try:
@@ -540,15 +720,16 @@ class MultiServiceScheduler:
                 continue
             routed = False
             holders = []  # services holding a TaskInfo under this name
-            for service in services.values():
+            for name, service in services.items():
                 info = service.state_store.fetch_task(task_name)
                 if info is None:
                     continue
                 if info.task_id == status.task_id:
                     service.agent.deliver(status)
+                    revived.add(name)
                     routed = True
                     break
-                holders.append(service)
+                holders.append((name, service))
             if routed:
                 continue
             # no exact id owner: deliver only to services that hold a
@@ -557,12 +738,14 @@ class MultiServiceScheduler:
             # nodes in services that never owned the task, which can
             # later wedge their uninstall kill-all
             if holders:
-                for service in holders:
+                for name, service in holders:
                     service.agent.deliver(status)
+                    revived.add(name)
             else:
                 LOG.info(
                     "dropped status for unknown task %s", status.task_id
                 )
+        return revived
 
     @staticmethod
     def _is_growing(scheduler: DefaultScheduler) -> bool:

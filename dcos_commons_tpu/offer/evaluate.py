@@ -89,8 +89,7 @@ class EvaluationContext:
         self._state_store = state_store
         self._inventory = inventory
         self._tasks: Optional[List[TaskInfo]] = None
-        self._hosts: Optional[Dict[str, object]] = None
-        self._hosts_token: Optional[int] = None
+        self._task_index: Optional[Dict[str, Dict[str, List[TaskInfo]]]] = None
 
     def tasks(self) -> List[TaskInfo]:
         if self._tasks is None:
@@ -98,13 +97,23 @@ class EvaluationContext:
         return self._tasks
 
     def hosts(self) -> Dict[str, object]:
-        token = self._inventory.topology_generation
-        if self._hosts is None or self._hosts_token != token:
-            self._hosts = {
-                h.host_id: h for h in self._inventory.hosts()
-            }
-            self._hosts_token = token
-        return self._hosts
+        # cached on the inventory itself (keyed to its topology
+        # generation) so every context of every cycle shares one dict
+        # instead of rebuilding a fleet-sized map per cycle
+        return self._inventory.hosts_by_id()
+
+    def task_index(self) -> Dict[str, Dict[str, List[TaskInfo]]]:
+        """pod_type -> instance key -> task infos, built once per
+        cycle: PlacementContext counts come from this instead of a
+        per-requirement scan over the whole task list."""
+        if self._task_index is None:
+            idx: Dict[str, Dict[str, List[TaskInfo]]] = {}
+            for info in self.tasks():
+                idx.setdefault(info.pod_type, {}).setdefault(
+                    f"{info.pod_type}-{info.pod_index}", []
+                ).append(info)
+            self._task_index = idx
+        return self._task_index
 
     def note_launched(self, infos: List[TaskInfo]) -> None:
         """Mirror ``StateStore.store_tasks`` semantics on the cached
@@ -115,12 +124,14 @@ class EvaluationContext:
         self._tasks = [
             t for t in self._tasks if t.name not in names
         ] + list(infos)
+        self._task_index = None
 
     def invalidate_tasks(self) -> None:
         """Drop the cached task scan after a mid-cycle state mutation
         this context cannot mirror (e.g. an ActionStep erasing tasks);
         the next evaluation re-fetches."""
         self._tasks = None
+        self._task_index = None
 
 
 @dataclass
@@ -170,12 +181,36 @@ class OfferEvaluator:
         # traceview flight recorder (set by the scheduler alongside
         # metrics); hand-wired evaluators default to the no-op recorder
         self.tracer = None
+        # fleet-scale fast path: shared copy-on-write snapshots,
+        # indexed placement pre-filtering, and the per-requirement
+        # failure memo.  False = the PR-1 behavior (per-host snapshot
+        # copies, full candidate scans) — the reference oracle the
+        # equivalence tests and bench_fleet_scale compare against.
+        self.fast_path = True
+        # requirement-name -> (change token, failed result, pod spec):
+        # a requirement that failed against an unchanged fleet/ledger/
+        # task set short-circuits without re-scanning.  The pod spec
+        # object is held so identity comparison can never alias a
+        # recycled id() from a superseded config.
+        self._memo: Dict[tuple, tuple] = {}
 
     def set_target_config(self, config_id: str) -> None:
         self._target_config_id = config_id
+        self._memo.clear()
 
     def set_snapshot_view(self, view) -> None:
         self._snapshot_view = view
+        self._memo.clear()
+
+    def invalidate_memo(self) -> None:
+        """Drop memoized requirement outcomes after a state mutation
+        the change tokens cannot see (e.g. an ActionStep erasing
+        tasks mid-cycle)."""
+        self._memo.clear()
+
+    def _incr(self, name: str) -> None:
+        if self.metrics is not None:
+            self.metrics.incr(name)
 
     # ------------------------------------------------------------------
 
@@ -220,29 +255,86 @@ class OfferEvaluator:
                 )
             return result
 
+    def _memo_token(self, inventory: SliceInventory):
+        """Change token guarding the requirement-failure memo: the
+        snapshot view's whole-ledger token plus the topology
+        generation.  None disables memoization (view has no token)."""
+        token_fn = getattr(self._snapshot_view, "generation_token", None)
+        view_token = token_fn() if token_fn is not None else None
+        if view_token is None:
+            return None
+        return (view_token, inventory.topology_generation)
+
     def _evaluate_requirement(
         self,
         requirement: PodInstanceRequirement,
         inventory: SliceInventory,
         context: EvaluationContext,
     ) -> EvaluationResult:
+        token = self._memo_token(inventory) if self.fast_path else None
+        memo_key = None
+        if token is not None:
+            memo_key = (
+                requirement.name,
+                tuple(requirement.instances),
+                tuple(requirement.tasks_to_launch),
+                requirement.recovery_type,
+            )
+            hit = self._memo.get(memo_key)
+            if hit is not None and hit[0] == token \
+                    and hit[2] is requirement.pod:
+                # prior outcome was computed against an unchanged
+                # candidate set: short-circuit without re-scanning
+                self._incr("offers.eval.shortcircuit")
+                return hit[1]
         timer = (
             self.metrics.time("cycle.snapshot")
             if self.metrics is not None else contextlib.nullcontext()
         )
+        index = None
         with timer:
-            snapshots = inventory.snapshots(self._snapshot_view)
+            if self.fast_path:
+                index = inventory.offer_view(self._snapshot_view)
+                snapshots = index.ordered_snapshots()
+            else:
+                snapshots = inventory.snapshots(self._snapshot_view)
         excluded = set(requirement.task_names())
-        ctx = PlacementContext(
-            pod_type=requirement.pod.type,
-            existing_tasks=[
-                t
-                for t in context.tasks()
-                # tasks being relaunched must not block their own placement
-                if t.name not in excluded
-            ],
-            hosts=context.hosts(),
+        if index is not None:
+            ctx = PlacementContext(
+                pod_type=requirement.pod.type,
+                hosts=context.hosts(),
+                task_index=context.task_index(),
+                excluded_names=frozenset(excluded),
+            )
+        else:
+            ctx = PlacementContext(
+                pod_type=requirement.pod.type,
+                existing_tasks=[
+                    t
+                    for t in context.tasks()
+                    # tasks being relaunched must not block their own
+                    # placement
+                    if t.name not in excluded
+                ],
+                hosts=context.hosts(),
+            )
+        result = self._evaluate_placed(
+            requirement, inventory, snapshots, ctx, index
         )
+        if memo_key is not None and not result.passed:
+            # only failures memoize: a pass consumes capacity and is
+            # never legitimately replayed
+            self._memo[memo_key] = (token, result, requirement.pod)
+        return result
+
+    def _evaluate_placed(
+        self,
+        requirement: PodInstanceRequirement,
+        inventory: SliceInventory,
+        snapshots,
+        ctx: PlacementContext,
+        index,
+    ) -> EvaluationResult:
 
         # In-place relaunch: reuse committed reservations when they are
         # still valid (reference: existing-pod pipeline reusing prior
@@ -297,8 +389,12 @@ class OfferEvaluator:
 
             rule = AndRule([VolumeProfilesRule(profiles), rule])
         if pod.gang and pod.tpu is not None and pod.tpu.topology:
-            return self._evaluate_gang(requirement, snapshots, rule, ctx)
-        return self._evaluate_instances(requirement, snapshots, rule, ctx)
+            return self._evaluate_gang(
+                requirement, snapshots, rule, ctx, index
+            )
+        return self._evaluate_instances(
+            requirement, snapshots, rule, ctx, index
+        )
 
     # -- reuse path ----------------------------------------------------
 
@@ -515,6 +611,7 @@ class OfferEvaluator:
         snapshots: List[ResourceSnapshot],
         rule: PlacementRule,
         ctx: PlacementContext,
+        index=None,
     ) -> EvaluationResult:
         pod = requirement.pod
         scalar_needs = _pod_scalar_needs(pod, requirement.tasks_to_launch)
@@ -530,6 +627,55 @@ class OfferEvaluator:
                     f"insufficient cpu/mem/disk for {scalar_needs}",
                 )
             return EvaluationOutcome.ok(f"host:{snap.host.host_id}")
+
+        if index is not None:
+            # torus-neighborhood pre-filter: a contiguous rectangle of
+            # tx*ty chips needs hosts_needed FULLY-FREE hosts inside
+            # one slice, so slices short of that can be skipped before
+            # any anchor search.  The whole slice's hosts (not just
+            # the free ones) are forwarded — the anchor grid's extent
+            # must come from slice membership, never the free subset.
+            total_chips = 1
+            for d in pod.tpu.topology_dims():
+                total_chips *= d
+            # per-slice host need comes from the HOSTS' chip blocks
+            # (find_subslice tiles by host block, not by the spec's
+            # declared chips-per-host — a mis-declared spec must not
+            # under-approximate here).  Max block area among the
+            # slice's free hosts keeps the filter superset-sound when
+            # blocks are mixed (mixed slices fail the search anyway).
+            hosts = ctx.hosts
+            eligible_slices = set()
+            # the "" bucket (TPU hosts registered without a slice id)
+            # is a searchable slice like any other — find_subslice
+            # groups such hosts under slice "" and can place a gang
+            # there, so skipping it would under-approximate
+            for s, free in index.fully_free_by_slice().items():
+                if not free:
+                    continue
+                area = max(
+                    (
+                        hosts[h].chips_per_host
+                        for h in free if h in hosts
+                    ),
+                    default=0,
+                )
+                if area <= 0:
+                    continue
+                if len(free) >= max(1, -(-total_chips // area)):
+                    eligible_slices.add(s)
+            if eligible_slices:
+                slice_index = index.value_index("slice")
+                candidate_ids: set = set()
+                for s in eligible_slices:
+                    candidate_ids |= slice_index.get(s, frozenset())
+                self._incr("offers.index.hit")
+                snapshots = index.snapshots_for(candidate_ids)
+            else:
+                # nothing can place: run the UNFILTERED search so the
+                # outcome tree explains every slice's refusal (the
+                # requirement memo keeps repeat failures O(1))
+                self._incr("offers.index.scan")
 
         # multi-slice gangs (tpu: slices: N): N slice-local sub-gangs,
         # one contiguous `topology` rectangle in each of N DISTINCT
@@ -580,7 +726,7 @@ class OfferEvaluator:
 
         reservations: List[Reservation] = []
         task_infos: List[TaskInfo] = []
-        for worker_id, (index, snap) in enumerate(
+        for worker_id, (index_i, snap) in enumerate(
             zip(requirement.instances, ordered)
         ):
             work = snap.copy()
@@ -599,7 +745,7 @@ class OfferEvaluator:
                     ENV_TPU_NUM_SLICES: str(n_slices),
                 }
             res, infos = self._claim_instance(
-                requirement, index, work, chips, coordinator,
+                requirement, index_i, work, chips, coordinator,
                 coordinator_here=(worker_id == 0), worker_id=worker_id,
                 extra_env=slice_env,
             )
@@ -620,17 +766,41 @@ class OfferEvaluator:
         snapshots: List[ResourceSnapshot],
         rule: PlacementRule,
         ctx: PlacementContext,
+        index=None,
     ) -> EvaluationResult:
         """Non-gang: place each instance independently, first host wins
-        (reference: first fully-passing offer, OfferEvaluator.java:137-171)."""
+        (reference: first fully-passing offer, OfferEvaluator.java:137-171).
+
+        Indexed path: the rule emits a candidate host-id SET which is
+        intersected with the free-chip-count bucket BEFORE any
+        snapshot is touched; candidates iterate in scan-order so the
+        winner is identical to a full scan.  Recomputed per instance —
+        each placement updates the counts the rules consult."""
         pod = requirement.pod
         reservations: List[Reservation] = []
         task_infos: List[TaskInfo] = []
         root = EvaluationOutcome.ok("evaluate", pod.type)
         claimed_hosts: Dict[str, ResourceSnapshot] = {}
-        for index in requirement.instances:
+        for index_i in requirement.instances:
+            scan = snapshots
+            if index is not None:
+                cand = rule.candidate_host_ids(ctx, index)
+                if pod.tpu is not None:
+                    chip_ok = index.hosts_with_free_chips(
+                        pod.tpu.chips_per_host
+                    )
+                    cand = chip_ok if cand is None else cand & chip_ok
+                if cand:
+                    self._incr("offers.index.hit")
+                    scan = index.snapshots_for(cand)
+                else:
+                    # unbounded rule (None) — or an EMPTY candidate
+                    # set, where the full scan runs so the outcome
+                    # tree explains every host's refusal (the
+                    # requirement memo keeps repeat failures O(1))
+                    self._incr("offers.index.scan")
             placed = False
-            for snap in snapshots:
+            for snap in scan:
                 snap = claimed_hosts.get(snap.host.host_id, snap)
                 rule_outcome = rule.filter(snap, ctx)
                 if not rule_outcome.passed:
@@ -653,8 +823,8 @@ class OfferEvaluator:
                         ))
                         continue
                 res, infos = self._claim_instance(
-                    requirement, index, work, chips or [], coordinator="",
-                    coordinator_here=False, worker_id=index,
+                    requirement, index_i, work, chips or [], coordinator="",
+                    coordinator_here=False, worker_id=index_i,
                 )
                 if res is None:
                     root.children.append(EvaluationOutcome.fail(
@@ -670,12 +840,12 @@ class OfferEvaluator:
                 placed = True
                 root.children.append(EvaluationOutcome.ok(
                     f"host:{snap.host.host_id}",
-                    f"{pod.type}-{index} placed",
+                    f"{pod.type}-{index_i} placed",
                 ))
                 break
             if not placed:
                 root.passed = False
-                root.reason = f"no host satisfies {pod.type}-{index}"
+                root.reason = f"no host satisfies {pod.type}-{index_i}"
                 return EvaluationResult(False, root)
         return EvaluationResult(True, root, reservations, task_infos)
 

@@ -76,6 +76,13 @@ class ResourceSnapshot:
     Reference: offer/MesosResourcePool.java.  Mutated by evaluation
     stages as they claim resources; commit/rollback is handled by the
     evaluator working on copies (gang evaluation is all-or-nothing).
+
+    Copy-on-write contract (fleet-scale fast path): the inventory's
+    per-view caches hand out ``shared`` masters that are reused across
+    requirements WITHOUT copying; reading them is free, but a caller
+    that wants to consume must ``copy()`` first — the mutators raise
+    on a shared snapshot so a forgotten clone fails loudly instead of
+    silently poisoning every later evaluation.
     """
 
     def __init__(
@@ -93,6 +100,7 @@ class ResourceSnapshot:
         self.disk_mb = disk_mb
         self.free_chips = set(free_chips)
         self.used_ports = set(used_ports)
+        self.shared = False
 
     def copy(self) -> "ResourceSnapshot":
         return ResourceSnapshot(
@@ -100,9 +108,17 @@ class ResourceSnapshot:
             set(self.free_chips), set(self.used_ports),
         )
 
+    def _writable(self) -> None:
+        if self.shared:
+            raise RuntimeError(
+                f"shared snapshot for {self.host.host_id!r}: copy() "
+                "before mutating (copy-on-write contract)"
+            )
+
     # -- consumption (evaluation stages call these) -------------------
 
     def try_consume_scalar(self, cpus: float, memory_mb: int, disk_mb: int) -> bool:
+        self._writable()
         if self.cpus + 1e-9 < cpus or self.memory_mb < memory_mb \
                 or self.disk_mb < disk_mb:
             return False
@@ -112,6 +128,7 @@ class ResourceSnapshot:
         return True
 
     def try_consume_chips(self, count: int) -> Optional[List[str]]:
+        self._writable()
         if len(self.free_chips) < count:
             return None
         taken = sorted(self.free_chips)[:count]
@@ -120,6 +137,7 @@ class ResourceSnapshot:
 
     def allocate_port(self, requested: int = 0) -> Optional[int]:
         """Fixed port if requested, else next free dynamic port."""
+        self._writable()
         if requested:
             if requested in self.used_ports:
                 return None
@@ -133,6 +151,117 @@ class ResourceSnapshot:
         return None
 
 
+def host_field(host: TpuHost, field_name: str) -> str:
+    """The ONE host-field accessor shared by placement rules and the
+    inverted indexes — a rule and the index it pre-filters through
+    must read the same value or candidates silently diverge."""
+    if field_name == "hostname":
+        return host.hostname
+    if field_name == "zone":
+        return host.zone
+    if field_name == "region":
+        return host.region
+    if field_name == "generation":
+        return host.generation
+    if field_name == "slice":
+        return host.slice_id
+    return host.attributes.get(field_name, "")
+
+
+class _ViewCache:
+    """Per-(inventory, ledger-view) snapshot cache — the dirty-host
+    incremental evaluation state.  One exists per view OBJECT, so a
+    multi-service scheduler alternating between its merged view and a
+    bare ledger no longer thrashes a single shared cache."""
+
+    __slots__ = (
+        "snaps", "tokens", "gen_token", "topo_gen", "ordered",
+        "free_chip_count", "fully_free_by_slice",
+    )
+
+    def __init__(self) -> None:
+        self.snaps: Dict[str, ResourceSnapshot] = {}  # host_id -> shared master
+        self.tokens: Dict[str, object] = {}           # host_id -> per-host token
+        self.gen_token: object = None                 # view token at last sync
+        self.topo_gen: int = -1
+        self.ordered: Optional[List[ResourceSnapshot]] = None
+        # ledger-dependent placement indexes, maintained with the
+        # snapshots they describe (a stale index would pre-filter
+        # against a fleet that no longer exists)
+        self.free_chip_count: Dict[str, int] = {}
+        self.fully_free_by_slice: Dict[str, Set[str]] = {}
+
+
+class HostIndex:
+    """Read-only index facade handed to placement pre-filtering: the
+    inventory's inverted field indexes (topology-keyed) plus one
+    view's chip-availability indexes (ledger-keyed).  Rules emit
+    candidate host-id SETS through this instead of filtering one
+    snapshot at a time."""
+
+    def __init__(self, inventory: "SliceInventory", cache: _ViewCache):
+        self._inventory = inventory
+        self._cache = cache
+
+    def universe(self) -> Set[str]:
+        """All up host ids (callers must not mutate)."""
+        return self._inventory._up_ids()
+
+    def hosts_with(self, field_name: str, value: str) -> Set[str]:
+        return self._inventory._field_index(field_name).get(value, _EMPTY)
+
+    def value_index(self, field_name: str) -> Dict[str, Set[str]]:
+        """value -> up host ids for one field (callers must not mutate)."""
+        return self._inventory._field_index(field_name)
+
+    def ordinal(self, host_id: str) -> int:
+        """Host's position in snapshot iteration order — candidates
+        sorted by this reproduce exactly the full-scan winner."""
+        return self._inventory._ordinals().get(host_id, 1 << 30)
+
+    def snapshot(self, host_id: str) -> Optional[ResourceSnapshot]:
+        return self._cache.snaps.get(host_id)
+
+    def ordered_snapshots(self) -> List[ResourceSnapshot]:
+        return self._inventory._ordered_snapshots(self._cache)
+
+    def snapshots_for(self, host_ids: Set[str]) -> List[ResourceSnapshot]:
+        """Shared snapshots for a candidate set, in scan order."""
+        up = self._inventory._up_ids()
+        if host_ids is up or len(host_ids) >= len(up):
+            # candidate sets are built from up-host indexes, so a
+            # full-cardinality set IS the universe — reuse the cached
+            # scan-order list instead of re-sorting the whole fleet
+            # per instance
+            return self.ordered_snapshots()
+        ordinals = self._inventory._ordinals()
+        snaps = self._cache.snaps
+        return [
+            snaps[h]
+            for h in sorted(host_ids, key=lambda h: ordinals.get(h, 1 << 30))
+            if h in snaps
+        ]
+
+    def hosts_with_free_chips(self, count: int) -> Set[str]:
+        """Up hosts with at least ``count`` chips unreserved under
+        this view (free-chip-count bucket query)."""
+        if count <= 0:
+            return self.universe()
+        return {
+            h for h, n in self._cache.free_chip_count.items() if n >= count
+        }
+
+    def fully_free_by_slice(self) -> Dict[str, Set[str]]:
+        """slice_id -> hosts whose entire chip block is unreserved —
+        the torus-neighborhood pre-filter (gang placement requires
+        fully-free hosts, offer/torus.py check())."""
+        return self._cache.fully_free_by_slice
+
+
+
+_EMPTY: Set[str] = frozenset()  # type: ignore[assignment]
+
+
 class SliceInventory:
     """The fleet: hosts + the reservation ledger's committed claims.
 
@@ -140,25 +269,43 @@ class SliceInventory:
     resources after subtracting every committed reservation.  This is
     the L0-replacement — where the reference waits for resourceOffers
     callbacks (FrameworkScheduler.java:196), our scheduler scans this.
-    """
+
+    Fleet-scale fast path: snapshots are cached PER VIEW and synced
+    incrementally — each pass asks the view which hosts changed since
+    the last sync (``changed_hosts_since``) and rebuilds exactly
+    those, so an idle 10k-host fleet pays an O(1) token compare, not
+    10k rebuild-or-copy decisions.  ``offer_view`` returns SHARED
+    copy-on-write masters; ``snapshots`` keeps the legacy
+    copy-per-host contract for direct callers."""
 
     def __init__(self, hosts: Optional[List[TpuHost]] = None):
         self._hosts: Dict[str, TpuHost] = {}
         self._down: Set[str] = set()
-        # snapshot cache (offer-cycle fast path): host_id -> (host
-        # object, ledger host-generation token, built snapshot).  An
-        # entry is valid while the exact host object is registered and
-        # the view reports the same per-host generation; callers get a
-        # copy, so the cached master is never mutated by evaluation.
-        self._snap_cache: Dict[str, tuple] = {}
-        # the view object itself is held (not its id()): id reuse
-        # after GC must never validate a stale cache
-        self._snap_view = None
+        # per-view snapshot caches: id(view) -> (view, _ViewCache).
+        # The view object itself is held (not just its id()): id reuse
+        # after GC must never validate a stale cache.
+        self._view_caches: Dict[int, tuple] = {}
         self.cache_hits = 0
         self.cache_misses = 0
-        # bumped on any host add/remove/up/down so per-cycle consumers
-        # (EvaluationContext's hosts dict) know when to rebuild
+        # dirty-host count of the most recent sync that found work
+        # (surfaced as the offers.dirty_hosts gauge)
+        self.last_dirty_hosts = 0
+        # bumped on any EFFECTIVE host add/remove/up/down so per-cycle
+        # consumers know when to rebuild; per-host change generations
+        # let view caches compute exactly which hosts moved
         self._topology_gen = 0
+        self._host_topo_gen: Dict[str, int] = {}
+        # inverted indexes over UP hosts (field value -> host ids),
+        # built lazily per field and discarded on topology change;
+        # _ordinal_cache maps host_id -> scan position
+        self._field_indexes: Dict[str, Dict[str, Set[str]]] = {}
+        self._index_gen = -1
+        self._ordinal_cache: Dict[str, int] = {}
+        self._ordinal_gen = -1
+        self._up_ids_cache: Optional[Set[str]] = None
+        self._up_ids_gen = -1
+        self._hosts_by_id: Optional[Dict[str, TpuHost]] = None
+        self._hosts_by_id_gen = -1
         for host in hosts or []:
             self.add_host(host)
 
@@ -166,27 +313,52 @@ class SliceInventory:
     def topology_generation(self) -> int:
         return self._topology_gen
 
+    # -- mutators (the ONLY writers of host state; each effective
+    # change bumps the generation so caches and indexes re-sync) ------
+
     def add_host(self, host: TpuHost) -> None:
         self._hosts[host.host_id] = host
-        self._snap_cache.pop(host.host_id, None)
         self._topology_gen += 1
+        self._host_topo_gen[host.host_id] = self._topology_gen
 
     def remove_host(self, host_id: str) -> None:
+        if host_id not in self._hosts:
+            return  # no-op: an unknown host must not dirty the fleet
         self._hosts.pop(host_id, None)
         self._down.discard(host_id)
-        self._snap_cache.pop(host_id, None)
         self._topology_gen += 1
+        self._host_topo_gen[host_id] = self._topology_gen
+        # journal compaction: removed hosts' stamps must outlive every
+        # view cache that hasn't observed the removal yet, so they are
+        # kept — but a months-long churny fleet must not accumulate
+        # them without bound.  Past 2x the live fleet, drop non-member
+        # stamps and clear the view caches outright: a from-scratch
+        # resync can never miss a pruned removal.
+        if len(self._host_topo_gen) > 2 * max(len(self._hosts), 512):
+            self._host_topo_gen = {
+                h: g for h, g in self._host_topo_gen.items()
+                if h in self._hosts
+            }
+            self._view_caches.clear()
 
     def mark_down(self, host_id: str) -> None:
         """Host lost/maintenance: excluded from snapshots (the TASK_LOST
         / PARTITION_AWARE analogue, SURVEY.md section 5.3)."""
-        if host_id in self._hosts:
+        if host_id in self._hosts and host_id not in self._down:
             self._down.add(host_id)
             self._topology_gen += 1
+            self._host_topo_gen[host_id] = self._topology_gen
 
     def mark_up(self, host_id: str) -> None:
-        self._down.discard(host_id)
-        self._topology_gen += 1
+        # no-op guard: re-marking an up (or unknown) host used to bump
+        # the generation anyway, invalidating every per-cycle hosts
+        # dict and dirtying the whole fleet for nothing
+        if host_id in self._down:
+            self._down.discard(host_id)
+            self._topology_gen += 1
+            self._host_topo_gen[host_id] = self._topology_gen
+
+    # -- queries ------------------------------------------------------
 
     def is_up(self, host_id: str) -> bool:
         return host_id in self._hosts and host_id not in self._down
@@ -200,44 +372,252 @@ class SliceInventory:
     def up_hosts(self) -> List[TpuHost]:
         return [h for h in self._hosts.values() if h.host_id not in self._down]
 
+    def hosts_by_id(self) -> Dict[str, TpuHost]:
+        """host_id -> host over the WHOLE fleet (incl. down hosts),
+        cached on the topology generation.  Callers must not mutate —
+        every evaluation context of a cycle shares this dict."""
+        gen = self._topology_gen
+        if self._hosts_by_id is None or self._hosts_by_id_gen != gen:
+            self._hosts_by_id = dict(self._hosts)
+            self._hosts_by_id_gen = gen
+        return self._hosts_by_id
+
+    # -- snapshots ----------------------------------------------------
+
     def snapshots(self, ledger: "ReservationLedgerView") -> List[ResourceSnapshot]:
-        """Synthesize the current offers, reusing cached per-host
-        snapshots while the ledger view's per-host generation is
-        unchanged.  A view without ``host_generation`` (or returning
-        None) disables caching for that host — correctness never
-        depends on the view being generation-aware."""
-        gen_of = getattr(ledger, "host_generation", None)
-        prepare = getattr(ledger, "prepare_pass", None)
-        if prepare is not None:
-            # composite views capture their member set once per pass
-            # instead of once per host
-            prepare()
-        if ledger is not self._snap_view:
-            # a different view object arbitrates now (e.g. the merged
-            # multi-service view replacing the bare ledger): its
-            # generations are not comparable with the cached tokens
-            self._snap_cache.clear()
-            self._snap_view = ledger
-        out = []
-        for host in self.up_hosts():
-            token = gen_of(host.host_id) if gen_of is not None else None
-            cached = self._snap_cache.get(host.host_id)
+        """Legacy contract: synthesize the current offers as MUTABLE
+        per-host copies.  Direct callers (tests, tools) may consume
+        them freely; the evaluator's fast path uses ``offer_view``."""
+        cache = self._sync_view(ledger)
+        return [s.copy() for s in self._ordered_snapshots(cache)]
+
+    def offer_view(self, ledger: "ReservationLedgerView") -> HostIndex:
+        """Sync this view's cache against the ledger + topology and
+        return the index facade over SHARED copy-on-write snapshots.
+        This is the per-requirement entry point: an unchanged fleet
+        costs one token compare, a changed one costs O(dirty hosts)."""
+        return HostIndex(self, self._sync_view(ledger))
+
+    def debug_stats(self) -> Dict[str, object]:
+        """Dirty-set / cache / index observability for
+        /v1/debug/offers (the slow-cycle triage surface).  Runs on
+        HTTP threads while the cycle thread mutates: the C-level
+        list()/dict() snapshots below are atomic under the GIL, so
+        iteration can never see a resize mid-flight."""
+        caches = list(self._view_caches.values())
+        field_indexes = dict(self._field_indexes)
+        return {
+            "topology_generation": self._topology_gen,
+            "hosts": len(self._hosts),
+            "up_hosts": len(self._up_ids()),
+            "last_dirty_hosts": self.last_dirty_hosts,
+            "snapshot_cache": {
+                "hits": self.cache_hits,
+                "misses": self.cache_misses,
+                "views": len(caches),
+                "entries": sum(len(c.snaps) for _, c in caches),
+            },
+            "index_cardinalities": {
+                f: len(ix) for f, ix in field_indexes.items()
+            },
+        }
+
+    # -- incremental sync (internal) ----------------------------------
+
+    # distinct live views are few (a service's ledger, the multi
+    # merged view); the bound only matters when views are RECREATED —
+    # live options updates swap the evaluator's ledger object, and
+    # each superseded view would otherwise pin a fleet-sized snapshot
+    # cache forever
+    _MAX_VIEW_CACHES = 8
+
+    def _sync_view(self, view: "ReservationLedgerView") -> _ViewCache:
+        key = id(view)
+        entry = self._view_caches.pop(key, None)
+        if entry is None or entry[0] is not view:
+            cache = _ViewCache()
+            while len(self._view_caches) >= self._MAX_VIEW_CACHES:
+                # LRU eviction: every sync re-inserts at the end, so
+                # the first key is the least-recently-synced view
+                self._view_caches.pop(next(iter(self._view_caches)))
+            self._view_caches[key] = (view, cache)
+        else:
+            # re-insert at the end (most-recently used)
+            self._view_caches[key] = entry
+            cache = entry[1]
+        token_fn = getattr(view, "generation_token", None)
+        token = token_fn() if token_fn is not None else None
+        if (
+            token is not None
+            and cache.gen_token == token
+            and cache.topo_gen == self._topology_gen
+        ):
+            # steady state: nothing changed anywhere — O(1)
+            self.cache_hits += len(cache.snaps)
+            self.last_dirty_hosts = 0
+            return cache
+        # which hosts moved?  Ledger side from the view's change
+        # journal (None = unknown -> per-host token compare), topology
+        # side from the per-host generation stamps.
+        changed: Optional[Set[str]] = None
+        if cache.gen_token is not None:
+            changed_fn = getattr(view, "changed_hosts_since", None)
+            if changed_fn is not None:
+                changed = changed_fn(cache.gen_token)
+        if cache.topo_gen != self._topology_gen and changed is not None:
+            changed = set(changed) | {
+                h for h, g in self._host_topo_gen.items()
+                if g > cache.topo_gen
+            }
+        if changed is None:
+            self._sync_full(view, cache)
+        else:
+            self._sync_dirty(view, cache, changed)
+        cache.gen_token = token
+        cache.topo_gen = self._topology_gen
+        return cache
+
+    def _sync_dirty(
+        self, view: "ReservationLedgerView", cache: _ViewCache,
+        dirty: Set[str],
+    ) -> None:
+        self.last_dirty_hosts = len(dirty)
+        if not dirty:
+            self.cache_hits += len(cache.snaps)
+            return
+        gen_of = getattr(view, "host_generation", None)
+        rebuilt = 0
+        for host_id in dirty:
+            host = self._hosts.get(host_id)
+            if host is None or host_id in self._down:
+                self._drop_entry(cache, host_id)
+                continue
+            token = gen_of(host_id) if gen_of is not None else None
+            self._rebuild_entry(view, cache, host, token)
+            rebuilt += 1
+        self.cache_misses += rebuilt
+        self.cache_hits += len(cache.snaps) - rebuilt
+
+    def _sync_full(
+        self, view: "ReservationLedgerView", cache: _ViewCache
+    ) -> None:
+        """No change journal available: fall back to comparing every
+        up host's per-view token (the PR-1 path, minus the copies)."""
+        gen_of = getattr(view, "host_generation", None)
+        seen: Set[str] = set()
+        rebuilt = 0
+        for host in self._hosts.values():
+            host_id = host.host_id
+            if host_id in self._down:
+                self._drop_entry(cache, host_id)
+                continue
+            seen.add(host_id)
+            token = gen_of(host_id) if gen_of is not None else None
+            current = cache.snaps.get(host_id)
             if (
                 token is not None
-                and cached is not None
-                and cached[0] is host
-                and cached[1] == token
+                and current is not None
+                and current.host is host
+                and cache.tokens.get(host_id) == token
             ):
                 self.cache_hits += 1
-                out.append(cached[2].copy())
                 continue
             self.cache_misses += 1
-            snap = self._build_snapshot(host, ledger)
-            if token is not None:
-                self._snap_cache[host.host_id] = (host, token, snap)
-                snap = snap.copy()
-            out.append(snap)
-        return out
+            rebuilt += 1
+            self._rebuild_entry(view, cache, host, token)
+        for host_id in list(cache.snaps):
+            if host_id not in seen:
+                self._drop_entry(cache, host_id)
+        self.last_dirty_hosts = rebuilt
+
+    def _rebuild_entry(
+        self, view, cache: _ViewCache, host: TpuHost, token
+    ) -> None:
+        snap = self._build_snapshot(host, view)
+        snap.shared = True
+        host_id = host.host_id
+        prev = cache.snaps.get(host_id)
+        if prev is not None and prev.host.slice_id != host.slice_id:
+            # host re-registered under a different slice: it must
+            # leave the OLD slice's fully-free bucket or the gang
+            # pre-filter counts a host that is no longer there
+            old_bucket = cache.fully_free_by_slice.get(prev.host.slice_id)
+            if old_bucket is not None:
+                old_bucket.discard(host_id)
+        cache.snaps[host_id] = snap
+        cache.tokens[host_id] = token
+        cache.ordered = None
+        n_free = len(snap.free_chips)
+        cache.free_chip_count[host_id] = n_free
+        bucket = cache.fully_free_by_slice.setdefault(host.slice_id, set())
+        if host.chips_per_host and n_free == host.chips_per_host:
+            bucket.add(host_id)
+        else:
+            bucket.discard(host_id)
+
+    def _drop_entry(self, cache: _ViewCache, host_id: str) -> None:
+        snap = cache.snaps.pop(host_id, None)
+        cache.tokens.pop(host_id, None)
+        cache.free_chip_count.pop(host_id, None)
+        if snap is not None:
+            cache.ordered = None
+            bucket = cache.fully_free_by_slice.get(snap.host.slice_id)
+            if bucket is not None:
+                bucket.discard(host_id)
+
+    def _ordered_snapshots(self, cache: _ViewCache) -> List[ResourceSnapshot]:
+        if cache.ordered is None:
+            snaps = cache.snaps
+            cache.ordered = [
+                snaps[h.host_id]
+                for h in self._hosts.values()
+                if h.host_id in snaps
+            ]
+        return cache.ordered
+
+    # -- inverted indexes (internal; rebuilt on topology change) ------
+
+    def _up_ids(self) -> Set[str]:
+        # capture the generation BEFORE building: a topology mutation
+        # racing this rebuild (HTTP debug thread vs cycle thread) must
+        # leave the cache stamped stale, not mask the change until the
+        # NEXT topology bump
+        gen = self._topology_gen
+        if self._up_ids_cache is None or self._up_ids_gen != gen:
+            # C-level snapshots first: debug_stats calls this from
+            # HTTP threads while the cycle thread mutates the fleet
+            down = set(self._down)
+            self._up_ids_cache = {
+                h for h in list(self._hosts) if h not in down
+            }
+            self._up_ids_gen = gen
+        return self._up_ids_cache
+
+    def _ordinals(self) -> Dict[str, int]:
+        gen = self._topology_gen
+        if self._ordinal_gen != gen:
+            self._ordinal_cache = {
+                host_id: i for i, host_id in enumerate(self._hosts)
+            }
+            self._ordinal_gen = gen
+        return self._ordinal_cache
+
+    def _field_index(self, field_name: str) -> Dict[str, Set[str]]:
+        gen = self._topology_gen
+        if self._index_gen != gen:
+            self._field_indexes = {}
+            self._index_gen = gen
+        index = self._field_indexes.get(field_name)
+        if index is None:
+            index = {}
+            for host in self._hosts.values():
+                if host.host_id in self._down:
+                    continue
+                index.setdefault(
+                    host_field(host, field_name), set()
+                ).add(host.host_id)
+            self._field_indexes[field_name] = index
+        return index
 
     def _build_snapshot(
         self, host: TpuHost, ledger: "ReservationLedgerView"
@@ -264,6 +644,18 @@ class ReservationLedgerView:
         """Change token for ``reserved_on(host_id)``; snapshots cached
         against it are reused while it compares equal.  None (the
         default) means "unknown — never cache"."""
+        return None
+
+    def generation_token(self):
+        """Whole-view change token: snapshots synced against it are
+        reused wholesale while it compares equal.  None (the default)
+        means "unknown — re-check every host each pass"."""
+        return None
+
+    def changed_hosts_since(self, token):
+        """Host ids whose ``reserved_on`` may differ from when the
+        view reported ``token``; None (the default) means "unknown —
+        treat every host as potentially dirty"."""
         return None
 
 

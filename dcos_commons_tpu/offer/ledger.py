@@ -67,7 +67,18 @@ class ReservationLedger(ReservationLedgerView):
         self._by_host: Dict[str, Dict[str, Reservation]] = {}
         self._by_task: Dict[str, Dict[str, Reservation]] = {}
         self._generation = 1
+        # generation counters restart at 1 for every ledger OBJECT (a
+        # service upgrade/reinstall rebuilds the ledger over the same
+        # persisted tree): the epoch disambiguates, so a change token
+        # minted against the old object can never alias the new one's
+        # rebased generations (a stale-but-colliding token would hide
+        # the final pre-rebuild commits from snapshot caches forever)
+        self._epoch = uuid.uuid4().hex[:12]
         self._host_gen: Dict[str, int] = {}
+        # newest pruned stamp: tokens older than this can no longer be
+        # answered incrementally (the pruned host's change would be
+        # invisible to them) and fall back to a full resync
+        self._prune_floor = 0
         self._load()
 
     def _path(self, reservation_id: str) -> str:
@@ -127,6 +138,25 @@ class ReservationLedger(ReservationLedgerView):
         if old is not None:
             self._generation += 1
             self._unindex(old)
+            self._compact_host_gen()
+
+    def _compact_host_gen(self) -> None:
+        """Prune generation stamps of hosts with no live claims once
+        the journal exceeds 2x the claimed-host set — months of fleet
+        churn (every replaced host once held a reservation) must not
+        grow memory or per-sync dirty-scan cost without bound.  The
+        same discipline as SliceInventory's topology-journal
+        compaction: anything pruned raises ``_prune_floor`` so a
+        pre-compaction token resyncs from scratch instead of missing
+        the pruned host's release."""
+        if len(self._host_gen) <= max(16, 2 * len(self._by_host)):
+            return
+        for host_id in [
+            h for h in self._host_gen if h not in self._by_host
+        ]:
+            stamp = self._host_gen.pop(host_id)
+            if stamp > self._prune_floor:
+                self._prune_floor = stamp
 
     # -- queries ------------------------------------------------------
 
@@ -139,6 +169,40 @@ class ReservationLedger(ReservationLedgerView):
         """Generation of the last mutation touching ``host_id`` (0 =
         never touched).  Snapshot caches key on this value."""
         return self._host_gen.get(host_id, 0)
+
+    @property
+    def epoch(self) -> str:
+        """Identity of this ledger OBJECT; tokens carry it so a
+        rebuilt ledger's rebased generations never alias stale ones."""
+        return self._epoch
+
+    def generation_token(self):
+        """Whole-ledger change token for incremental snapshot sync
+        (SliceInventory dirty-host evaluation)."""
+        return (self._epoch, self._generation)
+
+    def changed_hosts_since(self, token) -> Optional[Set[str]]:
+        """Hosts whose claims changed after ``token`` — the dirty set
+        an incremental snapshot sync rebuilds.  O(1) when nothing
+        changed; otherwise O(stamp journal), which compaction bounds
+        at 2x the currently-claimed host set.  A token from another
+        epoch (a superseded ledger object), from the future, or
+        predating a compaction returns None: the caller must treat
+        every host as dirty."""
+        if not (
+            isinstance(token, tuple)
+            and len(token) == 2
+            and token[0] == self._epoch
+            and isinstance(token[1], int)
+        ):
+            return None
+        if token[1] > self._generation:
+            return None
+        if token[1] == self._generation:
+            return set()
+        if token[1] < self._prune_floor:
+            return None  # a pruned stamp postdates this token
+        return {h for h, g in self._host_gen.items() if g > token[1]}
 
     def get(self, reservation_id: str) -> Optional[Reservation]:
         return self._cache.get(reservation_id)

@@ -21,10 +21,15 @@ import json
 import math
 import re
 from dataclasses import dataclass, field
-from typing import Dict, List, Sequence
+from typing import Dict, List, Optional, Sequence
 
 from dcos_commons_tpu.common import TaskInfo
-from dcos_commons_tpu.offer.inventory import ResourceSnapshot, TpuHost
+from dcos_commons_tpu.offer.inventory import (
+    HostIndex,
+    ResourceSnapshot,
+    TpuHost,
+    host_field,
+)
 from dcos_commons_tpu.offer.outcome import EvaluationOutcome
 
 
@@ -41,11 +46,20 @@ class PlacementContext:
     mid-evaluation MUST go through ``record_tasks`` — it invalidates
     the task-derived memos; mutating ``existing_tasks`` in place after
     the first rule ran would serve stale counts.
+
+    Fleet-scale path: when ``task_index`` (pod_type -> instance-key ->
+    task list, built once per cycle by EvaluationContext) is supplied,
+    per-pod instance lists and counts come from the index — no
+    per-requirement scan over the fleet's whole task list.
+    ``excluded_names`` are the requirement's own tasks (a relaunch
+    must not block its own placement).
     """
 
     pod_type: str
     existing_tasks: List[TaskInfo] = field(default_factory=list)
     hosts: Dict[str, TpuHost] = field(default_factory=dict)
+    task_index: Optional[Dict[str, Dict[str, List[TaskInfo]]]] = None
+    excluded_names: frozenset = frozenset()
     _instances_memo: Dict[str, List[TaskInfo]] = field(
         default_factory=dict, init=False, repr=False, compare=False
     )
@@ -55,33 +69,29 @@ class PlacementContext:
     _values_memo: Dict[str, set] = field(
         default_factory=dict, init=False, repr=False, compare=False
     )
+    _recorded: List[TaskInfo] = field(
+        default_factory=list, init=False, repr=False, compare=False
+    )
 
     def record_tasks(self, infos: List[TaskInfo]) -> None:
         """Append just-placed tasks so max-per/group-by rules count
         them for subsequent instances of the same requirement."""
-        self.existing_tasks.extend(infos)
+        if self.task_index is not None:
+            self._recorded.extend(infos)
+        else:
+            self.existing_tasks.extend(infos)
         self._instances_memo.clear()
         self._counts_memo.clear()
 
     def host_field(self, host: TpuHost, field_name: str) -> str:
-        if field_name == "hostname":
-            return host.hostname
-        if field_name == "zone":
-            return host.zone
-        if field_name == "region":
-            return host.region
-        if field_name == "generation":
-            return host.generation
-        if field_name == "slice":
-            return host.slice_id
-        return host.attributes.get(field_name, "")
+        return host_field(host, field_name)
 
     def field_values(self, field_name: str) -> set:
         """Every distinct value of ``field_name`` across the fleet."""
         values = self._values_memo.get(field_name)
         if values is None:
             values = {
-                self.host_field(h, field_name) for h in self.hosts.values()
+                host_field(h, field_name) for h in self.hosts.values()
             }
             self._values_memo[field_name] = values
         return values
@@ -91,14 +101,34 @@ class PlacementContext:
         cached = self._instances_memo.get(pod_type)
         if cached is None:
             seen = {}
-            for info in self.existing_tasks:
+            if self.task_index is not None:
+                for key, infos in self.task_index.get(pod_type, {}).items():
+                    for info in infos:
+                        if info.name not in self.excluded_names:
+                            # sibling tasks of one instance share the
+                            # host, so any non-excluded one represents
+                            # the instance for placement purposes
+                            seen[key] = info
+                            break
+                extra = self._recorded
+            else:
+                extra = self.existing_tasks
+            # recorded (just-placed) tasks are NEVER excluded: they
+            # carry this requirement's own names, but an earlier
+            # instance of a multi-instance requirement must count for
+            # max-per/group-by on the later ones (the legacy path
+            # appends them unfiltered for the same reason)
+            for info in extra:
                 if info.pod_type == pod_type:
                     seen[f"{info.pod_type}-{info.pod_index}"] = info
             cached = list(seen.values())
             self._instances_memo[pod_type] = cached
         return cached
 
-    def count_on(self, field_name: str, value: str, pod_type: str) -> int:
+    def counts_for(self, field_name: str, pod_type: str) -> Dict[str, int]:
+        """Instance count per distinct field value (memoized) — the
+        shared basis of count_on and index pre-filtering, so a rule's
+        filter() and its candidate set can never disagree."""
         key = (field_name, pod_type)
         counts = self._counts_memo.get(key)
         if counts is None:
@@ -106,10 +136,13 @@ class PlacementContext:
             for info in self.tasks_of_pod(pod_type):
                 host = self.hosts.get(info.agent_id)
                 if host is not None:
-                    actual = self.host_field(host, field_name)
+                    actual = host_field(host, field_name)
                     counts[actual] = counts.get(actual, 0) + 1
             self._counts_memo[key] = counts
-        return counts.get(value, 0)
+        return counts
+
+    def count_on(self, field_name: str, value: str, pod_type: str) -> int:
+        return self.counts_for(field_name, pod_type).get(value, 0)
 
 
 class PlacementRule:
@@ -117,6 +150,17 @@ class PlacementRule:
         self, snapshot: ResourceSnapshot, ctx: PlacementContext
     ) -> EvaluationOutcome:
         raise NotImplementedError
+
+    def candidate_host_ids(
+        self, ctx: PlacementContext, index: HostIndex
+    ) -> Optional[set]:
+        """Indexed pre-filtering: the host ids this rule could pass,
+        or None when the rule cannot bound its candidates (the
+        evaluator then scans).  MUST be a superset of the hosts
+        ``filter`` would pass — filter() still runs on every
+        candidate, so over-approximation costs time, never
+        correctness; UNDER-approximation changes placement."""
+        return None
 
 
 class PassthroughRule(PlacementRule):
@@ -137,6 +181,19 @@ class AndRule(PlacementRule):
         outcome.children = children
         return outcome
 
+    def candidate_host_ids(self, ctx, index):
+        # intersection of every bounding child; an unbounded child
+        # (None) constrains nothing
+        out = None
+        for rule in self.rules:
+            cand = rule.candidate_host_ids(ctx, index)
+            if cand is None:
+                continue
+            out = set(cand) if out is None else out & cand
+            if not out:
+                return out
+        return out
+
 
 class OrRule(PlacementRule):
     def __init__(self, rules: Sequence[PlacementRule]):
@@ -150,6 +207,16 @@ class OrRule(PlacementRule):
         )
         outcome.children = children
         return outcome
+
+    def candidate_host_ids(self, ctx, index):
+        # union; ANY unbounded branch makes the whole rule unbounded
+        out: set = set()
+        for rule in self.rules:
+            cand = rule.candidate_host_ids(ctx, index)
+            if cand is None:
+                return None
+            out |= cand
+        return out
 
 
 class NotRule(PlacementRule):
@@ -195,6 +262,23 @@ class FieldMatchRule(PlacementRule):
             f"{'matches' if self.invert else 'not in'} {self.values}",
         )
 
+    def candidate_host_ids(self, ctx, index):
+        value_index = index.value_index(self.field_name)
+        if self.regex:
+            matched: set = set()
+            # distinct values are few; the regex runs per value, not
+            # per host
+            for value, hosts in value_index.items():
+                if any(re.fullmatch(v, value) for v in self.values):
+                    matched |= hosts
+        else:
+            matched = set()
+            for v in self.values:
+                matched |= value_index.get(v, frozenset())
+        if self.invert:
+            return index.universe() - matched
+        return matched
+
 
 class MaxPerRule(PlacementRule):
     """At most N instances of this pod per distinct field value.
@@ -219,6 +303,21 @@ class MaxPerRule(PlacementRule):
             f"already {count}/{self.max_count} instances of "
             f"{ctx.pod_type!r} on {self.field_name}={value!r}",
         )
+
+    def candidate_host_ids(self, ctx, index):
+        # exclude hosts whose field value already carries max_count
+        # instances — the same counts filter() consults
+        counts = ctx.counts_for(self.field_name, ctx.pod_type)
+        saturated = [
+            v for v, n in counts.items() if n >= self.max_count
+        ]
+        if not saturated:
+            return index.universe()
+        value_index = index.value_index(self.field_name)
+        out = set(index.universe())
+        for v in saturated:
+            out -= value_index.get(v, frozenset())
+        return out
 
 
 class GroupByRule(PlacementRule):
@@ -249,6 +348,24 @@ class GroupByRule(PlacementRule):
             f"{self.field_name}={value!r} already has {count} "
             f"(ceiling {ceiling}) of {ctx.pod_type!r}",
         )
+
+    def candidate_host_ids(self, ctx, index):
+        # same ceiling arithmetic as filter(): an up host's value is
+        # already in the fleet value set, so the divisor is constant
+        # across candidates
+        values = ctx.field_values(self.field_name)
+        divisor = self.expected_values or len(values) or 1
+        total = len(ctx.tasks_of_pod(ctx.pod_type)) + 1
+        ceiling = math.ceil(total / divisor)
+        counts = ctx.counts_for(self.field_name, ctx.pod_type)
+        saturated = [v for v, n in counts.items() if n >= ceiling]
+        if not saturated:
+            return index.universe()
+        value_index = index.value_index(self.field_name)
+        out = set(index.universe())
+        for v in saturated:
+            out -= value_index.get(v, frozenset())
+        return out
 
 
 class TaskTypeRule(PlacementRule):
@@ -283,6 +400,16 @@ class TaskTypeRule(PlacementRule):
             )
         return EvaluationOutcome.ok(name, "avoided")
 
+    def candidate_host_ids(self, ctx, index):
+        hosts_of_other = {
+            info.agent_id for info in ctx.tasks_of_pod(self.other)
+        }
+        if self.colocate:
+            if not hosts_of_other:
+                return index.universe()  # nothing to colocate with yet
+            return hosts_of_other & index.universe()
+        return index.universe() - hosts_of_other
+
 
 class AgentRule(PlacementRule):
     """Pin to / avoid specific host ids.
@@ -308,6 +435,11 @@ class AgentRule(PlacementRule):
             f"{'is drained' if self.avoid else 'not in'} "
             f"{sorted(self.host_ids)}",
         )
+
+    def candidate_host_ids(self, ctx, index):
+        if self.avoid:
+            return index.universe() - self.host_ids
+        return self.host_ids & index.universe()
 
 
 class RoundRobinByRule(PlacementRule):
@@ -348,6 +480,22 @@ class RoundRobinByRule(PlacementRule):
             f"{ctx.pod_type!r}, another value is at {floor}",
         )
 
+    def candidate_host_ids(self, ctx, index):
+        # the floor is computed over the FLEET value set (incl. values
+        # seen only on down hosts — count 0), exactly as filter() does
+        values = ctx.field_values(self.field_name)
+        task_counts = ctx.counts_for(self.field_name, ctx.pod_type)
+        counts = {v: task_counts.get(v, 0) for v in values}
+        floor = min(counts.values()) if counts else 0
+        if self.expected_values and len(values) < self.expected_values:
+            floor = 0
+        value_index = index.value_index(self.field_name)
+        out: set = set()
+        for v, n in counts.items():
+            if n <= floor:
+                out |= value_index.get(v, frozenset())
+        return out
+
 
 class VolumeProfilesRule(PlacementRule):
     """The pod's volumes demand storage profiles (reference: profile
@@ -378,6 +526,16 @@ class VolumeProfilesRule(PlacementRule):
             f"{missing} (advertises {sorted(advertised) or 'none'})",
         )
 
+    def candidate_host_ids(self, ctx, index):
+        # the attribute is a free-form comma list: parse each DISTINCT
+        # advertised string once (few) instead of per host
+        out: set = set()
+        for raw, hosts in index.value_index("volume_profiles").items():
+            advertised = {p.strip() for p in raw.split(",") if p.strip()}
+            if all(p in advertised for p in self.profiles):
+                out |= hosts
+        return out
+
 
 class SameSliceRule(PlacementRule):
     """TPU-first: all instances of the pod on one physical slice."""
@@ -395,6 +553,20 @@ class SameSliceRule(PlacementRule):
             f"pod pinned to slice {sorted(slices)}, host is on "
             f"{snapshot.host.slice_id!r}",
         )
+
+    def candidate_host_ids(self, ctx, index):
+        slices = {
+            ctx.hosts[i.agent_id].slice_id
+            for i in ctx.tasks_of_pod(ctx.pod_type)
+            if i.agent_id in ctx.hosts
+        }
+        if not slices:
+            return index.universe()
+        value_index = index.value_index("slice")
+        out: set = set()
+        for s in slices:
+            out |= value_index.get(s, frozenset())
+        return out
 
 
 # ---------------------------------------------------------------------------

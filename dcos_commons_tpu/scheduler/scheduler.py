@@ -159,6 +159,10 @@ class DefaultScheduler:
         self.secrets_provider = None
         self.certificate_authority = None
         self._suppressed = False
+        # pending-nudge flag consumed by the multi-service offer
+        # discipline: a suppressed (skipped) service is revived when a
+        # nudge fired since its last cycle (status arrival, HTTP verb)
+        self._nudged = False
         self._fatal_error: Optional[str] = None
         self._stop = threading.Event()
         # event-driven wake-up (offer-cycle fast path): status arrival
@@ -175,6 +179,12 @@ class DefaultScheduler:
         self.metrics.gauge(
             "offers.snapshot_cache.miss",
             lambda: float(getattr(inventory, "cache_misses", 0)),
+        )
+        # dirty-host incremental evaluation: how many hosts the last
+        # snapshot sync actually re-synthesized (0 on a quiet fleet)
+        self.metrics.gauge(
+            "offers.dirty_hosts",
+            lambda: float(getattr(inventory, "last_dirty_hosts", 0)),
         )
         self.evaluator.metrics = self.metrics
         self.evaluator.tracer = self.tracer
@@ -315,7 +325,34 @@ class DefaultScheduler:
         # cycle clears it BEFORE serializing, so a racing flip only
         # costs one extra checkpoint, never a lost one.
         self._plan_dirty = True  # sdklint: disable=lock-discipline — see above
+        self._nudged = True  # sdklint: disable=lock-discipline — same monotonic-flip contract
         self._wake.set()
+
+    def take_nudge(self) -> bool:
+        """Consume the pending-nudge flag (multi-service offer
+        discipline): True when nudge() fired since the last consume.
+        Monotonic bool flip; a racing nudge after the read costs one
+        extra revive cycle, never a lost wake."""
+        if self._nudged:
+            self._nudged = False  # sdklint: disable=lock-discipline — see nudge()
+            return True
+        return False
+
+    def work_pending(self) -> bool:
+        """True while this service could need an offer cycle: pending/
+        in-flight plan work, unfinished reconciliation, or unacked
+        kills.  False = the service may be SUPPRESSED (skipped
+        entirely by MultiServiceScheduler.run_cycle) until a status or
+        nudge revives it — the reference's suppress/revive semantics
+        (framework/ReviveManager.java), now load-bearing at fleet
+        scale.  DELAYED (backoff) steps keep a plan incomplete, so a
+        service waiting out a crash-loop backoff is never suppressed
+        (backoff expiry is time-, not event-, driven)."""
+        return (
+            not self.reconciler.is_reconciled
+            or bool(self.task_killer.pending_ids())
+            or self.coordinator.has_work()
+        )
 
     def _chaos_point(self, kind: str) -> None:
         """Crash-injection hook: the chaos harness installs a callable
@@ -540,8 +577,11 @@ class DefaultScheduler:
                 # scheduler-side work (decommission/uninstall/custom)
                 step.execute(self)
                 # it may have killed/erased tasks: the shared context
-                # must not serve the pre-action scan to later steps
+                # must not serve the pre-action scan to later steps,
+                # and memoized requirement outcomes computed against
+                # the pre-action task set are void too
                 context.invalidate_tasks()
+                self.evaluator.invalidate_memo()
                 continue
             if not isinstance(step, DeploymentStep):
                 continue

@@ -84,7 +84,8 @@ def test_config_state_endpoints_health(deployed, capsys):
     metrics = cli(server, "metrics", capsys=capsys)
     assert metrics["operations.launch"] >= 1
     offers = cli(server, "debug", "offers", capsys=capsys)
-    assert offers[-1]["passed"]
+    assert offers["outcomes"][-1]["passed"]
+    assert "snapshot_cache" in offers["evaluation"]
 
 
 def test_plan_verbs(deployed, capsys):

@@ -1,0 +1,978 @@
+"""Fleet-scale offer cycle tests (ISSUE 9 tentpole).
+
+Four properties are load-bearing:
+
+1. EQUIVALENCE: the incremental/indexed evaluator (dirty-host
+   snapshot sync + candidate pre-filtering + requirement memo) must
+   produce IDENTICAL evaluation outcomes to the full-rebuild path —
+   same winner hosts, same failing_requirement reasons — under
+   randomized interleavings of reservations, host add/remove/up/down,
+   and pod relaunches.
+2. Dirty-host sync: an unchanged fleet costs an O(1) token compare;
+   a single commit re-synthesizes exactly the touched host; caches
+   are PER VIEW, so alternating views never thrash each other.
+3. Copy-on-write: shared snapshots raise on mutation; copies consume
+   freely.
+4. Suppress/revive: a multi-service scheduler skips services with no
+   pending work and revives them on status arrival and on HTTP-verb
+   nudges — a suppressed service never misses work.
+"""
+
+import pytest
+
+from dcos_commons_tpu.common import TaskState, TaskStatus
+from dcos_commons_tpu.offer import (
+    OfferEvaluator,
+    Reservation,
+    ReservationLedger,
+    SliceInventory,
+    TpuHost,
+)
+from dcos_commons_tpu.offer.inventory import make_test_fleet
+from dcos_commons_tpu.offer.ledger import new_reservation_id
+from dcos_commons_tpu.plan.step import PodInstanceRequirement, RecoveryType
+from dcos_commons_tpu.specification import from_yaml
+from dcos_commons_tpu.state import StateStore
+from dcos_commons_tpu.storage import MemPersister
+
+# -- equivalence: incremental/indexed == full rebuild -----------------
+
+FLEET_YAML = """
+name: fleet
+pods:
+  app:
+    count: 6
+    placement: '{placement}'
+    tasks:
+      server:
+        cmd: "serve"
+        cpus: 1.0
+        memory: 1024
+  tpuapp:
+    count: 2
+    placement: '{placement}'
+    tpu:
+      generation: v5e
+      chips-per-host: 4
+    tasks:
+      worker:
+        cmd: "python train.py"
+        cpus: 1.0
+        memory: 1024
+  gangpod:
+    count: 4
+    gang: true
+    tpu:
+      generation: v5e
+      chips-per-host: 4
+      topology: 4x4
+    tasks:
+      worker:
+        goal: FINISH
+        cmd: "python train.py"
+        cpus: 1.0
+        memory: 1024
+"""
+
+PLACEMENTS = [
+    "",
+    "max-per-host:1",
+    "max-per-zone:3",
+    "group-by:zone",
+    "round-robin:zone",
+    "zone:exact:zone-0,zone-1",
+    "hostname:regex:pod-0-.*",
+    "task-type:avoid:app",
+    "task-type:colocate:app",
+    "generation:v5e",
+    "same-slice",
+    "max-per-host:1 && zone:exact:zone-0 || group-by:zone",
+]
+
+
+def build_world(placement=""):
+    spec = from_yaml(FLEET_YAML.replace("{placement}", placement))
+    persister = MemPersister()
+    store = StateStore(persister)
+    ledger = ReservationLedger(persister)
+    hosts = make_test_fleet(
+        slice_id="pod-0", host_grid=(4, 2), chip_block=(2, 2), cpus=16.0
+    ) + make_test_fleet(
+        slice_id="pod-1", host_grid=(4, 2), chip_block=(2, 2), cpus=16.0
+    ) + [TpuHost(host_id=f"cpu-{i}", zone=f"zone-{i % 2}") for i in range(4)]
+    inv = SliceInventory(hosts)
+    ev = OfferEvaluator(store, ledger, spec.name, "cfg-1")
+    return spec, store, ledger, ev, inv, hosts
+
+
+def oracle_result(spec, store, ledger, hosts, down, requirement):
+    """Full-rebuild evaluation of the same state: fresh inventory
+    (empty caches), fast path disabled — the PR-1 behavior."""
+    oracle_inv = SliceInventory(hosts)
+    for host_id in down:
+        oracle_inv.mark_down(host_id)
+    oracle_ev = OfferEvaluator(store, ledger, spec.name, "cfg-1")
+    oracle_ev.fast_path = False
+    return oracle_ev.evaluate(requirement, oracle_inv)
+
+
+def outcome_signature(result):
+    """What must be identical between the two paths: pass/fail, the
+    chosen hosts (in worker order), and the failing reason."""
+    return (
+        result.passed,
+        [r.host_id for r in result.reservations],
+        [t.agent_id for t in result.task_infos],
+        result.outcome.reason or result.outcome.source,
+    )
+
+
+def test_equivalence_randomized_interleavings():
+    """Deterministic randomized sweep (runs without hypothesis): the
+    incremental evaluator tracks the full-rebuild oracle through
+    reservation churn, host up/down/add/remove, and relaunches."""
+    import random
+
+    rng = random.Random(20260803)
+    for placement in PLACEMENTS:
+        spec, store, ledger, ev, inv, hosts = build_world(placement)
+        hosts = list(hosts)
+        down = set()
+        for step in range(40):
+            op = rng.random()
+            if op < 0.35:
+                host = rng.choice(hosts)
+                chips = host.chip_ids()
+                ledger.commit([Reservation(
+                    reservation_id=new_reservation_id(),
+                    host_id=host.host_id,
+                    task_name=f"app-{rng.randrange(6)}-server",
+                    cpus=rng.choice([0.5, 2.0]),
+                    memory_mb=rng.choice([256, 2048]),
+                    chip_ids=(
+                        rng.sample(chips, rng.randrange(len(chips) + 1))
+                        if chips else []
+                    ),
+                )])
+            elif op < 0.55:
+                live = ledger.all()
+                if live:
+                    ledger.release(rng.choice(live).reservation_id)
+            elif op < 0.7:
+                host = rng.choice(hosts)
+                inv.mark_down(host.host_id)
+                down.add(host.host_id)
+            elif op < 0.85:
+                if down:
+                    host_id = down.pop()
+                    inv.mark_up(host_id)
+            else:
+                new_host = TpuHost(
+                    host_id=f"extra-{step}", zone=f"zone-{step % 2}"
+                )
+                hosts.append(new_host)
+                inv.add_host(new_host)
+            pod_name = rng.choice(["app", "tpuapp", "gangpod"])
+            pod = spec.pod(pod_name)
+            instances = (
+                list(range(pod.count)) if pod.gang
+                else [rng.randrange(pod.count)]
+            )
+            recovery = rng.choice(
+                [RecoveryType.NONE, RecoveryType.TRANSIENT,
+                 RecoveryType.PERMANENT]
+            )
+            requirement = PodInstanceRequirement(
+                pod=pod, instances=instances, recovery_type=recovery
+            )
+            fast = ev.evaluate(requirement, inv)
+            slow = oracle_result(spec, store, ledger, hosts, down, requirement)
+            assert outcome_signature(fast) == outcome_signature(slow), (
+                f"diverged at step {step} placement={placement!r} "
+                f"pod={pod_name} recovery={recovery}"
+            )
+        assert ev.fast_path  # the sweep exercised the indexed path
+
+
+def test_equivalence_property_hypothesis():
+    """Hypothesis-driven version: arbitrary op sequences, any
+    placement rule, same-winner/same-reason equivalence."""
+    hypothesis = pytest.importorskip("hypothesis")
+    from hypothesis import given, settings, strategies as st
+
+    ops = st.lists(
+        st.tuples(
+            st.sampled_from(
+                ["commit", "release", "down", "up", "evaluate"]
+            ),
+            st.integers(min_value=0, max_value=10 ** 6),
+        ),
+        min_size=1, max_size=25,
+    )
+
+    @settings(max_examples=40, deadline=None)
+    @given(
+        placement=st.sampled_from(PLACEMENTS),
+        sequence=ops,
+        seed=st.integers(min_value=0, max_value=2 ** 20),
+    )
+    def run(placement, sequence, seed):
+        import random
+
+        rng = random.Random(seed)
+        spec, store, ledger, ev, inv, hosts = build_world(placement)
+        down = set()
+        for op, arg in sequence:
+            if op == "commit":
+                host = hosts[arg % len(hosts)]
+                chips = host.chip_ids()
+                ledger.commit([Reservation(
+                    reservation_id=new_reservation_id(),
+                    host_id=host.host_id,
+                    task_name=f"app-{arg % 6}-server",
+                    cpus=0.5 + (arg % 4),
+                    memory_mb=256,
+                    chip_ids=chips[: arg % (len(chips) + 1)] if chips else [],
+                )])
+            elif op == "release":
+                live = ledger.all()
+                if live:
+                    ledger.release(live[arg % len(live)].reservation_id)
+            elif op == "down":
+                host_id = hosts[arg % len(hosts)].host_id
+                inv.mark_down(host_id)
+                down.add(host_id)
+            elif op == "up":
+                if down:
+                    host_id = sorted(down)[arg % len(down)]
+                    down.discard(host_id)
+                    inv.mark_up(host_id)
+            else:
+                pod = spec.pod(
+                    ["app", "tpuapp", "gangpod"][arg % 3]
+                )
+                instances = (
+                    list(range(pod.count)) if pod.gang
+                    else [arg % pod.count]
+                )
+                requirement = PodInstanceRequirement(
+                    pod=pod, instances=instances,
+                    recovery_type=(
+                        [RecoveryType.NONE, RecoveryType.TRANSIENT,
+                         RecoveryType.PERMANENT][arg % 3]
+                    ),
+                )
+                fast = ev.evaluate(requirement, inv)
+                slow = oracle_result(
+                    spec, store, ledger, hosts, down, requirement
+                )
+                assert outcome_signature(fast) == outcome_signature(slow)
+
+    run()
+
+
+# -- incremental sync mechanics ---------------------------------------
+
+
+def test_idle_sync_is_token_compare_only():
+    """An unchanged fleet re-syncs with zero rebuilds; one commit
+    dirties exactly the touched host."""
+    ledger = ReservationLedger(MemPersister())
+    inv = SliceInventory(make_test_fleet(host_grid=(4, 4)))
+    inv.snapshots(ledger)
+    assert inv.cache_misses == 16
+    inv.snapshots(ledger)
+    assert inv.cache_misses == 16 and inv.last_dirty_hosts == 0
+    target = inv.hosts()[5]
+    ledger.commit([Reservation(
+        reservation_id=new_reservation_id(),
+        host_id=target.host_id, task_name="t-0-x", cpus=1.0,
+    )])
+    inv.snapshots(ledger)
+    assert inv.last_dirty_hosts == 1
+    assert inv.cache_misses == 17  # exactly one rebuild
+
+
+def test_gang_prefilter_includes_sliceless_hosts():
+    """Regression (review): TPU hosts registered WITHOUT a slice id
+    group under slice "" in find_subslice and can host a gang; the
+    torus pre-filter skipping the "" bucket made the indexed path
+    fail a gang the full scan places whenever some NAMED slice passed
+    the fully-free count filter but lost the actual search."""
+    yaml_text = """
+name: fleet
+pods:
+  gang:
+    count: 4
+    gang: true
+    placement: 'zone:exact:good'
+    tpu:
+      generation: v5e
+      chips-per-host: 4
+      topology: 4x4
+    tasks:
+      worker:
+        goal: FINISH
+        cmd: "python train.py"
+        cpus: 1.0
+        memory: 1024
+"""
+    spec = from_yaml(yaml_text)
+    persister = MemPersister()
+    store = StateStore(persister)
+    ledger = ReservationLedger(persister)
+    # named slice: enough fully-free hosts to pass the count filter,
+    # but the zone rule rejects every one of them
+    hosts = make_test_fleet(
+        slice_id="pod-a", host_grid=(2, 2), chip_block=(2, 2),
+        zone_of=lambda gx, gy: "bad",
+    ) + [
+        TpuHost(
+            host_id=f"adhoc-{gx}-{gy}", slice_id="", generation="v5e",
+            grid=(gx, gy), chip_block=(2, 2), zone="good",
+        )
+        for gx in range(2) for gy in range(2)
+    ]
+    inv = SliceInventory(hosts)
+    ev = OfferEvaluator(store, ledger, spec.name, "cfg-1")
+    requirement = PodInstanceRequirement(
+        pod=spec.pod("gang"), instances=[0, 1, 2, 3]
+    )
+    fast = ev.evaluate(requirement, inv)
+    slow = oracle_result(spec, store, ledger, hosts, set(), requirement)
+    assert outcome_signature(fast) == outcome_signature(slow)
+    assert fast.passed, fast.outcome.flatten()
+    assert all(r.host_id.startswith("adhoc-") for r in fast.reservations)
+
+
+def test_ledger_host_gen_journal_compacts_and_stays_sound():
+    """Months of host churn (every replaced host once held a claim)
+    must not grow the ledger's per-host stamp journal without bound
+    (review: the inventory journal got this, the ledger's did not) —
+    and compaction must never let a pre-compaction token miss a
+    pruned host's release: such tokens fall back to a full resync."""
+    ledger = ReservationLedger(MemPersister())
+    pre_token = ledger.generation_token()
+    # churn: claim + release a long parade of one-shot hosts
+    for i in range(200):
+        rid = new_reservation_id()
+        ledger.commit([Reservation(
+            reservation_id=rid, host_id=f"ephemeral-{i}",
+            task_name="t-0-x", cpus=1.0,
+        )])
+        ledger.release(rid)
+    # a few live claims remain
+    for i in range(3):
+        ledger.commit([Reservation(
+            reservation_id=new_reservation_id(), host_id=f"live-{i}",
+            task_name="t-0-y", cpus=1.0,
+        )])
+    assert len(ledger._host_gen) <= max(16, 2 * 3) + 1, \
+        len(ledger._host_gen)
+    # the stale token cannot be answered incrementally (pruned stamps
+    # postdate it) — None = caller rebuilds everything, missing nothing
+    assert ledger.changed_hosts_since(pre_token) is None
+    # post-compaction tokens keep the O(dirty) incremental contract
+    token = ledger.generation_token()
+    assert ledger.changed_hosts_since(token) == set()
+    ledger.commit([Reservation(
+        reservation_id=new_reservation_id(), host_id="live-0",
+        task_name="t-0-z", cpus=1.0,
+    )])
+    assert ledger.changed_hosts_since(token) == {"live-0"}
+    # and the full snapshot sync over a compacted ledger stays exact
+    inv = SliceInventory(
+        [TpuHost(host_id=f"live-{i}") for i in range(3)]
+    )
+    snaps = {s.host.host_id: s for s in inv.snapshots(ledger)}
+    # live-0 carries two 1.0-cpu claims, live-1 one: the compacted
+    # journal still yields exact per-host accounting
+    assert snaps["live-1"].cpus - snaps["live-0"].cpus == 1.0
+    inv.snapshots(ledger)
+    assert inv.last_dirty_hosts == 0
+
+
+def test_noop_topology_mutations_do_not_dirty():
+    """mark_up of an up host, mark_down of a down host, remove of an
+    unknown host: all no-ops — no generation bump, no fleet-wide
+    invalidation (satellite fix)."""
+    inv = SliceInventory(make_test_fleet())
+    gen = inv.topology_generation
+    inv.mark_up(inv.hosts()[0].host_id)       # already up
+    inv.mark_up("never-heard-of-it")          # unknown
+    inv.remove_host("never-heard-of-it")      # unknown
+    assert inv.topology_generation == gen
+    inv.mark_down(inv.hosts()[0].host_id)
+    assert inv.topology_generation == gen + 1
+    inv.mark_down(inv.hosts()[0].host_id)     # already down
+    assert inv.topology_generation == gen + 1
+    inv.mark_up(inv.hosts()[0].host_id)
+    assert inv.topology_generation == gen + 2
+
+
+def test_per_view_caches_do_not_thrash():
+    """Two ledger views alternating against one inventory each keep
+    their own cache (satellite fix: the old single-view cache was
+    cleared wholesale on every alternation)."""
+    persister = MemPersister()
+    ledger_a = ReservationLedger(persister, "svc-a")
+    ledger_b = ReservationLedger(persister, "svc-b")
+    inv = SliceInventory(make_test_fleet(host_grid=(2, 2)))
+    inv.snapshots(ledger_a)
+    inv.snapshots(ledger_b)
+    misses_after_warmup = inv.cache_misses
+    for _ in range(5):
+        inv.snapshots(ledger_a)
+        inv.snapshots(ledger_b)
+    assert inv.cache_misses == misses_after_warmup
+    assert inv.cache_hits >= 40  # 5 alternations x 2 views x 4 hosts
+
+
+def test_shared_snapshots_copy_on_write():
+    """offer_view hands out shared masters: mutators raise until
+    copy(); the copy consumes freely and the master is unharmed."""
+    ledger = ReservationLedger(MemPersister())
+    inv = SliceInventory(make_test_fleet(host_grid=(1, 1)))
+    index = inv.offer_view(ledger)
+    [snap] = index.ordered_snapshots()
+    assert snap.shared
+    with pytest.raises(RuntimeError, match="copy"):
+        snap.try_consume_scalar(1.0, 1, 0)
+    with pytest.raises(RuntimeError, match="copy"):
+        snap.try_consume_chips(1)
+    with pytest.raises(RuntimeError, match="copy"):
+        snap.allocate_port()
+    work = snap.copy()
+    assert work.try_consume_scalar(1.0, 1, 0)
+    assert work.try_consume_chips(1)
+    again = inv.offer_view(ledger).ordered_snapshots()[0]
+    assert again.cpus == snap.cpus and len(again.free_chips) == 4
+
+
+def test_requirement_memo_short_circuits_and_invalidates():
+    """A failing requirement against an unchanged fleet short-circuits
+    (no re-scan); any ledger change invalidates the memo."""
+    from dcos_commons_tpu.metrics.registry import Metrics
+
+    spec, store, ledger, ev, inv, hosts = build_world("zone:exact:nowhere")
+    ev.metrics = Metrics()
+    requirement = PodInstanceRequirement(pod=spec.pod("app"), instances=[0])
+    first = ev.evaluate(requirement, inv)
+    assert not first.passed
+    again = ev.evaluate(requirement, inv)
+    assert outcome_signature(again) == outcome_signature(first)
+    counters = ev.metrics.counters()
+    assert counters.get("offers.eval.shortcircuit", 0) == 1
+    # a commit anywhere voids the memo
+    ledger.commit([Reservation(
+        reservation_id=new_reservation_id(),
+        host_id=hosts[0].host_id, task_name="t-0-x", cpus=0.5,
+    )])
+    third = ev.evaluate(requirement, inv)
+    assert not third.passed
+    assert ev.metrics.counters().get("offers.eval.shortcircuit", 0) == 1
+
+
+def test_multi_instance_requirement_counts_recorded_instances():
+    """Regression (review r9): a multi-instance requirement evaluated
+    in ONE call must count its earlier instances for max-per rules on
+    the later ones — the indexed path once filtered the just-placed
+    tasks through the requirement's own excluded names, letting two
+    instances land on one host."""
+    yaml_text = """
+name: spread
+pods:
+  app:
+    count: 2
+    gang: true
+    placement: 'max-per-host:1'
+    tasks:
+      main:
+        goal: RUNNING
+        cmd: sleep 1000
+        cpus: 0.5
+        memory: 256
+"""
+    spec = from_yaml(yaml_text)
+    persister = MemPersister()
+    store = StateStore(persister)
+    ledger = ReservationLedger(persister)
+    hosts = [TpuHost(host_id=f"h{i}") for i in range(4)]
+    inv = SliceInventory(hosts)
+    ev = OfferEvaluator(store, ledger, spec.name, "cfg-1")
+    requirement = PodInstanceRequirement(
+        pod=spec.pod("app"), instances=[0, 1]
+    )
+    result = ev.evaluate(requirement, inv)
+    assert result.passed, result.outcome.flatten()
+    placed_hosts = [t.agent_id for t in result.task_infos]
+    assert len(set(placed_hosts)) == 2, (
+        f"max-per-host:1 violated within one requirement: {placed_hosts}"
+    )
+    # and it still matches the full-rebuild oracle
+    slow = oracle_result(spec, store, ledger, hosts, set(), requirement)
+    assert outcome_signature(result) == outcome_signature(slow)
+
+
+def test_ledger_rebuild_invalidates_view_cache():
+    """Regression (review r9): a rebuilt ledger (service upgrade /
+    restart re-loads the same persisted tree) restarts its generation
+    counter.  A LONG-LIVED view over a swappable ledger — the
+    multi-service merged view's shape — must fully resync, not trust
+    the rebased generations (which can numerically collide with the
+    stale token)."""
+
+    class SwappableView:
+        def __init__(self, ledger):
+            self.ledger = ledger
+
+        def reserved_on(self, host_id):
+            return self.ledger.reserved_on(host_id)
+
+        def host_generation(self, host_id):
+            return (self.ledger.epoch, self.ledger.host_generation(host_id))
+
+        def generation_token(self):
+            return self.ledger.generation_token()
+
+        def changed_hosts_since(self, token):
+            return self.ledger.changed_hosts_since(token)
+
+    persister = MemPersister()
+    hosts = make_test_fleet(host_grid=(2, 2))
+    inv = SliceInventory(hosts)
+    view = SwappableView(ReservationLedger(persister))
+    inv.snapshots(view)
+    # a commit the cache never observes before the rebuild...
+    view.ledger.commit([Reservation(
+        reservation_id=new_reservation_id(),
+        host_id=hosts[0].host_id, task_name="t-0-x", cpus=10.0,
+    )])
+    old_token = view.ledger.generation_token()
+    # ...then the rebuild: same persisted tree, fresh counters.  The
+    # new generation (1 load + 1 commit land at 2 = the stale token's)
+    # would alias without the epoch.
+    view.ledger = ReservationLedger(persister)
+    assert view.ledger.changed_hosts_since(old_token) is None
+    snaps = {s.host.host_id: s for s in inv.snapshots(view)}
+    assert snaps[hosts[0].host_id].cpus == hosts[0].cpus - 10.0, (
+        "stale snapshot served after ledger rebuild"
+    )
+
+
+def test_gang_prefilter_uses_host_blocks_not_declared_chips():
+    """Regression (review r9): the torus slice pre-filter must size
+    per-slice host need from the HOSTS' chip blocks — a spec that
+    mis-declares chips-per-host must not make the fast path skip a
+    slice the full search would place in."""
+    yaml_text = """
+name: jax
+pods:
+  trainer:
+    count: 2
+    gang: true
+    tpu:
+      generation: v5e
+      chips-per-host: 4
+      topology: 4x4
+    tasks:
+      worker:
+        goal: FINISH
+        cmd: "python train.py"
+        cpus: 1.0
+        memory: 1024
+"""
+    spec = from_yaml(yaml_text)
+    persister = MemPersister()
+    store = StateStore(persister)
+    ledger = ReservationLedger(persister)
+    # slice-a hosts own 2x4 = 8-chip blocks: a 4x4 topology needs TWO
+    # fully free hosts there, not the declared-chips-derived four.
+    # slice-b is the decoy: four 4-chip hosts (passes the BUGGY
+    # declared-chips count) in a 4x1 grid that can never tile 4x4 —
+    # without it the empty-eligible fallback would mask the bug.
+    hosts = make_test_fleet(
+        slice_id="pod-a", host_grid=(2, 1), chip_block=(2, 4), cpus=16.0
+    ) + make_test_fleet(
+        slice_id="pod-b", host_grid=(4, 1), chip_block=(2, 2), cpus=16.0
+    )
+    inv = SliceInventory(hosts)
+    ev = OfferEvaluator(store, ledger, spec.name, "cfg-1")
+    requirement = PodInstanceRequirement(
+        pod=spec.pod("trainer"), instances=[0, 1]
+    )
+    fast = ev.evaluate(requirement, inv)
+    slow = oracle_result(spec, store, ledger, hosts, set(), requirement)
+    assert outcome_signature(fast) == outcome_signature(slow)
+    assert fast.passed, fast.outcome.flatten()
+
+
+def test_view_cache_bounded_under_view_churn():
+    """Regression (review r9): superseded view objects (live options
+    updates rebuild the ledger) must not pin fleet-sized snapshot
+    caches forever."""
+    persister = MemPersister()
+    inv = SliceInventory(make_test_fleet(host_grid=(2, 2)))
+    for _ in range(40):
+        inv.snapshots(ReservationLedger(persister))
+    assert len(inv._view_caches) <= inv._MAX_VIEW_CACHES
+
+
+def test_admission_feasibility_is_per_host_not_composite():
+    """Regression (review r9): a fleet with a 16-cpu/low-mem host and
+    an 8-cpu/high-mem host must REJECT a pod needing 12 cpus AND high
+    memory — no single host fits, even though the per-dimension maxima
+    would."""
+    from dcos_commons_tpu.multi.admission import validate_service_yaml
+
+    inv = SliceInventory([
+        TpuHost(host_id="cpuheavy", cpus=16.0, memory_mb=4096),
+        TpuHost(host_id="memheavy", cpus=8.0, memory_mb=262144),
+    ])
+    yaml_text = """
+name: fatpod
+pods:
+  app:
+    count: 1
+    tasks:
+      main:
+        goal: RUNNING
+        cmd: sleep 1000
+        cpus: 12
+        memory: 131072
+"""
+    spec, findings = validate_service_yaml(yaml_text, "fatpod", inventory=inv)
+    assert any(f.rule == "spec-resources" for f in findings), findings
+    # and each shape alone still admits what fits it
+    fits = yaml_text.replace("cpus: 12", "cpus: 4")
+    spec, findings = validate_service_yaml(fits, "fatpod", inventory=inv)
+    assert not [f for f in findings if f.rule == "spec-resources"], findings
+    # the rejection's remediation hint is the admission one, not the
+    # CI walker's CLI flags (which do not exist for a PUT)
+    spec, findings = validate_service_yaml(yaml_text, "fatpod", inventory=inv)
+    msg = next(f for f in findings if f.rule == "spec-resources").message
+    assert "--host-cpus" not in msg and "add larger hosts" in msg, msg
+
+
+def test_admission_skips_feasibility_when_no_hosts_up():
+    """A spec sized for the real fleet must be admitted while zero
+    hosts are up (scheduler bootstrap, transient outage): judging it
+    against the CI default shape would gate service registration on
+    fleet availability — the deploy plan just waits for hosts."""
+    from dcos_commons_tpu.multi.admission import validate_service_yaml
+
+    yaml_text = """
+name: bigpod
+pods:
+  app:
+    count: 1
+    tasks:
+      main:
+        goal: RUNNING
+        cmd: sleep 1000
+        cpus: 64
+        memory: 524288
+"""
+    for inv in (None, SliceInventory([])):
+        spec, findings = validate_service_yaml(
+            yaml_text, "bigpod", inventory=inv
+        )
+        assert spec is not None and not findings, (inv, findings)
+    # a fleet whose hosts are all DOWN is an unknown fleet too
+    inv = SliceInventory([TpuHost(host_id="h0", cpus=128.0,
+                                  memory_mb=1048576)])
+    inv.mark_down("h0")
+    spec, findings = validate_service_yaml(yaml_text, "bigpod", inventory=inv)
+    assert spec is not None and not findings, findings
+    # ...but an up host that cannot fit the pod still rejects
+    inv.mark_up("h0")
+    too_fat = yaml_text.replace("cpus: 64", "cpus: 256")
+    spec, findings = validate_service_yaml(too_fat, "bigpod", inventory=inv)
+    assert any(f.rule == "spec-resources" for f in findings), findings
+
+
+# -- suppress / revive ------------------------------------------------
+
+MULTI_SVC_YAML = """
+name: {name}
+pods:
+  app:
+    count: 1
+    tasks:
+      server:
+        goal: RUNNING
+        cmd: sleep 1000
+        cpus: 0.5
+        memory: 256
+"""
+
+
+def build_multi():
+    from dcos_commons_tpu.multi.scheduler import MultiServiceScheduler
+    from dcos_commons_tpu.scheduler.config import SchedulerConfig
+    from dcos_commons_tpu.testing import FakeAgent
+
+    agent = FakeAgent()
+    inv = SliceInventory([TpuHost(host_id=f"h{i}") for i in range(4)])
+    multi = MultiServiceScheduler(
+        MemPersister(), inv, agent,
+        scheduler_config=SchedulerConfig(backoff_enabled=False),
+    )
+    return multi, agent
+
+
+def deploy_all(multi, agent, cycles=10):
+    for _ in range(cycles):
+        multi.run_cycle()
+        for info in list(agent.launched):
+            agent.send(TaskStatus(
+                task_id=info.task_id, state=TaskState.RUNNING,
+                ready=True, agent_id=info.agent_id,
+            ))
+    multi.run_cycle()
+
+
+def test_idle_services_suppress_and_status_revives():
+    multi, agent = build_multi()
+    multi.add_service(from_yaml(MULTI_SVC_YAML.format(name="svc-a")))
+    multi.add_service(from_yaml(MULTI_SVC_YAML.format(name="svc-b")))
+    deploy_all(multi, agent)
+    for name in ("svc-a", "svc-b"):
+        plan = multi.get_service(name).deploy_manager.get_plan()
+        assert plan.is_complete, f"{name} did not deploy"
+    multi.run_cycle()
+    state = multi.suppress_state()
+    assert state["suppressed_services"] == ["svc-a", "svc-b"]
+    # the gauge rides every service's metrics snapshot
+    svc = multi.get_service("svc-a")
+    assert svc.metrics.snapshot()["cycle.suppressed_services"] == 2.0
+    # a suppressed service's cycle count stays flat
+    before = svc.metrics.snapshot().get("cycle.process.count", 0)
+    for _ in range(3):
+        multi.run_cycle()
+    assert svc.metrics.snapshot().get("cycle.process.count", 0) == before
+    # a status about its own task revives it (and only it)
+    info = agent.task_info_of("app-0-server")
+    assert info is not None
+    agent.send(TaskStatus(
+        task_id=info.task_id, state=TaskState.FAILED, agent_id=info.agent_id,
+    ))
+    multi.run_cycle()
+    assert "svc-a" in multi.suppress_state()["suppressed_services"] or \
+        "svc-b" in multi.suppress_state()["suppressed_services"]
+    # the owner woke and scheduled recovery work; drive it to done
+    deploy_all(multi, agent)
+    owner = "svc-a" if multi.get_service("svc-a").state_store.fetch_task(
+        "app-0-server"
+    ) and multi.get_service("svc-a").state_store.fetch_task(
+        "app-0-server"
+    ).task_id == info.task_id else "svc-b"
+    recovery = multi.get_service(owner).plan("recovery")
+    assert recovery is None or not multi.get_service(owner).work_pending()
+
+
+def test_http_mutation_revives_suppressed_service():
+    """An operator verb (pod restart -> nudge) on a suppressed
+    service revives it on the next merged cycle — it never misses
+    the work its own mutation created."""
+    multi, agent = build_multi()
+    multi.add_service(from_yaml(MULTI_SVC_YAML.format(name="svc-a")))
+    deploy_all(multi, agent)
+    multi.run_cycle()
+    assert multi.suppress_state()["suppressed_services"] == ["svc-a"]
+    svc = multi.get_service("svc-a")
+    old_id = agent.task_id_of("app-0-server")
+    svc.restart_pod("app", 0)  # kills + nudges, as the HTTP route does
+    deploy_all(multi, agent)
+    assert not svc.work_pending()
+    new_id = agent.task_id_of("app-0-server")
+    assert new_id is not None and new_id != old_id, \
+        "suppressed service missed its own restart work"
+    multi.run_cycle()
+    assert multi.suppress_state()["suppressed_services"] == ["svc-a"]
+
+
+def test_failed_cycle_leaves_revived_service_runnable():
+    """A revived service whose cycle raises must not stay suppressed:
+    its nudge was already consumed, so staying in the suppress set
+    would skip it forever — the operator verb silently dropped and the
+    consecutive-failure wedge detection unreachable."""
+    multi, agent = build_multi()
+    multi.add_service(from_yaml(MULTI_SVC_YAML.format(name="svc-a")))
+    deploy_all(multi, agent)
+    multi.run_cycle()
+    assert multi.suppress_state()["suppressed_services"] == ["svc-a"]
+    svc = multi.get_service("svc-a")
+    real_cycle = svc.run_cycle
+
+    def exploding_cycle(*a, **kw):
+        raise RuntimeError("transient store blip")
+
+    svc.run_cycle = exploding_cycle
+    svc.nudge()  # operator verb revives it...
+    multi.run_cycle()  # ...and the revived cycle fails
+    assert "svc-a" not in multi.suppress_state()["suppressed_services"], \
+        "failed cycle left the service suppressed with its nudge consumed"
+    # next cycle retries without any new trigger, and recovery resumes
+    svc.run_cycle = real_cycle
+    multi.run_cycle()
+    assert multi._cycle_failures["svc-a"] == 0
+
+
+# -- admission control ------------------------------------------------
+
+VALID_ADD_YAML = """
+name: added
+pods:
+  app:
+    count: 1
+    tasks:
+      server:
+        goal: RUNNING
+        cmd: sleep 1000
+        cpus: 0.5
+        memory: 256
+"""
+
+# plan names a pod that does not exist + a fixed-port conflict
+INVALID_ADD_YAML = """
+name: added
+pods:
+  app:
+    count: 2
+    tasks:
+      server:
+        goal: RUNNING
+        cmd: sleep 1000
+        cpus: 0.5
+        memory: 256
+        ports:
+          web: {port: 8080}
+plans:
+  deploy:
+    strategy: serial
+    phases:
+      main:
+        pod: nosuchpod
+"""
+
+
+def test_admission_rejects_invalid_spec_with_422_and_findings():
+    import json
+    import urllib.request
+
+    from dcos_commons_tpu.http.server import ApiServer
+
+    multi, agent = build_multi()
+    server = ApiServer(multi=multi, port=0).start()
+    try:
+        def put(body):
+            req = urllib.request.Request(
+                f"{server.url}/v1/multi/added", data=body.encode(),
+                method="PUT",
+            )
+            try:
+                with urllib.request.urlopen(req) as resp:
+                    return resp.status, json.loads(resp.read())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read())
+
+        code, body = put(INVALID_ADD_YAML)
+        assert code == 422, body
+        rules = {f["rule"] for f in body["findings"]}
+        assert "spec-plan" in rules
+        assert "spec-ports" in rules
+        # line-anchored: findings point into the submitted YAML
+        assert all(f["line"] >= 1 for f in body["findings"])
+        assert all(f["file"] == "added.yml" for f in body["findings"])
+        # nothing persisted
+        assert "added" not in multi.service_names()
+
+        code, body = put(VALID_ADD_YAML)
+        assert code == 200, body
+        assert "added" in multi.service_names()
+        # accepted unchanged: the stored spec round-trips the YAML
+        entry = multi.service_store.fetch("added")
+        assert entry["spec"]["name"] == "added"
+    finally:
+        server.stop()
+
+
+def test_admission_ignores_suppression_comments_in_payload():
+    """Suppression comments are a CI affordance; in the admission
+    path they live in the operator-submitted body, so honoring them
+    would let any payload waive its own rejection."""
+    from dcos_commons_tpu.multi.admission import validate_service_yaml
+
+    suppressed_invalid = "# sdklint: disable-file=all\n" + INVALID_ADD_YAML
+    spec, findings = validate_service_yaml(suppressed_invalid, "added")
+    assert {f.rule for f in findings} >= {"spec-plan", "spec-ports"}, findings
+
+    # an unparseable body whose render finding is "suppressed" must
+    # still reject (spec=None can never be admitted)
+    spec, findings = validate_service_yaml(
+        "# sdklint: disable-file=all\n:not yaml: [", "added"
+    )
+    assert spec is None
+    assert findings, "render failure admitted with zero findings"
+
+
+def test_admission_mesh_derivation_for_jax_workloads():
+    """A jax-targeting spec whose topology cannot lay a host-aligned
+    mesh is rejected with the shard-mesh rule, line-anchored at the
+    pod; a derivable one is admitted."""
+    from dcos_commons_tpu.multi.admission import validate_service_yaml
+
+    bad = """
+name: jaxsvc
+pods:
+  trainer:
+    count: 2
+    gang: true
+    tpu:
+      generation: v5e
+      chips-per-host: 3
+      topology: 4x4
+    tasks:
+      worker:
+        goal: FINISH
+        cmd: "python train_worker.py"
+        cpus: 1.0
+        memory: 1024
+"""
+    spec, findings = validate_service_yaml(bad, "jaxsvc")
+    assert any(f.rule == "shard-mesh" for f in findings), findings
+    anchored = [f for f in findings if f.rule == "shard-mesh"]
+    assert all(f.line > 1 for f in anchored)  # at the pod line, not 1
+
+    good = bad.replace("chips-per-host: 3", "chips-per-host: 4")
+    spec, findings = validate_service_yaml(good, "jaxsvc")
+    assert spec is not None
+    assert not [f for f in findings if f.rule == "shard-mesh"], findings
+
+
+def test_admission_mesh_uses_profile_mesh_not_bare_derive():
+    """Admission must reach the same verdict CI shardcheck does: the
+    serve profiles pin their own meshes (serve_worker = single chip),
+    so a 4-chip reservation for serve_worker.py is 'reserved chips
+    sit idle' even though derive(env) would happily lay dp=4."""
+    from dcos_commons_tpu.multi.admission import validate_service_yaml
+
+    idle_chips = """
+name: servesvc
+pods:
+  server:
+    count: 1
+    tpu:
+      generation: v5e
+      chips-per-host: 4
+    tasks:
+      serve:
+        goal: RUNNING
+        cmd: "python serve_worker.py"
+        cpus: 1.0
+        memory: 1024
+"""
+    spec, findings = validate_service_yaml(idle_chips, "servesvc")
+    mesh = [f for f in findings if f.rule == "shard-mesh"]
+    assert mesh and "sit idle" in mesh[0].message, findings

@@ -147,7 +147,11 @@ def test_configs_state_endpoints_debug_metrics(deployed):
     assert len(ep["address"]) == 2
 
     offers = get(server, "/v1/debug/offers")
-    assert offers and offers[-1]["passed"]
+    assert offers["outcomes"] and offers["outcomes"][-1]["passed"]
+    evaluation = offers["evaluation"]
+    assert evaluation["snapshot_cache"]["hits"] >= 0
+    assert "last_dirty_hosts" in evaluation
+    assert evaluation["counters"].get("offers.evaluated", 0) >= 1
     reservations = get(server, "/v1/debug/reservations")
     assert len(reservations) >= 2
     metrics = get(server, "/v1/metrics")

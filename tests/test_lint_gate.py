@@ -145,6 +145,40 @@ def test_rule_ledger_mutation(tmp_path):
     assert not findings and len(suppressed) == 1
 
 
+def test_rule_ledger_mutation_covers_index_maintenance(tmp_path):
+    """Fleet-scale extension: the inverted field indexes and per-view
+    snapshot caches may never be written around the generation-bumping
+    mutators — an index diverging from the ledger mis-routes every
+    future placement silently."""
+    # public inventory method mutating host state without a bump
+    findings, _ = _lint_fixture(
+        tmp_path,
+        """
+        class SliceInventory:
+            def evil_drain(self, host_id):
+                self._down.add(host_id)
+
+            def good_drain(self, host_id):
+                self._down.add(host_id)
+                self._topology_gen += 1
+                self._host_topo_gen[host_id] = self._topology_gen
+        """,
+        rule_id="ledger-mutation",
+    )
+    assert len(findings) == 1 and "evil_drain" in findings[0].message
+    # external reach-in to the index/cache structures is banned
+    # anywhere — even well-meaning "just patch the index" code
+    for reach in (
+        "def patch(inv, h):\n    inv._field_indexes['zone']['z'] = {h}\n",
+        "def patch(inv, h):\n    inv._view_caches.clear()\n",
+        "def patch(inv, h):\n    inv._ordinal_cache[h] = 0\n",
+    ):
+        findings, _ = _lint_fixture(
+            tmp_path, reach, rule_id="ledger-mutation"
+        )
+        assert len(findings) == 1, reach
+
+
 def test_rule_lock_discipline(tmp_path):
     src = """
     import threading

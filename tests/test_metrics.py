@@ -11,6 +11,31 @@ import socket
 from dcos_commons_tpu.metrics.registry import Metrics
 
 
+def test_timer_samples_window_survives_ring_trim():
+    """Phase-window callers (bench_fleet_scale) read timer_count()
+    before a phase and timer_samples(since_count=...) after; the
+    window must stay correct even when the 256-sample ring trims."""
+    m = Metrics()
+    for _ in range(10):
+        with m.time("t"):
+            pass
+    n0 = m.timer_count("t")
+    assert n0 == 10
+    assert m.timer_samples("t", since_count=n0) == []
+    for _ in range(5):
+        with m.time("t"):
+            pass
+    assert len(m.timer_samples("t", since_count=n0)) == 5
+    assert len(m.timer_samples("t")) == 15
+    # trim past the boundary: only the retained newest samples return
+    for _ in range(300):
+        with m.time("t"):
+            pass
+    windowed = m.timer_samples("t", since_count=n0)
+    assert len(windowed) == 256  # ring cap, not 305
+    assert m.timer_count("t") == 315
+
+
 def test_prometheus_types_counters_as_counter():
     m = Metrics()
     m.incr("operations.launch", 3)
