@@ -744,6 +744,156 @@ def bench_trace_overhead() -> dict:
     }
 
 
+def bench_health_overhead() -> dict:
+    """Fleet health plane overhead bound (ISSUE 10): the trace-bench
+    scenario (serial deploy over 64 TPU hosts, 32 steps = 2x the issue
+    scenario for stable medians) with the health plane DISABLED
+    (health_enabled=False -> NullHealthMonitor) vs ENABLED in
+    LOCKSTEP — same pairing/median discipline as bench_trace_overhead.
+    The enabled arm pays the full per-cycle bill: detector pass every
+    cycle (straggler median-ratio over a seeded 64-host steplog fan-in,
+    SLO watch, lease-churn watch), plan-transition journaling with
+    per-dirty-cycle flushes through the store, and metric-history
+    sampling at the production 1s cadence.  Tracing is OFF in both
+    arms so the ratio isolates the health plane.  The assertion
+    enforces the acceptance criterion: detectors + journal must cost
+    <5% of the offer-cycle figure."""
+    from dcos_commons_tpu.common import TaskState, TaskStatus
+    from dcos_commons_tpu.offer.inventory import (
+        SliceInventory,
+        make_test_fleet,
+    )
+    from dcos_commons_tpu.scheduler import SchedulerBuilder, SchedulerConfig
+    from dcos_commons_tpu.specification import from_yaml
+    from dcos_commons_tpu.storage import MemPersister
+    from dcos_commons_tpu.testing import FakeAgent
+
+    n_steps = 32
+    yaml_text = (
+        "name: healthoverhead\n"
+        "pods:\n"
+        "  app:\n"
+        f"    count: {n_steps}\n"
+        "    placement: 'max-per-host:1'\n"
+        "    tasks:\n"
+        "      server:\n"
+        "        goal: RUNNING\n"
+        "        cmd: sleep 1000\n"
+        "        cpus: 2\n"
+        "        memory: 1024\n"
+        "plans:\n"
+        "  deploy:\n"
+        "    strategy: serial\n"
+        "    phases:\n"
+        "      app:\n"
+        "        strategy: serial\n"
+        "        pod: app\n"
+    )
+
+    def steplog_of(task_name, agent_id=None):
+        # the shape a real gang-skew steplog has: 8 trailing records
+        # per task, one implicit straggler (app-7's host shows 10x own
+        # time), so the enabled arm's detector does real scoring work
+        own = 1.0 if task_name.startswith("app-7-") else 0.1
+        return [
+            {"step": i, "t": 100.0 + i, "wall_s": 1.0,
+             "blocked_s": round(1.0 - own, 3), "tokens": 4096}
+            for i in range(8)
+        ]
+
+    def build_world(enabled: bool):
+        hosts = []
+        for s in range(4):
+            hosts.extend(make_test_fleet(
+                slice_id=f"pod-{s}", host_grid=(4, 4), chip_block=(2, 2),
+                cpus=32.0, memory_mb=131072,
+            ))
+        builder = SchedulerBuilder(
+            from_yaml(yaml_text),
+            SchedulerConfig(
+                backoff_enabled=False, revive_capacity=10**9,
+                trace_capacity=0, health_enabled=enabled,
+            ),
+            MemPersister(),
+        )
+        builder.set_inventory(SliceInventory(hosts))
+        agent = FakeAgent()
+        agent.steplog_of = steplog_of
+        builder.set_agent(agent)
+        scheduler = builder.build()
+        # charge steplog fan-in + detector scoring at 20 Hz — 100x
+        # the production 5s cadence (sub-ms sim cycles would otherwise
+        # outrun the throttle and never exercise the detectors): the
+        # measured ratio upper-bounds what an operator pays
+        if enabled:
+            scheduler.health.telemetry_interval_s = 0.05
+        return scheduler, agent, set()
+
+    def tick(scheduler, agent, acked):
+        t0 = time.monotonic()
+        scheduler.run_cycle()
+        elapsed = time.monotonic() - t0
+        for info in list(agent.launched):
+            if info.task_id not in acked:
+                acked.add(info.task_id)
+                agent.send(TaskStatus(
+                    task_id=info.task_id, state=TaskState.RUNNING,
+                    ready=True, agent_id=info.agent_id,
+                ))
+        return elapsed
+
+    import gc
+
+    for warm_enabled in (False, True):
+        scheduler, agent, acked = build_world(warm_enabled)
+        for _ in range(10 * n_steps):
+            tick(scheduler, agent, acked)
+            if scheduler.deploy_manager.get_plan().is_complete:
+                break
+    sched_off, agent_off, acked_off = build_world(False)
+    sched_on, agent_on, acked_on = build_world(True)
+    off_times, on_times = [], []
+    gc.collect()
+    gc.disable()
+    try:
+        for _ in range(10 * n_steps):
+            off_times.append(tick(sched_off, agent_off, acked_off))
+            on_times.append(tick(sched_on, agent_on, acked_on))
+            if sched_off.deploy_manager.get_plan().is_complete and \
+                    sched_on.deploy_manager.get_plan().is_complete:
+                break
+    finally:
+        gc.enable()
+    assert sched_off.deploy_manager.get_plan().is_complete
+    assert sched_on.deploy_manager.get_plan().is_complete
+    # sanity: the enabled arm actually did health work (journal
+    # carries the deploy's plan transitions; a vacuous arm would make
+    # the 5% bound meaningless)
+    journaled = sched_on.journal.last_seq
+    assert journaled >= n_steps, f"journal only reached seq {journaled}"
+    assert not sched_off.journal.enabled
+    # ...and the detectors actually scored the seeded straggler
+    assert sched_on.health.straggler.suspects, "straggler never scored"
+    ratios = sorted(
+        on / max(off, 1e-9) for off, on in zip(off_times, on_times)
+    )
+    overhead = ratios[len(ratios) // 2] - 1.0
+    assert overhead < 0.05, (
+        f"health plane overhead {overhead * 100:.1f}% exceeds the 5% "
+        f"bound (median per-cycle ratio over {len(ratios)} lockstep "
+        f"cycles; totals {sum(on_times):.4f}s enabled vs "
+        f"{sum(off_times):.4f}s)"
+    )
+    return {
+        "health_overhead_deploy_s_disabled": round(sum(off_times), 4),
+        "health_overhead_deploy_s_enabled": round(sum(on_times), 4),
+        "health_overhead_pct": round(overhead * 100, 2),
+        "health_overhead_cycles": len(ratios),
+        "health_overhead_journal_seq": journaled,
+        "health_overhead_suspects": len(sched_on.health.straggler.suspects),
+    }
+
+
 def bench_failover() -> dict:
     """HA failover latency (ISSUE 8): a 64-host/32-pod deploy is
     driven halfway by leader scheduler A, which is then hard-killed
@@ -2312,6 +2462,13 @@ def main() -> None:
     except Exception as e:
         extras["trace_overhead_error"] = repr(e)[:200]
     _mark("trace_overhead")
+    # fleet health plane (ISSUE 10): detectors + journal overhead on
+    # the trace-bench scenario, fenced at <5% of cycle cost
+    try:
+        extras.update(bench_health_overhead())
+    except Exception as e:
+        extras["health_overhead_error"] = repr(e)[:200]
+    _mark("health_overhead")
     # HA failover latency (ISSUE 8): standby takeover during a 64-host
     # deploy — lease wait / rebuild / first-working-cycle breakdown
     try:

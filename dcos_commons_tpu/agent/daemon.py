@@ -19,6 +19,8 @@ Protocol (JSON over HTTP, scheduler -> agent):
     POST /v1/agent/drain   -> {statuses: [...]}   (drains pending updates)
     POST /v1/agent/reconcile  (re-arm current task states for re-delivery)
     GET  /v1/agent/sandbox?task=<name>&file=<rel> -> file text (debugging)
+    GET  /v1/agent/steplog?task=<name>    -> {records: [...]}  (telemetry)
+    GET  /v1/agent/servestats?task=<name> -> {stats: {...}}    (telemetry)
 
 Statuses are *pulled* by the scheduler (drain), matching the poll-based
 Agent contract — the daemon never needs to know where the scheduler
@@ -132,6 +134,27 @@ class AgentDaemon:
                         # connection
                         with open(path, "r", errors="replace") as f:
                             self._reply(200, f.read())
+                    elif parsed.path == "/v1/agent/steplog":
+                        # worker step telemetry for the scheduler's
+                        # traceview merge + straggler detector (the
+                        # remote half of LocalProcessAgent.steplog_of)
+                        query = parse_qs(parsed.query)
+                        task = (query.get("task") or [""])[0]
+                        if not daemon.valid_task_name(task):
+                            self._reply(404, {"message": "bad task name"})
+                            return
+                        self._reply(200, {
+                            "records": daemon._executor.steplog_of(task)
+                        })
+                    elif parsed.path == "/v1/agent/servestats":
+                        query = parse_qs(parsed.query)
+                        task = (query.get("task") or [""])[0]
+                        if not daemon.valid_task_name(task):
+                            self._reply(404, {"message": "bad task name"})
+                            return
+                        self._reply(200, {
+                            "stats": daemon._executor.serving_stats_of(task)
+                        })
                     else:
                         self._reply(
                             404, {"message": f"no route {parsed.path}"}
@@ -178,6 +201,12 @@ class AgentDaemon:
         self._thread: Optional[threading.Thread] = None
 
     # -- request handling --------------------------------------------
+
+    def valid_task_name(self, task: str) -> bool:
+        """Task names are attacker-controlled query params; the
+        steplog/servestats readers join them onto the workdir, so the
+        same confinement as sandbox reads applies."""
+        return bool(task) and os.sep not in task and task not in (".", "..")
 
     def resolve_sandbox_path(self, task: str, rel: str) -> Optional[str]:
         """Confine sandbox reads to the named task's sandbox: both the

@@ -977,19 +977,24 @@ class LocalProcessAgent:
     def sandbox_of(self, task_name: str) -> str:
         return os.path.join(self._workdir, task_name)
 
-    def steplog_of(self, task_name: str) -> List[dict]:
+    def steplog_of(
+        self, task_name: str, agent_id: Optional[str] = None
+    ) -> List[dict]:
         """Worker step telemetry from the task's sandbox
         (trace/steplog.py JSONL): the scheduler's /v1/debug/trace
         merges these into the control-plane timeline so gang skew
         across hosts is visible in one view.  [] when the task never
-        wrote one."""
+        wrote one.  ``agent_id`` is the routing hint RemoteFleet
+        needs; one sandbox tree serves every simulated host here."""
         from dcos_commons_tpu.trace.steplog import STEPLOG_NAME, read_steplog
 
         return read_steplog(
             os.path.join(self._workdir, task_name, STEPLOG_NAME)
         )
 
-    def serving_stats_of(self, task_name: str) -> dict:
+    def serving_stats_of(
+        self, task_name: str, agent_id: Optional[str] = None
+    ) -> dict:
         """Serving-load gauges from the task's sandbox (serve/engine.py
         servestats.json): queue depth, active slots, KV occupancy,
         tokens/s.  The scheduler's /v1/debug/serving merges these per

@@ -113,6 +113,27 @@ class RemoteAgentClient:
         raw = self._request("POST", "/v1/agent/drain")
         return [TaskStatus.from_dict(s) for s in raw["statuses"]]
 
+    def steplog_of(self, task_name: str) -> List[dict]:
+        """Worker step telemetry off the daemon's sandbox (the remote
+        half of LocalProcessAgent.steplog_of)."""
+        from urllib.parse import quote
+
+        body = self._request(
+            "GET", f"/v1/agent/steplog?task={quote(task_name)}"
+        )
+        records = body.get("records")
+        return records if isinstance(records, list) else []
+
+    def serving_stats_of(self, task_name: str) -> dict:
+        """Serving-engine gauges off the daemon's sandbox."""
+        from urllib.parse import quote
+
+        body = self._request(
+            "GET", f"/v1/agent/servestats?task={quote(task_name)}"
+        )
+        stats = body.get("stats")
+        return stats if isinstance(stats, dict) else {}
+
     def sandbox_file(self, task_name: str, rel: str = "stdout") -> str:
         from urllib.parse import quote
 
@@ -158,6 +179,15 @@ class RemoteFleet(Agent):
         # task_id -> host_id for kill routing + LOST synthesis; rebuilt
         # lazily from daemon task lists after a scheduler restart
         self._owners: Dict[str, str] = {}
+        # telemetry routes by task NAME: a generation-stamped lazy
+        # index over _owners (every mutation bumps _owners_gen, the
+        # index rebuilds once per change) — the health monitor makes
+        # TWO name lookups per task per refresh, and a linear
+        # owner-map scan per lookup would be O(tasks^2) per refresh
+        # under the fleet lock
+        self._owners_gen = 0
+        self._owner_names: Dict[str, str] = {}
+        self._owner_names_gen = -1
         self._pending: List[TaskStatus] = []
         self.on_host_down = on_host_down
         self.on_host_up = on_host_up
@@ -245,7 +275,9 @@ class RemoteFleet(Agent):
             self._fail_launch(info, f"agent unreachable at launch: {e}")
             return
         with self._lock:
-            self._owners[info.task_id] = info.agent_id
+            if self._owners.get(info.task_id) != info.agent_id:
+                self._owners[info.task_id] = info.agent_id
+                self._owners_gen += 1
 
     def _fail_launch(self, info: TaskInfo, message: str) -> None:
         LOG.warning("launch of %s failed: %s", info.task_id, message)
@@ -289,7 +321,9 @@ class RemoteFleet(Agent):
             self._note_success(host_id)
             with self._lock:
                 for task_id in result:
-                    self._owners.setdefault(task_id, host_id)
+                    if task_id not in self._owners:
+                        self._owners[task_id] = host_id
+                        self._owners_gen += 1
             out |= result
         return out
 
@@ -326,10 +360,16 @@ class RemoteFleet(Agent):
             self._note_success(host_id)
             for status in statuses:
                 with self._lock:
+                    # bump the generation only when the map actually
+                    # changed: a reconcile()-re-emitted RUNNING is a
+                    # no-op here, and a spurious bump would rebuild
+                    # the telemetry name index every refresh
                     if status.state.is_terminal:
-                        self._owners.pop(status.task_id, None)
-                    else:
-                        self._owners.setdefault(status.task_id, host_id)
+                        if self._owners.pop(status.task_id, None) is not None:
+                            self._owners_gen += 1
+                    elif status.task_id not in self._owners:
+                        self._owners[status.task_id] = host_id
+                        self._owners_gen += 1
                 out.append(status)
         return out
 
@@ -371,6 +411,8 @@ class RemoteFleet(Agent):
             lost = [t for t, h in self._owners.items() if h == host_id]
             for task_id in lost:
                 del self._owners[task_id]
+            if lost:
+                self._owners_gen += 1
         return [
             TaskStatus(
                 task_id=task_id,
@@ -384,3 +426,77 @@ class RemoteFleet(Agent):
     def down_hosts(self) -> Set[str]:
         with self._lock:
             return set(self._down)
+
+    # -- worker telemetry fan-in (best-effort) ------------------------
+
+    def _owner_client(self, task_name: str) -> Optional[RemoteAgentClient]:
+        """The daemon holding ``task_name``'s sandbox, via the
+        name-keyed owner index (rebuilt from the owner map only when
+        it changed — so a telemetry refresh over N tasks costs O(N)
+        once, not O(N^2); the owner map itself is rebuilt from daemon
+        task lists after a restart, so a freshly failed-over scheduler
+        regains telemetry after its first poll)."""
+        from dcos_commons_tpu.common import task_name_of
+
+        with self._lock:
+            if self._owner_names_gen != self._owners_gen:
+                names: Dict[str, str] = {}
+                for task_id, host_id in self._owners.items():
+                    try:
+                        names[task_name_of(task_id)] = host_id
+                    except ValueError:
+                        continue
+                self._owner_names = names
+                self._owner_names_gen = self._owners_gen
+            host_id = self._owner_names.get(task_name)
+            if host_id is None or host_id in self._down:
+                return None
+            return self._clients.get(host_id)
+
+    def _telemetry_client(
+        self, task_name: str, agent_id: Optional[str]
+    ) -> Optional[RemoteAgentClient]:
+        """Callers that know which host owns the task (the health
+        monitor reads ``info.agent_id`` from its own state store) pass
+        it and route EXACTLY — task names are not service-qualified,
+        so on a fleet shared by several services the name index could
+        hand service A another service's same-named task.  Name-based
+        lookup stays as the fallback for host-agnostic callers."""
+        if agent_id:
+            with self._lock:
+                if agent_id in self._down:
+                    return None
+                return self._clients.get(agent_id)
+        return self._owner_client(task_name)
+
+    def steplog_of(
+        self, task_name: str, agent_id: Optional[str] = None
+    ) -> List[dict]:
+        """Worker step telemetry over the wire — the production
+        topology's half of the /v1/debug/trace merge and the
+        straggler detector's input.  Best-effort by contract: no
+        owner, a down host, or a failed RPC reads as "no telemetry",
+        never as an error (liveness is poll()'s job — a telemetry
+        probe must not move the down-detection counters)."""
+        client = self._telemetry_client(task_name, agent_id)
+        if client is None:
+            return []
+        try:
+            return client.steplog_of(task_name)
+        except (urllib.error.URLError, OSError, json.JSONDecodeError,
+                ValueError):
+            return []
+
+    def serving_stats_of(
+        self, task_name: str, agent_id: Optional[str] = None
+    ) -> dict:
+        """Serving-engine gauges over the wire (same best-effort
+        contract as steplog_of)."""
+        client = self._telemetry_client(task_name, agent_id)
+        if client is None:
+            return {}
+        try:
+            return client.serving_stats_of(task_name)
+        except (urllib.error.URLError, OSError, json.JSONDecodeError,
+                ValueError):
+            return {}

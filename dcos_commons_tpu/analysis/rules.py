@@ -173,6 +173,11 @@ class LedgerMutationRule(Rule):
         | {
             "_view_caches", "_field_indexes", "_ordinal_cache",
             "_up_ids_cache", "_hosts_by_id",
+            # scan-order state (health plane's suspect demotion): an
+            # external write would desync ordinals from the per-view
+            # ordered lists and break indexed-vs-full-scan equivalence
+            "_suspect", "_suspect_sources", "_order_gen",
+            "_scan_cache", "_scan_cache_gen",
         }
     )
 
@@ -701,6 +706,153 @@ class LeaseGatedMutationRule(Rule):
         return out
 
 
+class MetricCardinalityRule(Rule):
+    """Metric names built from unbounded runtime values (task ids,
+    request ids, host ids interpolated into ``Metrics.incr``/
+    ``gauge``/``time`` names) grow the registry — and every
+    Prometheus scrape, snapshot, and history ring — without bound:
+    ten thousand relaunches mint ten thousand immortal series.
+    Dynamic name parts must be BOUNDED vocabularies (enum ``.value``,
+    a literal loop), registered in ``METRIC_CARDINALITY_ALLOWLIST``
+    (for prefixes whose id-space is bounded elsewhere, with the bound
+    stated), or carry an explaining ``# sdklint: disable``.  The
+    check flags f-string/%%/.format()/concat name arguments whose
+    interpolated expression terminates in an id-shaped identifier
+    (``*_id``, ``task_id``, ``request_id``, ``pid``, ``task_name``,
+    ...)."""
+
+    id = "metric-cardinality"
+    description = "metric name built from an unbounded runtime id"
+
+    _METHODS = {"incr", "gauge", "time"}
+    _ID_SHAPED = {
+        "pid", "tid", "uuid", "task_name", "task", "request",
+        "hostname",
+    }
+
+    def applies_to(self, ctx: LintContext) -> bool:
+        return (
+            ctx.tree is not None
+            and ctx.rel.startswith("dcos_commons_tpu/")
+            and not ctx.rel.startswith("dcos_commons_tpu/testing/")
+        )
+
+    @classmethod
+    def _is_id_shaped(cls, name: str) -> bool:
+        lowered = name.lower().lstrip("_")
+        return (
+            lowered in cls._ID_SHAPED
+            or lowered.endswith("_id")
+            or lowered == "id"
+            or lowered.endswith("_uuid")
+        )
+
+    @classmethod
+    def _terminal_name(cls, node: ast.AST):
+        """The identifier a dynamic expression terminates in:
+        ``pid`` for ``pid``, ``task_id`` for ``status.task_id``,
+        ``task_id`` for ``info.task_id.upper()``."""
+        if isinstance(node, ast.Name):
+            return node.id
+        if isinstance(node, ast.Attribute):
+            return node.attr
+        if isinstance(node, ast.Call):
+            return cls._terminal_name(node.func.value) if isinstance(
+                node.func, ast.Attribute
+            ) else None
+        if isinstance(node, ast.FormattedValue):
+            return cls._terminal_name(node.value)
+        return None
+
+    def _dynamic_parts(self, arg: ast.AST):
+        """Yield the non-literal sub-expressions of a metric-name
+        argument, however it was concatenated."""
+        if isinstance(arg, ast.JoinedStr):
+            for part in arg.values:
+                if isinstance(part, ast.FormattedValue):
+                    yield part.value
+        elif isinstance(arg, ast.BinOp) and isinstance(
+            arg.op, (ast.Add, ast.Mod)
+        ):
+            for side in (arg.left, arg.right):
+                if isinstance(side, ast.Tuple):
+                    for elt in side.elts:
+                        if not isinstance(elt, ast.Constant):
+                            yield elt
+                elif isinstance(side, (ast.BinOp, ast.JoinedStr)):
+                    yield from self._dynamic_parts(side)
+                elif not isinstance(side, ast.Constant):
+                    yield side
+        elif isinstance(arg, ast.Call) and isinstance(
+            arg.func, ast.Attribute
+        ) and arg.func.attr == "format":
+            yield from arg.args
+            yield from (kw.value for kw in arg.keywords)
+
+    @staticmethod
+    def _literal_prefix(arg: ast.AST) -> str:
+        if isinstance(arg, ast.JoinedStr) and arg.values and isinstance(
+            arg.values[0], ast.Constant
+        ):
+            return str(arg.values[0].value)
+        if isinstance(arg, ast.BinOp) and isinstance(
+            arg.left, ast.Constant
+        ):
+            return str(arg.left.value)
+        return ""
+
+    def check(self, ctx: LintContext) -> List[Finding]:
+        out = []
+        for node in ast.walk(ctx.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in self._METHODS
+                and node.args
+            ):
+                continue
+            receiver = node.func.value
+            receiver_name = (
+                receiver.id if isinstance(receiver, ast.Name)
+                else receiver.attr if isinstance(receiver, ast.Attribute)
+                else ""
+            )
+            if "metric" not in receiver_name.lower() and \
+                    receiver_name.lower() != "registry":
+                continue
+            name_arg = node.args[0]
+            prefix = self._literal_prefix(name_arg)
+            if any(
+                prefix.startswith(allowed)
+                for allowed in METRIC_CARDINALITY_ALLOWLIST
+            ):
+                continue
+            for part in self._dynamic_parts(name_arg):
+                terminal = self._terminal_name(part)
+                if terminal is not None and self._is_id_shaped(terminal):
+                    out.append(ctx.finding(
+                        node, self.id,
+                        f"metric name interpolates {terminal!r} (an "
+                        "unbounded runtime id): every distinct value "
+                        "mints an immortal series in the registry, "
+                        "scrape, and history ring — key by a bounded "
+                        "vocabulary, register the prefix in "
+                        "METRIC_CARDINALITY_ALLOWLIST with its bound, "
+                        "or suppress with the bound stated",
+                    ))
+                    break
+        return out
+
+
+# metric-name prefixes whose dynamic part is bounded by something
+# other than the interpolated identifier's type — each entry states
+# the bound, which is the contract a reviewer checks when one is
+# added.  (Deliberately empty at ship: the one in-tree dynamic-id
+# metric, ha.replication.lag.<puller>, carries an inline suppression
+# with its bound instead, keeping the waiver next to the code.)
+METRIC_CARDINALITY_ALLOWLIST: tuple = ()
+
+
 def all_rules() -> List[Rule]:
     return [
         NoBlockingSleepRule(),
@@ -711,6 +863,7 @@ def all_rules() -> List[Rule]:
         TracerUnsafeCastRule(),
         SpanLeakRule(),
         LeaseGatedMutationRule(),
+        MetricCardinalityRule(),
     ]
 
 

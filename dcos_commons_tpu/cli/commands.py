@@ -110,7 +110,23 @@ def build_parser() -> argparse.ArgumentParser:
     p = sections.add_parser("debug")
     p.add_argument(
         "tracker",
-        choices=["offers", "plans", "taskStatuses", "reservations"],
+        choices=["offers", "plans", "taskStatuses", "reservations",
+                 "health", "events"],
+    )
+    p.add_argument(
+        "--metric", default=None, metavar="NAME",
+        help="(health) return one metric's full timestamped history "
+             "series instead of the summary rows",
+    )
+    p.add_argument(
+        "--since", default=None, metavar="SEQ",
+        help="(events) resume the journal cursor past this sequence "
+             "number (seqs survive scheduler failovers)",
+    )
+    p.add_argument(
+        "--kind", default=None, metavar="KIND",
+        help="(events) filter to one event kind, e.g. alert, operator, "
+             "plan, election, recovery, admission",
     )
 
     # update (reference: cli/commands/update.go — `update start
@@ -154,7 +170,7 @@ def run(args: argparse.Namespace) -> Any:
             return client.get(f"/v1/endpoints/{args.name}")
         return client.get("/v1/endpoints")
     if section == "debug":
-        return client.get(f"/v1/debug/{args.tracker}")
+        return _debug(client, args)
     if section == "update":
         return _update(client, args)
     if section == "metrics":
@@ -162,6 +178,23 @@ def run(args: argparse.Namespace) -> Any:
     if section == "health":
         return client.get("/v1/health")
     raise CliError(0, f"unknown section {section}")
+
+
+def _debug(client: ApiClient, args) -> Any:
+    from urllib.parse import urlencode
+
+    params = {}
+    if args.tracker == "health" and args.metric:
+        params["metric"] = args.metric
+    if args.tracker == "events":
+        if args.since:
+            params["since"] = args.since
+        if args.kind:
+            params["kind"] = args.kind
+    path = f"/v1/debug/{args.tracker}"
+    if params:
+        path = f"{path}?{urlencode(params)}"
+    return client.get(path)
 
 
 def _update(client: ApiClient, args) -> Any:

@@ -158,8 +158,11 @@ class LeaderLease:
         # lease is a first election, not a failover)
         self.takeovers = 0
         # set by HAState.attach so promote/resign events land in the
-        # owning scheduler's flight recorder
+        # owning scheduler's flight recorder — and, when a journal is
+        # wired, in the durable event journal (the lease-churn
+        # detector's raw material survives the churn it measures)
         self.tracer = None
+        self.journal = None
         # where the promote event lives: (trace_id, span_id), used by
         # the scheduler to chain rehydrate.replay to the promotion
         self.promote_ref: Optional[tuple] = None
@@ -295,6 +298,13 @@ class LeaderLease:
                 pass
 
     def _record_event(self, name: str, **attrs) -> None:
+        journal = self.journal
+        if journal is not None:
+            # append only: the flush rides the owning scheduler's
+            # cycle — a resign on the way OUT of leadership must not
+            # block on (or be rejected by) the store it just lost
+            journal.append("election", event=name, owner=self.owner,
+                           **attrs)
         tracer = self.tracer
         if tracer is None:
             return
@@ -487,6 +497,7 @@ class HAState:
         self._metrics = scheduler.metrics
         if self.lease is not None:
             self.lease.tracer = scheduler.tracer
+            self.lease.journal = getattr(scheduler, "journal", None)
             if self.lease.is_leader and self.lease.promote_ref is None:
                 # promoted before this scheduler (and its tracer)
                 # existed: re-record so the failover chain is complete
@@ -540,7 +551,7 @@ class HAState:
                 for pid in (status.get("standbys") or {}):
                     if pid not in self._lag_gauges:
                         self._lag_gauges.add(pid)
-                        self._metrics.gauge(
+                        self._metrics.gauge(  # sdklint: disable=metric-cardinality — bounded by the standby TOPOLOGY (a handful of operator-deployed pullers, not per-request ids) and deduped via _lag_gauges
                             f"ha.replication.lag.{pid}",
                             lambda pid=pid: self._lag_of(pid),
                         )

@@ -1,0 +1,37 @@
+"""X9: the fleet health plane — metric history, event journal,
+anomaly detectors.
+
+Traceview (X4) answers "what just happened"; this package retains and
+judges: bounded metric history rings (metrics/registry.py
+MetricHistory) behind ``GET /v1/debug/health``, a durable
+capacity-bounded event journal (operator verbs, plan transitions,
+failovers, admission rejections, recovery actions, detector alerts)
+behind ``GET /v1/debug/events?since=``, and per-cycle detectors —
+straggler median-ratio scoring off merged steplogs, serving-SLO
+watchers off the engine gauges, lease-churn watching off ha.* — whose
+suspect-host output feeds placement as a soft sort-last signal.
+"""
+
+from dcos_commons_tpu.health.detectors import (
+    LeaseChurnWatcher,
+    ServingSloWatcher,
+    StragglerDetector,
+    median_ratio_scores,
+)
+from dcos_commons_tpu.health.journal import (
+    EventJournal,
+    PersisterBackend,
+    StatePropertyBackend,
+)
+from dcos_commons_tpu.health.monitor import HealthMonitor
+
+__all__ = [
+    "EventJournal",
+    "HealthMonitor",
+    "LeaseChurnWatcher",
+    "PersisterBackend",
+    "ServingSloWatcher",
+    "StatePropertyBackend",
+    "StragglerDetector",
+    "median_ratio_scores",
+]

@@ -1,0 +1,319 @@
+"""Anomaly detectors: straggler scoring, SLO watchers, lease churn.
+
+Every detector here runs scheduler-side off data the system already
+collects — merged worker steplogs (trace/steplog.py), per-pod serving
+gauges (serve/engine.py servestats), the ha.* lease state — and emits
+into the event journal.  Detection is advisory by contract: a suspect
+host is SORTED LAST in placement scan order (superset-sound, never
+excluded), and an SLO alert is a journal record, not an action.
+
+Straggler math — median-ratio over a sliding window: each host's
+score is the median of its recent per-step OWN time (``wall_s -
+blocked_s``: the barrier probe bills gang-imposed waiting to
+``blocked_s``, so own time isolates the host's contribution — in a
+synchronized gang every host's ``wall_s`` converges to the slowest
+host's, which would hide exactly the host we want to find) divided by
+the fleet median of those per-host medians.  Medians at both levels
+make the score robust: one preempted step doesn't flag a host, and
+one slow HOST doesn't shift the fleet baseline it is compared to
+(at ≥3 hosts, where the median excludes the outlier by construction).
+"""
+
+from __future__ import annotations
+
+import time
+from statistics import median
+from typing import Dict, List, Optional
+
+# below this many hosts the fleet median IS (or is dragged by) the
+# outlier: scoring 1-2 hosts against themselves only yields noise
+MIN_FLEET_FOR_SCORING = 3
+# ignore hosts whose own-time median is below this: sub-millisecond
+# steps are timer noise, and a ratio of two noise floors flags nothing
+# anyone can act on
+MIN_OWN_TIME_S = 1e-4
+
+
+def median_ratio_scores(
+    values_by_host: Dict[str, List[float]],
+    min_samples: int = 3,
+) -> Dict[str, float]:
+    """host -> (median of host's values) / (fleet median of those
+    medians).  Hosts with fewer than ``min_samples`` values are
+    skipped (a freshly-joined host must not be scored off one step);
+    {} when fewer than MIN_FLEET_FOR_SCORING hosts qualify.
+    Permutation-invariant by construction: medians depend on value
+    multisets only, never on dict or list order."""
+    per_host: Dict[str, float] = {}
+    for host, values in values_by_host.items():
+        usable = [v for v in values if v >= 0.0]
+        if len(usable) < min_samples:
+            continue
+        per_host[host] = median(usable)
+    if len(per_host) < MIN_FLEET_FOR_SCORING:
+        return {}
+    fleet = median(per_host.values())
+    if fleet < MIN_OWN_TIME_S:
+        return {}
+    return {host: value / fleet for host, value in per_host.items()}
+
+
+class StragglerDetector:
+    """Scores per-host step own-time from merged steplogs and tracks
+    the suspect set with alert edge-triggering.
+
+    ``observe(steplogs_by_host)`` takes {host_id: [steplog records]}
+    for one series per host, or {host_id: [[records], [records]]} for
+    a host running several tasks (records newest-last either way; the
+    trailing ``window`` applies PER SERIES — pooling colocated tasks
+    into one flat list would let whichever task was appended last
+    evict another task's records entirely, making detection depend on
+    task iteration order instead of recency).  Returns the events to
+    journal: an ``alert`` when a host's score first crosses
+    ``threshold``, and a ``clear`` when a previously-suspect host
+    drops back under it — an operator reading the journal sees
+    episodes, not one line per cycle.
+    """
+
+    def __init__(
+        self,
+        threshold: float = 2.0,
+        window: int = 32,
+        min_samples: int = 3,
+    ):
+        self.threshold = float(threshold)
+        self.window = max(1, int(window))
+        self.min_samples = max(1, int(min_samples))
+        self.scores: Dict[str, float] = {}
+        self.suspects: Dict[str, float] = {}
+
+    @staticmethod
+    def own_time(record: dict) -> Optional[float]:
+        try:
+            wall = float(record.get("wall_s", 0.0) or 0.0)
+            blocked = float(record.get("blocked_s", 0.0) or 0.0)
+        except (TypeError, ValueError):
+            return None
+        return max(0.0, wall - blocked)
+
+    def observe(
+        self, steplogs_by_host: Dict[str, List[dict]]
+    ) -> List[dict]:
+        values: Dict[str, List[float]] = {}
+        for host, records in steplogs_by_host.items():
+            series_list = records if records and isinstance(
+                records[0], list
+            ) else [records]
+            owns = []
+            for series in series_list:
+                for record in series[-self.window:]:
+                    own = self.own_time(record)
+                    if own is not None:
+                        owns.append(own)
+            if owns:
+                values.setdefault(host, []).extend(owns)
+        self.scores = median_ratio_scores(
+            values, min_samples=self.min_samples
+        )
+        now_suspect = {
+            host: round(score, 3)
+            for host, score in self.scores.items()
+            if score >= self.threshold
+        }
+        events = []
+        for host, score in sorted(now_suspect.items()):
+            if host not in self.suspects:
+                events.append({
+                    "kind": "alert",
+                    "detector": "straggler",
+                    "host": host,
+                    "score": score,
+                    "threshold": self.threshold,
+                    "message": (
+                        f"host {host} step own-time is {score}x the "
+                        f"fleet median (threshold {self.threshold}x)"
+                    ),
+                })
+        for host in sorted(self.suspects):
+            # a host that stopped reporting keeps its suspect mark
+            # (silence is not health); only a measured recovery clears
+            if host in self.scores and host not in now_suspect:
+                events.append({
+                    "kind": "alert",
+                    "detector": "straggler",
+                    "host": host,
+                    "score": round(self.scores[host], 3),
+                    "cleared": True,
+                    "message": f"host {host} back under the straggler "
+                               "threshold",
+                })
+                continue
+            if host not in now_suspect:
+                now_suspect[host] = self.suspects[host]
+        self.suspects = now_suspect
+        return events
+
+
+class ServingSloWatcher:
+    """Serving SLO burn off the merged per-task engine gauges.
+
+    Thresholds come from each serving task's own rendered env (the
+    options.json serving.* knobs ride the task env contract), falling
+    back to the scheduler-level defaults; a threshold of 0 disables
+    that check.  Edge-triggered per (task, signal): one alert when the
+    breach starts, one clear when it ends.
+    """
+
+    SIGNALS = (
+        # (signal key in stats, env knob, default attr)
+        ("ttft_p95_s", "SERVE_TTFT_SLO_S", "ttft_p95_slo_s"),
+        ("queue_depth", "SERVE_QUEUE_DEPTH_SLO", "queue_depth_slo"),
+        ("kv_occupancy", "SERVE_KV_OCCUPANCY_SLO", "kv_occupancy_slo"),
+    )
+    # consecutive collections a breaching (task, signal) may go
+    # unsampled before its episode is dropped as retired
+    RETIRE_AFTER_MISSES = 3
+
+    def __init__(
+        self,
+        ttft_p95_slo_s: float = 0.0,
+        queue_depth_slo: float = 0.0,
+        kv_occupancy_slo: float = 0.0,
+    ):
+        self.ttft_p95_slo_s = float(ttft_p95_slo_s)
+        self.queue_depth_slo = float(queue_depth_slo)
+        self.kv_occupancy_slo = float(kv_occupancy_slo)
+        self.breaches: Dict[tuple, float] = {}  # (task, signal) -> value
+        self._missed: Dict[tuple, int] = {}  # consecutive absent samples
+
+    def _threshold(self, env: Dict[str, str], knob: str, attr: str) -> float:
+        raw = (env or {}).get(knob, "")
+        if raw:
+            try:
+                return float(raw)
+            except ValueError:
+                pass
+        return getattr(self, attr)
+
+    def observe(
+        self,
+        stats_by_task: Dict[str, dict],
+        env_by_task: Optional[Dict[str, Dict[str, str]]] = None,
+    ) -> List[dict]:
+        events = []
+        seen = set()
+        for task, stats in sorted(stats_by_task.items()):
+            env = (env_by_task or {}).get(task, {})
+            for signal, knob, attr in self.SIGNALS:
+                threshold = self._threshold(env, knob, attr)
+                if threshold <= 0 or signal not in stats:
+                    continue
+                try:
+                    value = float(stats[signal])
+                except (TypeError, ValueError):
+                    continue
+                key = (task, signal)
+                seen.add(key)
+                if value > threshold and key in self.breaches:
+                    # still breaching: no repeat alert, but keep the
+                    # CURRENT magnitude — an operator triaging
+                    # /v1/debug/health must see the runaway value,
+                    # not the marginal first-breach one
+                    self.breaches[key] = value
+                elif value > threshold:
+                    self.breaches[key] = value
+                    events.append({
+                        "kind": "alert",
+                        "detector": "slo",
+                        "task": task,
+                        "signal": signal,
+                        "value": round(value, 4),
+                        "threshold": threshold,
+                        "message": (
+                            f"{task} {signal}={round(value, 4)} breaches "
+                            f"SLO {threshold}"
+                        ),
+                    })
+                elif value <= threshold and key in self.breaches:
+                    del self.breaches[key]
+                    events.append({
+                        "kind": "alert",
+                        "detector": "slo",
+                        "task": task,
+                        "signal": signal,
+                        "value": round(value, 4),
+                        "cleared": True,
+                        "message": f"{task} {signal} back under SLO",
+                    })
+        # a missing sample is not a recovery: one failed collection
+        # (a dropped RPC, an idle window omitting a percentile) must
+        # neither end an episode silently nor re-alert when the next
+        # sample arrives still breaching.  Only a task absent for
+        # several consecutive collections (a retired pod) drops its
+        # episodes — silently, since nothing was measured.
+        for key in list(self.breaches):
+            if key in seen:
+                self._missed.pop(key, None)
+                continue
+            self._missed[key] = self._missed.get(key, 0) + 1
+            if self._missed[key] >= self.RETIRE_AFTER_MISSES:
+                del self.breaches[key]
+                del self._missed[key]
+        return events
+
+
+class LeaseChurnWatcher:
+    """Flags flapping leadership: ``churn_n`` or more lease-epoch
+    changes inside ``window_s`` means schedulers are trading the lease
+    instead of holding it (renewal starvation, a crash loop, or a
+    split network) — each individual failover looks routine, the RATE
+    is the anomaly.  Edge-triggered episodes like the other detectors:
+    one alert when the rate crosses ``churn_n``, one clear (and
+    re-arm) when it drops back below — NOT when the window fully
+    empties, or a steady sub-threshold drip of routine failovers
+    would hold the alert suppressed forever."""
+
+    def __init__(self, churn_n: int = 3, window_s: float = 300.0):
+        self.churn_n = max(2, int(churn_n))
+        self.window_s = float(window_s)
+        self._changes: List[float] = []  # times of observed epoch bumps
+        self._last_epoch: Optional[int] = None
+        self._alerted = False
+
+    def observe(self, epoch: Optional[int], t: Optional[float] = None) -> List[dict]:
+        if epoch is None:
+            return []
+        now = time.time() if t is None else t
+        if self._last_epoch is not None and epoch != self._last_epoch:
+            self._changes.append(now)
+        self._last_epoch = epoch
+        self._changes = [
+            ts for ts in self._changes if now - ts <= self.window_s
+        ]
+        if len(self._changes) >= self.churn_n:
+            if not self._alerted:
+                self._alerted = True
+                return [{
+                    "kind": "alert",
+                    "detector": "lease-churn",
+                    "epoch": epoch,
+                    "changes": len(self._changes),
+                    "window_s": self.window_s,
+                    "message": (
+                        f"leader lease changed {len(self._changes)} times "
+                        f"in {self.window_s:.0f}s (epoch now {epoch}) — "
+                        "flapping leadership"
+                    ),
+                }]
+        elif self._alerted:
+            self._alerted = False  # episode over: clear and re-arm
+            return [{
+                "kind": "alert",
+                "detector": "lease-churn",
+                "epoch": epoch,
+                "changes": len(self._changes),
+                "cleared": True,
+                "message": "leader lease churn back under the "
+                           "flapping threshold",
+            }]
+        return []

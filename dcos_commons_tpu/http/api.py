@@ -38,6 +38,16 @@ class SchedulerApi:
         if callable(nudge):
             nudge()
 
+    def _journal_verb(self, verb: str, **attrs) -> None:
+        """Operator verbs land in the durable event journal — flushed
+        INLINE (unlike cycle-batched events): the operator's interrupt
+        must survive a crash that happens before the next cycle."""
+        journal = getattr(self._scheduler, "journal", None)
+        if journal is None:
+            return
+        journal.append("operator", verb=verb, **attrs)
+        journal.flush()
+
     # -- health (reference: http/endpoints/HealthResource.java) -------
 
     def health(self) -> Response:
@@ -103,6 +113,7 @@ class SchedulerApi:
         if error is not None:
             return error
         getattr(element, verb)()
+        self._journal_verb(verb, plan=plan_name, phase=phase, step=step)
         self._nudge()
         return 200, {"message": f"{verb} invoked", "plan": plan_name}
 
@@ -141,6 +152,7 @@ class SchedulerApi:
             setter(env)
         element.restart()
         element.proceed()
+        self._journal_verb("start", plan=plan_name)
         self._nudge()
         return 200, {
             "message": "started", "plan": plan_name,
@@ -154,6 +166,7 @@ class SchedulerApi:
             return error
         element.interrupt()
         element.restart()
+        self._journal_verb("stop", plan=plan_name)
         self._nudge()
         return 200, {"message": "stopped", "plan": plan_name}
 
@@ -237,7 +250,13 @@ class SchedulerApi:
         if error:
             return error
         killed = self._scheduler.restart_pod(pod_type, index, replace=replace)
+        self._flush_journal()  # the scheduler verb journaled; make it durable now
         return 200, {"pod": pod_instance, "tasks": killed}
+
+    def _flush_journal(self) -> None:
+        journal = getattr(self._scheduler, "journal", None)
+        if journal is not None:
+            journal.flush()
 
     def pod_pause(self, pod_instance: str, tasks=None) -> Response:
         pod_type, index, error = self._parse_instance(pod_instance)
@@ -248,6 +267,7 @@ class SchedulerApi:
             # no-op transition rejected (reference: PodQueries refuses
             # invalid override transitions)
             return 409, {"message": f"{pod_instance} is already paused"}
+        self._flush_journal()
         return 200, {"pod": pod_instance, "tasks": touched}
 
     def pod_resume(self, pod_instance: str, tasks=None) -> Response:
@@ -257,6 +277,7 @@ class SchedulerApi:
         touched = self._scheduler.resume_pod(pod_type, index, tasks)
         if not touched:
             return 409, {"message": f"{pod_instance} is not paused"}
+        self._flush_journal()
         return 200, {"pod": pod_instance, "tasks": touched}
 
     def _parse_instance(self, pod_instance: str):
@@ -580,7 +601,46 @@ class SchedulerApi:
         if fmt not in (None, "", "text"):
             return 400, {"message": f"unknown trace format {fmt!r} "
                                     "(expected 'chrome' or 'text')"}
-        return 200, to_text(tracer, service=service, steplogs=steplogs)
+        # journal events (operator verbs, failovers, detector alerts)
+        # render into the text timeline on a `journal` lane, so the
+        # ssh-and-curl view shows causes next to the spans they caused
+        journal = getattr(self._scheduler, "journal", None)
+        events = journal.events() if journal is not None else None
+        return 200, to_text(tracer, service=service, steplogs=steplogs,
+                            events=events)
+
+    def debug_health(self, metric: Optional[str] = None) -> Response:
+        """The fleet health plane: detector states (straggler scores,
+        suspect hosts, SLO breaches), journal stats, recent alerts,
+        and the bounded metric history (summary rows by default;
+        ``?metric=<name>`` returns that metric's full timestamped
+        series with the derived rate for counters)."""
+        health = getattr(self._scheduler, "health", None)
+        if health is None:
+            return 200, {"enabled": False}
+        return 200, health.describe(self._scheduler, metric=metric)
+
+    def debug_events(self, since: Optional[str] = None,
+                     kind: Optional[str] = None) -> Response:
+        """The durable event journal: operator verbs, plan-step
+        transitions, failovers/lease epochs, admission rejections,
+        recovery actions, detector alerts.  ``?since=<seq>`` resumes a
+        cursor (seqs are monotonic ACROSS failovers); ``?kind=`` filters
+        (e.g. ``alert``)."""
+        journal = getattr(self._scheduler, "journal", None)
+        if journal is None:
+            return 200, {"enabled": False, "events": [], "seq": 0}
+        try:
+            since_seq = int(since) if since else 0
+        except ValueError:
+            return 400, {"message": f"bad since cursor {since!r}"}
+        return 200, {
+            "events": journal.events(
+                since=since_seq, kinds=[kind] if kind else None
+            ),
+            "seq": journal.last_seq,
+            "journal": journal.describe(),
+        }
 
     def debug_ha(self) -> Response:
         """HA control-plane state: leader identity + lease expiry (the

@@ -116,6 +116,14 @@ def build_routes(api: SchedulerApi) -> List[Route]:
         # active slots, KV occupancy, tokens/s) merged from sandboxes
         r("GET", r"/v1/debug/serving",
           lambda m, q: api.debug_serving()),
+        # fleet health plane: detector states, suspect hosts, metric
+        # history (?metric=<name> for one full series)
+        r("GET", r"/v1/debug/health",
+          lambda m, q: api.debug_health(_one(q, "metric"))),
+        # durable event journal (?since=<seq> cursor, ?kind= filter)
+        r("GET", r"/v1/debug/events",
+          lambda m, q: api.debug_events(_one(q, "since"),
+                                        _one(q, "kind"))),
         # metrics
         r("GET", r"/v1/metrics/prometheus",
           lambda m, q: api.metrics_prometheus()),
@@ -233,7 +241,31 @@ class ApiServer:
                     if method == "GET":
                         return 200, multi_scheduler.service_names()
                     return 405, {"message": "use GET /v1/multi"}
+                if rest == "events" and method == "GET":
+                    # the fleet-level event journal (admission
+                    # rejections, service add/uninstall); per-service
+                    # journals live at /v1/multi/<name>/v1/debug/events
+                    journal = getattr(multi_scheduler, "journal", None)
+                    if journal is None:
+                        return 200, {"events": [], "seq": 0}
+                    try:
+                        since = int((query.get("since") or ["0"])[0])
+                    except ValueError:
+                        return 400, {"message": "bad since cursor"}
+                    return 200, {
+                        "events": journal.events(since=since),
+                        "seq": journal.last_seq,
+                        "journal": journal.describe(),
+                    }
                 name, _, sub = rest.partition("/")
+                if name == "events" and method == "PUT" and not sub:
+                    # reserved: GET /v1/multi/events is the fleet
+                    # journal — a service deployed under that name
+                    # would have its bare-name GET shadowed
+                    return 400, {
+                        "message": "service name 'events' is reserved "
+                                   "(fleet event journal route)"
+                    }
                 if method == "PUT" and not sub:
                     # body: service YAML, or a framework package
                     # tarball (Content-Type: application/gzip — the
@@ -299,6 +331,25 @@ class ApiServer:
                             raise AdmissionError(findings)
                         multi_scheduler.add_service(spec)
                     except AdmissionError as e:
+                        # journal the rejection: the operator who
+                        # PUT a bad spec is not always the operator
+                        # who later asks "why did nothing deploy?"
+                        journal = getattr(
+                            multi_scheduler, "journal", None
+                        )
+                        if journal is not None:
+                            journal.append(
+                                "admission",
+                                service=name,
+                                findings=len(e.findings),
+                                message=(
+                                    f"spec for {name!r} rejected: "
+                                    + "; ".join(
+                                        f.message for f in e.findings[:3]
+                                    )
+                                ),
+                            )
+                            journal.flush()
                         return 422, {
                             "message": f"spec rejected by admission "
                                        f"control ({len(e.findings)} "

@@ -6,6 +6,10 @@ revive/decline/suppress/operation/status counters) and
 PlanReporter.java (per-plan status gauges).
 """
 
-from dcos_commons_tpu.metrics.registry import Metrics
+from dcos_commons_tpu.metrics.registry import (
+    MetricHistory,
+    Metrics,
+    prometheus_name,
+)
 
-__all__ = ["Metrics"]
+__all__ = ["MetricHistory", "Metrics", "prometheus_name"]

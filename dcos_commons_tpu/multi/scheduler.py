@@ -73,6 +73,24 @@ class _ServiceAgentAdapter:
     def deliver(self, status) -> None:
         self._queue.append(status)
 
+    # worker telemetry pass-through: each service's /v1/debug/trace,
+    # /v1/debug/serving and health monitor read sandbox steplogs /
+    # serving gauges through ITS agent handle — without forwarding,
+    # multi mode (the production topology) was blind to both
+    def steplog_of(self, task_name, agent_id=None):
+        reader = getattr(self._agent, "steplog_of", None)
+        if not callable(reader):
+            return []
+        return reader(task_name, agent_id=agent_id) if agent_id \
+            else reader(task_name)
+
+    def serving_stats_of(self, task_name, agent_id=None):
+        reader = getattr(self._agent, "serving_stats_of", None)
+        if not callable(reader):
+            return {}
+        return reader(task_name, agent_id=agent_id) if agent_id \
+            else reader(task_name)
+
 
 class _MergedLedgerView:
     """Union view over every service's reservation ledger, handed to
@@ -201,6 +219,19 @@ class MultiServiceScheduler:
         add_listener = getattr(agent, "add_status_listener", None)
         if callable(add_listener):
             add_listener(self.nudge)
+        # fleet-level event journal (health plane): admission
+        # rejections target services that may not exist yet, so no
+        # per-service store can own them — this one persists at a raw
+        # tree path through the (fenced-in-HA) shared persister and is
+        # served at GET /v1/multi/events
+        from dcos_commons_tpu.health import EventJournal, PersisterBackend
+
+        self.journal = EventJournal(
+            PersisterBackend(persister),
+            capacity=self.config.health_journal_capacity,
+        ) if self.config.health_enabled else EventJournal(
+            backend=None, capacity=0
+        )
         # ONE merged view shared by every service's evaluator: the
         # shared inventory keys its snapshot cache on the view object,
         # so per-service view instances would clear it on every
@@ -242,6 +273,9 @@ class MultiServiceScheduler:
             self._services[spec.name] = built
             self._services_version += 1
             self._suppressed_services.discard(spec.name)
+        self.journal.append("operator", verb="add-service",
+                            service=spec.name)
+        self.journal.flush()
         self.nudge()  # deploy work just became pending
 
     @property
@@ -513,6 +547,9 @@ class MultiServiceScheduler:
             )
             self._services_version += 1
             self._suppressed_services.discard(name)
+        self.journal.append("operator", verb="uninstall-service",
+                            service=name)
+        self.journal.flush()
         self.nudge()  # teardown work just became pending
 
     def get_service(self, name: str):

@@ -176,7 +176,7 @@ class _ViewCache:
 
     __slots__ = (
         "snaps", "tokens", "gen_token", "topo_gen", "ordered",
-        "free_chip_count", "fully_free_by_slice",
+        "order_gen", "free_chip_count", "fully_free_by_slice",
     )
 
     def __init__(self) -> None:
@@ -185,6 +185,7 @@ class _ViewCache:
         self.gen_token: object = None                 # view token at last sync
         self.topo_gen: int = -1
         self.ordered: Optional[List[ResourceSnapshot]] = None
+        self.order_gen: int = -1                      # suspect-order stamp
         # ledger-dependent placement indexes, maintained with the
         # snapshots they describe (a stale index would pre-filter
         # against a fleet that no longer exists)
@@ -301,7 +302,17 @@ class SliceInventory:
         self._field_indexes: Dict[str, Dict[str, Set[str]]] = {}
         self._index_gen = -1
         self._ordinal_cache: Dict[str, int] = {}
-        self._ordinal_gen = -1
+        self._ordinal_gen: object = -1
+        # soft placement signal (health plane): suspect hosts sort
+        # LAST in scan order — superset-sound, a suspect host is still
+        # offered, it just loses first-fit ties to healthy peers.
+        # Order changes bump _order_gen so scan-order caches (ordinals,
+        # per-view ordered lists) re-sort without touching snapshots.
+        self._suspect: frozenset = frozenset()
+        self._suspect_sources: Dict[str, frozenset] = {}
+        self._order_gen = 0
+        self._scan_cache: Optional[List[TpuHost]] = None
+        self._scan_cache_gen: object = None
         self._up_ids_cache: Optional[Set[str]] = None
         self._up_ids_gen = -1
         self._hosts_by_id: Optional[Dict[str, TpuHost]] = None
@@ -410,6 +421,7 @@ class SliceInventory:
             "topology_generation": self._topology_gen,
             "hosts": len(self._hosts),
             "up_hosts": len(self._up_ids()),
+            "suspect_hosts": sorted(self._suspect),
             "last_dirty_hosts": self.last_dirty_hosts,
             "snapshot_cache": {
                 "hits": self.cache_hits,
@@ -566,14 +578,74 @@ class SliceInventory:
                 bucket.discard(host_id)
 
     def _ordered_snapshots(self, cache: _ViewCache) -> List[ResourceSnapshot]:
-        if cache.ordered is None:
+        if cache.ordered is None or cache.order_gen != self._order_gen:
             snaps = cache.snaps
             cache.ordered = [
                 snaps[h.host_id]
-                for h in self._hosts.values()
+                for h in self._scan_hosts()
                 if h.host_id in snaps
             ]
+            cache.order_gen = self._order_gen
         return cache.ordered
+
+    # -- scan order (health plane's soft placement signal) ------------
+
+    def set_suspect_hosts(self, host_ids, source: str = "") -> None:
+        """Demote hosts to the END of placement scan order (the health
+        monitor pushes its straggler suspect set here).  Superset-sound
+        by construction: membership in every candidate set and
+        snapshot cache is untouched — only iteration ORDER changes, so
+        a suspect host still places when it is the only fit.
+
+        ``source`` keys the contribution: on a SHARED multi-service
+        inventory every service's monitor pushes only its own
+        stragglers, so the effective demotion set is the UNION across
+        sources — a service with no stragglers pushing ``set()`` must
+        not clobber another service's demotion of a host they share.
+        No-op when the union is unchanged (a per-source change that
+        doesn't move the union never resorts); otherwise only the
+        ordering caches re-sort (snapshot content is
+        order-independent)."""
+        new = frozenset(host_ids)
+        if self._suspect_sources.get(source, frozenset()) == new:
+            return
+        if new:
+            self._suspect_sources[source] = new
+        else:
+            self._suspect_sources.pop(source, None)
+        union = frozenset().union(
+            *self._suspect_sources.values()
+        ) if self._suspect_sources else frozenset()
+        if union == self._suspect:
+            return
+        self._suspect = union
+        self._order_gen += 1
+
+    def suspect_hosts(self) -> Set[str]:
+        return set(self._suspect)
+
+    def _scan_hosts(self) -> List[TpuHost]:
+        """Hosts in scan (tie-breaking) order: registration order with
+        suspect hosts moved to the back, cached until topology or the
+        suspect set changes.  The ONE order shared by ``_ordinals`` and
+        the per-view ordered snapshot lists — indexed candidates sorted
+        by ordinal must reproduce exactly the full-scan winner."""
+        gen = (self._topology_gen, self._order_gen)
+        if self._scan_cache is None or self._scan_cache_gen != gen:
+            if self._suspect:
+                head = [
+                    h for h in self._hosts.values()
+                    if h.host_id not in self._suspect
+                ]
+                head += [
+                    h for h in self._hosts.values()
+                    if h.host_id in self._suspect
+                ]
+                self._scan_cache = head
+            else:
+                self._scan_cache = list(self._hosts.values())
+            self._scan_cache_gen = gen
+        return self._scan_cache
 
     # -- inverted indexes (internal; rebuilt on topology change) ------
 
@@ -594,10 +666,11 @@ class SliceInventory:
         return self._up_ids_cache
 
     def _ordinals(self) -> Dict[str, int]:
-        gen = self._topology_gen
+        gen = (self._topology_gen, self._order_gen)
         if self._ordinal_gen != gen:
             self._ordinal_cache = {
-                host_id: i for i, host_id in enumerate(self._hosts)
+                host.host_id: i
+                for i, host in enumerate(self._scan_hosts())
             }
             self._ordinal_gen = gen
         return self._ordinal_cache

@@ -71,6 +71,24 @@ class DefaultRecoveryPlanManager(PlanManager):
         # default scoping logic
         self._custom_keys: Set[str] = set()
         self._plan = Plan(RECOVERY_PLAN_NAME, [], ParallelStrategy())
+        # health-plane event journal (set by the owning scheduler):
+        # every synthesized recovery phase is a "recovery" event, so
+        # an operator can reconstruct WHEN a pod started recovering
+        # long after the recovery plan pruned the completed phase
+        self.journal = None
+
+    def _journal_phase(self, key: str, recovery_type, rebuilt: bool) -> None:
+        if self.journal is None:
+            return
+        self.journal.append(
+            "recovery",
+            pod=key,
+            type=recovery_type.value
+            if hasattr(recovery_type, "value") else str(recovery_type),
+            rebuilt=rebuilt,
+            message=f"recovery phase {'rebuilt' if rebuilt else 'created'} "
+                    f"for {key}",
+        )
 
     def set_spec(self, spec: ServiceSpec) -> None:
         with self._lock:
@@ -159,6 +177,7 @@ class DefaultRecoveryPlanManager(PlanManager):
                     if phase is not None:
                         self._phases[key] = phase
                         self._record_replace(pod_type, instances)
+                        self._journal_phase(key, recovery_type, True)
                 elif covered is not None and not required <= covered:
                     # a wider failure (an essential task died) arrived
                     # while a subset phase was in flight: rebuild so the
@@ -169,6 +188,7 @@ class DefaultRecoveryPlanManager(PlanManager):
                     )
                     if phase is not None:
                         self._phases[key] = phase
+                        self._journal_phase(key, recovery_type, True)
                 continue
             phase = self._make_phase(
                 pod_type, list(instances), recovery_type, tasks
@@ -177,6 +197,7 @@ class DefaultRecoveryPlanManager(PlanManager):
                 self._phases[key] = phase
                 if recovery_type is RecoveryType.PERMANENT:
                     self._record_replace(pod_type, instances)
+                self._journal_phase(key, recovery_type, False)
 
     def _launched_tasks(
         self, pod_type: str, instances

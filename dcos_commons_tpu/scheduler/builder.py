@@ -308,6 +308,44 @@ class SchedulerBuilder:
 
         from dcos_commons_tpu.trace.recorder import TraceRecorder
 
+        # health plane: config-driven monitor (journal capacity 0 =
+        # the whole plane off).  The journal persists through
+        # state_store, i.e. the (possibly lease-fenced) wired
+        # persister — a deposed leader's flush is rejected, the
+        # successor replays the journal and resumes the seq.
+        from dcos_commons_tpu.health import (
+            EventJournal,
+            HealthMonitor,
+            ServingSloWatcher,
+            StatePropertyBackend,
+            StragglerDetector,
+        )
+        from dcos_commons_tpu.health.monitor import NullHealthMonitor
+
+        if self._config.health_enabled and \
+                self._config.health_journal_capacity > 0:
+            health_monitor = HealthMonitor(
+                journal=EventJournal(
+                    StatePropertyBackend(state_store),
+                    capacity=self._config.health_journal_capacity,
+                ),
+                straggler=StragglerDetector(
+                    threshold=self._config.health_straggler_ratio,
+                    window=self._config.health_straggler_window,
+                ),
+                slo=ServingSloWatcher(
+                    ttft_p95_slo_s=self._config.health_ttft_p95_slo_s,
+                    queue_depth_slo=self._config.health_queue_depth_slo,
+                    kv_occupancy_slo=self._config.health_kv_occupancy_slo,
+                ),
+                telemetry_interval_s=(
+                    self._config.health_telemetry_interval_s
+                ),
+                history_interval_s=self._config.health_history_interval_s,
+            )
+        else:
+            health_monitor = NullHealthMonitor()
+
         scheduler = DefaultScheduler(
             spec=target_spec,
             state_store=state_store,
@@ -328,6 +366,7 @@ class SchedulerBuilder:
                 capacity=self._config.trace_capacity,
                 service=target_spec.name,
             ),
+            health_monitor=health_monitor,
         )
         scheduler.secrets_provider = secrets_provider
         scheduler.certificate_authority = certificate_authority

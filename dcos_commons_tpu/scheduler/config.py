@@ -52,6 +52,22 @@ class SchedulerConfig:
     trace_capacity: int = 2048
     # coordinator port range for pjit rendezvous
     coordinator_port_base: int = 8476
+    # fleet health plane (dcos_commons_tpu/health/): durable event
+    # journal capacity (0 disables the whole plane — detectors AND
+    # journal, the bench_health_overhead disabled arm), sandbox/wire
+    # telemetry fan-in cadence, metric-history sampling cadence, and
+    # the straggler detector's median-ratio threshold/window.  Serving
+    # SLO thresholds default off here; per-task env (the options.json
+    # serving.*_slo knobs ride the task env contract) overrides.
+    health_enabled: bool = True
+    health_journal_capacity: int = 512
+    health_telemetry_interval_s: float = 5.0
+    health_history_interval_s: float = 1.0
+    health_straggler_ratio: float = 2.0
+    health_straggler_window: int = 32
+    health_ttft_p95_slo_s: float = 0.0
+    health_queue_depth_slo: float = 0.0
+    health_kv_occupancy_slo: float = 0.0
     # control-plane credentials (security/auth.py): one cluster bearer
     # token shared by scheduler API, agent daemons, and state server;
     # TLS material for serving HTTPS / verifying peers
@@ -95,6 +111,30 @@ class SchedulerConfig:
             sandbox_root=env.get("SANDBOX_ROOT", "./sandboxes"),
             trace_capacity=int(env.get("TRACE_CAPACITY", "2048")),
             coordinator_port_base=int(env.get("COORDINATOR_PORT_BASE", "8476")),
+            health_enabled=env.get("HEALTH_ENABLED", "true")
+            not in ("0", "false"),
+            health_journal_capacity=int(
+                env.get("HEALTH_JOURNAL_CAPACITY", "512")
+            ),
+            health_telemetry_interval_s=float(
+                env.get("HEALTH_TELEMETRY_INTERVAL_S", "5.0")
+            ),
+            health_history_interval_s=float(
+                env.get("HEALTH_HISTORY_INTERVAL_S", "1.0")
+            ),
+            health_straggler_ratio=float(
+                env.get("HEALTH_STRAGGLER_RATIO", "2.0")
+            ),
+            health_straggler_window=int(
+                env.get("HEALTH_STRAGGLER_WINDOW", "32")
+            ),
+            health_ttft_p95_slo_s=float(env.get("SERVE_TTFT_SLO_S", "0")),
+            health_queue_depth_slo=float(
+                env.get("SERVE_QUEUE_DEPTH_SLO", "0")
+            ),
+            health_kv_occupancy_slo=float(
+                env.get("SERVE_KV_OCCUPANCY_SLO", "0")
+            ),
             auth_token=_load_token(env),
             tls_ca_file=env.get("TLS_CA_FILE", ""),
             tls_cert_file=env.get("TLS_CERT_FILE", ""),

@@ -69,6 +69,8 @@ class DefaultScheduler:
         kill_orphaned_tasks: bool = True,
         revive_bucket: Optional[TokenBucket] = None,
         tracer: Optional[TraceRecorder] = None,
+        journal=None,
+        health_monitor=None,
     ):
         # stores surfaced to the HTTP API (/v1/configs, /v1/state);
         # None when the scheduler is wired by hand in unit tests
@@ -109,6 +111,36 @@ class DefaultScheduler:
         self.chaos = None
         self.ha_state = None
         self.last_rehydration = None
+        # health plane (dcos_commons_tpu/health/): the durable event
+        # journal (operator verbs, plan transitions, failovers,
+        # recovery, detector alerts — persisted through the state
+        # store, so HA mode fences and replays it) and the per-cycle
+        # monitor (metric history sampling + anomaly detectors).
+        # Surfaced at /v1/debug/health and /v1/debug/events.
+        from dcos_commons_tpu.health import (
+            EventJournal,
+            HealthMonitor,
+            StatePropertyBackend,
+        )
+
+        if journal is None:
+            # adopt the monitor's journal when it brought a real (or
+            # deliberately disabled) one; default to a store-backed
+            # journal otherwise
+            if health_monitor is not None and (
+                health_monitor.journal._backend is not None
+                or not health_monitor.journal.enabled
+            ):
+                journal = health_monitor.journal
+            else:
+                journal = EventJournal(StatePropertyBackend(state_store))
+        self.journal = journal
+        self.health = health_monitor or HealthMonitor(journal=journal)
+        self.health.journal = journal
+        self.health.attach(self)
+        # recovery phases journal their creation (the recovery plan
+        # prunes completed phases; the journal remembers them)
+        recovery_manager.journal = journal
         from dcos_commons_tpu.ha.rehydrate import PlanCheckpointer
 
         self._plan_checkpointer = PlanCheckpointer(state_store)
@@ -255,6 +287,12 @@ class DefaultScheduler:
                     except BaseException:
                         self._plan_dirty = True
                         raise
+                # health plane: metric-history sampling + detectors +
+                # journal flush, time-throttled internally.  Runs on
+                # idle heartbeats too — a serving pod burns its TTFT
+                # SLO precisely while the control plane has nothing
+                # to do.  Never raises (counted in observe_errors).
+                self.health.observe(self)
                 cycle.set_attr("statuses", n_statuses)
                 cycle.set_attr("candidates", n_candidates)
                 if n_statuses == 0 and n_candidates == 0:
@@ -427,6 +465,24 @@ class DefaultScheduler:
             value = getattr(report, key)
             if value:
                 self.metrics.incr(f"ha.rehydrate.{key}", value)
+        # journal the incarnation boundary: a failover (promotion at a
+        # new lease epoch) or a cold start, with the replay verdict —
+        # the journal survives the takeover, so the successor's first
+        # event explains what it inherited
+        lease = self.ha_state.lease if self.ha_state is not None else None
+        self.journal.append(
+            "election" if lease is not None else "recovery",
+            message=(
+                f"rehydrated: adopted={report.adopted} "
+                f"reissued={report.reissued} lost={report.lost} "
+                f"orphans={report.orphans}"
+            ),
+            adopted=report.adopted,
+            reissued=report.reissued,
+            lost=report.lost,
+            orphans=report.orphans,
+            epoch=lease.epoch if lease is not None else None,
+        )
         return n
 
     def _work_in_flight(self) -> bool:
@@ -547,6 +603,15 @@ class DefaultScheduler:
             trace_id=trace_id,
             parent_id=parent_id,
             track="plan",
+            **{"from": old.value, "to": new.value},
+        )
+        # the journal keeps step transitions AFTER the flight
+        # recorder's ring has evicted them (flushed by the next
+        # cycle's health pass — transitions fire inside cycles and
+        # from HTTP verb threads, neither of which should pay a
+        # store write per step)
+        self.journal.append(  # sdklint: disable=lock-discipline — EventJournal serializes internally; like the tracer, it is callable from any thread
+            "plan", step=step.name,
             **{"from": old.value, "to": new.value},
         )
 
@@ -887,6 +952,12 @@ class DefaultScheduler:
                         info.task_id, task_spec.kill_grace_period_s
                     )
                     killed.append(full)
+            self.journal.append(
+                "operator",
+                verb="replace" if replace else "restart",
+                pod=f"{pod_type}-{index}",
+                tasks=len(killed),
+            )
             self.nudge()  # recovery work just became pending
             return killed
 
@@ -944,6 +1015,14 @@ class DefaultScheduler:
                         self.task_killer.kill(
                             info.task_id, task_spec.kill_grace_period_s
                         )
+            if touched:
+                self.journal.append(
+                    "operator",
+                    verb="pause" if override is GoalStateOverride.PAUSED
+                    else "resume",
+                    pod=f"{pod_type}-{index}",
+                    tasks=len(touched),
+                )
             self.nudge()  # override relaunch work just became pending
             return touched
 

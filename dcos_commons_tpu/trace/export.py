@@ -77,9 +77,13 @@ def to_text(
     recorder: TraceRecorder,
     service: str = "scheduler",
     steplogs: Optional[Steplogs] = None,
+    events: Optional[List[dict]] = None,
 ) -> str:
     """Human timeline: offset, duration, trace prefix, lane, name,
-    attrs — one line per span/step, sorted by start."""
+    attrs — one line per span/step, sorted by start.  ``events``
+    (journal records from the health plane) render on a ``journal``
+    lane, so operator verbs / failovers / detector alerts line up
+    against the spans around them."""
     rows = []  # (wall_start, dur_s, trace, track, name, attrs)
     for span in recorder.snapshot():
         rows.append((
@@ -106,6 +110,21 @@ def to_text(
                 f"step {record.get('step', '?')}",
                 attrs,
             ))
+    for event in events or []:
+        attrs = {
+            k: v for k, v in event.items()
+            if k not in ("t", "kind", "seq", "message")
+        }
+        if event.get("message"):
+            attrs["msg"] = event["message"]
+        rows.append((
+            float(event.get("t", 0.0) or 0.0),
+            0.0,
+            f"j{event.get('seq', '?')}",
+            "journal",
+            str(event.get("kind", "event")),
+            attrs,
+        ))
     rows.sort(key=lambda r: r[0])
     base = rows[0][0] if rows else 0.0
     lines = [

@@ -198,3 +198,30 @@ def test_debug_and_metrics_sections(deployed, capsys):
     assert "offers.evaluated" in json.dumps(metrics)
     reservations = cli(server, "debug", "reservations", capsys=capsys)
     assert reservations
+
+
+def test_debug_health_and_events_trackers(deployed, capsys):
+    runner, server = deployed
+    health = cli(server, "debug", "health", capsys=capsys)
+    assert health["enabled"] is True
+    assert health["status"] in ("ok", "warn")
+    assert "suspect_hosts" in health and "journal" in health
+    # --metric narrows to one series (sampled by the health pass the
+    # deploy cycles already ran)
+    one = cli(server, "debug", "health", "--metric", "cycle.process.count",
+              capsys=capsys)
+    assert one["history"]["metric"] == "cycle.process.count"
+    assert isinstance(one["history"]["samples"], list)
+    events = cli(server, "debug", "events", capsys=capsys)
+    assert events["seq"] >= 1
+    kinds = {e["kind"] for e in events["events"]}
+    assert "plan" in kinds  # deploy step transitions were journaled
+    # cursor resume: everything after the last seq is empty
+    tail = cli(server, "debug", "events", "--since", str(events["seq"]),
+               capsys=capsys)
+    assert tail["events"] == []
+    # kind filter
+    plans = cli(server, "debug", "events", "--kind", "plan", capsys=capsys)
+    assert plans["events"] and all(
+        e["kind"] == "plan" for e in plans["events"]
+    )

@@ -898,6 +898,51 @@ def test_admission_rejects_invalid_spec_with_422_and_findings():
         server.stop()
 
 
+def test_multi_events_route_and_reserved_name():
+    """GET /v1/multi/events serves the fleet journal (admission
+    rejections land there), and the 'events' service name is reserved
+    at the PUT boundary — a service deployed under it would have its
+    bare-name GET shadowed by the journal route."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    from dcos_commons_tpu.http.server import ApiServer
+
+    multi, agent = build_multi()
+    server = ApiServer(multi=multi, port=0).start()
+    try:
+        def request(path, body=None, method="GET"):
+            req = urllib.request.Request(
+                f"{server.url}{path}",
+                data=body.encode() if body else None,
+                method=method,
+            )
+            try:
+                with urllib.request.urlopen(req) as resp:
+                    return resp.status, json.loads(resp.read())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read())
+
+        code, body = request(
+            "/v1/multi/events", INVALID_ADD_YAML, method="PUT"
+        )
+        assert code == 400 and "reserved" in body["message"]
+        # a rejected spec journals an admission event at fleet level
+        code, _ = request("/v1/multi/added", INVALID_ADD_YAML,
+                          method="PUT")
+        assert code == 422
+        code, body = request("/v1/multi/events")
+        assert code == 200
+        kinds = {e["kind"] for e in body["events"]}
+        assert "admission" in kinds, body
+        # cursor drains
+        code, tail = request(f"/v1/multi/events?since={body['seq']}")
+        assert code == 200 and tail["events"] == []
+    finally:
+        server.stop()
+
+
 def test_admission_ignores_suppression_comments_in_payload():
     """Suppression comments are a CI affordance; in the admission
     path they live in the operator-submitted body, so honoring them

@@ -1,0 +1,665 @@
+"""The fleet health plane: journal, detectors, monitor, end to end.
+
+The acceptance scenario (ISSUE 10): a seeded slow host in a 4-host
+gang deploy produces a straggler alert in the durable event journal
+and a suspect-host score at GET /v1/debug/health; the suspect host is
+demoted to the END of placement scan order (superset-sound — it still
+places when it is the only fit); and the journal survives a scheduler
+failover, replayed under the HA fenced store with its sequence
+numbers continuing where the deposed leader stopped.
+"""
+
+from dcos_commons_tpu.ha.election import FencedPersister, LeaderLease
+from dcos_commons_tpu.health import (
+    EventJournal,
+    LeaseChurnWatcher,
+    ServingSloWatcher,
+    StatePropertyBackend,
+    StragglerDetector,
+    median_ratio_scores,
+)
+from dcos_commons_tpu.http.api import SchedulerApi
+from dcos_commons_tpu.offer.inventory import (
+    SliceInventory,
+    TpuHost,
+    make_test_fleet,
+)
+from dcos_commons_tpu.state.state_store import StateStore
+from dcos_commons_tpu.storage import MemPersister
+from dcos_commons_tpu.testing import (
+    AdvanceCycles,
+    ExpectDeploymentComplete,
+    SendTaskRunning,
+    ServiceTestRunner,
+)
+
+GANG_YAML = """
+name: jax
+pods:
+  trainer:
+    count: 4
+    gang: true
+    tpu:
+      generation: v5e
+      chips-per-host: 4
+      topology: 4x4
+    tasks:
+      worker:
+        goal: RUNNING
+        cmd: "python train.py"
+        cpus: 2.0
+        memory: 4096
+"""
+
+WEB_YAML = """
+name: web
+pods:
+  app:
+    count: 1
+    tasks:
+      srv:
+        goal: RUNNING
+        cmd: "serve"
+        cpus: 0.1
+        memory: 32
+"""
+
+
+# -- journal ----------------------------------------------------------
+
+
+def test_journal_append_bound_and_query():
+    journal = EventJournal(backend=None, capacity=4)
+    for i in range(6):
+        journal.append("operator", verb=f"v{i}")
+    events = journal.events()
+    # capacity-bounded drop-oldest, monotonic seq preserved
+    assert [e["seq"] for e in events] == [3, 4, 5, 6]
+    assert journal.last_seq == 6
+    assert [e["verb"] for e in journal.events(since=5)] == ["v5"]
+    journal.append("alert", detector="slo")
+    assert [e["kind"] for e in journal.events(kinds=("alert",))] == ["alert"]
+    assert len(journal.events(limit=2)) == 2
+    # no backend: flush is a no-op, never an error
+    assert journal.flush() is False
+    assert journal.describe()["events"] == 4
+
+
+def test_journal_disabled_is_inert():
+    journal = EventJournal(backend=None, capacity=0)
+    assert journal.append("operator", verb="x") == {}
+    assert journal.events() == []
+    assert journal.last_seq == 0
+    assert journal.flush() is False
+    assert not journal.enabled
+
+
+def test_journal_persists_and_reloads():
+    store = StateStore(MemPersister())
+    journal = EventJournal(StatePropertyBackend(store))
+    journal.append("operator", verb="interrupt", plan="deploy")
+    journal.append("plan", step="node-0")
+    assert journal.flush() is True
+    assert journal.flush() is False  # clean: no redundant write
+
+    reloaded = EventJournal(StatePropertyBackend(store))
+    events = reloaded.events()
+    assert [e["kind"] for e in events] == ["operator", "plan"]
+    assert reloaded.last_seq == 2
+    # seq continues across incarnations — operator cursors survive
+    event = reloaded.append("operator", verb="proceed")
+    assert event["seq"] == 3
+
+
+def test_journal_corrupt_or_missing_record_starts_empty():
+    store = StateStore(MemPersister())
+    store.store_property("health-journal", b"{not json")
+    journal = EventJournal(StatePropertyBackend(store))
+    assert journal.events() == []
+    assert journal.append("operator", verb="x")["seq"] == 1
+
+
+def test_journal_survives_failover_under_the_fenced_store():
+    """The acceptance criterion's durability half: leader A journals
+    through the fenced store, is deposed, and standby B replays the
+    journal and continues the sequence; A's post-deposition flush is
+    REJECTED by the fence (counted, not raced in) and never clobbers
+    B's events."""
+    mem = MemPersister()
+    clock = [1000.0]
+    lease_a = LeaderLease(mem, "svc", "sched-a", ttl_s=10.0,
+                          clock=lambda: clock[0])
+    assert lease_a.try_acquire()
+    journal_a = EventJournal(StatePropertyBackend(
+        StateStore(FencedPersister(mem, lease_a))
+    ))
+    journal_a.append("operator", verb="interrupt", plan="deploy")
+    journal_a.append("alert", detector="straggler", host="h3")
+    assert journal_a.flush()
+
+    clock[0] += 11.0  # A stalls past its TTL; B takes over
+    lease_b = LeaderLease(mem, "svc", "sched-b", ttl_s=10.0,
+                          clock=lambda: clock[0])
+    assert lease_b.try_acquire()
+    journal_b = EventJournal(StatePropertyBackend(
+        StateStore(FencedPersister(mem, lease_b))
+    ))
+    replayed = journal_b.events()
+    assert [e["kind"] for e in replayed] == ["operator", "alert"]
+    assert journal_b.append("election", event="promote")["seq"] == 3
+    assert journal_b.flush()
+
+    # the deposed leader's flush bounces off the fence
+    journal_a.append("operator", verb="zombie-write")
+    assert journal_a.flush() is False
+    assert journal_a.write_errors == 1
+    # ...and the store still carries B's journal, zombie-free
+    final = EventJournal(StatePropertyBackend(StateStore(mem)))
+    assert [e["seq"] for e in final.events()] == [1, 2, 3]
+    assert not any(
+        e.get("verb") == "zombie-write" for e in final.events()
+    )
+
+
+# -- detector units ---------------------------------------------------
+
+
+def test_median_ratio_scorer_gates():
+    # under 3 qualifying hosts: no scores (the fleet median would BE
+    # the outlier)
+    assert median_ratio_scores({"a": [1.0] * 3, "b": [9.0] * 3}) == {}
+    # hosts below min_samples are skipped, not scored off one step
+    scores = median_ratio_scores({
+        "a": [1.0] * 3, "b": [1.0] * 3, "c": [1.0] * 3, "fresh": [9.0],
+    })
+    assert "fresh" not in scores and len(scores) == 3
+
+
+def test_straggler_detector_alerts_once_and_clears():
+    detector = StragglerDetector(threshold=2.0)
+
+    def logs(slow_own):
+        fleet = {}
+        for i in range(3):
+            fleet[f"h{i}"] = [
+                {"wall_s": 1.0, "blocked_s": 0.9} for _ in range(4)
+            ]
+        fleet["h-slow"] = [
+            {"wall_s": 1.0, "blocked_s": 1.0 - slow_own}
+            for _ in range(4)
+        ]
+        return fleet
+
+    events = detector.observe(logs(slow_own=1.0))  # 10x the fleet
+    assert [e["host"] for e in events] == ["h-slow"]
+    assert detector.suspects and "h-slow" in detector.suspects
+    # steady breach: no repeat alert (episodes, not per-cycle spam)
+    assert detector.observe(logs(slow_own=1.0)) == []
+    # recovery: one clear event, suspect mark dropped
+    cleared = detector.observe(logs(slow_own=0.1))
+    assert len(cleared) == 1 and cleared[0].get("cleared")
+    assert detector.suspects == {}
+
+
+def test_straggler_window_applies_per_colocated_task_series():
+    """Regression: a host running several tasks hands the detector one
+    series PER TASK — with a flat pooled list, whichever task was
+    appended last would evict the other's records from the trailing
+    window and detection would depend on task iteration order."""
+    detector = StragglerDetector(threshold=2.0, window=8)
+    slow = [{"wall_s": 1.0, "blocked_s": 0.0}] * 8   # straggling task
+    fast = [{"wall_s": 1.0, "blocked_s": 0.9}] * 8
+    fleet = {
+        # the colocated host lists its FAST task last: a flat pool
+        # trimmed to window=8 would see only the fast series
+        "h-shared": [slow, fast],
+        "h1": [fast], "h2": [fast], "h3": [fast],
+    }
+    events = detector.observe(fleet)
+    assert [e["host"] for e in events] == ["h-shared"], events
+
+
+def test_journal_racing_flushes_persist_in_snapshot_order():
+    """Regression: flush snapshots the payload then stores OUTSIDE the
+    append lock — two racing flushes (cycle thread vs an operator
+    verb's inline flush) must still land newest-last, or a crash in
+    the window would lose the newer events and re-mint their seqs."""
+    import threading
+
+    stored = []
+    release = threading.Event()
+
+    class SlowBackend:
+        def load(self):
+            return None
+
+        def store(self, raw):
+            import json as _json
+
+            stored.append(_json.loads(raw.decode())["seq"])
+            if len(stored) == 1:
+                release.wait(5.0)  # first store stalls mid-write
+
+    journal = EventJournal(SlowBackend())
+    journal.append("operator", verb="first")
+    t = threading.Thread(target=journal.flush)
+    t.start()
+    while not stored:  # first flush is inside store()
+        pass
+    journal.append("operator", verb="second")  # the operator verb
+    done = []
+    t2 = threading.Thread(
+        target=lambda: done.append(journal.flush())
+    )
+    t2.start()
+    release.set()
+    t.join(5.0)
+    t2.join(5.0)
+    # the racing flush waited for the stalled one, then persisted the
+    # NEWER snapshot last — the store's final state carries seq 2
+    assert stored == [1, 2], stored
+    assert done == [True]
+
+
+def test_straggler_silent_host_keeps_its_mark():
+    detector = StragglerDetector(threshold=2.0)
+    fleet = {
+        f"h{i}": [{"wall_s": 1.0, "blocked_s": 0.9}] * 4 for i in range(3)
+    }
+    fleet["h-slow"] = [{"wall_s": 1.0, "blocked_s": 0.0}] * 4
+    assert detector.observe(fleet)
+    # the slow host stops reporting entirely: silence is not health
+    del fleet["h-slow"]
+    assert detector.observe(fleet) == []
+    assert "h-slow" in detector.suspects
+
+
+def test_slo_watcher_env_thresholds_and_episodes():
+    watcher = ServingSloWatcher(ttft_p95_slo_s=1.0)
+    stats = {"web-0-srv": {"ttft_p95_s": 2.5, "queue_depth": 100}}
+    events = watcher.observe(stats)
+    # queue_depth unchecked (no default, no env): only the TTFT fires
+    assert [e["signal"] for e in events] == ["ttft_p95_s"]
+    # steady breach: silent; recovery: one clear
+    assert watcher.observe(stats) == []
+    ok = {"web-0-srv": {"ttft_p95_s": 0.3, "queue_depth": 100}}
+    cleared = watcher.observe(ok)
+    assert len(cleared) == 1 and cleared[0].get("cleared")
+    # per-task env overrides the scheduler default (options.json
+    # serving.*_slo knobs ride the task env)
+    env = {"web-0-srv": {"SERVE_QUEUE_DEPTH_SLO": "8"}}
+    events = watcher.observe(ok, env)
+    assert [e["signal"] for e in events] == ["queue_depth"]
+    # a still-breaching signal keeps the CURRENT magnitude visible
+    # (an operator must see the runaway value, not the first blip)
+    worse = {"web-0-srv": {"ttft_p95_s": 0.3, "queue_depth": 400}}
+    assert watcher.observe(worse, env) == []  # no repeat alert
+    assert watcher.breaches[("web-0-srv", "queue_depth")] == 400
+    # ONE missed collection (dropped RPC, idle window) is not a
+    # recovery: the episode survives, and the returning still-breaching
+    # sample does NOT re-alert
+    assert watcher.observe({}, {}) == []
+    assert ("web-0-srv", "queue_depth") in watcher.breaches
+    assert watcher.observe(worse, env) == []
+    # a task absent for RETIRE_AFTER_MISSES straight collections is
+    # retired: episodes dropped silently (nothing was measured)
+    for _ in range(ServingSloWatcher.RETIRE_AFTER_MISSES):
+        assert watcher.observe({}, {}) == []
+    assert watcher.breaches == {}
+
+
+def test_lease_churn_watcher_flags_flapping_not_failover():
+    watcher = LeaseChurnWatcher(churn_n=3, window_s=100.0)
+    # one routine failover: no alert
+    assert watcher.observe(1, t=0.0) == []
+    assert watcher.observe(2, t=10.0) == []
+    # flapping: three changes inside the window
+    assert watcher.observe(3, t=20.0) == []
+    events = watcher.observe(4, t=30.0)
+    assert len(events) == 1 and events[0]["detector"] == "lease-churn"
+    # steady flapping: one alert per episode
+    assert watcher.observe(5, t=40.0) == []
+    # churn drops under the threshold: one clear event, re-armed
+    cleared = watcher.observe(5, t=200.0)
+    assert len(cleared) == 1 and cleared[0].get("cleared")
+    assert watcher.observe(6, t=300.0) == []  # 1 change < churn_n
+
+
+def test_lease_churn_sub_threshold_drip_does_not_suppress():
+    """Regression: episode end is churn dropping BELOW churn_n, not
+    the window emptying — a routine failover every ~250s keeps the
+    window non-empty forever, and the old empty-window re-arm would
+    have suppressed every future flapping episode."""
+    watcher = LeaseChurnWatcher(churn_n=3, window_s=300.0)
+    epoch, t = 1, 0.0
+    watcher.observe(epoch, t=t)  # baseline
+    for _ in range(3):
+        epoch, t = epoch + 1, t + 10.0
+        watcher.observe(epoch, t=t)
+    assert watcher._alerted  # first episode fired
+    # months of sub-threshold drip: one change per 250s, the window
+    # never empties but churn stays below churn_n
+    for _ in range(10):
+        epoch, t = epoch + 1, t + 250.0
+        events = watcher.observe(epoch, t=t)
+        assert all(e.get("cleared") for e in events)
+    # genuine flapping resumes: the alert MUST fire again
+    fired = []
+    for _ in range(3):
+        epoch, t = epoch + 1, t + 10.0
+        fired += watcher.observe(epoch, t=t)
+    assert any(
+        e["detector"] == "lease-churn" and not e.get("cleared")
+        for e in fired
+    ), fired
+
+
+# -- the suspect-host soft placement signal ---------------------------
+
+
+def hosts3():
+    return [TpuHost(host_id=f"host-{i}") for i in range(3)]
+
+
+def deploy_web(hosts, suspects=()):
+    runner = ServiceTestRunner(WEB_YAML, hosts=hosts)
+    runner.build()
+    runner.inventory.set_suspect_hosts(set(suspects))
+    runner.run([
+        AdvanceCycles(1),
+        SendTaskRunning("app-0-srv"),
+        ExpectDeploymentComplete(),
+    ])
+    return runner.world.state_store.fetch_task("app-0-srv").agent_id
+
+
+def test_suspect_host_sorts_last_in_placement():
+    # healthy fleet: first-fit lands on host-0 (registration order)
+    assert deploy_web(hosts3()) == "host-0"
+    # suspect host-0: demoted to the back, host-1 wins the tie
+    assert deploy_web(hosts3(), suspects={"host-0"}) == "host-1"
+    # superset-sound: a suspect host still places when it is the only
+    # host — demotion orders, never excludes
+    assert deploy_web([TpuHost(host_id="only")],
+                      suspects={"only"}) == "only"
+
+
+def test_suspect_set_change_resyncs_ordinals_not_snapshots():
+    inventory = SliceInventory(hosts3())
+    view_gen_before = inventory.topology_generation
+    inventory.set_suspect_hosts({"host-1"})
+    # ordering is not a topology change: snapshot caches stay valid
+    assert inventory.topology_generation == view_gen_before
+    assert inventory.suspect_hosts() == {"host-1"}
+    ordinals = inventory._ordinals()
+    assert ordinals["host-1"] == 2  # demoted behind host-0/host-2
+    assert ordinals["host-0"] == 0
+    # unchanged set: no-op (ordering caches keep their stamps)
+    cache_before = inventory._scan_hosts()
+    inventory.set_suspect_hosts({"host-1"})
+    assert inventory._scan_hosts() is cache_before
+
+
+def test_lease_churn_survives_incarnations_via_journal_seed():
+    """Regression: a LeaderLease's in-memory epoch is constant for
+    its process's lifetime (losing the lease restarts the process),
+    so flapping is only visible ACROSS incarnations.  The monitor
+    seeds the watcher from the journaled election events — which
+    replay after failover — and then watches the PERSISTED record's
+    epoch, so the third incarnation of a flapping fleet alerts even
+    though its own watcher never saw an epoch change."""
+    from dcos_commons_tpu.health.monitor import HealthMonitor
+
+    journal = EventJournal(backend=None)
+    # three prior incarnations journaled their promotions (the first
+    # seeds the watcher's baseline epoch)
+    journal.append("election", event="election.promote", epoch=1,
+                   t=990.0)
+    journal.append("election", event="election.promote", epoch=2,
+                   t=1000.0)
+    journal.append("election", event="election.promote", epoch=3,
+                   t=1010.0)
+
+    class FakeLease:
+        epoch = 4
+
+        def state(self):
+            return self
+
+    class FakeMetrics:
+        def incr(self, name, value=1):
+            pass
+
+        def gauge(self, name, fn):
+            pass
+
+        def sample_history(self, t=None):
+            pass
+
+    class FakeScheduler:
+        metrics = FakeMetrics()
+        agent = object()
+        ha_state = type("HA", (), {"lease": FakeLease()})()
+
+        class state_store:
+            @staticmethod
+            def fetch_tasks():
+                return []
+
+        inventory = None
+        spec = None
+
+    monitor = HealthMonitor(journal=journal, telemetry_interval_s=0)
+    events = monitor.observe(FakeScheduler(), now=1020.0)
+    churn = [e for e in events if e.get("detector") == "lease-churn"]
+    assert len(churn) == 1 and churn[0]["changes"] >= 3, events
+    assert monitor.observe_errors == 0
+
+
+def test_telemetry_collection_runs_off_the_cycle_thread():
+    """With a non-zero telemetry interval the fan-in runs on a
+    background thread (one slow daemon must not stall run_cycle);
+    detectors score the completed snapshot on a later cycle."""
+    import time as _time
+
+    runner = gang_world()
+    world = runner.world
+    scheduler = world.scheduler
+    seed_steplogs(world)
+    scheduler.health.telemetry_interval_s = 0.001
+    deadline = _time.monotonic() + 10
+    while _time.monotonic() < deadline and \
+            not scheduler.health.straggler.suspects:
+        scheduler.run_cycle()
+        _time.sleep(0.01)
+    assert scheduler.health.straggler.suspects
+    assert world.inventory.suspect_hosts()
+
+
+def test_suspect_sources_union_on_shared_inventory():
+    """Regression: on a multi-service fleet every service's monitor
+    pushes only ITS OWN stragglers into the ONE shared inventory — a
+    service with no stragglers pushing set() must not clear a host
+    another service demoted, and per-source no-op pushes must not
+    churn the ordering caches every cycle."""
+    inventory = SliceInventory(hosts3())
+    inventory.set_suspect_hosts({"host-1"}, source="svc-a")
+    assert inventory.suspect_hosts() == {"host-1"}
+    inventory.set_suspect_hosts(set(), source="svc-b")  # B: all healthy
+    assert inventory.suspect_hosts() == {"host-1"}  # A's demotion holds
+    # steady-state alternation (A re-pushes, B re-pushes): no resort
+    cache = inventory._scan_hosts()
+    inventory.set_suspect_hosts({"host-1"}, source="svc-a")
+    inventory.set_suspect_hosts(set(), source="svc-b")
+    assert inventory._scan_hosts() is cache
+    # the union grows and shrinks per contributor
+    inventory.set_suspect_hosts({"host-2"}, source="svc-b")
+    assert inventory.suspect_hosts() == {"host-1", "host-2"}
+    inventory.set_suspect_hosts(set(), source="svc-a")
+    assert inventory.suspect_hosts() == {"host-2"}
+    inventory.set_suspect_hosts(set(), source="svc-b")
+    assert inventory.suspect_hosts() == set()
+
+
+# -- end to end: the acceptance scenario ------------------------------
+
+
+def gang_world():
+    runner = ServiceTestRunner(
+        GANG_YAML,
+        hosts=make_test_fleet(host_grid=(2, 2), chip_block=(2, 2)),
+    )
+    runner.run([
+        AdvanceCycles(1),
+        SendTaskRunning("trainer-0-worker"),
+        SendTaskRunning("trainer-1-worker"),
+        SendTaskRunning("trainer-2-worker"),
+        SendTaskRunning("trainer-3-worker"),
+        ExpectDeploymentComplete(),
+    ])
+    return runner
+
+
+def seed_steplogs(world, slow_task="trainer-3-worker"):
+    """Give the sim agent the sandbox-steplog surface the real agents
+    expose, with one host doing the gang's compute slowly: the slow
+    host shows own time ~1.0s (never waits), the healthy three show
+    own time ~0.1s and 0.9s of barrier blocking — exactly the shape a
+    real gang-skew steplog has."""
+    def steplog_of(name, agent_id=None):
+        if not name.startswith("trainer-"):
+            return []
+        own = 1.0 if name == slow_task else 0.1
+        return [
+            {"step": i, "t": 100.0 + i, "wall_s": 1.0,
+             "blocked_s": round(1.0 - own, 3), "tokens": 4096}
+            for i in range(8)
+        ]
+
+    world.agent.steplog_of = steplog_of
+
+
+def test_gang_straggler_lands_in_journal_and_health():
+    runner = gang_world()
+    world = runner.world
+    scheduler = world.scheduler
+    seed_steplogs(world)
+    slow_host = world.state_store.fetch_task("trainer-3-worker").agent_id
+    # deterministic cadence for the test: no time throttles
+    scheduler.health.telemetry_interval_s = 0
+    scheduler.health.history_interval_s = 0
+    scheduler.run_cycle()
+
+    # the alert is IN the journal (and survives the ring-buffered
+    # flight recorder's eviction horizon by construction)
+    alerts = scheduler.journal.events(kinds=("alert",))
+    assert any(
+        e.get("detector") == "straggler" and e.get("host") == slow_host
+        for e in alerts
+    ), alerts
+
+    # ...and visible at GET /v1/debug/health with its score
+    api = SchedulerApi(scheduler)
+    code, body = api.debug_health()
+    assert code == 200 and body["enabled"]
+    assert body["status"] == "warn"
+    assert slow_host in body["suspect_hosts"]
+    assert body["suspect_hosts"][slow_host] >= 2.0
+    assert body["straggler"]["scores"][slow_host] >= 2.0
+    assert any(
+        e.get("host") == slow_host for e in body["alerts_recent"]
+    )
+
+    # the soft placement signal reached the inventory
+    assert world.inventory.suspect_hosts() == {slow_host}
+
+    # metric history: the sampled rings answer "what was it recently"
+    code, body = api.debug_health(metric="health.suspect_hosts")
+    assert code == 200
+    assert body["history"]["metric"] == "health.suspect_hosts"
+    assert body["history"]["samples"]
+
+    # /v1/debug/events serves the journal with a working cursor
+    code, body = api.debug_events()
+    assert code == 200 and body["seq"] >= 1
+    cursor = body["seq"]
+    assert api.debug_events(since=str(cursor))[1]["events"] == []
+    assert api.debug_events(since="bogus")[0] == 400
+
+    # recovery: the straggler gets healthy again -> clear event, mark
+    # dropped, placement order restored
+    seed_steplogs(world, slow_task="none")
+    scheduler.run_cycle()
+    assert world.inventory.suspect_hosts() == set()
+    assert any(
+        e.get("cleared") for e in
+        scheduler.journal.events(kinds=("alert",))
+    )
+
+
+def test_journal_survives_scheduler_restart_in_the_sim():
+    """Failover in the sim harness: a second scheduler built over the
+    SAME persister (the ServiceTestRunner restart idiom) replays the
+    journal — operator verbs and alerts from the first incarnation
+    are visible to the second, and new events continue the seq."""
+    runner = gang_world()
+    world = runner.world
+    scheduler = world.scheduler
+    seed_steplogs(world)
+    scheduler.health.telemetry_interval_s = 0
+    scheduler.run_cycle()
+    api = SchedulerApi(scheduler)
+    assert api.plan_interrupt("deploy")[0] == 200
+    seq_before = scheduler.journal.last_seq
+    assert seq_before > 0
+    kinds_before = {e["kind"] for e in scheduler.journal.events()}
+    assert {"plan", "operator", "alert"} <= kinds_before
+
+    second = ServiceTestRunner(
+        GANG_YAML,
+        hosts=make_test_fleet(host_grid=(2, 2), chip_block=(2, 2)),
+        persister=runner.persister,
+    )
+    restarted = second.build().scheduler
+    events = restarted.journal.events()
+    assert {e["kind"] for e in events} >= {"operator", "alert"}
+    assert restarted.journal.last_seq >= seq_before
+    assert restarted.journal.append("operator", verb="post-failover")[
+        "seq"
+    ] > seq_before
+
+
+def test_health_disabled_scheduler_reports_disabled():
+    from dcos_commons_tpu.scheduler.config import SchedulerConfig
+
+    runner = ServiceTestRunner(
+        WEB_YAML,
+        scheduler_config=SchedulerConfig(
+            backoff_enabled=False, revive_capacity=1_000_000,
+            health_enabled=False,
+        ),
+    )
+    runner.run([
+        AdvanceCycles(1),
+        SendTaskRunning("app-0-srv"),
+        ExpectDeploymentComplete(),
+    ])
+    scheduler = runner.world.scheduler
+    assert not scheduler.journal.enabled
+    assert scheduler.journal.events() == []  # transitions not recorded
+    api = SchedulerApi(scheduler)
+    assert api.debug_health()[1] == {"enabled": False}
+
+
+def test_observe_never_kills_the_cycle():
+    runner = gang_world()
+    scheduler = runner.world.scheduler
+    scheduler.health.telemetry_interval_s = 0
+
+    def broken(_name, agent_id=None):
+        raise RuntimeError("sandbox exploded")
+
+    runner.world.agent.steplog_of = broken
+    scheduler.run_cycle()  # must not raise
+    assert scheduler.health.observe_errors >= 1
+    assert scheduler.metrics.counters()["health.observe_errors"] >= 1

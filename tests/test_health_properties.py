@@ -1,0 +1,103 @@
+"""Property-based tests for the straggler median-ratio scorer.
+
+The scorer (health/detectors.py median_ratio_scores) is the math the
+fleet health plane trusts to demote hosts in placement order, so its
+contracts are pinned over generated inputs: permutation invariance
+(scores depend on value multisets, never dict/list order), no alert
+on a homogeneous fleet (every score is exactly 1.0), and a guaranteed
+alert on a k-times outlier whenever k clears the threshold (the
+fleet median excludes the outlier by construction at >= 3 hosts).
+"""
+
+import pytest
+
+pytest.importorskip(
+    "hypothesis", reason="property tests need the hypothesis package"
+)
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+from dcos_commons_tpu.health.detectors import (  # noqa: E402
+    StragglerDetector,
+    median_ratio_scores,
+)
+
+# per-host step own-times in a realistic band (seconds); >= 3 samples
+# so every generated host clears the scorer's min_samples gate
+host_values = st.lists(
+    st.floats(min_value=0.01, max_value=10.0,
+              allow_nan=False, allow_infinity=False),
+    min_size=3, max_size=16,
+)
+fleets = st.dictionaries(
+    st.text(
+        alphabet="abcdefgh0123456789-", min_size=1, max_size=12
+    ).map(lambda s: f"host-{s}"),
+    host_values,
+    min_size=3, max_size=12,
+)
+
+
+@settings(max_examples=200, deadline=None)
+@given(fleets, st.randoms())
+def test_permutation_invariance(fleet, rnd):
+    """Same multisets => same scores, whatever order hosts and values
+    arrive in (steplog merge order is racy by nature)."""
+    base = median_ratio_scores(fleet)
+    hosts = list(fleet)
+    rnd.shuffle(hosts)
+    shuffled = {}
+    for host in hosts:
+        values = list(fleet[host])
+        rnd.shuffle(values)
+        shuffled[host] = values
+    assert median_ratio_scores(shuffled) == base
+
+
+@settings(max_examples=200, deadline=None)
+@given(
+    st.integers(min_value=3, max_value=12),
+    st.floats(min_value=0.01, max_value=5.0,
+              allow_nan=False, allow_infinity=False),
+)
+def test_homogeneous_fleet_never_alerts(n_hosts, step_s):
+    """Every host identical => every score exactly 1.0; no threshold
+    above 1 can fire."""
+    fleet = {f"h{i}": [step_s] * 4 for i in range(n_hosts)}
+    scores = median_ratio_scores(fleet)
+    assert set(scores) == set(fleet)
+    assert all(score == 1.0 for score in scores.values())
+    detector = StragglerDetector(threshold=1.5)
+    events = detector.observe({
+        host: [{"wall_s": v, "blocked_s": 0.0} for v in values]
+        for host, values in fleet.items()
+    })
+    assert events == [] and detector.suspects == {}
+
+
+@settings(max_examples=200, deadline=None)
+@given(
+    st.integers(min_value=3, max_value=12),
+    st.floats(min_value=0.05, max_value=2.0,
+              allow_nan=False, allow_infinity=False),
+    st.floats(min_value=2.5, max_value=20.0,
+              allow_nan=False, allow_infinity=False),
+)
+def test_k_times_outlier_always_alerts(n_hosts, step_s, k):
+    """One host at k x the homogeneous fleet scores exactly k (the
+    fleet median is the healthy value at >= 3 hosts with one outlier
+    ... n_hosts >= 3 means healthy hosts are the strict majority), so
+    any threshold <= k fires, and only for that host."""
+    fleet = {f"h{i}": [step_s] * 4 for i in range(n_hosts)}
+    fleet["straggler"] = [step_s * k] * 4
+    detector = StragglerDetector(threshold=2.0)
+    events = detector.observe({
+        host: [{"wall_s": v, "blocked_s": 0.0} for v in values]
+        for host, values in fleet.items()
+    })
+    assert set(detector.suspects) == {"straggler"}
+    assert len(events) == 1
+    assert events[0]["host"] == "straggler"
+    assert abs(detector.scores["straggler"] - k) < 1e-6
+    # healthy hosts stay at exactly 1.0
+    for i in range(n_hosts):
+        assert detector.scores[f"h{i}"] == 1.0

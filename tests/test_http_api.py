@@ -189,7 +189,11 @@ def test_debug_trace_empty_recorder(deployed):
     assert chrome["traceEvents"] == []
     assert chrome["otherData"]["dropped"] == 0
     text = get(server, "/v1/debug/trace")
-    assert "0 entries" in text
+    assert "(0 dropped" in text
+    # an empty RECORDER still renders the journal lane (the deploy's
+    # plan transitions journaled): every non-header row is journal
+    rows = [l for l in text.splitlines() if not l.startswith("#")]
+    assert rows and all(" journal " in row for row in rows)
 
 
 def test_debug_trace_truncation_reports_dropped(deployed):

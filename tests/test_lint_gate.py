@@ -416,6 +416,59 @@ def test_rule_lease_gated_mutation(tmp_path):
     assert not findings and len(suppressed) == 1
 
 
+def test_rule_metric_cardinality(tmp_path):
+    src = """
+    class S:
+        def record(self, status, request_id):
+            self.metrics.incr(f"task_status.{status.task_id}")
+            self.metrics.gauge("lat." + request_id, lambda: 1.0)
+            self.metrics.incr("req.%s" % request_id)
+            self.metrics.incr("req.{}".format(request_id))
+    """
+    findings, _ = _lint_fixture(
+        tmp_path, src, rule_id="metric-cardinality"
+    )
+    assert len(findings) == 4
+    assert "task_id" in findings[0].message
+    # bounded vocabularies and non-metric receivers are out of scope
+    ok = """
+    class S:
+        def record(self, status, key, pid):
+            self.metrics.incr(f"task_status.{status.state.value}")
+            self.metrics.incr(f"ha.rehydrate.{key}")
+            self.metrics.incr("operations.launch")
+            self.queue.incr(f"depth.{status.task_id}")  # not a registry
+            self.log.time(f"t.{pid}")                   # not a registry
+    """
+    findings, _ = _lint_fixture(
+        tmp_path, ok, rule_id="metric-cardinality"
+    )
+    assert not findings
+    # the documented waiver: suppression with the bound stated
+    suppressed_src = """
+    class S:
+        def record(self, status, request_id):
+            self.metrics.incr(f"task_status.{status.task_id}")  # sdklint: disable=metric-cardinality — bounded: test fixture
+    """
+    findings, suppressed = _lint_fixture(
+        tmp_path, suppressed_src, rule_id="metric-cardinality"
+    )
+    assert not findings and len(suppressed) == 1
+    # registered allowlist prefixes waive the check (the bound lives
+    # at the registration site)
+    import dcos_commons_tpu.analysis.rules as rules_mod
+
+    original = rules_mod.METRIC_CARDINALITY_ALLOWLIST
+    rules_mod.METRIC_CARDINALITY_ALLOWLIST = ("task_status.",)
+    try:
+        findings, _ = _lint_fixture(
+            tmp_path, src, rule_id="metric-cardinality"
+        )
+        assert len(findings) == 3  # the task_status. call is waived
+    finally:
+        rules_mod.METRIC_CARDINALITY_ALLOWLIST = original
+
+
 def test_file_level_suppression(tmp_path):
     src = (
         "# sdklint: disable-file=no-blocking-sleep — tick harness\n"

@@ -1,14 +1,23 @@
-"""Metrics registry: exposition typing and StatsD push.
+"""Metrics registry: exposition typing, StatsD push, metric history.
 
 Reference: metrics/Metrics.java — counters AND timers push to StatsD
 when STATSD_UDP_HOST/PORT are set (Metrics.java:74-79), and the
 Prometheus exposition types monotonic counters as ``counter`` so
-downstream ``rate()`` works.
+downstream ``rate()`` works.  Timers additionally expose a full
+histogram family (monotonic ``_bucket``/``_sum``/``_count``), names
+are sanitized to the Prometheus charset, and every metric gains a
+bounded time-series history ring (the /v1/debug/health substrate).
 """
 
+import re
 import socket
 
-from dcos_commons_tpu.metrics.registry import Metrics
+from dcos_commons_tpu.metrics.registry import (
+    TIMER_BUCKETS,
+    MetricHistory,
+    Metrics,
+    prometheus_name,
+)
 
 
 def test_timer_samples_window_survives_ring_trim():
@@ -52,18 +61,122 @@ def test_prometheus_types_counters_as_counter():
     assert "# TYPE task_status_task_running counter" in lines
     # registered gauges stay gauges
     assert "# TYPE offers_snapshot_cache_hit gauge" in lines
-    # every timer aggregate (count/min/mean/max/p95) is a gauge: the
-    # window re-aggregates, so none of them is monotonic
-    timer_types = [
-        line for line in lines
-        if line.startswith("# TYPE cycle_process")
-    ]
-    assert timer_types and all(t.endswith("gauge") for t in timer_types)
-    # exposition shape: every TYPE line is followed by its sample
+    # windowed timer aggregates (min/mean/max/p95 over the sample
+    # ring) are gauges — the window re-aggregates, so none of them is
+    # monotonic; the monotonic side lives in the histogram family
+    for suffix in ("min_s", "mean_s", "avg_s", "max_s", "p95_s"):
+        assert f"# TYPE cycle_process_{suffix} gauge" in lines
+    assert "# TYPE cycle_process histogram" in lines
+    # exposition shape: every TYPE line is followed by its first
+    # sample (histogram samples carry the _bucket/_sum/_count suffix)
     for i, line in enumerate(lines):
         if line.startswith("# TYPE "):
-            metric = line.split()[2]
-            assert lines[i + 1].startswith(metric + " ")
+            metric, kind = line.split()[2], line.split()[3]
+            prefix = metric + ("_bucket{" if kind == "histogram"
+                               else " ")
+            assert lines[i + 1].startswith(prefix), (line, lines[i + 1])
+
+
+def test_prometheus_timer_histogram_family():
+    """Timers expose monotonic ``_bucket{le=...}``/``_sum``/``_count``
+    (the satellite fix: nothing monotonic was exported for timers, so
+    downstream rate()/histogram_quantile() had nothing to chew on) —
+    and the counts survive the 256-sample ring trim."""
+    m = Metrics()
+    for _ in range(300):
+        with m.time("cycle.process"):
+            pass
+    lines = m.prometheus().splitlines()
+    count = [l for l in lines if l.startswith("cycle_process_count ")]
+    assert count == ["cycle_process_count 300"]  # NOT the ring's 256
+    total = [l for l in lines if l.startswith("cycle_process_sum ")]
+    assert total and float(total[0].split()[1]) > 0.0
+    # the superseded ring-window .count gauge is skipped (it would
+    # collide with the monotonic _count under sanitization)
+    assert not any(l.startswith("# TYPE cycle_process_count") for l in lines)
+    buckets = [l for l in lines if l.startswith("cycle_process_bucket{")]
+    assert len(buckets) == len(TIMER_BUCKETS) + 1
+    assert buckets[-1] == 'cycle_process_bucket{le="+Inf"} 300'
+    # cumulative monotonicity across the ladder
+    counts = [int(l.rsplit(" ", 1)[1]) for l in buckets]
+    assert counts == sorted(counts)
+    # the snapshot JSON keeps the windowed .count aggregate untouched
+    assert m.snapshot()["cycle.process.count"] == 256.0
+
+
+def test_prometheus_name_sanitization():
+    """Names with embedded runtime ids (``ha.replication.lag.<id>``)
+    must emit charset-valid lines — one bad line makes a scraper
+    reject the whole exposition."""
+    assert prometheus_name("ha.replication.lag.standby@2") == \
+        "ha_replication_lag_standby_2"
+    assert prometheus_name("9lives") == "_9lives"
+    m = Metrics()
+    m.incr("ha.replication.lag.puller 1/east")
+    m.gauge("serving.ttft_p95_s.web:0", lambda: 1.25)
+    with m.time("cycle.evaluate"):
+        pass
+    valid = re.compile(
+        r"^(# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* \w+"
+        r"|[a-zA-Z_:][a-zA-Z0-9_:]*(\{le=\"[^\"]+\"\})? \S+)$"
+    )
+    for line in m.prometheus().splitlines():
+        assert valid.match(line), line
+    # a sanitization collision keeps the first series only (duplicate
+    # unlabeled series are invalid too)
+    m2 = Metrics()
+    m2.incr("offers.a-b")
+    m2.incr("offers.a.b")
+    lines = m2.prometheus().splitlines()
+    assert lines.count("# TYPE offers_a_b counter") == 1
+
+
+def test_metric_history_rings_and_rates():
+    history = MetricHistory(capacity=4)
+    for i in range(6):
+        history.record(
+            {"offers.evaluated": float(10 * i), "cycle.mean_s": 0.5},
+            counter_names={"offers.evaluated"},
+            t=100.0 + i,
+        )
+    # bounded drop-oldest ring with timestamps
+    series = history.series("offers.evaluated")
+    assert len(series) == 4
+    assert series[0] == (102.0, 20.0) and series[-1] == (105.0, 50.0)
+    assert history.series("offers.evaluated", since=104.0) == \
+        [(105.0, 50.0)]
+    # counter rate: 10/s over the observed window; non-counters None
+    assert abs(history.rate("offers.evaluated") - 10.0) < 1e-9
+    assert history.rate("cycle.mean_s") is None
+    assert history.rate("never.recorded") is None
+    summary = history.summary()
+    assert summary["offers.evaluated"]["last"] == 50.0
+    assert summary["offers.evaluated"]["rate_per_s"] == 10.0
+    assert summary["cycle.mean_s"]["n"] == 4
+    assert "rate_per_s" not in summary["cycle.mean_s"]
+
+
+def test_metric_history_counter_reset_clamps_rate():
+    history = MetricHistory()
+    history.record({"c": 100.0}, counter_names={"c"}, t=1.0)
+    history.record({"c": 5.0}, counter_names={"c"}, t=2.0)  # reset
+    assert history.rate("c") == 0.0
+
+
+def test_registry_sample_history_end_to_end():
+    m = Metrics()
+    m.incr("offers.evaluated", 5)
+    m.gauge("g", lambda: 7.0)
+    with m.time("cycle.process"):
+        pass
+    m.sample_history(t=10.0)
+    m.incr("offers.evaluated", 5)
+    m.sample_history(t=11.0)
+    assert [v for _, v in m.history.series("offers.evaluated")] == \
+        [5.0, 10.0]
+    assert m.history.rate("offers.evaluated") == 5.0
+    assert m.history.series("g")[-1][1] == 7.0
+    assert m.history.series("cycle.process.mean_s")
 
 
 def test_statsd_receives_counter_and_timing_datagrams(monkeypatch):

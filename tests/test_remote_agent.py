@@ -312,6 +312,111 @@ def test_daemon_death_triggers_host_down_and_replace(daemons, tmp_path):
     assert other.agent_id == placed[other_index]
 
 
+def test_fleet_telemetry_fan_in_over_the_wire(daemons, tmp_path):
+    """Remote-fleet telemetry parity (the PR 10 satellite): steplogs
+    and serving gauges written into a DAEMON's sandbox surface through
+    RemoteAgentClient and RemoteFleet exactly as LocalProcessAgent
+    surfaces them in-process — so /v1/debug/trace, /v1/debug/serving
+    and the straggler detector see the production topology too."""
+    import json as _json
+
+    daemon = daemons("h0")
+    client = RemoteAgentClient("h0", daemon.url)
+    steplog_line = _json.dumps(
+        {"step": 3, "t": 10.0, "wall_s": 0.5, "blocked_s": 0.1}
+    )
+    servestats = _json.dumps(
+        {"queue_depth": 2, "active_slots": 1, "ttft_p95_s": 0.8}
+    )
+    info = TaskInfo(
+        name="app-0-server",
+        task_id="app-0-server__tl",
+        agent_id="h0",
+        command=(
+            f"echo '{steplog_line}' > steplog.jsonl && "
+            f"echo '{servestats}' > servestats.json && sleep 60"
+        ),
+    )
+    client.launch([{"info": info.to_dict()}])
+    fleet = RemoteFleet()
+    fleet.add_host("h0", daemon.url)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if any(s.state.is_running for s in fleet.poll()):
+            break
+        time.sleep(0.05)
+    # files may land a beat after RUNNING: poll the reader
+    records = []
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and not records:
+        records = client.steplog_of("app-0-server")
+        time.sleep(0.05)
+    assert records == [
+        {"step": 3, "t": 10.0, "wall_s": 0.5, "blocked_s": 0.1}
+    ]
+    assert client.serving_stats_of("app-0-server") == {
+        "queue_depth": 2, "active_slots": 1, "ttft_p95_s": 0.8
+    }
+    # the fleet routes by task NAME through the owner map (learned
+    # from the poll above)
+    assert fleet.steplog_of("app-0-server") == records
+    assert fleet.serving_stats_of("app-0-server")["queue_depth"] == 2
+    # best-effort contract: unknown tasks and dead daemons read empty
+    assert fleet.steplog_of("never-launched") == []
+    assert fleet.serving_stats_of("never-launched") == {}
+    # an explicit agent_id routes EXACTLY (the health monitor passes
+    # the owner from its own state store — immune to cross-service
+    # task-name collisions on a shared fleet); an unknown host reads
+    # empty, never guesses by name
+    assert fleet.steplog_of("app-0-server", agent_id="h0") == records
+    assert fleet.steplog_of("app-0-server", agent_id="h-unknown") == []
+    # steady state: polls that change nothing do not invalidate the
+    # name index (the generation only moves on real owner changes)
+    fleet.poll()
+    gen_before = fleet._owners_gen
+    fleet.poll()
+    assert fleet._owners_gen == gen_before
+    # owner CHANGE refreshes the name-keyed routing index: the task's
+    # replacement lands on another daemon under the same name, and
+    # telemetry must follow it there (kill -> terminal pops the old
+    # owner; the relaunch poll inserts the new one)
+    d1 = daemons("h1")
+    fleet.add_host("h1", d1.url)
+    fleet.kill(info.task_id)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if any(s.state.is_terminal for s in fleet.poll()):
+            break
+        time.sleep(0.05)
+    replacement_line = _json.dumps(
+        {"step": 9, "t": 20.0, "wall_s": 0.7, "blocked_s": 0.2}
+    )
+    moved = TaskInfo(
+        name="app-0-server",
+        task_id="app-0-server__tl2",
+        agent_id="h1",
+        command=f"echo '{replacement_line}' > steplog.jsonl && sleep 60",
+    )
+    RemoteAgentClient("h1", d1.url).launch([{"info": moved.to_dict()}])
+    deadline = time.monotonic() + 10
+    routed = []
+    while time.monotonic() < deadline:
+        fleet.poll()
+        routed = fleet.steplog_of("app-0-server")
+        if routed:
+            break
+        time.sleep(0.05)
+    assert routed == [
+        {"step": 9, "t": 20.0, "wall_s": 0.7, "blocked_s": 0.2}
+    ]
+    daemon.stop()
+    d1.stop()
+    assert fleet.steplog_of("app-0-server") == []
+    assert fleet.serving_stats_of("app-0-server") == {}
+    # telemetry probes never move the down-detection counters
+    assert not fleet.down_hosts()
+
+
 def test_fleet_kill_unknown_owner_broadcasts(daemons):
     fleet = RemoteFleet()
     d0, d1 = daemons("h0"), daemons("h1")
