@@ -411,25 +411,41 @@ def _serve_leaves(env, mesh_total_tp: int) -> Tuple[Any, List[AbstractLeaf]]:
         shapes, rules,
         quantized=env.get("WEIGHT_DTYPE", "native") == "int8",
     )
-    # the serving KV footprint is the continuous-batching SLOT POOL
-    # (serve/engine.py): allocated ONCE at max-concurrent-slots x
-    # max_len — SERVE_SLOTS when the operator decouples residency
-    # from the per-request row cap, else SERVE_BATCH — honoring
-    # KV_DTYPE (int8 halves the pool bytes).  A managed budget, not a
-    # per-request guess: occupancy within this allocation is the
-    # runtime gauge (kv_occupancy), the allocation itself is what HBM
-    # must hold.
+    # the serving KV footprint IS the runtime allocation, exactly:
+    # by default the PAGED ARENA (serve/paging.py, ISSUE 11) —
+    # KV_PAGES usable pages + the trash page, each KV_PAGE_TOKENS
+    # positions, shaped by the SAME paged_config_from_env contract
+    # the workers and the PR 9 admission gate consume (an
+    # under-budgeted arena is a SpecError at derivation, so admission
+    # rejects page-budget overcommit at PUT time) — or, when
+    # KV_PAGE_TOKENS=0 selects the legacy slot pool, the SLOTS x
+    # MAX_LEN carve.  Both honor KV_DTYPE (int8 halves the bytes).
+    # A managed budget, not a per-request guess: occupancy within
+    # this allocation is the runtime gauge (kv_occupancy /
+    # kv_pages_free), the allocation itself is what HBM must hold.
+    from dcos_commons_tpu.serve.paging import paged_config_from_env
+
     slots = int(env.get("SERVE_SLOTS") or 0) or int(
         env.get("SERVE_BATCH", "1")
     )
     max_len = int(env.get("MAX_LEN", "256"))
     kv_dtype = env.get("KV_DTYPE", "native")
-    cache_shapes = jax.eval_shape(functools.partial(
-        init_kv_cache, config, slots, max_len, kv_dtype
-    ))
-    # pool dims (layers, slots, len, kv_heads, head_dim): heads ride
-    # tp like the attention weights when divisible (the gang worker's
-    # cache_sharding), else the pool replicates; slots replicate
+    paged = paged_config_from_env(env)
+    if paged is not None:
+        from dcos_commons_tpu.models.decode import init_paged_kv_cache
+
+        cache_shapes = jax.eval_shape(functools.partial(
+            init_paged_kv_cache, config, paged.arena_pages,
+            paged.page_tokens, kv_dtype,
+        ))
+    else:
+        cache_shapes = jax.eval_shape(functools.partial(
+            init_kv_cache, config, slots, max_len, kv_dtype
+        ))
+    # cache dims (layers, pages-or-slots, tokens, kv_heads, head_dim):
+    # heads ride tp like the attention weights when divisible (the
+    # gang worker's cache_sharding — kv heads sit on dim 3 in BOTH
+    # layouts), else the cache replicates; pages/slots replicate
     # across the gang (every rank steps the same broadcast pool)
     kv_sharded = (
         mesh_total_tp > 1 and config.n_kv_heads % mesh_total_tp == 0

@@ -161,14 +161,22 @@ class ServingSloWatcher:
     options.json serving.* knobs ride the task env contract), falling
     back to the scheduler-level defaults; a threshold of 0 disables
     that check.  Edge-triggered per (task, signal): one alert when the
-    breach starts, one clear when it ends.
+    breach starts, one clear when it ends.  Signals carry a DIRECTION:
+    ``max`` breaches above the threshold (latency, depth, occupancy);
+    ``min`` breaches below it — ``kv_pages_free`` is the paged
+    engine's memory headroom, and running OUT of pages (503s with a
+    kv-page-budget reason) is the breach.
     """
 
     SIGNALS = (
-        # (signal key in stats, env knob, default attr)
-        ("ttft_p95_s", "SERVE_TTFT_SLO_S", "ttft_p95_slo_s"),
-        ("queue_depth", "SERVE_QUEUE_DEPTH_SLO", "queue_depth_slo"),
-        ("kv_occupancy", "SERVE_KV_OCCUPANCY_SLO", "kv_occupancy_slo"),
+        # (signal key in stats, env knob, default attr, direction)
+        ("ttft_p95_s", "SERVE_TTFT_SLO_S", "ttft_p95_slo_s", "max"),
+        ("queue_depth", "SERVE_QUEUE_DEPTH_SLO", "queue_depth_slo",
+         "max"),
+        ("kv_occupancy", "SERVE_KV_OCCUPANCY_SLO", "kv_occupancy_slo",
+         "max"),
+        ("kv_pages_free", "SERVE_KV_PAGES_FREE_SLO",
+         "kv_pages_free_slo", "min"),
     )
     # consecutive collections a breaching (task, signal) may go
     # unsampled before its episode is dropped as retired
@@ -179,10 +187,12 @@ class ServingSloWatcher:
         ttft_p95_slo_s: float = 0.0,
         queue_depth_slo: float = 0.0,
         kv_occupancy_slo: float = 0.0,
+        kv_pages_free_slo: float = 0.0,
     ):
         self.ttft_p95_slo_s = float(ttft_p95_slo_s)
         self.queue_depth_slo = float(queue_depth_slo)
         self.kv_occupancy_slo = float(kv_occupancy_slo)
+        self.kv_pages_free_slo = float(kv_pages_free_slo)
         self.breaches: Dict[tuple, float] = {}  # (task, signal) -> value
         self._missed: Dict[tuple, int] = {}  # consecutive absent samples
 
@@ -204,7 +214,7 @@ class ServingSloWatcher:
         seen = set()
         for task, stats in sorted(stats_by_task.items()):
             env = (env_by_task or {}).get(task, {})
-            for signal, knob, attr in self.SIGNALS:
+            for signal, knob, attr, direction in self.SIGNALS:
                 threshold = self._threshold(env, knob, attr)
                 if threshold <= 0 or signal not in stats:
                     continue
@@ -214,13 +224,17 @@ class ServingSloWatcher:
                     continue
                 key = (task, signal)
                 seen.add(key)
-                if value > threshold and key in self.breaches:
+                breaching = (
+                    value < threshold if direction == "min"
+                    else value > threshold
+                )
+                if breaching and key in self.breaches:
                     # still breaching: no repeat alert, but keep the
                     # CURRENT magnitude — an operator triaging
                     # /v1/debug/health must see the runaway value,
                     # not the marginal first-breach one
                     self.breaches[key] = value
-                elif value > threshold:
+                elif breaching:
                     self.breaches[key] = value
                     events.append({
                         "kind": "alert",
@@ -232,10 +246,16 @@ class ServingSloWatcher:
                         "message": (
                             f"{task} {signal}={round(value, 4)} breaches "
                             f"SLO {threshold}"
+                            + (" (below minimum)"
+                               if direction == "min" else "")
                         ),
                     })
-                elif value <= threshold and key in self.breaches:
+                elif not breaching and key in self.breaches:
                     del self.breaches[key]
+                    recovery = (
+                        "back above minimum SLO"
+                        if direction == "min" else "back under SLO"
+                    )
                     events.append({
                         "kind": "alert",
                         "detector": "slo",
@@ -243,7 +263,7 @@ class ServingSloWatcher:
                         "signal": signal,
                         "value": round(value, 4),
                         "cleared": True,
-                        "message": f"{task} {signal} back under SLO",
+                        "message": f"{task} {signal} {recovery}",
                     })
         # a missing sample is not a recovery: one failed collection
         # (a dropped RPC, an idle window omitting a percentile) must

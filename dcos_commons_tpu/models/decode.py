@@ -62,6 +62,40 @@ def init_kv_cache(
     }
 
 
+def init_paged_kv_cache(
+    config: TransformerConfig, n_pages: int, page_tokens: int,
+    kv_dtype: str = "native",
+) -> Dict[str, jax.Array]:
+    """The paged arena: K/V stored as fixed-size pages instead of
+    per-request rows.  Shape [n_layers, n_pages, page_tokens,
+    n_kv_heads, head_dim]; a request's virtual position ``p`` lives at
+    ``(table[p // page_tokens], p % page_tokens)`` through its page
+    table.  Page 0 is the TRASH page (serve/paging.py): padding and
+    inactive-row writes land there, and table entry 0 also means
+    "virtual page unallocated" — those positions are always masked.
+
+    Same dict keys as ``init_kv_cache`` (int8 adds per-vector scales),
+    so ``kv_dtype`` handling and sharding rules carry over: dims are
+    (layers, pages, page_tokens, kv_heads, head_dim) — kv heads stay
+    dim 3, exactly where the gang lays the tp axis."""
+    shape = (
+        config.n_layers, n_pages, page_tokens, config.n_kv_heads,
+        config.head_dim,
+    )
+    if kv_dtype == "int8":
+        scale_shape = shape[:-1] + (1,)
+        return {
+            "k": jnp.zeros(shape, jnp.int8),
+            "v": jnp.zeros(shape, jnp.int8),
+            "k_scale": jnp.zeros(scale_shape, jnp.float32),
+            "v_scale": jnp.zeros(scale_shape, jnp.float32),
+        }
+    return {
+        "k": jnp.zeros(shape, config.dtype),
+        "v": jnp.zeros(shape, config.dtype),
+    }
+
+
 def _quantize_kv(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
     """Per-vector symmetric int8: each [head_dim] slice gets its own
     max-abs scale.  Decode is HBM-bound on streaming the cache, so
@@ -333,6 +367,232 @@ def decode_step(
         x, (ck, cv, cks, cvs) = lax.scan(
             layer_fn,
             x,
+            (params["layers"], cache["k"], cache["v"],
+             cache["k_scale"], cache["v_scale"]),
+        )
+        new_cache = {"k": ck, "v": cv, "k_scale": cks, "v_scale": cvs}
+    else:
+        x, (ck, cv) = lax.scan(
+            layer_fn, x, (params["layers"], cache["k"], cache["v"])
+        )
+        new_cache = {"k": ck, "v": cv}
+    x = rms_norm(x, params["final_norm"])
+    logits = jnp.einsum(
+        "bd,vd->bv", x[:, 0].astype(jnp.float32),
+        params["embed"].astype(jnp.float32),
+    )
+    return logits, new_cache
+
+
+def paged_prefill_chunk(
+    config: TransformerConfig,
+    params: Params,
+    cache: Dict[str, jax.Array],
+    tokens: jax.Array,
+    table: jax.Array,
+    start: jax.Array,
+    true_len: jax.Array,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """One CHUNK of a prompt through the trunk into a paged arena.
+
+    ``tokens [1, C]`` carries up to C prompt tokens at virtual
+    positions ``[start, start + true_len)`` of one request whose page
+    table is ``table [M]`` (physical page per virtual page; 0 =
+    unallocated).  K/V for the chunk is scattered through the table
+    (pad positions land in the trash page), then each chunk query
+    attends to EVERY earlier virtual position — prior chunks' pages,
+    prefix-cache pages, and the in-chunk causal prefix — gathered
+    through the same table.  Returns (logits at the chunk's last real
+    position [1, vocab] f32, updated cache).
+
+    ``start`` and ``true_len`` are TRACED: one compile covers every
+    chunk of every prompt — a request resuming at position k*P after
+    a prefix-cache hit runs the same program as one starting at 0.
+    This is the chunked-prefill entry: a long prompt costs several
+    SMALL dispatches interleaved with decode ticks instead of one
+    prompt-wide dispatch that blocks the pool (head-of-line TTFT).
+    """
+    b, c = tokens.shape
+    if b != 1:
+        raise ValueError(f"prefill chunks are per-request, got batch {b}")
+    h, kv, hd = config.n_heads, config.n_kv_heads, config.head_dim
+    p_tok = cache["k"].shape[2]
+    m = table.shape[0]
+    length = m * p_tok
+    quantized = "k_scale" in cache
+    reps = h // kv
+    start = jnp.asarray(start, jnp.int32)
+    true_len = jnp.asarray(true_len, jnp.int32)
+    offs = jnp.arange(c, dtype=jnp.int32)
+    abs_pos = start + offs                       # [c] virtual positions
+    positions = abs_pos[None, :]                 # [1, c]
+    vpage = jnp.minimum(abs_pos // p_tok, m - 1)
+    # pad positions (>= true_len) scatter into the trash page: their
+    # K/V must never land in a real page a later chunk would attend to
+    phys = jnp.where(offs < true_len, table[vpage], 0)
+    slot_off = abs_pos % p_tok
+    # causal across the whole virtual sequence: key position <= query
+    # position — covers prior chunks, cached prefix pages, and the
+    # in-chunk prefix in one mask; unallocated pages sit past every
+    # valid query and mask out
+    valid = (
+        lax.broadcasted_iota(jnp.int32, (c, length), 1)
+        <= abs_pos[:, None]
+    )                                            # [c, L]
+    x = params["embed"][tokens].astype(config.dtype)
+
+    def layer_fn(x, inputs):
+        if quantized:
+            layer, ck, cv, cks, cvs = inputs
+        else:
+            layer, ck, cv = inputs
+            cks = cvs = None
+        normed = rms_norm(x, layer["attn_norm"])
+        q, k_new, v_new = _project_kv(config, layer, normed, positions)
+        if quantized:
+            kq, ks_new = _quantize_kv(k_new)
+            vq, vs_new = _quantize_kv(v_new)
+            ck = ck.at[phys, slot_off].set(kq[0])
+            cv = cv.at[phys, slot_off].set(vq[0])
+            cks = cks.at[phys, slot_off].set(ks_new[0])
+            cvs = cvs.at[phys, slot_off].set(vs_new[0])
+        else:
+            ck = ck.at[phys, slot_off].set(k_new[0])
+            cv = cv.at[phys, slot_off].set(v_new[0])
+        # gather the request's whole virtual sequence through the
+        # table (scatter-then-gather: in-chunk keys ride the same
+        # path as prior pages — one attention covers both)
+        k_all = ck[table].reshape(1, length, kv, hd)
+        v_all = cv[table].reshape(1, length, kv, hd)
+        qg = (q.astype(jnp.float32) * hd ** -0.5).reshape(
+            1, c, kv, reps, hd
+        )
+        scores = jnp.einsum(
+            "bqkrd,blkd->bqkrl", qg, k_all.astype(jnp.float32)
+        )
+        if quantized:
+            ks_all = cks[table].reshape(1, length, kv)
+            vs_all = cvs[table].reshape(1, length, kv)
+            scores = scores * ks_all.transpose(0, 2, 1)[:, None, :, None, :]
+        scores = jnp.where(valid[None, :, None, None, :], scores, _NEG)
+        probs = jax.nn.softmax(scores, axis=-1)
+        if quantized:
+            probs = probs * vs_all.transpose(0, 2, 1)[:, None, :, None, :]
+        attn = jnp.einsum(
+            "bqkrl,blkd->bqkrd", probs, v_all.astype(jnp.float32)
+        ).astype(config.dtype)
+        x = x + attn.reshape(1, c, h * hd) @ dq(layer["wo"], x.dtype)
+        x, _moe_aux = _ffn_block(config, layer, x, decode=True)
+        if quantized:
+            return x, (ck, cv, cks, cvs)
+        return x, (ck, cv)
+
+    if quantized:
+        x, (ck, cv, cks, cvs) = lax.scan(
+            layer_fn, x,
+            (params["layers"], cache["k"], cache["v"],
+             cache["k_scale"], cache["v_scale"]),
+        )
+        new_cache = {"k": ck, "v": cv, "k_scale": cks, "v_scale": cvs}
+    else:
+        x, (ck, cv) = lax.scan(
+            layer_fn, x, (params["layers"], cache["k"], cache["v"])
+        )
+        new_cache = {"k": ck, "v": cv}
+    x = rms_norm(x, params["final_norm"])
+    x_last = lax.dynamic_index_in_dim(
+        x, true_len - 1, axis=1, keepdims=False
+    )
+    logits = jnp.einsum(
+        "bd,vd->bv", x_last.astype(jnp.float32),
+        params["embed"].astype(jnp.float32),
+    )
+    return logits, new_cache
+
+
+def paged_decode_step(
+    config: TransformerConfig,
+    params: Params,
+    cache: Dict[str, jax.Array],
+    token: jax.Array,
+    pos: jax.Array,
+    tables: jax.Array,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """One autoregressive step over the whole pool, KV indirected
+    through per-row page tables: ``token [S]`` at per-row positions
+    ``pos [S]``, ``tables [S, M]`` mapping each row's virtual pages to
+    arena pages -> (logits [S, vocab] f32, updated cache).
+
+    The row's new K/V is scattered to ``(tables[s, pos // P],
+    pos % P)`` — inactive rows (all-zero tables) write identical
+    values into the trash page — and attention gathers each row's
+    pages back into virtual order, so the masked-softmax math is
+    element-for-element the slot pool's with ``max_len = M * P``."""
+    b = token.shape[0]
+    h, kv, hd = config.n_heads, config.n_kv_heads, config.head_dim
+    p_tok = cache["k"].shape[2]
+    m = tables.shape[1]
+    length = m * p_tok
+    x = params["embed"][token][:, None, :].astype(config.dtype)
+    pos = jnp.asarray(pos, jnp.int32)
+    positions = pos[:, None]
+    rows = jnp.arange(b)
+    vpage = jnp.minimum(pos // p_tok, m - 1)
+    phys = tables[rows, vpage]                   # [b]
+    slot_off = pos % p_tok
+    valid = (
+        lax.broadcasted_iota(jnp.int32, (1, 1, length), 2)
+        <= pos[:, None, None]
+    )                                            # [b, 1, L]
+    quantized = "k_scale" in cache
+    reps = h // kv
+
+    def layer_fn(x, inputs):
+        if quantized:
+            layer, ck, cv, cks, cvs = inputs
+        else:
+            layer, ck, cv = inputs
+            cks = cvs = None
+        normed = rms_norm(x, layer["attn_norm"])
+        q, k_new, v_new = _project_kv(config, layer, normed, positions)
+        if quantized:
+            kq, ks_new = _quantize_kv(k_new)
+            vq, vs_new = _quantize_kv(v_new)
+            ck = ck.at[phys, slot_off].set(kq[:, 0])
+            cv = cv.at[phys, slot_off].set(vq[:, 0])
+            cks = cks.at[phys, slot_off].set(ks_new[:, 0])
+            cvs = cvs.at[phys, slot_off].set(vs_new[:, 0])
+        else:
+            ck = ck.at[phys, slot_off].set(k_new[:, 0])
+            cv = cv.at[phys, slot_off].set(v_new[:, 0])
+        k_all = ck[tables].reshape(b, length, kv, hd)
+        v_all = cv[tables].reshape(b, length, kv, hd)
+        qg = (q.astype(jnp.float32) * hd ** -0.5).reshape(
+            b, kv, reps, hd
+        )
+        scores = jnp.einsum(
+            "bkrd,blkd->bkrl", qg, k_all.astype(jnp.float32)
+        )
+        if quantized:
+            ks_all = cks[tables].reshape(b, length, kv)
+            vs_all = cvs[tables].reshape(b, length, kv)
+            scores = scores * ks_all.transpose(0, 2, 1)[:, :, None, :]
+        scores = jnp.where(valid[:, :, None, :], scores, _NEG)
+        probs = jax.nn.softmax(scores, axis=-1)
+        if quantized:
+            probs = probs * vs_all.transpose(0, 2, 1)[:, :, None, :]
+        attn = jnp.einsum(
+            "bkrl,blkd->bkrd", probs, v_all.astype(jnp.float32)
+        ).astype(config.dtype)
+        x = x + attn.reshape(b, 1, h * hd) @ dq(layer["wo"], x.dtype)
+        x, _moe_aux = _ffn_block(config, layer, x, decode=True)
+        if quantized:
+            return x, (ck, cv, cks, cvs)
+        return x, (ck, cv)
+
+    if quantized:
+        x, (ck, cv, cks, cvs) = lax.scan(
+            layer_fn, x,
             (params["layers"], cache["k"], cache["v"],
              cache["k_scale"], cache["v_scale"]),
         )

@@ -337,6 +337,9 @@ class SchedulerBuilder:
                     ttft_p95_slo_s=self._config.health_ttft_p95_slo_s,
                     queue_depth_slo=self._config.health_queue_depth_slo,
                     kv_occupancy_slo=self._config.health_kv_occupancy_slo,
+                    kv_pages_free_slo=(
+                        self._config.health_kv_pages_free_slo
+                    ),
                 ),
                 telemetry_interval_s=(
                     self._config.health_telemetry_interval_s

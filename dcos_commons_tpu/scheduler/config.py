@@ -68,6 +68,7 @@ class SchedulerConfig:
     health_ttft_p95_slo_s: float = 0.0
     health_queue_depth_slo: float = 0.0
     health_kv_occupancy_slo: float = 0.0
+    health_kv_pages_free_slo: float = 0.0
     # control-plane credentials (security/auth.py): one cluster bearer
     # token shared by scheduler API, agent daemons, and state server;
     # TLS material for serving HTTPS / verifying peers
@@ -134,6 +135,9 @@ class SchedulerConfig:
             ),
             health_kv_occupancy_slo=float(
                 env.get("SERVE_KV_OCCUPANCY_SLO", "0")
+            ),
+            health_kv_pages_free_slo=float(
+                env.get("SERVE_KV_PAGES_FREE_SLO", "0")
             ),
             auth_token=_load_token(env),
             tls_ca_file=env.get("TLS_CA_FILE", ""),

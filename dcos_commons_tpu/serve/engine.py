@@ -1,11 +1,17 @@
-"""Slot-pool continuous-batching engine (model-agnostic half).
+"""Continuous-batching engines (model-agnostic half): the slot pool
+and the paged KV arena that replaced it as the serving default.
 
 The dispatch-per-group serve loop ran one whole ``generate`` per
 micro-batch: a request arriving one step after a dispatch started
 waited the FULL previous generation before its prefill even began,
-and every row padded out to the group's longest generation.  This
-engine replaces that loop with per-step scheduling over a persistent
-slot pool:
+and every row padded out to the group's longest generation.
+``SlotEngine`` replaces that loop with per-step scheduling over a
+persistent slot pool; ``PagedEngine`` (ISSUE 11) further replaces
+the pool's row carve with a page-budgeted arena — block-granular KV
+through per-request page tables, chunked prefill interleaved with
+decode, refcounted prefix caching (serve/paging.py) — while sharing
+this loop's admission/retirement/telemetry machinery and keeping
+greedy outputs token-identical.  The slot-pool shape:
 
 * the KV cache is allocated ONCE at ``SLOTS x max_len`` (static
   shapes — XLA never recompiles as occupancy changes);
@@ -102,6 +108,12 @@ class SlotEngine:
     lock; only host-side bookkeeping holds it.
     """
 
+    _row_cls = _Row
+    # gauges register_metrics exports (subclasses extend)
+    METRIC_KEYS = (
+        "queue_depth", "active_slots", "kv_occupancy", "tokens_per_s",
+    )
+
     def __init__(
         self,
         prefill_fn: Callable,
@@ -144,6 +156,7 @@ class SlotEngine:
         self._admitted = 0
         self._completed = 0
         self._timeouts = 0
+        self._timeouts_by_kind: dict = {}
         self._tokens_out = 0
         self._ttft: deque = deque(maxlen=_TTFT_WINDOW)
         self._rate: deque = deque()  # (monotonic, tokens) per tick
@@ -189,7 +202,7 @@ class SlotEngine:
                 )
         group = _Group([])
         group.rows = [
-            _Row(
+            self._row_cls(
                 [int(t) for t in row], max_new_tokens, float(temperature),
                 eos_id,
                 int.from_bytes(os.urandom(4), "little") % (2 ** 31),
@@ -210,7 +223,7 @@ class SlotEngine:
         while not group.done.wait(timeout=self._queue_timeout_s):
             with self._cv:
                 admitted = any(r.slot >= 0 for r in group.rows)
-                progress = sum(len(r.out) for r in group.rows)
+                progress = self._progress_locked(group)
                 if admitted and progress > last_progress:
                     last_progress = progress
                     continue
@@ -222,13 +235,14 @@ class SlotEngine:
                 self._queue = deque(
                     r for r in self._queue if r.group is not group
                 )
-                self._timeouts += 1
-                reason = (
-                    "request timed out waiting for a KV slot"
-                    if not admitted else
-                    f"no decode progress in {self._queue_timeout_s}s"
+                reason, kind = self._timeout_reason_locked(
+                    group, admitted
                 )
-            raise QueueTimeoutError(reason)
+                self._timeouts += 1
+                self._timeouts_by_kind[kind] = (
+                    self._timeouts_by_kind.get(kind, 0) + 1
+                )
+            raise QueueTimeoutError(reason, kind=kind)
         if group.error is not None:
             raise group.error
         return [list(r.out) for r in group.rows]
@@ -239,6 +253,21 @@ class SlotEngine:
             self._cv.notify_all()
         self._thread.join(timeout=10)
 
+    def _progress_locked(self, group: _Group) -> int:
+        """Monotone per-group progress measure for the timeout loop
+        (tokens produced; the paged engine adds prefilled positions —
+        a long prompt mid-chunked-prefill IS making progress)."""
+        return sum(len(r.out) for r in group.rows)
+
+    def _timeout_reason_locked(self, group, admitted: bool):
+        """(reason string, QueueTimeoutError kind) for a timed-out
+        group — the 503 body and the split timeout counters."""
+        if not admitted:
+            return "request timed out waiting for a KV slot", "kv-slot"
+        return (
+            f"no decode progress in {self._queue_timeout_s}s", "stalled"
+        )
+
     # -- telemetry ---------------------------------------------------
 
     def stats(self) -> dict:
@@ -246,13 +275,11 @@ class SlotEngine:
         names as the scale-out signal)."""
         now = time.monotonic()
         with self._cv:
-            live_tokens = int(sum(
-                int(self._pos[s])
-                for s, row in enumerate(self._rows) if row is not None
-            ))
+            live_tokens = self._live_tokens_locked()
             window = [n for (t, n) in self._rate
                       if t > now - _RATE_WINDOW_S]
             ttft = sorted(self._ttft)
+            kinds = self._timeouts_by_kind
             out = {
                 "slots": self._slots,
                 "max_len": self._max_len,
@@ -261,7 +288,7 @@ class SlotEngine:
                 "free_slots": len(self._free),
                 "kv_live_tokens": live_tokens,
                 "kv_occupancy": round(
-                    live_tokens / float(self._slots * self._max_len), 4
+                    live_tokens / float(self._kv_capacity()), 4
                 ),
                 "tokens_per_s": round(
                     sum(window) / _RATE_WINDOW_S, 2
@@ -269,8 +296,18 @@ class SlotEngine:
                 "requests_admitted": self._admitted,
                 "requests_completed": self._completed,
                 "requests_timed_out": self._timeouts,
+                # the saturation split (utils/microbatch.py kinds):
+                # memory = the paged arena's page budget never fit;
+                # compute = no decode row freed / admitted but stalled
+                "requests_timed_out_memory": kinds.get(
+                    "kv-page-budget", 0
+                ),
+                "requests_timed_out_compute": (
+                    kinds.get("kv-slot", 0) + kinds.get("stalled", 0)
+                ),
                 "tokens_out": self._tokens_out,
             }
+            out.update(self._stats_extra_locked())
         if ttft:
             from dcos_commons_tpu.metrics.registry import percentile
 
@@ -279,12 +316,25 @@ class SlotEngine:
         out["t"] = time.time()
         return out
 
+    def _live_tokens_locked(self) -> int:
+        return int(sum(
+            int(self._pos[s])
+            for s, row in enumerate(self._rows) if row is not None
+        ))
+
+    def _kv_capacity(self) -> int:
+        """KV positions the cache can hold (the occupancy basis)."""
+        return self._slots * self._max_len
+
+    def _stats_extra_locked(self) -> dict:
+        return {}
+
     def register_metrics(self, metrics, prefix: str = "serving") -> None:
         """Export the load gauges through a metrics registry
         (metrics/registry.py): queue depth, active slots, KV
-        occupancy, tokens/s — scraped as gauges / pushed via StatsD."""
-        for key in ("queue_depth", "active_slots", "kv_occupancy",
-                    "tokens_per_s"):
+        occupancy, tokens/s — scraped as gauges / pushed via StatsD
+        (the paged engine adds page-budget and prefix-cache gauges)."""
+        for key in self.METRIC_KEYS:
             metrics.gauge(
                 f"{prefix}.{key}",
                 lambda key=key: self.stats()[key],
@@ -302,8 +352,7 @@ class SlotEngine:
             flush_now = False
             admits: List[_Row] = []
             with self._cv:
-                while (not self._queue and self._active == 0
-                       and not self._stopped):
+                while not self._has_work_locked() and not self._stopped:
                     if not flushed_idle:
                         # flush the terminal snapshot before parking:
                         # an idle server's LAST burst must be visible
@@ -318,11 +367,11 @@ class SlotEngine:
                         self._cv.wait()
                     else:
                         self._cv.wait(timeout=self._idle_every_s)
-                        if not self._queue and self._active == 0:
+                        if not self._has_work_locked():
                             break  # fire on_idle OUTSIDE the lock
                 if self._stopped:
                     return
-                idle = not self._queue and self._active == 0
+                idle = not self._has_work_locked()
                 if not idle:
                     flushed_idle = False  # work resumed: re-arm
                     admits = self._pop_admits_locked()
@@ -333,9 +382,7 @@ class SlotEngine:
                 self._safe_idle()
                 continue
             try:
-                self._admit_all(admits)
-                if self._active:  # loop thread is the only writer
-                    self._decode_tick()
+                self._work_tick(admits)
                 self._write_stats()
             except Exception as e:  # noqa: BLE001 — fail FAST, not silent
                 # a bookkeeping bug (bad decode shape, broken stats
@@ -345,6 +392,16 @@ class SlotEngine:
                 # Fan the error out and keep the loop alive.
                 with self._cv:
                     self._fail_all_locked(e)
+
+    def _has_work_locked(self) -> bool:
+        return bool(self._queue) or self._active > 0
+
+    def _work_tick(self, admits: List[_Row]) -> None:
+        """One scheduling round (loop thread, OUTSIDE the cv): admit,
+        then advance every active row one decode step."""
+        self._admit_all(admits)
+        if self._active:  # loop thread is the only writer
+            self._decode_tick()
 
     def _pop_admits_locked(self) -> List[_Row]:
         """FIFO admission: oldest waiting rows take the free slots —
@@ -402,12 +459,23 @@ class SlotEngine:
         self._temps[slot] = row.temp
         self._seeds[slot] = row.seed
 
+    _MERGE_NOUN = "slot pool"
+
+    def _decode_prep_locked(self) -> tuple:
+        """Extra positional args for ``decode_fn`` (before
+        ``n_active``), prepared under the cv — the paged engine
+        allocates write pages and snapshots the page tables here."""
+        return ()
+
     def _decode_tick(self) -> None:
-        active = self._active
+        with self._cv:
+            extra = self._decode_prep_locked()
+            active = self._active
         try:
             nxt = np.asarray(self._decode_fn(
                 self._tok.copy(), self._pos.copy(),
-                self._temps.copy(), self._seeds.copy(), active,
+                self._temps.copy(), self._seeds.copy(),
+                *extra, active,
             ))
         except Exception as e:  # noqa: BLE001 — fan out, keep serving
             with self._cv:
@@ -425,7 +493,7 @@ class SlotEngine:
         if merged is not None and self._log is not None:
             self._log(
                 f"continuous-batch: {merged} rows sharing one decode "
-                "step over the slot pool"
+                f"step over the {self._MERGE_NOUN}"
             )
 
     def _apply_decode_locked(self, nxt: np.ndarray, now: float) -> None:
@@ -524,6 +592,334 @@ class SlotEngine:
             os.replace(tmp, self._stats_path)
         except OSError:
             pass  # sdklint: disable=swallowed-exception — telemetry must never take the server down
+
+
+class _PagedRow(_Row):
+    """A request riding a PAGE TABLE instead of a contiguous slot row
+    (serve/paging.py): ``table[v]`` is the physical arena page holding
+    virtual positions ``[v*P, (v+1)*P)``; 0 = unallocated."""
+
+    __slots__ = (
+        "table", "fill_pos", "admission", "private_pages",
+        "registered_to",
+    )
+
+    def __init__(self, tokens, n, temp, eos, seed, group):
+        super().__init__(tokens, n, temp, eos, seed, group)
+        self.table = None            # np.int32 [M], built at admission
+        self.fill_pos = 0            # next prompt position to prefill
+        self.admission = None        # paging.Admission while admitted
+        self.private_pages: List[int] = []
+        self.registered_to = 0       # next prompt page to publish
+
+
+class PagedEngine(SlotEngine):
+    """Continuous batching over a PAGED KV arena: block-granular
+    allocation, chunked prefill, and prefix caching (the ISSUE 11
+    tentpole; vLLM's PagedAttention + SGLang's RadixAttention shape).
+
+    Differences from the slot pool it replaces:
+
+    * **Admission is page-budgeted** (serve/paging.py): a request
+      enters the pool only when a free decode row exists AND its
+      worst-case page need fits ``available - reserved`` — admitted
+      work can never OOM mid-generation, and a short reply returns
+      its unused pages immediately instead of stranding a MAX_LEN
+      row.  FIFO stays strict: a budget-blocked head is never jumped
+      by a smaller later request.
+    * **Prefill is chunked**: prompts run ``chunk_tokens`` at a time
+      — one chunk per PREFILLING REQUEST per engine tick, interleaved
+      with decode — so a long prompt no longer blocks the tick it
+      rides and queued requests stop paying head-of-line TTFT.
+      Chunk progress counts as progress for the 503 timeout (a long
+      prefill is not a stall).
+    * **Prefix caching**: fully-prefilled prompt pages are published
+      read-only; an identical later prefix pins them instead of
+      recomputing (COW-by-recompute on mid-page divergence — shared
+      pages are never written; see serve/paging.py).
+
+    ``prefill_chunk_fn(padded [1, C] i32, slot=, table= [M] i32,
+    start=, true_len=, temp=, seed=) -> first token`` runs one chunk
+    (the return value is consumed only when the chunk completes the
+    prompt); ``decode_fn(tok [S], pos [S], temps [S], seeds [S],
+    tables [S, M] i32, n_active) -> next tokens [S]`` advances every
+    row through its page table.  Scalars by KEYWORD, as ever.
+    """
+
+    _row_cls = _PagedRow
+    METRIC_KEYS = SlotEngine.METRIC_KEYS + (
+        "kv_pages_free", "prefix_cache_hit_rate",
+        "prefill_chunk_backlog",
+    )
+
+    def __init__(
+        self,
+        prefill_chunk_fn: Callable,
+        decode_fn: Callable,
+        slots: int,
+        max_len: int,
+        prompt_len: int,
+        *,
+        page_tokens: int,
+        pages: int,
+        chunk_tokens: int,
+        prefix_cache: bool = True,
+        **kw,
+    ):
+        from dcos_commons_tpu.serve.paging import (
+            PageAllocator,
+            pages_for,
+        )
+
+        # subclass state FIRST: the base constructor starts the loop
+        # thread as its last act, and the loop reads these
+        self._page_tokens = int(page_tokens)
+        self._pages_per_row = pages_for(int(max_len), int(page_tokens))
+        self._chunk_tokens = int(chunk_tokens)
+        self._allocator = PageAllocator(
+            int(pages), int(page_tokens), prefix_cache
+        )
+        self._prefilling: deque = deque()
+        super().__init__(
+            prefill_chunk_fn, decode_fn, slots, max_len, prompt_len,
+            **kw,
+        )
+
+    # -- admission ---------------------------------------------------
+
+    def _has_work_locked(self) -> bool:
+        return super()._has_work_locked() or bool(self._prefilling)
+
+    def _pop_admits_locked(self) -> List[_Row]:
+        """FIFO admission under BOTH constraints — a free decode row
+        and the page budget.  Strictly in order: the first request
+        that does not fit blocks the queue (admitting a smaller later
+        one would starve large requests forever)."""
+        admits: List[_Row] = []
+        while self._queue and self._free:
+            row = self._queue[0]
+            if row.group.abandoned:
+                self._queue.popleft()
+                continue
+            admission = self._allocator.admit(row.tokens, row.n)
+            if admission is None:
+                break
+            self._queue.popleft()
+            row.slot = self._free.pop()
+            row.admission = admission
+            row.table = np.zeros(self._pages_per_row, np.int32)
+            for i, entry in enumerate(admission.matched):
+                row.table[i] = entry.page
+            # prefill resumes past the cache-served pages
+            row.fill_pos = len(admission.matched) * self._page_tokens
+            row.registered_to = len(admission.matched)
+            admits.append(row)
+        return admits
+
+    def _work_tick(self, admits: List[_Row]) -> None:
+        if admits:
+            with self._cv:
+                self._prefilling.extend(admits)
+        self._prefill_tick()
+        if self._active:
+            self._decode_tick()
+
+    # -- chunked prefill ---------------------------------------------
+
+    def _prefill_tick(self) -> None:
+        """Advance EVERY prefilling row by one chunk, FIFO order.
+
+        Per-ROW chunking is the head-of-line fix: a long prompt costs
+        several small dispatches interleaved with decode ticks instead
+        of one prompt-wide dispatch that blocks the pool — while a
+        BURST of short prompts still admits in one tick (each is one
+        cheap chunk; serializing them across decode ticks would tax
+        every short request one full decode per queue position).
+        Per-tick prefill work stays bounded by the slot count — the
+        same bound the slot pool's admit-all batch had, at chunk
+        width instead of full prompt width."""
+        with self._cv:
+            rows = list(self._prefilling)
+        for row in rows:
+            with self._cv:
+                if row.admission is None:
+                    continue  # already retired/failed this tick
+                if row.group.abandoned:
+                    # abandoned before its first token: free the
+                    # pages/slot now, nothing ever reached the client
+                    self._prefilling.remove(row)
+                    self._retire_locked(row)
+                    continue
+                plen = len(row.tokens)
+                start = row.fill_pos
+                clen = min(self._chunk_tokens, plen - start)
+                self._ensure_pages_locked(row, start, start + clen - 1)
+                table = row.table.copy()
+            padded = np.zeros((1, self._chunk_tokens), np.int32)
+            padded[0, :clen] = row.tokens[start:start + clen]
+            first = self._prefill_fn(
+                padded, slot=row.slot, table=table, start=start,
+                true_len=clen, temp=row.temp, seed=row.seed,
+            )
+            now = time.monotonic()
+            with self._cv:
+                row.fill_pos = start + clen
+                self._register_pages_locked(row)
+                if row.fill_pos >= plen:
+                    self._prefilling.remove(row)
+                    if row.group.abandoned:
+                        self._retire_locked(row)
+                    else:
+                        self._apply_admit_locked(row, int(first), now)
+
+    def _ensure_pages_locked(self, row, first_pos: int,
+                             last_pos: int) -> None:
+        """Allocate the pages covering positions [first_pos,
+        last_pos] — drawn from the row's admission reservation, so
+        this cannot fail for an admitted row."""
+        for v in range(first_pos // self._page_tokens,
+                       last_pos // self._page_tokens + 1):
+            if row.table[v] == 0:
+                page = self._allocator.alloc(row.admission)
+                row.table[v] = page
+                row.private_pages.append(page)
+
+    def _register_pages_locked(self, row) -> None:
+        """Publish every newly-completed FULL prompt page into the
+        prefix cache.  The last (partial) prompt page stays private —
+        decode keeps writing into it, and shared pages are read-only
+        by contract."""
+        p = self._page_tokens
+        while ((row.registered_to + 1) * p <= row.fill_pos
+               and (row.registered_to + 1) * p <= len(row.tokens)):
+            v = row.registered_to
+            page = int(row.table[v])
+            toks = tuple(row.tokens[v * p:(v + 1) * p])
+            if self._allocator.register(row.admission, toks, page):
+                row.private_pages.remove(page)
+            row.registered_to += 1
+
+    # -- decode ------------------------------------------------------
+
+    _MERGE_NOUN = "paged arena"
+
+    def _decode_prep_locked(self) -> tuple:
+        """Allocate this tick's write pages and snapshot every row's
+        page table for the decode dispatch."""
+        for slot, row in enumerate(self._rows):
+            if row is None or row.group.abandoned:
+                # an abandoned row retires at apply; its write this
+                # tick lands in the trash page (table may miss the
+                # next page — masked, discarded)
+                continue
+            pos = int(self._pos[slot])
+            self._ensure_pages_locked(row, pos, pos)
+        tables = np.zeros(
+            (self._slots, self._pages_per_row), np.int32
+        )
+        for slot, row in enumerate(self._rows):
+            if row is not None:
+                tables[slot] = row.table
+        return (tables,)
+
+    # -- retirement / failure ----------------------------------------
+
+    def _retire_locked(self, row) -> None:
+        super()._retire_locked(row)
+        if row.admission is not None:
+            self._allocator.retire(row.admission, row.private_pages)
+            row.admission = None
+            row.private_pages = []
+            row.table = None
+
+    def _fail_all_locked(self, error, extra_groups=()) -> None:
+        extra = set(extra_groups)
+        extra |= {r.group for r in self._prefilling}
+        for row in self._prefilling:
+            self._free.append(row.slot)
+            row.slot = -1
+        self._prefilling.clear()
+        super()._fail_all_locked(error, extra_groups=extra)
+        # every admission died with its group: rebuild the arena
+        # bookkeeping (the prefix cache's pages may hold K/V written
+        # before the failure — integrity unknown, so drop them too)
+        self._allocator.reset()
+
+    # -- timeout basis / telemetry -----------------------------------
+
+    def _progress_locked(self, group) -> int:
+        # chunk progress counts: a long prompt mid-prefill must not
+        # be cut off as "stalled" just because no token landed yet
+        return super()._progress_locked(group) + sum(
+            r.fill_pos for r in group.rows
+        )
+
+    def _timeout_reason_locked(self, group, admitted: bool):
+        if not admitted:
+            alloc = self._allocator
+            budget_reason = (
+                "request timed out waiting for the KV page budget "
+                f"({alloc.free_pages} pages free of "
+                f"{alloc.pages_total}, {alloc.reserved_pages} "
+                "reserved)",
+                "kv-page-budget",
+            )
+            own = next(
+                (r for r in group.rows if r.slot < 0), group.rows[0]
+            )
+            if not alloc.would_admit(own.tokens, own.n):
+                return budget_reason
+            if not self._free:
+                return (
+                    "request timed out waiting for a KV slot",
+                    "kv-slot",
+                )
+            # our own rows fit and decode rows are free, so the
+            # starvation came from strict FIFO behind a blocked HEAD
+            # (our rows left the queue before this ran): classify by
+            # what blocks the head — a small request stuck behind a
+            # big budget-blocked one is memory saturation too
+            head = self._queue[0] if self._queue else None
+            if head is not None and not alloc.would_admit(
+                    head.tokens, head.n):
+                return budget_reason
+            return (
+                "request timed out waiting for a KV slot", "kv-slot"
+            )
+        return super()._timeout_reason_locked(group, admitted)
+
+    def _live_tokens_locked(self) -> int:
+        return super()._live_tokens_locked() + sum(
+            r.fill_pos for r in self._prefilling
+        )
+
+    def _kv_capacity(self) -> int:
+        return self._allocator.pages_total * self._page_tokens
+
+    def _stats_extra_locked(self) -> dict:
+        out = self._allocator.stats()
+        # PHYSICAL occupancy (overrides the base virtual-positions
+        # gauge): shared prefix pages count once, not once per
+        # pinning row — under heavy sharing the virtual sum can
+        # exceed the arena and would falsely breach kv_occupancy_slo
+        # while headroom exists.  Occupied = pages neither free nor
+        # reclaimable-by-admission.
+        alloc = self._allocator
+        out["kv_occupancy"] = round(
+            (alloc.pages_total - alloc.free_pages
+             - alloc.reclaimable_pages) / float(alloc.pages_total),
+            4,
+        )
+        out["kv_page_tokens"] = self._page_tokens
+        out["prefill_chunk_tokens"] = self._chunk_tokens
+        # prompt tokens not yet prefilled (queued + mid-chunk): the
+        # chunked-prefill pressure signal — sustained growth means
+        # prefill demand outruns the chunk-per-tick budget
+        out["prefill_chunk_backlog"] = int(
+            sum(len(r.tokens) - r.fill_pos for r in self._prefilling)
+            + sum(len(r.tokens) for r in self._queue)
+        )
+        return out
 
 
 def read_servestats(path: str) -> dict:

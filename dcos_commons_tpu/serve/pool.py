@@ -160,3 +160,162 @@ class PoolModel:
             np.zeros(self.slots, np.int32),
         )
         self._jax.block_until_ready(out)
+
+
+class PagedPoolModel:
+    """Device half of the PAGED engine: the jitted prefill-chunk /
+    decode-step pair over a persistent page arena (models/decode.py
+    ``init_paged_kv_cache`` / ``paged_prefill_chunk`` /
+    ``paged_decode_step``).
+
+    The two-compiles-per-lifetime property carries over from the slot
+    pool: ONE prefill-chunk program (chunk width ``chunk_tokens``
+    static; start position, true length, page table, temperature and
+    seed all traced — a request resuming after a prefix-cache hit is
+    the same program as one starting cold) and ONE decode program
+    (per-row positions/temps/seeds/page tables traced) cover every
+    request the server ever admits.  The arena holds ``pages`` usable
+    pages plus the TRASH page (physical page 0): padding and
+    inactive-row writes land there, so ``warm()`` — which runs both
+    programs over all-zero tables — never dirties a real page.
+
+    Not thread-safe by itself (the engine loop or a gang rank's tick
+    executor is the single caller); the gang driver reuses it via the
+    same ``put``/``constrain_out``/``cache_sharding`` riders as
+    ``PoolModel`` — kv heads sit on dim 3 of the arena, exactly where
+    the slot pool carried the tp axis.
+    """
+
+    def __init__(
+        self,
+        config,
+        params,
+        slots: int,
+        max_len: int,
+        page_tokens: int,
+        pages: int,
+        chunk_tokens: int,
+        kv_dtype: str = "native",
+        cache_sharding: Optional[Any] = None,
+        put: Optional[Callable] = None,
+        constrain_out: Optional[Callable] = None,
+    ):
+        import jax
+        import jax.numpy as jnp
+
+        from dcos_commons_tpu.models.decode import (
+            init_paged_kv_cache,
+            paged_decode_step,
+            paged_prefill_chunk,
+            sample_token,
+        )
+        from dcos_commons_tpu.serve.paging import pages_for
+
+        self._jax = jax
+        self.config = config
+        self.params = params
+        self.slots = slots
+        self.max_len = max_len
+        self.page_tokens = page_tokens
+        self.pages = pages
+        self.chunk_tokens = chunk_tokens
+        self.pages_per_row = pages_for(max_len, page_tokens)
+        self._put = put if put is not None else (lambda x: x)
+        con = constrain_out if constrain_out is not None else (lambda x: x)
+
+        init = functools.partial(
+            init_paged_kv_cache, config, pages + 1, page_tokens,
+            kv_dtype,
+        )
+        if cache_sharding is not None:
+            self.cache = jax.jit(init, out_shardings=cache_sharding)()
+        else:
+            self.cache = jax.jit(init)()
+
+        def _prefill(params, cache, tokens, table, start, true_len,
+                     temp, seed):
+            logits, cache = paged_prefill_chunk(
+                config, params, cache, tokens, table, start, true_len
+            )
+            # the fold matches the slot pool's: the chunk's last real
+            # position is start + true_len - 1 == prompt_len - 1 on
+            # the final chunk — same key, same sampled token
+            key = jax.random.fold_in(
+                jax.random.key(seed), start + true_len - 1
+            )
+            return con(sample_token(logits[0], temp, key)), cache
+
+        def _decode(params, cache, tok, pos, temps, seeds, tables):
+            logits, cache = paged_decode_step(
+                config, params, cache, tok, pos, tables
+            )
+
+            def pick_row(lg, temp, seed, p):
+                key = jax.random.fold_in(jax.random.key(seed), p)
+                return sample_token(lg, temp, key)
+
+            nxt = jax.vmap(pick_row)(logits, temps, seeds, pos)
+            return con(nxt), cache
+
+        donate = {}
+        if jax.default_backend() != "cpu":
+            donate = {"donate_argnums": (1,)}
+        self._prefill_c = jax.jit(_prefill, **donate)
+        self._decode_c = jax.jit(_decode, **donate)
+        self._jnp = jnp
+
+    def prefill_chunk(
+        self, tokens: np.ndarray, slot: int, table: np.ndarray,
+        start: int, true_len: int, temp: float, seed: int,
+    ) -> int:
+        """Run one [1, chunk_tokens] prompt chunk at virtual positions
+        [start, start + true_len) through ``table``; returns the
+        sampled token at the chunk's last real position (meaningful
+        only on the prompt's final chunk).  ``slot`` is the engine's
+        row id — a protocol rider (the gang driver broadcasts it), the
+        math needs only the table."""
+        del slot
+        first, self.cache = self._prefill_c(
+            self.params, self.cache,
+            self._put(np.asarray(tokens, np.int32)),
+            self._put(np.asarray(table, np.int32)),
+            np.int32(start), np.int32(true_len),
+            np.float32(temp), np.int32(seed),
+        )
+        return int(self._jax.device_get(first))
+
+    def decode(
+        self, tok: np.ndarray, pos: np.ndarray,
+        temps: np.ndarray, seeds: np.ndarray,
+        tables: np.ndarray, n_active: Optional[int] = None,
+    ) -> np.ndarray:
+        """One decode step over the whole pool through per-row page
+        tables; ONE bulk device fetch, same as the slot pool."""
+        nxt, self.cache = self._decode_c(
+            self.params, self.cache,
+            self._put(np.asarray(tok, np.int32)),
+            self._put(np.asarray(pos, np.int32)),
+            self._put(np.asarray(temps, np.float32)),
+            self._put(np.asarray(seeds, np.int32)),
+            self._put(np.asarray(tables, np.int32)),
+        )
+        return np.asarray(self._jax.device_get(nxt))
+
+    def warm(self) -> None:
+        """Compile + execute both entry points before readiness.  All
+        tables are zero, so every write lands in the trash page and
+        every gather is masked — warmup leaves no residue a real
+        request could attend to."""
+        self.prefill_chunk(
+            np.zeros((1, self.chunk_tokens), np.int32), slot=0,
+            table=np.zeros(self.pages_per_row, np.int32),
+            start=0, true_len=self.chunk_tokens, temp=0.0, seed=0,
+        )
+        out = self.decode(
+            np.zeros(self.slots, np.int32),
+            np.zeros(self.slots, np.int32),
+            np.zeros(self.slots, np.float32),
+            np.zeros(self.slots, np.int32),
+            np.zeros((self.slots, self.pages_per_row), np.int32),
+        )
+        self._jax.block_until_ready(out)

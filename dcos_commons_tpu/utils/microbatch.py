@@ -43,10 +43,26 @@ import numpy as np
 
 class QueueTimeoutError(RuntimeError):
     """A request expired waiting for chip capacity (the batcher's
-    queue timeout, or the slot-pool engine's admission queue).  This
+    queue timeout, or the serving engine's admission queue).  This
     is server SATURATION, not caller error: HTTP handlers map it to
     503 so load generators and clients can tell overload apart from a
-    400 bad request."""
+    400 bad request.
+
+    ``kind`` names the starved resource so operators can tell
+    saturation-by-memory from saturation-by-compute in the 503 body
+    and the split timeout counters (serve/engine.py stats):
+
+    * ``kv-page-budget`` — the request's worst-case KV page need
+      never fit the paged arena's budget (memory saturation: add
+      pages/HBM, shrink MAX_LEN, or rely on prefix caching);
+    * ``kv-slot`` — no decode row freed up (concurrency saturation);
+    * ``stalled`` — admitted but the pool produced no new token for a
+      full window (compute saturation or a wedged device).
+    """
+
+    def __init__(self, message: str = "", kind: str = "kv-slot"):
+        super().__init__(message)
+        self.kind = kind
 
 
 class WorkItem:

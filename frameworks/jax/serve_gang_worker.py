@@ -10,8 +10,10 @@ only at the VIP'd rank — so rank 0 drives the gang with PER-TICK
 broadcast ops and every rank executes the identical payload:
 
     NOOP    keep the gang meeting in a collective while idle
-    ADMIT   prefill ONE waiting request into a free pool slot
-    DECODE  advance EVERY pool row one step (per-row pos/temp/seed)
+    ADMIT   prefill ONE request (paged: ONE CHUNK of one request's
+            prompt, through its page table) into the pool
+    DECODE  advance EVERY pool row one step (per-row pos/temp/seed;
+            paged: through per-row page tables)
 
 Requests therefore join and leave MID-FLIGHT: a request arriving
 while others decode is admitted at the next tick (TTFT = one tick +
@@ -20,6 +22,17 @@ its EOS/max-token retires its slot immediately while the rest keep
 stepping.  The driver/follower shape is unchanged from the
 dispatch-per-group protocol this replaces (spmdcheck-clean: followers
 just execute the broadcast payload), only the op vocabulary grew.
+
+PAGED KV (ISSUE 11, the default — KV_PAGE_TOKENS=0 selects the
+legacy slot pool): the broadcast payload grows the chunk/page
+fields — ADMIT carries a PREFILL_CHUNK_TOKENS-wide prompt chunk, its
+traced start position/true length, and the request's page table;
+DECODE carries every row's page table alongside its (token, position,
+temp, seed) state.  Page allocation, budgeting and the prefix cache
+are rank 0's HOST-side bookkeeping (serve/paging.py): followers only
+ever see physical page ids in the broadcast tables, so every rank
+still executes the identical tick and the collective schedules never
+diverge.
 
 Failover comes from GANG recovery, not from this file: kill any host
 and the scheduler replaces the whole gang (tests/test_gang_serve.py
@@ -43,7 +56,12 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 sys.path.insert(0, os.environ.get("REPO_ROOT", "/root/repo"))
 
-from dcos_commons_tpu.serve import SERVESTATS_NAME, SlotEngine  # noqa: E402
+from dcos_commons_tpu.serve import (  # noqa: E402
+    SERVESTATS_NAME,
+    PagedEngine,
+    SlotEngine,
+    paged_config_from_env,
+)
 from dcos_commons_tpu.trace.steplog import StepLog  # noqa: E402
 from dcos_commons_tpu.utils.microbatch import QueueTimeoutError  # noqa: E402
 
@@ -110,6 +128,63 @@ def _execute_tick(pool, head, rows, prompt):
     return None
 
 
+# -- paged protocol (ISSUE 11) ----------------------------------------
+# the legacy payload grew chunk/page fields: ADMIT is now one prompt
+# CHUNK through the request's page table, DECODE rides every row's
+# table.  Same flat-byte-shape discipline: every tick broadcasts the
+# same tuple of arrays regardless of op.
+
+
+def _zero_paged_payload(slots, pages_per_row, chunk_tokens):
+    return (
+        # head by op: ADMIT = [op, slot, start, true_len, seed,
+        # temp_micro]; DECODE = [op, n_active, 0, 0, 0, 0]; NOOP = 0s
+        np.zeros(6, np.int64),
+        np.zeros((slots, 4), np.int64),   # rows [tok, pos, temp_u, seed]
+        np.zeros((slots, pages_per_row), np.int64),  # page tables
+        np.zeros((1, chunk_tokens), np.int32),       # ADMIT chunk
+    )
+
+
+def _broadcast_paged_tick(multihost_utils, payload, slots,
+                          pages_per_row, chunk_tokens):
+    """One gang-wide broadcast of the paged payload: rank 0 passes
+    (head, rows, tables, chunk), followers pass None and receive rank
+    0's.  Flat cost per tick: the byte shape never depends on op."""
+    if payload is None:
+        payload = _zero_paged_payload(slots, pages_per_row, chunk_tokens)
+    head, rows, tables, chunk = multihost_utils.broadcast_one_to_all(
+        payload
+    )
+    return (
+        np.asarray(head), np.asarray(rows), np.asarray(tables),
+        np.asarray(chunk),
+    )
+
+
+def _execute_paged_tick(pool, head, rows, tables, chunk):
+    """Execute the broadcast paged op on EVERY rank (rank 0 included)
+    — page ids arrive as data, so the traced operands are
+    byte-identical across the gang."""
+    op = int(head[0])
+    if op == OP_ADMIT:
+        slot = int(head[1])
+        return pool.prefill_chunk(
+            chunk, slot=slot, table=tables[slot].astype(np.int32),
+            start=int(head[2]), true_len=int(head[3]),
+            temp=int(head[5]) / 1e6, seed=int(head[4]),
+        )
+    if op == OP_DECODE:
+        return pool.decode(
+            rows[:, 0].astype(np.int32),
+            rows[:, 1].astype(np.int32),
+            (rows[:, 2] / 1e6).astype(np.float32),
+            rows[:, 3].astype(np.int32),
+            tables.astype(np.int32),
+        )
+    return None
+
+
 def main() -> int:
     from dcos_commons_tpu.parallel.distributed import initialize_from_env
 
@@ -128,7 +203,7 @@ def main() -> int:
     from dcos_commons_tpu.models import config_from_env, init_params
     from dcos_commons_tpu.models.transformer import param_shardings
     from dcos_commons_tpu.parallel.mesh import MeshSpec, make_mesh
-    from dcos_commons_tpu.serve.pool import PoolModel
+    from dcos_commons_tpu.serve.pool import PagedPoolModel, PoolModel
     from dcos_commons_tpu.utils import (
         enable_compilation_cache,
         restore_checkpoint,
@@ -193,25 +268,44 @@ def main() -> int:
         kv_dtype = os.environ.get("KV_DTYPE", "native")
         # the pool's KV heads ride the tp axis like the attention
         # weights when they divide it; otherwise the cache replicates
-        # (tiny-head test configs on wide meshes)
+        # (tiny-head test configs on wide meshes).  The paged arena
+        # keeps kv heads on dim 3 — (layers, pages, page_tokens, kv,
+        # hd) — so the SAME spec lays both pools
         kv_spec = (
             P(None, None, None, "tp", None)
             if config.n_kv_heads % n_devices == 0 else P()
         )
-        pool = PoolModel(
-            config, params, slots, max_len, kv_dtype=kv_dtype,
-            cache_sharding=NamedSharding(mesh, kv_spec),
-            put=to_global,
-            constrain_out=lambda x: jax.lax.with_sharding_constraint(
-                x, replicated
-            ),
-        )
+        paged = paged_config_from_env(os.environ)
+        if paged is not None:
+            pool = PagedPoolModel(
+                config, params, slots, max_len, paged.page_tokens,
+                paged.pages, paged.chunk_tokens, kv_dtype=kv_dtype,
+                cache_sharding=NamedSharding(mesh, kv_spec),
+                put=to_global,
+                constrain_out=lambda x: (
+                    jax.lax.with_sharding_constraint(x, replicated)
+                ),
+            )
+        else:
+            pool = PoolModel(
+                config, params, slots, max_len, kv_dtype=kv_dtype,
+                cache_sharding=NamedSharding(mesh, kv_spec),
+                put=to_global,
+                constrain_out=lambda x: (
+                    jax.lax.with_sharding_constraint(x, replicated)
+                ),
+            )
 
         # warm the compiled pool as a GANG before readiness: the first
         # request must not pay the compiles, and a rank that cannot
         # compile must fail deploy, not the first client.  Every rank
         # reaches this call at the same program point (pre-loop).
-        pool.warm(prompt_len)
+        if paged is not None:
+            pool.warm()
+        else:
+            pool.warm(prompt_len)
+        pages_per_row = paged.pages_per_row if paged is not None else 0
+        chunk_tokens = paged.chunk_tokens if paged is not None else 0
 
         # per-tick step telemetry ($SANDBOX/steplog.jsonl): sampled
         # decode ticks on every rank — wall seconds, active rows, and
@@ -249,6 +343,20 @@ def main() -> int:
             with open("ready", "w") as f:
                 f.write("warm\n")
             print(f"rank {rank}: following gang broadcasts", flush=True)
+            if paged is not None:
+                while True:
+                    b0 = _time.time()
+                    head, rows, tables, chunk = _broadcast_paged_tick(
+                        multihost_utils, None, slots, pages_per_row,
+                        chunk_tokens,
+                    )
+                    blocked_s = _time.time() - b0
+                    t0 = _time.time()
+                    _execute_paged_tick(pool, head, rows, tables, chunk)
+                    if int(head[0]) == OP_DECODE:
+                        _log_tick(
+                            _time.time() - t0, blocked_s, int(head[1])
+                        )
             while True:
                 b0 = _time.time()
                 head, rows, prompt = _broadcast_tick(
@@ -304,19 +412,85 @@ def main() -> int:
         def idle_tick():
             _broadcast_tick(multihost_utils, None, slots, prompt_len)
 
+        # -- paged protocol callbacks (ISSUE 11): same shape, the
+        # payload carries chunk/page fields and every rank executes
+        # the identical _execute_paged_tick
+        def paged_prefill_fn(padded, slot, table, start, true_len,
+                             temp, seed):
+            head = np.asarray(
+                [OP_ADMIT, slot, start, true_len, seed,
+                 round(temp * 1e6)],
+                np.int64,
+            )
+            _, zero_rows, zero_tables, _ = _zero_paged_payload(
+                slots, pages_per_row, chunk_tokens
+            )
+            zero_tables[slot] = table
+            out = _broadcast_paged_tick(
+                multihost_utils,
+                (head, zero_rows, zero_tables,
+                 padded.astype(np.int32)),
+                slots, pages_per_row, chunk_tokens,
+            )
+            return _execute_paged_tick(pool, *out)
+
+        def paged_decode_fn(tok, pos, temps, seeds, tables, n_active):
+            head = np.asarray(
+                [OP_DECODE, n_active, 0, 0, 0, 0], np.int64
+            )
+            rows = np.stack([
+                tok.astype(np.int64),
+                pos.astype(np.int64),
+                np.round(
+                    temps.astype(np.float64) * 1e6
+                ).astype(np.int64),
+                seeds.astype(np.int64),
+            ], axis=1)
+            zero_chunk = np.zeros((1, chunk_tokens), np.int32)
+            bcast = _broadcast_paged_tick(
+                multihost_utils,
+                (head, rows, tables.astype(np.int64), zero_chunk),
+                slots, pages_per_row, chunk_tokens,
+            )
+            t0 = _time.time()
+            out = _execute_paged_tick(pool, *bcast)
+            # rank 0 paces the gang; it never waits in the broadcast
+            _log_tick(_time.time() - t0, 0.0, n_active)
+            return out
+
+        def paged_idle_tick():
+            _broadcast_paged_tick(
+                multihost_utils, None, slots, pages_per_row,
+                chunk_tokens,
+            )
+
         queue_timeout_s = float(
             os.environ.get("SERVE_QUEUE_TIMEOUT_S", "600")
         )
         metrics = Metrics()
-        engine = SlotEngine(
-            prefill_fn, decode_fn, slots, max_len, prompt_len,
-            queue_timeout_s=queue_timeout_s,
-            on_idle=idle_tick, idle_every_s=IDLE_TICK_S,
-            stats_path=os.path.join(
-                os.environ.get("SANDBOX", "."), SERVESTATS_NAME
-            ),
-            log=lambda msg: print(msg, flush=True),
+        stats_path = os.path.join(
+            os.environ.get("SANDBOX", "."), SERVESTATS_NAME
         )
+        if paged is not None:
+            engine = PagedEngine(
+                paged_prefill_fn, paged_decode_fn, slots, max_len,
+                prompt_len,
+                page_tokens=paged.page_tokens, pages=paged.pages,
+                chunk_tokens=paged.chunk_tokens,
+                prefix_cache=paged.prefix_cache,
+                queue_timeout_s=queue_timeout_s,
+                on_idle=paged_idle_tick, idle_every_s=IDLE_TICK_S,
+                stats_path=stats_path,
+                log=lambda msg: print(msg, flush=True),
+            )
+        else:
+            engine = SlotEngine(
+                prefill_fn, decode_fn, slots, max_len, prompt_len,
+                queue_timeout_s=queue_timeout_s,
+                on_idle=idle_tick, idle_every_s=IDLE_TICK_S,
+                stats_path=stats_path,
+                log=lambda msg: print(msg, flush=True),
+            )
         engine.register_metrics(metrics)
 
         class Handler(BaseHTTPRequestHandler):
@@ -401,9 +575,14 @@ def main() -> int:
         server = ThreadingHTTPServer(("0.0.0.0", port), Handler)
         with open("ready", "w") as f:
             f.write("warm\n")
+        shape = (
+            f"{paged.pages}-page arena (pages of {paged.page_tokens}, "
+            f"{slots} rows, chunk {paged.chunk_tokens})"
+            if paged is not None else f"{slots}-slot pool"
+        )
         print(
-            f"rank 0: serving sharded generate over a {slots}-slot "
-            f"pool (prompts<={prompt_len}->{new_tokens}) tp={n_devices} "
+            f"rank 0: serving sharded generate over a {shape} "
+            f"(prompts<={prompt_len}->{new_tokens}) tp={n_devices} "
             f"on {server.server_address[1]}",
             flush=True,
         )

@@ -16,17 +16,22 @@ Errors:   400 = caller error (bad prompt/params); 503 = server
           saturation (the request timed out waiting for a KV slot) —
           load generators must be able to tell these apart.
 
-Concurrency: CONTINUOUS BATCHING over a persistent slot-pool KV cache
-(dcos_commons_tpu/serve/): the cache is allocated once at
-SERVE_SLOTS x MAX_LEN, waiting requests are admitted into free slots
-at EVERY decode step, and finished rows (per-row EOS / max-token)
-retire their slots immediately — no request waits for a whole
-preceding generation (time-to-first-token is one decode tick + its
-own prefill) and no row pads out to the longest generation in its
-batch.  Mixed prompt lengths, mixed requested lengths AND mixed
-temperatures all share one pool dispatch (per-row positions, temps
-and PRNG seeds are traced).  GET /stats exposes the serving gauges
-(queue depth, active slots, KV occupancy, tokens/s); the same
+Concurrency: CONTINUOUS BATCHING over a persistent PAGED KV arena
+(dcos_commons_tpu/serve/, ISSUE 11): KV memory is a fixed budget of
+KV_PAGE_TOKENS-sized pages with per-request page tables — a short
+reply holds exactly the pages its tokens need instead of stranding a
+MAX_LEN row, admission is page-budgeted (a request enters only when
+its worst-case page need fits, and the 503 body says whether memory
+or compute saturated), prompts prefill PREFILL_CHUNK_TOKENS at a time
+interleaved with decode ticks (a long prompt no longer blocks the
+tick it rides), and fully-prefilled prompt pages are shared read-only
+across requests with the same prefix (prefix caching — the system-
+prompt multiplier).  KV_PAGE_TOKENS=0 falls back to the PR 6 slot
+pool (SERVE_SLOTS x MAX_LEN rows).  Mixed prompt lengths, requested
+lengths AND temperatures still share one pool dispatch, and greedy
+outputs are token-identical on both paths.  GET /stats exposes the
+serving gauges (queue depth, KV occupancy, kv_pages_free,
+prefix_cache_hit_rate, prefill_chunk_backlog, tokens/s); the same
 snapshot lands in the sandbox for the scheduler's /v1/debug/serving.
 """
 
@@ -39,7 +44,12 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 sys.path.insert(0, os.environ.get("REPO_ROOT", "/root/repo"))
 
-from dcos_commons_tpu.serve import SERVESTATS_NAME, SlotEngine  # noqa: E402
+from dcos_commons_tpu.serve import (  # noqa: E402
+    SERVESTATS_NAME,
+    PagedEngine,
+    SlotEngine,
+    paged_config_from_env,
+)
 from dcos_commons_tpu.utils.microbatch import (  # noqa: E402
     MicroBatcher,
     QueueTimeoutError,
@@ -61,7 +71,7 @@ def main() -> int:
 
     from dcos_commons_tpu.metrics.registry import Metrics
     from dcos_commons_tpu.models import config_from_env, init_params
-    from dcos_commons_tpu.serve.pool import PoolModel
+    from dcos_commons_tpu.serve.pool import PagedPoolModel, PoolModel
     from dcos_commons_tpu.utils import (
         enable_compilation_cache,
         restore_checkpoint,
@@ -102,26 +112,48 @@ def main() -> int:
         params = jax.device_put(quantize_params_int8(params))
         print("weights quantized to int8 (per-channel)", flush=True)
 
-    # TWO compiles cover every request: prefill-into-slot (prompts
-    # RIGHT-padded, true length / slot / temperature / seed traced)
-    # and one decode step over the whole pool (per-row positions,
-    # temps, seeds traced) — novel requests never recompile.
-    # KV_DTYPE=int8 halves the pool bytes per decode step: the lever
-    # for many resident slots on a full chip (models/decode.py)
+    # TWO compiles cover every request on EITHER path: the paged
+    # arena's prefill-chunk + decode-step (page tables, start
+    # positions, true lengths, temps, seeds all traced) or the legacy
+    # slot pool's prefill-into-slot + decode-step — novel requests
+    # never recompile.  KV_DTYPE=int8 halves the cache bytes per
+    # decode step: the lever for many resident requests on a full
+    # chip (models/decode.py)
     prompt_len = max_len - new_tokens
     kv_dtype = os.environ.get("KV_DTYPE", "native")
-    pool = PoolModel(config, params, slots, max_len, kv_dtype=kv_dtype)
-
     queue_timeout_s = float(os.environ.get("SERVE_QUEUE_TIMEOUT_S", "600"))
     metrics = Metrics()
-    engine = SlotEngine(
-        pool.prefill, pool.decode, slots, max_len, prompt_len,
-        queue_timeout_s=queue_timeout_s,
-        stats_path=os.path.join(
-            os.environ.get("SANDBOX", "."), SERVESTATS_NAME
-        ),
-        log=lambda msg: print(msg, flush=True),
+    stats_path = os.path.join(
+        os.environ.get("SANDBOX", "."), SERVESTATS_NAME
     )
+    paged = paged_config_from_env(os.environ)
+    if paged is not None:
+        # the paged arena (ISSUE 11): page-budgeted admission,
+        # chunked prefill, prefix caching — the serving default
+        pool = PagedPoolModel(
+            config, params, slots, max_len, paged.page_tokens,
+            paged.pages, paged.chunk_tokens, kv_dtype=kv_dtype,
+        )
+        engine = PagedEngine(
+            pool.prefill_chunk, pool.decode, slots, max_len,
+            prompt_len,
+            page_tokens=paged.page_tokens, pages=paged.pages,
+            chunk_tokens=paged.chunk_tokens,
+            prefix_cache=paged.prefix_cache,
+            queue_timeout_s=queue_timeout_s, stats_path=stats_path,
+            log=lambda msg: print(msg, flush=True),
+        )
+    else:
+        # KV_PAGE_TOKENS=0: the PR 6 slot pool, kept as the
+        # operator's escape hatch and the bench baseline
+        pool = PoolModel(
+            config, params, slots, max_len, kv_dtype=kv_dtype
+        )
+        engine = SlotEngine(
+            pool.prefill, pool.decode, slots, max_len, prompt_len,
+            queue_timeout_s=queue_timeout_s, stats_path=stats_path,
+            log=lambda msg: print(msg, flush=True),
+        )
     engine.register_metrics(metrics)
 
     class Handler(BaseHTTPRequestHandler):
@@ -216,11 +248,20 @@ def main() -> int:
     # bind failure (port collision) must fail readiness, not pass it
     port = int(os.environ.get("PORT_HTTP", "0"))
     server = ThreadingHTTPServer(("0.0.0.0", port), Handler)
-    pool.warm(prompt_len)
+    if paged is not None:
+        pool.warm()
+        shape = (
+            f"paged KV: {paged.pages} pages x {paged.page_tokens} "
+            f"tokens, {slots} rows, chunk {paged.chunk_tokens}, "
+            f"prefix cache {'on' if paged.prefix_cache else 'off'}"
+        )
+    else:
+        pool.warm(prompt_len)
+        shape = f"slot pool: {slots} slots x {max_len}"
     with open("ready", "w") as f:
         f.write("warm\n")
     print(
-        f"warm: continuous batching {slots} slots x {max_len} "
+        f"warm: continuous batching ({shape}) "
         f"(prompts<={prompt_len}, <={new_tokens} new) on "
         f"{server.server_address[1]}",
         flush=True,

@@ -1,0 +1,892 @@
+"""Paged KV serving (ISSUE 11): allocator/prefix-cache soundness and
+the paged engine's greedy equivalence to the slot pool.
+
+Three layers of coverage:
+
+* ALLOCATOR properties (no jax): page conservation, no double-free,
+  reservation soundness (``reserved <= available`` so an admitted
+  request can never OOM mid-generation), refcounted prefix entries
+  freed only at refcount zero, leaf-first LRU eviction — held across
+  randomized admit/alloc/register/retire/abandon sequences by a
+  hypothesis sweep calling ``check_invariants`` after every op.
+
+* ENGINE properties against a deterministic fake model: chunked
+  prefill reproduces the oracle chain for any prompt length / chunk
+  width, page-budget exhaustion queues (FIFO) and completes, the
+  budget-starved 503 carries the distinct kv-page-budget reason and
+  lands in the requests_timed_out_memory split, and — the
+  copy-on-write contract — no physical page is ever written after it
+  was published into the prefix cache.
+
+* REAL-MODEL equivalence (tiny flagship on CPU): tokens produced by
+  the paged engine — chunked prefill, page-table attention, prefix-
+  cache hits, mixed chunked/unchunked admission — are IDENTICAL to
+  whole-batch ``generate`` / the slot-pool path on the same prompts,
+  including through the gang driver's paged broadcast protocol
+  executed for real in a single-process gang sim.
+"""
+
+import importlib.util
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dcos_commons_tpu.serve.engine import PagedEngine
+from dcos_commons_tpu.serve.paging import (
+    PageAllocator,
+    paged_config_from_env,
+    worst_case_pages,
+)
+from dcos_commons_tpu.utils.microbatch import QueueTimeoutError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- allocator unit + property coverage --------------------------------
+
+
+def test_allocator_admit_reserve_alloc_retire_roundtrip():
+    a = PageAllocator(pages=8, page_tokens=4)
+    adm = a.admit([1, 2, 3, 4, 5], max_new=4)  # worst: ceil(8/4) = 2
+    assert adm is not None and adm.reserve_left == 2
+    assert a.reserved_pages == 2
+    pages = [a.alloc(adm), a.alloc(adm)]
+    assert a.reserved_pages == 0
+    with pytest.raises(RuntimeError):
+        a.alloc(adm)  # past the worst case: engine bug, loud
+    a.retire(adm, pages)
+    assert a.free_pages == 8 and a.reserved_pages == 0
+    a.check_invariants()
+
+
+def test_allocator_admission_denied_when_budget_reserved():
+    a = PageAllocator(pages=4, page_tokens=4)
+    adm = a.admit([1] * 4, max_new=13)  # worst: ceil(16/4) = 4 pages
+    assert adm is not None
+    assert a.admit([2], max_new=1) is None  # 1 page needed, 0 left
+    assert not a.would_admit([2], max_new=1)
+    a.retire(adm, [])
+    assert a.would_admit([2], max_new=1)
+
+
+def test_allocator_double_free_and_foreign_free_raise():
+    a = PageAllocator(pages=4, page_tokens=2)
+    adm = a.admit([1, 2], max_new=2)
+    page = a.alloc(adm)
+    a.free_page(page)
+    with pytest.raises(RuntimeError, match="double free"):
+        a.free_page(page)
+    with pytest.raises(RuntimeError):
+        a.free_page(0)  # the trash page is never owned
+
+
+def test_prefix_chain_register_match_refcount_and_leaf_eviction():
+    a = PageAllocator(pages=6, page_tokens=2)
+    adm = a.admit([1, 2, 3, 4, 9], max_new=2)  # matches nothing yet
+    p1, p2 = a.alloc(adm), a.alloc(adm)
+    assert a.register(adm, (1, 2), p1)
+    assert a.register(adm, (3, 4), p2)
+    # registered pages are cache-owned: a private free must refuse
+    with pytest.raises(RuntimeError, match="prefix cache"):
+        a.free_page(p1)
+    a.retire(adm, [])
+    # zero refs: the WHOLE chain is reclaimable (refcounts are
+    # monotone down a chain, so leaf-first eviction reaches it all)
+    assert a.cached_pages == 2 and a.reclaimable_pages == 2
+    # a second identical prefix pins the chain (refs > 0 again)
+    adm2 = a.admit([1, 2, 3, 4, 9], max_new=2)
+    assert adm2.cached_pages == 2
+    assert a.reclaimable_pages == 0
+    a.retire(adm2, [])
+    # more than free + reclaimable can ever supply: denied outright
+    assert a.admit([5, 5], max_new=13) is None  # worst: 7 > 6
+    # eviction under pressure: the LEAF (3,4) goes first, then (1,2)
+    adm3 = a.admit([7, 8], max_new=11)  # worst: ceil(12/2) = 6 pages
+    assert adm3 is not None  # 4 free + 2 reclaimable = 6
+    held = [a.alloc(adm3) for _ in range(6)]
+    assert a.cached_pages == 0  # both entries evicted, leaf first
+    assert a.evictions == 2
+    a.retire(adm3, held)
+    a.check_invariants()
+
+
+def test_register_duplicate_key_keeps_page_private_and_closes_chain():
+    a = PageAllocator(pages=8, page_tokens=2)
+    adm1 = a.admit([1, 2, 3, 4, 5], max_new=2)
+    q1, q2 = a.alloc(adm1), a.alloc(adm1)
+    assert a.register(adm1, (1, 2), q1)
+    assert a.register(adm1, (3, 4), q2)
+    # a concurrent identical prompt that matched NOTHING (admitted
+    # before registration) tries to publish the same keys
+    a2 = PageAllocator(pages=8, page_tokens=2)  # fresh: simulate race
+    adm_a = a2.admit([1, 2, 3, 4, 5], max_new=2)
+    adm_b = a2.admit([1, 2, 3, 4, 5], max_new=2)
+    pa1, pb1 = a2.alloc(adm_a), a2.alloc(adm_b)
+    assert a2.register(adm_a, (1, 2), pa1)
+    assert not a2.register(adm_b, (1, 2), pb1)  # duplicate: private
+    assert not adm_b.chain_open
+    pb2 = a2.alloc(adm_b)
+    # chain closed: deeper pages stay private too
+    assert not a2.register(adm_b, (3, 4), pb2)
+    a2.retire(adm_b, [pb1, pb2])
+    a2.retire(adm_a, [])
+    a2.check_invariants()
+
+
+def test_allocator_property_random_lifecycles_conserve_pages():
+    hypothesis = pytest.importorskip("hypothesis")
+    st = hypothesis.strategies
+
+    @hypothesis.given(
+        st.lists(
+            st.tuples(
+                st.lists(st.integers(0, 3), min_size=1, max_size=12),
+                st.integers(1, 6),    # max_new
+                st.integers(0, 100),  # progress % before retire
+                st.booleans(),        # abandon (retire with no allocs)
+            ),
+            min_size=1, max_size=24,
+        ),
+        st.integers(2, 12),  # pages
+        st.integers(1, 4),   # page_tokens
+    )
+    @hypothesis.settings(max_examples=120, deadline=None)
+    def run(jobs, pages, page_tokens):
+        a = PageAllocator(pages, page_tokens)
+        live = []  # (admission, private_pages, prompt, progress plan)
+
+        def all_private():
+            return [p for _, pp, _ in live for p in pp]
+
+        for prompt, max_new, pct, abandon in jobs:
+            worst = worst_case_pages(len(prompt), max_new, page_tokens)
+            if worst > pages:
+                continue  # submit-time 400, never reaches admission
+            adm = a.admit(prompt, max_new)
+            if adm is None:
+                # budget-blocked: retire the oldest live request and
+                # retry once (the engine's FIFO drain, compressed)
+                if live:
+                    old_adm, old_pages, _ = live.pop(0)
+                    a.retire(old_adm, old_pages)
+                    a.check_invariants(all_private())
+                    adm = a.admit(prompt, max_new)
+                if adm is None:
+                    continue
+            private = []
+            live.append((adm, private, prompt))
+            a.check_invariants(all_private())
+            if abandon:
+                live.pop()
+                a.retire(adm, private)
+                a.check_invariants(all_private())
+                continue
+            # consume part of the reservation, registering full
+            # prompt pages as they complete (the engine's chunk walk)
+            to_alloc = (adm.reserve_left * pct) // 100
+            v = adm.cached_pages
+            for _ in range(to_alloc):
+                page = a.alloc(adm)
+                private.append(page)
+                a.check_invariants(all_private())
+                covered = (v + 1) * page_tokens
+                if covered <= len(prompt):
+                    toks = tuple(
+                        prompt[v * page_tokens:covered]
+                    )
+                    if a.register(adm, toks, page):
+                        private.remove(page)
+                    a.check_invariants(all_private())
+                v += 1
+        for adm, private, _ in live:
+            a.retire(adm, private)
+        a.check_invariants([])
+        # everything returned: free + resident cache == total
+        assert a.free_pages + a.cached_pages == pages
+        assert a.reserved_pages == 0
+        assert a.cached_pages == a.reclaimable_pages + sum(
+            1 for e in a._by_id.values() if e.children
+        )
+
+    run()
+
+
+def test_paged_config_from_env_contract():
+    from dcos_commons_tpu.specification.specs import SpecError
+
+    cfg = paged_config_from_env({"MAX_LEN": "64", "SERVE_BATCH": "4"})
+    assert cfg.page_tokens == 16 and cfg.pages == 16  # 4 * ceil(64/16)
+    assert cfg.pages_per_row == 4 and cfg.arena_pages == 17
+    assert paged_config_from_env({"KV_PAGE_TOKENS": "0"}) is None
+    with pytest.raises(SpecError, match="overcommitted"):
+        paged_config_from_env({
+            "MAX_LEN": "64", "KV_PAGES": "2", "KV_PAGE_TOKENS": "16",
+        })
+    with pytest.raises(SpecError):
+        paged_config_from_env({"PREFILL_CHUNK_TOKENS": "-1"})
+    off = paged_config_from_env({"PREFIX_CACHE": "0"})
+    assert off.prefix_cache is False
+
+
+# -- engine vs a deterministic fake model ------------------------------
+
+
+_V = 97
+
+
+def _chain_first(prompt):
+    return (sum(prompt) * 31 + len(prompt)) % _V
+
+
+def _chain_next(tok, pos):
+    return (tok * 7 + pos * 3 + 1) % _V
+
+
+def _chain_oracle(prompt, n, eos=None):
+    out = [_chain_first(prompt)]
+    pos = len(prompt)
+    while len(out) < n and (eos is None or out[-1] != eos):
+        out.append(_chain_next(out[-1], pos))
+        pos += 1
+    if eos is not None and eos in out:
+        out = out[: out.index(eos) + 1]
+    return out
+
+
+class FakePagedModel:
+    """Chunk-accumulating fake: chunks of one slot's prompt arrive in
+    order (prefix cache OFF keeps start=0 on the first chunk), the
+    final chunk's return is the chain's first token.  Decode asserts
+    every live row's write page is allocated (nonzero)."""
+
+    def __init__(self, step_gate=None):
+        self.partial = {}
+        self.step_gate = step_gate
+        self.decode_calls = 0
+        self.max_active = 0
+
+    def prefill_chunk(self, padded, slot, table, start, true_len,
+                      temp, seed):
+        if start == 0:
+            self.partial[slot] = []
+        buf = self.partial[slot]
+        assert len(buf) == start, "chunks arrived out of order"
+        buf.extend(int(t) for t in padded[0, :true_len])
+        # the chunk's pages must be allocated before the model runs
+        p = 4  # matches the engines below
+        for pos in range(start, start + true_len):
+            assert table[pos // p] != 0, "write into unallocated page"
+        return _chain_first(buf)
+
+    def decode(self, tok, pos, temps, seeds, tables, n_active):
+        if self.step_gate is not None:
+            assert self.step_gate.wait(10), "tick never released"
+            self.step_gate.clear()
+        self.decode_calls += 1
+        self.max_active = max(self.max_active, n_active)
+        p = 4
+        for s in range(len(tok)):
+            if pos[s] > 0:  # live row: write page must exist
+                assert tables[s][int(pos[s]) // p] != 0
+        return np.asarray(
+            [_chain_next(int(t), int(q)) for t, q in zip(tok, pos)],
+            np.int32,
+        )
+
+
+def _paged_engine(model, slots, pages, max_len=32, prompt_len=24,
+                  chunk=5, prefix=False, **kw):
+    return PagedEngine(
+        model.prefill_chunk, model.decode, slots, max_len, prompt_len,
+        page_tokens=4, pages=pages, chunk_tokens=chunk,
+        prefix_cache=prefix, **kw,
+    )
+
+
+def _swarm(engine, jobs):
+    results = [None] * len(jobs)
+    errors = []
+
+    def client(i):
+        rows, n, eos = jobs[i]
+        try:
+            results[i] = engine.submit(rows, n, eos_id=eos)
+        except Exception as e:  # noqa: BLE001 — surfaced via assert
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=client, args=(i,))
+        for i in range(len(jobs))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors, errors
+    return results
+
+
+def test_paged_engine_chunked_prefill_matches_oracle():
+    model = FakePagedModel()
+    engine = _paged_engine(model, slots=3, pages=24)
+    try:
+        jobs = [
+            ([[1, 2, 3]], 8, None),               # single chunk
+            ([list(range(1, 14))], 5, None),      # 13 tokens: 3 chunks
+            ([[4], [5, 6]], 5, None),
+            ([list(range(2, 20))], 6, None),      # 18 tokens: 4 chunks
+        ]
+        results = _swarm(engine, jobs)
+        for (rows, n, eos), result in zip(jobs, results):
+            assert result == [_chain_oracle(r, n, eos) for r in rows]
+        stats = engine.stats()
+        assert stats["active_slots"] == 0
+        assert stats["kv_pages_free"] == 24  # prefix off: all freed
+        assert stats["prefill_chunk_backlog"] == 0
+        engine._allocator.check_invariants()
+    finally:
+        engine.stop()
+
+
+def test_paged_engine_budget_exhaustion_queues_fifo_and_completes():
+    """More worst-case page demand than the arena: the overflow WAITS
+    for retirements (strict FIFO, no starvation, no mid-flight OOM)
+    and every chain still matches the oracle."""
+    model = FakePagedModel()
+    # 8 pages of 4: each job below worst-cases 3 pages, so at most 2
+    # run concurrently even though 4 decode rows exist
+    engine = _paged_engine(model, slots=4, pages=8, max_len=12,
+                           prompt_len=8)
+    try:
+        jobs = [([[i + 1, i + 2]], 8, None) for i in range(7)]
+        results = _swarm(engine, jobs)
+        for (rows, n, eos), result in zip(jobs, results):
+            assert result == [_chain_oracle(rows[0], n, eos)]
+        assert model.max_active <= 2
+        stats = engine.stats()
+        assert stats["kv_pages_free"] == 8
+        assert stats["kv_pages_reserved"] == 0
+    finally:
+        engine.stop()
+
+
+def test_paged_timeout_names_the_starved_resource():
+    """A budget-starved request 503s with the kv-page-budget reason
+    (the requests_timed_out_memory split); a slot-starved one keeps
+    the kv-slot reason (compute split)."""
+    gate = threading.Event()  # never set: decode wedges
+    model = FakePagedModel(step_gate=gate)
+    # 4 pages: the occupant's worst case takes them all; slots ample
+    engine = _paged_engine(model, slots=3, pages=4, max_len=16,
+                           prompt_len=8, queue_timeout_s=0.3)
+    try:
+        occupant = threading.Thread(
+            target=lambda: pytest.raises(
+                Exception, engine.submit, [[9, 9]], 14
+            ),
+            daemon=True,
+        )
+        occupant.start()
+        time.sleep(0.1)
+        with pytest.raises(QueueTimeoutError) as exc:
+            engine.submit([[5]], 4)
+        assert exc.value.kind == "kv-page-budget"
+        assert "page budget" in str(exc.value)
+        deadline = time.monotonic() + 5
+        while (engine.stats()["requests_timed_out"] < 2
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        stats = engine.stats()
+        assert stats["requests_timed_out_memory"] == 1
+        assert stats["requests_timed_out_compute"] == 1  # the stalled
+    finally:
+        gate.set()
+        engine.stop()
+    # slot starvation: pages ample, one decode row, wedged occupant
+    gate2 = threading.Event()
+    model2 = FakePagedModel(step_gate=gate2)
+    engine2 = _paged_engine(model2, slots=1, pages=24,
+                            queue_timeout_s=0.3)
+    try:
+        occupant = threading.Thread(
+            target=lambda: pytest.raises(
+                Exception, engine2.submit, [[9]], 8
+            ),
+            daemon=True,
+        )
+        occupant.start()
+        time.sleep(0.1)
+        with pytest.raises(QueueTimeoutError) as exc:
+            engine2.submit([[5]], 4)
+        assert exc.value.kind == "kv-slot"
+        assert engine2.stats()["requests_timed_out_memory"] == 0
+    finally:
+        gate2.set()
+        engine2.stop()
+
+
+def test_paged_long_prefill_is_progress_not_a_stall():
+    """A prompt whose CHUNKED prefill spans several timeout windows
+    must not be cut off as 'stalled': chunk progress is progress."""
+    model = FakePagedModel()
+    orig = model.prefill_chunk
+
+    def slow_chunk(*a, **kw):
+        time.sleep(0.15)  # half a window per chunk
+        return orig(*a, **kw)
+
+    model.prefill_chunk = slow_chunk
+    engine = _paged_engine(model, slots=1, pages=24, chunk=3,
+                           queue_timeout_s=0.3)
+    try:
+        # 15 tokens / 3-token chunks = 5 chunks ~= 0.75s > 2 windows
+        prompt = list(range(1, 16))
+        got = engine.submit([prompt], 4)[0]
+        assert got == _chain_oracle(prompt, 4)
+        assert engine.stats()["requests_timed_out"] == 0
+    finally:
+        engine.stop()
+
+
+def test_paged_cow_no_write_after_page_published():
+    """The copy-on-write contract, audited on the engine's own
+    thread: once a page is registered into the prefix cache, no model
+    call may ever write to it again.  Identical prompts hammer the
+    cache while the audit records every write and every
+    registration."""
+    events = []  # ("write", page) / ("reg", page), loop-thread order
+
+    class AuditModel(FakePagedModel):
+        def prefill_chunk(self, padded, slot, table, start, true_len,
+                          temp, seed):
+            p = 4
+            for pos in range(start, start + true_len):
+                events.append(("write", int(table[pos // p])))
+            if start == 0:
+                self.partial[slot] = []
+            buf = self.partial.setdefault(slot, [])
+            # cache hits skip earlier chunks: pad the buffer (token
+            # values untracked — this test audits pages, not tokens)
+            buf.extend([0] * (start - len(buf)))
+            buf.extend(int(t) for t in padded[0, :true_len])
+            return _chain_first(buf)
+
+        def decode(self, tok, pos, temps, seeds, tables, n_active):
+            p = 4
+            self.decode_calls += 1
+            for s in range(len(tok)):
+                if pos[s] > 0:
+                    events.append(
+                        ("write", int(tables[s][int(pos[s]) // p]))
+                    )
+            return np.asarray(
+                [_chain_next(int(t), int(q))
+                 for t, q in zip(tok, pos)],
+                np.int32,
+            )
+
+    model = AuditModel()
+    engine = _paged_engine(model, slots=3, pages=24, prefix=True)
+    reg_orig = engine._allocator.register
+
+    def audited_register(adm, toks, page):
+        ok = reg_orig(adm, toks, page)
+        if ok:
+            events.append(("reg", int(page)))
+        return ok
+
+    engine._allocator.register = audited_register
+    try:
+        prompt = list(range(1, 12))  # 2 full pages + a partial
+        jobs = [([prompt], 6, None) for _ in range(5)]
+        jobs += [([prompt + [77]], 6, None)]  # diverges mid-page 3
+        _swarm(engine, jobs)
+        assert engine.stats()["prefix_cache_hits"] > 0
+        published_at = {}
+        for i, (kind, page) in enumerate(events):
+            if kind == "reg":
+                published_at.setdefault(page, i)
+        for i, (kind, page) in enumerate(events):
+            if kind == "write" and page in published_at:
+                assert i < published_at[page], (
+                    f"page {page} written at event {i} after being "
+                    f"published at {published_at[page]}"
+                )
+        engine._allocator.check_invariants()
+    finally:
+        engine.stop()
+
+
+def test_paged_engine_property_any_request_mix_matches_oracle():
+    hypothesis = pytest.importorskip("hypothesis")
+    st = hypothesis.strategies
+
+    @hypothesis.given(
+        st.lists(
+            st.tuples(
+                st.lists(
+                    st.lists(st.integers(0, _V - 1), min_size=1,
+                             max_size=9),
+                    min_size=1, max_size=3,
+                ),
+                st.integers(1, 8),
+                st.one_of(st.none(), st.integers(0, _V - 1)),
+            ),
+            min_size=1, max_size=6,
+        ),
+        st.integers(1, 4),   # slots
+        st.integers(3, 10),  # pages (>= one worst-case request: 3)
+        st.integers(1, 6),   # chunk width
+    )
+    @hypothesis.settings(
+        max_examples=40, deadline=None,
+        suppress_health_check=[hypothesis.HealthCheck.too_slow],
+    )
+    def run(jobs, slots, pages, chunk):
+        max_len = 12
+        # clamp the requested length to what the 12-position virtual
+        # row can hold (over-length asks are a submit-time 400, not
+        # this test's subject)
+        jobs = [
+            (rows, min(n, max_len - max(len(r) for r in rows)), eos)
+            for rows, n, eos in jobs
+        ]
+        jobs = [j for j in jobs if j[1] >= 1]
+        if not jobs:
+            return
+        model = FakePagedModel()
+        engine = _paged_engine(
+            model, slots=slots, pages=pages, max_len=max_len,
+            prompt_len=9, chunk=chunk,
+        )
+        try:
+            results = _swarm(engine, jobs)
+            for (rows, n, eos), result in zip(jobs, results):
+                assert result == [
+                    _chain_oracle(r, n, eos) for r in rows
+                ]
+            stats = engine.stats()
+            assert stats["active_slots"] == 0
+            assert stats["queue_depth"] == 0
+            assert stats["kv_pages_free"] == pages
+            assert stats["kv_pages_reserved"] == 0
+            engine._allocator.check_invariants()
+        finally:
+            engine.stop()
+
+    run()
+
+
+# -- admission gate: page-budget overcommit is a 422, not a 503 --------
+
+
+def test_admission_gate_rejects_page_budget_overcommit():
+    """The PR 9 admission gate runs the serve workload builders, so
+    an arena that cannot hold one MAX_LEN request (a permanent-503
+    misconfiguration) is a line-anchored 422 finding at PUT time."""
+    jax = pytest.importorskip("jax")  # noqa: F841 — builder needs it
+
+    from dcos_commons_tpu.multi.admission import validate_service_yaml
+
+    yaml_text = """
+name: badserve
+pods:
+  server:
+    count: 1
+    tpu:
+      generation: v5e
+      chips-per-host: 1
+    tasks:
+      api:
+        goal: RUNNING
+        cmd: "python serve_worker.py"
+        cpus: 1
+        memory: 1024
+        env:
+          VOCAB: "512"
+          D_MODEL: "64"
+          N_LAYERS: "2"
+          MAX_LEN: "256"
+          SERVE_BATCH: "4"
+          KV_PAGE_TOKENS: "16"
+          KV_PAGES: "3"
+"""
+    _spec, findings = validate_service_yaml(yaml_text, "badserve")
+    assert any(
+        "overcommitted" in f.render() for f in findings
+    ), [f.render() for f in findings]
+    good = yaml_text.replace('KV_PAGES: "3"', 'KV_PAGES: "64"')
+    _spec, findings = validate_service_yaml(good, "badserve")
+    assert not [
+        f for f in findings if "overcommit" in f.render()
+    ], [f.render() for f in findings]
+
+
+# -- SLO watcher: the min-direction kv_pages_free signal ---------------
+
+
+def test_slo_watcher_kv_pages_free_breaches_below_minimum():
+    from dcos_commons_tpu.health.detectors import ServingSloWatcher
+
+    w = ServingSloWatcher(kv_pages_free_slo=5)
+    events = w.observe({"serve-0-task": {"kv_pages_free": 2}})
+    assert len(events) == 1 and not events[0].get("cleared")
+    assert events[0]["signal"] == "kv_pages_free"
+    assert "below minimum" in events[0]["message"]
+    # still breaching: no repeat, magnitude tracked
+    assert w.observe({"serve-0-task": {"kv_pages_free": 1}}) == []
+    assert w.breaches[("serve-0-task", "kv_pages_free")] == 1
+    # recovery clears
+    events = w.observe({"serve-0-task": {"kv_pages_free": 9}})
+    assert len(events) == 1 and events[0]["cleared"]
+    # per-task env override beats the scheduler default
+    w2 = ServingSloWatcher(kv_pages_free_slo=0)  # disabled by default
+    assert w2.observe({"t": {"kv_pages_free": 1}}) == []
+    events = w2.observe(
+        {"t": {"kv_pages_free": 1}},
+        env_by_task={"t": {"SERVE_KV_PAGES_FREE_SLO": "4"}},
+    )
+    assert len(events) == 1
+
+
+# -- real model: token-identical to the slot pool ----------------------
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    import jax
+    import jax.numpy as jnp
+
+    from dcos_commons_tpu.models import TransformerConfig, init_params
+
+    config = TransformerConfig(
+        vocab=64, d_model=32, n_layers=2, n_heads=8, n_kv_heads=4,
+        d_ff=96, max_seq=64, dtype=jnp.float32, remat=False,
+    )
+    return config, init_params(config, jax.random.key(0))
+
+
+MAX_LEN, NEW = 48, 8
+PROMPT_LEN = MAX_LEN - NEW
+PROMPTS = [
+    [1, 2, 3, 4],                             # shorter than a chunk
+    [9, 8],
+    [5, 6, 7, 2, 1],
+    [3],
+    [11, 12, 13, 14, 15, 16, 17, 2, 9],       # 9 tokens: 2 chunks
+]
+
+
+def _oracle(config, params, prompt, n):
+    import jax.numpy as jnp
+
+    from dcos_commons_tpu.models import generate
+
+    out = generate(
+        config, params, jnp.asarray([prompt], jnp.int32),
+        max_new_tokens=n,
+    )
+    return [int(t) for t in out[0]]
+
+
+def _real_paged(config, params, kv_dtype="native", slots=3, pages=30,
+                page_tokens=4, chunk=6, prefix=True, **kw):
+    from dcos_commons_tpu.serve.pool import PagedPoolModel
+
+    pool = PagedPoolModel(
+        config, params, slots, MAX_LEN, page_tokens, pages, chunk,
+        kv_dtype=kv_dtype,
+    )
+    pool.warm()
+    engine = PagedEngine(
+        pool.prefill_chunk, pool.decode, slots, MAX_LEN, PROMPT_LEN,
+        page_tokens=page_tokens, pages=pages, chunk_tokens=chunk,
+        prefix_cache=prefix, queue_timeout_s=120, **kw,
+    )
+    return pool, engine
+
+
+@pytest.mark.parametrize("kv_dtype", ["native", "int8"])
+def test_paged_engine_greedy_equals_whole_batch_generate(tiny, kv_dtype):
+    """Staggered concurrent admission over the paged arena — mixed
+    chunked/unchunked prompts, page tables, early retirement —
+    reproduces whole-batch generate token for token (the slot pool's
+    own equivalence bar, held by the paged path)."""
+    config, params = tiny
+    _pool, engine = _real_paged(config, params, kv_dtype=kv_dtype)
+    try:
+        results = [None] * len(PROMPTS)
+        errors = []
+
+        def client(i):
+            try:
+                results[i] = engine.submit([PROMPTS[i]], NEW)[0]
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=client, args=(i,))
+            for i in range(len(PROMPTS))
+        ]
+        for t in threads:
+            t.start()
+            time.sleep(0.01)  # staggered arrivals: mid-flight admission
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, errors
+        if kv_dtype == "native":
+            oracles = [
+                _oracle(config, params, p, NEW) for p in PROMPTS
+            ]
+            assert results == oracles
+        else:
+            # int8 equivalence is engine-vs-engine determinism, as in
+            # the slot-pool tests
+            again = [engine.submit([p], NEW)[0] for p in PROMPTS]
+            assert results == again
+        engine._allocator.check_invariants()
+    finally:
+        engine.stop()
+
+
+def test_paged_prefix_cache_hit_is_token_identical(tiny):
+    """A request served partly from CACHED prompt pages produces the
+    same tokens as the cold path — shared pages carry bit-identical
+    K/V, and divergence past the shared prefix recomputes privately."""
+    config, params = tiny
+    _pool, engine = _real_paged(config, params)
+    shared = [7, 3, 9, 1, 4, 4, 2, 8]  # exactly 2 full pages (P=4)
+    variants = [
+        shared + [5],
+        shared + [6, 1, 2],
+        shared + [5],          # full repeat: max cache reuse
+        shared[:6] + [9, 9],   # diverges MID page 2: partial miss
+    ]
+    try:
+        cold = engine.submit([variants[0]], NEW)[0]
+        base = engine.stats()["prefix_cache_hits"]
+        for v in variants:
+            got = engine.submit([v], NEW)[0]
+            assert got == _oracle(config, params, v, NEW)
+        assert cold == _oracle(config, params, variants[0], NEW)
+        stats = engine.stats()
+        assert stats["prefix_cache_hits"] > base
+        assert 0.0 < stats["prefix_cache_hit_rate"] <= 1.0
+        assert stats["kv_pages_cached"] > 0
+        engine._allocator.check_invariants()
+    finally:
+        engine.stop()
+
+
+def test_paged_vs_slot_pool_same_tokens_same_load(tiny):
+    """The two engines, same prompts, same greedy request mix: token
+    outputs must be IDENTICAL (the bench's equality fence, held as a
+    unit test)."""
+    from dcos_commons_tpu.serve.engine import SlotEngine
+    from dcos_commons_tpu.serve.pool import PoolModel
+
+    config, params = tiny
+    slot_pool = PoolModel(config, params, 3, MAX_LEN)
+    slot_engine = SlotEngine(
+        slot_pool.prefill, slot_pool.decode, 3, MAX_LEN, PROMPT_LEN,
+        queue_timeout_s=120,
+    )
+    _pool, paged_engine = _real_paged(config, params)
+    try:
+        slot_out = [slot_engine.submit([p], NEW)[0] for p in PROMPTS]
+        paged_out = [paged_engine.submit([p], NEW)[0] for p in PROMPTS]
+        assert slot_out == paged_out
+    finally:
+        slot_engine.stop()
+        paged_engine.stop()
+
+
+def test_paged_gang_sim_broadcast_protocol_equivalence(tiny):
+    """The gang driver's PAGED broadcast protocol (chunk/page fields)
+    executed for real in a single-process gang sim: rank 0's engine
+    callbacks broadcast each tick and _execute_paged_tick runs the
+    identical payload — greedy replies stay token-identical."""
+    from jax.experimental import multihost_utils
+
+    from dcos_commons_tpu.serve.pool import PagedPoolModel
+
+    path = os.path.join(REPO, "frameworks", "jax",
+                        "serve_gang_worker.py")
+    spec = importlib.util.spec_from_file_location(
+        "gang_worker_paged_ut", path
+    )
+    gw = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(gw)
+
+    config, params = tiny
+    slots, p_tok, pages, chunk = 3, 4, 30, 6
+    m = -(-MAX_LEN // p_tok)
+    pool = PagedPoolModel(
+        config, params, slots, MAX_LEN, p_tok, pages, chunk
+    )
+    pool.warm()
+    ticks = {"admit": 0, "decode": 0, "noop": 0}
+
+    def prefill_fn(padded, slot, table, start, true_len, temp, seed):
+        head = np.asarray(
+            [gw.OP_ADMIT, slot, start, true_len, seed,
+             round(temp * 1e6)],
+            np.int64,
+        )
+        _, zero_rows, zero_tables, _ = gw._zero_paged_payload(
+            slots, m, chunk
+        )
+        zero_tables[slot] = table
+        out = gw._broadcast_paged_tick(
+            multihost_utils,
+            (head, zero_rows, zero_tables, padded.astype(np.int32)),
+            slots, m, chunk,
+        )
+        ticks["admit"] += 1
+        return gw._execute_paged_tick(pool, *out)
+
+    def decode_fn(tok, pos, temps, seeds, tables, n_active):
+        head = np.asarray(
+            [gw.OP_DECODE, n_active, 0, 0, 0, 0], np.int64
+        )
+        rows = np.stack([
+            tok.astype(np.int64), pos.astype(np.int64),
+            np.round(temps.astype(np.float64) * 1e6).astype(np.int64),
+            seeds.astype(np.int64),
+        ], axis=1)
+        out = gw._broadcast_paged_tick(
+            multihost_utils,
+            (head, rows, tables.astype(np.int64),
+             np.zeros((1, chunk), np.int32)),
+            slots, m, chunk,
+        )
+        ticks["decode"] += 1
+        return gw._execute_paged_tick(pool, *out)
+
+    def idle():
+        out = gw._broadcast_paged_tick(
+            multihost_utils, None, slots, m, chunk
+        )
+        assert gw._execute_paged_tick(pool, *out) is None
+        ticks["noop"] += 1
+
+    engine = PagedEngine(
+        prefill_fn, decode_fn, slots, MAX_LEN, PROMPT_LEN,
+        page_tokens=p_tok, pages=pages, chunk_tokens=chunk,
+        queue_timeout_s=120, on_idle=idle, idle_every_s=0.01,
+    )
+    try:
+        results = engine.submit(PROMPTS, NEW)
+        oracles = [_oracle(config, params, p, NEW) for p in PROMPTS]
+        assert results == oracles
+        assert ticks["admit"] >= len(PROMPTS)  # >= 1 chunk each
+        assert ticks["decode"] >= NEW - 1
+        deadline = time.monotonic() + 5
+        while not ticks["noop"] and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert ticks["noop"] >= 1
+    finally:
+        engine.stop()
