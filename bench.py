@@ -1519,6 +1519,343 @@ def bench_continuous_serve() -> dict:
     return out
 
 
+def bench_router_scale() -> dict:
+    """Serving front door (ISSUE 12), CPU-runnable and jax-free: an
+    open-loop load sweep through the multi-pod RequestRouter over 1,
+    2 and 4 in-process "pods" — each a REAL PagedEngine (page-
+    budgeted admission, chunked prefill, refcounted prefix cache)
+    over a deterministic chain model whose decode tick costs a fixed
+    calibrated sleep, so pod service time is held constant and the
+    sweep measures the ROUTING layer: placement quality, affinity,
+    drain/failover.  (In production each pod is its own host; the
+    sleep stands in for the chip tick.)  Four fences:
+
+    * GREEDY EQUALITY, every round — continuations through the
+      router are token-identical to direct-to-pod (the chain
+      oracle), including through prefix-cache hits and mid-sweep
+      failover: the router must never corrupt or duplicate a reply;
+    * NEAR-LINEAR SCALING — aggregate tokens/s at 4 pods >= 3x the
+      single-pod run under proportionally-scaled offered load;
+    * AFFINITY BEATS SPRAY — under a shared-system-prompt session
+      workload, prefix-affinity routing must beat round-robin on the
+      pods' aggregate prefix_cache_hit_rate (random spray makes
+      every pod re-prefill every session: the 1/N dilution);
+    * BOUNDED DRAIN — a mid-sweep drain + kill of one pod loses no
+      request (in-flight fails over within the retry budget, the
+      drained pod takes zero new admissions) and p95 completion
+      latency stays within a fenced ratio of the steady-state round.
+
+    Open-loop throughout: arrivals ride a fixed schedule, never
+    completions — a saturating tier cannot slow its offered load.
+    """
+    import random
+    import statistics
+    import threading
+
+    import numpy as np
+
+    from dcos_commons_tpu.router import PodTransportError, RequestRouter
+    from dcos_commons_tpu.serve.engine import PagedEngine
+
+    _V = 997
+
+    def _chain_first(prompt):
+        return (sum(prompt) * 31 + len(prompt)) % _V
+
+    def _chain_next(tok, pos):
+        return (tok * 7 + pos * 3 + 1) % _V
+
+    def _oracle(prompt, n):
+        out = [_chain_first(prompt)]
+        pos = len(prompt)
+        while len(out) < n:
+            out.append(_chain_next(out[-1], pos))
+            pos += 1
+        return out
+
+    # pod geometry: pages of 4 so an 8-token session prefix is two
+    # cacheable full pages; the decode tick's sleep is the modeled
+    # chip time (dominates the host bookkeeping by ~100x)
+    P_TOK, CHUNK, MAX_LEN, PROMPT_LEN = 4, 8, 32, 24
+    SLOTS, STEP_S = 8, 0.01
+    PAGES = SLOTS * (MAX_LEN // P_TOK)
+    MAX_NEW = 8
+
+    class ChainArena:
+        """The fake device half of a paged pod: every prefilled
+        token is written into its (page, offset) cell, so a prefix-
+        cache-served prefix is RECONSTRUCTED from the arena exactly
+        like real attention would gather it — first tokens depend on
+        the full prompt regardless of how much the cache served, and
+        greedy equality survives any hit depth."""
+
+        def __init__(self):
+            self.cells = {}  # page -> {offset: token}
+            self.lock = threading.Lock()
+
+        def prefill_chunk(self, padded, slot, table, start, true_len,
+                          temp, seed):
+            time.sleep(STEP_S * 0.5)  # the modeled prefill dispatch
+            with self.lock:
+                buf = [
+                    self.cells[int(table[pos // P_TOK])][pos % P_TOK]
+                    for pos in range(start)
+                ]
+                for i in range(true_len):
+                    pos = start + i
+                    page = int(table[pos // P_TOK])
+                    tok = int(padded[0, i])
+                    self.cells.setdefault(page, {})[pos % P_TOK] = tok
+                    buf.append(tok)
+            return _chain_first(buf)
+
+        def decode(self, tok, pos, temps, seeds, tables, n_active):
+            time.sleep(STEP_S)  # the modeled decode tick
+            return np.asarray(
+                [_chain_next(int(t), int(q))
+                 for t, q in zip(tok, pos)],
+                np.int32,
+            )
+
+    class BenchPod:
+        def __init__(self, name):
+            self.name = name
+            self.arena = ChainArena()
+            self.engine = PagedEngine(
+                self.arena.prefill_chunk, self.arena.decode, SLOTS,
+                MAX_LEN, PROMPT_LEN, page_tokens=P_TOK, pages=PAGES,
+                chunk_tokens=CHUNK, prefix_cache=True,
+                queue_timeout_s=600,
+            )
+            self.killed = threading.Event()
+            self.admitted = 0
+
+        def send(self, request):
+            if self.killed.is_set():
+                raise PodTransportError(f"{self.name} is dead")
+            self.admitted += 1
+            out = self.engine.submit(
+                request["tokens"], request["max_new_tokens"],
+            )
+            if self.killed.is_set():
+                # the reply died on the wire: the failover trigger
+                raise PodTransportError(f"{self.name} died mid-reply")
+            return out
+
+        def stop(self):
+            self.engine.stop()
+
+    def build_workload(n_pods, rng):
+        """Per-pod-scaled session traffic: 6-request sessions sharing
+        an 8-token (two-full-page) prefix, plus unshared one-offs —
+        arrivals saturate the tier at ~1.3x its service rate so the
+        makespan measures sustained routing throughput."""
+        n_sessions = 10 * n_pods
+        reqs = []
+        for s in range(n_sessions):
+            prefix = [rng.randrange(_V) for _ in range(8)]
+            for i in range(6):
+                reqs.append({
+                    "prompt": prefix + [
+                        rng.randrange(_V) for _ in range(1 + i % 4)
+                    ],
+                    "n": [2, 4, MAX_NEW, MAX_NEW, 4, 6][i % 6],
+                })
+        for _ in range(12 * n_pods):
+            reqs.append({
+                "prompt": [rng.randrange(_V)
+                           for _ in range(2 + rng.randrange(8))],
+                "n": [2, 4, MAX_NEW][rng.randrange(3)],
+            })
+        rng.shuffle(reqs)
+        useful = sum(r["n"] for r in reqs)
+        # offered rate = 1.5x the tier's token service rate: deep
+        # enough saturation that every pod's decode rows stay full
+        capacity_tps = n_pods * SLOTS / STEP_S
+        span = useful / (1.5 * capacity_tps)
+        arrivals = sorted(rng.uniform(0.0, span) for _ in reqs)
+        return reqs, arrivals, useful
+
+    def run_round(n_pods, policy, rng, drain_script=None):
+        """One open-loop load through a fresh router + fresh pods.
+        Returns (metrics dict, pods) — pods still warm for gauge
+        reads; caller stops them."""
+        pods = {f"p{i}": BenchPod(f"p{i}") for i in range(n_pods)}
+        router = RequestRouter(
+            lambda name, addr, req: pods[name].send(req),
+            page_tokens=P_TOK, policy=policy, stale_after_s=5.0,
+            retry_budget=2,
+            # a tight slack keeps session pinning from imbalancing
+            # the tier: a hot pod sheds affinity traffic early
+            affinity_slack=2.0,
+        )
+        router.update_pods(
+            {n: {"address": f"{n}:0"} for n in pods}, generation="g1"
+        )
+        stop_poll = threading.Event()
+
+        def poller():
+            while not stop_poll.is_set():
+                for name, pod in pods.items():
+                    if not pod.killed.is_set():
+                        router.observe_stats(name, pod.engine.stats())
+                stop_poll.wait(0.025)
+
+        reqs, arrivals, useful = build_workload(n_pods, rng)
+        results = [None] * len(reqs)
+        done_s = [0.0] * len(reqs)
+        errors = []
+        t0 = time.monotonic()
+
+        def client(i):
+            delay = arrivals[i] - (time.monotonic() - t0)
+            if delay > 0:
+                time.sleep(delay)
+            t_req = time.monotonic()
+            try:
+                results[i] = router.submit(
+                    reqs[i]["prompt"], reqs[i]["n"]
+                )
+                done_s[i] = time.monotonic() - t_req
+            except Exception as e:  # noqa: BLE001
+                errors.append((i, e))
+
+        poll_thread = threading.Thread(target=poller, daemon=True)
+        poll_thread.start()
+        threads = [
+            threading.Thread(target=client, args=(i,))
+            for i in range(len(reqs))
+        ]
+        span = arrivals[-1] if arrivals else 0.0
+        script_thread = None
+        if drain_script is not None:
+            script_thread = threading.Thread(
+                target=drain_script, args=(router, pods, t0, span),
+                daemon=True,
+            )
+            script_thread.start()
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=600)
+        makespan = time.monotonic() - t0
+        stop_poll.set()
+        poll_thread.join(timeout=5)
+        if script_thread is not None:
+            script_thread.join(timeout=5)
+        assert not errors, errors[:3]
+        # correctness before speed, EVERY round: token-identical to
+        # direct-to-pod, through cache hits and failovers alike
+        for req, result in zip(reqs, results):
+            assert result == _oracle(req["prompt"], req["n"]), (
+                "router changed a greedy continuation"
+            )
+        hits = lookups = 0
+        for pod in pods.values():
+            s = pod.engine.stats()
+            hits += s["prefix_cache_hits"]
+            lookups += s["prefix_cache_lookups"]
+        metrics = {
+            "tps": useful / makespan,
+            "p95": statistics.quantiles(done_s, n=20)[-1]
+            if len(done_s) >= 2 else done_s[0],
+            "hit_rate": hits / lookups if lookups else 0.0,
+            "router": router.stats(),
+        }
+        return metrics, pods
+
+    out = {
+        "router_scale_step_s": STEP_S,
+        "router_scale_slots": SLOTS,
+        "router_scale_page_tokens": P_TOK,
+    }
+
+    # ---- the 1 -> 2 -> 4 pod sweep (affinity policy, the default)
+    sweep = {}
+    for n_pods in (1, 2, 4):
+        m, pods = run_round(n_pods, "affinity", random.Random(n_pods))
+        for pod in pods.values():
+            pod.stop()
+        sweep[n_pods] = m
+        out[f"router_scale_tokens_per_s_{n_pods}p"] = round(m["tps"], 1)
+        out[f"router_scale_p95_s_{n_pods}p"] = round(m["p95"], 4)
+    scale_x = sweep[4]["tps"] / sweep[1]["tps"]
+    out["router_scale_x_4p"] = round(scale_x, 2)
+
+    # ---- prefix affinity vs round-robin spray (4 pods, same seed:
+    # identical session workload, only the placement policy differs)
+    aff, aff_pods = run_round(4, "affinity", random.Random(99))
+    for pod in aff_pods.values():
+        pod.stop()
+    rr, rr_pods = run_round(4, "round-robin", random.Random(99))
+    for pod in rr_pods.values():
+        pod.stop()
+    out["router_affinity_prefix_hit_rate"] = round(aff["hit_rate"], 4)
+    out["router_roundrobin_prefix_hit_rate"] = round(rr["hit_rate"], 4)
+    out["router_affinity_tokens_per_s"] = round(aff["tps"], 1)
+    out["router_roundrobin_tokens_per_s"] = round(rr["tps"], 1)
+    out["router_affinity_hit_rate_gain"] = round(
+        aff["hit_rate"] - rr["hit_rate"], 4
+    )
+
+    # ---- mid-sweep drain + kill: graceful drain at 40% of the
+    # arrival span, hard kill at 70% — in-flight work fails over
+    def drain_script(router, pods, t0, span):
+        deadline = t0 + 0.4 * span
+        wait = deadline - time.monotonic()
+        if wait > 0:
+            time.sleep(wait)
+        router.drain("p3")
+        wait = t0 + 0.7 * span - time.monotonic()
+        if wait > 0:
+            time.sleep(wait)
+        pods["p3"].killed.set()
+
+    drain, drain_pods = run_round(
+        4, "affinity", random.Random(7), drain_script=drain_script
+    )
+    drained_admitted = drain_pods["p3"].admitted
+    for pod in drain_pods.values():
+        pod.stop()
+    out["router_drain_p95_s"] = round(drain["p95"], 4)
+    drain_ratio = drain["p95"] / max(sweep[4]["p95"], 1e-9)
+    out["router_drain_p95_ratio"] = round(drain_ratio, 2)
+    out["router_drain_failovers"] = drain["router"]["router_failovers"]
+    out["router_drain_completed"] = drain["router"]["requests_completed"]
+
+    print(
+        f"[router-scale] tokens/s 1p {sweep[1]['tps']:.0f} -> 2p "
+        f"{sweep[2]['tps']:.0f} -> 4p {sweep[4]['tps']:.0f} "
+        f"({scale_x:.2f}x), prefix hit rate affinity "
+        f"{aff['hit_rate']:.0%} vs round-robin {rr['hit_rate']:.0%}, "
+        f"drain p95 ratio {drain_ratio:.2f} "
+        f"({drain['router']['router_failovers']} failover(s))",
+        file=sys.stderr, flush=True,
+    )
+    # the headline fences
+    assert scale_x >= 3.0, (
+        f"aggregate tokens/s at 4 pods only {scale_x:.2f}x one pod "
+        "(near-linear fence is 3.0x)"
+    )
+    assert aff["hit_rate"] > rr["hit_rate"], (
+        f"prefix affinity ({aff['hit_rate']:.2%}) did not beat "
+        f"round-robin spray ({rr['hit_rate']:.2%}) on prefix cache "
+        "hit rate"
+    )
+    # every drain-round request completed (none lost) and the drained
+    # pod took zero admissions after its drain point is implied by
+    # the equality + failed-send accounting; the p95 collar bounds
+    # the failover detour
+    assert drain_ratio <= 4.0, (
+        f"p95 completion latency through a pod drain blew out "
+        f"{drain_ratio:.1f}x vs steady state (fence 4.0x)"
+    )
+    assert drained_admitted < drain["router"]["requests_admitted"], (
+        "drain round routed every request at the drained pod"
+    )
+    return out
+
+
 def bench_train_step() -> dict:
     """The worker step-time fast path vs the loop it replaced
     (ISSUE 7), CPU-runnable.  Two loops over identical data from an
@@ -2688,6 +3025,18 @@ def main() -> None:
     except Exception as e:
         extras["continuous_serve_error"] = repr(e)[:200]
     _mark("continuous_serve")
+    # CPU-runnable routing-tier trend (ISSUE 12): the multi-pod front
+    # door's 1/2/4-pod open-loop sweep, affinity-vs-spray prefix hit
+    # rate, and the mid-sweep drain round — jax-free, subprocess for
+    # the hard timeout
+    try:
+        extras.update(_run_subprocess_section(
+            "bench_router_scale", timeout_s=600,
+            env={"JAX_PLATFORMS": "cpu"},
+        ))
+    except Exception as e:
+        extras["router_scale_error"] = repr(e)[:200]
+    _mark("router_scale")
     # CPU-runnable training step-loop trend (ISSUE 7): the worker fast
     # path (donation + in-flight window + async fenced checkpointing)
     # vs the loop it replaced, plus the cost-model step-time gate

@@ -37,6 +37,32 @@ class Agent:
         """Drain pending status transitions (RUNNING, FINISHED, ...)."""
         raise NotImplementedError
 
+    def advertised_port_of(self, task_name: str, agent_id=None):
+        """The HTTP port the task ACTUALLY bound, or None.
+
+        Serving workers annotate their bound port into the servestats
+        snapshot (serve/engine.py ``annotate_stats``): on a simulated
+        fleet many "hosts" share one machine, so a worker whose
+        scheduler-assigned port was taken binds an ephemeral one and
+        advertises it here — /v1/endpoints lists what is DIALABLE,
+        not what was reserved (ISSUE 12).  Default implementation
+        reads the serving snapshot; agents without serving telemetry
+        advertise nothing."""
+        reader = getattr(self, "serving_stats_of", None)
+        if not callable(reader):
+            return None
+        try:
+            stats = reader(task_name, agent_id=agent_id)
+        except TypeError:
+            stats = reader(task_name)
+        except OSError:
+            return None
+        port = stats.get("http_port") if isinstance(stats, dict) else None
+        try:
+            return int(port) if port else None
+        except (TypeError, ValueError):
+            return None
+
     # -- status listeners (event-driven scheduling) -------------------
     #
     # Agents that learn of a status asynchronously (monitor threads,

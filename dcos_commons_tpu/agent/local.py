@@ -1009,6 +1009,18 @@ class LocalProcessAgent:
             os.path.join(self._workdir, task_name, SERVESTATS_NAME)
         )
 
+    def advertised_port_of(
+        self, task_name: str, agent_id: Optional[str] = None
+    ) -> Optional[int]:
+        """The HTTP port the task actually bound (annotated into its
+        servestats snapshot): /v1/endpoints advertises THIS for
+        ``advertise: true`` ports — on a one-machine simulated fleet
+        the reserved port may be taken, and the listing must name the
+        dialable one (ISSUE 12)."""
+        from dcos_commons_tpu.agent.base import Agent
+
+        return Agent.advertised_port_of(self, task_name, agent_id)
+
     def shutdown(self) -> None:
         with self._lock:
             for task_id in list(self._tasks):

@@ -844,6 +844,69 @@ class MetricCardinalityRule(Rule):
         return out
 
 
+class RouterStatsStalenessRule(Rule):
+    """Router invariant (ISSUE 12): every pod gauge the router acts
+    on must cross the staleness gate — ``router/telemetry.py`` parses
+    raw ``GET /stats`` dicts exactly once into ``PodTelemetry`` and
+    answers load questions through freshness-aware accessors, so a
+    wedged pod's last-good numbers can never steer placement.  Any
+    OTHER router module subscripting or ``.get()``-ing a stats-named
+    dict is reaching around the gate: flagged.  Scope:
+    ``dcos_commons_tpu/router/`` except the telemetry module itself.
+    A genuinely gauge-free read (router's own snapshot assembly)
+    carries an explaining ``# sdklint: disable``."""
+
+    id = "router-stats-staleness"
+    description = "router code reads a raw stats dict outside the telemetry staleness gate"
+
+    _GATE_MODULE = "dcos_commons_tpu/router/telemetry.py"
+
+    def applies_to(self, ctx: LintContext) -> bool:
+        return (
+            ctx.tree is not None
+            and ctx.rel.startswith("dcos_commons_tpu/router/")
+            and ctx.rel != self._GATE_MODULE
+        )
+
+    @staticmethod
+    def _terminal_name(node: ast.AST):
+        if isinstance(node, ast.Name):
+            return node.id
+        if isinstance(node, ast.Attribute):
+            return node.attr
+        return None
+
+    @classmethod
+    def _is_stats_named(cls, node: ast.AST) -> bool:
+        name = cls._terminal_name(node)
+        return name is not None and "stats" in name.lower()
+
+    def check(self, ctx: LintContext) -> List[Finding]:
+        out = []
+        for node in ast.walk(ctx.tree):
+            hit = None
+            if isinstance(node, ast.Subscript) and \
+                    self._is_stats_named(node.value):
+                hit = f"{self._terminal_name(node.value)}[...]"
+            elif (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "get"
+                and self._is_stats_named(node.func.value)
+            ):
+                hit = f"{self._terminal_name(node.func.value)}.get(...)"
+            if hit is not None:
+                out.append(ctx.finding(
+                    node, self.id,
+                    f"raw stats access {hit}: parse pod gauges in "
+                    "router/telemetry.py (PodTelemetry.observe) and "
+                    "read them through its staleness-gated accessors "
+                    "— a wedged pod's last-good numbers must not "
+                    "steer placement",
+                ))
+        return out
+
+
 # metric-name prefixes whose dynamic part is bounded by something
 # other than the interpolated identifier's type — each entry states
 # the bound, which is the contract a reviewer checks when one is
@@ -864,6 +927,7 @@ def all_rules() -> List[Rule]:
         SpanLeakRule(),
         LeaseGatedMutationRule(),
         MetricCardinalityRule(),
+        RouterStatsStalenessRule(),
     ]
 
 

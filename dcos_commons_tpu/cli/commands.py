@@ -111,7 +111,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "tracker",
         choices=["offers", "plans", "taskStatuses", "reservations",
-                 "health", "events"],
+                 "health", "events", "router"],
     )
     p.add_argument(
         "--metric", default=None, metavar="NAME",

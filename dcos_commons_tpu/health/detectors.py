@@ -166,6 +166,16 @@ class ServingSloWatcher:
     ``min`` breaches below it — ``kv_pages_free`` is the paged
     engine's memory headroom, and running OUT of pages (503s with a
     kv-page-budget reason) is the breach.
+
+    STALE snapshots are discarded, not scored (ISSUE 12): a wedged
+    pod keeps mirroring its last-good gauges, and judging SLOs off
+    them would hold a dead pod "healthy" forever.  A snapshot is
+    stale when its engine-liveness stamp (``stats_age_s``: seconds
+    since the serve loop last ticked, serve/engine.py) or its
+    wall-clock write stamp (``t``) exceeds ``stale_stats_s``.  A
+    stale snapshot counts as a MISSED sample: open episodes survive
+    ``RETIRE_AFTER_MISSES`` collections (no silent recovery), then
+    retire as unmeasurable — the same contract as an absent task.
     """
 
     SIGNALS = (
@@ -188,13 +198,17 @@ class ServingSloWatcher:
         queue_depth_slo: float = 0.0,
         kv_occupancy_slo: float = 0.0,
         kv_pages_free_slo: float = 0.0,
+        stale_stats_s: float = 30.0,
     ):
         self.ttft_p95_slo_s = float(ttft_p95_slo_s)
         self.queue_depth_slo = float(queue_depth_slo)
         self.kv_occupancy_slo = float(kv_occupancy_slo)
         self.kv_pages_free_slo = float(kv_pages_free_slo)
+        # 0 disables the staleness gate (deterministic tests)
+        self.stale_stats_s = float(stale_stats_s)
         self.breaches: Dict[tuple, float] = {}  # (task, signal) -> value
         self._missed: Dict[tuple, int] = {}  # consecutive absent samples
+        self.stale_discards = 0  # snapshots discarded as stale
 
     def _threshold(self, env: Dict[str, str], knob: str, attr: str) -> float:
         raw = (env or {}).get(knob, "")
@@ -205,15 +219,42 @@ class ServingSloWatcher:
                 pass
         return getattr(self, attr)
 
+    def _is_stale(self, stats: dict, now: float) -> bool:
+        """Either liveness stamp past the horizon marks the snapshot
+        unusable: ``stats_age_s`` (the pod's own serve loop wedged)
+        or ``t`` (the mirror file stopped being rewritten — the
+        whole worker is gone but its last file survives)."""
+        if self.stale_stats_s <= 0:
+            return False
+        for key, basis in (("stats_age_s", 0.0), ("t", now)):
+            raw = stats.get(key)
+            if raw is None:
+                continue
+            try:
+                age = basis - float(raw) if key == "t" else float(raw)
+            except (TypeError, ValueError):
+                continue
+            if age > self.stale_stats_s:
+                return True
+        return False
+
     def observe(
         self,
         stats_by_task: Dict[str, dict],
         env_by_task: Optional[Dict[str, Dict[str, str]]] = None,
+        now: Optional[float] = None,
     ) -> List[dict]:
+        now = time.time() if now is None else now
         events = []
         seen = set()
         for task, stats in sorted(stats_by_task.items()):
             env = (env_by_task or {}).get(task, {})
+            if self._is_stale(stats, now):
+                # discard, do not score: last-good gauges from a
+                # wedged pod look healthy precisely when it is not.
+                # The open episodes ride the missed-sample counter.
+                self.stale_discards += 1
+                continue
             for signal, knob, attr, direction in self.SIGNALS:
                 threshold = self._threshold(env, knob, attr)
                 if threshold <= 0 or signal not in stats:

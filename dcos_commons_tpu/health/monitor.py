@@ -315,6 +315,9 @@ class HealthMonitor:
                         self.slo.breaches.items()
                     )
                 ],
+                # snapshots discarded unscored because their liveness
+                # stamps went stale (a wedged pod's last-good gauges)
+                "stale_discards": self.slo.stale_discards,
             },
             "serving": self._serving_stats,
             "journal": self.journal.describe(),

@@ -409,13 +409,42 @@ class SchedulerApi:
 
     # -- endpoints (reference: http/endpoints/EndpointsResource) ------
 
-    def _endpoint_map(self) -> Dict[str, List[str]]:
-        """port name -> ["host:port", ...] over all running tasks, plus
-        TPU pod coordinator addresses under "coordinator"."""
-        out: Dict[str, List[str]] = {}
+    def endpoints_generation(self) -> str:
+        """Change stamp of the endpoint surface: reservations (ports
+        move with claims) + the task subtree (launches, statuses,
+        pause overrides — and advertised ports, which only change
+        across a relaunch, i.e. a task mutation).  A router polling
+        discovery compares this and skips the rebuild on a quiet
+        fleet (the PR 9 generation discipline, ISSUE 12)."""
         ledger = self._scheduler.ledger
+        store = self._scheduler.state_store
+        task_gen = getattr(store, "task_generation", "")
+        return f"{ledger.epoch}.{ledger.generation}/{task_gen}"
+
+    def _assemble_endpoints(self):
+        """One walk building both surfaces: port name -> ["host:port",
+        ...] (plus TPU coordinator addresses under "coordinator"),
+        and per-endpoint BACKEND rows carrying the task, its state,
+        and whether it is draining — what a routing tier needs beyond
+        bare addresses.
+
+        Cost per call: O(THIS service's tasks) store reads (the same
+        order as pod_statuses), plus one agent servestats read per
+        ``advertise: true`` task — serve pods only, so a router's
+        per-second discovery poll stays bounded by the serve pod
+        count, never the fleet.  The stamp skips the ROUTER-side
+        rebuild; caching the assembly scheduler-side would need the
+        advertised ports folded into the base counters first (they
+        are read live, outside them)."""
+        out: Dict[str, List[str]] = {}
+        backends: Dict[str, List[Dict[str, Any]]] = {}
+        ledger = self._scheduler.ledger
+        store = self._scheduler.state_store
         hosts = {h.host_id: h for h in self._scheduler.inventory.hosts()}
-        for info in self._scheduler.state_store.fetch_tasks():
+        port_reader = getattr(
+            self._scheduler.agent, "advertised_port_of", None
+        )
+        for info in store.fetch_tasks():
             host = hosts.get(info.agent_id)
             hostname = host.hostname if host else info.agent_id
             pod = None
@@ -436,15 +465,50 @@ class SchedulerApi:
                 )
             except Exception:
                 task_spec = None
+            status = store.fetch_status(info.name)
+            override, _progress = store.fetch_goal_override(info.name)
+            state = status.state.value if status else None
+            ready = bool(status.ready) if status else False
+            # a backend is DRAINING when it should receive no new
+            # requests: paused (decommission/pause rides the override),
+            # not running, or not yet warm — the router's drain signal
+            draining = (
+                override is not GoalStateOverride.NONE
+                or state != "TASK_RUNNING"
+                or not ready
+            )
+            advertised: Optional[int] = None
+            advertised_read = False
             reservations = list(ledger.for_task(info.name))
             for reservation in reservations:
                 port_specs = (
                     task_spec.resources.ports if task_spec is not None else []
                 )
                 for port_spec, port in zip(port_specs, reservation.ports):
-                    out.setdefault(port_spec.name, []).append(
-                        f"{hostname}:{port}"
-                    )
+                    if port_spec.advertise and callable(port_reader):
+                        # the worker's actually-bound port (servestats
+                        # annotation) wins over the reserved one: the
+                        # listing names what is DIALABLE.  One read
+                        # per task, shared by its advertise ports.
+                        if not advertised_read:
+                            advertised_read = True
+                            try:
+                                advertised = port_reader(
+                                    info.name, agent_id=info.agent_id
+                                )
+                            except OSError:
+                                advertised = None
+                        if advertised:
+                            port = advertised
+                    address = f"{hostname}:{port}"
+                    out.setdefault(port_spec.name, []).append(address)
+                    backends.setdefault(port_spec.name, []).append({
+                        "address": address,
+                        "task": info.name,
+                        "state": state,
+                        "ready": ready,
+                        "draining": draining,
+                    })
                     if port_spec.vip:
                         # VIP discovery (reference: NamedVIPEvaluation
                         # Stage + EndpointUtils VIP listing): clients
@@ -452,8 +516,15 @@ class SchedulerApi:
                         # backend set; "web:80" lists under "vip:web"
                         vip_name = port_spec.vip.split(":", 1)[0]
                         out.setdefault(f"vip:{vip_name}", []).append(
-                            f"{hostname}:{port}"
+                            address
                         )
+                        backends.setdefault(f"vip:{vip_name}", []).append({
+                            "address": address,
+                            "task": info.name,
+                            "state": state,
+                            "ready": ready,
+                            "draining": draining,
+                        })
             # stable DNS-style names (reference: DiscoveryInfo +
             # EndpointUtils listing <task>.<svc>.<tld> names; the
             # `discovery: prefix:` override renames the task part, and
@@ -491,16 +562,48 @@ class SchedulerApi:
             # web-url.yml analogue: the service's UI advertised with
             # its endpoints (reference: webui_url in FrameworkInfo)
             out.setdefault("web", []).append(self._scheduler.spec.web_url)
-        return out
+        return out, backends
+
+    def _endpoint_map(self) -> Dict[str, List[str]]:
+        """port name -> ["host:port", ...] (the original surface)."""
+        return self._assemble_endpoints()[0]
 
     def list_endpoints(self) -> Response:
         return 200, sorted(self._endpoint_map().keys())
 
     def get_endpoint(self, name: str) -> Response:
-        entries = self._endpoint_map().get(name)
-        if entries is None:
+        """One endpoint's addresses, its backend rows (task, state,
+        draining — the routing tier's discovery contract), and the
+        generation stamp a poller compares to skip quiet refreshes."""
+        import hashlib as _hashlib
+        import json as _json
+
+        entries, backends = self._assemble_endpoints()
+        addresses = entries.get(name)
+        if addresses is None:
             return 404, {"message": f"no endpoint {name}"}
-        return 200, {"name": name, "address": sorted(entries)}
+        body: Dict[str, Any] = {
+            "name": name,
+            "address": sorted(addresses),
+        }
+        rows = backends.get(name)
+        if rows:
+            body["backends"] = sorted(
+                rows, key=lambda r: (r["task"], r["address"])
+            )
+        # the stamp covers exactly what a poller CONSUMES: the base
+        # task/reservation generations plus a fingerprint of this
+        # endpoint's assembled surface.  Advertised ports are read
+        # live (outside the base counters), so without the
+        # fingerprint a transiently-failed servestats read could
+        # hand out a wrong address that an equal stamp then caches
+        # at the router until unrelated churn
+        surface = _hashlib.sha256(_json.dumps(
+            [body["address"], body.get("backends", [])],
+            sort_keys=True,
+        ).encode("utf-8")).hexdigest()[:12]
+        body["generation"] = f"{self.endpoints_generation()}+{surface}"
+        return 200, body
 
     # -- artifacts (reference: http/endpoints/ArtifactResource:50) ----
 
@@ -677,6 +780,29 @@ class SchedulerApi:
             if stats:
                 out[info.name] = stats
         return 200, {"serving": out}
+
+    def debug_router(self) -> Response:
+        """Serving-front-door state: every router task's gauge
+        snapshot (pod set size, draining/failed counts, affinity hit
+        rate, retries/failovers, latency percentiles — router/core.py
+        ``stats()``), split out of the serving merge by the
+        ``router_pods`` marker key, plus the endpoint generation the
+        routers' discovery is tracking.  The prefix-affinity triage
+        surface (operations-guide "Serving front door")."""
+        reader = getattr(self._scheduler.agent, "serving_stats_of", None)
+        routers: Dict[str, dict] = {}
+        if callable(reader):
+            for info in self._scheduler.state_store.fetch_tasks():
+                try:
+                    stats = reader(info.name)
+                except OSError:
+                    continue
+                if isinstance(stats, dict) and "router_pods" in stats:
+                    routers[info.name] = stats
+        return 200, {
+            "routers": routers,
+            "endpoints_generation": self.endpoints_generation(),
+        }
 
     def _collect_steplogs(self) -> Dict[str, List[dict]]:
         """Worker step telemetry, merged from task sandboxes when the

@@ -116,6 +116,10 @@ def build_routes(api: SchedulerApi) -> List[Route]:
         # active slots, KV occupancy, tokens/s) merged from sandboxes
         r("GET", r"/v1/debug/serving",
           lambda m, q: api.debug_serving()),
+        # serving front door: per-router gauge snapshots (pod set,
+        # affinity hit rate, failovers) + the endpoint generation
+        r("GET", r"/v1/debug/router",
+          lambda m, q: api.debug_router()),
         # fleet health plane: detector states, suspect hosts, metric
         # history (?metric=<name> for one full series)
         r("GET", r"/v1/debug/health",

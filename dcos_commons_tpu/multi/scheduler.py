@@ -91,6 +91,16 @@ class _ServiceAgentAdapter:
         return reader(task_name, agent_id=agent_id) if agent_id \
             else reader(task_name)
 
+    def advertised_port_of(self, task_name, agent_id=None):
+        # the /v1/endpoints `advertise: true` contract (ISSUE 12):
+        # without this forward, multi mode would list the reserved
+        # port even when the worker bound (and advertised) another
+        reader = getattr(self._agent, "advertised_port_of", None)
+        if not callable(reader):
+            return None
+        return reader(task_name, agent_id=agent_id) if agent_id \
+            else reader(task_name)
+
 
 class _MergedLedgerView:
     """Union view over every service's reservation ledger, handed to

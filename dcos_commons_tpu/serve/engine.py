@@ -127,6 +127,7 @@ class SlotEngine:
         stats_path: Optional[str] = None,
         stats_every_s: float = 1.0,
         log: Optional[Callable[[str], None]] = None,
+        extra_stats: Optional[dict] = None,
     ):
         if slots < 1:
             raise ValueError(f"slot pool needs >= 1 slot, got {slots}")
@@ -162,6 +163,19 @@ class SlotEngine:
         self._rate: deque = deque()  # (monotonic, tokens) per tick
         self._merge_logged = False
         self._stats_written = 0.0  # loop-thread only
+        # stats consumers may annotate the snapshot with facts the
+        # engine cannot know (the worker's actually-bound HTTP port:
+        # the /v1/endpoints advertisement, ISSUE 12).  Constructor-
+        # passed extras precede the loop thread's first flush, so the
+        # sandbox snapshot carries them from its very first write
+        self._extra_stats: dict = dict(extra_stats or {})
+        # loop-liveness stamp for the stats_age_s gauge: the router
+        # and HealthMonitor discard gauges whose engine stopped
+        # ticking instead of balancing on a wedged pod's last-good
+        # numbers.  Stamped at every loop wake AND at submit-time
+        # enqueue (an idle engine is trivially responsive — its age
+        # must start at the arrival, not at the end of the idle gap)
+        self._last_tick_mono = time.monotonic()
         self._thread = threading.Thread(
             target=self._loop, name="slot-engine", daemon=True
         )
@@ -212,6 +226,11 @@ class SlotEngine:
         ]
         group.remaining = len(group.rows)
         with self._cv:
+            now = time.monotonic()
+            if not self._has_work_locked():
+                # idle -> working transition: liveness is measured
+                # from THIS arrival, not across the idle gap
+                self._last_tick_mono = now
             self._queue.extend(group.rows)
             self._cv.notify_all()
         # the timeout bounds SATURATION, not a healthy generation: a
@@ -270,11 +289,27 @@ class SlotEngine:
 
     # -- telemetry ---------------------------------------------------
 
+    def annotate_stats(self, **extra) -> None:
+        """Attach static facts to every future ``stats()`` snapshot
+        (the worker's actually-bound ``http_port``; anything the
+        engine itself cannot know).  Keys must not collide with the
+        engine's own gauges."""
+        with self._cv:
+            self._extra_stats.update(extra)
+
     def stats(self) -> dict:
         """Serving-load snapshot (the per-pod gauges ROADMAP item 2
         names as the scale-out signal)."""
         now = time.monotonic()
         with self._cv:
+            # loop-liveness stamp: 0 while idle (a parked loop is
+            # trivially responsive; admission wakes it), else the
+            # time since the loop last proved alive — the wedge
+            # signal the router's staleness gate keys on
+            stats_age = (
+                max(0.0, now - self._last_tick_mono)
+                if self._has_work_locked() else 0.0
+            )
             live_tokens = self._live_tokens_locked()
             window = [n for (t, n) in self._rate
                       if t > now - _RATE_WINDOW_S]
@@ -307,7 +342,9 @@ class SlotEngine:
                 ),
                 "tokens_out": self._tokens_out,
             }
+            out["stats_age_s"] = round(stats_age, 4)
             out.update(self._stats_extra_locked())
+            out.update(self._extra_stats)
         if ttft:
             from dcos_commons_tpu.metrics.registry import percentile
 
@@ -352,6 +389,7 @@ class SlotEngine:
             flush_now = False
             admits: List[_Row] = []
             with self._cv:
+                self._last_tick_mono = time.monotonic()
                 while not self._has_work_locked() and not self._stopped:
                     if not flushed_idle:
                         # flush the terminal snapshot before parking:
@@ -365,8 +403,10 @@ class SlotEngine:
                         break
                     if self._on_idle is None:
                         self._cv.wait()
+                        self._last_tick_mono = time.monotonic()
                     else:
                         self._cv.wait(timeout=self._idle_every_s)
+                        self._last_tick_mono = time.monotonic()
                         if not self._has_work_locked():
                             break  # fire on_idle OUTSIDE the lock
                 if self._stopped:

@@ -108,6 +108,11 @@ class PortSpec:
     port: int = 0                    # 0 = dynamically assigned
     vip: str = ""                    # "name:port" service VIP
     env_key: str = ""                # env var to expose the port under
+    # endpoints list the port the worker ACTUALLY bound (advertised
+    # via its servestats snapshot) instead of the reserved one — for
+    # HTTP servers that fall back to an ephemeral bind when the
+    # assigned port is taken on a shared machine (ISSUE 12)
+    advertise: bool = False
 
 
 @dataclass(frozen=True)

@@ -325,6 +325,7 @@ def _map_task(
                 port=int(port_raw.get("port", 0)),
                 vip=str(port_raw.get("vip", "")),
                 env_key=str(port_raw.get("env-key", "")),
+                advertise=_truthy(port_raw.get("advertise", False)),
             )
         )
     hc_raw = raw.get("health-check")

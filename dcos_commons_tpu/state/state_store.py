@@ -60,6 +60,28 @@ class StateStore:
         # decoding ~1000 identical JSON blobs per cycle was the
         # scheduler loop's single largest cost.
         self._decode_cache: Dict[str, tuple] = {}
+        # task-subtree change stamp for generation-stamped readers
+        # (the /v1/endpoints discovery contract, ISSUE 12): every
+        # info/status/override mutation bumps it, so a quiet fleet's
+        # endpoint poll is one compare.  Per-OBJECT counter + epoch,
+        # the ReservationLedger discipline: a rebuilt store (failover,
+        # live update) re-bases counters under a fresh epoch so stale
+        # stamps can never alias
+        import uuid as _uuid
+
+        self._task_mutation = 0
+        self._task_epoch = _uuid.uuid4().hex[:12]
+
+    @property
+    def task_generation(self) -> str:
+        """Opaque change stamp of the task subtree (epoch-qualified
+        mutation counter): equal stamps guarantee an identical task/
+        status/override set, so endpoint discovery can skip rebuilds."""
+        with self._lock:
+            return f"{self._task_epoch}.{self._task_mutation}"
+
+    def _bump_task_generation_locked(self) -> None:
+        self._task_mutation += 1
 
     @property
     def persister(self) -> Persister:
@@ -85,6 +107,7 @@ class StateStore:
                 for info in infos
             ]
             self._persister.apply(ops)
+            self._bump_task_generation_locked()
 
     def _decode(self, path: str, raw: bytes, decoder):
         with self._lock:
@@ -131,6 +154,7 @@ class StateStore:
             self._persister.set(
                 self._task_path(task_name, "status"), status.to_bytes()
             )
+            self._bump_task_generation_locked()
             return True
 
     def fetch_status(self, task_name: str) -> Optional[TaskStatus]:
@@ -166,6 +190,7 @@ class StateStore:
             ops.append(SetOp(self._task_path(info.name, "status"), status.to_bytes()))
         with self._lock:
             self._persister.apply(ops)
+            self._bump_task_generation_locked()
 
     # -- task removal (decommission / GC) ----------------------------
 
@@ -182,6 +207,7 @@ class StateStore:
                 self._decode_cache.pop(
                     self._task_path(task_name, leaf), None
                 )
+            self._bump_task_generation_locked()
 
     # -- goal-state overrides (pod pause/resume) ----------------------
 
@@ -195,6 +221,8 @@ class StateStore:
             {"override": override.value, "progress": progress.value}
         ).encode("utf-8")
         self._persister.set(self._task_path(task_name, "override"), payload)
+        with self._lock:
+            self._bump_task_generation_locked()
 
     def fetch_goal_override(
         self, task_name: str

@@ -471,27 +471,6 @@ def main() -> int:
         stats_path = os.path.join(
             os.environ.get("SANDBOX", "."), SERVESTATS_NAME
         )
-        if paged is not None:
-            engine = PagedEngine(
-                paged_prefill_fn, paged_decode_fn, slots, max_len,
-                prompt_len,
-                page_tokens=paged.page_tokens, pages=paged.pages,
-                chunk_tokens=paged.chunk_tokens,
-                prefix_cache=paged.prefix_cache,
-                queue_timeout_s=queue_timeout_s,
-                on_idle=paged_idle_tick, idle_every_s=IDLE_TICK_S,
-                stats_path=stats_path,
-                log=lambda msg: print(msg, flush=True),
-            )
-        else:
-            engine = SlotEngine(
-                prefill_fn, decode_fn, slots, max_len, prompt_len,
-                queue_timeout_s=queue_timeout_s,
-                on_idle=idle_tick, idle_every_s=IDLE_TICK_S,
-                stats_path=stats_path,
-                log=lambda msg: print(msg, flush=True),
-            )
-        engine.register_metrics(metrics)
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, fmt, *args):
@@ -571,8 +550,45 @@ def main() -> int:
                 self.end_headers()
                 self.wfile.write(payload)
 
+        # bind BEFORE building the engine: the actually-bound port
+        # rides the engine's first stats flush (the /v1/endpoints
+        # `advertise: true` contract); on a shared machine a taken
+        # assigned port falls back to an ephemeral bind + advertise
         port = int(os.environ.get("PORT_HTTP", "0"))
-        server = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+        try:
+            server = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+        except OSError:
+            server = ThreadingHTTPServer(("0.0.0.0", 0), Handler)
+            print(
+                f"rank 0: port {port} in use; bound "
+                f"{server.server_address[1]} instead (advertised via "
+                "servestats)",
+                flush=True,
+            )
+        bound_port = int(server.server_address[1])
+        if paged is not None:
+            engine = PagedEngine(
+                paged_prefill_fn, paged_decode_fn, slots, max_len,
+                prompt_len,
+                page_tokens=paged.page_tokens, pages=paged.pages,
+                chunk_tokens=paged.chunk_tokens,
+                prefix_cache=paged.prefix_cache,
+                queue_timeout_s=queue_timeout_s,
+                on_idle=paged_idle_tick, idle_every_s=IDLE_TICK_S,
+                stats_path=stats_path,
+                log=lambda msg: print(msg, flush=True),
+                extra_stats={"http_port": bound_port},
+            )
+        else:
+            engine = SlotEngine(
+                prefill_fn, decode_fn, slots, max_len, prompt_len,
+                queue_timeout_s=queue_timeout_s,
+                on_idle=idle_tick, idle_every_s=IDLE_TICK_S,
+                stats_path=stats_path,
+                log=lambda msg: print(msg, flush=True),
+                extra_stats={"http_port": bound_port},
+            )
+        engine.register_metrics(metrics)
         with open("ready", "w") as f:
             f.write("warm\n")
         shape = (

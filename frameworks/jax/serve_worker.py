@@ -127,34 +127,6 @@ def main() -> int:
         os.environ.get("SANDBOX", "."), SERVESTATS_NAME
     )
     paged = paged_config_from_env(os.environ)
-    if paged is not None:
-        # the paged arena (ISSUE 11): page-budgeted admission,
-        # chunked prefill, prefix caching — the serving default
-        pool = PagedPoolModel(
-            config, params, slots, max_len, paged.page_tokens,
-            paged.pages, paged.chunk_tokens, kv_dtype=kv_dtype,
-        )
-        engine = PagedEngine(
-            pool.prefill_chunk, pool.decode, slots, max_len,
-            prompt_len,
-            page_tokens=paged.page_tokens, pages=paged.pages,
-            chunk_tokens=paged.chunk_tokens,
-            prefix_cache=paged.prefix_cache,
-            queue_timeout_s=queue_timeout_s, stats_path=stats_path,
-            log=lambda msg: print(msg, flush=True),
-        )
-    else:
-        # KV_PAGE_TOKENS=0: the PR 6 slot pool, kept as the
-        # operator's escape hatch and the bench baseline
-        pool = PoolModel(
-            config, params, slots, max_len, kv_dtype=kv_dtype
-        )
-        engine = SlotEngine(
-            pool.prefill, pool.decode, slots, max_len, prompt_len,
-            queue_timeout_s=queue_timeout_s, stats_path=stats_path,
-            log=lambda msg: print(msg, flush=True),
-        )
-    engine.register_metrics(metrics)
 
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, fmt, *args):
@@ -244,10 +216,55 @@ def main() -> int:
         os.remove("ready")
     except OSError:
         pass
-    # bind BEFORE warming and only then write the readiness file — a
-    # bind failure (port collision) must fail readiness, not pass it
+    # bind BEFORE building the engine: the port actually bound is
+    # annotated into the engine's very first stats snapshot, which is
+    # what /v1/endpoints advertises for `advertise: true` ports — and
+    # a hard bind failure still fails readiness, not the first client
     port = int(os.environ.get("PORT_HTTP", "0"))
-    server = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+    try:
+        server = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+    except OSError:
+        # the scheduler-assigned port is taken on this machine (a
+        # simulated fleet runs many "hosts" on one box): bind an
+        # ephemeral port and ADVERTISE it instead of crash-looping
+        server = ThreadingHTTPServer(("0.0.0.0", 0), Handler)
+        print(
+            f"port {port} in use; bound {server.server_address[1]} "
+            "instead (advertised via servestats)",
+            flush=True,
+        )
+    bound_port = int(server.server_address[1])
+
+    if paged is not None:
+        # the paged arena (ISSUE 11): page-budgeted admission,
+        # chunked prefill, prefix caching — the serving default
+        pool = PagedPoolModel(
+            config, params, slots, max_len, paged.page_tokens,
+            paged.pages, paged.chunk_tokens, kv_dtype=kv_dtype,
+        )
+        engine = PagedEngine(
+            pool.prefill_chunk, pool.decode, slots, max_len,
+            prompt_len,
+            page_tokens=paged.page_tokens, pages=paged.pages,
+            chunk_tokens=paged.chunk_tokens,
+            prefix_cache=paged.prefix_cache,
+            queue_timeout_s=queue_timeout_s, stats_path=stats_path,
+            log=lambda msg: print(msg, flush=True),
+            extra_stats={"http_port": bound_port},
+        )
+    else:
+        # KV_PAGE_TOKENS=0: the PR 6 slot pool, kept as the
+        # operator's escape hatch and the bench baseline
+        pool = PoolModel(
+            config, params, slots, max_len, kv_dtype=kv_dtype
+        )
+        engine = SlotEngine(
+            pool.prefill, pool.decode, slots, max_len, prompt_len,
+            queue_timeout_s=queue_timeout_s, stats_path=stats_path,
+            log=lambda msg: print(msg, flush=True),
+            extra_stats={"http_port": bound_port},
+        )
+    engine.register_metrics(metrics)
     if paged is not None:
         pool.warm()
         shape = (

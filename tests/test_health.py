@@ -308,6 +308,45 @@ def test_slo_watcher_env_thresholds_and_episodes():
     assert watcher.breaches == {}
 
 
+def test_slo_watcher_discards_stale_snapshots_unscored():
+    """ISSUE 12: a wedged pod keeps mirroring its last-good gauges —
+    the watcher must not score them (neither alert nor silently clear
+    an open episode); staleness rides the engine's stats_age_s stamp
+    or the snapshot's wall write stamp."""
+    watcher = ServingSloWatcher(ttft_p95_slo_s=1.0, stale_stats_s=10.0)
+    breaching = {"web-0-srv": {"ttft_p95_s": 2.5, "stats_age_s": 0.0}}
+    assert [e["signal"] for e in watcher.observe(breaching)] == \
+        ["ttft_p95_s"]
+    # the pod wedges: gauges FREEZE at breach values, age grows — the
+    # snapshot is discarded, the episode survives as a missed sample
+    stale = {"web-0-srv": {"ttft_p95_s": 2.5, "stats_age_s": 60.0}}
+    assert watcher.observe(stale) == []
+    assert ("web-0-srv", "ttft_p95_s") in watcher.breaches
+    assert watcher.stale_discards == 1
+    # a stale LOOKS-HEALTHY snapshot must not clear the episode either
+    stale_ok = {"web-0-srv": {"ttft_p95_s": 0.1, "stats_age_s": 60.0}}
+    assert watcher.observe(stale_ok) == []
+    assert ("web-0-srv", "ttft_p95_s") in watcher.breaches
+    # wall-stamp staleness: a mirror file that stopped being
+    # rewritten (worker gone, file survives) discards the same way —
+    # and as the RETIRE_AFTER_MISSES-th consecutive miss it retires
+    # the episode unmeasured, exactly like an absent task
+    assert ServingSloWatcher.RETIRE_AFTER_MISSES == 3
+    old_file = {"web-0-srv": {"ttft_p95_s": 2.5, "t": 100.0}}
+    assert watcher.observe(old_file, now=200.0) == []
+    assert watcher.stale_discards == 3
+    assert watcher.breaches == {}
+    # a FRESH recovery still clears normally (gate off the hot path)
+    events = watcher.observe(breaching)
+    assert len(events) == 1 and not events[0].get("cleared")
+    fresh_ok = {"web-0-srv": {"ttft_p95_s": 0.1, "stats_age_s": 0.0}}
+    assert [e.get("cleared") for e in watcher.observe(fresh_ok)] == \
+        [True]
+    # stale_stats_s=0 disables the gate (deterministic callers)
+    ungated = ServingSloWatcher(ttft_p95_slo_s=1.0, stale_stats_s=0)
+    assert ungated.observe(stale)  # scored despite the age
+
+
 def test_lease_churn_watcher_flags_flapping_not_failover():
     watcher = LeaseChurnWatcher(churn_n=3, window_s=100.0)
     # one routine failover: no alert

@@ -239,6 +239,108 @@ def test_debug_serving_route(deployed):
         scheduler.agent = original
 
 
+ADVERTISE_YAML = """
+name: adv-svc
+pods:
+  server:
+    count: 2
+    tasks:
+      api:
+        goal: RUNNING
+        cmd: "serve"
+        cpus: 0.1
+        memory: 32
+        ports:
+          http:
+            env-key: PORT_HTTP
+            vip: "inference:80"
+            advertise: true
+"""
+
+
+def test_endpoint_advertised_ports_generation_and_backends():
+    """The routing-tier discovery contract (ISSUE 12): `advertise:
+    true` ports list the worker's actually-bound port (servestats
+    annotation via the agent), the body carries backend rows with
+    drain state, and the generation stamp moves only when the task/
+    reservation surface does."""
+    runner = ServiceTestRunner(ADVERTISE_YAML)
+    runner.run([
+        AdvanceCycles(1),
+        SendTaskRunning("server-0-api"),
+        AdvanceCycles(1),
+        SendTaskRunning("server-1-api"),
+        ExpectDeploymentComplete(),
+    ])
+    scheduler = runner.world.scheduler
+    server = ApiServer(scheduler).start()
+
+    class _AdvertisingAgent:
+        def advertised_port_of(self, task_name, agent_id=None):
+            return 4242 if task_name == "server-0-api" else None
+
+    original = scheduler.agent
+    scheduler.agent = _AdvertisingAgent()
+    try:
+        ep = get(server, "/v1/endpoints/vip:inference")
+        assert ep["generation"]
+        # server-0 advertises its real bind; server-1 keeps the
+        # reserved port (no annotation -> reservation fallback)
+        by_task = {row["task"]: row for row in ep["backends"]}
+        assert by_task["server-0-api"]["address"].endswith(":4242")
+        assert not by_task["server-1-api"]["address"].endswith(":4242")
+        assert by_task["server-0-api"]["draining"] is False
+        assert set(ep["address"]) == {
+            by_task["server-0-api"]["address"],
+            by_task["server-1-api"]["address"],
+        }
+        # quiet fleet: the stamp is stable across reads...
+        gen = ep["generation"]
+        assert get(server, "/v1/endpoints/vip:inference")["generation"] \
+            == gen
+        # ...and moves on a task mutation (pause -> draining backend)
+        post(server, "/v1/pod/server-1/pause")
+        ep2 = get(server, "/v1/endpoints/vip:inference")
+        assert ep2["generation"] != gen
+        by_task2 = {row["task"]: row for row in ep2["backends"]}
+        assert by_task2["server-1-api"]["draining"] is True
+    finally:
+        scheduler.agent = original
+        server.stop()
+
+
+def test_debug_router_route(deployed):
+    """Front-door state surface: router tasks split out of the
+    serving merge by the router_pods marker; the endpoint generation
+    rides along for discovery triage."""
+    runner, server = deployed
+    body = get(server, "/v1/debug/router")
+    assert body["routers"] == {}
+    assert body["endpoints_generation"]
+
+    router_stats = {
+        "router_pods": 3, "router_affinity_hit_rate": 0.8,
+        "queue_depth": 2, "stats_age_s": 0.0,
+    }
+    serve_stats = {"queue_depth": 1, "active_slots": 2}
+
+    class _MixedAgent:
+        def serving_stats_of(self, task_name):
+            if task_name == "web-0-srv":
+                return dict(router_stats)
+            return dict(serve_stats)
+
+    scheduler = runner.world.scheduler
+    original = scheduler.agent
+    scheduler.agent = _MixedAgent()
+    try:
+        body = get(server, "/v1/debug/router")
+        # only the router task appears; plain serve gauges stay out
+        assert body["routers"] == {"web-0-srv": router_stats}
+    finally:
+        scheduler.agent = original
+
+
 def test_plan_verbs_over_http(deployed):
     runner, server = deployed
     # a COMPLETE plan stays COMPLETE through interrupt/continue

@@ -469,6 +469,62 @@ def test_rule_metric_cardinality(tmp_path):
         rules_mod.METRIC_CARDINALITY_ALLOWLIST = original
 
 
+def test_rule_router_stats_staleness(tmp_path):
+    """Router code reaching into raw stats dicts bypasses the
+    telemetry staleness gate (ISSUE 12): subscripts and .get() on
+    stats-named receivers are flagged in router/ — except inside
+    telemetry.py, the gate itself."""
+    src = """
+    def pick(pods):
+        for pod in pods:
+            depth = pod.stats["queue_depth"]
+            free = pod.last_stats.get("kv_pages_free", 0)
+        return depth, free
+    """
+    findings, _ = _lint_fixture(
+        tmp_path, src, rel="dcos_commons_tpu/router/core.py",
+        rule_id="router-stats-staleness",
+    )
+    assert len(findings) == 2
+    assert "staleness" in findings[0].message
+    # the gate module itself is the one legitimate parser
+    findings, _ = _lint_fixture(
+        tmp_path, src, rel="dcos_commons_tpu/router/telemetry.py",
+        rule_id="router-stats-staleness",
+    )
+    assert not findings
+    # code OUTSIDE router/ is out of scope (the serve engine builds
+    # its own stats dicts all day)
+    findings, _ = _lint_fixture(
+        tmp_path, src, rel="dcos_commons_tpu/serve/engine.py",
+        rule_id="router-stats-staleness",
+    )
+    assert not findings
+    # non-stats dicts and gauge METHOD calls stay clean
+    ok = """
+    def pick(router, body):
+        rows = body["tokens"]
+        snapshot = router.stats()
+        return rows, snapshot
+    """
+    findings, _ = _lint_fixture(
+        tmp_path, ok, rel="dcos_commons_tpu/router/core.py",
+        rule_id="router-stats-staleness",
+    )
+    assert not findings
+    # the documented waiver form
+    suppressed_src = """
+    def mirror(stats):
+        return stats["t"]  # sdklint: disable=router-stats-staleness — writing our OWN snapshot, not a pod's
+    """
+    findings, suppressed = _lint_fixture(
+        tmp_path, suppressed_src,
+        rel="dcos_commons_tpu/router/core.py",
+        rule_id="router-stats-staleness",
+    )
+    assert not findings and len(suppressed) == 1
+
+
 def test_file_level_suppression(tmp_path):
     src = (
         "# sdklint: disable-file=no-blocking-sleep — tick harness\n"
