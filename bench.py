@@ -1038,6 +1038,86 @@ def bench_failover() -> dict:
     }
 
 
+def bench_preemption_recovery() -> dict:
+    """Preemption -> gang recovery latency (ISSUE 13) at 64 hosts.
+
+    Two scenarios over a 16-slice/64-host fleet with one 4-host
+    tpu-gang trainer (FakeAgent — control-plane latency, no jax):
+
+      preemption_resume_s       single gang-host kill -> the WHOLE
+                                gang relaunched and RUNNING again
+                                (kill survivors, unreserve the broken
+                                sub-slice, re-place honoring torus
+                                adjacency on a spare slice, statuses
+                                acked) — "time to training resumed"
+                                at the scheduler's granularity
+      preemption_storm_s        a 4-kill storm (2 at once, a third
+                                mid-recovery, a fourth at a plan-
+                                transition boundary) -> converged
+                                with the storm invariants held (zero
+                                double-reservations, zero orphaned
+                                reservations on preempted hosts,
+                                exactly one gang incarnation running)
+
+    Wall budgets are generous CI fences (shared boxes swing), not
+    perf claims: the point is that recovery converges in control-
+    plane time, not operator time."""
+    from dcos_commons_tpu.offer.inventory import make_test_fleet
+    from dcos_commons_tpu.testing.chaos import (
+        RECOVERY_ACTIVE,
+        STORM_START,
+        PreemptSpec,
+        PreemptionStorm,
+    )
+
+    def fleet():
+        hosts = []
+        for s in range(16):  # 64 TPU hosts, 16 placeable slices
+            hosts.extend(make_test_fleet(
+                slice_id=f"pod-{s}", host_grid=(2, 2), chip_block=(2, 2),
+                cpus=16.0, memory_mb=65536,
+            ))
+        return hosts
+
+    # single gang-host preemption
+    storm = PreemptionStorm(
+        [PreemptSpec(at=STORM_START, hosts=1)], hosts=fleet(),
+    )
+    t0 = time.monotonic()
+    report = storm.run(timeout_s=60.0)
+    single_s = time.monotonic() - t0
+    single_cycles = report.cycles
+    storm.shutdown()
+
+    # 4-kill storm: 2 simultaneous, 1 mid-recovery, 1 at a span
+    # boundary the recovery work itself causes
+    storm = PreemptionStorm(
+        [
+            PreemptSpec(at=STORM_START, hosts=2),
+            PreemptSpec(at=RECOVERY_ACTIVE, occurrence=1, hosts=1),
+            PreemptSpec(at="mid-plan-transition", occurrence=2, hosts=1),
+        ],
+        hosts=fleet(),
+    )
+    t0 = time.monotonic()
+    storm_report = storm.run(timeout_s=120.0)
+    storm_s = time.monotonic() - t0
+    storm.shutdown()
+
+    assert report.converged and storm_report.converged
+    assert single_s < 10.0, f"single-kill resume took {single_s:.1f}s"
+    assert storm_s < 30.0, f"4-kill storm took {storm_s:.1f}s"
+    return {
+        "preemption_hosts": 64,
+        "preemption_resume_s": round(single_s, 3),
+        "preemption_resume_cycles": single_cycles,
+        "preemption_storm_kills": len(storm_report.preempted),
+        "preemption_storm_s": round(storm_s, 3),
+        "preemption_storm_cycles": storm_report.cycles,
+        "preemption_storm_converged": storm_report.converged,
+    }
+
+
 def bench_continuous_serve() -> dict:
     """Continuous batching vs dispatch-per-group serving (ISSUE 6),
     CPU-runnable: the SAME open-loop load — staggered arrivals, mixed
@@ -3013,6 +3093,14 @@ def main() -> None:
     except Exception as e:
         extras["failover_error"] = repr(e)[:200]
     _mark("failover")
+    # preemption -> gang recovery latency (ISSUE 13): single gang-host
+    # kill to training-resumed, and a 4-kill storm (incl. mid-recovery
+    # and span-boundary kills) to convergence, invariants asserted
+    try:
+        extras.update(bench_preemption_recovery())
+    except Exception as e:
+        extras["preemption_error"] = repr(e)[:200]
+    _mark("preemption_recovery")
     # CPU-runnable serving data-plane trend (ISSUE 6): subprocess so
     # the forced-cpu jax init cannot leak into the chip sections
     try:

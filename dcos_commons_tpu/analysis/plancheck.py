@@ -51,6 +51,7 @@ from dcos_commons_tpu.plan.phase import Phase
 from dcos_commons_tpu.plan.plan import Plan
 from dcos_commons_tpu.plan.status import Status, aggregate
 from dcos_commons_tpu.plan.step import (
+    ActionStep,
     DeploymentStep,
     PodInstanceRequirement,
 )
@@ -87,7 +88,12 @@ class ModelBackoff(Backoff):
 # -- snapshots --------------------------------------------------------------
 
 
-def _snap_step(step: DeploymentStep, quotient: bool = False) -> tuple:
+def _snap_step(step, quotient: bool = False) -> tuple:
+    if isinstance(step, ActionStep):
+        # scheduler-side steps (the gang recovery choreography's
+        # kill/unreserve/trim): three mutable fields, no launch residue
+        return ("A", step._status.value, step._interrupted,
+                tuple(step.errors))
     if quotient and step._status is Status.COMPLETE and not step.errors:
         # quotient: a COMPLETE step ignores every status (the
         # is_complete guard) and every exit (restart) wipes the
@@ -113,7 +119,13 @@ def _snap_step(step: DeploymentStep, quotient: bool = False) -> tuple:
     )
 
 
-def _restore_step(step: DeploymentStep, snap: tuple) -> None:
+def _restore_step(step, snap: tuple) -> None:
+    if isinstance(step, ActionStep):
+        _tag, status, interrupted, errors = snap
+        step._status = Status(status)
+        step._interrupted = interrupted
+        step.errors[:] = list(errors)
+        return
     if len(snap) == 2:  # the COMPLETE quotient
         step._status = Status.COMPLETE
         step._interrupted = snap[1]
@@ -155,10 +167,17 @@ class PlanHarness:
     in all of them; phase/plan interrupts are always in the alphabet).
     """
 
-    def __init__(self, plan: Plan, step_interrupts: bool = False):
+    def __init__(self, plan: Plan, step_interrupts: bool = False,
+                 world=None):
         self.plan = plan
         self.step_interrupts = step_interrupts
         self.quotient = False  # enabled by _quotient_probe() only
+        # optional non-plan model state (the gang-recovery config):
+        # snapshot/restore ride the plan's; ``world.events(harness)``
+        # joins the alphabet; ``world.launch_overrides`` maps step
+        # name -> replacement launch callable (a launch with a WAL
+        # side effect the model must observe)
+        self.world = world
         self.steps: List[DeploymentStep] = [
             s for p in plan.phases for s in p.steps
         ]
@@ -167,17 +186,22 @@ class PlanHarness:
         ]
 
     def snapshot(self) -> tuple:
-        return (
+        snap = (
             tuple(_snap_step(s, self.quotient) for s in self.steps),
             tuple(_snap_strategy(s) for s in self.strategies),
         )
+        if self.world is not None:
+            return snap + (self.world.snapshot(),)
+        return snap
 
     def restore(self, snap: tuple) -> None:
-        step_snaps, strat_snaps = snap
+        step_snaps, strat_snaps = snap[0], snap[1]
         for step, ssnap in zip(self.steps, step_snaps):
             _restore_step(step, ssnap)
         for strategy, tsnap in zip(self.strategies, strat_snaps):
             _restore_strategy(strategy, tsnap)
+        if self.world is not None:
+            self.world.restore(snap[2])
 
     # -- events -------------------------------------------------------
 
@@ -186,31 +210,56 @@ class PlanHarness:
         does not change the snapshot is a self-loop and is dropped by
         the dedup, so "disabled" events cost one transition apply."""
         out: List[Tuple[str, Callable[[], None]]] = []
+        overrides = getattr(self.world, "launch_overrides", {}) \
+            if self.world is not None else {}
         for step in self.steps:
             name = step.name
-            task, spec = next(iter(step._spec_by_full.items()))
-            out.append((f"launch({name})", self._launcher(step)))
-            statuses = [
-                ("RUNNING", TaskState.RUNNING, False),
-                ("FINISHED", TaskState.FINISHED, False),
-                ("FAILED", TaskState.FAILED, False),
-                ("TASK_ERROR", TaskState.ERROR, False),
-            ]
-            if spec.readiness_check is not None:
-                # only meaningful with a readiness gate; elsewhere it
-                # just doubles RUNNING
-                statuses.insert(1, ("READY", TaskState.RUNNING, True))
-            for label, state, ready in statuses:
+            if isinstance(step, ActionStep):
+                # scheduler-side step: its "launch" is execute(),
+                # gated on candidacy exactly as run_cycle gates it.
+                # No force_complete in the model alphabet: forcing a
+                # kill/unreserve step asserts OUT-OF-BAND operator
+                # knowledge (the processes are known dead, the claims
+                # known released) the world cannot represent — modeled
+                # instead by the world's own death/release events.
+                out.append((f"execute({name})", self._executor(step)))
+                out.append((f"restart({name})", step.restart))
+                if self.step_interrupts:
+                    out.append((f"interrupt({name})", step.interrupt))
+                    out.append((f"proceed({name})", step.proceed))
+                continue
+            launcher = overrides.get(name) or self._launcher(step)
+            out.append((f"launch({name})", launcher))
+            # status events for EVERY task the step covers (a gang
+            # step completes only when all its tasks report; a
+            # single-task step is unchanged by the loop)
+            for task, spec in step._spec_by_full.items():
+                statuses = [
+                    ("RUNNING", TaskState.RUNNING, False),
+                    ("FINISHED", TaskState.FINISHED, False),
+                    ("FAILED", TaskState.FAILED, False),
+                    ("TASK_ERROR", TaskState.ERROR, False),
+                ]
+                if spec.readiness_check is not None:
+                    # only meaningful with a readiness gate; elsewhere
+                    # it just doubles RUNNING
+                    statuses.insert(1, ("READY", TaskState.RUNNING, True))
+                prefix = f"status({name}" if len(step._spec_by_full) == 1 \
+                    else f"status({name}:{task}"
+                for label, state, ready in statuses:
+                    out.append((
+                        f"{prefix},{label})",
+                        self._status_sender(task, state, ready, _LIVE),
+                    ))
+                # a status from a launch that no longer exists
+                # (reordered delivery across a restart) — must always
+                # be ignored
                 out.append((
-                    f"status({name},{label})",
-                    self._status_sender(task, state, ready, _LIVE),
+                    f"{prefix},STALE_FAILED)",
+                    self._status_sender(
+                        task, TaskState.FAILED, False, _STALE
+                    ),
                 ))
-            # a status from a launch that no longer exists (reordered
-            # delivery across a restart) — must always be ignored
-            out.append((
-                f"status({name},STALE_FAILED)",
-                self._status_sender(task, TaskState.FAILED, False, _STALE),
-            ))
             out.append((f"restart({name})", step.restart))
             out.append((f"force_complete({name})", step.force_complete))
             if self.step_interrupts:
@@ -221,7 +270,18 @@ class PlanHarness:
             out.append((f"proceed(phase:{phase.name})", phase.proceed))
         out.append(("interrupt(plan)", self.plan.interrupt))
         out.append(("proceed(plan)", self.plan.proceed))
+        if self.world is not None:
+            out.extend(self.world.events(self))
         return out
+
+    def _executor(self, step: ActionStep) -> Callable[[], None]:
+        def execute() -> None:
+            # run_cycle only executes CANDIDATES; the serial strategy
+            # is what orders kill -> unreserve -> replace
+            if step not in self.plan.candidates(set()):
+                return
+            step.execute(None)
+        return execute
 
     def _launcher(self, step: DeploymentStep) -> Callable[[], None]:
         def launch() -> None:
@@ -271,6 +331,8 @@ def _quotient_probe(harness: PlanHarness) -> bool:
     """
     events = harness.events()
     for step in harness.steps:
+        if isinstance(step, ActionStep):
+            continue  # no launch residue to quotient
         task = next(iter(step._spec_by_full))
         live = f"{task}__{_LIVE}"
         running = TaskState.RUNNING.value
@@ -285,6 +347,7 @@ def _quotient_probe(harness: PlanHarness) -> bool:
         mine = [
             ev for label, ev in events
             if label.startswith(f"status({step.name},")
+            or label.startswith(f"status({step.name}:")
             or label == f"launch({step.name})"
         ]
         for residue in residues:
@@ -543,9 +606,18 @@ def check_plan(
     graph via snapshot/restore, so the checker checks the REAL
     production classes, not a transcription of them.
     """
-    harness = PlanHarness(factory(), step_interrupts=step_interrupts)
+    made = factory()
+    if isinstance(made, tuple):
+        plan, world = made
+    else:
+        plan, world = made, None
+    harness = PlanHarness(
+        plan, step_interrupts=step_interrupts, world=world
+    )
     invs = list(invariants) if invariants is not None \
         else default_invariants()
+    if world is not None and hasattr(world, "invariants"):
+        invs += world.invariants()
     events = harness.events()
 
     pre_probe = harness.snapshot()
@@ -730,13 +802,209 @@ def _canary_plan() -> Plan:
     return Plan("update", [phase], SerialStrategy())
 
 
+# -- the gang-recovery configuration (ISSUE 13) -----------------------
+#
+# Models DefaultRecoveryPlanManager._make_gang_phase's choreography
+# with the REAL plan objects (ActionStep kill/unreserve + a gang
+# DeploymentStep replace under SerialStrategy) over a tiny world of
+# the non-plan facts the steps mutate: which OLD incarnation
+# processes still run, and which incarnation holds reservations.
+# Old-task deaths arrive as world events (covering kill acks AND a
+# second preemption landing mid-recovery — the storm case); the
+# replace launch carries the WAL side effect (reservations commit
+# with the launch).  Verified invariants:
+#
+#   no-split-brain-gang      an old-incarnation process never
+#                            coexists with a RUNNING new-incarnation
+#                            task (the wedged-collective guarantee)
+#   no-double-reservation    the broken sub-slice's claims are
+#                            released before the replacement gang's
+#                            claims commit
+
+
+class GangRecoveryWorld:
+    """Non-plan model state for the gang-recovery configuration."""
+
+    # surviving old-incarnation processes at entry; each dies
+    # independently at any point (kill ack or mid-recovery
+    # preemption), so the subset lattice is explored exhaustively.
+    # 4 old x 2-host replacement gang lands the configuration at
+    # ~10k states in ~12s — deep enough for the storm interleavings,
+    # cheap enough for the repo gate.
+    N_OLD = 4
+
+    def __init__(self, kill_step, unreserve_step, replace_step):
+        self.kill_step = kill_step
+        self.unreserve_step = unreserve_step
+        self.replace_step = replace_step
+        self.old_alive = frozenset(range(self.N_OLD))
+        self.old_reserved = True
+        self.new_reserved = False
+        self.launch_overrides = {
+            replace_step.name: self._launch_replace,
+        }
+        self._plan: Optional[Plan] = None
+
+    def bind(self, plan: Plan) -> "GangRecoveryWorld":
+        self._plan = plan
+        return self
+
+    # -- snapshot protocol -------------------------------------------
+
+    def snapshot(self) -> tuple:
+        return (self.old_alive, self.old_reserved, self.new_reserved)
+
+    def restore(self, snap: tuple) -> None:
+        self.old_alive, self.old_reserved, self.new_reserved = snap
+
+    # -- model events -------------------------------------------------
+
+    def events(self, harness: "PlanHarness"):
+        out = []
+        for i in range(self.N_OLD):
+            # an old process dies: a kill ack, OR the host it sat on
+            # getting preempted mid-recovery (the storm case) — the
+            # model does not distinguish, the plan must tolerate both
+            # at ANY point
+            out.append((
+                f"old-task-dies({i})",
+                lambda i=i: self._die(i),
+            ))
+        return out
+
+    def _die(self, i: int) -> None:
+        self.old_alive = self.old_alive - {i}
+
+    def _launch_replace(self) -> None:
+        step = self.replace_step
+        if step not in self._plan.candidates(set()):
+            return
+        requirement = step.start()
+        if requirement is None:
+            return
+        # WAL discipline: reservations are durable WITH the launch
+        # record (run_cycle commits the ledger inside the launch span)
+        self.new_reserved = True
+        step.record_launch({
+            task: f"{task}__{_LIVE}"
+            for task in requirement.task_names()
+        })
+
+    # -- model actions (close over self; ActionStep passes None) ------
+
+    def kill_survivors(self, _scheduler) -> bool:
+        # issues kills; completes only when nothing old is alive —
+        # exactly DefaultRecoveryPlanManager's kill action, with the
+        # agent's process table abstracted to ``old_alive``
+        return not self.old_alive
+
+    def unreserve_slice(self, _scheduler) -> bool:
+        self.old_reserved = False
+        return True
+
+    # -- invariants ----------------------------------------------------
+
+    def invariants(self) -> List["Invariant"]:
+        return [NoSplitBrainGang(), NoDoubleReservation()]
+
+
+class NoSplitBrainGang(Invariant):
+    """Old and new gang incarnations never run simultaneously: a new
+    task reaching RUNNING while an old process survives means two
+    incarnations fight over the checkpoint directory and the
+    collective fabric (incarnation fencing makes the loser's WRITES
+    harmless, but the plan must never create the overlap)."""
+
+    name = "no-split-brain-gang"
+
+    def on_state(self, harness):
+        world = harness.world
+        if not world.old_alive:
+            return None
+        step = world.replace_step
+        # the hazard is a RUNNING new task while an old process lives.
+        # A force-completed replace step with no launch is NOT a
+        # split brain — the operator skipped the relaunch, nothing
+        # new runs (and any status-driven COMPLETE passed through a
+        # RUNNING state this check already saw).
+        running = [
+            task for task, state in step._task_states.items()
+            if state is TaskState.RUNNING
+        ]
+        if running:
+            return (
+                f"old incarnation processes {sorted(world.old_alive)} "
+                f"still alive while replacement gang runs "
+                f"{sorted(running)}"
+            )
+        return None
+
+
+class NoDoubleReservation(Invariant):
+    """The broken sub-slice's reservations are released before the
+    replacement gang's commit: overlapping claims would double-count
+    capacity and can double-book chips once the freed hosts re-enter
+    the candidate set mid-evaluation."""
+
+    name = "no-double-reservation"
+
+    def on_state(self, harness):
+        world = harness.world
+        if world.old_reserved and world.new_reserved:
+            return (
+                "broken sub-slice still reserved while the replacement "
+                "gang holds committed reservations"
+            )
+        return None
+
+
+def _gang_recovery_plan():
+    from dcos_commons_tpu.plan.strategy import SerialStrategy as _Serial
+
+    # the REPLACEMENT gang is 2 hosts (an elastic shrink of the 4 old
+    # survivors' slice) — decoupled from N_OLD on purpose: the
+    # replace step's task lattice and the old-process subset lattice
+    # multiply, and 2x4 is the sweet spot between depth and gate cost
+    pod = PodSpec(
+        type="trainer",
+        count=2,
+        gang=True,
+        tasks=[TaskSpec(name="worker", goal=GoalState.RUNNING,
+                        cmd="train")],
+    )
+    replace = DeploymentStep(
+        "replace-trainer-gang",
+        PodInstanceRequirement(
+            pod=pod,
+            instances=list(range(pod.count)),
+        ),
+        backoff=ModelBackoff(),
+    )
+    # world first (the action callables close over it), steps after
+    kill = ActionStep("kill-trainer-survivors", lambda s: False)
+    unreserve = ActionStep("unreserve-trainer-slice", lambda s: False)
+    world = GangRecoveryWorld(kill, unreserve, replace)
+    kill._action = world.kill_survivors
+    unreserve._action = world.unreserve_slice
+    phase = Phase(
+        "recover-trainer-gang", [kill, unreserve, replace], _Serial()
+    )
+    plan = Plan("recovery", [phase], _Serial())
+    world.bind(plan)
+    return plan, world
+
+
 # name -> (factory, step_interrupts): per-step interrupt verbs only
-# where the extra state-space doubling buys new interleavings
+# where the extra state-space doubling buys new interleavings.
+# ``gang-recovery``'s factory returns (plan, world) — the checker
+# folds the world's state into dedup snapshots and its events into
+# the alphabet.
 BUILTIN_CONFIGS: Dict[str, Tuple[Callable[[], Plan], bool]] = {
     "serial-2phase": (_serial_plan, False),
     "parallel": (_parallel_plan, True),
     "dependency-dag": (_dependency_plan, False),
     "canary": (_canary_plan, True),
+    "gang-recovery": (_gang_recovery_plan, True),
 }
 
 

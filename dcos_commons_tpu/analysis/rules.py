@@ -161,7 +161,8 @@ class LedgerMutationRule(Rule):
             {"_cache", "_by_host", "_by_task", "_host_gen"}, "_generation",
         ),
         "SliceInventory": (
-            {"_hosts", "_down", "_host_topo_gen"}, "_topology_gen",
+            {"_hosts", "_down", "_preempted", "_maintenance",
+             "_host_topo_gen"}, "_topology_gen",
         ),
     }
     # every tracked attr plus the generation counters and the snapshot

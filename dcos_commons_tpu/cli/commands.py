@@ -106,6 +106,22 @@ def build_parser() -> argparse.ArgumentParser:
     p = sections.add_parser("endpoints")
     p.add_argument("name", nargs="?")
 
+    # hosts: preemption & maintenance lifecycle (ISSUE 13)
+    host = sections.add_parser("host").add_subparsers(
+        dest="verb", required=True
+    )
+    host.add_parser("list")
+    p = host.add_parser("drain")
+    p.add_argument("host_id")
+    p.add_argument(
+        "--window-s", type=float, default=0.0, metavar="SECONDS",
+        help="maintenance window length; a finite window makes elastic "
+             "gang recovery wait for the capacity instead of shrinking",
+    )
+    for verb in ("preempt", "up"):
+        p = host.add_parser(verb)
+        p.add_argument("host_id")
+
     # debug
     p = sections.add_parser("debug")
     p.add_argument(
@@ -169,6 +185,8 @@ def run(args: argparse.Namespace) -> Any:
         if args.name:
             return client.get(f"/v1/endpoints/{args.name}")
         return client.get("/v1/endpoints")
+    if section == "host":
+        return _host(client, args)
     if section == "debug":
         return _debug(client, args)
     if section == "update":
@@ -178,6 +196,17 @@ def run(args: argparse.Namespace) -> Any:
     if section == "health":
         return client.get("/v1/health")
     raise CliError(0, f"unknown section {section}")
+
+
+def _host(client: ApiClient, args) -> Any:
+    if args.verb == "list":
+        return client.get("/v1/hosts")
+    if args.verb == "drain":
+        return client.post(
+            f"/v1/hosts/{args.host_id}/drain",
+            body={"window_s": args.window_s},
+        )
+    return client.post(f"/v1/hosts/{args.host_id}/{args.verb}")
 
 
 def _debug(client: ApiClient, args) -> Any:

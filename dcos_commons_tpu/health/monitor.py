@@ -67,6 +67,7 @@ class HealthMonitor:
         telemetry_interval_s: float = 5.0,
         history_interval_s: float = 1.0,
         flush_interval_s: float = 1.0,
+        auto_replace: bool = False,
     ):
         self.journal = journal or EventJournal(backend=None)
         self.straggler = straggler or StragglerDetector()
@@ -87,6 +88,17 @@ class HealthMonitor:
         # batching rides this clock (bounded-loss contract: a crash
         # forfeits at most flush_interval_s of transition events)
         self.flush_interval_s = float(flush_interval_s)
+        # health -> action seam (ISSUE 13 satellite, ROADMAP item 2
+        # minimal slice), DEFAULT OFF: a CONFIRMED straggler episode
+        # on a host carrying a gang member may trigger AT MOST ONE
+        # automated pod replace per episode — the replace rides the
+        # gang recovery plan (journal-audited, operator-interruptible
+        # via the ordinary plan verbs), and the suspect host is
+        # already demoted to the back of placement scan order, so the
+        # re-placed gang prefers non-suspect hosts.  The episode's
+        # clear event re-arms the host.
+        self.auto_replace = bool(auto_replace)
+        self._auto_replaced: set = set()
         self.observe_errors = 0
         self._last_observe = 0.0
         self._last_telemetry = 0.0
@@ -222,6 +234,8 @@ class HealthMonitor:
             )
             self._alerts += 1
             scheduler.metrics.incr("health.alerts")
+        if self.auto_replace:
+            events += self._auto_replace_stragglers(scheduler, events)
         # alerts deserve immediate durability; routine transition
         # batches flush on the throttle clock
         if events or not self.flush_interval_s or \
@@ -229,6 +243,71 @@ class HealthMonitor:
             self._last_flush = now
             self.journal.flush()
         return events
+
+    def _auto_replace_stragglers(self, scheduler, events) -> List[dict]:
+        """The health -> action seam (default off, ``auto_replace``):
+        act on THIS pass's straggler episode edges.  A new CONFIRMED
+        episode on a host carrying a gang member triggers one pod
+        replace (PERMANENT -> the gang recovery plan, which the
+        operator can interrupt like any plan); the episode's clear
+        re-arms the host.  At most one replace fires per observe pass
+        — a detector wobble must not evict half the fleet at once."""
+        for event in events:
+            if event.get("detector") == "straggler" and \
+                    event.get("cleared"):
+                self._auto_replaced.discard(event.get("host"))
+        out: List[dict] = []
+        for event in events:
+            if event.get("detector") != "straggler" or \
+                    event.get("cleared"):
+                continue
+            host = event.get("host")
+            if host in self._auto_replaced:
+                continue
+            target = self._gang_member_on(scheduler, host)
+            if target is None:
+                continue
+            pod_type, index = target
+            # arm AFTER the replace succeeds: a transient store error
+            # inside restart_pod must not consume the episode's one
+            # allowed action with neither a replace nor an audit trail
+            killed = scheduler.restart_pod(pod_type, index, replace=True)
+            self._auto_replaced.add(host)
+            action = {
+                "kind": "health",
+                "verb": "auto-replace",
+                "host": host,
+                "pod": f"{pod_type}-{index}",
+                "tasks": len(killed),
+                "message": (
+                    f"auto-replace: confirmed straggler {host} carries "
+                    f"gang member {pod_type}-{index}; replacing onto a "
+                    "non-suspect host (suspects sort last in placement)"
+                ),
+            }
+            self.journal.append(
+                "health",
+                message=action["message"],
+                **{k: v for k, v in action.items()
+                   if k not in ("kind", "message")},
+            )
+            scheduler.metrics.incr("health.auto_replace")
+            out.append(action)
+            break  # at most one automated replace per pass
+        return out
+
+    def _gang_member_on(self, scheduler, host):
+        """(pod_type, index) of a gang member running on ``host``, or
+        None — only gang pods ride the auto-replace seam (a straggler
+        host drags its WHOLE gang's step time; a non-gang pod's
+        remediation story belongs to the full ROADMAP item 2)."""
+        gang_types = {p.type for p in scheduler.spec.pods if p.gang}
+        if not gang_types:
+            return None
+        for info in scheduler.state_store.fetch_tasks():
+            if info.agent_id == host and info.pod_type in gang_types:
+                return (info.pod_type, info.pod_index)
+        return None
 
     def _collect_background(self, scheduler) -> None:
         try:

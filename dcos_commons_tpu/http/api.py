@@ -407,6 +407,50 @@ class SchedulerApi:
             h.host_id: h.zone for h in self._scheduler.inventory.hosts()
         }
 
+    # -- hosts (ISSUE 13: preemption & maintenance verbs) -------------
+
+    def list_hosts(self) -> Response:
+        """Per-host lifecycle state (up/down/preempted/maintenance)
+        plus maintenance windows — the operator's drain dashboard."""
+        inventory = self._scheduler.inventory
+        if not hasattr(inventory, "host_states"):
+            return 200, {"hosts": {}}
+        return 200, {"hosts": inventory.host_states()}
+
+    def host_drain(self, host_id: str, body: Optional[dict] = None) -> Response:
+        if self._scheduler.inventory.host(host_id) is None:
+            return 404, {"message": f"no host {host_id}"}
+        try:
+            window_s = float((body or {}).get("window_s", 0) or 0)
+        except (TypeError, ValueError):
+            return 400, {"message": "window_s must be a number"}
+        changed = self._scheduler.drain_host(host_id, window_s=window_s)
+        self._flush_journal()
+        return 200, {
+            "host": host_id,
+            "state": "maintenance",
+            "changed": changed,
+            "window_s": window_s,
+        }
+
+    def host_preempt(self, host_id: str) -> Response:
+        if self._scheduler.inventory.host(host_id) is None:
+            return 404, {"message": f"no host {host_id}"}
+        lost = self._scheduler.preempt_host(host_id)
+        self._flush_journal()
+        return 200, {
+            "host": host_id,
+            "state": "preempted",
+            "tasks_lost": lost,
+        }
+
+    def host_up(self, host_id: str) -> Response:
+        if self._scheduler.inventory.host(host_id) is None:
+            return 404, {"message": f"no host {host_id}"}
+        changed = self._scheduler.undrain_host(host_id)
+        self._flush_journal()
+        return 200, {"host": host_id, "state": "up", "changed": changed}
+
     # -- endpoints (reference: http/endpoints/EndpointsResource) ------
 
     def endpoints_generation(self) -> str:
@@ -471,11 +515,21 @@ class SchedulerApi:
             ready = bool(status.ready) if status else False
             # a backend is DRAINING when it should receive no new
             # requests: paused (decommission/pause rides the override),
-            # not running, or not yet warm — the router's drain signal
+            # not running, not yet warm — or its HOST is leaving
+            # (maintenance drain, mark_down, preemption).  The host
+            # check is what makes `host drain` stop the routing tier
+            # BEFORE any kill fires: the task is still RUNNING and
+            # ready, but its machine is going away (ISSUE 13
+            # satellite — previously only the task-level signals were
+            # consulted, so a pre-kill drain never surfaced)
+            host_state = getattr(
+                self._scheduler.inventory, "host_state", lambda _h: "up"
+            )(info.agent_id)
             draining = (
                 override is not GoalStateOverride.NONE
                 or state != "TASK_RUNNING"
                 or not ready
+                or host_state not in ("up", "")
             )
             advertised: Optional[int] = None
             advertised_read = False

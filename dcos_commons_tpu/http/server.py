@@ -90,6 +90,18 @@ def build_routes(api: SchedulerApi) -> List[Route]:
         r("PUT", r"/v1/state/files/([^/]+)",
           lambda m, q, body: api.state_file_put(m.group(1), body),
           True),
+        # hosts: preemption & maintenance lifecycle (ISSUE 13) — the
+        # drain verb excludes the host from placement and flips its
+        # serve backends to draining BEFORE anything is killed;
+        # preempt surfaces an involuntary capacity loss (tasks LOST,
+        # gang recovery synthesized); up returns the host to service
+        r("GET", r"/v1/hosts", lambda m, q: api.list_hosts()),
+        r("POST", r"/v1/hosts/([^/]+)/drain",
+          lambda m, q, body: api.host_drain(m.group(1), body), True),
+        r("POST", r"/v1/hosts/([^/]+)/preempt",
+          lambda m, q: api.host_preempt(m.group(1))),
+        r("POST", r"/v1/hosts/([^/]+)/up",
+          lambda m, q: api.host_up(m.group(1))),
         # endpoints
         r("GET", r"/v1/endpoints", lambda m, q: api.list_endpoints()),
         r("GET", r"/v1/endpoints/([^/]+)",
@@ -261,14 +273,69 @@ class ApiServer:
                         "seq": journal.last_seq,
                         "journal": journal.describe(),
                     }
+                if rest == "hosts" and method == "GET":
+                    # fleet host states (the shared inventory)
+                    inv = getattr(multi_scheduler, "inventory", None)
+                    if inv is None or not hasattr(inv, "host_states"):
+                        return 200, {"hosts": {}}
+                    return 200, {"hosts": inv.host_states()}
+                if rest.startswith("hosts/") and method == "POST":
+                    # fleet-level host lifecycle: one inventory mark,
+                    # preemption stamping fanned out to every service
+                    parts = rest.split("/")
+                    if len(parts) == 3:
+                        _, host_id, verb = parts
+                        try:
+                            if verb == "drain":
+                                body = self._json_body()
+                                window_s = float(
+                                    body.get("window_s", 0) or 0
+                                )
+                                changed = multi_scheduler.drain_host(
+                                    host_id, window_s=window_s
+                                )
+                                return 200, {
+                                    "host": host_id,
+                                    "state": "maintenance",
+                                    "changed": changed,
+                                }
+                            if verb == "preempt":
+                                lost = multi_scheduler.preempt_host(
+                                    host_id
+                                )
+                                return 200, {
+                                    "host": host_id,
+                                    "state": "preempted",
+                                    "tasks_lost": lost,
+                                }
+                            if verb == "up":
+                                changed = multi_scheduler.undrain_host(
+                                    host_id
+                                )
+                                return 200, {
+                                    "host": host_id,
+                                    "state": "up",
+                                    "changed": changed,
+                                }
+                        except KeyError:
+                            return 404, {
+                                "message": f"no host {host_id}"
+                            }
+                        except (TypeError, ValueError) as e:
+                            return 400, {"message": str(e)}
+                    return 404, {
+                        "message": f"no route {method} /v1/multi/{rest}"
+                    }
                 name, _, sub = rest.partition("/")
-                if name == "events" and method == "PUT" and not sub:
+                if name in ("events", "hosts") and method == "PUT" \
+                        and not sub:
                     # reserved: GET /v1/multi/events is the fleet
-                    # journal — a service deployed under that name
-                    # would have its bare-name GET shadowed
+                    # journal and /v1/multi/hosts the fleet host
+                    # surface — a service deployed under either name
+                    # would have its bare-name routes shadowed
                     return 400, {
-                        "message": "service name 'events' is reserved "
-                                   "(fleet event journal route)"
+                        "message": f"service name {name!r} is reserved "
+                                   "(fleet route)"
                     }
                 if method == "PUT" and not sub:
                     # body: service YAML, or a framework package

@@ -209,6 +209,15 @@ class MultiServiceScheduler:
         # merged orphan sweep goes through a TaskKiller so lost kill
         # requests are retried and acked like every other kill
         self.task_killer = TaskKiller(agent)
+        # incremental orphan index (ISSUE 13 satellite, the PR 9
+        # remainder): per-service expected-task-id sets cached on the
+        # service's task-subtree generation stamp, so the per-cycle
+        # sweep is O(services) stamp compares on a quiet fleet instead
+        # of O(services x tasks) store scans.  The stamp is
+        # epoch-qualified (StateStore.task_generation), so a service
+        # REBUILD (upgrade, failover) re-bases under a fresh epoch and
+        # can never alias a stale cached set.
+        self._orphan_index: Dict[str, tuple] = {}
         # wedge detection (mirrors DefaultScheduler.run_forever): a
         # service failing this many consecutive cycles flags the whole
         # process fatal for supervised restart
@@ -658,6 +667,71 @@ class MultiServiceScheduler:
             deregister=False,
         )
 
+    # -- host lifecycle verbs (ISSUE 13) ------------------------------
+    # fleet-level: the inventory is SHARED, so the mark lands once,
+    # but preemption's task stamping fans out to every service that
+    # has tasks on the host (routes: /v1/multi/hosts/<id>/<verb>)
+
+    def drain_host(self, host_id: str, window_s: float = 0.0) -> bool:
+        import time as _time
+
+        with self._lock:
+            if self.inventory.host(host_id) is None:
+                raise KeyError(host_id)
+            window_end = _time.time() + window_s if window_s > 0 else 0.0
+            changed = self.inventory.set_maintenance(host_id, window_end)
+        if changed:
+            self.journal.append(
+                "host", verb="drain", host=host_id, window_s=window_s,
+                message=f"host {host_id} entering maintenance",
+            )
+            self.journal.flush()
+        self.nudge()
+        return changed
+
+    def undrain_host(self, host_id: str) -> bool:
+        with self._lock:
+            if self.inventory.host(host_id) is None:
+                raise KeyError(host_id)
+            changed = self.inventory.clear_host_state(host_id)
+        if changed:
+            self.journal.append(
+                "host", verb="up", host=host_id,
+                message=f"host {host_id} back in service",
+            )
+            self.journal.flush()
+        self.nudge()
+        return changed
+
+    def preempt_host(self, host_id: str) -> Dict[str, List[str]]:
+        """Mark the host preempted once, then stamp every service's
+        tasks on it (each service synthesizes its own LOST statuses
+        and gang recovery).  The per-service calls run OUTSIDE the
+        multi lock — they take each service's own lock, and holding
+        both here would order-invert against run_cycle."""
+        with self._lock:
+            if self.inventory.host(host_id) is None:
+                raise KeyError(host_id)
+            self.inventory.set_preempted(host_id)
+            services = dict(self._services)
+        lost: Dict[str, List[str]] = {}
+        for name, service in services.items():
+            noter = getattr(service, "note_host_preempted", None)
+            if callable(noter):
+                touched = noter(host_id)
+                if touched:
+                    lost[name] = touched
+        self.journal.append(
+            "host", verb="preempt", host=host_id,
+            tasks=sum(len(v) for v in lost.values()),
+            message=f"host {host_id} preempted "
+                    f"({sum(len(v) for v in lost.values())} task(s) "
+                    f"across {len(lost)} service(s))",
+        )
+        self.journal.flush()
+        self.nudge()
+        return lost
+
     # -- the loop (reference: MultiServiceEventClient fan-out) --------
 
     def run_cycle(self) -> None:
@@ -733,15 +807,47 @@ class MultiServiceScheduler:
                     self._suppressed_services.discard(name)
                     LOG.info("service %s uninstalled and removed", name)
 
+    def _expected_task_ids(self, services: Dict[str, object]) -> set:
+        """Union of every service's stored task ids, served from the
+        incremental orphan index: a service whose task-generation
+        stamp is unchanged reuses its cached id set (one string
+        compare), only mutated services pay the store scan.  Must be
+        EXACTLY equivalent to the full scan — an over-approximation
+        would shelter a real orphan, an under-approximation would
+        kill a live task (equivalence-tested in test_multi_service)."""
+        expected: set = set()
+        for name, service in services.items():
+            store = service.state_store
+            gen = getattr(store, "task_generation", None)
+            hit = self._orphan_index.get(name)
+            if gen is not None and hit is not None and hit[0] == gen:
+                ids = hit[1]
+            else:
+                ids = frozenset(
+                    info.task_id for info in store.fetch_tasks()
+                )
+                if gen is not None:
+                    # re-read the stamp AFTER the scan: a mutation
+                    # racing the scan must invalidate, not be masked
+                    # behind the pre-scan stamp
+                    post = store.task_generation
+                    if post == gen:
+                        self._orphan_index[name] = (gen, ids)
+            expected |= ids
+        if len(self._orphan_index) > len(services):
+            # drop removed/rebuilt-away services so the index cannot
+            # grow without bound across add/uninstall churn
+            self._orphan_index = {
+                n: v for n, v in self._orphan_index.items()
+                if n in services
+            }
+        return expected
+
     def _kill_merged_orphans(self, services: Dict[str, object]) -> None:
         """Kill agent tasks NO service's store owns (lost-kill safety
         net; the per-service sweep is disabled in multi mode because
         each service sees the shared agent's full task set)."""
-        expected = set()
-        for service in services.values():
-            expected |= {
-                info.task_id for info in service.state_store.fetch_tasks()
-            }
+        expected = self._expected_task_ids(services)
         for task_id in self.agent.active_task_ids() - expected:
             if task_id in self.task_killer.pending_ids():
                 continue  # retry_pending re-issues until acked

@@ -282,6 +282,17 @@ class SliceInventory:
     def __init__(self, hosts: Optional[List[TpuHost]] = None):
         self._hosts: Dict[str, TpuHost] = {}
         self._down: Set[str] = set()
+        # TPU-native failure-domain states (ISSUE 13): ``preempted``
+        # hosts are DOWN with a cause (the cloud took the capacity
+        # back; tasks there are dead and recovery treats them as
+        # PERMANENT); ``maintenance`` hosts are UP but drain-first —
+        # excluded from every snapshot/candidate set (no NEW
+        # placements, fresh or in-place growth) while their running
+        # work keeps running until the operator (or the maintenance
+        # automation) kills it.  Values: host_id -> wall-clock window
+        # end (0.0 = indefinite / unknown).
+        self._preempted: Set[str] = set()
+        self._maintenance: Dict[str, float] = {}
         # per-view snapshot caches: id(view) -> (view, _ViewCache).
         # The view object itself is held (not just its id()): id reuse
         # after GC must never validate a stale cache.
@@ -337,6 +348,8 @@ class SliceInventory:
             return  # no-op: an unknown host must not dirty the fleet
         self._hosts.pop(host_id, None)
         self._down.discard(host_id)
+        self._preempted.discard(host_id)
+        self._maintenance.pop(host_id, None)
         self._topology_gen += 1
         self._host_topo_gen[host_id] = self._topology_gen
         # journal compaction: removed hosts' stamps must outlive every
@@ -363,11 +376,115 @@ class SliceInventory:
     def mark_up(self, host_id: str) -> None:
         # no-op guard: re-marking an up (or unknown) host used to bump
         # the generation anyway, invalidating every per-cycle hosts
-        # dict and dirtying the whole fleet for nothing
+        # dict and dirtying the whole fleet for nothing.  A returning
+        # host sheds its preemption mark (the capacity is back) but
+        # NOT a maintenance mark — the drain was scheduled by an
+        # operator and only clear_host_state/the window may end it.
         if host_id in self._down:
             self._down.discard(host_id)
+            self._preempted.discard(host_id)
             self._topology_gen += 1
             self._host_topo_gen[host_id] = self._topology_gen
+
+    # -- preemption / maintenance (ISSUE 13) --------------------------
+
+    def set_preempted(self, host_id: str) -> bool:
+        """Immediate, involuntary capacity loss: the host is DOWN (its
+        tasks are dead, snapshots excluded) and the preemption cause is
+        recorded so recovery and the /v1/hosts surface can tell a
+        preemption from a plain heartbeat loss.  Returns False when
+        the host is unknown or already marked."""
+        if host_id not in self._hosts or host_id in self._preempted:
+            return False
+        self._preempted.add(host_id)
+        self._maintenance.pop(host_id, None)
+        if host_id not in self._down:
+            self._down.add(host_id)
+        self._topology_gen += 1
+        self._host_topo_gen[host_id] = self._topology_gen
+        return True
+
+    def set_maintenance(self, host_id: str, window_end: float = 0.0) -> bool:
+        """Scheduled drain: the host stays UP (running work keeps
+        running, in-place relaunches of existing footprints still
+        work) but is HARD-excluded from snapshots and candidate
+        indexes — no new placement lands on a host about to go away.
+        ``window_end`` is the wall-clock end of the maintenance window
+        (0.0 = indefinite); the elastic-resize decision rule reads it
+        to choose waiting over shrinking.  Returns False when the
+        host is unknown or already draining with the same window."""
+        if host_id not in self._hosts:
+            return False
+        if self._maintenance.get(host_id) == window_end and \
+                host_id in self._maintenance:
+            return False
+        self._maintenance[host_id] = float(window_end)
+        self._topology_gen += 1
+        self._host_topo_gen[host_id] = self._topology_gen
+        return True
+
+    def clear_host_state(self, host_id: str) -> bool:
+        """Operator ``up`` verb: shed preempted/maintenance/down marks
+        and return the host to full placement eligibility."""
+        if host_id not in self._hosts:
+            return False
+        changed = (
+            host_id in self._down
+            or host_id in self._preempted
+            or host_id in self._maintenance
+        )
+        if not changed:
+            return False
+        self._down.discard(host_id)
+        self._preempted.discard(host_id)
+        self._maintenance.pop(host_id, None)
+        self._topology_gen += 1
+        self._host_topo_gen[host_id] = self._topology_gen
+        return True
+
+    def host_state(self, host_id: str) -> str:
+        """One of "up" | "down" | "preempted" | "maintenance" ("" for
+        an unknown host).  ``maintenance`` wins over up (the host IS
+        up — that is the point of a drain)."""
+        if host_id not in self._hosts:
+            return ""
+        if host_id in self._preempted:
+            return "preempted"
+        if host_id in self._down:
+            return "down"
+        if host_id in self._maintenance:
+            return "maintenance"
+        return "up"
+
+    def maintenance_window(self, host_id: str) -> Optional[float]:
+        """Window end for a draining host (0.0 = indefinite), None
+        when the host is not in maintenance."""
+        return self._maintenance.get(host_id)
+
+    def maintenance_hosts(self) -> Dict[str, float]:
+        return dict(self._maintenance)
+
+    def preempted_hosts(self) -> Set[str]:
+        return set(self._preempted)
+
+    def host_states(self) -> Dict[str, dict]:
+        """Per-host state rows for GET /v1/hosts (operator surface)."""
+        out: Dict[str, dict] = {}
+        for host_id, host in self._hosts.items():
+            row: Dict[str, object] = {
+                "state": self.host_state(host_id),
+                "slice": host.slice_id,
+                "chips": host.chips_per_host,
+            }
+            window = self._maintenance.get(host_id)
+            if window is not None:
+                row["window_end"] = window
+            out[host_id] = row
+        return out
+
+    def _placement_excluded(self, host_id: str) -> bool:
+        """Down OR draining: no snapshot, no candidate membership."""
+        return host_id in self._down or host_id in self._maintenance
 
     # -- queries ------------------------------------------------------
 
@@ -421,6 +538,8 @@ class SliceInventory:
             "topology_generation": self._topology_gen,
             "hosts": len(self._hosts),
             "up_hosts": len(self._up_ids()),
+            "preempted_hosts": sorted(self._preempted),
+            "maintenance_hosts": dict(sorted(self._maintenance.items())),
             "suspect_hosts": sorted(self._suspect),
             "last_dirty_hosts": self.last_dirty_hosts,
             "snapshot_cache": {
@@ -501,7 +620,7 @@ class SliceInventory:
         rebuilt = 0
         for host_id in dirty:
             host = self._hosts.get(host_id)
-            if host is None or host_id in self._down:
+            if host is None or self._placement_excluded(host_id):
                 self._drop_entry(cache, host_id)
                 continue
             token = gen_of(host_id) if gen_of is not None else None
@@ -520,7 +639,7 @@ class SliceInventory:
         rebuilt = 0
         for host in self._hosts.values():
             host_id = host.host_id
-            if host_id in self._down:
+            if self._placement_excluded(host_id):
                 self._drop_entry(cache, host_id)
                 continue
             seen.add(host_id)
@@ -657,10 +776,13 @@ class SliceInventory:
         gen = self._topology_gen
         if self._up_ids_cache is None or self._up_ids_gen != gen:
             # C-level snapshots first: debug_stats calls this from
-            # HTTP threads while the cycle thread mutates the fleet
-            down = set(self._down)
+            # HTTP threads while the cycle thread mutates the fleet.
+            # Maintenance hosts are excluded like down ones — this set
+            # feeds candidate indexes, and a draining host may take no
+            # new placements (its RUNNING work is untouched)
+            excluded = set(self._down) | set(self._maintenance)
             self._up_ids_cache = {
-                h for h in list(self._hosts) if h not in down
+                h for h in list(self._hosts) if h not in excluded
             }
             self._up_ids_gen = gen
         return self._up_ids_cache
@@ -684,7 +806,7 @@ class SliceInventory:
         if index is None:
             index = {}
             for host in self._hosts.values():
-                if host.host_id in self._down:
+                if self._placement_excluded(host.host_id):
                     continue
                 index.setdefault(
                     host_field(host, field_name), set()

@@ -151,6 +151,26 @@ def mesh_from_env(env: Dict[str, str], n_devices: Optional[int] = None) -> Mesh:
     return make_mesh(derive(env, n))
 
 
+def elastic_reshard_ok(old: MeshSpec, new: MeshSpec) -> bool:
+    """True when a checkpoint written under ``old`` restores onto
+    ``new`` as a pure re-layout — elastic-DP resize (ISSUE 13).
+
+    The contract: only the batch axes (``dp``/``dcn``) may change.
+    Params and optimizer state are REPLICATED over dp/dcn, so a
+    changed width re-lays the same leaves; any model-sharding axis
+    changing (tp/sp/pp/ep/fsdp) would change leaf SHARDS, and the
+    host-gathered npz checkpoint would silently restore a different
+    parallelism than the step function expects.  The worker refuses
+    that resume loudly instead."""
+    return (
+        old.tp == new.tp
+        and old.sp == new.sp
+        and old.pp == new.pp
+        and old.ep == new.ep
+        and old.fsdp == new.fsdp
+    )
+
+
 # -- sharding rules ---------------------------------------------------
 
 Rules = Tuple[Tuple[str, PartitionSpec], ...]

@@ -1,0 +1,273 @@
+"""Elastic re-slicing: the gang that shrinks instead of waiting.
+
+The reference SDK only ever recovers 1:1 — PERMANENT recovery
+re-places the same footprint it lost (SURVEY section 7 stage 8).  A
+TPU fleet loses capacity it cannot get back (preemption) or loses it
+for a bounded window (maintenance), and a DP-sharded trainer can keep
+making progress on a smaller mesh: params and optimizer state are
+replicated over the ``dp`` axis, so restoring the newest fenced
+checkpoint onto fewer hosts is a pure re-layout — same leaves, new
+sharding (parallel/mesh.py ``elastic_reshard_ok`` is the worker-side
+contract check).
+
+Two pieces live here:
+
+* :func:`decide_resize` — the PURE decision rule (plancheck-style
+  verifiable, property-testable): when a full-size sub-slice cannot
+  place, shrink only if (a) the pod opted in (``tpu: elastic:``),
+  (b) enough placement attempts failed that "transient fragmentation"
+  is off the table, (c) no maintenance window promises the capacity
+  back, and (d) a clean smaller size exists — a DIVISOR of the full
+  gang (so the global batch reshards evenly over the new ``dp``) at
+  or above ``min_hosts``.
+* :class:`ElasticGangStep` — the recovery plan's replace step: a
+  DeploymentStep whose requirement starts at full size and re-scopes
+  itself (smaller pod copy, scaled topology) when the rule says
+  shrink.  Every re-scope is journaled; the step stays operator-
+  interruptible like any other.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Callable, List, Optional
+
+from dcos_commons_tpu.plan.backoff import Backoff
+from dcos_commons_tpu.plan.step import (
+    DeploymentStep,
+    PodInstanceRequirement,
+    RecoveryType,
+)
+from dcos_commons_tpu.specification.specs import PodSpec, task_full_name
+
+
+@dataclass(frozen=True)
+class ElasticPolicy:
+    """Per-pod elastic-resize policy (from the ``tpu:`` YAML block)."""
+
+    enabled: bool = False
+    # never shrink below this many hosts (the operator's floor: a
+    # 2-host trainer may be pointless for the workload)
+    min_hosts: int = 1
+    # full-size placement attempts (declined offer cycles) before the
+    # rule considers the capacity really gone rather than fragmented
+    shrink_after_declines: int = 3
+
+
+@dataclass(frozen=True)
+class ResizeDecision:
+    target_hosts: int
+    reason: str
+
+
+def shrink_candidates(full_hosts: int, min_hosts: int) -> List[int]:
+    """Descending proper divisors of ``full_hosts`` at or above
+    ``min_hosts`` — the only sizes a DP-sharded trainer reshards onto
+    cleanly (the global batch and the checkpoint's dp axis must
+    divide)."""
+    floor = max(1, int(min_hosts))
+    return [
+        k for k in range(full_hosts - 1, floor - 1, -1)
+        if full_hosts % k == 0
+    ]
+
+
+def decide_resize(
+    current_hosts: int,
+    full_hosts: int,
+    declines: int,
+    policy: ElasticPolicy,
+    maintenance_returning: bool,
+) -> ResizeDecision:
+    """The shrink-vs-wait rule.  PURE — no clocks, no inventory: the
+    caller feeds observed facts, the rule returns the target size.
+
+    ``maintenance_returning`` is True when any drained host has a
+    FINITE maintenance window (the capacity comes back): waiting for
+    the window beats training at half width and paying a second
+    restart when it ends.  Preempted capacity never returns by
+    contract, so a pure-preemption loss shrinks as soon as the
+    decline budget is spent.
+    """
+    if not policy.enabled:
+        return ResizeDecision(current_hosts, "elastic disabled")
+    if declines < max(1, policy.shrink_after_declines):
+        return ResizeDecision(
+            current_hosts,
+            f"waiting: {declines}/{policy.shrink_after_declines} "
+            "placement attempts",
+        )
+    if maintenance_returning:
+        return ResizeDecision(
+            current_hosts,
+            "waiting: a maintenance window promises the capacity back",
+        )
+    # divisors of the FULL gang size, strictly below the current
+    # target — the checkpoint's dp axis reshards cleanly onto exactly
+    # these widths
+    for k in shrink_candidates(full_hosts, policy.min_hosts):
+        if k < current_hosts:
+            return ResizeDecision(
+                k, f"shrinking {current_hosts} -> {k} hosts"
+            )
+    return ResizeDecision(
+        current_hosts,
+        f"no clean size between {policy.min_hosts} and "
+        f"{current_hosts - 1} hosts",
+    )
+
+
+def shrink_topology(tpu, target_hosts: int) -> Optional[str]:
+    """Scale a declared torus topology down to ``target_hosts`` hosts
+    by halving dimensions largest-first; None when no clean rectangle
+    exists (the caller must not shrink).  The result keeps every
+    dimension a positive integer and the chip total exactly
+    ``target_hosts * chips_per_host`` — what ``find_subslice`` needs
+    to tile the smaller gang contiguously."""
+    dims = list(tpu.topology_dims())
+    if not dims:
+        return ""
+    want = target_hosts * tpu.chips_per_host
+    have = 1
+    for d in dims:
+        have *= d
+    while have > want:
+        dims.sort(reverse=True)
+        if have % 2 or dims[0] % 2:
+            return None
+        dims[0] //= 2
+        have //= 2
+    if have != want:
+        return None
+    return "x".join(str(d) for d in sorted(dims, reverse=True))
+
+
+def shrunken_pod(pod: PodSpec, target_hosts: int) -> Optional[PodSpec]:
+    """A copy of a gang pod scoped to ``target_hosts`` instances with
+    a proportionally scaled topology; None when the topology cannot
+    scale to that size.  The copy rides ONLY the recovery
+    requirement — the service spec keeps the full-size pod, so a
+    later `pod replace` (or a scheduler restart's update plan)
+    restores full width when capacity returns."""
+    if target_hosts >= pod.count:
+        return pod
+    if pod.tpu is None:
+        return dataclasses.replace(pod, count=target_hosts)
+    if pod.tpu.slices > 1:
+        # multi-slice gangs do not shrink (yet): count must equal
+        # slices x hosts-per-slice and the dcn axis couples the slice
+        # count to the checkpoint layout — a naive count shrink would
+        # emit a requirement no evaluator can satisfy.  Refusing here
+        # keeps the replace step WAITING at full size, which is
+        # honest; dropping whole slices is future work.
+        return None
+    topo = shrink_topology(pod.tpu, target_hosts)
+    if topo is None:
+        return None
+    tpu = dataclasses.replace(pod.tpu, topology=topo)
+    return dataclasses.replace(pod, count=target_hosts, tpu=tpu)
+
+
+class ElasticGangStep(DeploymentStep):
+    """The gang recovery plan's replace step.
+
+    Starts as a PERMANENT whole-gang requirement.  Each declined offer
+    cycle feeds :func:`decide_resize`; when the rule says shrink, the
+    requirement is re-scoped in place to a smaller pod copy (count +
+    topology scaled) and the next evaluation places the narrower gang.
+    ``target_hosts`` is read by the trailing trim step to erase the
+    surplus instances' state so recovery does not chase ghosts.
+
+    ``maintenance_probe`` is a callable returning True while any
+    drained host has a finite maintenance window (recovery manager
+    closes it over the shared inventory)."""
+
+    def __init__(
+        self,
+        name: str,
+        pod: PodSpec,
+        tasks: Optional[List[str]],
+        backoff: Optional[Backoff],
+        policy: ElasticPolicy,
+        maintenance_probe: Optional[Callable[[], bool]] = None,
+        journal=None,
+    ):
+        self._full_pod = pod
+        self._tasks = list(tasks) if tasks is not None else None
+        self._policy = policy
+        self._maintenance_probe = maintenance_probe or (lambda: False)
+        self.journal = journal
+        self.target_hosts = pod.count
+        self._declines = 0
+        super().__init__(
+            name,
+            PodInstanceRequirement(
+                pod=pod,
+                instances=list(range(pod.count)),
+                recovery_type=RecoveryType.PERMANENT,
+                tasks_to_launch=list(self._tasks or []),
+            ),
+            backoff=backoff,
+        )
+
+    def update_offer_status(self, launched: bool) -> None:
+        with self._lock:
+            if launched:
+                self._declines = 0
+                return
+            self._declines += 1
+            decision = decide_resize(
+                self.target_hosts,
+                self._full_pod.count,
+                self._declines,
+                self._policy,
+                self._maintenance_probe(),
+            )
+            if decision.target_hosts >= self.target_hosts:
+                return
+            pod = shrunken_pod(self._full_pod, decision.target_hosts)
+            if pod is None:
+                return  # topology cannot scale to that size: keep waiting
+            self._rescope_locked(pod, decision)
+
+    def _rescope_locked(self, pod: PodSpec, decision: ResizeDecision) -> None:
+        self.target_hosts = pod.count
+        self._declines = 0
+        self.requirement = PodInstanceRequirement(
+            pod=pod,
+            instances=list(range(pod.count)),
+            recovery_type=RecoveryType.PERMANENT,
+            tasks_to_launch=list(self._tasks or []),
+        )
+        # the status-routing map must match the new scope, or a
+        # surplus instance's stale status could move this step
+        self._spec_by_full = {
+            task_full_name(pod.type, i, spec.name): spec
+            for i in self.requirement.instances
+            for spec in pod.tasks
+            if spec.name in self.requirement.tasks_to_launch
+        }
+        self._expected = {}
+        self._task_states = {}
+        self._task_ready = {}
+        if self.journal is not None:
+            self.journal.append(
+                "recovery",
+                pod=pod.type,
+                verb="elastic-shrink",
+                hosts=pod.count,
+                full=self._full_pod.count,
+                topology=pod.tpu.topology if pod.tpu else "",
+                message=(
+                    f"elastic re-slice: {decision.reason} "
+                    f"(topology {pod.tpu.topology if pod.tpu else 'n/a'})"
+                ),
+            )
+
+    def surplus_instances(self) -> List[int]:
+        """Instances of the FULL gang the current scope dropped — the
+        trim step erases their task state after the narrow gang is
+        running."""
+        with self._lock:
+            return list(range(self.target_hosts, self._full_pod.count))

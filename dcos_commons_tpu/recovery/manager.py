@@ -21,12 +21,14 @@ from dcos_commons_tpu.plan.phase import Phase
 from dcos_commons_tpu.plan.plan import RECOVERY_PLAN_NAME, Plan
 from dcos_commons_tpu.plan.plan_manager import PlanManager
 from dcos_commons_tpu.plan.step import (
+    ActionStep,
     DeploymentStep,
     PodInstanceRequirement,
     RecoveryType,
     Step,
 )
-from dcos_commons_tpu.plan.strategy import ParallelStrategy
+from dcos_commons_tpu.plan.strategy import ParallelStrategy, SerialStrategy
+from dcos_commons_tpu.recovery.elastic import ElasticGangStep, ElasticPolicy
 from dcos_commons_tpu.recovery.monitor import FailureMonitor, NeverFailureMonitor
 from dcos_commons_tpu.specification.specs import (
     GoalState,
@@ -76,6 +78,11 @@ class DefaultRecoveryPlanManager(PlanManager):
         # an operator can reconstruct WHEN a pod started recovering
         # long after the recovery plan pruned the completed phase
         self.journal = None
+        # the shared fleet inventory (set by the builder): the gang
+        # recovery phase's elastic step probes maintenance windows
+        # through it to choose waiting over shrinking.  None (hand-
+        # wired tests) means "no window ever promises capacity back".
+        self.inventory = None
 
     def _journal_phase(self, key: str, recovery_type, rebuilt: bool) -> None:
         if self.journal is None:
@@ -145,6 +152,13 @@ class DefaultRecoveryPlanManager(PlanManager):
                 tasks = self._launched_tasks(pod_type, instances)
             existing = self._phases.get(key)
             if existing is not None:
+                if getattr(existing, "gang_recovery", False):
+                    # the gang recovery phase IS the widest possible
+                    # scope (kill all -> unreserve -> re-place whole
+                    # gang, PERMANENT): nothing escalates past it, and
+                    # its ActionSteps must never be "rebuilt" by the
+                    # DeploymentStep-shaped widening logic below
+                    continue
                 if key in self._custom_keys:
                     # overrider choreography is authoritative: escalate
                     # its steps in place, never rebuild around it
@@ -384,6 +398,14 @@ class DefaultRecoveryPlanManager(PlanManager):
                 return phase
         self._custom_keys.discard(key)
         pod = self._spec.pod(pod_type)
+        if recovery_type is RecoveryType.PERMANENT and pod.gang and \
+                len(instances) > 1:
+            # whole-gang PERMANENT loss (preemption, operator replace,
+            # monitor escalation): a pile of per-task relaunches would
+            # leave survivors wedged in a dead collective and the
+            # broken sub-slice reserved — synthesize the plan-driven
+            # choreography instead
+            return self._make_gang_phase(pod, instances, tasks)
         requirement = PodInstanceRequirement(
             pod=pod, instances=instances, recovery_type=recovery_type,
             tasks_to_launch=tasks,
@@ -393,3 +415,162 @@ class DefaultRecoveryPlanManager(PlanManager):
         ) == 1 else f"recover-{pod_type}-gang"
         step = DeploymentStep(name, requirement, backoff=self._backoff)
         return Phase(name, [step], ParallelStrategy())
+
+    # -- gang-granular recovery (ISSUE 13) ----------------------------
+
+    def _maintenance_returning(self, pod) -> bool:
+        """True while some drained host's FINITE maintenance window
+        (still in the future) could actually restore a full-size
+        placement for ``pod`` — the elastic rule then waits instead
+        of shrinking through it.
+
+        Scoped to slices that could hold the gang: a window on an
+        unrelated slice too small for the gang must NOT suppress the
+        shrink (on a fleet doing routine rolling maintenance, some
+        host always has a window somewhere — fleet-global waiting
+        would disable elastic exactly at the scale it exists for).
+        A slice qualifies when its hosts that are up-or-returning
+        (up now, or draining with a finite future window) reach the
+        gang's host count."""
+        inventory = self.inventory
+        if inventory is None or not hasattr(inventory, "maintenance_hosts"):
+            return False
+        now = time.time()
+        returning = {
+            h for h, end in inventory.maintenance_hosts().items()
+            if end > now
+        }
+        if not returning:
+            return False
+        by_slice: Dict[str, List[str]] = {}
+        for host in inventory.hosts():
+            by_slice.setdefault(host.slice_id, []).append(host.host_id)
+        need = pod.count
+        for host_id in returning:
+            host = inventory.host(host_id)
+            if host is None:
+                continue
+            usable = [
+                h for h in by_slice.get(host.slice_id, ())
+                if h in returning or inventory.host_state(h) == "up"
+            ]
+            if len(usable) >= need:
+                return True
+        return False
+
+    def _make_gang_phase(
+        self,
+        pod,
+        instances: List[int],
+        tasks: Optional[List[str]],
+    ) -> Phase:
+        """The gang recovery choreography, one serial phase:
+
+            kill-survivors   a worker that lost a gang peer is wedged
+                             in a dead collective — reap every live
+                             member (tasks whose process no agent
+                             reports count as already dead)
+            unreserve-slice  release the broken footprint so the
+                             re-placement may claim freed capacity
+                             (incl. the survivors' own hosts)
+            replace-gang     re-place the WHOLE gang PERMANENT,
+                             honoring torus adjacency; shrinks to a
+                             smaller mesh when the pod is elastic and
+                             the decision rule allows
+            trim-surplus     after an elastic shrink, erase the
+                             surplus instances' task state so the
+                             failure scan stops chasing ghosts
+
+        Restart-safe by construction: every step is idempotent (a
+        successor that re-runs kill/unreserve against an already-clean
+        world completes them immediately) and the replace step's
+        incarnation fencing (utils/checkpoint.py) makes any zombie
+        survivor's late writes harmless.
+        """
+        names = sorted(self._required_tasks(pod.type, instances, tasks))
+        assets = {pod_instance_name(pod.type, i) for i in instances}
+        phase_name = f"recover-{pod.type}-gang"
+
+        def kill_survivors(scheduler) -> bool:
+            pending = False
+            active = scheduler.agent.active_task_ids()
+            for full in names:
+                info = scheduler.state_store.fetch_task(full)
+                if info is None:
+                    continue
+                status = scheduler.state_store.fetch_status(full)
+                if status is not None and status.task_id == info.task_id \
+                        and status.state.is_terminal:
+                    continue
+                if info.task_id not in active:
+                    # no agent runs this process (preempted host, an
+                    # already-reaped kill whose status was lost): dead
+                    # in fact, even without a terminal status
+                    continue
+                scheduler.task_killer.kill(info.task_id)
+                pending = True
+            return not pending
+
+        def unreserve_slice(scheduler) -> bool:
+            released = 0
+            for full in names:
+                for res in list(scheduler.ledger.for_task(full)):
+                    scheduler.ledger.release(res.reservation_id)
+                    released += 1
+            if released and scheduler.journal is not None:
+                scheduler.journal.append(
+                    "recovery", pod=pod.type, verb="unreserve",
+                    reservations=released,
+                    message=f"released {released} reservation(s) of the "
+                            f"broken {pod.type} gang sub-slice",
+                )
+            return True
+
+        policy = ElasticPolicy(
+            enabled=bool(pod.tpu is not None and pod.tpu.elastic),
+            min_hosts=pod.tpu.min_hosts if pod.tpu is not None else 1,
+        )
+        replace = ElasticGangStep(
+            f"replace-{pod.type}-gang",
+            pod,
+            tasks,
+            self._backoff,
+            policy,
+            maintenance_probe=lambda: self._maintenance_returning(pod),
+            journal=self.journal,
+        )
+
+        def trim_surplus(scheduler) -> bool:
+            erased = 0
+            for i in replace.surplus_instances():
+                for task_spec in pod.tasks:
+                    full = task_full_name(pod.type, i, task_spec.name)
+                    for res in list(scheduler.ledger.for_task(full)):
+                        scheduler.ledger.release(res.reservation_id)
+                    if scheduler.state_store.fetch_task(full) is not None:
+                        scheduler.state_store.clear_task(full)
+                        erased += 1
+            if erased and scheduler.journal is not None:
+                scheduler.journal.append(
+                    "recovery", pod=pod.type, verb="trim-surplus",
+                    tasks=erased,
+                    message=f"erased {erased} surplus task(s) after "
+                            f"elastic re-slice of {pod.type}",
+                )
+            return True
+
+        steps: List[Step] = [
+            ActionStep(
+                f"kill-{pod.type}-survivors", kill_survivors, assets=assets
+            ),
+            ActionStep(
+                f"unreserve-{pod.type}-slice", unreserve_slice, assets=assets
+            ),
+            replace,
+            ActionStep(
+                f"trim-{pod.type}-surplus", trim_surplus, assets=assets
+            ),
+        ]
+        phase = Phase(phase_name, steps, SerialStrategy())
+        phase.gang_recovery = True
+        return phase

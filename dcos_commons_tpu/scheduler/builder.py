@@ -239,6 +239,9 @@ class SchedulerBuilder:
             state_store, ledger, target_spec.name, target_id
         )
         inventory = self._inventory or SliceInventory()
+        # gang recovery's elastic step probes maintenance windows
+        # through the shared inventory (wait-for-window beats shrink)
+        recovery_manager.inventory = inventory
         agent = self._agent
         if agent is None:
             from dcos_commons_tpu.agent.local import LocalProcessAgent
@@ -345,6 +348,7 @@ class SchedulerBuilder:
                     self._config.health_telemetry_interval_s
                 ),
                 history_interval_s=self._config.health_history_interval_s,
+                auto_replace=self._config.health_auto_replace,
             )
         else:
             health_monitor = NullHealthMonitor()

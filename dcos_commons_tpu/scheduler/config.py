@@ -65,6 +65,12 @@ class SchedulerConfig:
     health_history_interval_s: float = 1.0
     health_straggler_ratio: float = 2.0
     health_straggler_window: int = 32
+    # health -> action seam (ISSUE 13 satellite), DEFAULT OFF: a
+    # confirmed straggler episode on a host carrying a gang member
+    # triggers at most one automated pod replace (riding the gang
+    # recovery plan) per episode.  Opt-in — automated eviction must
+    # be an operator decision.
+    health_auto_replace: bool = False
     health_ttft_p95_slo_s: float = 0.0
     health_queue_depth_slo: float = 0.0
     health_kv_occupancy_slo: float = 0.0
@@ -129,6 +135,8 @@ class SchedulerConfig:
             health_straggler_window=int(
                 env.get("HEALTH_STRAGGLER_WINDOW", "32")
             ),
+            health_auto_replace=env.get("HEALTH_AUTO_REPLACE", "")
+            not in ("", "0", "false"),
             health_ttft_p95_slo_s=float(env.get("SERVE_TTFT_SLO_S", "0")),
             health_queue_depth_slo=float(
                 env.get("SERVE_QUEUE_DEPTH_SLO", "0")

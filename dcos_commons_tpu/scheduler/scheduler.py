@@ -1026,6 +1026,93 @@ class DefaultScheduler:
             self.nudge()  # override relaunch work just became pending
             return touched
 
+    # -- host lifecycle verbs (ISSUE 13: preemption & maintenance) ----
+
+    def drain_host(self, host_id: str, window_s: float = 0.0) -> bool:
+        """Operator ``POST /v1/hosts/<id>/drain``: mark the host for
+        maintenance.  Placement excludes it immediately (hard
+        exclusion at admission); running work keeps running (soft
+        drain) and the /v1/endpoints backend rows surface it as
+        ``draining`` so the serving front door stops routing new
+        requests BEFORE anything is killed.  ``window_s`` > 0 records
+        when the window ends — the elastic-resize rule prefers
+        waiting out a finite window over shrinking a gang."""
+        import time as _time
+
+        with self._lock:
+            window_end = _time.time() + window_s if window_s > 0 else 0.0
+            changed = self.inventory.set_maintenance(host_id, window_end)
+            if changed:
+                self.journal.append(
+                    "host", verb="drain", host=host_id,
+                    window_s=window_s,
+                    message=f"host {host_id} entering maintenance"
+                            + (f" ({window_s:.0f}s window)"
+                               if window_s > 0 else ""),
+                )
+            self.nudge()
+            return changed
+
+    def undrain_host(self, host_id: str) -> bool:
+        """Operator ``POST /v1/hosts/<id>/up``: clear every
+        preempted/maintenance/down mark and return the host to full
+        placement eligibility."""
+        with self._lock:
+            changed = self.inventory.clear_host_state(host_id)
+            if changed:
+                self.journal.append(
+                    "host", verb="up", host=host_id,
+                    message=f"host {host_id} back in service",
+                )
+            self.nudge()
+            return changed
+
+    def preempt_host(self, host_id: str) -> List[str]:
+        """Operator ``POST /v1/hosts/<id>/preempt`` (or the agent
+        plane's preemption notice): the cloud took the host back.
+        Marks it preempted in the inventory and surfaces the loss to
+        THIS service's tasks — see :meth:`note_host_preempted`."""
+        with self._lock:
+            self.inventory.set_preempted(host_id)
+            return self.note_host_preempted(host_id)
+
+    def note_host_preempted(self, host_id: str) -> List[str]:
+        """Every stored task on the preempted host is dead NOW and the
+        capacity is not coming back: stamp PERMANENTLY_FAILED (so
+        recovery goes straight to PERMANENT — for a gang member, the
+        gang recovery plan) and route a synthesized TASK_LOST through
+        the normal status path.  Idempotent: already-terminal tasks
+        are skipped, so a verb racing the agent plane's own
+        down-detection stamps each task once."""
+        from dcos_commons_tpu.common import TaskState
+
+        with self._lock:
+            touched: List[str] = []
+            for info in self.state_store.fetch_tasks():
+                if info.agent_id != host_id:
+                    continue
+                status = self.state_store.fetch_status(info.name)
+                if status is not None and status.task_id == info.task_id \
+                        and status.state.is_terminal:
+                    continue
+                self.state_store.store_tasks(
+                    [info.with_label(Label.PERMANENTLY_FAILED, "true")]
+                )
+                self._process_status(TaskStatus(
+                    task_id=info.task_id,
+                    state=TaskState.LOST,
+                    agent_id=host_id,
+                    message=f"host {host_id} preempted",
+                ))
+                touched.append(info.name)
+            self.journal.append(
+                "host", verb="preempt", host=host_id, tasks=len(touched),
+                message=f"host {host_id} preempted "
+                        f"({len(touched)} task(s) lost)",
+            )
+            self.nudge()  # gang recovery work just became pending
+            return touched
+
     def plans(self) -> Dict[str, Plan]:
         out = {}
         for manager in self.coordinator.plan_managers:

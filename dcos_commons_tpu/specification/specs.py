@@ -56,6 +56,14 @@ class TpuSpec:
     # parallel across slices is the standard recipe — the dcn mesh
     # axis).  count must equal slices * hosts-per-slice.
     slices: int = 1
+    # elastic re-slicing (ISSUE 13): a DP-sharded trainer gang that
+    # cannot re-place at full size after preemption may restart on a
+    # smaller mesh (a divisor of the gang size, never below
+    # ``min_hosts``) instead of waiting for capacity that is not
+    # coming back.  Opt-in — shrinking changes the effective batch
+    # layout and the operator must have designed for it.
+    elastic: bool = False
+    min_hosts: int = 1
 
     def topology_dims(self) -> Tuple[int, ...]:
         if not self.topology:

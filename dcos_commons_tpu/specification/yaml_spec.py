@@ -221,6 +221,8 @@ def _map_pod(
             chips_per_host=int(tpu_raw.get("chips-per-host", 4)),
             topology=str(tpu_raw.get("topology", "")),
             slices=int(tpu_raw.get("slices", 1)),
+            elastic=bool(tpu_raw.get("elastic", False)),
+            min_hosts=int(tpu_raw.get("min-hosts", 1)),
         )
     from dcos_commons_tpu.specification.specs import (
         merge_pod_uris,

@@ -54,8 +54,11 @@ from dcos_commons_tpu.testing.ticks import (
     ExpectTaskKilled,
     ExpectTaskNotKilled,
     ExpectTaskStateStored,
+    DrainHost,
+    HostUp,
     MarkHostDown,
     MarkHostUp,
+    PreemptHost,
     PlanContinue,
     PlanForceComplete,
     PlanInterrupt,
@@ -85,8 +88,11 @@ __all__ = [
     "SendTaskFailed",
     "AddHost",
     "RemoveHost",
+    "DrainHost",
+    "HostUp",
     "MarkHostDown",
     "MarkHostUp",
+    "PreemptHost",
     "AdvanceCycles",
     "PlanInterrupt",
     "PlanContinue",

@@ -378,6 +378,384 @@ class ChaosHarness:
                 )
 
 
+# -- host-level preemption storms (ISSUE 13) --------------------------
+
+# synthetic triggers beyond the span-boundary kinds:
+#   STORM_START      immediately after the healthy deploy completes —
+#                    the storm's initiating loss (span boundaries only
+#                    fire while the scheduler has work, so the FIRST
+#                    preemption cannot ride one)
+#   RECOVERY_ACTIVE  the first cycle boundary where the recovery plan
+#                    holds incomplete work — the storm-within-recovery
+#                    case (a second host dies while the first loss's
+#                    gang recovery plan is mid-flight)
+STORM_START = "start"
+RECOVERY_ACTIVE = "recovery-active"
+
+
+@dataclass(frozen=True)
+class PreemptSpec:
+    """Preempt ``hosts`` gang-carrying hosts when ``at`` fires for the
+    ``occurrence``-th time.  ``at`` is a span-boundary kind from
+    CHAOS_KINDS (the preemption lands MID-CYCLE, exactly where a
+    cloud reclaim would; counting starts once the storm is armed,
+    post-deploy), STORM_START, or RECOVERY_ACTIVE.
+    ``kill_scheduler`` also crashes the scheduler at the same
+    boundary — preemption and failover composed."""
+
+    at: str = STORM_START
+    occurrence: int = 1
+    hosts: int = 1
+    kill_scheduler: bool = False
+
+    def __post_init__(self):
+        allowed = CHAOS_KINDS + (STORM_START, RECOVERY_ACTIVE)
+        if self.at not in allowed:
+            raise ValueError(
+                f"unknown preemption trigger {self.at!r}; expected one "
+                f"of {allowed}"
+            )
+
+
+@dataclass
+class StormReport:
+    specs: Tuple[PreemptSpec, ...]
+    seed: int = 0
+    preempted: List[str] = field(default_factory=list)
+    incarnations: int = 1
+    cycles: int = 0
+    converged: bool = False
+    recoveries_seen: int = 0
+    final_task_ids: Dict[str, str] = field(default_factory=dict)
+    final_hosts: Dict[str, str] = field(default_factory=dict)
+
+    def describe(self) -> str:
+        return (
+            f"storm[specs={list(self.specs)} seed={self.seed} "
+            f"preempted={self.preempted} "
+            f"incarnations={self.incarnations} cycles={self.cycles} "
+            f"converged={self.converged}]"
+        )
+
+
+class _StormInjector:
+    """Installed as ``scheduler.chaos``: at the scheduled span
+    boundary it PHYSICALLY preempts K gang hosts (agent processes die
+    silently, inventory marks the capacity gone) — and optionally
+    raises SchedulerKilled on top.  Detection (the LOST synthesis) is
+    deliberately NOT done here: it happens at the next cycle boundary
+    through the same verb path production uses, so the window where
+    the store still believes in dead tasks is part of the test."""
+
+    def __init__(self, storm: "PreemptionStorm",
+                 specs: List[PreemptSpec]):
+        self.storm = storm
+        self.specs = list(specs)
+        self.hits: Dict[str, int] = {}
+
+    def __call__(self, kind: str) -> None:
+        self.hits[kind] = self.hits.get(kind, 0) + 1
+        fired = [
+            spec for spec in self.specs
+            if spec.at == kind and self.hits[kind] == spec.occurrence
+        ]
+        for spec in fired:
+            self.specs.remove(spec)
+            self.storm.preempt_now(spec.hosts)
+            if spec.kill_scheduler:
+                raise SchedulerKilled(kind, spec.occurrence)
+
+
+# three slices so a 4-host gang can re-place twice (the storm's
+# second preemption lands on the replacement slice)
+def storm_fleet(slices: int = 3) -> List[TpuHost]:
+    from dcos_commons_tpu.offer.inventory import make_test_fleet
+
+    hosts: List[TpuHost] = []
+    for i in range(slices):
+        hosts += make_test_fleet(
+            f"pod-{i}", host_grid=(2, 2), chip_block=(2, 2),
+            cpus=16.0, memory_mb=65536,
+        )
+    return hosts
+
+
+class PreemptionStorm:
+    """Deploy a gang, storm it with host preemptions at chosen span
+    boundaries (optionally composed with scheduler kills), converge,
+    and assert the gang-recovery invariants: zero double-reservations,
+    zero reservations left on preempted hosts, and EXACTLY ONE gang
+    incarnation running at the end (every older launch's processes
+    dead, every current task adopted by exactly one agent process).
+    FakeAgent mode — fast and deterministic, tier-1-runnable."""
+
+    def __init__(
+        self,
+        specs: List[PreemptSpec],
+        yaml_text: Optional[str] = None,
+        hosts: Optional[List[TpuHost]] = None,
+        seed: int = 0,
+        gang_pod: str = "trainer",
+    ):
+        self.specs = list(specs)
+        self.gang_pod = gang_pod
+        self.harness = ChaosHarness(
+            yaml_text=yaml_text or CHAOS_GANG_YAML,
+            hosts=hosts if hosts is not None else storm_fleet(),
+            seed=seed,
+        )
+        self.agent = self.harness.agent
+        self.scheduler: Optional[DefaultScheduler] = None
+        self.report = StormReport(specs=tuple(self.specs), seed=seed)
+        # preempted but not yet surfaced to the scheduler (the
+        # detection gap between the physical loss and the verb)
+        self._unnotified: set = set()
+        self._acked: set = set()
+
+    # -- injector callbacks -------------------------------------------
+
+    def preempt_now(self, k: int) -> None:
+        """Physically preempt up to ``k`` gang-carrying hosts NOW."""
+        scheduler = self.scheduler
+        assert scheduler is not None
+        by_host: Dict[str, int] = {}
+        for info in scheduler.state_store.fetch_tasks():
+            if info.pod_type == self.gang_pod:
+                by_host[info.agent_id] = by_host.get(info.agent_id, 0) + 1
+        victims = [
+            h for h in sorted(by_host)
+            if scheduler.inventory.host_state(h) != "preempted"
+        ][:k]
+        for host_id in victims:
+            self.agent.fail_host(host_id)
+            scheduler.inventory.set_preempted(host_id)
+            self.report.preempted.append(host_id)
+            self._unnotified.add(host_id)
+
+    # -- the storm loop -----------------------------------------------
+
+    def _gang_task_names(self, scheduler) -> List[str]:
+        pod = scheduler.spec.pod(self.gang_pod)
+        return [
+            f"{pod.type}-{i}-{t.name}"
+            for i in range(pod.count)
+            for t in pod.tasks
+        ]
+
+    def _ack_staging(self, scheduler) -> None:
+        for info in list(self.agent.launched):
+            if info.task_id in self._acked:
+                continue
+            if info.task_id not in self.agent.active_task_ids():
+                continue  # preempted before it could report
+            status = scheduler.state_store.fetch_status(info.name)
+            if status is not None and status.task_id == info.task_id \
+                    and status.state is TaskState.STAGING:
+                self._acked.add(info.task_id)
+                self.agent.send(TaskStatus(
+                    task_id=info.task_id, state=TaskState.RUNNING,
+                    ready=True, agent_id=info.agent_id,
+                ))
+
+    def _recovery_in_flight(self, scheduler) -> bool:
+        plan = scheduler.plan("recovery")
+        return plan is not None and bool(plan.phases) \
+            and not plan.is_complete
+
+    def _gang_converged(self, scheduler) -> bool:
+        if self._recovery_in_flight(scheduler):
+            return False
+        active = scheduler.agent.active_task_ids()
+        names = self._gang_task_names(scheduler)
+        seen = 0
+        for name in names:
+            info = scheduler.state_store.fetch_task(name)
+            if info is None:
+                continue  # trimmed by an elastic shrink
+            status = scheduler.state_store.fetch_status(name)
+            if status is None or status.task_id != info.task_id or \
+                    status.state is not TaskState.RUNNING or \
+                    info.task_id not in active:
+                return False
+            seen += 1
+        return seen > 0
+
+    def run(self, timeout_s: float = 60.0) -> StormReport:
+        scheduler = self.harness.build_scheduler()
+        self.scheduler = scheduler
+        report = self.report
+        deadline = time.monotonic() + timeout_s
+        # phase 1: the healthy deploy, chaos-free — the storm hits a
+        # RUNNING gang, not a rollout
+        while time.monotonic() < deadline:
+            scheduler.run_cycle()
+            report.cycles += 1
+            self._ack_staging(scheduler)
+            if scheduler.deploy_manager.get_plan().is_complete:
+                break
+        assert scheduler.deploy_manager.get_plan().is_complete, (
+            f"deploy never completed before the storm: "
+            f"{report.describe()}"
+        )
+        # phase 2: arm the storm.  Span-boundary occurrence counting
+        # starts HERE, so `post-evaluate occurrence 1` means the first
+        # post-evaluate the storm's own recovery work causes.
+        injector = _StormInjector(
+            self,
+            [s for s in self.specs
+             if s.at not in (RECOVERY_ACTIVE, STORM_START)],
+        )
+        recovery_specs = [
+            s for s in self.specs if s.at == RECOVERY_ACTIVE
+        ]
+        recovery_hits = 0
+        scheduler.chaos = injector
+        for spec in [s for s in self.specs if s.at == STORM_START]:
+            self.preempt_now(spec.hosts)
+            if spec.kill_scheduler:
+                report.incarnations += 1
+                scheduler = self.harness.build_scheduler()
+                self.scheduler = scheduler
+                scheduler.chaos = injector
+        while time.monotonic() < deadline:
+            try:
+                scheduler.run_cycle()
+                report.cycles += 1
+                # detection: surface physical preemptions through the
+                # production verb path (stamp + LOST + gang recovery).
+                # Inside the try: the verb routes statuses through the
+                # same span boundaries, so a kill_scheduler spec can
+                # fire HERE too — that is a real failover timing
+                for host_id in sorted(self._unnotified):
+                    # discard AFTER the verb completes: a scheduler
+                    # kill mid-verb leaves the host unnotified and
+                    # the successor repeats the (idempotent) verb
+                    scheduler.note_host_preempted(host_id)
+                    self._unnotified.discard(host_id)
+                if recovery_specs and self._recovery_in_flight(scheduler):
+                    recovery_hits += 1
+                    fired = [
+                        s for s in recovery_specs
+                        if s.occurrence == recovery_hits
+                    ]
+                    for spec in fired:
+                        recovery_specs.remove(spec)
+                        self.preempt_now(spec.hosts)
+            except SchedulerKilled:
+                # failover composed with the preemption: successor
+                # over the same persister + inventory + agent
+                report.incarnations += 1
+                scheduler = self.harness.build_scheduler()
+                self.scheduler = scheduler
+                scheduler.chaos = injector
+                continue
+            if self._recovery_in_flight(scheduler):
+                report.recoveries_seen += 1
+            self._ack_staging(scheduler)
+            if not injector.specs and not recovery_specs and \
+                    not self._unnotified and \
+                    scheduler.deploy_manager.get_plan().is_complete and \
+                    self._gang_converged(scheduler):
+                report.converged = True
+                break
+        if injector.specs or recovery_specs:
+            raise AssertionError(
+                f"preemption trigger(s) never fired: "
+                f"{injector.specs + recovery_specs}: {report.describe()}"
+            )
+        for info in scheduler.state_store.fetch_tasks():
+            report.final_task_ids[info.name] = info.task_id
+            report.final_hosts[info.name] = info.agent_id
+        self.assert_invariants(scheduler, report)
+        return report
+
+    # -- the preemption invariants ------------------------------------
+
+    def assert_invariants(self, scheduler, report: StormReport) -> None:
+        describe = report.describe()
+        assert report.converged, f"storm never converged: {describe}"
+
+        # 1. no reservation survives on a preempted host, and no chip
+        #    is claimed twice anywhere (the re-slice was clean)
+        claimed: Dict[tuple, str] = {}
+        for reservation in scheduler.ledger.all():
+            assert reservation.host_id not in report.preempted, (
+                f"reservation {reservation.reservation_id} orphaned on "
+                f"preempted host {reservation.host_id}: {describe}"
+            )
+            for chip in reservation.chip_ids:
+                key = (reservation.host_id, chip)
+                assert key not in claimed, (
+                    f"chip {key} double-reserved: {describe}"
+                )
+                claimed[key] = reservation.reservation_id
+
+        # 2. exactly ONE gang incarnation is running: every stored
+        #    gang task's CURRENT id is alive on the agent, and no id
+        #    from any older gang launch survives anywhere
+        active = scheduler.agent.active_task_ids()
+        current_ids = set()
+        for name in self._gang_task_names(scheduler):
+            info = scheduler.state_store.fetch_task(name)
+            if info is None:
+                continue  # elastically trimmed
+            current_ids.add(info.task_id)
+            assert info.task_id in active, (
+                f"{name} has no live process: {describe}"
+            )
+            assert info.agent_id not in report.preempted, (
+                f"{name} placed on preempted host {info.agent_id}: "
+                f"{describe}"
+            )
+        stale = {
+            launched.task_id
+            for launched in self.agent.launched
+            if launched.pod_type == self.gang_pod
+            and launched.task_id not in current_ids
+        }
+        assert not (stale & active), (
+            f"zombie gang incarnation still running: "
+            f"{sorted(stale & active)}: {describe}"
+        )
+
+        # 3. torus adjacency held: a single-slice gang landed in ONE
+        #    slice (find_subslice's contract; trivially true for the
+        #    elastic-shrunk gang too)
+        slices = {
+            scheduler.inventory.host(h).slice_id
+            for h in set(report.final_hosts.values())
+            if scheduler.inventory.host(h) is not None
+        }
+        pod = scheduler.spec.pod(self.gang_pod)
+        if pod.tpu is not None and pod.tpu.topology and pod.tpu.slices == 1:
+            gang_hosts = {
+                host for name, host in report.final_hosts.items()
+                if name.startswith(f"{self.gang_pod}-")
+            }
+            gang_slices = {
+                scheduler.inventory.host(h).slice_id
+                for h in gang_hosts
+                if scheduler.inventory.host(h) is not None
+            }
+            assert len(gang_slices) <= 1, (
+                f"gang split across slices {sorted(gang_slices)}: "
+                f"{describe}"
+            )
+        del slices
+
+        # 4. the WAL/status consistency the chaos harness promises
+        for info in scheduler.state_store.fetch_tasks():
+            status = scheduler.state_store.fetch_status(info.name)
+            assert status is not None and \
+                status.task_id == info.task_id, (
+                    f"task {info.name} has no status for its launch: "
+                    f"{describe}"
+                )
+
+    def shutdown(self) -> None:
+        self.harness.shutdown()
+
+
 class ChaosMatrix:
     """The full kill matrix: every kind x a set of occurrences, run
     order shuffled by ``seed`` (recorded in every report so failures

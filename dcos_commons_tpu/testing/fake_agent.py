@@ -147,6 +147,22 @@ class FakeAgent:
                     pass
         return out
 
+    def fail_host(self, host_id: str) -> List[str]:
+        """Preemption semantics: every task process on ``host_id``
+        dies SILENTLY — no terminal status is ever reported (the
+        machine is gone, nothing is left to report it).  Returns the
+        reaped task ids.  Detection is the control plane's job: the
+        preempt verb / agent plane synthesizes the TASK_LOSTs."""
+        with self._lock:
+            gone = [
+                task_id
+                for task_id, info in self._active.items()
+                if info.agent_id == host_id
+            ]
+            for task_id in gone:
+                self._active.pop(task_id, None)
+            return gone
+
     def shutdown(self) -> None:
         with self._lock:
             self._active.clear()

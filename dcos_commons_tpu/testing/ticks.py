@@ -145,6 +145,53 @@ class MarkHostUp(Send):
         world.inventory.mark_up(self.host_id)
 
 
+class PreemptHost(Send):
+    """TPU preemption (ISSUE 13): the host's task processes die
+    silently, then the scheduler is told (the operator verb / agent
+    plane path) — tasks are stamped PERMANENTLY_FAILED and LOST, and
+    a gang member's loss synthesizes the gang recovery plan."""
+
+    def __init__(self, host_id: str):
+        self.host_id = host_id
+
+    def mutate(self, world: SimulationWorld) -> None:
+        fail = getattr(world.agent, "fail_host", None)
+        if callable(fail):
+            fail(self.host_id)
+        world.scheduler.preempt_host(self.host_id)
+
+    def describe(self) -> str:
+        return f"PreemptHost({self.host_id})"
+
+
+class DrainHost(Send):
+    """Maintenance drain: placement excludes the host immediately,
+    serve backends surface draining, running work keeps running."""
+
+    def __init__(self, host_id: str, window_s: float = 0.0):
+        self.host_id = host_id
+        self.window_s = window_s
+
+    def mutate(self, world: SimulationWorld) -> None:
+        world.scheduler.drain_host(self.host_id, window_s=self.window_s)
+
+    def describe(self) -> str:
+        return f"DrainHost({self.host_id})"
+
+
+class HostUp(Send):
+    """Clear preempted/maintenance/down marks (the `up` verb)."""
+
+    def __init__(self, host_id: str):
+        self.host_id = host_id
+
+    def mutate(self, world: SimulationWorld) -> None:
+        world.scheduler.undrain_host(self.host_id)
+
+    def describe(self) -> str:
+        return f"HostUp({self.host_id})"
+
+
 class _PlanVerb(Send):
     """Plan lifecycle verbs (reference: PlansQueries.java:47-231)."""
 

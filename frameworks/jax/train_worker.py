@@ -67,6 +67,41 @@ def main() -> int:
         "0", "false"
     )
     mesh = mesh_from_env(os.environ)
+    if os.environ.get("TPU_TOPOLOGY"):
+        # elastic-DP resume guard (ISSUE 13): when the devices actually
+        # present disagree with the DECLARED topology (a resized
+        # relaunch), proceeding is only safe if the change is a pure
+        # batch-axis (dp/dcn) re-layout — params and optimizer state
+        # replicate over those axes, so the fenced checkpoint restores
+        # bit-identically onto the new mesh.  A model-axis change
+        # (tp/fsdp/...) would silently train a different parallelism:
+        # refuse loudly.  TRAIN_ELASTIC_DP=1 opts in; the scheduler's
+        # own elastic re-slice rewrites TPU_TOPOLOGY consistently and
+        # never needs the flag.
+        from dcos_commons_tpu.parallel.mesh import (
+            derive,
+            elastic_reshard_ok,
+        )
+
+        declared = derive(os.environ)
+        actual = derive(os.environ, n_devices=mesh.devices.size)
+        if actual != declared:
+            elastic = os.environ.get("TRAIN_ELASTIC_DP", "0") not in (
+                "0", "false"
+            )
+            if not elastic or not elastic_reshard_ok(declared, actual):
+                raise RuntimeError(
+                    f"mesh mismatch: declared topology derives {declared} "
+                    f"but {mesh.devices.size} device(s) derive {actual}; "
+                    "only a dp/dcn change is elastically resumable "
+                    "(set TRAIN_ELASTIC_DP=1 to allow it)"
+                )
+            print(
+                f"elastic-dp resume: {declared.total} -> {actual.total} "
+                f"chips (dp {declared.dp}->{actual.dp}, dcn "
+                f"{declared.dcn}->{actual.dcn}); checkpoint reshards as "
+                "a pure re-layout", flush=True,
+            )
     # the env->config contract lives in models/transformer.py so
     # analysis/shardcheck verifies the EXACT model this pod trains
     config = config_from_env(os.environ, dtype=jnp.bfloat16)

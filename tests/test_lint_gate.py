@@ -1415,6 +1415,72 @@ def test_plancheck_repo_gate():
         assert not result.truncated, result.config
         assert result.livelock_checked, result.config
         assert result.complete_states > 0, result.config
+    # the gang-recovery configuration (ISSUE 13) is part of the gate
+    # and must ITSELF clear the 10k-state bar: the kill/unreserve/
+    # replace choreography x old-process deaths x operator verbs is
+    # where the split-brain and double-reservation interleavings live
+    by_name = {r.config: r for r in summary.results}
+    assert "gang-recovery" in by_name, sorted(by_name)
+    assert by_name["gang-recovery"].states >= 10_000, summary.render()
+
+
+def test_plancheck_catches_unordered_gang_recovery():
+    """Seeded bug: a gang recovery phase whose strategy does NOT
+    serialize kill -> unreserve -> replace lets the replacement gang
+    launch while old processes live and old claims stand — both new
+    invariants must fire with minimal traces."""
+    from dcos_commons_tpu.plan.phase import Phase
+    from dcos_commons_tpu.plan.plan import Plan
+    from dcos_commons_tpu.plan.step import (
+        ActionStep,
+        DeploymentStep,
+        PodInstanceRequirement,
+    )
+    from dcos_commons_tpu.plan.strategy import ParallelStrategy
+    from dcos_commons_tpu.specification.specs import (
+        GoalState,
+        PodSpec,
+        TaskSpec,
+    )
+
+    def broken():
+        pod = PodSpec(
+            type="trainer", count=2, gang=True,
+            tasks=[TaskSpec(name="worker", goal=GoalState.RUNNING,
+                            cmd="train")],
+        )
+        replace = DeploymentStep(
+            "replace-trainer-gang",
+            PodInstanceRequirement(pod=pod, instances=[0, 1]),
+            backoff=plancheck.ModelBackoff(),
+        )
+        kill = ActionStep("kill-trainer-survivors", lambda s: False)
+        unreserve = ActionStep(
+            "unreserve-trainer-slice", lambda s: False
+        )
+        world = plancheck.GangRecoveryWorld(kill, unreserve, replace)
+        kill._action = world.kill_survivors
+        unreserve._action = world.unreserve_slice
+        phase = Phase(
+            "recover-trainer-gang", [kill, unreserve, replace],
+            ParallelStrategy(),  # SEEDED BUG: no ordering
+        )
+        plan = Plan("recovery", [phase], ParallelStrategy())
+        world.bind(plan)
+        return plan, world
+
+    result = plancheck.check_plan(
+        broken, config_name="broken-gang", max_states=50_000,
+        max_violations=6, check_livelock=False,
+    )
+    names = {v.invariant for v in result.violations}
+    assert "no-split-brain-gang" in names, result.violations
+    assert "no-double-reservation" in names, result.violations
+    shortest = min(
+        len(v.trace) for v in result.violations
+        if v.invariant == "no-double-reservation"
+    )
+    assert shortest == 1  # launch(replace) alone exposes it
 
 
 # -- plancheck: seeded bugs produce minimal traces --------------------

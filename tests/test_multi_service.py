@@ -234,3 +234,67 @@ def test_uninstall_one_service_spares_others():
     assert gone_id in multi.agent.kills
     assert keep_id not in multi.agent.kills
     assert keep_id in multi.agent.active_task_ids()
+
+
+def test_orphan_index_equivalent_to_full_scan():
+    """The incremental orphan index (ISSUE 13 satellite, PR 9
+    remainder) must match the full O(services x tasks) scan after
+    EVERY mutation kind: launches, status-driven kills, task
+    erasure, service add and uninstall."""
+    import random
+
+    multi = make_multi(hosts=6)
+    rng = random.Random(7)
+    multi.add_service(from_yaml(svc_yaml("alpha", count=2)))
+    multi.add_service(from_yaml(svc_yaml("beta", count=2)))
+
+    def full_scan():
+        return {
+            info.task_id
+            for service in multi.services().values()
+            for info in service.state_store.fetch_tasks()
+        }
+
+    def check():
+        services = multi.services()
+        incremental = multi._expected_task_ids(services)
+        assert incremental == full_scan()
+        # second read is the cached path — must agree too
+        assert multi._expected_task_ids(services) == full_scan()
+
+    check()
+    for _ in range(30):
+        op = rng.choice(["cycle", "ack", "fail", "clear"])
+        if op == "cycle":
+            multi.run_cycle()
+        elif op == "ack":
+            launched = list(multi.agent.launched)
+            if launched:
+                info = rng.choice(launched)
+                multi.agent.send(TaskStatus(
+                    task_id=info.task_id, state=TaskState.RUNNING,
+                    ready=True))
+                multi.run_cycle()
+        elif op == "fail":
+            launched = list(multi.agent.launched)
+            if launched:
+                info = rng.choice(launched)
+                multi.agent.send(TaskStatus(
+                    task_id=info.task_id, state=TaskState.FAILED))
+                multi.run_cycle()
+        elif op == "clear":
+            service = multi.get_service(rng.choice(["alpha", "beta"]))
+            names = service.state_store.fetch_task_names()
+            if names:
+                service.state_store.clear_task(rng.choice(names))
+        check()
+    # service teardown drops its ids from the union and the index
+    multi.uninstall_service("beta")
+    deadline = 40
+    while "beta" in multi.services() and deadline:
+        multi.run_cycle()
+        check()
+        deadline -= 1
+    assert "beta" not in multi.services(), "uninstall never finished"
+    multi._expected_task_ids(multi.services())  # prunes removed services
+    assert "beta" not in multi._orphan_index

@@ -1,0 +1,884 @@
+"""Preemption, maintenance windows, and elastic re-slicing (ISSUE 13).
+
+Covers the whole spine: inventory host states and placement
+exclusion, the drain/preempt/up operator verbs (HTTP + journal),
+pre-kill draining in /v1/endpoints, the gang-granular recovery plan
+(kill survivors -> unreserve -> re-place honoring torus adjacency),
+elastic shrink with surplus trim, the preemption-storm chaos matrix
+(every span-boundary kind, storm-within-recovery, scheduler-kill
+composition), checkpoint fencing of a zombie pre-preemption writer,
+bit-identical elastic restore across a dp re-layout, and the health
+auto-replace seam.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from dcos_commons_tpu.common import TaskState, TaskStatus
+from dcos_commons_tpu.offer.inventory import (
+    SliceInventory,
+    TpuHost,
+    make_test_fleet,
+)
+from dcos_commons_tpu.testing import (
+    AdvanceCycles,
+    DrainHost,
+    ExpectDeploymentComplete,
+    HostUp,
+    PreemptHost,
+    SendTaskRunning,
+    ServiceTestRunner,
+)
+
+GANG_YAML = """
+name: preemptsvc
+pods:
+  trainer:
+    count: 4
+    gang: true
+    tpu:
+      generation: v5e
+      chips-per-host: 4
+      topology: 4x4
+    tasks:
+      worker:
+        goal: RUNNING
+        cmd: "train"
+        cpus: 1.0
+        memory: 256
+"""
+
+ELASTIC_YAML = GANG_YAML.replace(
+    "      topology: 4x4\n",
+    "      topology: 4x4\n      elastic: true\n      min-hosts: 2\n",
+).replace("name: preemptsvc", "name: elasticsvc")
+
+
+def two_slice_fleet():
+    return make_test_fleet("pod-a") + make_test_fleet("pod-b")
+
+
+def deploy_gang(yaml_text=GANG_YAML, hosts=None):
+    runner = ServiceTestRunner(
+        yaml_text, hosts=hosts if hosts is not None else two_slice_fleet()
+    )
+    runner.run([
+        AdvanceCycles(1),
+        *[SendTaskRunning(f"trainer-{i}-worker") for i in range(4)],
+        ExpectDeploymentComplete(),
+    ])
+    return runner
+
+
+def gang_hosts(scheduler):
+    return {
+        info.name: info.agent_id
+        for info in scheduler.state_store.fetch_tasks()
+    }
+
+
+def ack_new_launches(world, acked):
+    """RUNNING-ack every WAL'd launch whose process is still alive."""
+    scheduler = world.scheduler
+    for info in list(world.agent.launched):
+        if info.task_id in acked:
+            continue
+        if info.task_id not in world.agent.active_task_ids():
+            continue
+        status = scheduler.state_store.fetch_status(info.name)
+        if status is not None and status.task_id == info.task_id and \
+                status.state is TaskState.STAGING:
+            acked.add(info.task_id)
+            world.agent.send(TaskStatus(
+                task_id=info.task_id, state=TaskState.RUNNING,
+                ready=True, agent_id=info.agent_id,
+            ))
+
+
+def drive_to_recovered(world, cycles=20):
+    acked = set()
+    for _ in range(cycles):
+        world.scheduler.run_cycle()
+        ack_new_launches(world, acked)
+        if world.scheduler.plan("recovery").is_complete:
+            return True
+    return False
+
+
+# -- inventory host states --------------------------------------------
+
+
+def test_host_states_and_placement_exclusion():
+    inv = SliceInventory(make_test_fleet("pod-a"))
+    host = "pod-a-h0-0"
+    assert inv.host_state(host) == "up"
+    gen = inv.topology_generation
+
+    assert inv.set_maintenance(host)
+    assert inv.host_state(host) == "maintenance"
+    assert inv.topology_generation > gen
+    # maintenance: still UP (running work keeps running)...
+    assert inv.is_up(host)
+    # ...but hard-excluded from candidate sets and snapshots
+    assert host not in inv._up_ids()
+    snaps = inv.snapshots(_EmptyView())
+    assert host not in {s.host.host_id for s in snaps}
+
+    assert inv.clear_host_state(host)
+    assert inv.host_state(host) == "up"
+    assert host in inv._up_ids()
+
+    assert inv.set_preempted(host)
+    assert inv.host_state(host) == "preempted"
+    assert not inv.is_up(host)  # preempted = down with a cause
+    assert host not in inv._up_ids()
+    # mark_up (agent heartbeat) sheds the preemption mark
+    inv.mark_up(host)
+    assert inv.host_state(host) == "up"
+
+    # unknown hosts are refused, never dirty the fleet
+    gen = inv.topology_generation
+    assert not inv.set_preempted("nope")
+    assert not inv.set_maintenance("nope")
+    assert not inv.clear_host_state("nope")
+    assert inv.topology_generation == gen
+
+
+def test_maintenance_window_recorded():
+    inv = SliceInventory(make_test_fleet("pod-a"))
+    assert inv.set_maintenance("pod-a-h0-0", window_end=123.0)
+    assert inv.maintenance_window("pod-a-h0-0") == 123.0
+    assert inv.maintenance_hosts() == {"pod-a-h0-0": 123.0}
+    states = inv.host_states()
+    assert states["pod-a-h0-0"]["state"] == "maintenance"
+    assert states["pod-a-h0-0"]["window_end"] == 123.0
+    stats = inv.debug_stats()
+    assert stats["maintenance_hosts"] == {"pod-a-h0-0": 123.0}
+
+
+class _EmptyView:
+    def reserved_on(self, host_id):
+        return []
+
+
+def test_drain_blocks_new_placement_but_not_inplace_relaunch():
+    """Soft drain: a maintenance host takes no NEW work, but a
+    TRANSIENT crash of a pod already there relaunches in place."""
+    yaml_text = """
+name: drainsvc
+pods:
+  app:
+    count: 1
+    tasks:
+      server:
+        goal: RUNNING
+        cmd: "serve"
+        cpus: 0.1
+        memory: 32
+"""
+    runner = ServiceTestRunner(
+        yaml_text, hosts=[TpuHost(host_id=f"h{i}") for i in range(2)]
+    )
+    runner.run([
+        AdvanceCycles(1),
+        SendTaskRunning("app-0-server"),
+        ExpectDeploymentComplete(),
+    ])
+    world = runner.world
+    placed = gang_hosts(world.scheduler)["app-0-server"]
+    runner.run([DrainHost(placed)])
+    # transient crash: relaunch lands IN PLACE on the draining host
+    from dcos_commons_tpu.testing import SendTaskFailed
+
+    runner.run([SendTaskFailed("app-0-server"), AdvanceCycles(2)])
+    assert gang_hosts(world.scheduler)["app-0-server"] == placed
+    # journal carries the drain
+    kinds = [e["verb"] for e in world.scheduler.journal.events(
+        kinds=("host",))]
+    assert "drain" in kinds
+
+
+# -- the gang recovery plan -------------------------------------------
+
+
+def test_preemption_synthesizes_gang_recovery_plan():
+    runner = deploy_gang()
+    world = runner.world
+    scheduler = world.scheduler
+    before = gang_hosts(scheduler)
+    victim = before["trainer-0-worker"]
+    old_ids = {
+        info.name: info.task_id
+        for info in scheduler.state_store.fetch_tasks()
+    }
+
+    runner.run([PreemptHost(victim)])
+    # the choreography exists with the right shape and order
+    plan = scheduler.plan("recovery")
+    steps = [s.name for p in plan.phases for s in p.steps]
+    assert steps == [
+        "kill-trainer-survivors", "unreserve-trainer-slice",
+        "replace-trainer-gang", "trim-trainer-surplus",
+    ]
+    assert getattr(plan.phases[0], "gang_recovery", False)
+
+    assert drive_to_recovered(world)
+    after = gang_hosts(scheduler)
+    # whole gang re-placed (fresh ids), torus adjacency held: all four
+    # workers share ONE slice, and nothing sits on the preempted host
+    new_ids = {
+        info.name: info.task_id
+        for info in scheduler.state_store.fetch_tasks()
+    }
+    assert set(after) == set(before)
+    assert all(new_ids[n] != old_ids[n] for n in old_ids)
+    slices = {h.rsplit("-h", 1)[0] for h in after.values()}
+    assert len(slices) == 1
+    assert victim not in after.values()
+    # zero reservations left on the preempted host, no double-claims
+    assert not [
+        r for r in scheduler.ledger.all() if r.host_id == victim
+    ]
+    claimed = set()
+    for r in scheduler.ledger.all():
+        for chip in r.chip_ids:
+            assert (r.host_id, chip) not in claimed
+            claimed.add((r.host_id, chip))
+    # survivors were killed (wedged in a dead collective)
+    killed = set(world.agent.killed_names())
+    assert {"trainer-1-worker", "trainer-2-worker",
+            "trainer-3-worker"} <= killed
+    # journal tells the story
+    verbs = [
+        e.get("verb") for e in scheduler.journal.events(
+            kinds=("host", "recovery"))
+    ]
+    assert "preempt" in verbs and "unreserve" in verbs
+
+
+def test_elastic_shrink_when_capacity_cannot_return():
+    """No same-size sub-slice exists and nothing promises capacity
+    back: the elastic gang shrinks to a clean divisor, the surplus
+    instances are erased, and the shrunken env contract is coherent
+    (scaled topology, scaled worker count)."""
+    hosts = make_test_fleet("pod-a") + make_test_fleet(
+        "pod-b", host_grid=(2, 1)
+    )
+    runner = deploy_gang(ELASTIC_YAML, hosts=hosts)
+    world = runner.world
+    scheduler = world.scheduler
+    placed = gang_hosts(scheduler)
+    # two pod-a hosts die: only 2 fully-free hosts exist anywhere
+    victims = sorted(set(placed.values()))[:2]
+    runner.run([PreemptHost(victims[0]), PreemptHost(victims[1])])
+    assert drive_to_recovered(world)
+    after = gang_hosts(scheduler)
+    assert len(after) == 2  # trainer-2/3 trimmed
+    envs = {
+        info.name: info.env
+        for info in scheduler.state_store.fetch_tasks()
+    }
+    for env in envs.values():
+        assert env["TPU_TOPOLOGY"] == "4x2"
+        assert env["TPU_WORKER_COUNT"] == "2"
+    # surplus state erased: the failure scan chases no ghosts
+    assert scheduler.state_store.fetch_task("trainer-2-worker") is None
+    assert scheduler.state_store.fetch_task("trainer-3-worker") is None
+    scheduler.run_cycle()
+    assert scheduler.plan("recovery").is_complete
+    # journaled for the operator
+    verbs = [
+        e.get("verb")
+        for e in scheduler.journal.events(kinds=("recovery",))
+    ]
+    assert "elastic-shrink" in verbs and "trim-surplus" in verbs
+
+
+def test_elastic_waits_for_finite_maintenance_window():
+    """Drained hosts with a FINITE window promise the capacity back:
+    the decision rule waits instead of shrinking, and recovery
+    completes at FULL size once the window ends."""
+    # a full-size spare slice exists (pod-b) but two of its hosts sit
+    # in a finite maintenance window, so full-size placement is
+    # temporarily impossible after pod-a loses a host
+    hosts = two_slice_fleet()
+    runner = deploy_gang(ELASTIC_YAML, hosts=hosts)
+    world = runner.world
+    scheduler = world.scheduler
+    placed = gang_hosts(scheduler)
+    gang_slice = sorted(set(placed.values()))[0].rsplit("-h", 1)[0]
+    spare_slice = "pod-b" if gang_slice == "pod-a" else "pod-a"
+    drained = [f"{spare_slice}-h0-0", f"{spare_slice}-h1-0"]
+    runner.run([
+        DrainHost(drained[0], window_s=3600.0),
+        DrainHost(drained[1], window_s=3600.0),
+        PreemptHost(sorted(set(placed.values()))[0]),
+    ])
+    for _ in range(10):
+        scheduler.run_cycle()
+    plan = scheduler.plan("recovery")
+    replace = [
+        s for p in plan.phases for s in p.steps
+        if s.name == "replace-trainer-gang"
+    ]
+    assert replace and replace[0].target_hosts == 4  # no shrink
+    assert not plan.is_complete
+    # window ends -> the drained hosts return -> full-size recovery
+    runner.run([HostUp(drained[0]), HostUp(drained[1])])
+    assert drive_to_recovered(world)
+    after = gang_hosts(scheduler)
+    assert len(after) == 4
+    assert {h.rsplit("-h", 1)[0] for h in after.values()} == {
+        spare_slice
+    }
+
+
+def test_elastic_decision_rule_pure_properties():
+    from dcos_commons_tpu.recovery.elastic import (
+        ElasticPolicy,
+        decide_resize,
+        shrink_candidates,
+        shrink_topology,
+        shrunken_pod,
+    )
+    from dcos_commons_tpu.specification.specs import TpuSpec
+
+    off = ElasticPolicy(enabled=False)
+    on = ElasticPolicy(enabled=True, min_hosts=2, shrink_after_declines=3)
+
+    assert decide_resize(8, 8, 99, off, False).target_hosts == 8
+    assert decide_resize(8, 8, 2, on, False).target_hosts == 8  # budget
+    assert decide_resize(8, 8, 3, on, True).target_hosts == 8   # window
+    assert decide_resize(8, 8, 3, on, False).target_hosts == 4  # shrink
+    # shrink targets are divisors of the FULL size at/above the floor
+    assert shrink_candidates(8, 2) == [4, 2]
+    assert shrink_candidates(6, 1) == [3, 2, 1]
+    assert shrink_candidates(4, 3) == []  # 3 does not divide 4
+    # topology scales by halving the largest dimension
+    tpu = TpuSpec(chips_per_host=4, topology="4x4")
+    assert shrink_topology(tpu, 2) == "4x2"
+    assert shrink_topology(tpu, 1) == "2x2"
+    # a pod copy carries the scaled shape; the spec keeps full width
+    from dcos_commons_tpu.specification.yaml_spec import from_yaml
+
+    pod = from_yaml(ELASTIC_YAML).pod("trainer")
+    small = shrunken_pod(pod, 2)
+    assert small.count == 2 and small.tpu.topology == "4x2"
+    assert pod.count == 4 and pod.tpu.topology == "4x4"
+    # multi-slice gangs refuse to shrink: count couples to
+    # slices x hosts-per-slice and a naive shrink would emit a
+    # requirement no evaluator can satisfy
+    import dataclasses as _dc
+
+    multi = _dc.replace(pod, count=8, tpu=_dc.replace(pod.tpu, slices=2))
+    assert shrunken_pod(multi, 4) is None
+    # decide_resize shrinks onto divisors of the FULL size even from
+    # an already-shrunk width (8 -> 4 -> 2, never 3)
+    assert decide_resize(4, 8, 3, on, False).target_hosts == 2
+
+
+# -- HTTP surface ------------------------------------------------------
+
+
+def _get(server, path, expect=200):
+    try:
+        with urllib.request.urlopen(server.url + path) as resp:
+            code, raw = resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        code, raw = e.code, e.read()
+    assert code == expect, f"GET {path} -> {code}: {raw[:200]}"
+    return json.loads(raw.decode("utf-8"))
+
+
+def _post(server, path, body=None, expect=200):
+    data = json.dumps(body).encode() if body is not None else b""
+    req = urllib.request.Request(
+        server.url + path, method="POST", data=data,
+        headers={"Content-Type": "application/json"} if body else {},
+    )
+    try:
+        with urllib.request.urlopen(req) as resp:
+            code, raw = resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        code, raw = e.code, e.read()
+    assert code == expect, f"POST {path} -> {code}: {raw[:200]}"
+    return json.loads(raw.decode("utf-8"))
+
+
+SERVE_YAML = """
+name: servesvc
+pods:
+  web:
+    count: 1
+    tasks:
+      srv:
+        goal: RUNNING
+        cmd: "serve"
+        cpus: 0.1
+        memory: 32
+        ports:
+          http:
+            env-key: PORT_HTTP
+"""
+
+
+def test_host_verbs_and_pre_kill_endpoint_draining():
+    """The satellite bugfix: a host entering maintenance flips its
+    serve backends to draining in /v1/endpoints while the task is
+    still RUNNING and ready — BEFORE any kill fires — so the router
+    stops placing new requests there."""
+    from dcos_commons_tpu.http import ApiServer
+
+    runner = ServiceTestRunner(
+        SERVE_YAML, hosts=[TpuHost(host_id=f"h{i}") for i in range(2)]
+    )
+    runner.run([
+        AdvanceCycles(1),
+        SendTaskRunning("web-0-srv"),
+        ExpectDeploymentComplete(),
+    ])
+    world = runner.world
+    server = ApiServer(world.scheduler).start()
+    try:
+        hosts = _get(server, "/v1/hosts")["hosts"]
+        assert set(hosts) == {"h0", "h1"}
+        assert all(row["state"] == "up" for row in hosts.values())
+
+        placed = gang_hosts(world.scheduler)["web-0-srv"]
+        endpoint = _get(server, "/v1/endpoints/http")
+        row = endpoint["backends"][0]
+        assert row["state"] == "TASK_RUNNING" and not row["draining"]
+        generation = endpoint["generation"]
+
+        body = _post(
+            server, f"/v1/hosts/{placed}/drain", {"window_s": 60}
+        )
+        assert body["changed"] and body["state"] == "maintenance"
+        endpoint = _get(server, "/v1/endpoints/http")
+        row = endpoint["backends"][0]
+        # the task was NOT killed — it drains purely on host state
+        assert row["state"] == "TASK_RUNNING" and row["ready"]
+        assert row["draining"]
+        assert endpoint["generation"] != generation
+
+        body = _post(server, f"/v1/hosts/{placed}/up")
+        assert body["changed"]
+        row = _get(server, "/v1/endpoints/http")["backends"][0]
+        assert not row["draining"]
+
+        # preempt over HTTP: LOST tasks reported, state flips
+        body = _post(server, f"/v1/hosts/{placed}/preempt")
+        assert body["tasks_lost"] == ["web-0-srv"]
+        assert _get(server, "/v1/hosts")["hosts"][placed]["state"] == \
+            "preempted"
+
+        _post(server, "/v1/hosts/nope/drain", {}, expect=404)
+        _post(server, "/v1/hosts/nope/preempt", expect=404)
+    finally:
+        server.stop()
+
+
+def test_cli_host_verbs():
+    from dcos_commons_tpu.cli.commands import build_parser, run
+    from dcos_commons_tpu.http import ApiServer
+
+    runner = ServiceTestRunner(
+        SERVE_YAML, hosts=[TpuHost(host_id=f"h{i}") for i in range(2)]
+    )
+    runner.run([
+        AdvanceCycles(1),
+        SendTaskRunning("web-0-srv"),
+        ExpectDeploymentComplete(),
+    ])
+    server = ApiServer(runner.world.scheduler).start()
+    try:
+        parser = build_parser()
+        out = run(parser.parse_args(
+            ["--url", server.url, "host", "list"]
+        ))
+        assert set(out["hosts"]) == {"h0", "h1"}
+        out = run(parser.parse_args(
+            ["--url", server.url, "host", "drain", "h0",
+             "--window-s", "30"]
+        ))
+        assert out["state"] == "maintenance"
+        out = run(parser.parse_args(
+            ["--url", server.url, "host", "up", "h0"]
+        ))
+        assert out["state"] == "up"
+    finally:
+        server.stop()
+
+
+# -- preemption storms (chaos) ----------------------------------------
+
+
+def test_storm_single_preemption_converges():
+    from dcos_commons_tpu.testing.chaos import (
+        STORM_START,
+        PreemptSpec,
+        PreemptionStorm,
+    )
+
+    storm = PreemptionStorm([PreemptSpec(at=STORM_START, hosts=1)])
+    try:
+        report = storm.run(timeout_s=60.0)
+    finally:
+        storm.shutdown()
+    assert report.converged and len(report.preempted) == 1
+
+
+def test_storm_second_host_mid_recovery():
+    """The storm-within-recovery case: a second host dies while the
+    first loss's gang recovery plan is mid-flight.  Converges with
+    zero double-reservations and exactly one surviving incarnation
+    (assert_invariants inside run())."""
+    from dcos_commons_tpu.testing.chaos import (
+        RECOVERY_ACTIVE,
+        STORM_START,
+        PreemptSpec,
+        PreemptionStorm,
+    )
+
+    storm = PreemptionStorm([
+        PreemptSpec(at=STORM_START, hosts=1),
+        PreemptSpec(at=RECOVERY_ACTIVE, occurrence=2, hosts=1),
+    ])
+    try:
+        report = storm.run(timeout_s=60.0)
+    finally:
+        storm.shutdown()
+    assert report.converged and len(report.preempted) == 2
+
+
+def test_storm_composed_with_scheduler_kill():
+    """Preemption AND failover at one boundary: the successor
+    scheduler inherits the half-done recovery and converges it."""
+    from dcos_commons_tpu.testing.chaos import (
+        STORM_START,
+        PreemptSpec,
+        PreemptionStorm,
+    )
+
+    storm = PreemptionStorm([
+        PreemptSpec(at=STORM_START, hosts=1),
+        PreemptSpec(at="post-wal", occurrence=1, hosts=1,
+                    kill_scheduler=True),
+    ])
+    try:
+        report = storm.run(timeout_s=60.0)
+    finally:
+        storm.shutdown()
+    assert report.converged and report.incarnations == 2
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_storm_matrix_every_kill_point():
+    """K>=2 host kills across EVERY span-boundary kind, including
+    mid-recovery-plan — the acceptance matrix."""
+    from dcos_commons_tpu.testing.chaos import (
+        CHAOS_KINDS,
+        RECOVERY_ACTIVE,
+        STORM_START,
+        PreemptSpec,
+        PreemptionStorm,
+    )
+
+    cases = [
+        [PreemptSpec(at=STORM_START, hosts=2)],
+        [
+            PreemptSpec(at=STORM_START, hosts=2),
+            PreemptSpec(at=RECOVERY_ACTIVE, occurrence=1, hosts=1),
+        ],
+    ]
+    for kind in CHAOS_KINDS:
+        cases.append([
+            PreemptSpec(at=STORM_START, hosts=1),
+            PreemptSpec(at=kind, occurrence=1, hosts=1),
+        ])
+        cases.append([
+            PreemptSpec(at=STORM_START, hosts=1),
+            PreemptSpec(at=kind, occurrence=1, hosts=1,
+                        kill_scheduler=True),
+        ])
+    for specs in cases:
+        storm = PreemptionStorm(specs)
+        try:
+            report = storm.run(timeout_s=60.0)
+        finally:
+            storm.shutdown()
+        assert report.converged, report.describe()
+
+
+# -- checkpoint fencing + elastic restore -----------------------------
+
+
+def test_zombie_preempted_writer_late_save_is_fenced(tmp_path):
+    """A writer that survived preemption (network partition, zombie
+    VM) flushes one last save AFTER recovery relaunched a newer
+    incarnation: the save must be refused and restore must keep the
+    newer incarnation's frontier."""
+    import numpy as np
+
+    from dcos_commons_tpu.utils.checkpoint import (
+        StaleWriterError,
+        claim_incarnation,
+        restore_checkpoint,
+        save_checkpoint,
+    )
+
+    ckpt = str(tmp_path / "ckpt")
+    tree_v1 = {"w": np.arange(4, dtype=np.float32)}
+    inc1 = claim_incarnation(ckpt)
+    save_checkpoint(ckpt, 10, tree_v1, incarnation=inc1)
+
+    # the gang recovery relaunch claims the next incarnation and
+    # resumes from the newest fenced checkpoint
+    inc2 = claim_incarnation(ckpt)
+    assert inc2 > inc1
+    tree_v2 = {"w": np.arange(4, dtype=np.float32) * 2}
+    save_checkpoint(ckpt, 12, tree_v2, incarnation=inc2)
+
+    # the zombie's late flush is refused...
+    with pytest.raises(StaleWriterError):
+        save_checkpoint(
+            ckpt, 14, {"w": np.full(4, -1.0, np.float32)},
+            incarnation=inc1,
+        )
+    # ...and the frontier still belongs to the live incarnation
+    restored, step = restore_checkpoint(ckpt, {"w": np.zeros(4, np.float32)})
+    assert step == 12
+    assert np.array_equal(restored["w"], tree_v2["w"])
+
+
+def test_elastic_restore_is_bit_identical_across_dp_shrink():
+    """8-host -> 4-host DP shrink: params AND optimizer state restore
+    bit-identically (same leaves, new layout), and the shrunken mesh
+    trains.  Runs on the 8 forced CPU devices conftest provides."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from dcos_commons_tpu.models import (
+        config_from_env,
+        init_params,
+        make_train_step,
+    )
+    from dcos_commons_tpu.parallel.mesh import MeshSpec, make_mesh
+    from dcos_commons_tpu.utils import (
+        restore_checkpoint,
+        save_checkpoint,
+        synthetic_tokens,
+    )
+
+    devices = jax.devices()
+    if len(devices) < 8:
+        pytest.skip("needs 8 forced host devices")
+    config = config_from_env(
+        {"D_MODEL": "32", "N_LAYERS": "1", "N_HEADS": "2",
+         "N_KV_HEADS": "2", "D_FF": "64", "VOCAB": "64",
+         "SEQ_LEN": "16"},
+        dtype=jnp.float32,
+    )
+    optimizer = optax.adamw(1e-3)
+
+    mesh8 = make_mesh(MeshSpec(dp=8), devices=devices[:8])
+    with mesh8:
+        params = init_params(config, jax.random.key(0))
+        opt_state = optimizer.init(params)
+        step_fn = make_train_step(config, optimizer, mesh=mesh8)
+        tokens, targets = synthetic_tokens(
+            jax.random.key(1), 8, config.max_seq, config.vocab
+        )
+        params, opt_state, _loss = step_fn(
+            params, opt_state, tokens, targets
+        )
+        state8 = {"params": params, "opt_state": opt_state}
+        import tempfile
+
+        ckpt = tempfile.mkdtemp(prefix="elastic-ckpt-")
+        save_checkpoint(ckpt, 1, state8)
+        flat8 = jax.tree.leaves(state8)
+
+    # the SHRUNKEN mesh: same model axes, half the dp width
+    mesh4 = make_mesh(MeshSpec(dp=4), devices=devices[:4])
+    with mesh4:
+        params4 = init_params(config, jax.random.key(7))  # junk seed
+        state4 = {"params": params4, "opt_state": optimizer.init(params4)}
+        restored, step = restore_checkpoint(ckpt, state4)
+        assert step == 1
+        flat4 = jax.tree.leaves(restored)
+        assert len(flat4) == len(flat8)  # same leaves...
+        for a, b in zip(flat8, flat4):
+            assert np.array_equal(np.asarray(a), np.asarray(b)), \
+                "elastic restore must be bit-identical"
+        # ...new layout: the restored tree trains on the 4-wide mesh
+        step_fn4 = make_train_step(config, optimizer, mesh=mesh4)
+        tokens4, targets4 = synthetic_tokens(
+            jax.random.key(1), 8, config.max_seq, config.vocab
+        )
+        p, o, loss = step_fn4(
+            restored["params"], restored["opt_state"], tokens4, targets4
+        )
+        assert np.isfinite(float(loss))
+
+
+def test_resume_from_fenced_checkpoint_matches_unpreempted_run():
+    """Training resumed from the newest fenced checkpoint produces
+    EXACTLY the loss sequence an unpreempted run produces from that
+    checkpoint — preemption recovery loses wall time, never math."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from dcos_commons_tpu.models import (
+        config_from_env,
+        init_params,
+        make_train_step,
+    )
+    from dcos_commons_tpu.utils import (
+        restore_checkpoint,
+        save_checkpoint,
+        synthetic_tokens,
+    )
+
+    config = config_from_env(
+        {"D_MODEL": "32", "N_LAYERS": "1", "N_HEADS": "2",
+         "N_KV_HEADS": "2", "D_FF": "64", "VOCAB": "64",
+         "SEQ_LEN": "16"},
+        dtype=jnp.float32,
+    )
+    optimizer = optax.adamw(1e-3)
+    step_fn = make_train_step(config, optimizer, donate=False)
+    tokens, targets = synthetic_tokens(
+        jax.random.key(1), 4, config.max_seq, config.vocab
+    )
+
+    def run(params, opt_state, start, steps, save_at=None, ckpt=None):
+        losses = []
+        for i in range(start, steps):
+            params, opt_state, loss = step_fn(
+                params, opt_state, tokens, targets
+            )
+            losses.append(float(loss))
+            if save_at is not None and i + 1 == save_at:
+                save_checkpoint(
+                    ckpt, i + 1,
+                    {"params": params, "opt_state": opt_state},
+                )
+        return params, opt_state, losses
+
+    import tempfile
+
+    ckpt = tempfile.mkdtemp(prefix="resume-ckpt-")
+    params = init_params(config, jax.random.key(0))
+    opt_state = optimizer.init(params)
+    # the reference run: 6 uninterrupted steps, checkpoint at step 3
+    _p, _o, full_losses = run(
+        params, opt_state, 0, 6, save_at=3, ckpt=ckpt
+    )
+    # the preempted run: restore the step-3 checkpoint, finish 3..6
+    like = {
+        "params": init_params(config, jax.random.key(9)),
+        "opt_state": opt_state,
+    }
+    state, start = restore_checkpoint(ckpt, like)
+    assert start == 3
+    _p, _o, resumed_losses = run(
+        state["params"], state["opt_state"], start, 6
+    )
+    assert resumed_losses == full_losses[3:]
+
+
+def test_elastic_reshard_contract():
+    from dcos_commons_tpu.parallel.mesh import MeshSpec, elastic_reshard_ok
+
+    assert elastic_reshard_ok(MeshSpec(dp=8), MeshSpec(dp=4))
+    assert elastic_reshard_ok(
+        MeshSpec(dcn=2, dp=4, tp=4), MeshSpec(dcn=1, dp=2, tp=4)
+    )
+    # any model-axis change is NOT a pure re-layout
+    assert not elastic_reshard_ok(MeshSpec(dp=4, tp=2), MeshSpec(dp=8))
+    assert not elastic_reshard_ok(
+        MeshSpec(dp=4, fsdp=2), MeshSpec(dp=8, fsdp=1)
+    )
+
+
+# -- health auto-replace seam -----------------------------------------
+
+
+def test_auto_replace_straggler_gang_member():
+    """A confirmed straggler episode on a gang-member host triggers
+    exactly ONE automated pod replace (and only with the default-off
+    gate opened); the replace rides the gang recovery plan."""
+    from dcos_commons_tpu.scheduler.config import SchedulerConfig
+
+    runner = ServiceTestRunner(
+        GANG_YAML,
+        hosts=two_slice_fleet(),
+        scheduler_config=SchedulerConfig(
+            backoff_enabled=False, revive_capacity=10**9,
+            health_auto_replace=True,
+        ),
+    )
+    runner.run([
+        AdvanceCycles(1),
+        *[SendTaskRunning(f"trainer-{i}-worker") for i in range(4)],
+        ExpectDeploymentComplete(),
+    ])
+    world = runner.world
+    scheduler = world.scheduler
+    monitor = scheduler.health
+    assert monitor.auto_replace
+    placed = gang_hosts(scheduler)
+    slow = placed["trainer-0-worker"]
+
+    def steplogs(slow_wall):
+        out = {}
+        for name, host in placed.items():
+            wall = slow_wall if host == slow else 1.0
+            out[host] = [[
+                {"step": s, "wall_s": wall, "blocked_s": 0.0}
+                for s in range(5)
+            ]]
+        return out
+
+    # feed the detector directly (the telemetry fan-in is exercised
+    # by test_health; this test owns the ACTION seam).  Collection is
+    # parked far in the future so _observe scores the injected
+    # snapshot instead of re-collecting over the FakeAgent.
+    monitor.telemetry_interval_s = 1e9
+    monitor._last_telemetry = 1e18
+    monitor._steplogs_by_host = steplogs(10.0)
+    monitor._telemetry_seq += 1
+    events = monitor._observe(scheduler, None)
+    replaces = [e for e in events if e.get("verb") == "auto-replace"]
+    assert len(replaces) == 1
+    assert replaces[0]["host"] == slow
+    # the PERMANENT escalation landed: gang recovery synthesizes
+    scheduler.run_cycle()
+    plan = scheduler.plan("recovery")
+    assert any(
+        getattr(p, "gang_recovery", False) for p in plan.phases
+    )
+    # still-confirmed episode on the next pass: NO second replace
+    monitor._steplogs_by_host = steplogs(10.0)
+    monitor._telemetry_seq += 1
+    events = monitor._observe(scheduler, None)
+    assert not [e for e in events if e.get("verb") == "auto-replace"]
+    # journal carries the audited action
+    health_events = scheduler.journal.events(kinds=("health",))
+    assert any(
+        e.get("verb") == "auto-replace" for e in health_events
+    )
+
+
+def test_auto_replace_default_off():
+    runner = deploy_gang()
+    monitor = runner.world.scheduler.health
+    assert not monitor.auto_replace

@@ -226,10 +226,18 @@ pods:
         ]
 
     assert recovery_types() == [RecoveryType.PERMANENT]
+    # PERMANENT gang recovery is now the plan-driven choreography
+    # (ISSUE 13): kill-survivors (worker-1's auto-acked KILLED lands
+    # on the next intake), unreserve-slice, then the replace step
+    # re-launches the whole gang under fresh task ids
     runner.run([
+        AdvanceCycles(4),
         SendTaskRunning("worker-0-main"),
         SendTaskRunning("worker-1-main"),
-        AdvanceCycles(1),
+        AdvanceCycles(2),
+    ])
+    assert runner.world.scheduler.plan("recovery").is_complete
+    runner.run([
         # the OTHER worker fails inside the window: still rate limited
         SendTaskFailed("worker-1-main"),
         AdvanceCycles(1),
