@@ -1038,6 +1038,192 @@ def bench_failover() -> dict:
     }
 
 
+def bench_slo_recovery() -> dict:
+    """Closed health->action loop latency (ISSUE 15): seeded serving
+    SLO breach under open-loop load -> time to the scale-out plan and
+    time to recovered SLO, then a quiet period -> scale-in with the
+    pre-kill drain, zero flap asserted over the whole run.
+
+    The load model is open-loop at the control-plane boundary: each
+    serving pod mirrors ``queue_depth = offered / live_pods`` — the
+    gauge every pod already exports — so the breach clears exactly
+    when the scale-out's new instances reach RUNNING and take their
+    share.  Offered load 48 vs a queue-depth SLO of 16: one pod
+    breaches 3x (severity 3 -> a 2-instance step), three pods sit at
+    the threshold (recovered).  FakeAgent: this measures the
+    scheduler loop — detection latency, plan synthesis, deploy-through
+    -offer-cycle — not model serving.
+
+      slo_recovery_scale_plan_s   breach injected -> scale-out plan
+                                  journaled (detection + hysteresis
+                                  hold + governor)
+      slo_recovery_recovered_s    breach injected -> SLO clear event
+                                  (new pods RUNNING, load spread)
+      slo_recovery_scale_in_s     quiet injected -> scale-in plan
+                                  complete (incl. the router drain
+                                  grace before the kill)
+      slo_recovery_zero_flap      1 = exactly one scale-out and one
+                                  scale-in, in that order, no
+                                  opposite-direction overlap
+
+    Tracked like failover_*: regressions here mean the loop got
+    slower to react or started flapping."""
+    from dcos_commons_tpu.common import TaskState, TaskStatus
+    from dcos_commons_tpu.offer.inventory import SliceInventory, TpuHost
+    from dcos_commons_tpu.scheduler import SchedulerBuilder, SchedulerConfig
+    from dcos_commons_tpu.specification import from_yaml
+    from dcos_commons_tpu.storage import MemPersister
+    from dcos_commons_tpu.testing import FakeAgent
+
+    yaml_text = (
+        "name: slo\n"
+        "pods:\n"
+        "  serve:\n"
+        "    count: 1\n"
+        "    tasks:\n"
+        "      server:\n"
+        "        goal: RUNNING\n"
+        "        cmd: serve\n"
+        "        cpus: 1\n"
+        "        memory: 512\n"
+    )
+    config = SchedulerConfig(
+        backoff_enabled=False,
+        revive_capacity=10**9,
+        health_autoscale=True,
+        health_queue_depth_slo=16.0,
+        autoscale_max_instances=4,
+        autoscale_breach_hold_s=0.05,
+        autoscale_quiet_hold_s=0.05,
+        autoscale_cooldown_out_s=0.5,
+        autoscale_cooldown_in_s=0.5,
+        autoscale_drain_grace_s=0.1,
+    )
+    hosts = [TpuHost(host_id=f"host-{i}", cpus=8.0, memory_mb=8192)
+             for i in range(4)]
+    agent = FakeAgent()
+    builder = SchedulerBuilder(
+        from_yaml(yaml_text), config, MemPersister()
+    )
+    builder.set_inventory(SliceInventory(hosts))
+    builder.set_agent(agent)
+    scheduler = builder.build()
+    monitor = scheduler.health
+    # the bench injects gauges directly (the sandbox/wire fan-in is
+    # bench_health_overhead's subject): park collection
+    monitor.telemetry_interval_s = 1e9
+    monitor._last_telemetry = 1e18
+    acked = set()
+
+    def ack():
+        for info in list(agent.launched):
+            if info.task_id not in acked:
+                acked.add(info.task_id)
+                agent.send(TaskStatus(
+                    task_id=info.task_id, state=TaskState.RUNNING,
+                    ready=True, agent_id=info.agent_id,
+                ))
+
+    def running_serve_tasks():
+        out = []
+        for name, status in scheduler.state_store.fetch_statuses().items():
+            if status.state is TaskState.RUNNING and \
+                    name.startswith("serve-"):
+                out.append(name)
+        return out
+
+    def inject(offered: float):
+        live = running_serve_tasks()
+        depth = offered / max(1, len(live))
+        monitor._serving_stats = {
+            name: {"queue_depth": depth} for name in live
+        }
+        monitor._serving_env = {name: {} for name in live}
+        monitor._telemetry_seq += 1
+
+    def health_events():
+        return scheduler.journal.events(kinds=("health",))
+
+    def spin(offered: float, until, timeout_s: float, label: str):
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            inject(offered)
+            scheduler.run_cycle()
+            ack()
+            if until():
+                return
+        raise RuntimeError(f"slo bench: {label} not reached "
+                           f"in {timeout_s}s")
+
+    # deploy the single pod
+    spin(0.0, lambda: scheduler.deploy_manager.get_plan().is_complete,
+         30.0, "initial deploy")
+
+    # phase 1: the breach
+    t_breach = time.monotonic()
+    spin(
+        48.0,
+        lambda: any(e.get("stage") == "start" for e in health_events()),
+        30.0, "scale-out plan",
+    )
+    t_plan = time.monotonic()
+    spin(
+        48.0,
+        lambda: any(
+            e.get("detector") == "slo" and e.get("cleared")
+            for e in scheduler.journal.events(kinds=("alert",))
+        ) and scheduler.actions.manager.phase_for("serve") is None,
+        60.0, "recovered SLO",
+    )
+    t_recovered = time.monotonic()
+    count_after_out = scheduler.spec.pod("serve").count
+
+    # phase 2: the quiet period
+    t_quiet = time.monotonic()
+    spin(
+        0.5,
+        lambda: any(
+            e.get("verb") == "scale-in" and e.get("stage") == "complete"
+            for e in health_events()
+        ),
+        60.0, "scale-in complete",
+    )
+    t_scaled_in = time.monotonic()
+
+    stages = [
+        (e["verb"], e["stage"]) for e in health_events()
+        if e.get("stage") in ("start", "complete")
+    ]
+    outs = [s for s in stages if s[0] == "scale-out"]
+    ins = [s for s in stages if s[0] == "scale-in"]
+    # zero flap: one scale-out episode, then scale-in(s) — never an
+    # out after an in, never overlapping opposite directions (starts
+    # strictly alternate with their completes)
+    first_in = stages.index(("scale-in", "start")) if ins else len(stages)
+    zero_flap = (
+        outs == [("scale-out", "start"), ("scale-out", "complete")]
+        and all(s[0] == "scale-in" for s in stages[first_in:])
+        and stages[:2] == outs
+    )
+    assert zero_flap, stages
+    assert count_after_out == 3, count_after_out
+    scale_plan_s = t_plan - t_breach
+    recovered_s = t_recovered - t_breach
+    scale_in_s = t_scaled_in - t_quiet
+    assert scale_plan_s < 10.0, scale_plan_s
+    assert recovered_s < 30.0, recovered_s
+    assert scale_in_s < 30.0, scale_in_s
+    return {
+        "slo_recovery_scale_plan_s": round(scale_plan_s, 3),
+        "slo_recovery_recovered_s": round(recovered_s, 3),
+        "slo_recovery_scale_in_s": round(scale_in_s, 3),
+        "slo_recovery_count_after_out": count_after_out,
+        "slo_recovery_count_final": scheduler.spec.pod("serve").count,
+        "slo_recovery_zero_flap": 1 if zero_flap else 0,
+        "slo_recovery_events": len(stages),
+    }
+
+
 def bench_preemption_recovery() -> dict:
     """Preemption -> gang recovery latency (ISSUE 13) at 64 hosts.
 
@@ -3101,6 +3287,14 @@ def main() -> None:
     except Exception as e:
         extras["preemption_error"] = repr(e)[:200]
     _mark("preemption_recovery")
+    # closed health->action loop (ISSUE 15): seeded SLO breach ->
+    # time-to-scale-plan / time-to-recovered-SLO, quiet -> scale-in
+    # with the pre-kill drain, zero flap asserted over the run
+    try:
+        extras.update(bench_slo_recovery())
+    except Exception as e:
+        extras["slo_recovery_error"] = repr(e)[:200]
+    _mark("slo_recovery")
     # CPU-runnable serving data-plane trend (ISSUE 6): subprocess so
     # the forced-cpu jax init cannot leak into the chip sections
     try:

@@ -994,17 +994,327 @@ def _gang_recovery_plan():
     return plan, world
 
 
+# -- the autoscale configuration (ISSUE 15) ---------------------------
+#
+# Models the closed health->action loop's no-flap algebra with the
+# REAL plan objects AND the REAL decision functions
+# (health/actions.py decide() / remediation_allowed() — not a
+# transcription): three scale actions (two successive scale-outs and
+# a scale-in, so the cooldown latch between same-direction actions is
+# reachable) plus a remediation flag, driven by breach/cooldown
+# toggles, a deterministic governor tick, and a settle event that
+# starts cooldown clocks at every terminal action state.  Arming is
+# gated by the WORLD (action callables return False and the launch
+# override no-ops while unarmed), so the whole operator-verb alphabet
+# stays live without a per-phase interrupt blow-up.  Verified
+# invariants (the issue's no-flap contract):
+#
+#   no-opposite-concurrent   a scale-out and a scale-in are never
+#                            armed simultaneously (single flight)
+#   cooldown-honored         no same-direction action arms while its
+#                            direction's cooldown latch is set
+#   no-remediation-storm     remediation never arms while any scale
+#                            action is armed (at most one eviction
+#                            per service at a time)
+#
+# The ``honor_cooldown`` / ``single_flight`` knobs exist ONLY for the
+# seeded-flap fixture in test_lint_gate: a governor that skips either
+# check is caught with a minimal trace.
+
+_NOW = 1_000.0
+
+
+class AutoscaleWorld:
+    """Non-plan model state for the autoscale configuration."""
+
+    DIRECTION = {
+        "scale-out-a": "out",
+        "scale-out-b": "out",
+        "scale-in-a": "in",
+    }
+
+    def __init__(self, actions: Dict[str, List],
+                 honor_cooldown: bool = True,
+                 single_flight: bool = True):
+        from dcos_commons_tpu.health.actions import ActionPolicy
+
+        # action name -> its steps (all must complete to settle)
+        self.actions = actions
+        self.honor_cooldown = honor_cooldown
+        self.single_flight = single_flight
+        self.policy = ActionPolicy(
+            autoscale=True, breach_hold_s=0.0, quiet_hold_s=0.0,
+            max_instances=4, cooldown_out_s=60.0, cooldown_in_s=60.0,
+        )
+        self.breach = False
+        self.quiet = False
+        self.cool_out = False
+        self.cool_in = False
+        self.replace_active = False
+        self.armed: frozenset = frozenset()
+        # set (only reachable with broken knobs) when remediation
+        # armed while a scale action was armed — the storm marker
+        self.storm = False
+        self.launch_overrides = {}
+        for name, steps in actions.items():
+            for step in steps:
+                if isinstance(step, ActionStep):
+                    step._action = self._gated_action(name)
+                else:
+                    self.launch_overrides[step.name] = \
+                        self._gated_launch(name, step)
+        self._plan: Optional[Plan] = None
+
+    def bind(self, plan: Plan) -> "AutoscaleWorld":
+        self._plan = plan
+        return self
+
+    # -- snapshot protocol -------------------------------------------
+
+    def snapshot(self) -> tuple:
+        return (self.breach, self.quiet, self.cool_out, self.cool_in,
+                self.replace_active, self.armed, self.storm)
+
+    def restore(self, snap: tuple) -> None:
+        (self.breach, self.quiet, self.cool_out, self.cool_in,
+         self.replace_active, self.armed, self.storm) = snap
+
+    # -- arming gates --------------------------------------------------
+
+    def _gated_action(self, name: str):
+        def action(_scheduler) -> bool:
+            # the engine only has steps for ARMED actions; here the
+            # phase is pre-built, so unarmed steps simply make no
+            # progress (stay PENDING)
+            return name in self.armed
+        return action
+
+    def _gated_launch(self, name: str, step: DeploymentStep):
+        def launch() -> None:
+            if name not in self.armed:
+                return
+            if step not in self._plan.candidates(set()):
+                return
+            requirement = step.start()
+            if requirement is None:
+                return
+            step.record_launch({
+                task: f"{task}__{_LIVE}"
+                for task in requirement.task_names()
+            })
+        return launch
+
+    def _steps_complete(self, name: str) -> bool:
+        return all(s.get_status().is_complete for s in self.actions[name])
+
+    # -- model events -------------------------------------------------
+
+    def events(self, harness: "PlanHarness"):
+        return [
+            ("breach-start", lambda: self._set_breach(True)),
+            ("breach-end", lambda: self._set_breach(False)),
+            ("quiet-start", lambda: self._set_quiet(True)),
+            ("quiet-end", lambda: self._set_quiet(False)),
+            ("cooldown-out-expires", lambda: self._expire("out")),
+            ("cooldown-in-expires", lambda: self._expire("in")),
+            ("governor-tick", self._tick),
+            ("settle", self._settle),
+            ("replace-done", self._replace_done),
+        ]
+
+    def _set_breach(self, value: bool) -> None:
+        self.breach = value
+
+    def _set_quiet(self, value: bool) -> None:
+        self.quiet = value
+
+    def _expire(self, direction: str) -> None:
+        if direction == "out":
+            self.cool_out = False
+        else:
+            self.cool_in = False
+
+    def _replace_done(self) -> None:
+        self.replace_active = False
+
+    def _tick(self) -> None:
+        """One governor pass: applies the REAL decide() /
+        remediation_allowed() (with the knob-degraded inputs a broken
+        governor would pass) and arms at most one action."""
+        from dcos_commons_tpu.health.actions import (
+            decide,
+            remediation_allowed,
+        )
+
+        active_dirs = {self.DIRECTION[n] for n in self.armed}
+        active = (
+            sorted(active_dirs)[0]
+            if active_dirs and self.single_flight else None
+        )
+        far = _FAR_FUTURE
+        for name in ("scale-out-a", "scale-out-b", "scale-in-a"):
+            if name in self.armed or self._steps_complete(name):
+                continue
+            decision = decide(
+                _NOW,
+                policy=self.policy,
+                count=2,
+                baseline=1,
+                breach_since=0.0 if self.breach else None,
+                severity=4.0,
+                quiet_since=0.0 if self.quiet else None,
+                active=active,
+                hold=False,
+                cooldown_out_until=(
+                    far if (self.cool_out and self.honor_cooldown)
+                    else 0.0
+                ),
+                cooldown_in_until=(
+                    far if (self.cool_in and self.honor_cooldown)
+                    else 0.0
+                ),
+            )
+            if decision is not None and \
+                    decision.direction == self.DIRECTION[name]:
+                self.armed = self.armed | {name}
+                return
+        if not self.replace_active and remediation_allowed(
+            _NOW,
+            enabled=True,
+            scale_active=bool(self.armed) and self.single_flight,
+            hold=False,
+            last_replace_t=None,
+            cooldown_s=0.0,
+        ):
+            if self.armed:
+                self.storm = True  # only reachable with broken knobs
+            self.replace_active = True
+
+    def _settle(self) -> None:
+        """Terminal action states start their direction's cooldown
+        clock and disarm — the engine's _settle, every terminal state
+        counted (natural completion and operator force-complete
+        alike)."""
+        for name in sorted(self.armed):
+            if not self._steps_complete(name):
+                continue
+            self.armed = self.armed - {name}
+            if self.DIRECTION[name] == "out":
+                self.cool_out = True
+            else:
+                self.cool_in = True
+
+    # -- invariants ----------------------------------------------------
+
+    def invariants(self) -> List["Invariant"]:
+        return [NoOppositeConcurrent(), CooldownHonored(),
+                NoRemediationStorm()]
+
+
+class NoOppositeConcurrent(Invariant):
+    """A scale-out and a scale-in never run concurrently: the pair
+    would thrash capacity (the scale-in killing what the scale-out
+    just deployed) — the definition of flapping."""
+
+    name = "no-opposite-concurrent"
+
+    def on_state(self, harness):
+        world = harness.world
+        dirs = {world.DIRECTION[n] for n in world.armed}
+        if "out" in dirs and "in" in dirs:
+            return (
+                f"opposite-direction actions armed concurrently: "
+                f"{sorted(world.armed)}"
+            )
+        return None
+
+
+class CooldownHonored(Invariant):
+    """No same-direction action is armed while that direction's
+    cooldown latch (set at every terminal action state) is still
+    set: settle disarms atomically with latching, so the overlap is
+    reachable only through a governor that skipped the cooldown
+    check."""
+
+    name = "cooldown-honored"
+
+    def on_state(self, harness):
+        world = harness.world
+        for name in sorted(world.armed):
+            direction = world.DIRECTION[name]
+            cooling = (
+                world.cool_out if direction == "out" else world.cool_in
+            )
+            if cooling:
+                return (
+                    f"{name} armed while the {direction}-direction "
+                    "cooldown is still latched"
+                )
+        return None
+
+
+class NoRemediationStorm(Invariant):
+    """Remediation never arms while a scale action is armed: an
+    automated eviction racing an automated resize is the storm the
+    single-flight rule exists to prevent (at most one automated
+    eviction per service at a time)."""
+
+    name = "no-remediation-storm"
+
+    def on_state(self, harness):
+        if harness.world.storm:
+            return (
+                "remediation armed while a scale action was in "
+                f"flight ({sorted(harness.world.armed)})"
+            )
+        return None
+
+
+def _autoscale_plan(honor_cooldown: bool = True,
+                    single_flight: bool = True):
+    grow_a = ActionStep("grow-serve-to-3", lambda s: False)
+    deploy_a = DeploymentStep(
+        "deploy-serve-2",
+        PodInstanceRequirement(pod=_pod("serve", readiness=True),
+                               instances=[2]),
+        backoff=ModelBackoff(),
+    )
+    grow_b = ActionStep("grow-serve-to-4", lambda s: False)
+    shrink = ActionStep("shrink-serve-to-1", lambda s: False)
+    world = AutoscaleWorld(
+        {
+            "scale-out-a": [grow_a, deploy_a],
+            "scale-out-b": [grow_b],
+            "scale-in-a": [shrink],
+        },
+        honor_cooldown=honor_cooldown,
+        single_flight=single_flight,
+    )
+    phase = Phase(
+        "autoscale-serve", [grow_a, deploy_a, grow_b, shrink],
+        ParallelStrategy(),
+    )
+    plan = Plan("autoscale", [phase], ParallelStrategy())
+    world.bind(plan)
+    return plan, world
+
+
+def _autoscale_plan_strict():
+    return _autoscale_plan()
+
+
 # name -> (factory, step_interrupts): per-step interrupt verbs only
 # where the extra state-space doubling buys new interleavings.
-# ``gang-recovery``'s factory returns (plan, world) — the checker
-# folds the world's state into dedup snapshots and its events into
-# the alphabet.
+# ``gang-recovery``'s and ``autoscale``'s factories return
+# (plan, world) — the checker folds the world's state into dedup
+# snapshots and its events into the alphabet.
 BUILTIN_CONFIGS: Dict[str, Tuple[Callable[[], Plan], bool]] = {
     "serial-2phase": (_serial_plan, False),
     "parallel": (_parallel_plan, True),
     "dependency-dag": (_dependency_plan, False),
     "canary": (_canary_plan, True),
     "gang-recovery": (_gang_recovery_plan, True),
+    "autoscale": (_autoscale_plan_strict, False),
 }
 
 

@@ -917,6 +917,85 @@ class RouterStatsStalenessRule(Rule):
 METRIC_CARDINALITY_ALLOWLIST: tuple = ()
 
 
+class HealthActionPurityRule(Rule):
+    """ROADMAP item 2's layering invariant: the health plane DECIDES,
+    only the plan engine and journaled scheduler verbs ACT.  Code in
+    ``dcos_commons_tpu/health/`` (detectors, the action governor)
+    must not mutate the ledger, state store, or persister directly —
+    a detector that writes state bypasses the audit trail, the
+    operator's plan-verb interrupt surface, and the failover
+    re-synthesis contract all at once.  Mutations belong in
+    factory-built plan steps (decommission/factory.py,
+    plan/builders.py) or scheduler verbs (``set_pod_count``,
+    ``restart_pod``).  ``journal.py`` is exempt: the journal IS the
+    audit surface and owns its own persistence backend.  A deliberate
+    exception carries an explaining ``# sdklint: disable``."""
+
+    id = "health-plan-only"
+    description = (
+        "health-plane code mutating ledger/state-store directly "
+        "(actions must ride the plan/verb surface)"
+    )
+
+    _SCOPED = ("dcos_commons_tpu/health/",)
+    _EXEMPT = ("dcos_commons_tpu/health/journal.py",)
+    _MUTATIONS = {
+        # ledger
+        "commit", "release",
+        # state store
+        "store_tasks", "store_status", "store_property", "clear_task",
+        "store_goal_override", "set_target_config", "clear_all_data",
+        # raw persister
+        "set", "apply", "recursive_delete", "wipe_namespace",
+        # launch WAL
+        "record",
+    }
+
+    def applies_to(self, ctx: LintContext) -> bool:
+        return (
+            ctx.tree is not None
+            and any(ctx.rel.startswith(p) for p in self._SCOPED)
+            and ctx.rel not in self._EXEMPT
+        )
+
+    @staticmethod
+    def _receiver_name(node: ast.AST):
+        if isinstance(node, ast.Name):
+            return node.id
+        if isinstance(node, ast.Attribute):
+            return node.attr
+        return None
+
+    def check(self, ctx: LintContext) -> List[Finding]:
+        out = []
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in self._MUTATIONS):
+                continue
+            name = self._receiver_name(node.func.value)
+            if name is None:
+                continue
+            lowered = name.lower()
+            if not (
+                "ledger" in lowered
+                or "persister" in lowered
+                or "recorder" in lowered
+                or lowered == "store"
+                or lowered.endswith("_store")
+            ):
+                continue
+            out.append(ctx.finding(
+                node, self.id,
+                f"{name}.{node.func.attr}(...) mutates system state "
+                "from the health plane: route the action through a "
+                "plan step (decommission/plan factories) or a "
+                "journaled scheduler verb so it stays audited and "
+                "operator-interruptible",
+            ))
+        return out
+
+
 def all_rules() -> List[Rule]:
     return [
         NoBlockingSleepRule(),
@@ -929,6 +1008,7 @@ def all_rules() -> List[Rule]:
         LeaseGatedMutationRule(),
         MetricCardinalityRule(),
         RouterStatsStalenessRule(),
+        HealthActionPurityRule(),
     ]
 
 

@@ -81,6 +81,15 @@ def build_parser() -> argparse.ArgumentParser:
         p = pod.add_parser(verb)
         p.add_argument("pod")
         p.add_argument("-t", "--tasks", action="append")
+    # manual scale (ISSUE 15): rides the autoscale plan machinery,
+    # single-flight with any automated action on the same pod;
+    # scale-abandon drops an in-flight action (count settles to
+    # deployed reality, the direction's cooldown latches)
+    p = pod.add_parser("scale")
+    p.add_argument("pod", help="pod TYPE (not an instance)")
+    p.add_argument("count", type=int)
+    p = pod.add_parser("scale-abandon")
+    p.add_argument("pod", help="pod TYPE (not an instance)")
 
     # config
     config = sections.add_parser("config").add_subparsers(
@@ -289,6 +298,12 @@ def _pod(client: ApiClient, args) -> Any:
         return client.get(f"/v1/pod/{args.pod}/info")
     if verb in ("restart", "replace"):
         return client.post(f"/v1/pod/{args.pod}/{verb}")
+    if verb == "scale":
+        return client.post(
+            f"/v1/pod/{args.pod}/scale", body={"count": args.count}
+        )
+    if verb == "scale-abandon":
+        return client.post(f"/v1/pod/{args.pod}/scale/abandon")
     if verb in ("pause", "resume"):
         params = {}
         if args.tasks:

@@ -10,10 +10,26 @@ behind ``GET /v1/debug/events?since=``, and per-cycle detectors —
 straggler median-ratio scoring off merged steplogs, serving-SLO
 watchers off the engine gauges, lease-churn watching off ha.* — whose
 suspect-host output feeds placement as a soft sort-last signal.
+
+ROADMAP item 2 closed the loop (health/actions.py): detector
+episodes now drive audited, flap-proof, operator-interruptible
+ACTIONS — SLO-breach scale-out, quiet-pod scale-in through the
+decommission step family with a pre-kill router drain, and general
+straggler remediation — all riding the plan engine and seeded from
+the replayed journal across failovers.
 """
 
+from dcos_commons_tpu.health.actions import (
+    ActionPolicy,
+    HealthActionEngine,
+    decide,
+    remediation_allowed,
+    scale_out_target,
+    seed_latches,
+)
 from dcos_commons_tpu.health.detectors import (
     LeaseChurnWatcher,
+    QuietPodWatcher,
     ServingSloWatcher,
     StragglerDetector,
     median_ratio_scores,
@@ -26,12 +42,19 @@ from dcos_commons_tpu.health.journal import (
 from dcos_commons_tpu.health.monitor import HealthMonitor
 
 __all__ = [
+    "ActionPolicy",
     "EventJournal",
+    "HealthActionEngine",
     "HealthMonitor",
     "LeaseChurnWatcher",
     "PersisterBackend",
+    "QuietPodWatcher",
     "ServingSloWatcher",
     "StatePropertyBackend",
     "StragglerDetector",
+    "decide",
     "median_ratio_scores",
+    "remediation_allowed",
+    "scale_out_target",
+    "seed_latches",
 ]

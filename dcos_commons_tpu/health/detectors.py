@@ -207,6 +207,13 @@ class ServingSloWatcher:
         # 0 disables the staleness gate (deterministic tests)
         self.stale_stats_s = float(stale_stats_s)
         self.breaches: Dict[tuple, float] = {}  # (task, signal) -> value
+        # episode metadata the action governor consumes (health/
+        # actions.py): when each open breach STARTED (the hysteresis
+        # hold measures against this) and its current magnitude
+        # (value/threshold for max-direction signals, threshold/value
+        # for min — >= 1, what scale_out_target is monotone in)
+        self.breach_since: Dict[tuple, float] = {}
+        self.breach_severity: Dict[tuple, float] = {}
         self._missed: Dict[tuple, int] = {}  # consecutive absent samples
         self.stale_discards = 0  # snapshots discarded as stale
 
@@ -269,6 +276,13 @@ class ServingSloWatcher:
                     value < threshold if direction == "min"
                     else value > threshold
                 )
+                if breaching:
+                    tiny = 1e-9
+                    self.breach_severity[key] = (
+                        threshold / max(value, tiny)
+                        if direction == "min"
+                        else value / max(threshold, tiny)
+                    )
                 if breaching and key in self.breaches:
                     # still breaching: no repeat alert, but keep the
                     # CURRENT magnitude — an operator triaging
@@ -277,6 +291,7 @@ class ServingSloWatcher:
                     self.breaches[key] = value
                 elif breaching:
                     self.breaches[key] = value
+                    self.breach_since[key] = now
                     events.append({
                         "kind": "alert",
                         "detector": "slo",
@@ -293,6 +308,8 @@ class ServingSloWatcher:
                     })
                 elif not breaching and key in self.breaches:
                     del self.breaches[key]
+                    self.breach_since.pop(key, None)
+                    self.breach_severity.pop(key, None)
                     recovery = (
                         "back above minimum SLO"
                         if direction == "min" else "back under SLO"
@@ -320,6 +337,117 @@ class ServingSloWatcher:
             if self._missed[key] >= self.RETIRE_AFTER_MISSES:
                 del self.breaches[key]
                 del self._missed[key]
+                self.breach_since.pop(key, None)
+                self.breach_severity.pop(key, None)
+        return events
+
+
+class QuietPodWatcher:
+    """The LOW-watermark detector over the same serving gauges: a pod
+    instance is QUIET when every enabled max-direction SLO signal it
+    reports sits at or below ``quiet_factor`` x its breach threshold
+    (and no min-direction signal is breaching).  The gap between the
+    quiet watermark and the breach threshold is the hysteresis dead
+    band — a constant signal inside it triggers neither direction.
+
+    Edge-triggered episodes like every detector here: one alert when
+    quiet is ESTABLISHED (carrying ``since``), one clear when any
+    signal rises back above the watermark.  The scale-in governor
+    applies its own ``quiet_hold_s`` on top of ``since`` — this
+    watcher marks episodes, the policy decides.
+
+    Threshold resolution is SHARED with the breach watcher (same
+    env-knob fallback chain), so the two bands can never drift apart;
+    missing/stale samples ride the same missed-sample counter (one
+    dropped RPC neither ends a quiet episode nor starts one)."""
+
+    RETIRE_AFTER_MISSES = 3
+
+    def __init__(self, slo: ServingSloWatcher,
+                 quiet_factor: float = 0.25):
+        self._slo = slo
+        self.quiet_factor = float(quiet_factor)
+        self.quiet_since: Dict[str, float] = {}
+        self._missed: Dict[str, int] = {}
+
+    def _is_quiet(self, stats: dict, env: Dict[str, str]) -> Optional[bool]:
+        """True/False, or None when no enabled LOAD signal is present
+        (an unknown pod is neither quiet nor loaded).  Quiet EVIDENCE
+        comes only from max-direction load signals sitting under the
+        watermark; min-direction headroom signals can veto (a starved
+        arena is the opposite of quiet) but never attest — a
+        deployment with only ``kv_pages_free_slo`` enabled would
+        otherwise mark every non-starved pod quiet regardless of
+        load, and the scale-in it triggers would breach and flap."""
+        any_load_signal = False
+        for signal, knob, attr, direction in ServingSloWatcher.SIGNALS:
+            threshold = self._slo._threshold(env, knob, attr)
+            if threshold <= 0 or signal not in stats:
+                continue
+            try:
+                value = float(stats[signal])
+            except (TypeError, ValueError):
+                continue
+            if direction == "min":
+                # headroom signal: breaching (below minimum) is the
+                # opposite of quiet; plentiful headroom is neutral
+                if value < threshold:
+                    return False
+                continue
+            any_load_signal = True
+            if value > threshold * self.quiet_factor:
+                return False
+        return True if any_load_signal else None
+
+    def observe(
+        self,
+        stats_by_task: Dict[str, dict],
+        env_by_task: Optional[Dict[str, Dict[str, str]]] = None,
+        now: Optional[float] = None,
+    ) -> List[dict]:
+        now = time.time() if now is None else now
+        events: List[dict] = []
+        seen = set()
+        for task, stats in sorted(stats_by_task.items()):
+            env = (env_by_task or {}).get(task, {})
+            if self._slo._is_stale(stats, now):
+                continue  # missed sample, not evidence of anything
+            verdict = self._is_quiet(stats, env)
+            if verdict is None:
+                continue
+            seen.add(task)
+            if verdict and task not in self.quiet_since:
+                self.quiet_since[task] = now
+                events.append({
+                    "kind": "alert",
+                    "detector": "quiet",
+                    "task": task,
+                    "since": round(now, 3),
+                    "message": (
+                        f"{task} quiet: all serving gauges at or "
+                        f"below {self.quiet_factor}x their SLO "
+                        "thresholds"
+                    ),
+                })
+            elif not verdict and task in self.quiet_since:
+                del self.quiet_since[task]
+                events.append({
+                    "kind": "alert",
+                    "detector": "quiet",
+                    "task": task,
+                    "cleared": True,
+                    "message": f"{task} back above the quiet watermark",
+                })
+        for task in list(self.quiet_since):
+            if task in seen:
+                self._missed.pop(task, None)
+                continue
+            self._missed[task] = self._missed.get(task, 0) + 1
+            if self._missed[task] >= self.RETIRE_AFTER_MISSES:
+                # retired pod (or the scale-in that quiet triggered
+                # already killed it): drop silently, nothing measured
+                del self.quiet_since[task]
+                del self._missed[task]
         return events
 
 
@@ -340,6 +468,13 @@ class LeaseChurnWatcher:
         self._changes: List[float] = []  # times of observed epoch bumps
         self._last_epoch: Optional[int] = None
         self._alerted = False
+
+    @property
+    def alerted(self) -> bool:
+        """True while a churn episode is OPEN — the action governor's
+        flap hold (no automated scale/remediation under flapping
+        leadership)."""
+        return self._alerted
 
     def observe(self, epoch: Optional[int], t: Optional[float] = None) -> List[dict]:
         if epoch is None:

@@ -31,6 +31,7 @@ from typing import Dict, List, Optional
 
 from dcos_commons_tpu.health.detectors import (
     LeaseChurnWatcher,
+    QuietPodWatcher,
     ServingSloWatcher,
     StragglerDetector,
 )
@@ -63,16 +64,24 @@ class HealthMonitor:
         straggler: Optional[StragglerDetector] = None,
         slo: Optional[ServingSloWatcher] = None,
         lease_churn: Optional[LeaseChurnWatcher] = None,
+        quiet: Optional[QuietPodWatcher] = None,
         interval_s: float = 0.0,
         telemetry_interval_s: float = 5.0,
         history_interval_s: float = 1.0,
         flush_interval_s: float = 1.0,
         auto_replace: bool = False,
+        quiet_factor: float = 0.25,
     ):
         self.journal = journal or EventJournal(backend=None)
         self.straggler = straggler or StragglerDetector()
         self.slo = slo or ServingSloWatcher()
         self.lease_churn = lease_churn or LeaseChurnWatcher()
+        # the scale-in low-watermark detector shares the SLO watcher's
+        # threshold resolution (the two hysteresis bands must never
+        # drift apart)
+        self.quiet = quiet or QuietPodWatcher(
+            self.slo, quiet_factor=quiet_factor
+        )
         # detector cadence: 0 = every observe() call (tests, bench
         # worst case); production default rides the cycle rate
         self.interval_s = float(interval_s)
@@ -88,17 +97,13 @@ class HealthMonitor:
         # batching rides this clock (bounded-loss contract: a crash
         # forfeits at most flush_interval_s of transition events)
         self.flush_interval_s = float(flush_interval_s)
-        # health -> action seam (ISSUE 13 satellite, ROADMAP item 2
-        # minimal slice), DEFAULT OFF: a CONFIRMED straggler episode
-        # on a host carrying a gang member may trigger AT MOST ONE
-        # automated pod replace per episode — the replace rides the
-        # gang recovery plan (journal-audited, operator-interruptible
-        # via the ordinary plan verbs), and the suspect host is
-        # already demoted to the back of placement scan order, so the
-        # re-placed gang prefers non-suspect hosts.  The episode's
-        # clear event re-arms the host.
+        # health -> action seam, DEFAULT OFF.  The logic lives in the
+        # scheduler-owned HealthActionEngine (health/actions.py) —
+        # this flag is the legacy ISSUE-13 gate that enables the
+        # straggler auto-replace path even when the full action
+        # policy is off; the engine also honors its own
+        # ``policy.remediation`` gate.
         self.auto_replace = bool(auto_replace)
-        self._auto_replaced: set = set()
         self.observe_errors = 0
         self._last_observe = 0.0
         self._last_telemetry = 0.0
@@ -195,7 +200,10 @@ class HealthMonitor:
             events += self.straggler.observe(self._steplogs_by_host)
             self._push_suspects(scheduler)
             events += self.slo.observe(
-                self._serving_stats, self._serving_env
+                self._serving_stats, self._serving_env, now=now
+            )
+            events += self.quiet.observe(
+                self._serving_stats, self._serving_env, now=now
             )
         ha_state = getattr(scheduler, "ha_state", None)
         lease = getattr(ha_state, "lease", None)
@@ -234,8 +242,24 @@ class HealthMonitor:
             )
             self._alerts += 1
             scheduler.metrics.incr("health.alerts")
-        if self.auto_replace:
-            events += self._auto_replace_stragglers(scheduler, events)
+        # the action governor (health/actions.py): settle terminal
+        # action phases, apply the autoscale decision rule against
+        # this pass's episode state, and run the remediation seam on
+        # this pass's straggler edges.  The engine journals its own
+        # events (they are alerts: inline durability below).
+        actions = getattr(scheduler, "actions", None)
+        if actions is not None:
+            events += actions.observe(scheduler, self, now)
+            # one gate expression; remediate() is a cheap no-op when
+            # disabled (remediation_allowed re-checks enabled)
+            events += actions.remediate(
+                scheduler, events,
+                self.auto_replace or actions.policy.remediation,
+                now,
+                # the STATEFUL churn flag: the hold must cover the
+                # whole open episode, not just its opening edge
+                hold=bool(getattr(self.lease_churn, "alerted", False)),
+            )
         # alerts deserve immediate durability; routine transition
         # batches flush on the throttle clock
         if events or not self.flush_interval_s or \
@@ -244,70 +268,11 @@ class HealthMonitor:
             self.journal.flush()
         return events
 
-    def _auto_replace_stragglers(self, scheduler, events) -> List[dict]:
-        """The health -> action seam (default off, ``auto_replace``):
-        act on THIS pass's straggler episode edges.  A new CONFIRMED
-        episode on a host carrying a gang member triggers one pod
-        replace (PERMANENT -> the gang recovery plan, which the
-        operator can interrupt like any plan); the episode's clear
-        re-arms the host.  At most one replace fires per observe pass
-        — a detector wobble must not evict half the fleet at once."""
-        for event in events:
-            if event.get("detector") == "straggler" and \
-                    event.get("cleared"):
-                self._auto_replaced.discard(event.get("host"))
-        out: List[dict] = []
-        for event in events:
-            if event.get("detector") != "straggler" or \
-                    event.get("cleared"):
-                continue
-            host = event.get("host")
-            if host in self._auto_replaced:
-                continue
-            target = self._gang_member_on(scheduler, host)
-            if target is None:
-                continue
-            pod_type, index = target
-            # arm AFTER the replace succeeds: a transient store error
-            # inside restart_pod must not consume the episode's one
-            # allowed action with neither a replace nor an audit trail
-            killed = scheduler.restart_pod(pod_type, index, replace=True)
-            self._auto_replaced.add(host)
-            action = {
-                "kind": "health",
-                "verb": "auto-replace",
-                "host": host,
-                "pod": f"{pod_type}-{index}",
-                "tasks": len(killed),
-                "message": (
-                    f"auto-replace: confirmed straggler {host} carries "
-                    f"gang member {pod_type}-{index}; replacing onto a "
-                    "non-suspect host (suspects sort last in placement)"
-                ),
-            }
-            self.journal.append(
-                "health",
-                message=action["message"],
-                **{k: v for k, v in action.items()
-                   if k not in ("kind", "message")},
-            )
-            scheduler.metrics.incr("health.auto_replace")
-            out.append(action)
-            break  # at most one automated replace per pass
-        return out
-
-    def _gang_member_on(self, scheduler, host):
-        """(pod_type, index) of a gang member running on ``host``, or
-        None — only gang pods ride the auto-replace seam (a straggler
-        host drags its WHOLE gang's step time; a non-gang pod's
-        remediation story belongs to the full ROADMAP item 2)."""
-        gang_types = {p.type for p in scheduler.spec.pods if p.gang}
-        if not gang_types:
-            return None
-        for info in scheduler.state_store.fetch_tasks():
-            if info.agent_id == host and info.pod_type in gang_types:
-                return (info.pod_type, info.pod_index)
-        return None
+    @property
+    def serving_stats(self):
+        """The last completed telemetry snapshot (task -> stats) —
+        the action governor's read surface."""
+        return self._serving_stats
 
     def _collect_background(self, scheduler) -> None:
         try:
@@ -398,11 +363,29 @@ class HealthMonitor:
                 # stamps went stale (a wedged pod's last-good gauges)
                 "stale_discards": self.slo.stale_discards,
             },
+            "quiet": {
+                "tasks": {
+                    task: round(since, 3)
+                    for task, since in sorted(
+                        self.quiet.quiet_since.items()
+                    )
+                },
+                "factor": self.quiet.quiet_factor,
+            },
             "serving": self._serving_stats,
             "journal": self.journal.describe(),
             "alerts_recent": self.journal.events(kinds=("alert",), limit=20),
             "observe_errors": self.observe_errors,
         }
+        actions = getattr(scheduler, "actions", None)
+        if actions is not None:
+            # the closed-loop state: active scale phases, cooldown
+            # clocks, remediation latches (the runbook's first read
+            # when triaging an automated action)
+            body["actions"] = actions.describe()
+            body["actions"]["recent"] = self.journal.events(
+                kinds=("health",), limit=20
+            )
         history = scheduler.metrics.history
         if metric:
             body["history"] = {

@@ -280,6 +280,48 @@ class SchedulerApi:
         self._flush_journal()
         return 200, {"pod": pod_instance, "tasks": touched}
 
+    def pod_scale(self, pod_type: str, body: Optional[dict] = None) -> Response:
+        """Operator scale verb (``POST /v1/pod/<type>/scale`` with
+        ``{"count": N}``): rides the autoscale plan machinery — the
+        action is visible, journaled, and interruptible under the
+        ``autoscale`` plan, and the single-flight rule applies (409
+        while another scale action for the pod is in flight)."""
+        try:
+            self._scheduler.spec.pod(pod_type)
+        except Exception:
+            return 404, {"message": f"no pod type {pod_type}"}
+        count = (body or {}).get("count")
+        if not isinstance(count, int) or isinstance(count, bool):
+            return 400, {"message": "body must be {\"count\": <int>}"}
+        try:
+            phase = self._scheduler.scale_pod(pod_type, count)
+        except RuntimeError as e:
+            return 409, {"message": str(e)}
+        except ValueError as e:
+            return 400, {"message": str(e)}
+        self._flush_journal()
+        return 200, {
+            "pod": pod_type,
+            "count": count,
+            "plan": "autoscale",
+            "phase": phase.name,
+        }
+
+    def pod_scale_abandon(self, pod_type: str) -> Response:
+        """Drop an in-flight scale action for the pod: the persisted
+        count settles to deployed reality and the direction's
+        cooldown latches (journaled as ``stage=abandoned``)."""
+        try:
+            self._scheduler.spec.pod(pod_type)
+        except Exception:
+            return 404, {"message": f"no pod type {pod_type}"}
+        if not self._scheduler.abandon_scale(pod_type):
+            return 409, {
+                "message": f"no in-flight scale action for {pod_type}"
+            }
+        self._flush_journal()
+        return 200, {"pod": pod_type, "abandoned": True}
+
     def _parse_instance(self, pod_instance: str):
         pod_type, sep, index = pod_instance.rpartition("-")
         if not sep or not index.isdigit():
@@ -488,6 +530,16 @@ class SchedulerApi:
         port_reader = getattr(
             self._scheduler.agent, "advertised_port_of", None
         )
+        # instances an ACTIVE pod-level teardown (surplus
+        # decommission or autoscale scale-in) is about to kill: their
+        # rows flip draining:true while task AND host still look
+        # healthy, so the router drain-grace elapses before the kill
+        # step fires (ISSUE 15 satellite — host-level drain alone
+        # missed pod-granular teardowns)
+        drain_reader = getattr(
+            self._scheduler, "draining_instances", None
+        )
+        draining_pods = drain_reader() if callable(drain_reader) else set()
         for info in store.fetch_tasks():
             host = hosts.get(info.agent_id)
             hostname = host.hostname if host else info.agent_id
@@ -530,6 +582,7 @@ class SchedulerApi:
                 or state != "TASK_RUNNING"
                 or not ready
                 or host_state not in ("up", "")
+                or f"{info.pod_type}-{info.pod_index}" in draining_pods
             )
             advertised: Optional[int] = None
             advertised_read = False

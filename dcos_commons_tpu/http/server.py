@@ -69,6 +69,14 @@ def build_routes(api: SchedulerApi) -> List[Route]:
           lambda m, q: api.pod_pause(m.group(1), q.get("task"))),
         r("POST", r"/v1/pod/([^/]+)/resume",
           lambda m, q: api.pod_resume(m.group(1), q.get("task"))),
+        # manual scale (ISSUE 15): {"count": N} — rides the autoscale
+        # plan machinery, honoring the single-flight rule; /abandon
+        # drops an in-flight action, settling the count to deployed
+        # reality
+        r("POST", r"/v1/pod/([^/]+)/scale/abandon",
+          lambda m, q: api.pod_scale_abandon(m.group(1))),
+        r("POST", r"/v1/pod/([^/]+)/scale",
+          lambda m, q, body: api.pod_scale(m.group(1), body), True),
         # configs
         r("GET", r"/v1/configs", lambda m, q: api.list_configs()),
         r("GET", r"/v1/configs/targetId", lambda m, q: api.target_config_id()),

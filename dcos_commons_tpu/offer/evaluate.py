@@ -194,6 +194,12 @@ class OfferEvaluator:
         # recycled id() from a superseded config.
         self._memo: Dict[tuple, tuple] = {}
 
+    @property
+    def target_config_id(self) -> str:
+        """The config id launches are stamped with (read by the
+        autoscale plan synthesis when no config store is wired)."""
+        return self._target_config_id
+
     def set_target_config(self, config_id: str) -> None:
         self._target_config_id = config_id
         self._memo.clear()
@@ -781,10 +787,37 @@ class OfferEvaluator:
         task_infos: List[TaskInfo] = []
         root = EvaluationOutcome.ok("evaluate", pod.type)
         claimed_hosts: Dict[str, ResourceSnapshot] = {}
+        # deploy-time candidate algebra (the PR 9 remainder): a rule
+        # with a STATIC candidate key yields the same candidate set —
+        # and the same chip-bucket intersection, which reads the
+        # committed view, not the loop's local claims — for every
+        # instance of this requirement, so the set algebra and the
+        # scan-order sort run ONCE, not once per instance.  Dynamic
+        # rules (count-dependent) recompute per placement as before.
+        static_scan: Optional[List[ResourceSnapshot]] = None
+        rule_is_static = False
+        if index is not None:
+            key_of = getattr(rule, "candidate_key", None)
+            rule_is_static = callable(key_of) and key_of() is not None
+            if rule_is_static:
+                cand = index.rule_candidates(rule, ctx)
+                if pod.tpu is not None:
+                    chip_ok = index.hosts_with_free_chips(
+                        pod.tpu.chips_per_host
+                    )
+                    cand = chip_ok if cand is None else cand & chip_ok
+                if cand:
+                    static_scan = index.snapshots_for(cand)
         for index_i in requirement.instances:
             scan = snapshots
-            if index is not None:
-                cand = rule.candidate_host_ids(ctx, index)
+            if index is not None and rule_is_static:
+                if static_scan is not None:
+                    self._incr("offers.index.hit")
+                    scan = static_scan
+                else:
+                    self._incr("offers.index.scan")
+            elif index is not None:
+                cand = index.rule_candidates(rule, ctx)
                 if pod.tpu is not None:
                     chip_ok = index.hosts_with_free_chips(
                         pod.tpu.chips_per_host

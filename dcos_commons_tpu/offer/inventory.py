@@ -252,6 +252,36 @@ class HostIndex:
             h for h, n in self._cache.free_chip_count.items() if n >= count
         }
 
+    def rule_candidates(self, rule, ctx) -> Optional[Set[str]]:
+        """A rule's candidate host-id set, memoized per topology
+        generation when the rule declares a static
+        ``candidate_key()`` (field matches and their and/or algebra
+        — incl. the O(fleet) inverted-match universe subtraction).
+        The PR 9 remainder: a multi-instance deploy used to pay the
+        full set algebra once PER INSTANCE; now it pays one lookup.
+        Dynamic rules (count-dependent) fall through to a fresh
+        computation every call — membership correctness first."""
+        key_of = getattr(rule, "candidate_key", None)
+        key = key_of() if callable(key_of) else None
+        if key is None:
+            return rule.candidate_host_ids(ctx, self)
+        inv = self._inventory
+        topo = inv._topology_gen
+        entry = inv._static_candidates.get(key)
+        if entry is not None and entry[0] == topo:
+            inv.static_cand_hits += 1
+            return entry[1]
+        inv.static_cand_misses += 1
+        cand = rule.candidate_host_ids(ctx, self)
+        if len(inv._static_candidates) >= 256:
+            # distinct static rules are few (they come from pod
+            # specs); a runaway vocabulary resets rather than grows
+            inv._static_candidates.clear()
+        inv._static_candidates[key] = (
+            topo, frozenset(cand) if cand is not None else None
+        )
+        return cand
+
     def fully_free_by_slice(self) -> Dict[str, Set[str]]:
         """slice_id -> hosts whose entire chip block is unreserved —
         the torus-neighborhood pre-filter (gang placement requires
@@ -297,6 +327,13 @@ class SliceInventory:
         # The view object itself is held (not just its id()): id reuse
         # after GC must never validate a stale cache.
         self._view_caches: Dict[int, tuple] = {}
+        # static placement candidate sets (HostIndex.rule_candidates):
+        # candidate_key -> (topology_gen, frozenset | None).  Stamped
+        # per entry, so no invalidation hook is needed — a topology
+        # bump simply makes every stamp compare stale.
+        self._static_candidates: Dict[tuple, tuple] = {}
+        self.static_cand_hits = 0
+        self.static_cand_misses = 0
         self.cache_hits = 0
         self.cache_misses = 0
         # dirty-host count of the most recent sync that found work
@@ -550,6 +587,11 @@ class SliceInventory:
             },
             "index_cardinalities": {
                 f: len(ix) for f, ix in field_indexes.items()
+            },
+            "static_candidates": {
+                "hits": self.static_cand_hits,
+                "misses": self.static_cand_misses,
+                "entries": len(self._static_candidates),
             },
         }
 

@@ -162,6 +162,17 @@ class PlacementRule:
         correctness; UNDER-approximation changes placement."""
         return None
 
+    def candidate_key(self):
+        """Hashable identity of this rule's candidate set when it is
+        STATIC — a pure function of fleet topology, independent of
+        the placement context's task counts — or None when dynamic
+        (max-per / group-by / round-robin consult live counts and
+        must recompute).  Static sets are memoized per topology
+        generation (HostIndex.rule_candidates), so a deploy of N
+        instances pays ONE candidate-set computation instead of N
+        fleet-sized set algebras (the PR 9 remainder)."""
+        return None
+
 
 class PassthroughRule(PlacementRule):
     def filter(self, snapshot, ctx):
@@ -194,6 +205,18 @@ class AndRule(PlacementRule):
                 return out
         return out
 
+    def candidate_key(self):
+        # static only when EVERY bounding child is static: a dynamic
+        # child changes the intersection between instances
+        keys = []
+        for rule in self.rules:
+            key = rule.candidate_key()
+            if key is None and rule.candidate_host_ids.__func__ is not \
+                    PlacementRule.candidate_host_ids:
+                return None
+            keys.append(key)
+        return ("and", tuple(keys))
+
 
 class OrRule(PlacementRule):
     def __init__(self, rules: Sequence[PlacementRule]):
@@ -217,6 +240,16 @@ class OrRule(PlacementRule):
                 return None
             out |= cand
         return out
+
+    def candidate_key(self):
+        keys = []
+        for rule in self.rules:
+            key = rule.candidate_key()
+            if key is None and rule.candidate_host_ids.__func__ is not \
+                    PlacementRule.candidate_host_ids:
+                return None  # a dynamic bounding child: recompute
+            keys.append(key)
+        return ("or", tuple(keys))
 
 
 class NotRule(PlacementRule):
@@ -278,6 +311,15 @@ class FieldMatchRule(PlacementRule):
         if self.invert:
             return index.universe() - matched
         return matched
+
+    def candidate_key(self):
+        # pure function of host fields: the candidate set (including
+        # the O(fleet) inverted-match universe subtraction) only moves
+        # when topology does
+        return (
+            "match", self.field_name, tuple(self.values),
+            self.regex, self.invert,
+        )
 
 
 class MaxPerRule(PlacementRule):

@@ -26,6 +26,27 @@ from dcos_commons_tpu.specification.specs import (
 from dcos_commons_tpu.state.state_store import StateStore
 
 
+def build_instance_steps(
+    pod: PodSpec,
+    instances: List[int],
+    state_store: StateStore,
+    target_config_id: str,
+    backoff: Optional[Backoff] = None,
+) -> List[DeploymentStep]:
+    """One deployment step per listed instance of a NON-GANG pod,
+    seeded from persisted state exactly like the deploy plan's own
+    steps (an already-launched instance restores COMPLETE).  The
+    autoscale scale-out phase (health/actions.py) builds its new
+    instances through this, so an automated scale-out deploys through
+    the identical launch path — and re-synthesizing the phase after a
+    failover can never re-deploy what already landed."""
+    factory = DeployPlanFactory(backoff)
+    return [
+        factory._make_step(pod, [index], state_store, target_config_id)
+        for index in instances
+    ]
+
+
 class DeployPlanFactory:
     """Builds the default deploy plan: one phase per pod, serial over
     phases; parallel gang pods get one step covering all instances."""

@@ -101,6 +101,18 @@ class DefaultRecoveryPlanManager(PlanManager):
         with self._lock:
             self._spec = spec
 
+    def add_externally_managed(self, predicate) -> None:
+        """OR another owner into the externally-managed check: the
+        scheduler registers the autoscale plan here so recovery never
+        races an in-flight scale action for the same instance (a
+        failed scale-out launch is the scale phase's to retry, like a
+        failed deploy launch is the deploy plan's)."""
+        with self._lock:
+            prev = self._externally_managed
+            self._externally_managed = (
+                lambda name: prev(name) or predicate(name)
+            )
+
     # -- PlanManager --------------------------------------------------
 
     def get_plan(self) -> Plan:

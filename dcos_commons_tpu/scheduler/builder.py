@@ -73,6 +73,50 @@ def make_persister(config: SchedulerConfig) -> Persister:
     return FileWalPersister(config.state_dir)
 
 
+def _apply_autoscale_counts(spec: ServiceSpec, state_store: StateStore):
+    """Overlay persisted ``autoscale-count-<pod>`` properties onto the
+    target spec's non-gang pod counts.  The property is stamped
+    ``count@floor`` with the YAML count it was written against: an
+    override only applies while the YAML count is UNCHANGED — the
+    moment an operator's config update moves the declared count in
+    either direction, the stale autoscale decision is dropped and the
+    spec wins (otherwise a scaled-out width would permanently
+    neutralize an operator's count decrease).  Applied counts are
+    clamped to >= the YAML floor.  Returns
+    (spec, {pod_type: yaml_count}) — the baselines the action engine
+    scales back down to."""
+    import dataclasses
+
+    from dcos_commons_tpu.health.actions import COUNT_PROPERTY_PREFIX
+
+    baselines = {
+        pod.type: pod.count for pod in spec.pods if not pod.gang
+    }
+    new_pods = []
+    changed = False
+    for pod in spec.pods:
+        count = pod.count
+        if not pod.gang:
+            raw = state_store.fetch_property(
+                f"{COUNT_PROPERTY_PREFIX}{pod.type}"
+            )
+            if raw is not None:
+                try:
+                    text = raw.decode("utf-8")
+                    stored, _sep, floor = text.partition("@")
+                    if not floor or int(floor) == pod.count:
+                        count = max(pod.count, int(stored))
+                except (ValueError, UnicodeDecodeError):
+                    count = pod.count
+        if count != pod.count:
+            pod = dataclasses.replace(pod, count=count)
+            changed = True
+        new_pods.append(pod)
+    if changed:
+        spec = dataclasses.replace(spec, pods=tuple(new_pods))
+    return spec, baselines
+
+
 class SchedulerBuilder:
     def __init__(
         self,
@@ -182,6 +226,16 @@ class SchedulerBuilder:
             state_store, config_store
         )
         target_spec = self._load_target_spec(config_store, target_id)
+        # the autoscale desired-count overrides (ISSUE 15): a prior
+        # incarnation's set_pod_count verb persisted the scaled width;
+        # applying it BEFORE plan construction means the deploy plan
+        # covers the scaled instances (seeding COMPLETE from state)
+        # and the decommission factory sees a mid-scale-in victim as
+        # surplus.  The YAML counts stay recorded as the scale-in
+        # floor (engine baselines).
+        target_spec, autoscale_baselines = _apply_autoscale_counts(
+            target_spec, state_store
+        )
 
         backoff = self._make_backoff()
         factory = DeployPlanFactory(backoff)
@@ -267,8 +321,40 @@ class SchedulerBuilder:
             if self._plan_customizer is not None:
                 custom_plan = self._plan_customizer(custom_plan) or custom_plan
             other_managers.append(DefaultPlanManager(custom_plan))
+        # the durable event journal is created HERE (and handed to the
+        # health monitor below) because the decommission scan needs
+        # it: an in-flight scale-in latched in the journal owns its
+        # victim's teardown — the re-synthesized scale-in phase tears
+        # down through the router drain grace, while this plan's kill
+        # step has no drain.  Excluding the victim keeps the failover
+        # path honoring the full grace instead of racing past it.
+        from dcos_commons_tpu.health import (
+            EventJournal,
+            StatePropertyBackend,
+        )
+        from dcos_commons_tpu.health.actions import seed_latches
+        from dcos_commons_tpu.specification.specs import (
+            pod_instance_name,
+        )
+
+        health_journal = None
+        scale_in_victims: set = set()
+        if self._config.health_enabled and \
+                self._config.health_journal_capacity > 0:
+            health_journal = EventJournal(
+                StatePropertyBackend(state_store),
+                capacity=self._config.health_journal_capacity,
+            )
+            in_flight, _done, _replace = seed_latches(
+                health_journal.events(kinds=("health",))
+            )
+            scale_in_victims = {
+                pod_instance_name(pod_type, latch["from"] - 1)
+                for pod_type, latch in in_flight.items()
+                if latch["direction"] == "in"
+            }
         decommission_plan = DecommissionPlanFactory().build(
-            target_spec, state_store
+            target_spec, state_store, exclude=scale_in_victims
         )
         if decommission_plan.phases:
             if self._plan_customizer is not None:
@@ -317,21 +403,15 @@ class SchedulerBuilder:
         # persister — a deposed leader's flush is rejected, the
         # successor replays the journal and resumes the seq.
         from dcos_commons_tpu.health import (
-            EventJournal,
             HealthMonitor,
             ServingSloWatcher,
-            StatePropertyBackend,
             StragglerDetector,
         )
         from dcos_commons_tpu.health.monitor import NullHealthMonitor
 
-        if self._config.health_enabled and \
-                self._config.health_journal_capacity > 0:
+        if health_journal is not None:
             health_monitor = HealthMonitor(
-                journal=EventJournal(
-                    StatePropertyBackend(state_store),
-                    capacity=self._config.health_journal_capacity,
-                ),
+                journal=health_journal,
                 straggler=StragglerDetector(
                     threshold=self._config.health_straggler_ratio,
                     window=self._config.health_straggler_window,
@@ -349,9 +429,24 @@ class SchedulerBuilder:
                 ),
                 history_interval_s=self._config.health_history_interval_s,
                 auto_replace=self._config.health_auto_replace,
+                quiet_factor=self._config.autoscale_quiet_factor,
             )
         else:
             health_monitor = NullHealthMonitor()
+
+        from dcos_commons_tpu.health.actions import ActionPolicy
+
+        action_policy = ActionPolicy(
+            autoscale=self._config.health_autoscale,
+            remediation=self._config.health_remediation,
+            max_instances=self._config.autoscale_max_instances,
+            breach_hold_s=self._config.autoscale_breach_hold_s,
+            quiet_hold_s=self._config.autoscale_quiet_hold_s,
+            quiet_factor=self._config.autoscale_quiet_factor,
+            cooldown_out_s=self._config.autoscale_cooldown_out_s,
+            cooldown_in_s=self._config.autoscale_cooldown_in_s,
+            drain_grace_s=self._config.autoscale_drain_grace_s,
+        )
 
         scheduler = DefaultScheduler(
             spec=target_spec,
@@ -374,7 +469,14 @@ class SchedulerBuilder:
                 service=target_spec.name,
             ),
             health_monitor=health_monitor,
+            action_policy=action_policy,
         )
+        # the YAML instance counts are the scale-in floor; recorded
+        # here because the live spec may already carry a scaled width
+        scheduler.actions.baselines.update(autoscale_baselines)
+        # scale-out deployment steps back off like deploy-plan steps
+        # (a crash-looping scaled instance must not hot-retry)
+        scheduler.actions.backoff = backoff
         scheduler.secrets_provider = secrets_provider
         scheduler.certificate_authority = certificate_authority
         if self._leader_lease is not None:

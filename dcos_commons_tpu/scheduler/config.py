@@ -71,6 +71,20 @@ class SchedulerConfig:
     # recovery plan) per episode.  Opt-in — automated eviction must
     # be an operator decision.
     health_auto_replace: bool = False
+    # the CLOSED health->action loop (health/actions.py, ISSUE 15):
+    # SLO-breach scale-out + quiet-pod scale-in for non-gang serve
+    # pods, and general straggler remediation.  Both families default
+    # OFF — automated resizing/eviction is an operator decision.  The
+    # hysteresis/cooldown/drain knobs feed the ActionPolicy verbatim.
+    health_autoscale: bool = False
+    health_remediation: bool = False
+    autoscale_max_instances: int = 4
+    autoscale_breach_hold_s: float = 10.0
+    autoscale_quiet_hold_s: float = 60.0
+    autoscale_quiet_factor: float = 0.25
+    autoscale_cooldown_out_s: float = 60.0
+    autoscale_cooldown_in_s: float = 300.0
+    autoscale_drain_grace_s: float = 5.0
     health_ttft_p95_slo_s: float = 0.0
     health_queue_depth_slo: float = 0.0
     health_kv_occupancy_slo: float = 0.0
@@ -137,6 +151,31 @@ class SchedulerConfig:
             ),
             health_auto_replace=env.get("HEALTH_AUTO_REPLACE", "")
             not in ("", "0", "false"),
+            health_autoscale=env.get("HEALTH_AUTOSCALE", "")
+            not in ("", "0", "false"),
+            health_remediation=env.get("HEALTH_REMEDIATION", "")
+            not in ("", "0", "false"),
+            autoscale_max_instances=int(
+                env.get("AUTOSCALE_MAX_INSTANCES", "4")
+            ),
+            autoscale_breach_hold_s=float(
+                env.get("AUTOSCALE_BREACH_HOLD_S", "10")
+            ),
+            autoscale_quiet_hold_s=float(
+                env.get("AUTOSCALE_QUIET_HOLD_S", "60")
+            ),
+            autoscale_quiet_factor=float(
+                env.get("AUTOSCALE_QUIET_FACTOR", "0.25")
+            ),
+            autoscale_cooldown_out_s=float(
+                env.get("AUTOSCALE_COOLDOWN_OUT_S", "60")
+            ),
+            autoscale_cooldown_in_s=float(
+                env.get("AUTOSCALE_COOLDOWN_IN_S", "300")
+            ),
+            autoscale_drain_grace_s=float(
+                env.get("AUTOSCALE_DRAIN_GRACE_S", "5")
+            ),
             health_ttft_p95_slo_s=float(env.get("SERVE_TTFT_SLO_S", "0")),
             health_queue_depth_slo=float(
                 env.get("SERVE_QUEUE_DEPTH_SLO", "0")

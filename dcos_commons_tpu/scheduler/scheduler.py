@@ -71,6 +71,7 @@ class DefaultScheduler:
         tracer: Optional[TraceRecorder] = None,
         journal=None,
         health_monitor=None,
+        action_policy=None,
     ):
         # stores surfaced to the HTTP API (/v1/configs, /v1/state);
         # None when the scheduler is wired by hand in unit tests
@@ -148,6 +149,27 @@ class DefaultScheduler:
         # cycles so idle heartbeats never serialize the plan tree
         self._plan_dirty = True
         self._transition_seq = 0
+        # the closed health->action loop (health/actions.py): the
+        # engine's dynamic `autoscale` plan joins the coordinator so
+        # automated scale-out/scale-in phases ride the ordinary
+        # candidate -> evaluate -> WAL -> launch machinery, are
+        # operator-interruptible via the plan verbs, and are
+        # checkpointed/restored across failover like every plan.
+        # Policy defaults OFF; the engine still settles/reseeds
+        # journal-latched actions so a disabled successor never
+        # forgets a predecessor's in-flight plan.
+        from dcos_commons_tpu.health.actions import HealthActionEngine
+
+        self.actions = HealthActionEngine(policy=action_policy)
+        self.other_managers.append(self.actions.manager)
+        # an instance an in-flight scale action owns is the SCALE
+        # phase's to drive (incl. retrying a failed scale-out
+        # launch) — recovery must defer exactly as it defers to an
+        # incomplete deploy step, or the two plans would trade
+        # launches for the same task names
+        recovery_manager.add_externally_managed(
+            self._scale_managed_instance
+        )
         # deploy before recovery: rollout owns incomplete pods, and the
         # recovery manager defers to them via externally_managed
         self.coordinator = DefaultPlanCoordinator(
@@ -421,6 +443,10 @@ class DefaultScheduler:
             {"trace_id": promote_ref[0], "parent_id": promote_ref[1]}
             if promote_ref is not None else {"parent": cycle}
         )
+        # re-synthesize journal-latched in-flight health actions
+        # BEFORE the checkpoint restore: their phases must exist for
+        # restore_plans to re-apply operator interrupts onto them
+        self.actions.seed(self)
         report = _rehydrate.RehydrationReport()
         with self.tracer.span(
             "rehydrate.replay", track="scheduler", **kwargs
@@ -1025,6 +1051,106 @@ class DefaultScheduler:
                 )
             self.nudge()  # override relaunch work just became pending
             return touched
+
+    # -- instance-count + scale verbs (ISSUE 15: the health loop) -----
+
+    def _scale_managed_instance(self, asset: str) -> bool:
+        """True while an incomplete autoscale phase step owns this
+        pod-instance asset (recovery's externally-managed check)."""
+        for phase in self.actions.manager.get_plan().phases:
+            for step in phase.steps:
+                if asset in step.get_asset_names() and \
+                        not step.is_complete:
+                    return True
+        return False
+
+    def set_pod_count(self, pod_type: str, count: int,
+                      source: str = "operator") -> bool:
+        """THE one mutation point for a non-gang pod's instance count:
+        swaps the live spec (frozen dataclasses — a replaced copy),
+        keeps the recovery manager's spec in step, persists the
+        desired count as a state-store property so a restart/failover
+        rebuilds the deploy plan at the scaled width, and journals.
+        Idempotent at the target count (returns False) — what lets
+        the autoscale grow/shrink steps re-run safely after a
+        failover.  Action code (health/actions.py) mutates counts
+        ONLY through this verb (the health-plan-only lint rule)."""
+        import dataclasses
+
+        from dcos_commons_tpu.health.actions import COUNT_PROPERTY_PREFIX
+
+        with self._lock:
+            pod = self.spec.pod(pod_type)
+            count = int(count)
+            if pod.gang:
+                raise ValueError(
+                    f"pod {pod_type!r} is a gang: its count is the "
+                    "mesh width (elastic re-slicing owns gang width)"
+                )
+            if count < 1:
+                raise ValueError("count must be >= 1")
+            if count == pod.count:
+                return False
+            new_pod = dataclasses.replace(pod, count=count)
+            self.spec = dataclasses.replace(
+                self.spec,
+                pods=tuple(
+                    new_pod if p.type == pod_type else p
+                    for p in self.spec.pods
+                ),
+            )
+            self.recovery_manager.set_spec(self.spec)
+            # the property carries the YAML floor it was written
+            # against ("count@floor"): a later config update that
+            # CHANGES the YAML count invalidates the override at the
+            # next rebuild — operator intent in the spec always beats
+            # a stale autoscale decision
+            floor = self.actions._baseline(self, pod_type)
+            self.state_store.store_property(
+                f"{COUNT_PROPERTY_PREFIX}{pod_type}",
+                f"{count}@{floor}".encode("utf-8"),
+            )
+            self.journal.append(
+                "health" if source == "autoscale" else "operator",
+                verb="set-count", pod=pod_type, count=count,
+                source=source,
+            )
+            self.nudge()
+            return True
+
+    def scale_pod(self, pod_type: str, count: int):
+        """Operator ``POST /v1/pod/<type>/scale``: manual scale
+        through the SAME plan machinery (and single-flight rule) as
+        the automated loop — the returned phase is visible and
+        interruptible under the ``autoscale`` plan.  Serialized with
+        run_cycle like every verb."""
+        with self._lock:
+            return self.actions.request_scale(self, pod_type, count)
+
+    def abandon_scale(self, pod_type: str) -> bool:
+        """Operator ``POST /v1/pod/<type>/scale/abandon``: drop the
+        pod's in-flight scale action, reconciling the persisted count
+        to deployed reality (a half-deployed widening must not resume
+        at the next restart) and latching the direction's cooldown.
+        The bail-out for a wedged scale action — plan interrupt only
+        PARKS it (single flight then blocks the pod forever), and
+        force-complete would journal a false completion."""
+        with self._lock:
+            return self.actions.abandon(self, pod_type)
+
+    def draining_instances(self) -> Set[str]:
+        """Pod-instance names an ACTIVE teardown plan is about to
+        kill (surplus decommission or autoscale scale-in): endpoint
+        assembly flips their backend rows to ``draining:true`` so the
+        router stops placing BEFORE the kill step fires, while task
+        and host still look perfectly healthy."""
+        out: Set[str] = set()
+        for plan in self.plans().values():
+            for phase in plan.phases:
+                targets = getattr(phase, "decommission_targets", None)
+                if targets and not phase.is_complete:
+                    out |= set(targets)
+        return out
 
     # -- host lifecycle verbs (ISSUE 13: preemption & maintenance) ----
 

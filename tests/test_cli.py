@@ -59,7 +59,8 @@ def cli(server, *argv, expect_rc=0, capsys=None):
 
 def test_plan_and_pod_sections(deployed, capsys):
     runner, server = deployed
-    assert cli(server, "plan", "list", capsys=capsys) == ["deploy", "recovery"]
+    assert cli(server, "plan", "list", capsys=capsys) == \
+        ["autoscale", "deploy", "recovery"]
     plan = cli(server, "plan", "show", "deploy", capsys=capsys)
     assert plan["status"] == "COMPLETE"
     assert cli(server, "pod", "list", capsys=capsys) == ["app-0"]
@@ -69,6 +70,12 @@ def test_plan_and_pod_sections(deployed, capsys):
     cli(server, "pod", "restart", "app-0", capsys=capsys)
     runner.run([AdvanceCycles(2), SendTaskRunning("app-0-main")])
     assert len(runner.agent.launches_of("app-0-main")) == 2
+
+    # manual scale rides the autoscale plan machinery (ISSUE 15)
+    scaled = cli(server, "pod", "scale", "app", "2", capsys=capsys)
+    assert scaled["phase"] == "scale-out-app-2"
+    runner.run([AdvanceCycles(2), SendTaskRunning("app-1-main")])
+    assert cli(server, "pod", "list", capsys=capsys) == ["app-0", "app-1"]
 
 
 def test_config_state_endpoints_health(deployed, capsys):
@@ -114,7 +121,7 @@ def test_subprocess_smoke(deployed):
         capture_output=True, text=True, timeout=30, cwd="/root/repo",
     )
     assert result.returncode == 0, result.stderr
-    assert json.loads(result.stdout) == ["deploy", "recovery"]
+    assert json.loads(result.stdout) == ["autoscale", "deploy", "recovery"]
 
 
 def test_plan_start_stop_sidecar(deployed, capsys):

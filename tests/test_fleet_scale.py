@@ -1021,3 +1021,115 @@ pods:
     spec, findings = validate_service_yaml(idle_chips, "servesvc")
     mesh = [f for f in findings if f.rule == "shard-mesh"]
     assert mesh and "sit idle" in mesh[0].message, findings
+
+
+# -- static candidate memo (ISSUE 15 satellite: the PR 9 remainder) --
+
+
+def test_static_candidate_memo_equivalence_and_invalidation():
+    """Static rules (field matches and their and/or algebra) memoize
+    their candidate sets per topology generation through the
+    inventory: repeat queries are one dict hit, membership is
+    IDENTICAL to a fresh computation, and any topology mutation
+    (host down/up/add) invalidates by stamping."""
+    from dcos_commons_tpu.offer.placement import (
+        AndRule,
+        FieldMatchRule,
+        MaxPerRule,
+        OrRule,
+    )
+
+    hosts = [
+        TpuHost(host_id=f"h{i}", zone=("z1" if i % 2 else "z2"))
+        for i in range(8)
+    ]
+    inv = SliceInventory(hosts)
+    ledger = ReservationLedger(MemPersister())
+    index = inv.offer_view(ledger)
+
+    rule = FieldMatchRule("zone", ["z1"], invert=True)
+    fresh = rule.candidate_host_ids(None, index)
+    first = index.rule_candidates(rule, None)
+    assert set(first) == set(fresh) == {f"h{i}" for i in range(0, 8, 2)}
+    hits0 = inv.static_cand_hits
+    again = index.rule_candidates(rule, None)
+    assert inv.static_cand_hits == hits0 + 1
+    assert again == first
+    # an EQUIVALENT rule object shares the entry (key is structural)
+    clone = FieldMatchRule("zone", ["z1"], invert=True)
+    assert index.rule_candidates(clone, None) == first
+    assert inv.static_cand_hits == hits0 + 2
+
+    # topology mutation: the memo must see the new world
+    inv.mark_down("h0")
+    index2 = inv.offer_view(ledger)
+    assert "h0" not in index2.rule_candidates(rule, None)
+    inv.mark_up("h0")
+    index3 = inv.offer_view(ledger)
+    assert "h0" in index3.rule_candidates(rule, None)
+
+    # composition: and/or of static rules is static; anything with a
+    # count-dependent child is dynamic (no key, no memo entry)
+    z1 = FieldMatchRule("zone", ["z1"])
+    z2 = FieldMatchRule("zone", ["z2"])
+    assert AndRule([z1, z2]).candidate_key() is not None
+    assert OrRule([z1, z2]).candidate_key() is not None
+    assert MaxPerRule("hostname", 1).candidate_key() is None
+    assert AndRule([z1, MaxPerRule("hostname", 1)]).candidate_key() \
+        is None
+    assert OrRule([z1, MaxPerRule("hostname", 1)]).candidate_key() \
+        is None
+    misses0 = inv.static_cand_misses
+    assert set(index3.rule_candidates(OrRule([z1, z2]), None)) == {
+        h.host_id for h in hosts
+    }
+    assert inv.static_cand_misses == misses0 + 1
+
+
+def test_deploy_reuses_candidates_across_instances():
+    """A multi-instance deploy with a static placement rule pays the
+    candidate-set algebra once, not once per instance — and places
+    exactly as before (the existing randomized equivalence sweeps
+    pin the winners; this pins the cost shape)."""
+    yaml_text = """
+name: fleet
+pods:
+  app:
+    count: 6
+    placement: 'zone:exact:good'
+    tasks:
+      server:
+        goal: RUNNING
+        cmd: "serve"
+        cpus: 0.5
+        memory: 64
+"""
+    from dcos_commons_tpu.testing import ServiceTestRunner
+
+    hosts = [
+        TpuHost(host_id=f"g{i}", zone="good", cpus=8.0)
+        for i in range(4)
+    ] + [
+        TpuHost(host_id=f"b{i}", zone="bad", cpus=8.0)
+        for i in range(4)
+    ]
+    runner = ServiceTestRunner(yaml_text, hosts=hosts)
+    world = runner.build()
+    acked = set()
+    for _ in range(10):
+        world.scheduler.run_cycle()
+        for info in list(world.agent.launched):
+            if info.task_id not in acked:
+                acked.add(info.task_id)
+                world.agent.send(TaskStatus(
+                    task_id=info.task_id, state=TaskState.RUNNING,
+                    ready=True, agent_id=info.agent_id,
+                ))
+    assert world.scheduler.deploy_manager.get_plan().is_complete
+    inv = world.inventory
+    placed = {i.agent_id for i in world.agent.launched}
+    assert placed and placed <= {f"g{i}" for i in range(4)}
+    # the zone rule's set was computed once and then served from the
+    # memo for every further instance/cycle
+    assert inv.static_cand_misses >= 1
+    assert inv.static_cand_hits >= inv.static_cand_misses

@@ -80,7 +80,9 @@ def test_health_and_plans(deployed):
     health = get(server, "/v1/health")
     assert health["healthy"] and health["deployed"]
 
-    assert get(server, "/v1/plans") == ["deploy", "recovery"]
+    # `autoscale` is the health-action engine's (empty-until-used)
+    # dynamic plan, present on every scheduler since ISSUE 15
+    assert get(server, "/v1/plans") == ["autoscale", "deploy", "recovery"]
     plan = get(server, "/v1/plans/deploy")
     assert plan["status"] == "COMPLETE"
     assert plan["phases"][0]["steps"][0]["status"] == "COMPLETE"

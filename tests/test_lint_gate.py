@@ -416,6 +416,64 @@ def test_rule_lease_gated_mutation(tmp_path):
     assert not findings and len(suppressed) == 1
 
 
+def test_rule_health_plan_only(tmp_path):
+    """ISSUE 15's layering invariant: health-plane code (detectors,
+    the action governor) may not mutate ledger/state-store/persister
+    directly — actions ride factory-built plan steps and journaled
+    scheduler verbs."""
+    src = """
+    class RogueDetector:
+        def act(self, scheduler):
+            scheduler.ledger.release("res-1")
+            scheduler.state_store.clear_task("serve-2-server")
+            scheduler.state_store.store_property("k", b"v")
+            self._persister.set("/x", b"1")
+    """
+    findings, _ = _lint_fixture(
+        tmp_path, src, rel="dcos_commons_tpu/health/actions.py",
+        rule_id="health-plan-only",
+    )
+    assert len(findings) == 4
+    # the allowed surface: journal appends, scheduler verbs, plan
+    # synthesis, reads — and non-store receivers named like builtins
+    ok = """
+    class Governor:
+        def act(self, scheduler):
+            scheduler.journal.append("health", verb="scale-out")
+            scheduler.set_pod_count("serve", 3, source="autoscale")
+            scheduler.restart_pod("serve", 1, replace=True)
+            scheduler.state_store.fetch_tasks()
+            self._seen.add("h1")          # a set, not a persister
+            self._wake.set()              # an Event, not a persister
+    """
+    findings, _ = _lint_fixture(
+        tmp_path, ok, rel="dcos_commons_tpu/health/actions.py",
+        rule_id="health-plan-only",
+    )
+    assert not findings
+    # journal.py is exempt (it IS the audit surface and owns its
+    # backend); non-health paths are out of scope
+    for exempt_rel in (
+        "dcos_commons_tpu/health/journal.py",
+        "dcos_commons_tpu/decommission/factory.py",
+    ):
+        findings, _ = _lint_fixture(
+            tmp_path, src, rel=exempt_rel, rule_id="health-plan-only",
+        )
+        assert not findings, exempt_rel
+    suppressed_src = """
+    class Governor:
+        def act(self, scheduler):
+            scheduler.ledger.release("res-1")  # sdklint: disable=health-plan-only — test-only fixture
+    """
+    findings, suppressed = _lint_fixture(
+        tmp_path, suppressed_src,
+        rel="dcos_commons_tpu/health/actions.py",
+        rule_id="health-plan-only",
+    )
+    assert not findings and len(suppressed) == 1
+
+
 def test_rule_metric_cardinality(tmp_path):
     src = """
     class S:
@@ -1422,6 +1480,43 @@ def test_plancheck_repo_gate():
     by_name = {r.config: r for r in summary.results}
     assert "gang-recovery" in by_name, sorted(by_name)
     assert by_name["gang-recovery"].states >= 10_000, summary.render()
+    # the autoscale configuration (ISSUE 15) gates the closed
+    # health->action loop's no-flap contract at the same depth: the
+    # REAL decide()/remediation_allowed() x cooldown latches x
+    # episode toggles x operator verbs, livelock-sound (asserted for
+    # every config above), with 0 violations of
+    # no-opposite-concurrent / cooldown-honored / no-remediation-storm
+    assert "autoscale" in by_name, sorted(by_name)
+    assert by_name["autoscale"].states >= 10_000, summary.render()
+
+
+def test_plancheck_catches_flapping_governor():
+    """Seeded flap: a governor that skips the cooldown check re-arms
+    a same-direction scale action while the cooldown latch from the
+    previous terminal state is still set — caught by
+    cooldown-honored with a minimal trace.  A governor that skips
+    the single-flight check is caught too (remediation storm /
+    opposite-direction concurrency)."""
+    result = plancheck.check_plan(
+        lambda: plancheck._autoscale_plan(honor_cooldown=False),
+        config_name="seeded-flap", max_states=120_000,
+        check_livelock=False,
+    )
+    flap = [v for v in result.violations
+            if v.invariant == "cooldown-honored"]
+    assert flap, result.violations
+    # BFS minimality: breach -> arm -> complete -> settle -> re-arm
+    # is a handful of events, not a wandering trace
+    assert len(flap[0].trace) <= 8, flap[0].render()
+
+    result = plancheck.check_plan(
+        lambda: plancheck._autoscale_plan(single_flight=False),
+        config_name="seeded-storm", max_states=120_000,
+        check_livelock=False,
+    )
+    names = {v.invariant for v in result.violations}
+    assert "no-remediation-storm" in names or \
+        "no-opposite-concurrent" in names, result.violations
 
 
 def test_plancheck_catches_unordered_gang_recovery():
