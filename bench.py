@@ -2122,6 +2122,423 @@ def bench_router_scale() -> dict:
     return out
 
 
+def bench_disagg() -> dict:
+    """Disaggregated prefill/decode + live KV page migration
+    (ISSUE 16), CPU-runnable and jax-free: the same calibrated-sleep
+    chain pods as ``bench_router_scale``, arranged two ways under an
+    identical long-prefill-heavy mix —
+
+    * UNIFIED: two unified pods; long prompts' chunked prefill
+      interleaves with every pod's decode ticks, so short requests
+      pay head-of-line TTFT behind long prefills;
+    * DISAGGREGATED: one prefill-role pod + one decode-role pod; the
+      router sends long prompts to prefill capacity, the prefill pod
+      streams finished pages to the decode pool (the migration
+      protocol), and short requests land on a pod that never runs a
+      long prefill.
+
+    Fences: greedy equality on EVERY request in both topologies
+    (zero token loss through handoff + collect-follow), disaggregated
+    short-request p95 TTFT strictly better than unified, and
+    drain-with-migration strictly faster than waiting out the
+    generations.  Also reported, unfenced: decode tick jitter per
+    topology and the bytes/duration of one mid-generation move over
+    the simulated DCN transport.
+    """
+    import random
+    import statistics
+    import threading
+
+    import numpy as np
+
+    from dcos_commons_tpu.router import RequestRouter
+    from dcos_commons_tpu.serve.engine import PagedEngine
+    from dcos_commons_tpu.serve.migration import (
+        PrefillHandoff,
+        SessionMigratedError,
+        SimulatedDcnTransport,
+        drain_sessions,
+        migrate_session,
+    )
+
+    _V = 997
+
+    def _chain_first(prompt):
+        return (sum(prompt) * 31 + len(prompt)) % _V
+
+    def _chain_next(tok, pos):
+        return (tok * 7 + pos * 3 + 1) % _V
+
+    def _oracle(prompt, n):
+        out = [_chain_first(prompt)]
+        pos = len(prompt)
+        while len(out) < n:
+            out.append(_chain_next(out[-1], pos))
+            pos += 1
+        return out
+
+    P_TOK, CHUNK, MAX_LEN, PROMPT_LEN = 4, 8, 64, 48
+    SLOTS, STEP_S, PAGES = 8, 0.01, 160
+    LONG = 40  # >= the router's 4*page_tokens prefill-route floor
+
+    class ChainArena:
+        """Content-faithful fake device (the test_migration arena):
+        every token lands in its (page, offset) cell so a migrated
+        page's payload is the real export/import contract, and
+        prefill resume after a move reads the spliced cells."""
+
+        def __init__(self):
+            self.cells = {}
+            self.lock = threading.Lock()
+            self.ticks = []  # decode dispatch timestamps (jitter)
+
+        def prefill_chunk(self, padded, slot, table, start, true_len,
+                          temp, seed):
+            # a full-width chunk costs about a decode tick on real
+            # chips; the 5-chunk long prompts are the head-of-line
+            # hazard this bench measures
+            time.sleep(STEP_S)
+            with self.lock:
+                buf = [
+                    self.cells[int(table[pos // P_TOK])][pos % P_TOK]
+                    for pos in range(start)
+                ]
+                for i in range(true_len):
+                    pos = start + i
+                    page = int(table[pos // P_TOK])
+                    tok = int(padded[0, i])
+                    self.cells.setdefault(page, {})[pos % P_TOK] = tok
+                    buf.append(tok)
+            return _chain_first(buf)
+
+        def decode(self, tok, pos, temps, seeds, tables, n_active):
+            time.sleep(STEP_S)  # the modeled decode tick
+            with self.lock:
+                self.ticks.append(time.monotonic())
+                for s in range(len(tok)):
+                    if int(pos[s]) > 0:
+                        page = int(tables[s][int(pos[s]) // P_TOK])
+                        if page != 0:
+                            self.cells.setdefault(page, {})[
+                                int(pos[s]) % P_TOK
+                            ] = int(tok[s])
+            return np.asarray(
+                [_chain_next(int(t), int(q))
+                 for t, q in zip(tok, pos)],
+                np.int32,
+            )
+
+        def read_page(self, page):
+            with self.lock:
+                return dict(self.cells.get(page, {}))
+
+        def write_page(self, page, payload):
+            with self.lock:
+                self.cells[page] = dict(payload)
+
+    class BenchPod:
+        def __init__(self, name, role="unified", handoff=None):
+            self.name = name
+            self.arena = ChainArena()
+            self.engine = PagedEngine(
+                self.arena.prefill_chunk, self.arena.decode, SLOTS,
+                MAX_LEN, PROMPT_LEN, page_tokens=P_TOK, pages=PAGES,
+                chunk_tokens=CHUNK, prefix_cache=True, role=role,
+                read_page=self.arena.read_page,
+                write_page=self.arena.write_page, handoff=handoff,
+                queue_timeout_s=600,
+            )
+
+        def send(self, request):
+            if "collect" in request:
+                # the router following a migrated session
+                return [self.engine.collect(
+                    int(request["collect"]), timeout=120
+                )]
+            return self.engine.submit(
+                request["tokens"], request["max_new_tokens"]
+            )
+
+        def stop(self):
+            self.engine.stop()
+
+    def build_mix(rng):
+        """Long-prefill-heavy: 36 long prompts (5 prefill chunks
+        each), 48 decode-load shorts, and 24 one-token PROBES whose
+        client-side completion time IS their TTFT (queue + prefill +
+        first sample; no decode tail to blur it)."""
+        reqs = []
+        for _ in range(36):
+            reqs.append({
+                "prompt": [rng.randrange(_V) for _ in range(LONG)],
+                "n": 4, "probe": False,
+            })
+        for _ in range(44):
+            reqs.append({
+                "prompt": [rng.randrange(_V)
+                           for _ in range(4 + rng.randrange(8))],
+                "n": 6, "probe": False,
+            })
+        for _ in range(32):
+            reqs.append({
+                "prompt": [rng.randrange(_V)
+                           for _ in range(4 + rng.randrange(4))],
+                "n": 1, "probe": True,
+            })
+        rng.shuffle(reqs)
+        arrivals = sorted(rng.uniform(0.0, 2.4) for _ in reqs)
+        return reqs, arrivals
+
+    def run_topology(disagg):
+        """One open-loop mix through a fresh router + fresh pods;
+        identical workload seed either way, only the topology
+        differs.  Returns (probe p95 TTFT, decode tick jitter ms,
+        handoff counters)."""
+        handoff = None
+        if disagg:
+            pods = {}
+            pods["dc0"] = BenchPod("dc0", role="decode")
+            handoff = PrefillHandoff(
+                lambda: {"dc0": pods["dc0"].engine}
+            )
+            pods["pf0"] = BenchPod(
+                "pf0", role="prefill", handoff=handoff
+            )
+            entries = {
+                "pf0": {"address": "pf0:0", "role": "prefill"},
+                "dc0": {"address": "dc0:0", "role": "decode"},
+            }
+            decode_arenas = [pods["dc0"].arena]
+        else:
+            pods = {n: BenchPod(n) for n in ("u0", "u1")}
+            entries = {n: {"address": f"{n}:0"} for n in pods}
+            decode_arenas = [p.arena for p in pods.values()]
+        router = RequestRouter(
+            lambda name, addr, req: pods[name].send(req),
+            page_tokens=P_TOK, policy="affinity",
+            stale_after_s=5.0, retry_budget=2,
+        )
+        router.update_pods(entries, generation="g1")
+        stop_poll = threading.Event()
+
+        def poller():
+            while not stop_poll.is_set():
+                for name, pod in pods.items():
+                    router.observe_stats(name, pod.engine.stats())
+                stop_poll.wait(0.025)
+
+        rng = random.Random(16)
+        reqs, arrivals = build_mix(rng)
+        results = [None] * len(reqs)
+        done_s = [0.0] * len(reqs)
+        errors = []
+        t0 = time.monotonic()
+
+        def client(i):
+            delay = arrivals[i] - (time.monotonic() - t0)
+            if delay > 0:
+                time.sleep(delay)
+            t_req = time.monotonic()
+            try:
+                results[i] = router.submit(
+                    reqs[i]["prompt"], reqs[i]["n"]
+                )
+                done_s[i] = time.monotonic() - t_req
+            except Exception as e:  # noqa: BLE001
+                errors.append((i, e))
+
+        poll_thread = threading.Thread(target=poller, daemon=True)
+        poll_thread.start()
+        threads = [
+            threading.Thread(target=client, args=(i,))
+            for i in range(len(reqs))
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=600)
+        stop_poll.set()
+        poll_thread.join(timeout=5)
+        assert not errors, errors[:3]
+        # zero token loss, EVERY request: identical to direct-to-pod,
+        # through prefill handoff + collect-follow included
+        for req, result in zip(reqs, results):
+            assert result == _oracle(req["prompt"], req["n"]), (
+                "topology changed a greedy continuation"
+            )
+        probes = [d for r, d in zip(reqs, done_s) if r["probe"]]
+        p95 = statistics.quantiles(probes, n=20)[-1]
+        gaps = []
+        for arena in decode_arenas:
+            with arena.lock:
+                ticks = list(arena.ticks)
+            gaps.extend(
+                b - a for a, b in zip(ticks, ticks[1:])
+                if b - a <= 10 * STEP_S  # drop idle-loop stretches
+            )
+        jitter_ms = (
+            statistics.pstdev(gaps) * 1e3 if len(gaps) >= 2 else 0.0
+        )
+        counters = (
+            (handoff.handoffs, handoff.fallbacks) if handoff
+            else (0, 0)
+        )
+        for pod in pods.values():
+            pod.stop()
+        return p95, jitter_ms, counters
+
+    out = {
+        "disagg_step_s": STEP_S,
+        "disagg_long_prompt_tokens": LONG,
+    }
+
+    # ---- unified vs disaggregated under the same mix
+    uni_p95, uni_jit, _ = run_topology(disagg=False)
+    dis_p95, dis_jit, (handoffs, fallbacks) = run_topology(
+        disagg=True
+    )
+    out["disagg_unified_ttft_p95_s"] = round(uni_p95, 4)
+    out["disagg_split_ttft_p95_s"] = round(dis_p95, 4)
+    out["disagg_ttft_gain_x"] = round(uni_p95 / max(dis_p95, 1e-9), 2)
+    out["disagg_unified_tick_jitter_ms"] = round(uni_jit, 3)
+    out["disagg_decode_tick_jitter_ms"] = round(dis_jit, 3)
+    out["disagg_handoffs"] = handoffs
+    out["disagg_handoff_fallbacks"] = fallbacks
+
+    # ---- drain a loaded pod: wait out the generations vs migrate
+    def load_sessions(src):
+        """Six mid-generation sessions on ``src``; returns (threads,
+        results, prompts, n) once every session is decoding."""
+        rng = random.Random(7)
+        prompts = [
+            [rng.randrange(_V) for _ in range(8)] for _ in range(6)
+        ]
+        n = 48
+        results = [None] * len(prompts)
+
+        def run(i):
+            try:
+                results[i] = src.engine.submit([prompts[i]], n)[0]
+            except SessionMigratedError as e:
+                results[i] = e
+        threads = [
+            threading.Thread(target=run, args=(i,), daemon=True)
+            for i in range(len(prompts))
+        ]
+        for th in threads:
+            th.start()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            sess = src.engine.sessions()
+            if (len(sess) == len(prompts)
+                    and all(s["state"] == "decode" for s in sess)
+                    and src.engine.stats()["tokens_out"]
+                    >= 4 * len(prompts)):
+                break
+            time.sleep(0.005)
+        else:
+            raise AssertionError("sessions never reached mid-decode")
+        return threads, results, prompts, n
+
+    # without migration: drain = stop admitting, wait for the tail
+    src = BenchPod("src")
+    threads, results, prompts, n = load_sessions(src)
+    t0 = time.monotonic()
+    for th in threads:
+        th.join(timeout=120)
+    legacy_s = time.monotonic() - t0
+    for got, prompt in zip(results, prompts):
+        assert got == _oracle(prompt, n)
+    src.stop()
+
+    # with migration: the same tail moves to a peer in one pass
+    src, dst = BenchPod("src"), BenchPod("dst")
+    threads, results, prompts, n = load_sessions(src)
+    t0 = time.monotonic()
+    report = drain_sessions(src.engine, {"dst": dst.engine})
+    migrate_s = time.monotonic() - t0
+    assert all(row["ok"] for row in report), report
+    assert src.engine.sessions() == []
+    for th in threads:
+        th.join(timeout=120)
+    for got, prompt in zip(results, prompts):
+        assert isinstance(got, SessionMigratedError), got
+        assert dst.engine.collect(got.dest_rid, timeout=120) \
+            == _oracle(prompt, n), "migration lost or doubled tokens"
+    out["disagg_drain_legacy_s"] = round(legacy_s, 3)
+    out["disagg_drain_migrate_s"] = round(migrate_s, 3)
+    out["disagg_drain_speedup_x"] = round(
+        legacy_s / max(migrate_s, 1e-9), 1
+    )
+    src.stop()
+    dst.stop()
+
+    # ---- one forced mid-generation move over the modeled DCN
+    src, dst = BenchPod("src"), BenchPod("dst")
+    rng = random.Random(11)
+    prompt = [rng.randrange(_V) for _ in range(16)]
+    n = 24
+    moved = {}
+
+    def mover():
+        try:
+            moved["r"] = src.engine.submit([prompt], n)[0]
+        except SessionMigratedError as e:
+            moved["r"] = e
+    th = threading.Thread(target=mover, daemon=True)
+    th.start()
+    deadline = time.monotonic() + 30
+    rid = None
+    while time.monotonic() < deadline:
+        sess = src.engine.sessions()
+        if (sess and sess[0]["state"] == "decode"
+                and src.engine.stats()["tokens_out"] >= 8):
+            rid = sess[0]["rid"]
+            break
+        time.sleep(0.005)
+    assert rid is not None, "session never reached mid-decode"
+    record = migrate_session(
+        src.engine, dst.engine, rid, dest_name="dst",
+        transport=SimulatedDcnTransport(),
+    )
+    th.join(timeout=120)
+    err = moved["r"]
+    assert isinstance(err, SessionMigratedError), err
+    assert dst.engine.collect(err.dest_rid, timeout=120) \
+        == _oracle(prompt, n), "mid-generation move lost tokens"
+    assert src.engine.stats()["migrations_out"] == 1
+    assert dst.engine.stats()["migrations_in"] == 1
+    out["disagg_migration_kbytes"] = round(record.bytes / 1024, 1)
+    out["disagg_migration_ms"] = round(record.duration_s * 1e3, 1)
+    out["disagg_migration_pages"] = record.pages
+    out["disagg_migration_greedy_equal"] = 1
+    src.stop()
+    dst.stop()
+
+    print(
+        f"[disagg] probe TTFT p95 unified {uni_p95 * 1e3:.0f}ms -> "
+        f"split {dis_p95 * 1e3:.0f}ms "
+        f"({out['disagg_ttft_gain_x']:.2f}x), tick jitter "
+        f"{uni_jit:.2f} -> {dis_jit:.2f}ms, drain {legacy_s:.2f}s -> "
+        f"{migrate_s:.2f}s ({out['disagg_drain_speedup_x']:.0f}x), "
+        f"{handoffs} handoff(s) / {fallbacks} fallback(s)",
+        file=sys.stderr, flush=True,
+    )
+    # the headline fences
+    assert dis_p95 < uni_p95, (
+        f"disaggregation did not improve short-request p95 TTFT "
+        f"({dis_p95 * 1e3:.0f}ms vs unified {uni_p95 * 1e3:.0f}ms)"
+    )
+    assert handoffs >= 1, (
+        "the prefill pod never handed a session to the decode pool"
+    )
+    assert migrate_s < legacy_s, (
+        f"drain-with-migration ({migrate_s:.2f}s) was not faster "
+        f"than waiting out the generations ({legacy_s:.2f}s)"
+    )
+    return out
+
+
 def bench_train_step() -> dict:
     """The worker step-time fast path vs the loop it replaced
     (ISSUE 7), CPU-runnable.  Two loops over identical data from an
@@ -3319,6 +3736,18 @@ def main() -> None:
     except Exception as e:
         extras["router_scale_error"] = repr(e)[:200]
     _mark("router_scale")
+    # CPU-runnable disaggregated-serving trend (ISSUE 16): unified vs
+    # prefill/decode split under a long-prefill-heavy mix, drain with
+    # vs without live KV migration, and one mid-generation move over
+    # the modeled DCN — jax-free, subprocess for the hard timeout
+    try:
+        extras.update(_run_subprocess_section(
+            "bench_disagg", timeout_s=600,
+            env={"JAX_PLATFORMS": "cpu"},
+        ))
+    except Exception as e:
+        extras["disagg_error"] = repr(e)[:200]
+    _mark("disagg")
     # CPU-runnable training step-loop trend (ISSUE 7): the worker fast
     # path (donation + in-flight window + async fenced checkpointing)
     # vs the loop it replaced, plus the cost-model step-time gate

@@ -1303,11 +1303,246 @@ def _autoscale_plan_strict():
     return _autoscale_plan()
 
 
+# -- the migration configuration (ISSUE 16) ---------------------------
+#
+# Models serve/migration.py's fenced cutover protocol (freeze ->
+# stream/splice -> cutover -> release) as four ActionSteps under
+# SerialStrategy over a world of the two pods' session facts: where
+# the session's row is live (source serving / frozen / released,
+# destination none / spliced / active), with operator abort and
+# either pod dying as world events at EVERY protocol state.  The
+# protocol's two one-way doors are what the search certifies:
+#
+#   no-double-serve   the source row never serves while an ACTIVATED
+#                     destination copy is alive (cutover is final —
+#                     abort must refuse after it; a resumed source
+#                     plus an active dest would decode the session
+#                     twice and fork the token stream)
+#   no-token-loss     the source row is only retired once the
+#                     destination copy is ACTIVATED (release before
+#                     the activate ack — or after an abort/dest
+#                     death — discards the only copy mid-generation)
+#
+# Pod deaths are availability loss, not protocol loss: a session on
+# a dying pod dies with it exactly as it would without migration, so
+# death alone never fires no-token-loss; the invariant fires only
+# when the PROTOCOL retires the surviving copy.  Actions complete
+# vacuously once their work is moot (abort honored, pod dead), so
+# the plan always terminates and the livelock check stays sound.
+#
+# The ``abort_after_cutover`` / ``release_before_activate`` knobs
+# exist ONLY for the seeded fixtures in test_lint_gate: a protocol
+# that honors an abort after activation, or retires the source on
+# splice success instead of the activate ack, is caught with a
+# minimal trace.
+
+
+class MigrationWorld:
+    """Non-plan model state for the migration configuration."""
+
+    def __init__(self, freeze_step, stream_step, cutover_step,
+                 release_step,
+                 abort_after_cutover: bool = False,
+                 release_before_activate: bool = False):
+        self.freeze_step = freeze_step
+        self.stream_step = stream_step
+        self.cutover_step = cutover_step
+        self.release_step = release_step
+        self.abort_after_cutover = abort_after_cutover
+        self.release_before_activate = release_before_activate
+        self.source_alive = True
+        self.dest_alive = True
+        self.source_state = "serving"   # serving | frozen | released
+        self.dest_state = "none"        # none | spliced | active
+        self.aborted = False
+        # set when a protocol action discards the session's only
+        # copy (retires the source with no activated destination) —
+        # reachable only with broken knobs
+        self.lost = False
+        self.launch_overrides: Dict[str, Callable[[], None]] = {}
+        self._plan: Optional[Plan] = None
+
+    def bind(self, plan: Plan) -> "MigrationWorld":
+        self._plan = plan
+        return self
+
+    # -- snapshot protocol -------------------------------------------
+
+    def snapshot(self) -> tuple:
+        return (self.source_alive, self.dest_alive, self.source_state,
+                self.dest_state, self.aborted, self.lost)
+
+    def restore(self, snap: tuple) -> None:
+        (self.source_alive, self.dest_alive, self.source_state,
+         self.dest_state, self.aborted, self.lost) = snap
+
+    # -- model events -------------------------------------------------
+
+    def events(self, harness: "PlanHarness"):
+        # all three may land at ANY protocol state — that coverage is
+        # the point of the configuration
+        return [
+            ("operator-abort", self._op_abort),
+            ("source-pod-dies", self._source_dies),
+            ("dest-pod-dies", self._dest_dies),
+        ]
+
+    def _op_abort(self) -> None:
+        self.aborted = True
+        if self.abort_after_cutover and self.source_alive \
+                and self.source_state == "frozen":
+            # SEEDED BUG: the abort handler unfreezes the source
+            # without checking whether the destination already
+            # activated — post-cutover this forks the stream
+            self.source_state = "serving"
+
+    def _source_dies(self) -> None:
+        self.source_alive = False
+
+    def _dest_dies(self) -> None:
+        if not self.dest_alive:
+            return
+        self.dest_alive = False
+        # its spliced pages / activation die with the pod
+        self.dest_state = "none"
+
+    def _resume_source(self) -> None:
+        """Pre-cutover failure path: retire any splice, unfreeze the
+        source.  NEVER past activation — cutover is a one-way door."""
+        if self.dest_state == "active":
+            return
+        if self.dest_state == "spliced":
+            self.dest_state = "none"  # abort_splice at the dest
+        if self.source_alive and self.source_state == "frozen":
+            self.source_state = "serving"
+
+    # -- model actions (close over self; ActionStep passes None) ------
+
+    def do_freeze(self, _scheduler) -> bool:
+        if self.aborted or not self.source_alive \
+                or self.source_state != "serving":
+            return True  # moot: nothing to fence
+        self.source_state = "frozen"
+        return True
+
+    def do_stream(self, _scheduler) -> bool:
+        if self.dest_state != "none":
+            return True  # already streamed
+        if self.aborted or not self.source_alive \
+                or not self.dest_alive or self.source_state != "frozen":
+            self._resume_source()
+            return True
+        self.dest_state = "spliced"
+        return True
+
+    def do_cutover(self, _scheduler) -> bool:
+        if self.dest_state == "active":
+            return True  # already activated
+        if self.release_before_activate and self.source_alive \
+                and self.source_state == "frozen" \
+                and self.dest_state == "spliced":
+            # SEEDED BUG: retire the source row on splice success,
+            # before the activate ack lands
+            self.source_state = "released"
+            if self.aborted or not self.dest_alive:
+                self.dest_state = "none"
+                self.lost = True
+            else:
+                self.dest_state = "active"
+            return True
+        if self.aborted or not self.dest_alive \
+                or self.dest_state != "spliced":
+            self._resume_source()
+            return True
+        self.dest_state = "active"
+        return True
+
+    def do_release(self, _scheduler) -> bool:
+        if not self.source_alive or self.source_state != "frozen":
+            return True  # nothing to retire
+        if self.dest_state != "active":
+            # activation never landed (abort, dest death): the only
+            # legal continuation is keeping the source copy — the
+            # ``aborted`` flag is deliberately NOT consulted here,
+            # because post-cutover the move is final
+            self._resume_source()
+            return True
+        self.source_state = "released"
+        return True
+
+    # -- invariants ----------------------------------------------------
+
+    def invariants(self) -> List["Invariant"]:
+        return [NoDoubleServe(), NoTokenLoss()]
+
+
+class NoDoubleServe(Invariant):
+    """The source row never serves while an activated destination
+    copy is alive: both would decode the same session and the token
+    streams fork — the exactly-once cutover contract."""
+
+    name = "no-double-serve"
+
+    def on_state(self, harness):
+        world = harness.world
+        if (world.source_alive and world.source_state == "serving"
+                and world.dest_alive and world.dest_state == "active"):
+            return (
+                "source row serving while the activated destination "
+                "copy is alive (the session decodes twice)"
+            )
+        return None
+
+
+class NoTokenLoss(Invariant):
+    """The source row is only retired once the destination copy is
+    ACTIVATED: releasing against anything weaker (splice success, an
+    abort, a dead dest) discards the session's only copy."""
+
+    name = "no-token-loss"
+
+    def on_state(self, harness):
+        if harness.world.lost:
+            return (
+                "source row retired with no activated destination "
+                "copy (mid-generation tokens discarded)"
+            )
+        return None
+
+
+def _migration_plan(abort_after_cutover: bool = False,
+                    release_before_activate: bool = False):
+    freeze = ActionStep("freeze-session", lambda s: False)
+    stream = ActionStep("stream-pages", lambda s: False)
+    cutover = ActionStep("cutover-dest", lambda s: False)
+    release = ActionStep("release-source", lambda s: False)
+    world = MigrationWorld(
+        freeze, stream, cutover, release,
+        abort_after_cutover=abort_after_cutover,
+        release_before_activate=release_before_activate,
+    )
+    freeze._action = world.do_freeze
+    stream._action = world.do_stream
+    cutover._action = world.do_cutover
+    release._action = world.do_release
+    phase = Phase(
+        "migrate-session", [freeze, stream, cutover, release],
+        SerialStrategy(),
+    )
+    plan = Plan("migration", [phase], SerialStrategy())
+    world.bind(plan)
+    return plan, world
+
+
+def _migration_plan_strict():
+    return _migration_plan()
+
+
 # name -> (factory, step_interrupts): per-step interrupt verbs only
 # where the extra state-space doubling buys new interleavings.
-# ``gang-recovery``'s and ``autoscale``'s factories return
-# (plan, world) — the checker folds the world's state into dedup
-# snapshots and its events into the alphabet.
+# ``gang-recovery``'s, ``autoscale``'s and ``migration``'s factories
+# return (plan, world) — the checker folds the world's state into
+# dedup snapshots and its events into the alphabet.
 BUILTIN_CONFIGS: Dict[str, Tuple[Callable[[], Plan], bool]] = {
     "serial-2phase": (_serial_plan, False),
     "parallel": (_parallel_plan, True),
@@ -1315,6 +1550,7 @@ BUILTIN_CONFIGS: Dict[str, Tuple[Callable[[], Plan], bool]] = {
     "canary": (_canary_plan, True),
     "gang-recovery": (_gang_recovery_plan, True),
     "autoscale": (_autoscale_plan_strict, False),
+    "migration": (_migration_plan_strict, True),
 }
 
 

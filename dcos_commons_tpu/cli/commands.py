@@ -136,7 +136,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "tracker",
         choices=["offers", "plans", "taskStatuses", "reservations",
-                 "health", "events", "router"],
+                 "health", "events", "router", "serving"],
     )
     p.add_argument(
         "--metric", default=None, metavar="NAME",

@@ -106,6 +106,13 @@ class ActionPolicy:
     # router drain grace between the endpoints draining flip and the
     # scale-in kill step
     drain_grace_s: float = 5.0
+    # drain-with-migration (serve/migration.py): at drain start, ask
+    # the scale-in victim to MOVE its live sessions to the surviving
+    # peers — the grace then covers router awareness only, not whole
+    # generations, and the eventual kill cuts nothing off.  Best
+    # effort: a pod without the migrate surface rides the grace
+    # exactly as before
+    drain_migrate: bool = True
     remediation_cooldown_s: float = 300.0
 
 
@@ -626,10 +633,19 @@ class HealthActionEngine:
             return True
 
         drain_started: List[float] = []
+        victim_index = from_count - 1
 
-        def drain(_s) -> bool:
+        def drain(s) -> bool:
             if not drain_started:
                 drain_started.append(self._clock())
+                if self.policy.drain_migrate:
+                    # move the victim's live sessions to surviving
+                    # peers NOW, so the grace below covers router
+                    # awareness — not whole generations — and the
+                    # kill step cuts nothing off (serve/migration.py)
+                    self._migrate_victim_sessions(
+                        s, pod_type, victim_index, to_count
+                    )
                 return False
             return (
                 self._clock() - drain_started[0]
@@ -648,6 +664,95 @@ class HealthActionEngine:
         phase.to_count = to_count
         self.manager.add(pod_type, phase)
         return phase
+
+    def _migrate_victim_sessions(
+        self, scheduler, pod_type: str, victim_index: int,
+        to_count: int,
+    ) -> None:
+        """Best-effort drain-with-migration: POST the victim's serve
+        worker a one-shot drain verb naming the SURVIVING instances
+        as destinations (frameworks/jax serve_worker /migrate).  Any
+        failure — no serving stats, no dialable peers, a pod built
+        before the migrate surface — leaves the legacy wait-out drain
+        in charge; this never blocks or fails the scale-in plan."""
+        import json as _json
+        import urllib.request
+
+        try:
+            serving = self._serving_addresses(scheduler, pod_type)
+            victim = serving.get(victim_index)
+            dests = {
+                f"{pod_type}-{idx}": addr
+                for idx, addr in serving.items()
+                if idx < to_count
+            }
+            if victim is None or not dests:
+                return
+            req = urllib.request.Request(
+                f"http://{victim}/migrate",
+                data=_json.dumps(
+                    {"verb": "drain", "dests": dests}
+                ).encode("utf-8"),
+                headers={"Content-Type": "application/json"},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=120.0) as resp:
+                report = _json.loads(resp.read().decode("utf-8"))
+            rows = report.get("report") or []
+            moved = sum(1 for r in rows if r.get("ok"))
+            scheduler.journal.append(
+                "health",
+                verb="scale-in",
+                stage="migrate",
+                pod=pod_type,
+                message=(
+                    f"scale-in drain migrated {moved}/{len(rows)} "
+                    f"live session(s) off {pod_type}-{victim_index}"
+                ),
+            )
+        except Exception as e:  # noqa: BLE001 — best-effort by contract
+            try:
+                scheduler.journal.append(
+                    "health",
+                    verb="scale-in",
+                    stage="migrate",
+                    pod=pod_type,
+                    message=(
+                        f"scale-in drain of {pod_type}-{victim_index} "
+                        f"fell back to wait-out: {e}"
+                    ),
+                )
+            except Exception:  # noqa: BLE001, sdklint: disable=swallowed-exception — journaling a fallback must not break the drain step
+                pass
+
+    def _serving_addresses(
+        self, scheduler, pod_type: str
+    ) -> Dict[int, str]:
+        """pod index -> dialable address for every instance of
+        ``pod_type`` whose sandbox mirrors serving stats with an
+        http_port annotation (the same advertised-port contract
+        /v1/endpoints reads)."""
+        reader = getattr(scheduler.agent, "serving_stats_of", None)
+        if not callable(reader):
+            return {}
+        hosts = {
+            h.host_id: h for h in scheduler.inventory.hosts()
+        }
+        out: Dict[int, str] = {}
+        for info in scheduler.state_store.fetch_tasks():
+            if info.pod_type != pod_type:
+                continue
+            try:
+                stats = reader(info.name)
+            except OSError:
+                continue
+            port = (stats or {}).get("http_port")
+            if not port:
+                continue
+            host = hosts.get(info.agent_id)
+            hostname = host.hostname if host else "127.0.0.1"
+            out[info.pod_index] = f"{hostname}:{int(port)}"
+        return out
 
     # -- settling ----------------------------------------------------
 

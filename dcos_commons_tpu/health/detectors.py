@@ -187,7 +187,20 @@ class ServingSloWatcher:
          "max"),
         ("kv_pages_free", "SERVE_KV_PAGES_FREE_SLO",
          "kv_pages_free_slo", "min"),
+        ("prefill_chunk_backlog", "SERVE_PREFILL_BACKLOG_SLO",
+         "prefill_backlog_slo", "max"),
     )
+    # signals that are MEANINGLESS for a serving role and must be
+    # neither breached on nor counted as quiet evidence there.  A
+    # prefill pod (ISSUE 16 disaggregation) holds KV pages only for
+    # the instants between finishing a prompt and streaming it to a
+    # decode pod: its occupancy/headroom gauges sit near their idle
+    # values BY DESIGN, and judging it on them would let the quiet
+    # watcher scale in a prefill pod that is saturated with prompt
+    # work (its real load lives in prefill_chunk_backlog).
+    ROLE_EXCLUDED_SIGNALS = {
+        "prefill": frozenset({"kv_occupancy", "kv_pages_free"}),
+    }
     # consecutive collections a breaching (task, signal) may go
     # unsampled before its episode is dropped as retired
     RETIRE_AFTER_MISSES = 3
@@ -198,12 +211,14 @@ class ServingSloWatcher:
         queue_depth_slo: float = 0.0,
         kv_occupancy_slo: float = 0.0,
         kv_pages_free_slo: float = 0.0,
+        prefill_backlog_slo: float = 0.0,
         stale_stats_s: float = 30.0,
     ):
         self.ttft_p95_slo_s = float(ttft_p95_slo_s)
         self.queue_depth_slo = float(queue_depth_slo)
         self.kv_occupancy_slo = float(kv_occupancy_slo)
         self.kv_pages_free_slo = float(kv_pages_free_slo)
+        self.prefill_backlog_slo = float(prefill_backlog_slo)
         # 0 disables the staleness gate (deterministic tests)
         self.stale_stats_s = float(stale_stats_s)
         self.breaches: Dict[tuple, float] = {}  # (task, signal) -> value
@@ -216,6 +231,17 @@ class ServingSloWatcher:
         self.breach_severity: Dict[tuple, float] = {}
         self._missed: Dict[tuple, int] = {}  # consecutive absent samples
         self.stale_discards = 0  # snapshots discarded as stale
+
+    @classmethod
+    def _excluded_signals(cls, stats: dict) -> frozenset:
+        """The signals this snapshot's serving role opts out of.
+        Pods that never report a role ("" / absent → unified) keep
+        the full signal set — pre-disaggregation fleets see zero
+        behavior change."""
+        role = stats.get("serving_role")
+        if not isinstance(role, str):
+            return frozenset()
+        return cls.ROLE_EXCLUDED_SIGNALS.get(role, frozenset())
 
     def _threshold(self, env: Dict[str, str], knob: str, attr: str) -> float:
         raw = (env or {}).get(knob, "")
@@ -262,7 +288,10 @@ class ServingSloWatcher:
                 # The open episodes ride the missed-sample counter.
                 self.stale_discards += 1
                 continue
+            excluded = self._excluded_signals(stats)
             for signal, knob, attr, direction in self.SIGNALS:
+                if signal in excluded:
+                    continue  # meaningless for this serving role
                 threshold = self._threshold(env, knob, attr)
                 if threshold <= 0 or signal not in stats:
                     continue
@@ -380,7 +409,13 @@ class QuietPodWatcher:
         otherwise mark every non-starved pod quiet regardless of
         load, and the scale-in it triggers would breach and flap."""
         any_load_signal = False
+        excluded = ServingSloWatcher._excluded_signals(stats)
         for signal, knob, attr, direction in ServingSloWatcher.SIGNALS:
+            if signal in excluded:
+                # role-excluded gauges attest nothing: a prefill
+                # pod's near-zero decode occupancy is its design
+                # point, not quiet evidence
+                continue
             threshold = self._slo._threshold(env, knob, attr)
             if threshold <= 0 or signal not in stats:
                 continue

@@ -608,6 +608,11 @@ class SchedulerApi:
                         if advertised:
                             port = advertised
                     address = f"{hostname}:{port}"
+                    # serving role rides discovery (ISSUE 16): the
+                    # router learns prefill/decode capacity from the
+                    # same poll that hands it addresses — no extra
+                    # round trip before the first placement decision
+                    role = info.env.get("SERVE_ROLE", "")
                     out.setdefault(port_spec.name, []).append(address)
                     backends.setdefault(port_spec.name, []).append({
                         "address": address,
@@ -615,6 +620,7 @@ class SchedulerApi:
                         "state": state,
                         "ready": ready,
                         "draining": draining,
+                        "role": role,
                     })
                     if port_spec.vip:
                         # VIP discovery (reference: NamedVIPEvaluation
@@ -631,6 +637,7 @@ class SchedulerApi:
                             "state": state,
                             "ready": ready,
                             "draining": draining,
+                            "role": role,
                         })
             # stable DNS-style names (reference: DiscoveryInfo +
             # EndpointUtils listing <task>.<svc>.<tld> names; the

@@ -93,3 +93,37 @@ class AffinityMap:
         for key in dead:
             del self._claims[key]
         return len(dead)
+
+    def repoint(self, keys: Sequence[int], pod: str) -> int:
+        """Re-point EXISTING claims on the given chain to ``pod`` —
+        the cache-preserving half of migration (ISSUE 16): when a
+        session's pages move, the knowledge of where its prefix lives
+        moves with them instead of being dropped.  Only nodes already
+        claimed move (an unclaimed node carries no knowledge); returns
+        how many moved."""
+        moved = 0
+        for key in keys:
+            if key in self._claims:
+                self._claims[key] = pod
+                self._claims.move_to_end(key)
+                moved += 1
+        return moved
+
+    def repoint_pod(self, old: str, new: str) -> int:
+        """Bulk re-point: every claim on ``old`` now names ``new`` —
+        the drain-with-migration path, where the whole cache moved."""
+        moved = 0
+        for key, pod in self._claims.items():
+            if pod == old:
+                self._claims[key] = new
+                moved += 1
+        return moved
+
+    def claims_by_pod(self) -> dict:
+        """Claim counts per pod — the hotspot-detection signal: a pod
+        holding far more chain claims than its peers is where the
+        shared prefixes (and their traffic) concentrate."""
+        counts: dict = {}
+        for pod in self._claims.values():
+            counts[pod] = counts.get(pod, 0) + 1
+        return counts

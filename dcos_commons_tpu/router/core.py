@@ -55,6 +55,7 @@ from dcos_commons_tpu.router.telemetry import (
     DEFAULT_STALE_AFTER_S,
     PodTelemetry,
 )
+from dcos_commons_tpu.serve.migration import SessionMigratedError
 
 ROUTERSTATS_NAME = "servestats.json"  # rides the serving-stats plumbing
 _LATENCY_WINDOW = 512
@@ -76,12 +77,20 @@ class _PodState:
     __slots__ = (
         "name", "address", "telemetry", "draining",
         "operator_drained", "failed", "in_flight", "admitted",
+        "role",
     )
 
     def __init__(self, name: str, address: str, stale_after_s: float):
         self.name = name
         self.address = address
         self.telemetry = PodTelemetry(stale_after_s)
+        # serving role (ISSUE 16 disaggregation): "unified" serves
+        # everything; "prefill" pods take long prompts and hand the
+        # finished pages to the decode pool; "decode" pods take the
+        # short/interactive traffic.  Seeded from discovery (the
+        # pod's SERVE_ROLE env, surfaced through /v1/endpoints) and
+        # refined by the pod's own serving_role gauge
+        self.role = "unified"
         # two INDEPENDENT drain flags, OR'd for admission: discovery
         # state (scheduler-side pause/decommission, refreshed by every
         # update_pods) and the operator's front-door verb (owned by
@@ -129,6 +138,7 @@ class RequestRouter:
         retry_budget: int = 2,
         affinity_slack: float = 4.0,
         affinity_capacity: int = 65536,
+        prefill_route_tokens: Optional[int] = None,
         log: Optional[Callable[[str], None]] = None,
     ):
         if policy not in ("affinity", "least-loaded", "round-robin"):
@@ -139,6 +149,17 @@ class RequestRouter:
         self._stale_after_s = float(stale_after_s)
         self._retry_budget = max(0, int(retry_budget))
         self._affinity_slack = float(affinity_slack)
+        # disaggregation threshold: prompts at least this long go to
+        # prefill-role capacity (when any is offered).  None = auto
+        # (a prompt spanning 4+ pages is "long" — one chunked-prefill
+        # burst a decode tick should not absorb); 0 = never steer by
+        # length (prefill pods then only take traffic as a last
+        # resort).  Role filtering is inert while every pod is
+        # unified, which is every pre-disaggregation deployment.
+        if prefill_route_tokens is None:
+            self._prefill_route_tokens = 4 * self._page_tokens
+        else:
+            self._prefill_route_tokens = max(0, int(prefill_route_tokens))
         self._log = log
         self._lock = threading.Lock()
         self._pods: Dict[str, _PodState] = {}
@@ -155,6 +176,8 @@ class RequestRouter:
         self._affinity_hits = 0
         self._affinity_overridden = 0
         self._stale_routing_rounds = 0
+        self._migration_follows = 0
+        self._chain_repoints = 0
         self._latency: deque = deque(maxlen=_LATENCY_WINDOW)
         self._started_mono = time.monotonic()
         self._extra_stats: Dict[str, object] = {}
@@ -200,6 +223,10 @@ class RequestRouter:
                 if draining and not pod.draining:
                     self._affinity.evict_pod(name)
                 pod.draining = draining
+                role = entry.get("role") if isinstance(entry, dict) \
+                    else None
+                if role:
+                    pod.role = str(role)
         if removed and self._log is not None:
             self._log(f"router: pods left the set: {sorted(removed)}")
         return True
@@ -217,21 +244,42 @@ class RequestRouter:
             pod.telemetry.observe(stats, now)
             if pod.telemetry.fresh(now):
                 pod.failed = False
+            if pod.telemetry.serving_role:
+                pod.role = pod.telemetry.serving_role
 
-    def drain(self, name: str) -> bool:
+    def drain(self, name: str,
+              migrated_to: Optional[str] = None) -> bool:
         """Operator drain: zero new admissions, in-flight finishes.
         The drain runbook's first verb (operations-guide).  Sticky
         against discovery: only undrain() (or the pod leaving the
         set) clears it — a poll-driven pod-set refresh must not undo
-        a drain mid-decommission."""
+        a drain mid-decommission.
+
+        ``migrated_to`` names the pod the drain migrated this pod's
+        sessions (and their cached pages) to: the leaving pod's
+        prefix-chain claims RE-POINT there instead of being dropped,
+        so post-drain requests still hit the moved cache.  Without it
+        — the legacy wait-out drain — claims are evicted, because the
+        cache genuinely dies with the pod."""
         with self._lock:
             pod = self._pods.get(name)
             if pod is None:
                 return False
             pod.operator_drained = True
-            self._affinity.evict_pod(name)
+            dest = self._pods.get(migrated_to) if migrated_to else None
+            if dest is not None and dest.name != name:
+                moved = self._affinity.repoint_pod(name, dest.name)
+                self._chain_repoints += moved
+            else:
+                moved = -self._affinity.evict_pod(name)
         if self._log is not None:
-            self._log(f"router: draining {name}")
+            if moved > 0:
+                self._log(
+                    f"router: draining {name}; {moved} prefix claims "
+                    f"re-pointed to {migrated_to}"
+                )
+            else:
+                self._log(f"router: draining {name}")
         return True
 
     def undrain(self, name: str) -> bool:
@@ -246,6 +294,50 @@ class RequestRouter:
         with self._lock:
             return sorted(self._pods)
 
+    def repoint_prompt(self, tokens: Sequence[int], dest: str) -> int:
+        """Re-point one prompt's prefix-chain claims to ``dest`` —
+        the rebalance consumer's verb: after migrating a session's
+        pages, its chain knowledge follows (drain_sessions report
+        rows carry the tokens).  Returns claims moved."""
+        keys = prefix_chain_keys(tokens, self._page_tokens)
+        with self._lock:
+            if not keys or dest not in self._pods:
+                return 0
+            moved = self._affinity.repoint(keys, dest)
+            self._chain_repoints += moved
+            return moved
+
+    def rebalance_suggestion(self, min_claims: int = 8,
+                             min_skew: float = 2.0) -> Optional[dict]:
+        """Prefix-hotspot detection: the pod whose claim count AND
+        load dominate its peers is where a hot shared prefix welded
+        traffic.  Returns ``{"from", "to", "claims", "load_gap"}`` —
+        migrate sessions from/to those pods (serve.migration.
+        drain_sessions + repoint_prompt) to shed load WITH the cache
+        — or None while the fleet is balanced."""
+        now = time.monotonic()
+        with self._lock:
+            pods = self._eligible_locked(())
+            if len(pods) < 2:
+                return None
+            counts = self._affinity.claims_by_pod()
+            hot = max(pods, key=lambda p: (counts.get(p.name, 0),
+                                           p.load(now), p.name))
+            cold = min(pods, key=lambda p: (counts.get(p.name, 0),
+                                            p.load(now), p.name))
+            hot_claims = counts.get(hot.name, 0)
+            cold_claims = counts.get(cold.name, 0)
+            if (hot.name == cold.name
+                    or hot_claims < max(1, int(min_claims))
+                    or hot_claims < min_skew * max(1, cold_claims)
+                    or hot.load(now) <= cold.load(now)):
+                return None
+            return {
+                "from": hot.name, "to": cold.name,
+                "claims": hot_claims,
+                "load_gap": round(hot.load(now) - cold.load(now), 2),
+            }
+
     # -- placement ----------------------------------------------------
 
     def _eligible_locked(self, exclude) -> List[_PodState]:
@@ -255,7 +347,23 @@ class RequestRouter:
             and p.name not in exclude
         ]
 
-    def _pick_locked(self, keys: Sequence[int], exclude) -> _PodState:
+    def _role_filter_locked(self, pods: List[_PodState],
+                            prompt_len: int) -> List[_PodState]:
+        """Disaggregated placement: long prompts go to prefill-role
+        capacity; everything else stays off it (a short prompt on a
+        prefill pod would just bounce through a handoff).  Inert
+        while no offered pod declares a prefill role — every
+        pre-disaggregation fleet."""
+        prefill = [p for p in pods if p.role == "prefill"]
+        if not prefill or len(prefill) == len(pods):
+            return pods
+        if (self._prefill_route_tokens > 0
+                and prompt_len >= self._prefill_route_tokens):
+            return prefill
+        return [p for p in pods if p.role != "prefill"]
+
+    def _pick_locked(self, keys: Sequence[int], exclude,
+                     prompt_len: int = 0) -> _PodState:
         pods = self._eligible_locked(exclude)
         if not pods:
             self._rejected_no_pod += 1
@@ -263,6 +371,8 @@ class RequestRouter:
                 "no serve pod is admitting (all draining, failed, or "
                 "undiscovered)"
             )
+        pods = self._role_filter_locked(pods, prompt_len)
+        allowed = {p.name for p in pods}
         now = time.monotonic()
         if all(not p.telemetry.fresh(now) for p in pods):
             self._stale_routing_rounds += 1
@@ -275,7 +385,7 @@ class RequestRouter:
         if self._policy == "affinity" and keys:
             self._affinity_lookups += 1
             claimed, _depth = self._affinity.lookup(keys)
-            if claimed is not None:
+            if claimed is not None and claimed in allowed:
                 pod = self._pods.get(claimed)
                 if (pod is not None and not pod.admitting_blocked
                         and not pod.failed and pod.name not in exclude):
@@ -291,7 +401,9 @@ class RequestRouter:
         this prompt go to right now?"""
         keys = prefix_chain_keys(tokens, self._page_tokens)
         with self._lock:
-            return self._pick_locked(keys, exclude=()).name
+            return self._pick_locked(
+                keys, exclude=(), prompt_len=len(tokens)
+            ).name
 
     # -- the request path ---------------------------------------------
 
@@ -322,7 +434,9 @@ class RequestRouter:
             self._requests += 1
         while True:
             with self._lock:
-                pod = self._pick_locked(keys, tried)
+                pod = self._pick_locked(
+                    keys, tried, prompt_len=len(tokens)
+                )
                 pod.in_flight += 1
                 pod.admitted += 1
                 if self._policy == "affinity" and keys:
@@ -357,6 +471,40 @@ class RequestRouter:
                 with self._lock:
                     self._failovers += 1
                 continue
+            except SessionMigratedError as e:
+                # the session moved mid-generation (drain, rebalance,
+                # or a prefill pod's handoff): follow it with a
+                # collect — the destination answers with the FULL
+                # output, so the client sees one uninterrupted reply
+                with self._lock:
+                    pod.in_flight -= 1
+                    self._migration_follows += 1
+                    dest = self._pods.get(e.moved_to)
+                    if dest is not None:
+                        dest.in_flight += 1
+                if dest is None:
+                    raise PodTransportError(
+                        f"session migrated to unknown pod "
+                        f"{e.moved_to!r}"
+                    ) from e
+                if self._log is not None:
+                    self._log(
+                        f"router: following migrated session from "
+                        f"{name} to {dest.name}"
+                    )
+                try:
+                    result = self._send(
+                        dest.name, dest.address,
+                        {"collect": int(e.dest_rid)},
+                    )
+                finally:
+                    with self._lock:
+                        dest.in_flight -= 1
+                now = time.monotonic()
+                with self._lock:
+                    self._completed += 1
+                    self._latency.append(now - t0)
+                return result[0]
             except Exception:
                 with self._lock:
                     pod.in_flight -= 1
@@ -402,6 +550,11 @@ class RequestRouter:
                     self._affinity_hits / self._affinity_lookups, 4
                 ) if self._affinity_lookups else 0.0,
                 "router_stale_routing_rounds": self._stale_routing_rounds,
+                "router_migration_follows": self._migration_follows,
+                "router_chain_repoints": self._chain_repoints,
+                "router_prefill_pods": sum(
+                    p.role == "prefill" for p in pods
+                ),
                 "router_policy": self._policy,
                 "router_generation": self._generation,
             }
@@ -428,6 +581,7 @@ class RequestRouter:
                 "pods": {
                     p.name: {
                         "address": p.address,
+                        "role": p.role,
                         "draining": p.admitting_blocked,
                         "discovery_draining": p.draining,
                         "operator_drained": p.operator_drained,

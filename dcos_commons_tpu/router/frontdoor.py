@@ -45,6 +45,7 @@ from dcos_commons_tpu.router.core import (
     PodTransportError,
     RequestRouter,
 )
+from dcos_commons_tpu.serve.migration import SessionMigratedError
 
 
 class PodHttpError(RuntimeError):
@@ -75,13 +76,53 @@ def http_send(name: str, address: str, request: dict,
         with urllib.request.urlopen(req, timeout=timeout_s) as resp:
             body = json.loads(resp.read().decode("utf-8"))
     except urllib.error.HTTPError as e:
-        raise PodHttpError(e.code, e.read()) from e
+        raw = e.read()
+        if e.code == 409:
+            # the pod moved the session mid-flight (serve/migration.py):
+            # 409 {"migrated_to", "dest_rid"} tells the router WHERE to
+            # collect the finished tokens — a redirect, not a failure
+            try:
+                verdict = json.loads(raw.decode("utf-8"))
+            except (ValueError, UnicodeDecodeError):
+                verdict = {}
+            moved_to = verdict.get("migrated_to")
+            if isinstance(moved_to, str) and moved_to:
+                raise SessionMigratedError(
+                    int(verdict.get("rid", -1)), moved_to,
+                    int(verdict.get("dest_rid", -1)),
+                ) from e
+        raise PodHttpError(e.code, raw) from e
     except (urllib.error.URLError, OSError, json.JSONDecodeError) as e:
         raise PodTransportError(f"{name} ({address}): {e}") from e
     tokens = body.get("tokens")
     if not isinstance(tokens, list):
         raise PodTransportError(f"{name} returned a bodiless reply")
     return tokens
+
+
+def migrate_drain(router: RequestRouter, pod: str, dest: str,
+                  timeout_s: float = 120.0) -> dict:
+    """Drive the cache-preserving half of ``/drain?pod=X&to=Y``: ask
+    the SOURCE pod to migrate its live sessions to ``dest`` (the serve
+    worker's one-shot drain verb, serve/migration.py).  Best-effort by
+    design — any failure leaves the legacy wait-out drain in charge
+    and is reported, never raised (the drain itself already took)."""
+    state = router.describe()["pods"]
+    src_row, dest_row = state.get(pod), state.get(dest)
+    if src_row is None or dest_row is None:
+        return {"error": f"unknown pod {pod if src_row is None else dest}"}
+    payload = json.dumps({
+        "verb": "drain", "dests": {dest: dest_row["address"]},
+    }).encode("utf-8")
+    req = urllib.request.Request(
+        f"http://{src_row['address']}/migrate", data=payload,
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            return json.loads(resp.read().decode("utf-8"))
+    except (urllib.error.URLError, OSError, ValueError) as e:
+        return {"error": f"migration drain failed: {e}"}
 
 
 def fetch_endpoint(scheduler_url: str, endpoint: str,
@@ -168,6 +209,12 @@ class RouterServer:
                     self._reply(200, router.stats())
                 elif path == "/pods":
                     self._reply(200, router.describe())
+                elif path == "/rebalance":
+                    # advisory only: the operator (or remediation)
+                    # reads the suggestion and drives the migration
+                    self._reply(200, {
+                        "suggestion": router.rebalance_suggestion(),
+                    })
                 else:
                     self._reply(404, {"error": f"no route {path}"})
 
@@ -176,13 +223,26 @@ class RouterServer:
 
                 parsed = urlparse(self.path)
                 if parsed.path in ("/drain", "/undrain"):
-                    pod = (parse_qs(parsed.query).get("pod") or [""])[0]
-                    verb = router.drain if parsed.path == "/drain" \
-                        else router.undrain
-                    if verb(pod):
-                        self._reply(200, {"pod": pod,
-                                          "draining": parsed.path ==
-                                          "/drain"})
+                    query = parse_qs(parsed.query)
+                    pod = (query.get("pod") or [""])[0]
+                    body = {"pod": pod,
+                            "draining": parsed.path == "/drain"}
+                    if parsed.path == "/drain":
+                        # ?to= names the migration destination: the
+                        # pod's live sessions move there WITH their
+                        # pages (the worker's drain verb) and its
+                        # chain claims re-point instead of being
+                        # dropped (cache-preserving drain)
+                        dest = (query.get("to") or [""])[0]
+                        ok = router.drain(pod, migrated_to=dest or None)
+                        if ok and dest:
+                            body["report"] = migrate_drain(
+                                router, pod, dest
+                            )
+                    else:
+                        ok = router.undrain(pod)
+                    if ok:
+                        self._reply(200, body)
                     else:
                         self._reply(404, {"error": f"no pod {pod}"})
                     return

@@ -53,6 +53,7 @@ class PodTelemetry:
         "stale_after_s", "observed_at", "engine_age_s", "queue_depth",
         "active_slots", "free_slots", "kv_pages_free", "kv_occupancy",
         "tokens_per_s", "prefix_hit_rate", "ttft_p95_s", "has_snapshot",
+        "serving_role", "prefill_backlog",
     )
 
     def __init__(self, stale_after_s: float = DEFAULT_STALE_AFTER_S):
@@ -68,6 +69,12 @@ class PodTelemetry:
         self.prefix_hit_rate = 0.0
         self.ttft_p95_s = 0.0
         self.has_snapshot = False
+        # disaggregation gauges (ISSUE 16): the pod's declared
+        # serving role ("" until it reports one) and its unfilled
+        # prompt-token backlog — the load signal that matters on a
+        # prefill pod, whose decode gauges sit near zero by design
+        self.serving_role = ""
+        self.prefill_backlog = 0.0
 
     # -- ingestion (the single raw-dict touchpoint) -------------------
 
@@ -89,6 +96,12 @@ class PodTelemetry:
         self.tokens_per_s = _as_float(stats.get("tokens_per_s"))
         self.prefix_hit_rate = _as_float(stats.get("prefix_cache_hit_rate"))
         self.ttft_p95_s = _as_float(stats.get("ttft_p95_s"))
+        role = stats.get("serving_role")
+        if isinstance(role, str):
+            self.serving_role = role
+        self.prefill_backlog = _as_float(
+            stats.get("prefill_chunk_backlog")
+        )
 
     # -- the staleness gate -------------------------------------------
 
@@ -114,7 +127,14 @@ class PodTelemetry:
         headroom_penalty = 0.0
         if self.kv_occupancy > 0.9:
             headroom_penalty = (self.kv_occupancy - 0.9) * 10.0
-        return self.queue_depth + self.active_slots + headroom_penalty
+        score = self.queue_depth + self.active_slots + headroom_penalty
+        if self.serving_role == "prefill":
+            # a prefill pod's real load is its unfilled prompt
+            # backlog (rows sit in _prefilling, not the queue, and
+            # hand off before decode): scale tokens to request-ish
+            # units so prefill pods spread like any other capacity
+            score += self.prefill_backlog / 64.0
+        return score
 
     def describe(self, now: float) -> dict:
         """Debug-surface row (front door ``GET /pods``)."""
@@ -133,4 +153,6 @@ class PodTelemetry:
             "tokens_per_s": self.tokens_per_s,
             "prefix_cache_hit_rate": self.prefix_hit_rate,
             "ttft_p95_s": self.ttft_p95_s,
+            "serving_role": self.serving_role or "unified",
+            "prefill_chunk_backlog": self.prefill_backlog,
         }

@@ -18,6 +18,11 @@ generation.  Two engines share that loop:
 ``pool.py`` is the device half: the jitted prefill/decode pair over
 the persistent cache (models/decode.py), shared by the single-chip
 server and the multi-host gang driver.
+
+``migration.py`` (ISSUE 16) makes the KV page the unit of MOBILITY:
+live sessions move pod-to-pod mid-generation under a fenced cutover
+protocol — the primitive behind drain-with-migration, prefix-hotspot
+rebalancing, and prefill/decode disaggregation.
 """
 
 from dcos_commons_tpu.serve.engine import (
@@ -25,6 +30,19 @@ from dcos_commons_tpu.serve.engine import (
     PagedEngine,
     SlotEngine,
     read_servestats,
+)
+from dcos_commons_tpu.serve.migration import (
+    HttpEngineClient,
+    InProcessTransport,
+    MigrationError,
+    MigrationRecord,
+    PrefillHandoff,
+    ReleasePendingError,
+    SessionMigratedError,
+    SessionSnapshot,
+    SimulatedDcnTransport,
+    drain_sessions,
+    migrate_session,
 )
 from dcos_commons_tpu.serve.paging import (
     PageAllocator,
@@ -34,10 +52,21 @@ from dcos_commons_tpu.serve.paging import (
 
 __all__ = [
     "SERVESTATS_NAME",
+    "HttpEngineClient",
+    "InProcessTransport",
+    "MigrationError",
+    "MigrationRecord",
     "PageAllocator",
     "PagedEngine",
     "PagedServeConfig",
+    "PrefillHandoff",
+    "ReleasePendingError",
+    "SessionMigratedError",
+    "SessionSnapshot",
+    "SimulatedDcnTransport",
     "SlotEngine",
+    "drain_sessions",
+    "migrate_session",
     "paged_config_from_env",
     "read_servestats",
 ]

@@ -78,7 +78,7 @@ class _Row:
 
     __slots__ = (
         "tokens", "n", "temp", "eos", "seed", "out", "group",
-        "arrival", "slot",
+        "arrival", "slot", "rid", "frozen",
     )
 
     def __init__(self, tokens, n, temp, eos, seed, group):
@@ -91,6 +91,12 @@ class _Row:
         self.group = group
         self.arrival = time.monotonic()
         self.slot = -1
+        # migration identity/fence (serve/migration.py, ISSUE 16):
+        # rid is the pod-local session id; a frozen row holds its
+        # slot and pages but is excluded from every dispatch until
+        # unfrozen, released to a peer, or activated after a splice
+        self.rid = -1
+        self.frozen = False
 
 
 class SlotEngine:
@@ -153,6 +159,7 @@ class SlotEngine:
         self._temps = np.zeros(slots, np.float32)
         self._seeds = np.zeros(slots, np.int32)
         self._stopped = False
+        self._next_rid = 1  # session ids (migration's addressing unit)
         # telemetry (counters under the cv; deques pruned on append)
         self._admitted = 0
         self._completed = 0
@@ -231,6 +238,9 @@ class SlotEngine:
                 # idle -> working transition: liveness is measured
                 # from THIS arrival, not across the idle gap
                 self._last_tick_mono = now
+            for r in group.rows:
+                r.rid = self._next_rid
+                self._next_rid += 1
             self._queue.extend(group.rows)
             self._cv.notify_all()
         # the timeout bounds SATURATION, not a healthy generation: a
@@ -491,11 +501,19 @@ class SlotEngine:
         if self._row_finished(row, first, int(len(row.tokens))):
             self._retire_locked(row)
             return
+        self._install_decode_locked(row)
+
+    def _install_decode_locked(self, row: _Row) -> None:
+        """Enter ``row`` into the decode set at its current progress
+        — a fresh admission (out == [first]) and a spliced-in
+        migrated session (out carries every token so far) resume
+        through the same door: decode continues from (out[-1],
+        plen + len(out) - 1), wherever that state was produced."""
         slot = row.slot
         self._rows[slot] = row
         self._active += 1
-        self._tok[slot] = first
-        self._pos[slot] = len(row.tokens)  # next cache write position
+        self._tok[slot] = row.out[-1]
+        self._pos[slot] = len(row.tokens) + len(row.out) - 1
         self._temps[slot] = row.temp
         self._seeds[slot] = row.seed
 
@@ -511,6 +529,17 @@ class SlotEngine:
         with self._cv:
             extra = self._decode_prep_locked()
             active = self._active
+            # who this tick actually computes for: a row installed
+            # into a slot AFTER this point (a splice activation or a
+            # migration-abort unfreeze, both peer threads) must not
+            # be credited this tick's sample — it was computed from
+            # the slot's previous state.  Frozen rows count as
+            # not-dispatched: their table was zeroed above, so the
+            # sample is trash even if they unfreeze mid-tick.
+            dispatched = [
+                r if (r is not None and not r.frozen) else None
+                for r in self._rows
+            ]
         try:
             nxt = np.asarray(self._decode_fn(
                 self._tok.copy(), self._pos.copy(),
@@ -524,7 +553,7 @@ class SlotEngine:
         now = time.monotonic()
         merged = None
         with self._cv:
-            self._apply_decode_locked(nxt, now)
+            self._apply_decode_locked(nxt, now, dispatched)
             if self._active >= 2 and not self._merge_logged:
                 self._merge_logged = True
                 merged = self._active
@@ -536,11 +565,24 @@ class SlotEngine:
                 f"step over the {self._MERGE_NOUN}"
             )
 
-    def _apply_decode_locked(self, nxt: np.ndarray, now: float) -> None:
+    def _apply_decode_locked(self, nxt: np.ndarray, now: float,
+                             dispatched=None) -> None:
         produced = 0
         for slot in range(self._slots):
             row = self._rows[slot]
             if row is None:
+                continue
+            if dispatched is not None and dispatched[slot] is not row:
+                # not this tick's row (installed or unfrozen mid-tick
+                # by a migration thread): its first real sample is
+                # next tick's
+                continue
+            if row.frozen:
+                # fenced for migration: this tick dispatched it with
+                # a zero (trash) table row, so the sampled token is
+                # discarded and (tok, pos) stand still — decode
+                # resumes from the exact frozen state on whichever
+                # pod ends up owning the session
                 continue
             if row.group.abandoned:
                 self._retire_locked(row)
@@ -689,7 +731,7 @@ class PagedEngine(SlotEngine):
     _row_cls = _PagedRow
     METRIC_KEYS = SlotEngine.METRIC_KEYS + (
         "kv_pages_free", "prefix_cache_hit_rate",
-        "prefill_chunk_backlog",
+        "prefill_chunk_backlog", "migrations_in", "migrations_out",
     )
 
     def __init__(
@@ -704,7 +746,11 @@ class PagedEngine(SlotEngine):
         pages: int,
         chunk_tokens: int,
         prefix_cache: bool = True,
-        **kw,
+        role: str = "unified",
+        read_page: Optional[Callable] = None,
+        write_page: Optional[Callable] = None,
+        handoff: Optional[Callable] = None,
+    **kw,
     ):
         from dcos_commons_tpu.serve.paging import (
             PageAllocator,
@@ -720,6 +766,23 @@ class PagedEngine(SlotEngine):
             int(pages), int(page_tokens), prefix_cache
         )
         self._prefilling: deque = deque()
+        # migration state (serve/migration.py, ISSUE 16).  role is
+        # the pod's advertised serving posture (unified / prefill /
+        # decode) — telemetry and routing read it; the HANDOFF hook's
+        # presence is what actually diverts finished prefills.
+        # read_page/write_page are the device half of page mobility
+        # (PagedPoolModel.export_page/import_page on real pods); both
+        # run ONLY on the engine loop thread (_device_io), preserving
+        # the single-device-caller discipline.
+        self._role = str(role)
+        self._read_page = read_page
+        self._write_page = write_page
+        self._handoff = handoff
+        self._page_io: deque = deque()
+        self._spliced: dict = {}    # rid -> parked row (pre-cutover)
+        self._migrated: dict = {}   # rid -> spliced row (collectable)
+        self._migrated_in = 0
+        self._migrated_out = 0
         super().__init__(
             prefill_chunk_fn, decode_fn, slots, max_len, prompt_len,
             **kw,
@@ -728,7 +791,11 @@ class PagedEngine(SlotEngine):
     # -- admission ---------------------------------------------------
 
     def _has_work_locked(self) -> bool:
-        return super()._has_work_locked() or bool(self._prefilling)
+        return (
+            super()._has_work_locked()
+            or bool(self._prefilling)
+            or bool(self._page_io)
+        )
 
     def _pop_admits_locked(self) -> List[_Row]:
         """FIFO admission under BOTH constraints — a free decode row
@@ -757,12 +824,23 @@ class PagedEngine(SlotEngine):
         return admits
 
     def _work_tick(self, admits: List[_Row]) -> None:
+        self._run_page_io()
         if admits:
             with self._cv:
                 self._prefilling.extend(admits)
         self._prefill_tick()
         if self._active:
             self._decode_tick()
+
+    def _run_page_io(self) -> None:
+        """Drain queued migration page reads/writes (loop thread,
+        outside the cv — these are device calls like any dispatch)."""
+        while True:
+            with self._cv:
+                if not self._page_io:
+                    return
+                job = self._page_io.popleft()
+            job()
 
     # -- chunked prefill ---------------------------------------------
 
@@ -784,6 +862,8 @@ class PagedEngine(SlotEngine):
             with self._cv:
                 if row.admission is None:
                     continue  # already retired/failed this tick
+                if row.frozen:
+                    continue  # fenced mid-prefill for migration
                 if row.group.abandoned:
                     # abandoned before its first token: free the
                     # pages/slot now, nothing ever reached the client
@@ -802,15 +882,67 @@ class PagedEngine(SlotEngine):
                 true_len=clen, temp=row.temp, seed=row.seed,
             )
             now = time.monotonic()
+            handoff_row = None
             with self._cv:
                 row.fill_pos = start + clen
                 self._register_pages_locked(row)
                 if row.fill_pos >= plen:
-                    self._prefilling.remove(row)
                     if row.group.abandoned:
+                        self._prefilling.remove(row)
                         self._retire_locked(row)
+                    elif self._handoff is not None:
+                        # disaggregation: the prompt is prefilled and
+                        # its first token sampled — this pod's work
+                        # is done.  Count admission/TTFT HERE (the
+                        # destination replays neither), fence the row
+                        # and ship it to a decode pod outside the cv
+                        self._admitted += 1
+                        self._ttft.append(now - row.arrival)
+                        row.out.append(int(first))
+                        self._count_tokens_locked(1, now)
+                        if self._row_finished(row, int(first), plen):
+                            self._prefilling.remove(row)
+                            self._retire_locked(row)
+                        else:
+                            row.frozen = True
+                            handoff_row = row
                     else:
+                        self._prefilling.remove(row)
                         self._apply_admit_locked(row, int(first), now)
+            if handoff_row is not None:
+                self._run_handoff(handoff_row)
+
+    def _run_handoff(self, row) -> None:
+        """Hand a finished prefill to the decode pool (loop thread,
+        outside the cv).  Any pre-cutover failure falls back to
+        decoding locally — a prefill pod degrades to unified rather
+        than failing the request.  A post-cutover failure
+        (ReleasePendingError) leaves the row frozen: the destination
+        owns the session now, and resuming here would double-serve."""
+        from dcos_commons_tpu.serve.migration import (
+            ReleasePendingError,
+        )
+
+        try:
+            ok = self._handoff(self, row.rid)
+        except ReleasePendingError:
+            if self._log is not None:
+                self._log(
+                    f"handoff of session {row.rid} cut over but "
+                    "release failed; holding the frozen source row "
+                    "for a retried release"
+                )
+            return
+        except Exception as e:  # noqa: BLE001 — degrade, don't fail the request
+            ok = None
+            if self._log is not None:
+                self._log(
+                    f"prefill handoff failed ({e}); decoding locally"
+                )
+        if ok is None:
+            with self._cv:
+                if row.frozen:
+                    self._unfreeze_locked(row)
 
     def _ensure_pages_locked(self, row, first_pos: int,
                              last_pos: int) -> None:
@@ -847,10 +979,12 @@ class PagedEngine(SlotEngine):
         """Allocate this tick's write pages and snapshot every row's
         page table for the decode dispatch."""
         for slot, row in enumerate(self._rows):
-            if row is None or row.group.abandoned:
+            if row is None or row.group.abandoned or row.frozen:
                 # an abandoned row retires at apply; its write this
                 # tick lands in the trash page (table may miss the
-                # next page — masked, discarded)
+                # next page — masked, discarded).  A FROZEN row gets
+                # a zero table below: its pages must stop changing
+                # the moment the migration fence drops
                 continue
             pos = int(self._pos[slot])
             self._ensure_pages_locked(row, pos, pos)
@@ -858,9 +992,354 @@ class PagedEngine(SlotEngine):
             (self._slots, self._pages_per_row), np.int32
         )
         for slot, row in enumerate(self._rows):
-            if row is not None:
+            if row is not None and not row.frozen:
                 tables[slot] = row.table
         return (tables,)
+
+    # -- migration (serve/migration.py, ISSUE 16) --------------------
+
+    def _device_io(self, fn):
+        """Run a page read/write on the loop thread (the engine's one
+        device caller) and return its result.  Called FROM the loop
+        thread (prefill handoff) it runs inline; from a migration
+        thread it queues and blocks until the loop executes it."""
+        from dcos_commons_tpu.serve.migration import MigrationError
+
+        if threading.current_thread() is self._thread:
+            return fn()
+        done = threading.Event()
+        box: dict = {}
+
+        def job():
+            try:
+                box["result"] = fn()
+            except BaseException as e:  # noqa: BLE001 — re-raised in the waiter
+                box["error"] = e
+            finally:
+                done.set()
+
+        with self._cv:
+            if self._stopped:
+                raise MigrationError("engine stopped")
+            self._page_io.append(job)
+            self._cv.notify_all()
+        if not done.wait(timeout=60.0):
+            raise MigrationError("page io stalled on the engine loop")
+        if "error" in box:
+            raise box["error"]
+        return box["result"]
+
+    def _find_rid_locked(self, rid: int):
+        for row in self._rows:
+            if row is not None and row.rid == rid:
+                return row
+        for row in self._prefilling:
+            if row.rid == rid:
+                return row
+        return None
+
+    def sessions(self) -> List[dict]:
+        """Live migratable sessions: rows holding pages that are not
+        already fenced — the drain/rebalance work list."""
+        out: List[dict] = []
+        with self._cv:
+            for row in self._prefilling:
+                if not row.frozen and not row.group.abandoned:
+                    out.append({
+                        "rid": row.rid, "tokens": list(row.tokens),
+                        "state": "prefill",
+                        "pages": int(np.count_nonzero(row.table)),
+                    })
+            for row in self._rows:
+                if (row is not None and not row.frozen
+                        and not row.group.abandoned
+                        and row.admission is not None):
+                    out.append({
+                        "rid": row.rid, "tokens": list(row.tokens),
+                        "state": "decode",
+                        "pages": int(np.count_nonzero(row.table)),
+                    })
+        return out
+
+    def freeze(self, rid: int) -> None:
+        """Fence a session: decode/prefill stop at the next tick
+        boundary and its pages stop changing (the in-flight tick's
+        write is idempotent — K/V at a position is a pure function of
+        token and position — and its sampled token is discarded)."""
+        from dcos_commons_tpu.serve.migration import MigrationError
+
+        with self._cv:
+            row = self._find_rid_locked(rid)
+            if row is None or row.admission is None:
+                raise MigrationError(f"no live session {rid} to freeze")
+            row.frozen = True
+
+    def unfreeze(self, rid: int) -> None:
+        """Drop the fence: an aborted migration resumes exactly where
+        it froze.  Silently a no-op when the session is gone (a
+        failure fan-out already answered its client)."""
+        with self._cv:
+            row = self._find_rid_locked(rid)
+            if row is None:
+                return
+            if row.frozen:
+                self._unfreeze_locked(row)
+            self._cv.notify_all()
+
+    def _unfreeze_locked(self, row) -> None:
+        row.frozen = False
+        if row in self._prefilling and row.fill_pos >= len(row.tokens):
+            # a prefill-COMPLETE fenced row (handoff path): it never
+            # entered the decode set, so resuming means installing it
+            self._prefilling.remove(row)
+            if self._row_finished(
+                row, row.out[-1], len(row.tokens) + len(row.out) - 1
+            ):
+                self._retire_locked(row)
+            else:
+                self._install_decode_locked(row)
+        self._cv.notify_all()
+
+    def export_frozen(self, rid: int):
+        """Snapshot a frozen session for the wire: request + progress
+        + every mapped page's payload, keyed by VIRTUAL index
+        (physical ids never leave the pod).  Page reads run on the
+        loop thread."""
+        from dcos_commons_tpu.serve.migration import (
+            MigrationError,
+            SessionSnapshot,
+        )
+
+        if self._read_page is None:
+            raise MigrationError(
+                "no page reader bound (PagedEngine read_page=...)"
+            )
+        with self._cv:
+            row = self._find_rid_locked(rid)
+            if row is None or row.admission is None:
+                raise MigrationError(f"no live session {rid} to export")
+            if not row.frozen:
+                raise MigrationError(
+                    f"session {rid} is not frozen — export without a "
+                    "fence would race decode"
+                )
+            plen = len(row.tokens)
+            kv_end = (
+                plen + len(row.out) - 1
+                if row.fill_pos >= plen and row.out else row.fill_pos
+            )
+            pages = [
+                (v, int(row.table[v]))
+                for v in range(len(row.table)) if row.table[v] != 0
+            ]
+            meta = (
+                list(row.tokens), row.n, row.temp, row.eos, row.seed,
+                list(row.out), row.fill_pos,
+            )
+        payloads = self._device_io(
+            lambda: [(v, self._read_page(p)) for v, p in pages]
+        )
+        tokens, n, temp, eos, seed, out, fill_pos = meta
+        return SessionSnapshot(
+            rid=rid, tokens=tokens, max_new=n, temperature=temp,
+            eos=eos, seed=seed, out=out, fill_pos=fill_pos,
+            kv_end=kv_end, page_tokens=self._page_tokens,
+            pages=payloads, source=self._role,
+        )
+
+    def splice(self, snap) -> int:
+        """Admit a migrated session under the SAME transactional rule
+        a fresh request faces (paging.admit — worst-case reservation,
+        prefix-cache matching), copy only the pages the local prefix
+        cache cannot serve, and PARK the row.  Nothing decodes until
+        ``activate``; ``abort_splice`` undoes everything.  Returns
+        the destination-local rid."""
+        from dcos_commons_tpu.serve.migration import MigrationError
+        from dcos_commons_tpu.serve.paging import pages_for
+
+        if self._write_page is None:
+            raise MigrationError(
+                "no page writer bound (PagedEngine write_page=...)"
+            )
+        if int(snap.page_tokens) != self._page_tokens:
+            raise MigrationError(
+                f"page geometry mismatch: snapshot has "
+                f"{snap.page_tokens}-token pages, this arena "
+                f"{self._page_tokens}"
+            )
+        plen = len(snap.tokens)
+        if plen > self._prompt_len or plen + snap.max_new > self._max_len:
+            raise MigrationError(
+                f"session does not fit this pod's geometry "
+                f"({plen}+{snap.max_new} vs {self._max_len})"
+            )
+        incoming = dict(snap.pages)
+        with self._cv:
+            if not self._free:
+                raise MigrationError("no free decode row")
+            admission = self._allocator.admit(snap.tokens, snap.max_new)
+            if admission is None:
+                raise MigrationError(
+                    "page budget cannot admit the migrated session"
+                )
+            m = len(admission.matched)
+            need = (
+                pages_for(int(snap.kv_end), self._page_tokens)
+                if snap.kv_end > 0 else 0
+            )
+            missing = [
+                v for v in range(m, need) if v not in incoming
+            ]
+            if missing:
+                self._allocator.retire(admission, [])
+                raise MigrationError(
+                    f"snapshot is missing pages {missing}"
+                )
+            group = _Group([])
+            row = self._row_cls(
+                list(snap.tokens), snap.max_new, snap.temperature,
+                snap.eos, snap.seed, group,
+            )
+            group.rows = [row]
+            group.remaining = 1
+            row.rid = self._next_rid
+            self._next_rid += 1
+            row.slot = self._free.pop()
+            row.admission = admission
+            row.table = np.zeros(self._pages_per_row, np.int32)
+            for i, entry in enumerate(admission.matched):
+                row.table[i] = entry.page
+            row.registered_to = m
+            # the local cache may hold MORE of the prompt than the
+            # source had prefilled — prefill resumes past it
+            row.fill_pos = max(int(snap.fill_pos),
+                               m * self._page_tokens)
+            row.out = [int(t) for t in snap.out]
+            row.frozen = True
+            imports = []
+            for v in range(m, need):
+                page = self._allocator.alloc(admission)
+                row.table[v] = page
+                row.private_pages.append(page)
+                imports.append((page, incoming[v]))
+            self._spliced[row.rid] = row
+            self._migrated[row.rid] = row
+            if len(self._migrated) > 256:
+                # uncollected finished sessions age out (a router
+                # always collects; this bounds a buggy caller)
+                for old_rid in [
+                    r for r, rw in self._migrated.items()
+                    if rw.group.done.is_set()
+                ][:64]:
+                    self._migrated.pop(old_rid, None)
+            self._cv.notify_all()
+        try:
+            self._device_io(lambda: [
+                self._write_page(p, payload) for p, payload in imports
+            ])
+        except BaseException:
+            self.abort_splice(row.rid)
+            raise
+        return row.rid
+
+    def activate(self, rid: int) -> None:
+        """CUTOVER: the parked spliced row starts serving here.  Full
+        prompt pages it carried are published to the prefix cache
+        only now — after their payloads landed (registering sooner
+        would let a concurrent admission pin an unwritten page)."""
+        from dcos_commons_tpu.serve.migration import MigrationError
+
+        with self._cv:
+            row = self._spliced.pop(rid, None)
+            if row is None:
+                raise MigrationError(f"no spliced session {rid}")
+            row.frozen = False
+            self._register_pages_locked(row)
+            self._migrated_in += 1
+            plen = len(row.tokens)
+            if row.fill_pos < plen:
+                self._prefilling.append(row)  # resumes chunked prefill
+            elif row.out and self._row_finished(
+                row, row.out[-1], plen + len(row.out) - 1
+            ):
+                self._retire_locked(row)
+            elif row.out:
+                self._install_decode_locked(row)
+            else:
+                raise MigrationError(
+                    f"spliced session {rid} has no resume point"
+                )
+            if not self._has_work_locked():
+                self._last_tick_mono = time.monotonic()
+            self._cv.notify_all()
+
+    def abort_splice(self, rid: int) -> None:
+        """Undo a splice that never activated: pages and slot return
+        to the arena.  No-op when the rid is unknown (already
+        activated or never spliced) — abort is best-effort."""
+        with self._cv:
+            row = self._spliced.pop(rid, None)
+            if row is None:
+                return
+            self._migrated.pop(rid, None)
+            self._free.append(row.slot)
+            if row.admission is not None:
+                self._allocator.retire(row.admission, row.private_pages)
+                row.admission = None
+                row.private_pages = []
+                row.table = None
+
+    def release_migrated(self, rid: int, *, moved_to: str,
+                         dest_rid: int) -> None:
+        """The protocol's last verb: after cutover, retire the frozen
+        source row, free its pages, and answer its blocked client
+        with ``SessionMigratedError`` naming the destination (the
+        router follows with a collect request)."""
+        from dcos_commons_tpu.serve.migration import (
+            MigrationError,
+            SessionMigratedError,
+        )
+
+        with self._cv:
+            row = self._find_rid_locked(rid)
+            if row is None:
+                raise MigrationError(f"no session {rid} to release")
+            if not row.frozen:
+                raise MigrationError(
+                    f"session {rid} is not frozen — release without a "
+                    "fence would double-serve"
+                )
+            if row in self._prefilling:
+                self._prefilling.remove(row)
+            self._migrated_out += 1
+            row.group.error = SessionMigratedError(
+                rid, moved_to, dest_rid
+            )
+            self._retire_locked(row)
+
+    def collect(self, rid: int,
+                timeout: Optional[float] = None) -> List[int]:
+        """Block until a migrated-in session finishes and return its
+        FULL output — the tokens the source already produced plus
+        everything decoded here, one seamless reply."""
+        from dcos_commons_tpu.serve.migration import MigrationError
+
+        with self._cv:
+            row = self._migrated.get(rid)
+        if row is None:
+            raise MigrationError(
+                f"no migrated session {rid} to collect"
+            )
+        wait_s = timeout if timeout is not None else self._queue_timeout_s
+        if not row.group.done.wait(timeout=wait_s):
+            raise QueueTimeoutError(
+                "migrated session did not finish", kind="stalled"
+            )
+        with self._cv:
+            self._migrated.pop(rid, None)
+        if row.group.error is not None:
+            raise row.group.error
+        return list(row.out)
 
     # -- retirement / failure ----------------------------------------
 
@@ -879,6 +1358,15 @@ class PagedEngine(SlotEngine):
             self._free.append(row.slot)
             row.slot = -1
         self._prefilling.clear()
+        # parked spliced rows die with everything else: their groups
+        # error out so a blocked collect() unblocks, and their slots
+        # return (allocator.reset() below reclaims the pages)
+        extra |= {r.group for r in self._spliced.values()}
+        for row in self._spliced.values():
+            self._free.append(row.slot)
+            row.slot = -1
+            row.admission = None
+        self._spliced.clear()
         super()._fail_all_locked(error, extra_groups=extra)
         # every admission died with its group: rebuild the arena
         # bookkeeping (the prefix cache's pages may hold K/V written
@@ -959,6 +1447,13 @@ class PagedEngine(SlotEngine):
             sum(len(r.tokens) - r.fill_pos for r in self._prefilling)
             + sum(len(r.tokens) for r in self._queue)
         )
+        # migration surfaces (ISSUE 16): the pod's serving posture —
+        # the router's role-aware placement and the role-aware health
+        # gating (health/detectors.py) key on serving_role — and the
+        # protocol's traffic counters for /v1/debug/serving
+        out["serving_role"] = self._role
+        out["migrations_in"] = self._migrated_in
+        out["migrations_out"] = self._migrated_out
         return out
 
 

@@ -301,6 +301,34 @@ class PagedPoolModel:
         )
         return np.asarray(self._jax.device_get(nxt))
 
+    def export_page(self, page: int) -> dict:
+        """Snapshot one physical page as host numpy, every cache key
+        included (int8 arenas ship their per-vector scales too — a
+        page without its scales decodes to garbage).  Single-caller
+        contract like ``prefill_chunk``/``decode``: only the engine
+        loop may call this (serve/engine.py routes it through the
+        page-I/O queue), since it reads ``self.cache`` mid-stream."""
+        return {
+            key: np.asarray(self._jax.device_get(arr[:, page]))
+            for key, arr in self.cache.items()
+        }
+
+    def import_page(self, page: int, payload: dict) -> None:
+        """Splice one exported page into physical page ``page`` of
+        THIS arena.  Keys must match this pool's cache layout (both
+        ends run the same model/kv_dtype — the migration geometry
+        check upstream guarantees page_tokens; dtype mismatch raises
+        here).  Same single-caller contract as ``export_page``."""
+        if set(payload) != set(self.cache):
+            raise ValueError(
+                f"page payload keys {sorted(payload)} do not match "
+                f"arena keys {sorted(self.cache)} (kv_dtype mismatch?)"
+            )
+        for key, arr in self.cache.items():
+            self.cache[key] = arr.at[:, page].set(
+                self._jnp.asarray(payload[key], arr.dtype)
+            )
+
     def warm(self) -> None:
         """Compile + execute both entry points before readiness.  All
         tables are zero, so every write lands in the trash page and

@@ -50,6 +50,14 @@ from dcos_commons_tpu.serve import (  # noqa: E402
     SlotEngine,
     paged_config_from_env,
 )
+from dcos_commons_tpu.serve.migration import (  # noqa: E402
+    HttpEngineClient,
+    MigrationError,
+    PrefillHandoff,
+    SessionMigratedError,
+    SessionSnapshot,
+    drain_sessions,
+)
 from dcos_commons_tpu.utils.microbatch import (  # noqa: E402
     MicroBatcher,
     QueueTimeoutError,
@@ -144,12 +152,24 @@ def main() -> int:
             self.wfile.write(payload)
 
         def do_POST(self):
+            if self.path == "/migrate":
+                self._do_migrate()
+                return
             if self.path != "/generate":
                 self.send_error(404)
                 return
             length = int(self.headers.get("Content-Length", 0))
             try:
                 body = json.loads(self.rfile.read(length))
+                if "collect" in body:
+                    # the migration follow-up (router/core.py): the
+                    # session moved HERE mid-generation and the
+                    # router collects the finished reply by dest rid
+                    result = [engine.collect(int(body["collect"]))]
+                    payload = json.dumps({"tokens": result}).encode()
+                    self.send_response(200)
+                    self._finish(payload)
+                    return
                 rows = body["tokens"]
                 if len(rows) > batch:
                     raise ValueError(
@@ -197,6 +217,17 @@ def main() -> int:
                 )
                 payload = json.dumps({"tokens": result}).encode()
                 self.send_response(200)
+            except SessionMigratedError as e:
+                # a redirect, not a failure: the session finished on
+                # another pod — 409 names it and the router follows
+                # with a collect request (router/frontdoor.py)
+                payload = json.dumps({
+                    "error": str(e),
+                    "rid": e.rid,
+                    "migrated_to": e.moved_to,
+                    "dest_rid": e.dest_rid,
+                }).encode()
+                self.send_response(409)
             except QueueTimeoutError as e:
                 # saturation, NOT caller error: the request never got
                 # a KV slot in time — clients/load generators back off
@@ -205,10 +236,70 @@ def main() -> int:
             except Exception as e:  # noqa: BLE001 — surface to client
                 payload = json.dumps({"error": str(e)}).encode()
                 self.send_response(400)
+            self._finish(payload)
+
+        def _finish(self, payload: bytes) -> None:
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(payload)))
             self.end_headers()
             self.wfile.write(payload)
+
+        def _do_migrate(self) -> None:
+            """The DCN lane's HTTP leg: this pod as a migration
+            DESTINATION (serve/migration.py HttpEngineClient drives
+            it verb by verb).  409 = the engine refused (budget,
+            geometry, unknown rid) — the source aborts cleanly and
+            resumes; 400 = malformed request."""
+            length = int(self.headers.get("Content-Length", 0))
+            try:
+                body = json.loads(self.rfile.read(length))
+                verb = body.get("verb")
+                if paged is None:
+                    raise MigrationError(
+                        "slot-pool pods cannot host migrations "
+                        "(KV_PAGE_TOKENS=0)"
+                    )
+                if verb == "splice":
+                    snap = SessionSnapshot.from_wire(body["snapshot"])
+                    dest_rid = engine.splice(snap)
+                    payload = json.dumps(
+                        {"dest_rid": dest_rid}
+                    ).encode()
+                elif verb == "activate":
+                    engine.activate(int(body["rid"]))
+                    payload = json.dumps({"ok": True}).encode()
+                elif verb == "abort":
+                    engine.abort_splice(int(body["rid"]))
+                    payload = json.dumps({"ok": True}).encode()
+                elif verb == "drain":
+                    # source-side one-shot: move every live session
+                    # to the named peers (drain-with-migration — the
+                    # front door's /drain?to= and the scale-in plan
+                    # both drive this instead of waiting generations
+                    # out).  Sessions that cannot move are reported
+                    # ok=false and finish here under the legacy drain.
+                    dests = {
+                        str(peer): HttpEngineClient(str(peer),
+                                                    str(addr))
+                        for peer, addr in dict(
+                            body.get("dests") or {}
+                        ).items()
+                    }
+                    report = drain_sessions(
+                        engine, dests,
+                        log=lambda msg: print(msg, flush=True),
+                    )
+                    payload = json.dumps({"report": report}).encode()
+                else:
+                    raise ValueError(f"unknown migrate verb {verb!r}")
+                self.send_response(200)
+            except MigrationError as e:
+                payload = json.dumps({"error": str(e)}).encode()
+                self.send_response(409)
+            except Exception as e:  # noqa: BLE001 — surface to client
+                payload = json.dumps({"error": str(e)}).encode()
+                self.send_response(400)
+            self._finish(payload)
 
     # a RELAUNCH reuses the sandbox: a stale ready file from the
     # previous incarnation must not pass readiness while we are cold
@@ -237,7 +328,28 @@ def main() -> int:
 
     if paged is not None:
         # the paged arena (ISSUE 11): page-budgeted admission,
-        # chunked prefill, prefix caching — the serving default
+        # chunked prefill, prefix caching — the serving default.
+        # SERVE_ROLE (ISSUE 16) declares this pod's place in a
+        # disaggregated topology; a prefill pod with SERVE_DECODE_PODS
+        # peers hands finished prompts to the decode pool over the
+        # /migrate lane, and degrades to unified when it cannot.
+        role = (os.environ.get("SERVE_ROLE") or "").strip() or "unified"
+        handoff = None
+        if role == "prefill":
+            decode_pods = {}
+            for item in os.environ.get("SERVE_DECODE_PODS",
+                                       "").split(","):
+                if "=" not in item:
+                    continue
+                peer, addr = item.split("=", 1)
+                peer, addr = peer.strip(), addr.strip()
+                if peer and addr:
+                    decode_pods[peer] = HttpEngineClient(peer, addr)
+            if decode_pods:
+                handoff = PrefillHandoff(
+                    lambda: decode_pods,
+                    log=lambda msg: print(msg, flush=True),
+                )
         pool = PagedPoolModel(
             config, params, slots, max_len, paged.page_tokens,
             paged.pages, paged.chunk_tokens, kv_dtype=kv_dtype,
@@ -249,6 +361,8 @@ def main() -> int:
             chunk_tokens=paged.chunk_tokens,
             prefix_cache=paged.prefix_cache,
             queue_timeout_s=queue_timeout_s, stats_path=stats_path,
+            role=role, read_page=pool.export_page,
+            write_page=pool.import_page, handoff=handoff,
             log=lambda msg: print(msg, flush=True),
             extra_stats={"http_port": bound_port},
         )

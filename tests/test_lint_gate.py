@@ -1488,6 +1488,43 @@ def test_plancheck_repo_gate():
     # no-opposite-concurrent / cooldown-honored / no-remediation-storm
     assert "autoscale" in by_name, sorted(by_name)
     assert by_name["autoscale"].states >= 10_000, summary.render()
+    # the migration configuration (ISSUE 16) gates the fenced cutover
+    # protocol at the same depth: freeze/stream/cutover/release x
+    # operator abort x pod deaths at every protocol state x operator
+    # verbs, with 0 violations of no-double-serve / no-token-loss —
+    # the exactly-once cutover contract bench_disagg asserts
+    # empirically, certified over ALL interleavings here
+    assert "migration" in by_name, sorted(by_name)
+    assert by_name["migration"].states >= 10_000, summary.render()
+
+
+def test_plancheck_catches_broken_cutover_protocol():
+    """Seeded migration-protocol bugs: an abort handler that unfreezes
+    the source after the destination activated forks the token stream
+    (no-double-serve); a protocol that retires the source row on
+    splice success instead of the activate ack discards the session's
+    only copy when the activation never lands (no-token-loss).  Both
+    caught with minimal traces."""
+    result = plancheck.check_plan(
+        lambda: plancheck._migration_plan(abort_after_cutover=True),
+        config_name="seeded-late-abort", max_states=120_000,
+        check_livelock=False,
+    )
+    fork = [v for v in result.violations
+            if v.invariant == "no-double-serve"]
+    assert fork, result.violations
+    # BFS minimality: freeze -> stream -> cutover -> abort, no detour
+    assert len(fork[0].trace) <= 5, fork[0].render()
+
+    result = plancheck.check_plan(
+        lambda: plancheck._migration_plan(release_before_activate=True),
+        config_name="seeded-early-release", max_states=120_000,
+        check_livelock=False,
+    )
+    loss = [v for v in result.violations
+            if v.invariant == "no-token-loss"]
+    assert loss, result.violations
+    assert len(loss[0].trace) <= 5, loss[0].render()
 
 
 def test_plancheck_catches_flapping_governor():
