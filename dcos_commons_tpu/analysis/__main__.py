@@ -7,9 +7,11 @@ Commands (also reachable as ``python -m dcos_commons_tpu analyze``):
     spmd     SPMD collective-safety analyzer (cross-host divergence)
     plan     plan state-machine model checker (exhaustive BFS)
     shard    static sharding / HBM-footprint / collective-cost analyzer
+    race     thread-ownership / happens-before race analyzer (static
+             half; the dynamic half runs under SDKLINT_RACECHECK=1)
     all      everything — the CI gate; default when no command given
 
-Flag spelling (``--lint``/.../``--shard``/``--all``) is accepted too,
+Flag spelling (``--lint``/.../``--race``/``--all``) is accepted too,
 composably: ``--lint --spmd`` runs exactly those two.
 
 Options:
@@ -44,7 +46,7 @@ import os
 import sys
 from typing import List
 
-_COMMANDS = ("lint", "specs", "spmd", "plan", "shard", "all")
+_COMMANDS = ("lint", "specs", "spmd", "plan", "shard", "race", "all")
 
 
 def _default_root() -> str:
@@ -58,11 +60,13 @@ def main(argv: List[str] = None) -> int:
     from dcos_commons_tpu.analysis import baseline as baseline_mod
     from dcos_commons_tpu.analysis import (
         plancheck,
+        racecheck,
         shardcheck,
         speccheck,
         spmdcheck,
     )
     from dcos_commons_tpu.analysis.linter import lint_tree
+    from dcos_commons_tpu.analysis.racecheck import race_rule_catalog
     from dcos_commons_tpu.analysis.rules import rule_catalog
     from dcos_commons_tpu.analysis.shardcheck import shard_rule_catalog
     from dcos_commons_tpu.analysis.spmdcheck import spmd_rule_catalog
@@ -81,6 +85,7 @@ def main(argv: List[str] = None) -> int:
     parser.add_argument("--spmd", action="store_true")
     parser.add_argument("--plan", action="store_true")
     parser.add_argument("--shard", action="store_true")
+    parser.add_argument("--race", action="store_true")
     parser.add_argument("--all", action="store_true")
     parser.add_argument("--json", action="store_true", dest="as_json")
     parser.add_argument("--update-baseline", action="store_true")
@@ -120,15 +125,18 @@ def main(argv: List[str] = None) -> int:
         print(spmd_rule_catalog())
         print()
         print(shard_rule_catalog())
+        print()
+        print(race_rule_catalog())
         return 0
 
     any_mode = (args.lint or args.specs or args.spmd or args.plan
-                or args.shard)
+                or args.shard or args.race)
     run_lint = args.lint or args.all or not any_mode
     run_specs = args.specs or args.all or not any_mode
     run_spmd = args.spmd or args.all or not any_mode
     run_plan = args.plan or args.all or not any_mode
     run_shard = args.shard or args.all or not any_mode
+    run_race = args.race or args.all or not any_mode
     root = os.path.abspath(args.root)
     baseline_path = args.baseline or baseline_mod.baseline_path(root)
     known = baseline_mod.load_baseline(baseline_path)
@@ -178,6 +186,28 @@ def main(argv: List[str] = None) -> int:
     if run_spmd:
         run_findings_pass("spmd", spmdcheck.analyze_tree(root))
 
+    if run_race:
+        race_result = racecheck.analyze_tree(root)
+        run_findings_pass("race", race_result)
+        # trend keys: how much shared state the thread model carries
+        doc["race"]["shared_attrs"] = sum(
+            len(attrs) for attrs in race_result.shared_attrs.values()
+        )
+        doc["race"]["roles"] = len({
+            role
+            for roles in race_result.roles.values()
+            for role in roles
+        })
+        doc["race"]["classes"] = {
+            cls: {
+                "shared_attrs": race_result.shared_attrs.get(cls, []),
+                "roles": race_result.roles.get(cls, []),
+            }
+            for cls in sorted(
+                set(race_result.shared_attrs) | set(race_result.roles)
+            )
+        }
+
     if run_shard:
         shard_result = shardcheck.analyze_all(
             root, hbm_mb=args.hbm_mb, giant_mb=args.giant_mb
@@ -219,10 +249,10 @@ def main(argv: List[str] = None) -> int:
                 failed |= comparison["regression"] is True
 
     if args.update_baseline:
-        if not (run_lint or run_spmd or run_shard):
+        if not (run_lint or run_spmd or run_shard or run_race):
             emit(
-                "baseline: nothing to update — only lint, spmd, and "
-                "shard feed the baseline; run one of them"
+                "baseline: nothing to update — only lint, spmd, shard, "
+                "and race feed the baseline; run one of them"
             )
         else:
             # entries of a baseline-feeding pass that did NOT run
@@ -236,6 +266,8 @@ def main(argv: List[str] = None) -> int:
                     owner_ran = run_spmd
                 elif rule.startswith("shard-"):
                     owner_ran = run_shard
+                elif rule.startswith("race-"):
+                    owner_ran = run_race
                 else:
                     owner_ran = run_lint
                 if not owner_ran:

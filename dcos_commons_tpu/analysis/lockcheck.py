@@ -1,322 +1,31 @@
-"""Runtime lock-order checker: the dynamic half of sdklint.
+"""Back-compat alias: lockcheck grew into racecheck (ISSUE 17).
 
-The static ``lock-discipline`` rule sees one class at a time; what it
-cannot see is the ORDER locks nest across objects at runtime — the
-scheduler cycle holding ``DefaultScheduler._lock`` while stepping
-into ``StateStore._lock``, a plan element's RLock taken inside both.
-A cycle in that nesting graph is a latent deadlock: thread A holds
-L1 wanting L2 while thread B holds L2 wanting L1.
-
-Opt-in instrumentation (reference: findbugs' JSR-166 lock analysis,
-here done dynamically like TSan's lock-order graph):
-
-- ``install()`` patches ``threading.Lock``/``RLock`` factories with a
-  recording wrapper.  Every lock is named by its creation site
-  (``file:line``), so the 20+ ``self._lock = threading.RLock()``
-  sites in this codebase each become one graph node.
-- Each thread keeps its held-lock stack; acquiring B while holding A
-  records the edge A->B (with the acquiring stack, for the report).
-- ``report()`` returns the edge list and every cycle found in the
-  graph; the e2e suites assert the cycle list is empty.
-- ``watch(obj)`` additionally instruments one object's attribute
-  writes, reporting attributes written by multiple threads where at
-  least one write held no instrumented lock (cross-thread unguarded
-  writes).
-
-Enabled in tests via ``SDKLINT_LOCKCHECK=1`` (conftest installs) or
-explicitly by a fixture.  The wrappers stay functional after
-``uninstall()`` — recording is gated, delegation is not — so locks
-created during an instrumented window keep working forever.
+PR 2's runtime lock-order checker is now the dynamic half of
+``dcos_commons_tpu.analysis.racecheck`` — same ``install``/``watch``/
+``report`` API, plus vector-clock happens-before tracking, Thread
+start/join edges, and ``watch_type``.  Lock-order cycle detection is
+unchanged (reported as the ``race-lock-cycle`` rule).  This module
+keeps every historical import site and the ``SDKLINT_LOCKCHECK=1``
+opt-in working; new code should import racecheck directly.
 """
 
 from __future__ import annotations
 
-import os
-import threading
-import traceback
-from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Set, Tuple
-
-ENV_VAR = "SDKLINT_LOCKCHECK"
-
-_state_lock = threading.Lock()  # guards the module-level graph below
-_enabled = False
-_originals: Optional[Tuple] = None
-_tls = threading.local()
-
-# lock-order graph: (outer_site, inner_site) -> one sample acquiring
-# stack (the first observed, enough to locate the nesting)
-_edges: Dict[Tuple[str, str], str] = {}
-# site -> set of thread names that ever acquired it
-_threads_per_site: Dict[str, Set[str]] = {}
-# watch(): (class_name, attr) -> {thread: ALL writes held a lock}
-_watched_writes: Dict[Tuple[str, str], Dict[str, bool]] = {}
-
-
-def _held_stack() -> List["InstrumentedLock"]:
-    stack = getattr(_tls, "held", None)
-    if stack is None:
-        stack = _tls.held = []
-    return stack
-
-
-def _creation_site() -> str:
-    """file:line of the frame that called threading.Lock()/RLock(),
-    relative to the repo so sites read like lint findings."""
-    for frame in reversed(traceback.extract_stack(limit=8)[:-2]):
-        if os.sep + "analysis" + os.sep + "lockcheck" in frame.filename:
-            continue
-        if frame.filename.startswith("<"):
-            continue
-        name = frame.filename
-        for marker in ("dcos_commons_tpu", "frameworks", "tests"):
-            idx = name.find(os.sep + marker + os.sep)
-            if idx >= 0:
-                name = name[idx + 1:]
-                break
-        return f"{name.replace(os.sep, '/')}:{frame.lineno}"
-    return "<unknown>"
-
-
-class InstrumentedLock:
-    """Wraps one real Lock/RLock; records nesting edges on acquire."""
-
-    def __init__(self, inner, site: str, reentrant: bool):
-        self._inner = inner
-        self.site = site
-        self._reentrant = reentrant
-
-    # -- recording ----------------------------------------------------
-
-    def _record_acquire(self) -> None:
-        if not _enabled:
-            return
-        try:
-            stack = _held_stack()
-            if self._reentrant and any(h is self for h in stack):
-                stack.append(self)  # reentry: no new edges
-                return
-            held_sites = {h.site for h in stack if h is not self}
-            new_edges = [
-                (outer, self.site) for outer in held_sites
-                if outer != self.site and (outer, self.site) not in _edges
-            ]
-            if new_edges:
-                # format the (expensive) sample stack only for a
-                # first-seen edge; steady-state nested acquires just
-                # re-confirm known edges
-                sample = "".join(traceback.format_stack(limit=12)[:-2])
-                with _state_lock:
-                    for edge in new_edges:
-                        _edges.setdefault(edge, sample)
-            with _state_lock:
-                _threads_per_site.setdefault(self.site, set()).add(
-                    threading.current_thread().name
-                )
-            stack.append(self)
-        except Exception:  # sdklint: disable=swallowed-exception — the checker must never break the code under test
-            pass
-
-    def _record_release(self) -> None:
-        if not _enabled:
-            return
-        try:
-            stack = _held_stack()
-            for i in range(len(stack) - 1, -1, -1):
-                if stack[i] is self:
-                    del stack[i]
-                    break
-        except Exception:  # sdklint: disable=swallowed-exception — see _record_acquire
-            pass
-
-    # -- the lock protocol -------------------------------------------
-
-    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
-        got = self._inner.acquire(blocking, timeout)
-        if got:
-            self._record_acquire()
-        return got
-
-    def release(self) -> None:
-        self._record_release()
-        self._inner.release()
-
-    def locked(self) -> bool:
-        locked = getattr(self._inner, "locked", None)
-        if locked is not None:
-            return locked()
-        # RLock pre-3.12 has no locked(); _is_owned is close enough
-        return bool(self._inner._is_owned())
-
-    def __enter__(self) -> bool:
-        self.acquire()
-        return True
-
-    def __exit__(self, *exc) -> None:
-        self.release()
-
-    def __repr__(self) -> str:
-        return f"<InstrumentedLock {self.site} wrapping {self._inner!r}>"
-
-
-def install() -> None:
-    """Patch threading's lock factories; idempotent."""
-    global _enabled, _originals
-    with _state_lock:
-        if _originals is None:
-            real_lock, real_rlock = threading.Lock, threading.RLock
-            real_condition = threading.Condition
-
-            def make_lock():
-                return InstrumentedLock(real_lock(), _creation_site(), False)
-
-            def make_rlock():
-                return InstrumentedLock(real_rlock(), _creation_site(), True)
-
-            def make_condition(lock=None):
-                # Condition needs the real lock's _release_save /
-                # _is_owned internals; hand it an unwrapped lock
-                # (cv-guarded state is the static rule's concern)
-                if isinstance(lock, InstrumentedLock):
-                    lock = lock._inner
-                return real_condition(real_rlock() if lock is None else lock)
-
-            threading.Lock = make_lock
-            threading.RLock = make_rlock
-            threading.Condition = make_condition
-            _originals = (real_lock, real_rlock, real_condition)
-        _enabled = True
-
-
-def uninstall() -> None:
-    """Restore the factories and stop recording.  Wrappers already
-    handed out keep delegating to their inner locks."""
-    global _enabled, _originals
-    with _state_lock:
-        if _originals is not None:
-            threading.Lock, threading.RLock, threading.Condition = _originals
-            _originals = None
-        _enabled = False
-
-
-def reset() -> None:
-    with _state_lock:
-        _edges.clear()
-        _threads_per_site.clear()
-        _watched_writes.clear()
-
-
-def is_enabled() -> bool:
-    return _enabled
-
-
-def env_requested() -> bool:
-    return os.environ.get(ENV_VAR, "") not in ("", "0", "false")
-
-
-# -- watch(): cross-thread unguarded writes ---------------------------
-
-
-def watch(obj) -> None:
-    """Record attribute writes on ``obj``: which threads wrote, and
-    whether any instrumented lock was held.  Implemented by swapping
-    in a one-off subclass overriding ``__setattr__``."""
-    cls = type(obj)
-    if getattr(cls, "_sdklint_watched", False):
-        return
-    base_name = cls.__name__
-
-    def recording_setattr(self, name, value):
-        if _enabled:
-            try:
-                held = bool(_held_stack())
-                thread = threading.current_thread().name
-                with _state_lock:
-                    by_thread = _watched_writes.setdefault(
-                        (base_name, name), {}
-                    )
-                    # AND across the thread's writes: one unguarded
-                    # write taints the thread forever — a guarded
-                    # write later must never mask it
-                    by_thread[thread] = by_thread.get(thread, True) and held
-            except Exception:  # sdklint: disable=swallowed-exception — never break the watched object
-                pass
-        super(watched, self).__setattr__(name, value)
-
-    watched = type(
-        f"{base_name}_sdklint",
-        (cls,),
-        {"__setattr__": recording_setattr, "_sdklint_watched": True},
-    )
-    obj.__class__ = watched
-
-
-# -- report -----------------------------------------------------------
-
-
-@dataclass
-class LockReport:
-    edges: Dict[Tuple[str, str], str] = field(default_factory=dict)
-    cycles: List[List[str]] = field(default_factory=list)
-    unguarded_writes: List[str] = field(default_factory=list)
-
-    def describe(self) -> str:
-        lines = [
-            f"lock-order edges: {len(self.edges)}, "
-            f"cycles: {len(self.cycles)}, "
-            f"cross-thread unguarded writes: {len(self.unguarded_writes)}"
-        ]
-        for cycle in self.cycles:
-            lines.append("  DEADLOCK RISK: " + " -> ".join(cycle + cycle[:1]))
-            first = (cycle[0], cycle[1 % len(cycle)])
-            if first in self.edges:
-                lines.append("  sample acquiring stack:\n" + self.edges[first])
-        lines += [f"  UNGUARDED: {w}" for w in self.unguarded_writes]
-        return "\n".join(lines)
-
-
-def _find_cycles(adjacency: Dict[str, Set[str]]) -> List[List[str]]:
-    """Simple elementary-cycle scan: DFS from each node, reporting
-    each cycle once (canonicalized by its smallest rotation)."""
-    seen_cycles: Set[Tuple[str, ...]] = set()
-    cycles: List[List[str]] = []
-
-    def canonical(path: List[str]) -> Tuple[str, ...]:
-        pivot = min(range(len(path)), key=lambda i: path[i])
-        return tuple(path[pivot:] + path[:pivot])
-
-    def dfs(node: str, path: List[str], on_path: Set[str]) -> None:
-        for nxt in sorted(adjacency.get(node, ())):
-            if nxt in on_path:
-                cycle = path[path.index(nxt):]
-                key = canonical(cycle)
-                if key not in seen_cycles:
-                    seen_cycles.add(key)
-                    cycles.append(list(key))
-                continue
-            if len(path) < 32:  # bound pathological graphs
-                dfs(nxt, path + [nxt], on_path | {nxt})
-
-    for start in sorted(adjacency):
-        dfs(start, [start], {start})
-    return cycles
-
-
-def report() -> LockReport:
-    with _state_lock:
-        edges = dict(_edges)
-        watched = {k: dict(v) for k, v in _watched_writes.items()}
-    adjacency: Dict[str, Set[str]] = {}
-    for outer, inner in edges:
-        adjacency.setdefault(outer, set()).add(inner)
-    unguarded = [
-        f"{cls}.{attr} written by threads {sorted(by_thread)} "
-        "with at least one write holding no lock"
-        for (cls, attr), by_thread in sorted(watched.items())
-        if len(by_thread) > 1 and not all(by_thread.values())
-    ]
-    return LockReport(
-        edges=edges,
-        cycles=_find_cycles(adjacency),
-        unguarded_writes=unguarded,
-    )
+from dcos_commons_tpu.analysis.racecheck import (  # noqa: F401
+    InstrumentedLock,
+    LockReport,
+    RaceRecord,
+    RaceReport,
+    env_requested,
+    install,
+    is_enabled,
+    report,
+    reset,
+    uninstall,
+    unwatch_types,
+    watch,
+    watch_type,
+)
+from dcos_commons_tpu.analysis.racecheck import (  # noqa: F401
+    LEGACY_ENV_VAR as ENV_VAR,
+)

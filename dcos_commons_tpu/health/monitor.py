@@ -115,6 +115,13 @@ class HealthMonitor:
         # ran inline (interval 0) or on the background thread
         self._telemetry_seq = 0
         self._scored_seq = 0
+        # publication lock for the completed-collection snapshot: the
+        # background collector swaps the three dicts + bumps the seq
+        # under it, the scoring pass grabs the seq and dict REFERENCES
+        # under it (the dicts themselves are replaced wholesale, never
+        # mutated in place, so readers hold a consistent snapshot
+        # lock-free once they have the references)
+        self._telemetry_lock = threading.Lock()
         self._telemetry_thread: Optional[threading.Thread] = None
         # one steplog series per task, grouped by host (list of
         # record-lists — the straggler window applies per series)
@@ -154,7 +161,8 @@ class HealthMonitor:
         try:
             return self._observe(scheduler, now)
         except Exception:
-            self.observe_errors += 1
+            with self._telemetry_lock:
+                self.observe_errors += 1
             scheduler.metrics.incr("health.observe_errors")
             return []
 
@@ -195,15 +203,20 @@ class HealthMonitor:
         # COMPLETED since the last scoring pass: identical cached
         # telemetry yields identical verdicts, and the median-ratio
         # pass over a big fleet's windows is the expensive part
-        if self._telemetry_seq != self._scored_seq:
-            self._scored_seq = self._telemetry_seq
-            events += self.straggler.observe(self._steplogs_by_host)
+        with self._telemetry_lock:
+            telemetry_seq = self._telemetry_seq
+            steplogs_by_host = self._steplogs_by_host
+            serving_stats = self._serving_stats
+            serving_env = self._serving_env
+        if telemetry_seq != self._scored_seq:
+            self._scored_seq = telemetry_seq
+            events += self.straggler.observe(steplogs_by_host)
             self._push_suspects(scheduler)
             events += self.slo.observe(
-                self._serving_stats, self._serving_env, now=now
+                serving_stats, serving_env, now=now
             )
             events += self.quiet.observe(
-                self._serving_stats, self._serving_env, now=now
+                serving_stats, serving_env, now=now
             )
         ha_state = getattr(scheduler, "ha_state", None)
         lease = getattr(ha_state, "lease", None)
@@ -278,7 +291,8 @@ class HealthMonitor:
         try:
             self._collect_telemetry(scheduler)
         except Exception:
-            self.observe_errors += 1
+            with self._telemetry_lock:
+                self.observe_errors += 1
             try:
                 scheduler.metrics.incr("health.observe_errors")
             except Exception:  # sdklint: disable=swallowed-exception — already inside the error path of a telemetry thread; observe_errors was counted above, and a metrics hiccup must not kill the collector
@@ -318,10 +332,14 @@ class HealthMonitor:
                 if stats:
                     serving[info.name] = stats
                     env_of[info.name] = info.env
-        self._steplogs_by_host = steplogs
-        self._serving_stats = serving
-        self._serving_env = env_of
-        self._telemetry_seq += 1
+        # publish the completed fan-in atomically: fresh dicts swapped
+        # in wholesale (never mutated after this point), seq bumped
+        # LAST so a reader seeing the new seq sees the new dicts
+        with self._telemetry_lock:
+            self._steplogs_by_host = steplogs
+            self._serving_stats = serving
+            self._serving_env = env_of
+            self._telemetry_seq += 1
 
     def _push_suspects(self, scheduler) -> None:
         setter = getattr(scheduler.inventory, "set_suspect_hosts", None)

@@ -832,6 +832,7 @@ class MultiServiceScheduler:
                     # behind the pre-scan stamp
                     post = store.task_generation
                     if post == gen:
+                        # racecheck: handoff=only the multi-loop thread (or a test driving run_cycle inline) reaches the orphan sweep; cycles never overlap
                         self._orphan_index[name] = (gen, ids)
             expected |= ids
         if len(self._orphan_index) > len(services):

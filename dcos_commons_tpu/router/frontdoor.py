@@ -187,7 +187,10 @@ class RouterServer:
         )
         self._stop = threading.Event()
         self._poll_thread: Optional[threading.Thread] = None
-        self._refreshed = False
+        # set by refresh_once from BOTH the starting caller and the
+        # router-poll thread; an Event gives the flip a memory fence
+        # instead of relying on a benign torn bool
+        self._refreshed = threading.Event()
         router = self.router
 
         class Handler(BaseHTTPRequestHandler):
@@ -337,7 +340,7 @@ class RouterServer:
             self.router.observe_stats(name, stats)
         if self._stats_path is not None:
             self.router.write_stats(self._stats_path)
-        self._refreshed = True
+        self._refreshed.set()
 
     def _poll_loop(self) -> None:
         while not self._stop.is_set():
@@ -352,7 +355,7 @@ class RouterServer:
         """One shared startup sequence for both entry points: the
         first request must see a pod set (skip the refresh only when
         the caller already ran one, e.g. a readiness gate)."""
-        if not self._refreshed:
+        if not self._refreshed.is_set():
             self.refresh_once()
         self._poll_thread = threading.Thread(
             target=self._poll_loop, name="router-poll", daemon=True

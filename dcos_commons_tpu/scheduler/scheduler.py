@@ -384,6 +384,7 @@ class DefaultScheduler:
         # the next cycle.  Monotonic bool flip from any thread; the
         # cycle clears it BEFORE serializing, so a racing flip only
         # costs one extra checkpoint, never a lost one.
+        # racecheck: handoff=monotonic dirty flip; cycle clears before serializing, a racing flip costs one extra checkpoint, never a lost one
         self._plan_dirty = True  # sdklint: disable=lock-discipline — see above
         self._nudged = True  # sdklint: disable=lock-discipline — same monotonic-flip contract
         self._wake.set()
@@ -584,6 +585,7 @@ class DefaultScheduler:
         self.task_killer.handle_status(status)
         # step transitions triggered by THIS status reference its
         # correlation id (the listener reads _trace_ctx)
+        # racecheck: handoff=thread-id-stamped slot; _on_step_transition only honors a ctx whose get_ident matches its own, so a concurrent writer's value is ignored, worst case an unanchored span
         self._trace_ctx = (
             threading.get_ident(), event.trace_id, event.span_id
         )
@@ -636,6 +638,7 @@ class DefaultScheduler:
         # cycle's health pass — transitions fire inside cycles and
         # from HTTP verb threads, neither of which should pay a
         # store write per step)
+        # racecheck: handoff=EventJournal.append takes its own internal lock; the attribute itself is bound once in __init__
         self.journal.append(  # sdklint: disable=lock-discipline — EventJournal serializes internally; like the tracer, it is callable from any thread
             "plan", step=step.name,
             **{"from": old.value, "to": new.value},
@@ -649,6 +652,7 @@ class DefaultScheduler:
         candidates = self.coordinator.get_candidates()
         if not candidates:
             if not self._suppressed:
+                # racecheck: handoff=only the cycle thread reaches _process_candidates (run_forever's loop, or a test driving run_cycle inline); cycles never overlap
                 self._suppressed = True
                 self.metrics.incr("suppresses")
             return 0

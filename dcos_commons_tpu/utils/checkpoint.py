@@ -333,6 +333,11 @@ class AsyncCheckpointer:
         self.incarnation = incarnation
         self.errors: List[str] = []
         self.saved: List[str] = []
+        # the fence latch flips on BOTH sides of the queue: the writer
+        # thread latches on StaleWriterError, the caller latches on
+        # the broadcast verdict — a lock keeps the flip ordered (reads
+        # stay lock-free: the latch is monotonic False -> True)
+        self._fence_lock = threading.Lock()
         self.fenced = False
         self._queue: "queue.Queue" = queue.Queue(maxsize=max(1, max_pending))
         self._thread = threading.Thread(
@@ -371,7 +376,8 @@ class AsyncCheckpointer:
                 jnp.int32(int(self.fenced))
             )))
             if fenced:
-                self.fenced = True
+                with self._fence_lock:
+                    self.fenced = True
                 return
         elif self.fenced:
             return
@@ -393,7 +399,8 @@ class AsyncCheckpointer:
                         incarnation=self.incarnation,
                     ))
                 except StaleWriterError as e:
-                    self.fenced = True
+                    with self._fence_lock:
+                        self.fenced = True
                     self.errors.append(str(e))
                 except Exception as e:  # noqa: BLE001 — a failed save
                     # (full disk, NFS hiccup) must not kill the writer

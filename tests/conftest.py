@@ -64,28 +64,36 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(pytest.mark.slow)
 
 
-# -- sdklint lock-order checker (opt-in, SDKLINT_LOCKCHECK=1) ---------
+# -- sdklint race checker (opt-in, SDKLINT_RACECHECK=1) ---------------
 #
-# Instruments threading.Lock/RLock for the whole session and fails the
-# run if the observed lock-nesting graph contains a cycle (deadlock
-# risk).  tests/test_scheduler_e2e.py and tests/test_multi_service.py
-# additionally enable it per-test regardless of the env var.
+# Instruments threading.Lock/RLock/Condition and Thread.start/join for
+# the whole session and fails the run if (a) the observed lock-nesting
+# graph contains a cycle (deadlock risk) or (b) the vector-clock
+# checker saw two unordered writes to a watched attribute (data race).
+# SDKLINT_LOCKCHECK=1 is kept as a back-compat alias for the same
+# switch.  tests/test_scheduler_e2e.py and tests/test_multi_service.py
+# additionally enable the cycle check per-test regardless of the env
+# var; the threaded modules (continuous batching, migration, HA
+# failover, health, replication) add per-module write probes via
+# racecheck_watch_guard().
 
 import pytest  # noqa: E402
 
 
 @pytest.fixture(scope="session", autouse=True)
-def _sdklint_lockcheck_session():
-    from dcos_commons_tpu.analysis import lockcheck
+def _sdklint_racecheck_session():
+    from dcos_commons_tpu.analysis import racecheck
 
-    if not lockcheck.env_requested():
+    if not racecheck.env_requested():
         yield
         return
-    lockcheck.install()
+    racecheck.install()
     yield
-    report = lockcheck.report()
-    lockcheck.uninstall()
+    report = racecheck.report()
+    racecheck.unwatch_types()
+    racecheck.uninstall()
     assert not report.cycles, report.describe()
+    assert not report.races, report.describe()
 
 
 def lockcheck_guard():
@@ -95,14 +103,40 @@ def lockcheck_guard():
     on any lock-order cycle.  Coexists with the session checker above
     — when that is active, the accumulated cross-test graph is left
     intact (no reset/uninstall)."""
-    from dcos_commons_tpu.analysis import lockcheck
+    from dcos_commons_tpu.analysis import racecheck
 
-    already = lockcheck.is_enabled()
-    lockcheck.install()
+    already = racecheck.is_enabled()
+    racecheck.install()
     if not already:
-        lockcheck.reset()
+        racecheck.reset()
     yield
-    report = lockcheck.report()
+    report = racecheck.report()
     if not already:
-        lockcheck.uninstall()
+        racecheck.uninstall()
     assert not report.cycles, report.describe()
+
+
+def racecheck_watch_guard(*classes):
+    """Shared body for the per-module write-probe fixtures in the
+    threaded test modules (``yield from racecheck_watch_guard(Cls,
+    ...)``): when SDKLINT_RACECHECK=1 (or the legacy alias) is set,
+    watch every attribute the static pass reports as cross-thread
+    shared on the given classes, run the module's tests, and fail on
+    any unordered write pair.  A no-op when the env var is unset so the
+    fast tier pays nothing."""
+    from dcos_commons_tpu.analysis import racecheck
+
+    if not racecheck.env_requested():
+        yield
+        return
+    import os as _os
+
+    root = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    shared = racecheck.shared_write_map(root)
+    for cls in classes:
+        attrs = shared.get(cls.__name__)
+        if attrs:
+            racecheck.watch_type(cls, attrs)
+    yield
+    # session fixture asserts on the accumulated report at exit; probes
+    # stay installed so later modules of the same run keep their watch.

@@ -33,6 +33,19 @@ from dcos_commons_tpu.utils.microbatch import QueueTimeoutError
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _racecheck_probes():
+    """Dynamic race probes (SDKLINT_RACECHECK=1): watch every attribute
+    the static pass reports as cross-thread shared on the engine loop's
+    classes; the session fixture fails the run on any unordered write
+    pair.  No-op in the fast tier."""
+    from dcos_commons_tpu.utils.microbatch import MicroBatcher
+
+    from conftest import racecheck_watch_guard
+
+    yield from racecheck_watch_guard(SlotEngine, MicroBatcher)
+
+
 # -- fake model: deterministic per-row chain ---------------------------
 
 

@@ -46,6 +46,20 @@ from dcos_commons_tpu.testing.chaos import (
 CHAOS_SEED = int(os.environ.get("CHAOS_SEED", "0"))
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _racecheck_probes():
+    """Dynamic race probes (SDKLINT_RACECHECK=1): failover drives the
+    scheduler cycle and the async checkpoint writer concurrently —
+    watch their shared-write sets so any unordered pair fails the run.
+    No-op in the fast tier."""
+    from dcos_commons_tpu.scheduler.scheduler import DefaultScheduler
+    from dcos_commons_tpu.utils.checkpoint import AsyncCheckpointer
+
+    from conftest import racecheck_watch_guard
+
+    yield from racecheck_watch_guard(DefaultScheduler, AsyncCheckpointer)
+
+
 class FakeClock:
     def __init__(self, now: float = 1000.0):
         self.now = now

@@ -34,6 +34,20 @@ from dcos_commons_tpu.storage.replication import ReplicationLog
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _racecheck_probes():
+    """Dynamic race probes (SDKLINT_RACECHECK=1): the replication
+    puller applies entries on its own thread while the serving side
+    reads — watch the replication classes' shared-write set so any
+    unordered pair fails the run.  No-op in the fast tier."""
+    from dcos_commons_tpu.storage.remote import StateServer
+    from dcos_commons_tpu.storage.replication import ReplicationLog
+
+    from conftest import racecheck_watch_guard
+
+    yield from racecheck_watch_guard(StateServer, ReplicationLog)
+
+
 def wait_until(check, timeout_s=10.0, interval_s=0.05, what="condition"):
     deadline = time.monotonic() + timeout_s
     while time.monotonic() < deadline:

@@ -9,6 +9,8 @@ failover, replayed under the HA fenced store with its sequence
 numbers continuing where the deposed leader stopped.
 """
 
+import pytest
+
 from dcos_commons_tpu.ha.election import FencedPersister, LeaderLease
 from dcos_commons_tpu.health import (
     EventJournal,
@@ -32,6 +34,20 @@ from dcos_commons_tpu.testing import (
     SendTaskRunning,
     ServiceTestRunner,
 )
+
+@pytest.fixture(scope="module", autouse=True)
+def _racecheck_probes():
+    """Dynamic race probes (SDKLINT_RACECHECK=1): the monitor's
+    background telemetry collector publishes snapshots the scoring
+    thread consumes — watch HealthMonitor's shared-write set so any
+    unordered publish/consume pair fails the run.  No-op in the fast
+    tier."""
+    from dcos_commons_tpu.health.monitor import HealthMonitor
+
+    from conftest import racecheck_watch_guard
+
+    yield from racecheck_watch_guard(HealthMonitor)
+
 
 GANG_YAML = """
 name: jax

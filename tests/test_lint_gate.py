@@ -16,11 +16,13 @@ import json
 import os
 import textwrap
 import threading
+import time
 
 from dcos_commons_tpu.analysis import baseline as baseline_mod
 from dcos_commons_tpu.analysis import (
     lockcheck,
     plancheck,
+    racecheck,
     shardcheck,
     speccheck,
     spmdcheck,
@@ -49,17 +51,36 @@ def test_repo_spec_analyzer_gate():
     assert not findings, "\n".join(f.render() for f in findings)
 
 
+def test_repo_race_gate():
+    """Zero non-baselined thread-ownership findings across the package
+    — the racecheck baseline ships EMPTY, so every cross-thread write
+    in tree is lock-guarded, channel-handed-off, or carries an
+    annotated `# racecheck: handoff=` invariant."""
+    result = racecheck.analyze_tree(REPO)
+    known = baseline_mod.load_baseline(baseline_mod.baseline_path(REPO))
+    fresh, _ = baseline_mod.apply_baseline(result.findings, known)
+    assert not fresh, "\n".join(f.render() for f in fresh)
+    assert not any(k.startswith("race-") for k in known), \
+        "the race baseline must stay empty: fix or annotate instead"
+    assert result.files_checked >= 100
+
+
 def test_cli_all_exits_zero(capsys):
     """The CI entry point: `python -m dcos_commons_tpu.analysis --all`
-    (lint + specs + spmd + plan + shard; the plancheck cap is trimmed
-    here — test_plancheck_repo_gate owns the full-depth run)."""
+    (lint + specs + spmd + plan + shard + race; the plancheck cap is
+    trimmed here — test_plancheck_repo_gate owns the full-depth run).
+    The whole sweep stays inside the ~40s CI budget."""
+    start = time.monotonic()
     rc = analysis_main([
         "--all", "--root", REPO, "--plan-max-states", "1500",
     ])
+    elapsed = time.monotonic() - start
     out = capsys.readouterr().out
     assert rc == 0, out
     assert "lint:" in out and "specs:" in out
     assert "spmd:" in out and "plan:" in out and "shard:" in out
+    assert "race:" in out
+    assert elapsed < 40.0, f"analysis all took {elapsed:.1f}s"
 
 
 def test_rule_catalog_lists_every_rule():
@@ -1791,6 +1812,14 @@ def test_cli_json_output(capsys):
             entry
         )
         assert entry["ring_mb_per_chip"] <= entry["allgather_mb_per_chip"]
+    # the race document: findings gate PLUS the trend keys dashboards
+    # watch — total cross-thread shared attrs and distinct thread roles
+    assert doc["race"]["findings"] == []
+    assert doc["race"]["shared_attrs"] >= 1
+    assert doc["race"]["roles"] >= 2
+    assert any(
+        info["shared_attrs"] for info in doc["race"]["classes"].values()
+    )
 
 
 def test_cli_json_reports_findings(tmp_path, capsys):

@@ -58,6 +58,20 @@ _V = 97
 P = 4  # page tokens
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _racecheck_probes():
+    """Dynamic race probes (SDKLINT_RACECHECK=1): migration splices KV
+    state into a live decode loop from a foreign thread — watch the
+    engine classes' shared-write set so any unordered splice/tick pair
+    fails the run (the PR 16 bug class).  No-op in the fast tier."""
+    from dcos_commons_tpu.serve.engine import SlotEngine
+    from dcos_commons_tpu.utils.microbatch import MicroBatcher
+
+    from conftest import racecheck_watch_guard
+
+    yield from racecheck_watch_guard(PagedEngine, SlotEngine, MicroBatcher)
+
+
 def _chain_first(prompt):
     return (sum(prompt) * 31 + len(prompt)) % _V
 
