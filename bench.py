@@ -3978,6 +3978,22 @@ def main() -> None:
     except Exception as e:
         extras["moe8_error"] = repr(e)[:200]
     _mark("moe8")
+    try:
+        # analyzer-coverage trend keys: how much of the env/config
+        # contract surface configcheck's flow graph tracks (the
+        # findings gate itself lives in tests/test_lint_gate.py)
+        from dcos_commons_tpu.analysis import configcheck
+
+        config_result = configcheck.analyze_all(
+            os.path.dirname(os.path.abspath(__file__))
+        )
+        extras["config_env_vars"] = len(config_result.env_vars)
+        extras["config_flows"] = len(config_result.flows)
+        extras["config_findings"] = len(config_result.findings)
+        extras["config_suppressed"] = len(config_result.suppressed)
+    except Exception as e:
+        extras["config_error"] = repr(e)[:200]
+    _mark("configcheck")
     value = deploy["deploy_wall_clock_s"]
     print(
         json.dumps(

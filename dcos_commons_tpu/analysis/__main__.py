@@ -9,6 +9,8 @@ Commands (also reachable as ``python -m dcos_commons_tpu analyze``):
     shard    static sharding / HBM-footprint / collective-cost analyzer
     race     thread-ownership / happens-before race analyzer (static
              half; the dynamic half runs under SDKLINT_RACECHECK=1)
+    config   env/config contract analyzer (options.json ⇄ YAML
+             templates ⇄ task env ⇄ worker/SDK reads)
     all      everything — the CI gate; default when no command given
 
 Flag spelling (``--lint``/.../``--race``/``--all``) is accepted too,
@@ -17,7 +19,10 @@ composably: ``--lint --spmd`` runs exactly those two.
 Options:
     --json              one machine-readable JSON document on stdout
                         (findings per analyzer, plancheck.states_explored,
-                        shard.footprint / shard.cost per analyzed pod)
+                        shard.footprint / shard.cost per analyzed pod,
+                        config.env_vars / config.flows / config.per_rule)
+    --docs              render the config flow graph to
+                        docs/config-reference.md (implies config)
     --update-baseline   rewrite the baseline from current
                         lint+spmd+shard findings
     --catalog           print the rule catalogs and exit
@@ -46,7 +51,9 @@ import os
 import sys
 from typing import List
 
-_COMMANDS = ("lint", "specs", "spmd", "plan", "shard", "race", "all")
+_COMMANDS = (
+    "lint", "specs", "spmd", "plan", "shard", "race", "config", "all"
+)
 
 
 def _default_root() -> str:
@@ -59,12 +66,14 @@ def _default_root() -> str:
 def main(argv: List[str] = None) -> int:
     from dcos_commons_tpu.analysis import baseline as baseline_mod
     from dcos_commons_tpu.analysis import (
+        configcheck,
         plancheck,
         racecheck,
         shardcheck,
         speccheck,
         spmdcheck,
     )
+    from dcos_commons_tpu.analysis.configcheck import config_rule_catalog
     from dcos_commons_tpu.analysis.linter import lint_tree
     from dcos_commons_tpu.analysis.racecheck import race_rule_catalog
     from dcos_commons_tpu.analysis.rules import rule_catalog
@@ -86,7 +95,13 @@ def main(argv: List[str] = None) -> int:
     parser.add_argument("--plan", action="store_true")
     parser.add_argument("--shard", action="store_true")
     parser.add_argument("--race", action="store_true")
+    parser.add_argument("--config", action="store_true")
     parser.add_argument("--all", action="store_true")
+    parser.add_argument(
+        "--docs", action="store_true",
+        help="render the config flow graph to docs/config-reference.md "
+             "(implies --config)",
+    )
     parser.add_argument("--json", action="store_true", dest="as_json")
     parser.add_argument("--update-baseline", action="store_true")
     parser.add_argument("--catalog", action="store_true")
@@ -127,16 +142,20 @@ def main(argv: List[str] = None) -> int:
         print(shard_rule_catalog())
         print()
         print(race_rule_catalog())
+        print()
+        print(config_rule_catalog())
         return 0
 
     any_mode = (args.lint or args.specs or args.spmd or args.plan
-                or args.shard or args.race)
+                or args.shard or args.race or args.config
+                or args.docs)
     run_lint = args.lint or args.all or not any_mode
     run_specs = args.specs or args.all or not any_mode
     run_spmd = args.spmd or args.all or not any_mode
     run_plan = args.plan or args.all or not any_mode
     run_shard = args.shard or args.all or not any_mode
     run_race = args.race or args.all or not any_mode
+    run_config = args.config or args.docs or args.all or not any_mode
     root = os.path.abspath(args.root)
     baseline_path = args.baseline or baseline_mod.baseline_path(root)
     known = baseline_mod.load_baseline(baseline_path)
@@ -248,11 +267,26 @@ def main(argv: List[str] = None) -> int:
                 )
                 failed |= comparison["regression"] is True
 
+    if run_config:
+        config_result = configcheck.analyze_all(root)
+        run_findings_pass("config", config_result)
+        # trend keys: how much of the env surface the graph covers
+        doc["config"]["env_vars"] = len(config_result.env_vars)
+        doc["config"]["flows"] = len(config_result.flows)
+        doc["config"]["per_rule"] = dict(config_result.per_rule)
+        if args.docs:
+            docs_path = configcheck.write_config_reference(
+                root, config_result
+            )
+            emit(f"docs: wrote {docs_path}")
+            doc["config"]["docs_path"] = docs_path
+
     if args.update_baseline:
-        if not (run_lint or run_spmd or run_shard or run_race):
+        if not (run_lint or run_spmd or run_shard or run_race
+                or run_config):
             emit(
                 "baseline: nothing to update — only lint, spmd, shard, "
-                "and race feed the baseline; run one of them"
+                "race, and config feed the baseline; run one of them"
             )
         else:
             # entries of a baseline-feeding pass that did NOT run
@@ -268,6 +302,8 @@ def main(argv: List[str] = None) -> int:
                     owner_ran = run_shard
                 elif rule.startswith("race-"):
                     owner_ran = run_race
+                elif rule.startswith("config-"):
+                    owner_ran = run_config
                 else:
                     owner_ran = run_lint
                 if not owner_ran:

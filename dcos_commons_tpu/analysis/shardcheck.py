@@ -426,6 +426,9 @@ def _serve_leaves(env, mesh_total_tp: int) -> Tuple[Any, List[AbstractLeaf]]:
     from dcos_commons_tpu.serve.paging import paged_config_from_env
 
     slots = int(env.get("SERVE_SLOTS") or 0) or int(
+        # mirrors the serve workers' conservative single-request
+        # fallback, not the options.json deploy default
+        # sdklint: disable=config-default-drift — dev fallback
         env.get("SERVE_BATCH", "1")
     )
     max_len = int(env.get("MAX_LEN", "256"))

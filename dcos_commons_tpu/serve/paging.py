@@ -106,6 +106,10 @@ def paged_config_from_env(env) -> Optional[PagedServeConfig]:
     if page_tokens <= 0:
         return None
     max_len = int(env.get("MAX_LEN", "256"))
+    # unset SERVE_BATCH means a bare/dev launch; fall back to one
+    # slot rather than the deploy default 8 (see options.json
+    # serving.batch description)
+    # sdklint: disable=config-default-drift — dev fallback
     batch = int(env.get("SERVE_BATCH", "1"))
     slots = int(env.get("SERVE_SLOTS") or 0) or batch
     # default budget = full residency for every row (NO overcommit:

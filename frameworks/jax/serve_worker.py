@@ -94,6 +94,10 @@ def main() -> int:
         remat=False,
     )
     max_len = int(os.environ.get("MAX_LEN", "256"))
+    # unset SERVE_BATCH means a bare/dev launch; fall back to one
+    # request rather than the deploy default 8 (see options.json
+    # serving.batch description)
+    # sdklint: disable=config-default-drift — dev fallback
     batch = int(os.environ.get("SERVE_BATCH", "1"))
     # the slot POOL defaults to the request cap; SERVE_SLOTS decouples
     # them (more concurrent residents than any one request may carry);

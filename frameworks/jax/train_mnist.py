@@ -32,6 +32,9 @@ def main() -> int:
     # skip XLA recompilation entirely ($JAX_COMPILATION_CACHE_DIR)
     enable_compilation_cache()
 
+    # demo-scale run: 60 steps converges MNIST; the options default
+    # 100 sizes the full trainer
+    # sdklint: disable=config-default-drift — demo scale
     steps = int(os.environ.get("TRAIN_STEPS", "60"))
     config = MlpConfig()
     params = mlp_init(config, jax.random.key(0))

@@ -1,0 +1,107 @@
+"""Property-based tests for the ``{{VAR:-default}}`` template grammar.
+
+configcheck's whole YAML side rests on one claim: the pairs
+``template_occurrences`` parses out of a spec are EXACTLY what
+``render_template`` would substitute — same variable, same default,
+one left-to-right pass.  These properties pin that agreement (and the
+deliberately non-recursive nested-default behavior) over random
+identifiers and default strings, the same hypothesis-importorskip
+pattern as tests/test_shard_properties.py.
+"""
+
+import string
+
+import pytest
+
+pytest.importorskip(
+    "hypothesis", reason="property tests need the hypothesis package"
+)
+from hypothesis import given, strategies as st  # noqa: E402
+
+from dcos_commons_tpu.analysis.configcheck import (  # noqa: E402
+    template_occurrences,
+)
+from dcos_commons_tpu.specification.specs import SpecError  # noqa: E402
+from dcos_commons_tpu.specification.yaml_spec import (  # noqa: E402
+    _truthy,
+    render_template,
+)
+
+# template names follow the renderer's grammar [A-Za-z_][A-Za-z0-9_]*
+NAMES = st.from_regex(r"[A-Za-z_][A-Za-z0-9_]{0,15}", fullmatch=True)
+# default/value strings: anything brace-free renders literally; '#'
+# is excluded because the PARSER strips YAML comment tails, and
+# whitespace is excluded so the round-trip is not confounded by the
+# comment-strip's "space before #" rule
+SAFE = st.text(
+    alphabet=string.ascii_letters + string.digits + "._-/+:=,@",
+    max_size=24,
+)
+
+
+@given(NAMES, SAFE, SAFE)
+def test_render_parse_round_trip(name, value, default):
+    """The (var, default) pair the parser extracts predicts the
+    renderer byte-for-byte: default when unset, env value when set."""
+    line = f'KEY: "{{{{{name}:-{default}}}}}"'
+    occs = template_occurrences([line])
+    assert occs == [(name, default, 1, "var")]
+    template = f"{{{{{name}:-{default}}}}}"
+    assert render_template(template, {}) == default
+    assert render_template(template, {name: value}) == value
+
+
+@given(NAMES, SAFE)
+def test_empty_default_renders_empty(name, value):
+    """``{{VAR:-}}`` is the 'optional, defaults to empty' idiom
+    (svc_serve.yml SERVE_SLOTS): unset renders "", set renders the
+    value, and the parser reports the default as '' — distinct from
+    the None of a defaultless ``{{VAR}}``."""
+    template = f"{{{{{name}:-}}}}"
+    assert render_template(template, {}) == ""
+    assert render_template(template, {name: value}) == value
+    occs = template_occurrences([template])
+    assert occs == [(name, "", 1, "var")]
+    bare = template_occurrences([f"{{{{{name}}}}}"])
+    assert bare == [(name, None, 1, "var")]
+
+
+@given(NAMES, NAMES, SAFE)
+def test_nested_default_is_single_pass(outer, inner, default):
+    """Defaults substitute in ONE left-to-right pass and are never
+    re-expanded: ``{{A:-{{B:-x}}}}`` with A unset leaves the literal
+    inner template text, not x — the regex's ``[^}]*`` default stops
+    at the first brace, so nesting is (deliberately) not a feature.
+    The parser agrees, reporting the same truncated default."""
+    template = f"{{{{{outer}:-{{{{{inner}:-{default}}}}}}}}}"
+    rendered = render_template(template, {})
+    assert rendered == f"{{{{{inner}:-{default}}}}}"
+    # the inner template text survives VERBATIM — a second render
+    # would substitute it, proving nothing recursed the first time
+    assert render_template(rendered, {}) == default
+    occs = template_occurrences([template])
+    assert occs[0][:2] == (outer, f"{{{{{inner}:-{default}")
+
+
+@given(NAMES)
+def test_missing_defaultless_var_raises(name):
+    """A defaultless ``{{VAR}}`` with no env value fails the render
+    loudly, naming the variable (TemplateUtils semantics)."""
+    with pytest.raises(SpecError) as err:
+        render_template(f"{{{{{name}}}}}", {})
+    assert name in str(err.value)
+
+
+@given(NAMES, SAFE, SAFE)
+def test_section_visibility_matches_truthy(name, body, value):
+    """``{{#VAR}}body{{/VAR}}`` keeps the body exactly when _truthy
+    says so, and ``{{^VAR}}`` is its complement."""
+    pos = f"{{{{#{name}}}}}{body}{{{{/{name}}}}}"
+    neg = f"{{{{^{name}}}}}{body}{{{{/{name}}}}}"
+    env = {name: value}
+    assert render_template(pos, env) == (
+        body if _truthy(value) else ""
+    )
+    assert render_template(neg, env) == (
+        "" if _truthy(value) else body
+    )

@@ -20,6 +20,7 @@ import time
 
 from dcos_commons_tpu.analysis import baseline as baseline_mod
 from dcos_commons_tpu.analysis import (
+    configcheck,
     lockcheck,
     plancheck,
     racecheck,
@@ -67,9 +68,9 @@ def test_repo_race_gate():
 
 def test_cli_all_exits_zero(capsys):
     """The CI entry point: `python -m dcos_commons_tpu.analysis --all`
-    (lint + specs + spmd + plan + shard + race; the plancheck cap is
-    trimmed here — test_plancheck_repo_gate owns the full-depth run).
-    The whole sweep stays inside the ~40s CI budget."""
+    (lint + specs + spmd + plan + shard + race + config; the plancheck
+    cap is trimmed here — test_plancheck_repo_gate owns the full-depth
+    run).  The whole sweep stays inside the ~40s CI budget."""
     start = time.monotonic()
     rc = analysis_main([
         "--all", "--root", REPO, "--plan-max-states", "1500",
@@ -79,7 +80,7 @@ def test_cli_all_exits_zero(capsys):
     assert rc == 0, out
     assert "lint:" in out and "specs:" in out
     assert "spmd:" in out and "plan:" in out and "shard:" in out
-    assert "race:" in out
+    assert "race:" in out and "config:" in out
     assert elapsed < 40.0, f"analysis all took {elapsed:.1f}s"
 
 
@@ -1820,6 +1821,16 @@ def test_cli_json_output(capsys):
     assert any(
         info["shared_attrs"] for info in doc["race"]["classes"].values()
     )
+    # the config document: findings gate PLUS the flow-graph trend
+    # keys — tracked vars, joined YAML-env→reader edges, per-rule
+    # counters for every rule in the catalog
+    assert doc["config"]["findings"] == []
+    assert doc["config"]["env_vars"] >= 100
+    assert doc["config"]["flows"] >= 30
+    assert set(doc["config"]["per_rule"]) == {
+        rule_id for rule_id, _ in configcheck.CONFIG_RULES
+    }
+    assert all(n == 0 for n in doc["config"]["per_rule"].values())
 
 
 def test_cli_json_reports_findings(tmp_path, capsys):
@@ -2252,4 +2263,343 @@ def test_stepcompare_cli_steplog(tmp_path, capsys):
     assert any(
         c["regression"] is True
         for c in doc["shard"]["stepcompare"].values()
+    )
+
+
+# -- configcheck: the repo gate ---------------------------------------
+
+
+def test_configcheck_repo_gate():
+    """Zero non-baselined config-contract findings across the package
+    — the config baseline ships EMPTY, so every env var the pipeline
+    sets is read, every read is covered, and every deliberate default
+    split carries an inline `# sdklint: disable=` rationale."""
+    result = configcheck.analyze_all(REPO)
+    known = baseline_mod.load_baseline(baseline_mod.baseline_path(REPO))
+    fresh, _ = baseline_mod.apply_baseline(result.findings, known)
+    assert not fresh, "\n".join(f.render() for f in fresh)
+    assert not any("config-" in k for k in known), \
+        "the config baseline must stay empty: fix or suppress instead"
+    assert result.files_checked >= 100
+    # the deliberate default splits (SERVE_BATCH dev fallback,
+    # TPU_CHIPS_PER_HOST autodetect sentinel, mnist demo scale) are
+    # suppressed in-tree, not invisible
+    assert any(
+        f.rule == "config-default-drift" for f in result.suppressed
+    )
+    # the flow graph actually joined YAML env to worker reads
+    assert len(result.env_vars) >= 100
+    assert len(result.flows) >= 30
+
+
+def test_config_rule_catalog_lists_every_rule():
+    catalog = configcheck.config_rule_catalog()
+    for rule_id, _ in configcheck.CONFIG_RULES:
+        assert rule_id in catalog
+
+
+# -- configcheck: per-rule fixtures (caught + suppressed) --------------
+
+
+_CONFIG_YAML = """
+name: fix
+pods:
+  web:{pod_comment}
+    count: 1
+    tasks:
+      server:
+        goal: RUNNING
+        cmd: "python frameworks/fix/worker.py"
+        cpus: 1
+        memory: 1024
+        env:{env_block}
+"""
+
+_CONFIG_WORKER = """
+import os
+
+
+def main():
+    steps = int(os.environ.get("STEPS", "5")){extra}
+    return steps
+"""
+
+
+def _config_fixture(tmp_path, yaml=None, worker=None, options=None):
+    framework = tmp_path / "frameworks" / "fix"
+    framework.mkdir(parents=True, exist_ok=True)
+    (framework / "svc.yml").write_text(textwrap.dedent(
+        yaml if yaml is not None else _config_yaml()
+    ))
+    (framework / "worker.py").write_text(textwrap.dedent(
+        worker if worker is not None else _CONFIG_WORKER.format(extra="")
+    ))
+    if options is not None:
+        (framework / "options.json").write_text(json.dumps(options))
+    return configcheck.analyze_all(str(tmp_path))
+
+
+def _config_yaml(pod_comment="", env_block='\n          STEPS: "3"'):
+    return _CONFIG_YAML.format(
+        pod_comment=pod_comment, env_block=env_block
+    )
+
+
+def _config_options(**extra):
+    props = {
+        "steps": {
+            "description": "Fixture steps",
+            "type": "integer", "default": 5, "env": "STEPS",
+        },
+    }
+    props.update(extra)
+    return {"properties": {"fix": {"properties": props}}}
+
+
+def test_config_rule_undeclared_read(tmp_path):
+    """A required os.environ[...] read the task env never sets (and
+    the launch path never injects) fails the pod at its declaring
+    line."""
+    worker = _CONFIG_WORKER.format(
+        extra='\n    token = os.environ["FIXTURE_TOKEN"]'
+    )
+    result = _config_fixture(tmp_path, worker=worker)
+    found = [f for f in result.findings
+             if f.rule == "config-undeclared-read"]
+    assert found and "FIXTURE_TOKEN" in found[0].message
+    assert "worker.py" in found[0].message
+    assert found[0].line > 1  # anchored to the pod's declaring line
+    suppressed = _config_fixture(tmp_path, yaml=_config_yaml(
+        pod_comment="  # sdklint: disable=config-undeclared-read"
+        " — fixture",
+    ), worker=worker)
+    assert not [f for f in suppressed.findings
+                if f.rule == "config-undeclared-read"]
+    assert [f for f in suppressed.suppressed
+            if f.rule == "config-undeclared-read"]
+    # setting the var in the task env clears it
+    clean = _config_fixture(tmp_path, yaml=_config_yaml(
+        env_block='\n          STEPS: "3"'
+        '\n          FIXTURE_TOKEN: "t"',
+    ), worker=worker)
+    assert not [f for f in clean.findings
+                if f.rule == "config-undeclared-read"]
+
+
+def test_config_rule_dead_var(tmp_path):
+    """An env key nothing reads — no direct read, no contract-helper
+    closure, no dynamic table, no template/cmd reference in the YAML
+    itself — is dead operator surface, anchored at the key's line."""
+    result = _config_fixture(tmp_path, yaml=_config_yaml(
+        env_block='\n          STEPS: "3"\n          DEAD_KEY: "1"',
+    ))
+    found = [f for f in result.findings if f.rule == "config-dead-var"]
+    assert found and "DEAD_KEY" in found[0].message
+    assert not any("STEPS" in f.message for f in found)
+    suppressed = _config_fixture(tmp_path, yaml=_config_yaml(
+        env_block='\n          STEPS: "3"'
+        '\n          # sdklint: disable=config-dead-var — fixture'
+        '\n          DEAD_KEY: "1"',
+    ))
+    assert not [f for f in suppressed.findings
+                if f.rule == "config-dead-var"]
+    assert [f for f in suppressed.suppressed
+            if f.rule == "config-dead-var"]
+
+
+def test_config_dead_var_spares_shell_consumers(tmp_path):
+    """A var the task's own cmd consumes ($VAR expansion — the
+    helloworld SLEEP_DURATION shape) is alive without any Python
+    reader."""
+    yaml = """
+    name: fix
+    pods:
+      web:
+        count: 1
+        tasks:
+          server:
+            goal: RUNNING
+            cmd: "sleep $NAP_S && python frameworks/fix/worker.py"
+            cpus: 1
+            memory: 1024
+            env:
+              STEPS: "3"
+              NAP_S: "10"
+    """
+    result = _config_fixture(tmp_path, yaml=yaml)
+    assert not [f for f in result.findings
+                if f.rule == "config-dead-var"]
+
+
+def test_config_rule_type_mismatch(tmp_path):
+    """An env value the read-site cast cannot parse crashes the
+    worker at startup — caught at the key's line instead."""
+    result = _config_fixture(tmp_path, yaml=_config_yaml(
+        env_block='\n          STEPS: "not-a-number"',
+    ))
+    found = [f for f in result.findings
+             if f.rule == "config-type-mismatch"]
+    assert found and "int()" in found[0].message
+    assert "worker.py" in found[0].message
+    suppressed = _config_fixture(tmp_path, yaml=_config_yaml(
+        env_block='\n          # sdklint: disable=config-type-mismatch'
+        ' — fixture\n          STEPS: "not-a-number"',
+    ))
+    assert not [f for f in suppressed.findings
+                if f.rule == "config-type-mismatch"]
+    assert [f for f in suppressed.suppressed
+            if f.rule == "config-type-mismatch"]
+
+
+def test_config_rule_default_drift_code(tmp_path):
+    """The microbatch bug class: the worker's in-code fallback and
+    options.json disagree about the same knob, anchored at the READ
+    site and suppressible there."""
+    worker = """
+    import os
+
+
+    def main():
+        return int(os.environ.get("STEPS", "7"))
+    """
+    result = _config_fixture(
+        tmp_path, yaml=_config_yaml(env_block='\n          STEPS: "{{STEPS:-5}}"'),
+        worker=worker, options=_config_options(),
+    )
+    found = [f for f in result.findings
+             if f.rule == "config-default-drift"]
+    assert found and "'7'" in found[0].message
+    assert found[0].file == "frameworks/fix/worker.py"
+    suppressed_worker = """
+    import os
+
+
+    def main():
+        # sdklint: disable=config-default-drift — fixture
+        return int(os.environ.get("STEPS", "7"))
+    """
+    suppressed = _config_fixture(
+        tmp_path, yaml=_config_yaml(env_block='\n          STEPS: "{{STEPS:-5}}"'),
+        worker=suppressed_worker, options=_config_options(),
+    )
+    assert not [f for f in suppressed.findings
+                if f.rule == "config-default-drift"]
+    assert [f for f in suppressed.suppressed
+            if f.rule == "config-default-drift"]
+
+
+def test_config_rule_default_drift_template(tmp_path):
+    """The YAML-only leg: a template fallback that disagrees with the
+    options default splits YAML-only deploys from rendered ones."""
+    drifted = _config_yaml(env_block='\n          STEPS: "{{STEPS:-9}}"')
+    result = _config_fixture(
+        tmp_path, yaml=drifted, options=_config_options(),
+    )
+    found = [f for f in result.findings
+             if f.rule == "config-default-drift"]
+    assert found and "{{STEPS:-9}}" in found[0].message
+    assert found[0].file == "frameworks/fix/svc.yml"
+    suppressed = _config_fixture(tmp_path, yaml=_config_yaml(
+        env_block='\n          # sdklint: disable=config-default-drift'
+        ' — fixture\n          STEPS: "{{STEPS:-9}}"',
+    ), options=_config_options())
+    assert not [f for f in suppressed.findings
+                if f.rule == "config-default-drift"]
+    assert [f for f in suppressed.suppressed
+            if f.rule == "config-default-drift"]
+    # a matching template default is quiet
+    clean = _config_fixture(tmp_path, yaml=_config_yaml(
+        env_block='\n          STEPS: "{{STEPS:-5}}"',
+    ), options=_config_options())
+    assert not [f for f in clean.findings
+                if f.rule == "config-default-drift"]
+
+
+def test_config_rule_options_orphan(tmp_path):
+    """An options.json knob no YAML template consumes is dead
+    operator surface; JSON cannot carry comments, so the
+    x-sdklint-disable escape hatch is the suppression plane."""
+    orphan = {
+        "description": "Renders nowhere",
+        "type": "string", "default": "x", "env": "ORPHAN_KEY",
+    }
+    options = _config_options(orphan=orphan)
+    result = _config_fixture(
+        tmp_path, yaml=_config_yaml(env_block='\n          STEPS: "{{STEPS:-5}}"'),
+        options=options,
+    )
+    found = [f for f in result.findings
+             if f.rule == "config-options-orphan"]
+    assert found and "ORPHAN_KEY" in found[0].message
+    assert found[0].file == "frameworks/fix/options.json"
+    options["x-sdklint-disable"] = ["config-options-orphan"]
+    suppressed = _config_fixture(
+        tmp_path, yaml=_config_yaml(env_block='\n          STEPS: "{{STEPS:-5}}"'),
+        options=options,
+    )
+    assert not [f for f in suppressed.findings
+                if f.rule == "config-options-orphan"]
+    assert [f for f in suppressed.suppressed
+            if f.rule == "config-options-orphan"]
+
+
+def test_config_cli_subcommand_and_json(tmp_path, capsys):
+    """`config` runs as a positional subcommand; a seeded drifting
+    fixture surfaces in the --json document and flips the exit
+    code."""
+    rc = analysis_main(["config", "--root", REPO])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "config:" in out and "lint:" not in out
+    _config_fixture(tmp_path, yaml=_config_yaml(
+        env_block='\n          STEPS: "{{STEPS:-9}}"'
+        '\n          DEAD_KEY: "1"',
+    ), options=_config_options())
+    rc = analysis_main(["--config", "--json", "--root", str(tmp_path)])
+    doc = json.loads(capsys.readouterr().out)
+    assert rc == 1 and doc["exit_code"] == 1
+    rules = {f["rule"] for f in doc["config"]["findings"]}
+    assert "config-default-drift" in rules
+    assert "config-dead-var" in rules
+    assert doc["config"]["per_rule"]["config-dead-var"] >= 1
+    assert doc["config"]["env_vars"] >= 1
+    assert all(f["line"] > 1 for f in doc["config"]["findings"])
+
+
+def test_config_baseline_ownership(tmp_path):
+    """config- baseline entries survive a `--lint --update-baseline`
+    that never recomputed them, like the shard/spmd entries do."""
+    _config_fixture(tmp_path, yaml=_config_yaml(
+        env_block='\n          DEAD_KEY: "1"\n          STEPS: "3"',
+    ))
+    (tmp_path / "dcos_commons_tpu").mkdir(exist_ok=True)
+    (tmp_path / "dcos_commons_tpu" / "legacy.py").write_text(
+        "import time\n\ndef poll():\n    time.sleep(1)\n"
+    )
+    root = str(tmp_path)
+    rc = analysis_main(["--lint", "--config", "--update-baseline",
+                        "--root", root])
+    assert rc == 0
+    both = baseline_mod.load_baseline(baseline_mod.baseline_path(root))
+    assert any("config-dead-var" in k for k in both)
+    assert any("no-blocking-sleep" in k for k in both)
+    rc = analysis_main(["--lint", "--update-baseline", "--root", root])
+    assert rc == 0
+    after = baseline_mod.load_baseline(baseline_mod.baseline_path(root))
+    assert after == both
+    rc = analysis_main(["--lint", "--config", "--root", root])
+    assert rc == 0
+
+
+def test_config_reference_doc_is_current():
+    """docs/config-reference.md is generated; the committed copy must
+    match what `analysis config --docs` would write today."""
+    result = configcheck.analyze_all(REPO)
+    expected = configcheck.render_config_reference(result)
+    path = os.path.join(REPO, "docs", "config-reference.md")
+    with open(path, "r", encoding="utf-8") as f:
+        committed = f.read()
+    assert committed == expected, (
+        "docs/config-reference.md is stale — regenerate with "
+        "`python -m dcos_commons_tpu.analysis config --docs`"
     )
