@@ -3994,6 +3994,27 @@ def main() -> None:
     except Exception as e:
         extras["config_error"] = repr(e)[:200]
     _mark("configcheck")
+    try:
+        # durability-surface trend keys: how many persistence
+        # boundaries durcheck tracks for the auto-derived chaos
+        # matrix (the findings gate lives in tests/test_lint_gate.py)
+        from dcos_commons_tpu.analysis import durcheck
+
+        dur_result = durcheck.analyze_tree(
+            os.path.dirname(os.path.abspath(__file__))
+        )
+        extras["dur_persistence_points"] = len(
+            dur_result.persistence_points
+        )
+        extras["dur_findings"] = len(dur_result.findings)
+        extras["dur_suppressed"] = len(dur_result.suppressed)
+        per_kind: dict = {}
+        for point in dur_result.persistence_points:
+            per_kind[point.kind] = per_kind.get(point.kind, 0) + 1
+        extras["dur_per_kind"] = per_kind
+    except Exception as e:
+        extras["dur_error"] = repr(e)[:200]
+    _mark("durcheck")
     value = deploy["deploy_wall_clock_s"]
     print(
         json.dumps(

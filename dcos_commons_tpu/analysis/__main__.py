@@ -11,6 +11,10 @@ Commands (also reachable as ``python -m dcos_commons_tpu analyze``):
              half; the dynamic half runs under SDKLINT_RACECHECK=1)
     config   env/config contract analyzer (options.json ⇄ YAML
              templates ⇄ task env ⇄ worker/SDK reads)
+    dur      crash-consistency / durability-ordering analyzer
+             (WAL-before-effect, replay parity, fence coverage,
+             atomic pairs, file discipline + the persistence-point
+             map the chaos harness auto-derives kill points from)
     all      everything — the CI gate; default when no command given
 
 Flag spelling (``--lint``/.../``--race``/``--all``) is accepted too,
@@ -23,6 +27,9 @@ Options:
                         config.env_vars / config.flows / config.per_rule)
     --docs              render the config flow graph to
                         docs/config-reference.md (implies config)
+    --points            dump the durcheck persistence-point map as a
+                        JSON document and exit (for the chaos harness
+                        and /v1/debug/health consumers)
     --update-baseline   rewrite the baseline from current
                         lint+spmd+shard findings
     --catalog           print the rule catalogs and exit
@@ -52,7 +59,8 @@ import sys
 from typing import List
 
 _COMMANDS = (
-    "lint", "specs", "spmd", "plan", "shard", "race", "config", "all"
+    "lint", "specs", "spmd", "plan", "shard", "race", "config", "dur",
+    "all",
 )
 
 
@@ -67,6 +75,7 @@ def main(argv: List[str] = None) -> int:
     from dcos_commons_tpu.analysis import baseline as baseline_mod
     from dcos_commons_tpu.analysis import (
         configcheck,
+        durcheck,
         plancheck,
         racecheck,
         shardcheck,
@@ -74,6 +83,7 @@ def main(argv: List[str] = None) -> int:
         spmdcheck,
     )
     from dcos_commons_tpu.analysis.configcheck import config_rule_catalog
+    from dcos_commons_tpu.analysis.durcheck import dur_rule_catalog
     from dcos_commons_tpu.analysis.linter import lint_tree
     from dcos_commons_tpu.analysis.racecheck import race_rule_catalog
     from dcos_commons_tpu.analysis.rules import rule_catalog
@@ -96,11 +106,16 @@ def main(argv: List[str] = None) -> int:
     parser.add_argument("--shard", action="store_true")
     parser.add_argument("--race", action="store_true")
     parser.add_argument("--config", action="store_true")
+    parser.add_argument("--dur", action="store_true")
     parser.add_argument("--all", action="store_true")
     parser.add_argument(
         "--docs", action="store_true",
         help="render the config flow graph to docs/config-reference.md "
              "(implies --config)",
+    )
+    parser.add_argument(
+        "--points", action="store_true",
+        help="dump the durcheck persistence-point map as JSON and exit",
     )
     parser.add_argument("--json", action="store_true", dest="as_json")
     parser.add_argument("--update-baseline", action="store_true")
@@ -144,11 +159,27 @@ def main(argv: List[str] = None) -> int:
         print(race_rule_catalog())
         print()
         print(config_rule_catalog())
+        print()
+        print(dur_rule_catalog())
+        return 0
+
+    if args.points:
+        # the machine contract: testing/chaos.py auto-derives its
+        # crash-injection points from exactly this document, and the
+        # /v1/debug/health handler links it for operators
+        points = durcheck.persistence_point_map(os.path.abspath(args.root))
+        per_kind: dict = {}
+        for point in points:
+            per_kind[point["kind"]] = per_kind.get(point["kind"], 0) + 1
+        print(json.dumps(
+            {"persistence_points": points, "per_kind": per_kind},
+            indent=2, sort_keys=True,
+        ))
         return 0
 
     any_mode = (args.lint or args.specs or args.spmd or args.plan
                 or args.shard or args.race or args.config
-                or args.docs)
+                or args.dur or args.docs)
     run_lint = args.lint or args.all or not any_mode
     run_specs = args.specs or args.all or not any_mode
     run_spmd = args.spmd or args.all or not any_mode
@@ -156,6 +187,7 @@ def main(argv: List[str] = None) -> int:
     run_shard = args.shard or args.all or not any_mode
     run_race = args.race or args.all or not any_mode
     run_config = args.config or args.docs or args.all or not any_mode
+    run_dur = args.dur or args.all or not any_mode
     root = os.path.abspath(args.root)
     baseline_path = args.baseline or baseline_mod.baseline_path(root)
     known = baseline_mod.load_baseline(baseline_path)
@@ -281,12 +313,26 @@ def main(argv: List[str] = None) -> int:
             emit(f"docs: wrote {docs_path}")
             doc["config"]["docs_path"] = docs_path
 
+    if run_dur:
+        dur_result = durcheck.analyze_tree(root)
+        run_findings_pass("dur", dur_result)
+        # trend keys: the durability surface the chaos matrix covers
+        doc["dur"]["persistence_points"] = len(
+            dur_result.persistence_points
+        )
+        per_kind: dict = {}
+        for point in dur_result.persistence_points:
+            per_kind[point.kind] = per_kind.get(point.kind, 0) + 1
+        doc["dur"]["per_kind"] = per_kind
+        doc["dur"]["per_rule"] = dict(dur_result.per_rule)
+
     if args.update_baseline:
         if not (run_lint or run_spmd or run_shard or run_race
-                or run_config):
+                or run_config or run_dur):
             emit(
                 "baseline: nothing to update — only lint, spmd, shard, "
-                "race, and config feed the baseline; run one of them"
+                "race, config, and dur feed the baseline; run one of "
+                "them"
             )
         else:
             # entries of a baseline-feeding pass that did NOT run
@@ -304,6 +350,8 @@ def main(argv: List[str] = None) -> int:
                     owner_ran = run_race
                 elif rule.startswith("config-"):
                     owner_ran = run_config
+                elif rule.startswith("dur-"):
+                    owner_ran = run_dur
                 else:
                     owner_ran = run_lint
                 if not owner_ran:

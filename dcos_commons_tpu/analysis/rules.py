@@ -646,7 +646,14 @@ class LeaseGatedMutationRule(Rule):
     scheduler-path packages below; store/fence modules themselves
     (state/, storage/, multi/store.py, ha/election.py) and testing/
     are exempt.  A deliberate raw write carries an explaining
-    ``# sdklint: disable``."""
+    ``# sdklint: disable``.
+
+    Division of labor with durcheck's ``dur-unfenced-write``: this
+    rule owns DIRECT raw mutations inside ``_SCOPED`` (single-file,
+    cheap, runs on every lint); durcheck owns raw mutations OUTSIDE
+    the scope that are nevertheless reachable from scheduler-path
+    code over the interprocedural call graph — durcheck skips every
+    site in ``_SCOPED``, so one site is never double-reported."""
 
     id = "lease-gated-mutation"
     description = "raw persister mutation in a scheduler path (bypasses the lease-fenced store layer)"

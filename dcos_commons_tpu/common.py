@@ -213,13 +213,16 @@ class TaskStatus(SerializableMixin):
 
 
 def atomic_write_text(path: str, content: str) -> None:
-    """Write-tmp-then-rename so readers never see a partial file
+    """Write-tmp-fsync-then-rename so readers never see a partial
+    file AND the content survives a power failure at the rename
     (announce files, PID files)."""
     import os
 
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
         f.write(content)
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path)
 
 

@@ -66,6 +66,7 @@ class PersisterBackend:
         return self._persister.get_or_none(self._path)
 
     def store(self, raw: bytes) -> None:
+        # durcheck: dur-unfenced-write=builder injects a FencedPersister in HA mode, so the fence lives in the instance, not this call site
         self._persister.set(self._path, raw)
 
 

@@ -121,6 +121,7 @@ class ReservationLedger(ReservationLedgerView):
             SetOp(self._path(r.reservation_id), r.to_bytes())
             for r in reservations
         ]
+        # durcheck: dur-unfenced-write=the scheduler builder hands this ledger a FencedPersister in HA mode; the fence is in the injected instance
         self._persister.apply(ops)
         self._generation += 1
         for r in reservations:
@@ -131,6 +132,7 @@ class ReservationLedger(ReservationLedgerView):
 
         path = self._path(reservation_id)
         try:
+            # durcheck: dur-unfenced-write=same injected FencedPersister as commit(); a deposed leader's delete raises through the fence
             self._persister.recursive_delete(path)
         except PersisterError:
             pass

@@ -600,6 +600,7 @@ class RequestRouter:
         and /v1/debug/router merge them per task."""
         try:
             tmp = path + ".tmp"
+            # durcheck: dur-file-discipline=telemetry mirror: loss on power failure is acceptable, the rename alone keeps readers partial-free
             with open(tmp, "w", encoding="utf-8") as f:
                 json.dump(self.stats(), f)
             os.replace(tmp, path)

@@ -709,6 +709,7 @@ class DefaultScheduler:
                 # WAL discipline: reservations + task infos are durable
                 # BEFORE the agent sees a launch
                 # (DefaultScheduler.java:454)
+                # durcheck: dur-effect-before-wal=the preceding kill is recovery-covered: a crash here leaves a terminal status the successor relaunches from; this WAL only covers the NEW launch
                 self.ledger.commit(result.reservations)
                 self.launch_recorder.record(
                     result.task_infos, parent=launch_span

@@ -669,6 +669,7 @@ class SlotEngine:
         self._stats_written = now
         try:
             tmp = self._stats_path + ".tmp"
+            # durcheck: dur-file-discipline=telemetry mirror: loss on power failure is acceptable, the rename alone keeps readers partial-free
             with open(tmp, "w", encoding="utf-8") as f:
                 json.dump(self.stats(), f)
             os.replace(tmp, self._stats_path)

@@ -25,14 +25,28 @@ again.
 Deterministic: kills fire at exact occurrence counts of exact kinds;
 ``ChaosMatrix`` derives its schedule from a seed recorded in every
 report, so a failing combination replays from the log line alone.
+
+The five hand-wired kinds above are the HISTORY; the durcheck
+analyzer (``analysis dur --points``) now emits the full persistence-
+point map — every WAL/store/property/persister/delete boundary it
+discovered statically — and ``AutoChaosMatrix`` turns each one into a
+crash-injection point: a ``PersisterCrashProxy`` wraps the harness
+persister, stack-matches every mutation against the map (marking
+coverage), and dies immediately BEFORE the targeted mutation — the
+crash window ``dur-effect-before-wal`` reasons about.  A coverage
+probe run first separates reachable boundaries from unreachable
+ones; unreachable boundaries are REPORTED in the result, never
+silently skipped, so the map stays probe-verified.
 """
 
 from __future__ import annotations
 
+import os
 import random
+import sys
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Set, Tuple
 
 from dcos_commons_tpu.common import TaskState, TaskStatus
 from dcos_commons_tpu.offer.inventory import SliceInventory, TpuHost
@@ -55,8 +69,11 @@ CHAOS_KINDS = (
 )
 
 
-class SchedulerKilled(Exception):
-    """Raised by a CrashInjector: the scheduler 'process' died here."""
+class SchedulerKilled(BaseException):
+    """Raised by a CrashInjector: the scheduler 'process' died here.
+    A ``BaseException`` on purpose — it models SIGKILL, and a
+    catch-all ``except Exception`` telemetry guard (health observe,
+    journal flush) must not be able to 'survive' process death."""
 
     def __init__(self, kind: str, occurrence: int):
         super().__init__(f"chaos kill at {kind} (occurrence {occurrence})")
@@ -376,6 +393,89 @@ class ChaosHarness:
                     f"WAL'd task {info.name} has no status for its "
                     f"launch: {describe}"
                 )
+
+    # -- auto-derived boundary runs (durcheck persistence points) -----
+
+    def run_boundary(
+        self,
+        proxy: "PersisterCrashProxy",
+        timeout_s: float = 60.0,
+        settle_s: float = 0.02,
+    ) -> "BoundaryReport":
+        """Like ``run``, but the killer is a ``PersisterCrashProxy``
+        already installed as ``self.persister`` instead of a span-kind
+        injector.  Differences the boundary semantics force:
+
+        * ``build_scheduler`` runs INSIDE the try — rehydrate and
+          builder mutations cross persistence boundaries too, and a
+          targeted boundary may only be reachable there.
+        * at death the report additionally records the **unWAL'd
+          effects**: agent-active task ids the store has no record of.
+          Zero for the healthy scheduler at every boundary (the proxy
+          dies BEFORE the mutation, so the crash window is maximal) —
+          nonzero exactly when an effect escaped ahead of its WAL,
+          which is what the seeded-bug fixture demonstrates.
+
+        With ``proxy.target`` None this is the coverage probe: a
+        healthy converging run that marks every boundary the harness
+        actually crosses in ``proxy.covered``."""
+        from dcos_commons_tpu.state.state_store import StateStore
+
+        report = ChaosReport(kill=None, seed=self.seed)
+        boundary = BoundaryReport(point=proxy.target, report=report)
+        scheduler = self.scheduler
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            try:
+                if scheduler is None:
+                    scheduler = self.build_scheduler()
+                scheduler.run_cycle()
+            except SchedulerKilled:
+                report.killed = True
+                if scheduler is not None:
+                    self._snapshot_plans(scheduler, report)
+                self._snapshot_persisted(report)
+                stored_ids = {
+                    info.task_id
+                    for info in StateStore(self.persister).fetch_tasks()
+                }
+                active = set(self.agent.active_task_ids())
+                boundary.unwald_at_death = sorted(active - stored_ids)
+                scheduler = None  # successor rebuilt inside the try
+                # Mesos-style status reconciliation: the successor asks
+                # the agent to re-send task state, so a status consumed
+                # but killed before its store write is re-delivered
+                # (span-kind kills never die mid-status, so ``run``
+                # does not need this).
+                self._acked.clear()
+                report.incarnations += 1
+                continue
+            report.cycles += 1
+            if report.killed and report.rehydration is None:
+                report.rehydration = scheduler.last_rehydration
+            if not self.local_mode:
+                self._ack_fake_launches()
+            if scheduler.deploy_manager.get_plan().is_complete:
+                report.converged = True
+                # a targeted boundary may belong to a wall-clock
+                # periodic writer (health journal flushes): keep the
+                # converged world running until the kill fires, so a
+                # point the probe reached is never lost to deploy-vs-
+                # interval jitter.  The deadline still bounds a target
+                # that genuinely cannot fire.
+                if proxy.target is None or report.killed:
+                    break
+            if self.local_mode:
+                time.sleep(settle_s)
+        if proxy.target is not None and not report.killed:
+            raise AssertionError(
+                f"auto boundary {proxy.target} never fired: "
+                f"{report.describe()}"
+            )
+        for info in scheduler.state_store.fetch_tasks():
+            report.final_task_ids[info.name] = info.task_id
+        self.assert_invariants(scheduler, report)
+        return boundary
 
 
 # -- host-level preemption storms (ISSUE 13) --------------------------
@@ -783,3 +883,203 @@ class ChaosMatrix:
             finally:
                 harness.shutdown()
         return reports
+
+
+# -- auto-derived chaos points (durcheck persistence-point map) -------
+
+# the point kinds a persister-level crash proxy can actually observe:
+# everything that crosses the persister write API.  Journal appends
+# are buffered record writes (the flush's store_property is the
+# durability boundary), checkpoints ride a store_property too, and
+# file writes bypass the persister entirely — excluding them keeps
+# statically-unprobeable kinds out of the probe set, so 'unreached'
+# means "this persister boundary was not exercised", never "this kind
+# is invisible by construction".
+AUTO_CHAOS_KINDS = ("wal", "store", "property", "persister", "delete")
+
+
+def point_key(point: Dict[str, object]) -> Tuple[str, int, str]:
+    """Stable identity of a persistence point across runs."""
+    return (str(point["file"]), int(point["line"]), str(point["kind"]))
+
+
+def auto_chaos_points(root: Optional[str] = None) -> List[Dict[str, object]]:
+    """The statically discovered crash-injection candidates: the
+    durcheck persistence-point map filtered to persister-crossing
+    kinds (cached in durcheck, so every harness in a session shares
+    one AST pass)."""
+    from dcos_commons_tpu.analysis.durcheck import persistence_point_map
+
+    return [
+        point for point in persistence_point_map(root)
+        if point["kind"] in AUTO_CHAOS_KINDS
+    ]
+
+
+class PersisterCrashProxy:
+    """Wraps the harness persister; every mutation is stack-matched
+    against the persistence-point map.  Each matching frame marks
+    that point covered (one ``store.store_launch`` call covers both
+    the state-store apply site and the scheduler's recorder line —
+    every boundary on the stack IS at its crash window).  When the
+    designated ``target`` point appears on the stack for the
+    ``occurrence``-th time, the proxy raises ``SchedulerKilled``
+    BEFORE delegating — crash-before-mutation, the maximal window
+    ``dur-effect-before-wal`` reasons about — then disarms so the
+    successor converges.  Reads delegate untouched."""
+
+    _MUTATORS = ("set", "apply", "recursive_delete", "clear_all_data")
+
+    def __init__(
+        self,
+        inner,
+        points: List[Dict[str, object]],
+        target: Optional[Dict[str, object]] = None,
+        occurrence: int = 1,
+    ):
+        self._inner = inner
+        self._points = points
+        self.target = target
+        self._target_key = point_key(target) if target else None
+        self._occurrence = occurrence
+        self._hits = 0
+        self.fired = False
+        self.covered: Set[Tuple[str, int, str]] = set()
+
+    def _observe(self) -> None:
+        on_target = False
+        frame = sys._getframe(2)
+        while frame is not None:
+            fname = frame.f_code.co_filename.replace(os.sep, "/")
+            lineno = frame.f_lineno
+            for point in self._points:
+                if fname.endswith(str(point["file"])) and \
+                        int(point["line"]) <= lineno <= \
+                        int(point["end_line"]):
+                    key = point_key(point)
+                    self.covered.add(key)
+                    if key == self._target_key:
+                        on_target = True
+            frame = frame.f_back
+        if on_target and not self.fired:
+            self._hits += 1
+            if self._hits >= self._occurrence:
+                self.fired = True
+                target = self.target
+                raise SchedulerKilled(
+                    f"auto:{target['file']}:{target['line']}"
+                    f":{target['kind']}",
+                    self._hits,
+                )
+
+    def set(self, *args, **kwargs):
+        self._observe()
+        return self._inner.set(*args, **kwargs)
+
+    def apply(self, *args, **kwargs):
+        self._observe()
+        return self._inner.apply(*args, **kwargs)
+
+    def recursive_delete(self, *args, **kwargs):
+        self._observe()
+        return self._inner.recursive_delete(*args, **kwargs)
+
+    def clear_all_data(self, *args, **kwargs):
+        self._observe()
+        return self._inner.clear_all_data(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+@dataclass
+class BoundaryReport:
+    """One auto-derived boundary run: the targeted point (None for
+    the coverage probe), the underlying kill-and-converge report, and
+    the unWAL'd effects observed at the moment of death."""
+
+    point: Optional[Dict[str, object]]
+    report: ChaosReport
+    unwald_at_death: List[str] = field(default_factory=list)
+
+
+@dataclass
+class AutoChaosResult:
+    """The auto-derived matrix outcome.  ``unreached`` is DATA, not a
+    skip: every statically discovered boundary the harness could not
+    cross is accounted here, and the integration test pins the set —
+    a new unreachable boundary is a finding someone must explain."""
+
+    seed: int
+    all_points: List[Dict[str, object]] = field(default_factory=list)
+    reached: List[Dict[str, object]] = field(default_factory=list)
+    unreached: List[Dict[str, object]] = field(default_factory=list)
+    targeted: List[Dict[str, object]] = field(default_factory=list)
+    reports: List[BoundaryReport] = field(default_factory=list)
+
+    def describe(self) -> str:
+        return (
+            f"auto-chaos[seed={self.seed} "
+            f"points={len(self.all_points)} "
+            f"reached={len(self.reached)} "
+            f"unreached={len(self.unreached)} "
+            f"crashed={len(self.reports)}]"
+        )
+
+
+class AutoChaosMatrix:
+    """The statically derived kill matrix: one uninjected coverage
+    probe separates reachable boundaries from unreachable ones, then
+    a seed-shuffled budgeted subset of the REACHED points each get a
+    fresh-world crash run (CI budget discipline: the full reached set
+    is usually larger than one tier can afford; the seed is recorded
+    so a failing subset replays exactly)."""
+
+    def __init__(
+        self,
+        seed: int = 0,
+        budget: int = 6,
+        root: Optional[str] = None,
+    ):
+        self.seed = seed
+        self.budget = budget
+        self.points = auto_chaos_points(root)
+
+    def run(self, harness_factory,
+            timeout_s: float = 60.0) -> AutoChaosResult:
+        """``harness_factory(seed) -> ChaosHarness`` builds a FRESH
+        world per boundary, exactly like ``ChaosMatrix.run``."""
+        result = AutoChaosResult(seed=self.seed, all_points=self.points)
+        # 1. coverage probe: healthy run, no injection — which of the
+        #    statically discovered boundaries does this world cross?
+        harness = harness_factory(self.seed)
+        probe = PersisterCrashProxy(harness.persister, self.points)
+        harness.persister = probe
+        try:
+            harness.run_boundary(probe, timeout_s=timeout_s)
+        finally:
+            harness.shutdown()
+        reached_keys = set(probe.covered)
+        result.reached = [
+            p for p in self.points if point_key(p) in reached_keys
+        ]
+        result.unreached = [
+            p for p in self.points if point_key(p) not in reached_keys
+        ]
+        # 2. seeded budgeted subset of reached boundaries: crash runs
+        targeted = list(result.reached)
+        random.Random(self.seed).shuffle(targeted)
+        result.targeted = targeted[: self.budget]
+        for point in result.targeted:
+            harness = harness_factory(self.seed)
+            proxy = PersisterCrashProxy(
+                harness.persister, self.points, target=point
+            )
+            harness.persister = proxy
+            try:
+                result.reports.append(
+                    harness.run_boundary(proxy, timeout_s=timeout_s)
+                )
+            finally:
+                harness.shutdown()
+        return result

@@ -1,0 +1,209 @@
+"""Property tests for the durcheck crash-consistency analyzer.
+
+Two properties the analyzer leans on:
+
+* ``DurProgram.propagate()`` is a monotone fixpoint: summaries only
+  ever grow, and a second run changes nothing.  Random call graphs
+  with random primitive persists/effects pin this down.
+
+* The effect-before-WAL flow is path-join sound: on straight-line
+  if/else trees a persist on only one branch never masks an effect
+  that reaches the trigger on another path.  We brute-force every
+  path through small random statement trees and require the analyzer
+  to agree exactly.
+"""
+
+import pytest
+
+pytest.importorskip("hypothesis", reason="property tests need the hypothesis package")
+
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+from dcos_commons_tpu.analysis import durcheck  # noqa: E402
+from dcos_commons_tpu.analysis.durcheck import (  # noqa: E402
+    DurProgram,
+    DurSummary,
+    EffectBeforeWalRule,
+)
+
+PERSIST_POOL = sorted(durcheck.PERSIST_KINDS)
+EFFECT_POOL = sorted(durcheck.EFFECT_KINDS)
+
+
+# ---------------------------------------------------------------------------
+# propagate(): monotone to a fixpoint
+# ---------------------------------------------------------------------------
+
+@st.composite
+def call_graphs(draw):
+    """A random DurProgram: N functions, random direct persists and
+    effects, random call edges (cycles allowed — union-by-name in the
+    real analyzer makes them common)."""
+    n = draw(st.integers(min_value=1, max_value=8))
+    names = [f"mod.f{i}" for i in range(n)]
+    program = DurProgram()
+    for i, name in enumerate(names):
+        persists = set(draw(st.lists(st.sampled_from(PERSIST_POOL), max_size=3)))
+        effects = set(draw(st.lists(st.sampled_from(EFFECT_POOL), max_size=2)))
+        callees = set(
+            draw(st.lists(st.sampled_from(names), max_size=4))
+        ) - {name}
+        simple_callees = {c.rsplit(".", 1)[-1] for c in callees}
+        program.add(
+            DurSummary(
+                qualname=name,
+                file="mod.py",
+                lineno=i + 1,
+                persists=persists,
+                effects=effects,
+                calls=simple_callees,
+                edge_calls=set(simple_callees),
+            )
+        )
+    return program
+
+
+@settings(max_examples=60, deadline=None)
+@given(call_graphs())
+def test_propagate_only_grows_summaries(program):
+    before = {
+        name: (set(s.persists), set(s.effects))
+        for name, s in program.functions.items()
+    }
+    program.propagate()
+    for name, summary in program.functions.items():
+        pre_persists, pre_effects = before[name]
+        assert pre_persists <= summary.persists
+        assert pre_effects <= summary.effects
+        # transitive closure: every direct callee's post-state is in
+        for callee_name in summary.calls:
+            for callee in program.resolve(callee_name):
+                assert callee.persists <= summary.persists
+                assert callee.effects <= summary.effects
+
+
+@settings(max_examples=60, deadline=None)
+@given(call_graphs())
+def test_propagate_twice_is_noop(program):
+    program.propagate()
+    snapshot = {
+        name: (set(s.persists), set(s.effects))
+        for name, s in program.functions.items()
+    }
+    rounds = program.propagate()
+    assert rounds == 1  # one scan that finds nothing to change
+    after = {
+        name: (set(s.persists), set(s.effects))
+        for name, s in program.functions.items()
+    }
+    assert after == snapshot
+
+
+# ---------------------------------------------------------------------------
+# dur-effect-before-wal: path-join soundness
+# ---------------------------------------------------------------------------
+#
+# A statement tree is a list of nodes; each node is one of
+#   ("effect",)            -> self.task_killer.kill("t")
+#   ("trigger",)           -> self.ledger.commit(ops)     (WAL persist)
+#   ("noop",)              -> self.log.info("x")
+#   ("if", body, orelse)   -> if cond: ... else: ...
+#
+# Brute force enumerates every path (each If independently takes its
+# body or its orelse) and asks: does SOME path run an effect strictly
+# before a trigger?  The analyzer must answer exactly the same.
+
+def _leaf():
+    return st.sampled_from([("effect",), ("trigger",), ("noop",)])
+
+
+def _trees(depth):
+    if depth == 0:
+        return st.lists(_leaf(), min_size=0, max_size=3)
+    sub = _trees(depth - 1)
+    node = st.one_of(
+        _leaf(),
+        st.tuples(st.just("if"), sub, sub),
+    )
+    return st.lists(node, min_size=0, max_size=3)
+
+
+def _render(stmts, indent):
+    pad = " " * indent
+    lines = []
+    for node in stmts:
+        if node[0] == "effect":
+            lines.append(pad + 'self.task_killer.kill("t")')
+        elif node[0] == "trigger":
+            lines.append(pad + "self.ledger.commit(ops)")
+        elif node[0] == "noop":
+            lines.append(pad + 'self.log.info("x")')
+        else:
+            _, body, orelse = node
+            lines.append(pad + "if self.cond():")
+            lines.extend(_render(body, indent + 4) or [pad + "    pass"])
+            lines.append(pad + "else:")
+            lines.extend(_render(orelse, indent + 4) or [pad + "    pass"])
+    return lines
+
+
+def _paths(stmts):
+    """Every linear execution path as a list of 'effect'/'trigger'."""
+    acc = [[]]
+    for node in stmts:
+        if node[0] == "if":
+            _, body, orelse = node
+            branches = _paths(body) + _paths(orelse)
+            acc = [p + b for p in acc for b in branches]
+        elif node[0] == "noop":
+            continue
+        else:
+            acc = [p + [node[0]] for p in acc]
+    return acc
+
+
+def _some_path_has_effect_before_trigger(stmts):
+    for path in _paths(stmts):
+        armed = False
+        for step in path:
+            if step == "effect":
+                armed = True
+            elif step == "trigger" and armed:
+                return True
+    return False
+
+
+@settings(max_examples=80, deadline=None)
+@given(_trees(depth=2))
+def test_effect_before_wal_matches_brute_force_paths(stmts):
+    body = _render(stmts, indent=8) or ["        pass"]
+    src = "class S:\n    def run(self, ops):\n" + "\n".join(body) + "\n"
+    result = durcheck.analyze_paths(
+        [("/fix/mod.py", "dcos_commons_tpu/scheduler/mod.py", src)],
+        rules=[EffectBeforeWalRule()],
+    )
+    assert result.files_checked == 1  # fixture must parse
+    expected = _some_path_has_effect_before_trigger(stmts)
+    got = bool(result.findings)
+    assert got == expected, (
+        f"analyzer={'finding' if got else 'clean'} but brute-force "
+        f"paths say {'tainted' if expected else 'clean'}:\n{src}"
+    )
+
+
+def test_persist_on_one_branch_never_masks():
+    # The concrete regression the property defends: the branch that
+    # persists first must not scrub the effect flowing in from the
+    # other branch.
+    stmts = [
+        ("if", [("trigger",)], [("effect",)]),
+        ("trigger",),
+    ]
+    assert _some_path_has_effect_before_trigger(stmts)
+    body = _render(stmts, indent=8)
+    src = "class S:\n    def run(self, ops):\n" + "\n".join(body) + "\n"
+    result = durcheck.analyze_paths(
+        [("/fix/mod.py", "dcos_commons_tpu/scheduler/mod.py", src)],
+        rules=[EffectBeforeWalRule()],
+    )
+    assert len(result.findings) == 1

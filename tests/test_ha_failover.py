@@ -38,9 +38,13 @@ from dcos_commons_tpu.storage import MemPersister
 from dcos_commons_tpu.testing import FakeAgent
 from dcos_commons_tpu.testing.chaos import (
     CHAOS_KINDS,
+    AutoChaosMatrix,
     ChaosHarness,
     ChaosMatrix,
     KillPoint,
+    PersisterCrashProxy,
+    auto_chaos_points,
+    point_key,
 )
 
 CHAOS_SEED = int(os.environ.get("CHAOS_SEED", "0"))
@@ -412,6 +416,108 @@ def test_chaos_unkilled_baseline():
         harness.shutdown()
     assert report.converged and not report.killed
     assert report.incarnations == 1
+
+
+# -- the auto-derived chaos matrix (durcheck persistence points) ------
+
+
+def test_auto_chaos_matrix_outgrows_hand_wired_kinds():
+    """The statically derived matrix: durcheck's persistence-point map
+    yields strictly more crash boundaries than the 5 hand-wired span
+    kinds, every budgeted crash run converges with ZERO unWAL'd
+    effects at death (crash-before-mutation is the maximal window),
+    and every discovered boundary is accounted as reached or
+    unreached — never silently skipped."""
+    matrix = AutoChaosMatrix(seed=CHAOS_SEED, budget=6)
+    assert len(matrix.points) > len(CHAOS_KINDS), (
+        "static discovery found fewer boundaries than the hand-wired "
+        f"kinds: {len(matrix.points)}"
+    )
+    result = matrix.run(lambda seed: ChaosHarness(seed=seed),
+                        timeout_s=30)
+    # discovery beats the hand-wired matrix on REACHED (not just
+    # discovered) boundaries
+    assert len(result.reached) > len(CHAOS_KINDS), result.describe()
+    # full accounting: reached and unreached partition the point set
+    reached = {point_key(p) for p in result.reached}
+    unreached = {point_key(p) for p in result.unreached}
+    assert not reached & unreached
+    assert reached | unreached == {point_key(p) for p in result.all_points}
+    # the budgeted subset all died at their boundary and converged,
+    # and the healthy scheduler never leaks an effect past its WAL
+    assert len(result.reports) == len(result.targeted) == \
+        min(6, len(result.reached))
+    for boundary in result.reports:
+        assert boundary.report.killed and boundary.report.converged, \
+            f"{boundary.point}: {boundary.report.describe()}"
+        assert boundary.unwald_at_death == [], (
+            f"unWAL'd effect at {boundary.point}: "
+            f"{boundary.unwald_at_death}"
+        )
+
+
+def test_auto_chaos_seed_replays_identical_subset():
+    """CHAOS_SEED=<seed> replays the exact budgeted subset: same seed,
+    same targeted boundaries, in order (the CI budget discipline the
+    failure-log replay instructions depend on)."""
+    runs = []
+    for _ in range(2):
+        matrix = AutoChaosMatrix(seed=CHAOS_SEED, budget=2)
+        result = matrix.run(lambda seed: ChaosHarness(seed=seed),
+                            timeout_s=30)
+        runs.append([point_key(p) for p in result.targeted])
+    assert runs[0] == runs[1] and len(runs[0]) == 2
+
+
+def test_seeded_unwald_launch_bug_caught_both_ways():
+    """The seeded durability bug — launch reaches the agent BEFORE its
+    WAL write — is caught twice over: statically by
+    dur-effect-before-wal on a fixture of the same shape, and
+    dynamically by a crashed auto boundary observing a nonzero
+    unWAL'd-effect set at death."""
+    # static half: same shape as the runtime bug below
+    from dcos_commons_tpu.analysis import durcheck
+
+    fixture = (
+        "class BuggyRecorder:\n"
+        "    def record(self, infos, parent=None):\n"
+        "        self.agent.launch(infos)\n"
+        "        self._state_store.store_launch(infos)\n"
+    )
+    static = durcheck.analyze_paths(
+        [("/fix/rec.py", "dcos_commons_tpu/state/rec.py", fixture)]
+    )
+    assert [f.rule for f in static.findings] == ["dur-effect-before-wal"]
+    assert "launch" in static.findings[0].message
+
+    # dynamic half: crash at the store_launch boundary with the launch
+    # effect moved ahead of the recorder's WAL write
+    points = auto_chaos_points()
+    target = next(
+        p for p in points
+        if str(p["file"]).endswith("state/state_store.py")
+        and str(p["function"]).endswith("store_launch")
+    )
+    harness = ChaosHarness(seed=CHAOS_SEED)
+    proxy = PersisterCrashProxy(harness.persister, points, target=target)
+    harness.persister = proxy
+    try:
+        scheduler = harness.build_scheduler()
+        real_record = scheduler.launch_recorder.record
+
+        def buggy_record(infos, parent=None):
+            harness.agent.launch(infos)  # effect escapes its WAL
+            real_record(infos, parent=parent)
+
+        scheduler.launch_recorder.record = buggy_record
+        boundary = harness.run_boundary(proxy, timeout_s=30)
+    finally:
+        harness.shutdown()
+    report = boundary.report
+    assert report.killed and report.converged, report.describe()
+    # the dynamic signature of the static finding: agent-active tasks
+    # the store had never heard of at the moment of death
+    assert boundary.unwald_at_death, report.describe()
 
 
 # -- the chaos kill matrix (chaos tier: real processes) ---------------

@@ -21,6 +21,7 @@ import time
 from dcos_commons_tpu.analysis import baseline as baseline_mod
 from dcos_commons_tpu.analysis import (
     configcheck,
+    durcheck,
     lockcheck,
     plancheck,
     racecheck,
@@ -68,9 +69,10 @@ def test_repo_race_gate():
 
 def test_cli_all_exits_zero(capsys):
     """The CI entry point: `python -m dcos_commons_tpu.analysis --all`
-    (lint + specs + spmd + plan + shard + race + config; the plancheck
-    cap is trimmed here — test_plancheck_repo_gate owns the full-depth
-    run).  The whole sweep stays inside the ~40s CI budget."""
+    (lint + specs + spmd + plan + shard + race + config + dur; the
+    plancheck cap is trimmed here — test_plancheck_repo_gate owns the
+    full-depth run).  The whole sweep stays inside the ~40s CI
+    budget."""
     start = time.monotonic()
     rc = analysis_main([
         "--all", "--root", REPO, "--plan-max-states", "1500",
@@ -80,7 +82,7 @@ def test_cli_all_exits_zero(capsys):
     assert rc == 0, out
     assert "lint:" in out and "specs:" in out
     assert "spmd:" in out and "plan:" in out and "shard:" in out
-    assert "race:" in out and "config:" in out
+    assert "race:" in out and "config:" in out and "dur:" in out
     assert elapsed < 40.0, f"analysis all took {elapsed:.1f}s"
 
 
@@ -1831,6 +1833,15 @@ def test_cli_json_output(capsys):
         rule_id for rule_id, _ in configcheck.CONFIG_RULES
     }
     assert all(n == 0 for n in doc["config"]["per_rule"].values())
+    # the dur document: findings gate PLUS the durability-surface
+    # trend keys the chaos matrix and dashboards consume
+    assert doc["dur"]["findings"] == []
+    assert doc["dur"]["persistence_points"] > 50
+    assert doc["dur"]["per_kind"]["wal"] >= 3
+    assert doc["dur"]["per_kind"]["persister"] >= 10
+    # per_rule counts fresh+suppressed: the six annotated in-tree
+    # debts stay on the trend line even though the gate is clean
+    assert sum(doc["dur"]["per_rule"].values()) == doc["dur"]["suppressed"]
 
 
 def test_cli_json_reports_findings(tmp_path, capsys):
@@ -2603,3 +2614,339 @@ def test_config_reference_doc_is_current():
         "docs/config-reference.md is stale — regenerate with "
         "`python -m dcos_commons_tpu.analysis config --docs`"
     )
+
+
+# -- durcheck: the repo gate ------------------------------------------
+
+
+def test_durcheck_repo_gate():
+    """Zero non-baselined crash-consistency findings across the
+    persistence layers — the dur baseline ships EMPTY, so every
+    effect-before-WAL window, unfenced write, and fsync-less file
+    persist in tree is either fixed or carries an inline
+    `# durcheck: <rule>=<reason>` rationale."""
+    result = durcheck.analyze_tree(REPO)
+    known = baseline_mod.load_baseline(baseline_mod.baseline_path(REPO))
+    fresh, _ = baseline_mod.apply_baseline(result.findings, known)
+    assert not fresh, "\n".join(f.render() for f in fresh)
+    assert not any("dur-" in k for k in known), \
+        "the dur baseline must stay empty: fix or annotate instead"
+    assert result.files_checked >= 50
+    # the durability surface the chaos matrix auto-derives from
+    assert len(result.persistence_points) > 50
+    kinds = {p.kind for p in result.persistence_points}
+    assert {"wal", "store", "property", "persister", "file"} <= kinds
+    # the deliberate in-tree debts (recovery-covered kill before the
+    # relaunch WAL, fence-injected raw persisters, telemetry mirrors)
+    # are annotated, not invisible
+    suppressed_rules = {f.rule for f in result.suppressed}
+    assert {"dur-effect-before-wal", "dur-unfenced-write",
+            "dur-file-discipline"} <= suppressed_rules
+
+
+def test_dur_rule_catalog_lists_every_rule():
+    catalog = durcheck.dur_rule_catalog()
+    for rule in durcheck.all_dur_rules():
+        assert rule.id in catalog
+
+
+# -- durcheck: per-rule fixtures (caught + suppressed) ----------------
+
+
+def _dur_fixture(files, rule_id):
+    """Run durcheck over in-memory (rel, source) fixture pairs;
+    returns (findings, suppressed) filtered to rule_id."""
+    triples = [
+        (f"/fix/{rel}", rel, textwrap.dedent(src))
+        for rel, src in files
+    ]
+    result = durcheck.analyze_paths(triples)
+    pick = lambda fs: [f for f in fs if f.rule == rule_id]  # noqa: E731
+    return pick(result.findings), pick(result.suppressed)
+
+
+def test_dur_rule_effect_before_wal():
+    src = """
+    class S:
+        def process(self, ops):
+            self.task_killer.kill("old-task")
+            self.ledger.commit(ops)
+    """
+    files = [("dcos_commons_tpu/scheduler/mod.py", src)]
+    findings, _ = _dur_fixture(files, "dur-effect-before-wal")
+    assert len(findings) == 1 and "kill" in findings[0].message
+    suppressed_src = src.replace(
+        "self.ledger.commit(ops)",
+        "# durcheck: dur-effect-before-wal=kill is recovery-covered\n"
+        "            self.ledger.commit(ops)",
+    )
+    findings, suppressed = _dur_fixture(
+        [("dcos_commons_tpu/scheduler/mod.py", suppressed_src)],
+        "dur-effect-before-wal",
+    )
+    assert not findings and len(suppressed) == 1
+
+
+def test_dur_effect_before_wal_is_path_sensitive():
+    # an effect on ONE branch taints the join: a persist-free branch
+    # never masks the ordering hazard (may-analysis)
+    branchy = """
+    class S:
+        def process(self, ops, cond):
+            if cond:
+                self.task_killer.kill("old-task")
+            self.ledger.commit(ops)
+    """
+    files = [("dcos_commons_tpu/scheduler/mod.py", branchy)]
+    findings, _ = _dur_fixture(files, "dur-effect-before-wal")
+    assert len(findings) == 1
+    # ...but a branch that TERMINATES after the effect does not flow
+    # to the join: kill-then-early-return is the fenced bail-out
+    # pattern, not an ordering hazard
+    terminated = branchy.replace(
+        'self.task_killer.kill("old-task")',
+        'self.task_killer.kill("old-task")\n                return',
+    )
+    files = [("dcos_commons_tpu/scheduler/mod.py", terminated)]
+    findings, _ = _dur_fixture(files, "dur-effect-before-wal")
+    assert not findings
+
+
+def test_dur_effect_before_wal_interprocedural_effects():
+    # the kill happens two calls away; the summary fixpoint carries
+    # it to the caller, where the flow walk sees it precede the WAL
+    src = """
+    class S:
+        def _evict(self, name):
+            self._reap(name)
+
+        def _reap(self, name):
+            self.task_killer.kill(name)
+
+        def process(self, ops):
+            self._evict("old")
+            self.ledger.commit(ops)
+    """
+    files = [("dcos_commons_tpu/scheduler/mod.py", src)]
+    findings, _ = _dur_fixture(files, "dur-effect-before-wal")
+    assert len(findings) == 1
+    assert "commit" in findings[0].message  # flagged AT the WAL site
+
+
+def test_dur_rule_replay_parity():
+    src = """
+    class S:
+        def save(self, store):
+            store.store_property("ghost-record", b"x")
+
+        def load(self, store):
+            return store.fetch_property("orphan-key")
+    """
+    files = [("dcos_commons_tpu/state/mod.py", src)]
+    findings, _ = _dur_fixture(files, "dur-replay-parity")
+    messages = " | ".join(f.message for f in findings)
+    assert len(findings) == 2
+    assert "ghost-record" in messages and "orphan-key" in messages
+    # pairing the keys clears both directions
+    paired = src.replace('"orphan-key"', '"ghost-record"')
+    findings, _ = _dur_fixture(
+        [("dcos_commons_tpu/state/mod.py", paired)], "dur-replay-parity"
+    )
+    assert not findings
+    # suppression: annotated write-only key (e.g. exported for an
+    # external consumer) is documented debt, not a finding
+    suppressed_src = src.replace(
+        'store.store_property("ghost-record", b"x")',
+        "# durcheck: dur-replay-parity=read by the fleet dashboard\n"
+        '            store.store_property("ghost-record", b"x")',
+    ).replace('"orphan-key"', '"ghost-record"')
+    findings, suppressed = _dur_fixture(
+        [("dcos_commons_tpu/state/mod.py", suppressed_src)],
+        "dur-replay-parity",
+    )
+    assert not findings
+
+
+def test_dur_replay_parity_journal_kinds():
+    # a kind-filtered query for a kind nothing appends is an orphan
+    # reader (typo'd query kind) — the generic events() consumer only
+    # satisfies the WRITE side
+    src = """
+    class S:
+        def emit(self):
+            self.journal.append("scale-up", count=3)
+
+        def recent(self):
+            return self.journal.events(kinds=("scale-upp",))
+    """
+    files = [("dcos_commons_tpu/health/mod.py", src)]
+    findings, _ = _dur_fixture(files, "dur-replay-parity")
+    assert len(findings) == 2  # typo'd filter + now-unread append
+    assert any("scale-upp" in f.message for f in findings)
+    fixed = src.replace('"scale-upp"', '"scale-up"')
+    findings, _ = _dur_fixture(
+        [("dcos_commons_tpu/health/mod.py", fixed)], "dur-replay-parity"
+    )
+    assert not findings
+
+
+def test_dur_rule_unfenced_write():
+    # a raw persister write OUTSIDE the lease-gated-mutation scope,
+    # reachable from scheduler-path code over the call graph — the
+    # site the single-file lint structurally cannot see
+    helper = """
+    class Backend:
+        def __init__(self, persister):
+            self._persister = persister
+
+        def store(self, raw):
+            self._persister.set("/journal", raw)
+    """
+    caller = """
+    def run_cycle(backend):
+        backend.store(b"x")
+    """
+    files = [
+        ("dcos_commons_tpu/health/helper.py", helper),
+        ("dcos_commons_tpu/scheduler/mod.py", caller),
+    ]
+    findings, _ = _dur_fixture(files, "dur-unfenced-write")
+    assert len(findings) == 1
+    assert findings[0].file == "dcos_commons_tpu/health/helper.py"
+    # cross-reference: the same raw write INSIDE the lint's scope is
+    # lease-gated-mutation's finding, never durcheck's — one site is
+    # never double-reported
+    files = [
+        ("dcos_commons_tpu/scheduler/helper.py", helper),
+        ("dcos_commons_tpu/scheduler/mod.py", caller),
+    ]
+    findings, _ = _dur_fixture(files, "dur-unfenced-write")
+    assert not findings
+    # ...and unreachable helpers are not findings: nothing scheduler-
+    # path can execute them
+    files = [("dcos_commons_tpu/health/helper.py", helper)]
+    findings, _ = _dur_fixture(files, "dur-unfenced-write")
+    assert not findings
+    # suppression with rationale
+    suppressed_src = helper.replace(
+        'self._persister.set("/journal", raw)',
+        "# durcheck: dur-unfenced-write=builder injects the fence\n"
+        '            self._persister.set("/journal", raw)',
+    )
+    files = [
+        ("dcos_commons_tpu/health/helper.py", suppressed_src),
+        ("dcos_commons_tpu/scheduler/mod.py", caller),
+    ]
+    findings, suppressed = _dur_fixture(files, "dur-unfenced-write")
+    assert not findings and len(suppressed) == 1
+
+
+def test_dur_rule_nonatomic_pair():
+    src = """
+    class Store:
+        def save(self, name):
+            self._persister.set(self._task_path(name, "info"), b"a")
+            self._persister.set(self._task_path(name, "status"), b"b")
+    """
+    files = [("dcos_commons_tpu/state/mod.py", src)]
+    findings, _ = _dur_fixture(files, "dur-nonatomic-pair")
+    assert len(findings) == 1 and "tear" in findings[0].message
+    # a generation bump between the writes makes the pair observable-
+    # safe (replayers reject the torn half)
+    bumped = src.replace(
+        'self._persister.set(self._task_path(name, "status"), b"b")',
+        "self._bump_generation(name)\n"
+        '            self._persister.set(self._task_path(name, "status"), b"b")',
+    )
+    findings, _ = _dur_fixture(
+        [("dcos_commons_tpu/state/mod.py", bumped)], "dur-nonatomic-pair"
+    )
+    assert not findings
+    # suppression
+    suppressed_src = src.replace(
+        'self._persister.set(self._task_path(name, "status"), b"b")',
+        "# durcheck: dur-nonatomic-pair=status replay tolerates tears\n"
+        '            self._persister.set(self._task_path(name, "status"), b"b")',
+    )
+    findings, suppressed = _dur_fixture(
+        [("dcos_commons_tpu/state/mod.py", suppressed_src)],
+        "dur-nonatomic-pair",
+    )
+    assert not findings and len(suppressed) == 1
+
+
+def test_dur_rule_file_discipline():
+    src = """
+    import os
+
+    def save(path, data):
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(data)
+        os.replace(tmp, path)
+    """
+    files = [("dcos_commons_tpu/utils/mod.py", src)]
+    findings, _ = _dur_fixture(files, "dur-file-discipline")
+    assert len(findings) == 1 and "fsync" in findings[0].message
+    fixed = src.replace(
+        "f.write(data)",
+        "f.write(data)\n"
+        "            f.flush()\n"
+        "            os.fsync(f.fileno())",
+    )
+    findings, _ = _dur_fixture(
+        [("dcos_commons_tpu/utils/mod.py", fixed)], "dur-file-discipline"
+    )
+    assert not findings
+    suppressed_src = src.replace(
+        'with open(tmp, "w") as f:',
+        "# durcheck: dur-file-discipline=telemetry mirror, loss ok\n"
+        '        with open(tmp, "w") as f:',
+    )
+    findings, suppressed = _dur_fixture(
+        [("dcos_commons_tpu/utils/mod.py", suppressed_src)],
+        "dur-file-discipline",
+    )
+    assert not findings and len(suppressed) == 1
+
+
+def test_dur_cli_subcommand_and_points(capsys):
+    """`analysis dur` gates; `analysis dur --points` dumps the
+    persistence-point map the chaos harness consumes."""
+    rc = analysis_main(["dur", "--root", REPO])
+    out = capsys.readouterr().out
+    assert rc == 0 and "dur:" in out
+    rc = analysis_main(["dur", "--points", "--root", REPO])
+    out = capsys.readouterr().out
+    assert rc == 0
+    doc = json.loads(out)
+    points = doc["persistence_points"]
+    assert len(points) > 50
+    assert all(
+        {"file", "line", "end_line", "kind", "function"} <= set(p)
+        for p in points
+    )
+    assert doc["per_kind"]["wal"] >= 3
+
+
+def test_dur_baseline_ownership(tmp_path):
+    """`--dur --update-baseline` owns only dur- entries: debt triaged
+    by other analyzers survives a dur-only rewrite verbatim."""
+    pkg = tmp_path / "dcos_commons_tpu" / "utils"
+    pkg.mkdir(parents=True)
+    (pkg / "writer.py").write_text(textwrap.dedent("""
+        import os
+
+        def save(path, data):
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(data)
+            os.replace(tmp, path)
+    """))
+    root = str(tmp_path)
+    rc = analysis_main(["--dur", "--update-baseline", "--root", root])
+    assert rc == 0
+    entries = baseline_mod.load_baseline(baseline_mod.baseline_path(root))
+    assert any("dur-file-discipline" in k for k in entries)
+    # the gate is clean against its own baseline
+    assert analysis_main(["--dur", "--root", root]) == 0
