@@ -1304,6 +1304,145 @@ def bench_preemption_recovery() -> dict:
     }
 
 
+def bench_multislice() -> dict:
+    """Multi-slice gang lifecycle (ISSUE 20) on a 10,000-host world.
+
+    One 2-slice x 4x4 elastic trainer gang (8 hosts over DCN) on a
+    fleet of 2,500 slices where exactly TWO slices match the gang's
+    generation — so a whole-slice preemption cannot re-place at full
+    width and MUST take the elastic whole-slice shrink path
+    (FakeAgent — control-plane latency, no jax):
+
+      multislice_deploy_s         spec PUT -> 8 workers RUNNING with
+                                  the cross-slice coordinator contract
+                                  (TPU_SLICE_COORDS et al) claimed —
+                                  slice-set placement over 10k hosts
+      multislice_shrink_resume_s  one whole slice preempted,
+                                  physically, statuses never arrive ->
+                                  converged at 1 slice (kill
+                                  survivors, unreserve, re-place
+                                  shrunken, trim) — "time to training
+                                  resumed at reduced width"
+      multislice_regrow_s         the dead slice's hosts return ->
+                                  converged back at declared width
+                                  (the manager's elastic-regrow
+                                  choreography)
+
+    Wall budgets are generous CI fences (shared boxes swing), not
+    perf claims: the point is that whole-slice elasticity converges
+    in control-plane time even on a 10k-host world."""
+    from dcos_commons_tpu.offer.inventory import make_test_fleet
+    from dcos_commons_tpu.testing.chaos import (
+        CHAOS_MULTISLICE_YAML,
+        PreemptSpec,
+        PreemptionStorm,
+        STORM_START,
+    )
+
+    def fleet():
+        hosts = []
+        for s in range(2):  # the only slices matching the gang
+            hosts.extend(make_test_fleet(
+                slice_id=f"gang-{s}", host_grid=(2, 2),
+                chip_block=(2, 2), generation="v5p",
+                cpus=16.0, memory_mb=65536,
+            ))
+        for s in range(2498):  # 9,992 filler hosts, wrong generation
+            hosts.extend(make_test_fleet(
+                slice_id=f"filler-{s}", host_grid=(2, 2),
+                chip_block=(2, 2), generation="v5e",
+                cpus=16.0, memory_mb=65536,
+            ))
+        return hosts
+
+    storm = PreemptionStorm(
+        [PreemptSpec(at=STORM_START, hosts=1, whole_slice=True)],
+        yaml_text=CHAOS_MULTISLICE_YAML.replace(
+            "generation: v5e", "generation: v5p"
+        ),
+        hosts=fleet(),
+    )
+    scheduler = storm.harness.build_scheduler()
+    storm.scheduler = scheduler
+    n_hosts = len(storm.harness.hosts)
+
+    # phase 1: the 2-slice deploy
+    t0 = time.monotonic()
+    deadline = t0 + 300.0
+    while time.monotonic() < deadline:
+        scheduler.run_cycle()
+        storm._ack_staging(scheduler)
+        if scheduler.deploy_manager.get_plan().is_complete:
+            break
+    deploy_s = time.monotonic() - t0
+    assert scheduler.deploy_manager.get_plan().is_complete, \
+        "2-slice deploy never completed"
+
+    # phase 2: one whole slice preempted mid-training -> shrink
+    t0 = time.monotonic()
+    storm.preempt_now(1, whole_slice=True)
+    shrink_cycles = 0
+    while time.monotonic() < deadline:
+        scheduler.run_cycle()
+        shrink_cycles += 1
+        for host_id in sorted(storm._unnotified):
+            scheduler.note_host_preempted(host_id)
+            storm._unnotified.discard(host_id)
+        storm._ack_staging(scheduler)
+        if storm._gang_converged(scheduler):
+            break
+    shrink_s = time.monotonic() - t0
+    stored = [
+        info for info in scheduler.state_store.fetch_tasks()
+        if info.pod_type == "trainer"
+    ]
+    assert len(stored) == 4, \
+        f"expected a 1-slice shrunken gang, got {len(stored)} workers"
+    verbs = [
+        e.get("verb")
+        for e in scheduler.journal.events(kinds=("recovery",))
+    ]
+    assert "elastic-shrink" in verbs, verbs
+
+    # phase 3: the dead slice returns -> regrow to declared width
+    for host_id in list(storm.report.preempted):
+        scheduler.inventory.mark_up(host_id)
+    t0 = time.monotonic()
+    regrow_cycles = 0
+    regrown = False
+    while time.monotonic() < deadline:
+        scheduler.run_cycle()
+        regrow_cycles += 1
+        storm._ack_staging(scheduler)
+        stored = [
+            info for info in scheduler.state_store.fetch_tasks()
+            if info.pod_type == "trainer"
+        ]
+        if len(stored) == 8 and storm._gang_converged(scheduler):
+            regrown = True
+            break
+    regrow_s = time.monotonic() - t0
+    assert regrown, "gang never regrew to declared width"
+    verbs = [
+        e.get("verb")
+        for e in scheduler.journal.events(kinds=("recovery",))
+    ]
+    assert "elastic-regrow" in verbs, verbs
+    storm.shutdown()
+
+    assert deploy_s < 120.0, f"2-slice deploy took {deploy_s:.1f}s"
+    assert shrink_s < 60.0, f"shrink-resume took {shrink_s:.1f}s"
+    assert regrow_s < 60.0, f"regrow took {regrow_s:.1f}s"
+    return {
+        "multislice_hosts": n_hosts,
+        "multislice_deploy_s": round(deploy_s, 3),
+        "multislice_shrink_resume_s": round(shrink_s, 3),
+        "multislice_shrink_cycles": shrink_cycles,
+        "multislice_regrow_s": round(regrow_s, 3),
+        "multislice_regrow_cycles": regrow_cycles,
+    }
+
+
 def bench_continuous_serve() -> dict:
     """Continuous batching vs dispatch-per-group serving (ISSUE 6),
     CPU-runnable: the SAME open-loop load — staggered arrivals, mixed
@@ -3704,6 +3843,14 @@ def main() -> None:
     except Exception as e:
         extras["preemption_error"] = repr(e)[:200]
     _mark("preemption_recovery")
+    # multi-slice gang lifecycle (ISSUE 20): 2-slice deploy on a 10k-
+    # host world, whole-slice preemption -> time-to-resumed-shrunken,
+    # capacity return -> time-to-regrown, journal verbs asserted
+    try:
+        extras.update(bench_multislice())
+    except Exception as e:
+        extras["multislice_error"] = repr(e)[:200]
+    _mark("multislice")
     # closed health->action loop (ISSUE 15): seeded SLO breach ->
     # time-to-scale-plan / time-to-recovered-SLO, quiet -> scale-in
     # with the pre-kill drain, zero flap asserted over the run

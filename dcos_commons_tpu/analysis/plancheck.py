@@ -994,6 +994,315 @@ def _gang_recovery_plan():
     return plan, world
 
 
+# -- the multi-slice recovery configuration (ISSUE 20) ----------------
+#
+# Models the whole-slice elastic choreography end to end: a
+# dcn-spanning gang loses one slice, the recovery plan shrinks to the
+# surviving slice (kill-survivors -> unreserve-dead-slice ->
+# replace-shrunken), and when slice capacity returns the manager's
+# regrow phase widens back to declared width (kill-shrunken ->
+# unreserve-shrunken -> replace-full).  THREE incarnations (old /
+# shrunken / full) share one fabric and one ledger, so the
+# gang-recovery invariants quantify over all of them:
+#
+#   no-split-brain-multislice   no older incarnation's process is
+#                               alive while a newer incarnation's
+#                               task runs (shrink AND regrow edges)
+#   no-double-slice-reservation two incarnations never hold committed
+#                               claims simultaneously
+#
+# The production manager synthesizes the regrow phase only once a
+# fresh slice registers; the model pre-builds both phases and gates
+# the regrow's first action (and the full-width launch) on the
+# ``slice-capacity-returns`` world event instead.  The
+# ``regrow_skips_kill`` knob exists ONLY for the seeded-bug fixture
+# in test_lint_gate: a regrow that relaunches the declared width
+# without first killing + unreserving the shrunken gang is caught by
+# both invariants with a minimal trace.
+
+
+class MultiSliceRecoveryWorld:
+    """Non-plan model state for the multislice-recovery config."""
+
+    # survivors of the OLD full-width incarnation on the live slice,
+    # and the shrunken replacement's width.  2 x 2 x six steps across
+    # two serial phases x the capacity bit lands ~40k states — well
+    # past the 10k repo-gate bar, untruncated under its 120k cap.
+    # Per-step interrupt verbs are OFF for this configuration (the
+    # phase/plan interrupts stay in the alphabet): six steps double
+    # six times and the space blows through the cap.
+    N_OLD = 2
+    N_SHRUNK = 2
+
+    def __init__(self, replace_shrunk, replace_full):
+        self.replace_shrunk = replace_shrunk
+        self.replace_full = replace_full
+        self.old_alive = frozenset(range(self.N_OLD))
+        self.shrunk_alive: frozenset = frozenset()
+        self.old_reserved = True
+        self.shrunk_reserved = False
+        self.full_reserved = False
+        self.capacity = False
+        # set once the regrow choreography arms: the production
+        # manager REPLACES the shrink phase with the regrow phase in
+        # its phase map, so the shrink replace step cannot relaunch
+        # afterwards — the model keeps both phases alive and fences
+        # the stale launch path with this bit instead
+        self.regrow_begun = False
+        self.launch_overrides = {
+            replace_shrunk.name: self._launch_shrunk,
+            replace_full.name: self._launch_full,
+        }
+        self._plan: Optional[Plan] = None
+
+    def bind(self, plan: Plan) -> "MultiSliceRecoveryWorld":
+        self._plan = plan
+        return self
+
+    # -- snapshot protocol -------------------------------------------
+
+    def snapshot(self) -> tuple:
+        return (
+            self.old_alive, self.shrunk_alive, self.old_reserved,
+            self.shrunk_reserved, self.full_reserved, self.capacity,
+            self.regrow_begun,
+        )
+
+    def restore(self, snap: tuple) -> None:
+        (self.old_alive, self.shrunk_alive, self.old_reserved,
+         self.shrunk_reserved, self.full_reserved, self.capacity,
+         self.regrow_begun) = snap
+
+    # -- model events -------------------------------------------------
+
+    def events(self, harness: "PlanHarness"):
+        out = []
+        for i in range(self.N_OLD):
+            # a surviving old worker dies at ANY point: kill ack or a
+            # second preemption landing mid-recovery (the storm case)
+            out.append((
+                f"old-task-dies({i})",
+                lambda i=i: self._die_old(i),
+            ))
+        for i in range(self.N_SHRUNK):
+            # a shrunken worker dies mid-regrow — the kill step's ack,
+            # or the surviving slice getting reclaimed too
+            out.append((
+                f"shrunk-task-dies({i})",
+                lambda i=i: self._die_shrunk(i),
+            ))
+        out.append((
+            "slice-capacity-returns", self._capacity_returns,
+        ))
+        return out
+
+    def _die_old(self, i: int) -> None:
+        self.old_alive = self.old_alive - {i}
+
+    def _die_shrunk(self, i: int) -> None:
+        self.shrunk_alive = self.shrunk_alive - {i}
+
+    def _capacity_returns(self) -> None:
+        self.capacity = True
+
+    def _launch_shrunk(self) -> None:
+        step = self.replace_shrunk
+        if step not in self._plan.candidates(set()):
+            return
+        # placement feasibility the model MUST keep: the shrunken
+        # gang targets the surviving slice, and an offer cycle
+        # declines while another incarnation's claims sit on those
+        # chips — without this, an operator restart of the completed
+        # shrink step after regrow would "place" over the full gang
+        if self.old_reserved or self.full_reserved:
+            return
+        if self.regrow_begun:
+            return  # the manager swapped phases: this step is stale
+        requirement = step.start()
+        if requirement is None:
+            return
+        self.shrunk_reserved = True
+        # a (re)launch is a fresh set of shrunken processes
+        self.shrunk_alive = frozenset(range(self.N_SHRUNK))
+        step.record_launch({
+            task: f"{task}__{_LIVE}"
+            for task in requirement.task_names()
+        })
+
+    def _launch_full(self) -> None:
+        step = self.replace_full
+        if step not in self._plan.candidates(set()):
+            return
+        if not self.capacity:
+            return  # no fresh slice registered: the offer declines
+        # deliberately NO claim-feasibility guard here (mirroring
+        # GangRecoveryWorld's launch): the invariant certifies the
+        # PLAN orders unreserve-shrunken before replace-full — the
+        # choreography must not lean on evaluator feasibility to
+        # avoid the double-commit
+        requirement = step.start()
+        if requirement is None:
+            return
+        self.full_reserved = True
+        step.record_launch({
+            task: f"{task}__{_LIVE}"
+            for task in requirement.task_names()
+        })
+
+    # -- model actions (close over self; ActionStep passes None) ------
+
+    def kill_old_survivors(self, _scheduler) -> bool:
+        return not self.old_alive
+
+    def unreserve_dead_slice(self, _scheduler) -> bool:
+        self.old_reserved = False
+        return True
+
+    def kill_shrunken_gang(self, _scheduler) -> bool:
+        # the regrow choreography arms only once a fresh slice
+        # registers (the manager's capacity probe), then completes
+        # when nothing shrunken is left running
+        if not self.capacity:
+            return False
+        self.regrow_begun = True
+        return not self.shrunk_alive
+
+    def unreserve_shrunken_claims(self, _scheduler) -> bool:
+        self.shrunk_reserved = False
+        return True
+
+    # -- invariants ----------------------------------------------------
+
+    def invariants(self) -> List["Invariant"]:
+        return [NoSplitBrainMultiSlice(), NoDoubleSliceReservation()]
+
+
+class NoSplitBrainMultiSlice(Invariant):
+    """No older incarnation's process survives while a newer
+    incarnation's task runs: old-vs-shrunken is the wedged-collective
+    guarantee from the single-slice configuration, and
+    shrunken-vs-full is the regrow edge — the widened gang re-forms
+    the dcn ring over the surviving slice's chips, so a leftover
+    shrunken worker there fights the full gang for its own fabric."""
+
+    name = "no-split-brain-multislice"
+
+    def on_state(self, harness):
+        world = harness.world
+        hazards = (
+            (world.old_alive, world.replace_shrunk, "old"),
+            (world.old_alive, world.replace_full, "old"),
+            (world.shrunk_alive, world.replace_full, "shrunken"),
+        )
+        for ghosts, step, label in hazards:
+            if not ghosts:
+                continue
+            running = [
+                task for task, state in step._task_states.items()
+                if state is TaskState.RUNNING
+            ]
+            if running:
+                return (
+                    f"{label} incarnation processes {sorted(ghosts)} "
+                    f"still alive while {step.name} runs "
+                    f"{sorted(running)}"
+                )
+        return None
+
+
+class NoDoubleSliceReservation(Invariant):
+    """At most one gang incarnation holds committed claims: the
+    shrink must release the dead span's rows before the shrunken
+    commit, and the regrow must release the shrunken rows before the
+    full-width commit — overlap double-counts the surviving slice's
+    chips in the ledger."""
+
+    name = "no-double-slice-reservation"
+
+    def on_state(self, harness):
+        world = harness.world
+        holders = [
+            label for label, held in (
+                ("old", world.old_reserved),
+                ("shrunken", world.shrunk_reserved),
+                ("full", world.full_reserved),
+            ) if held
+        ]
+        if len(holders) > 1:
+            return (
+                f"incarnations {holders} hold committed reservations "
+                "simultaneously"
+            )
+        return None
+
+
+def _multislice_recovery_plan(regrow_skips_kill: bool = False):
+    from dcos_commons_tpu.plan.strategy import SerialStrategy as _Serial
+
+    # the gang declares 2 slices x 2 hosts; the shrunken incarnation
+    # is the surviving slice's pair (instances 0/1) and the regrown
+    # full width relaunches EVERYTHING — the model tracks ONE
+    # fresh-slice worker (instance 2) for the full step so the two
+    # incarnations' status alphabets stay disjoint (production reuses
+    # names with fresh task ids; the model's __live suffix cannot
+    # carry that distinction) and the state space clears the repo
+    # gate untruncated; the hazards quantify over ANY running full
+    # task, so one representative is enough
+    shrunk_pod = PodSpec(
+        type="trainer",
+        count=2,
+        gang=True,
+        tasks=[TaskSpec(name="worker", goal=GoalState.RUNNING,
+                        cmd="train")],
+    )
+    full_pod = PodSpec(
+        type="trainer",
+        count=4,
+        gang=True,
+        tasks=[TaskSpec(name="worker", goal=GoalState.RUNNING,
+                        cmd="train")],
+    )
+    replace_shrunk = DeploymentStep(
+        "replace-shrunken-gang",
+        PodInstanceRequirement(pod=shrunk_pod, instances=[0, 1]),
+        backoff=ModelBackoff(),
+    )
+    replace_full = DeploymentStep(
+        "replace-full-gang",
+        PodInstanceRequirement(pod=full_pod, instances=[2]),
+        backoff=ModelBackoff(),
+    )
+    kill_old = ActionStep("kill-old-survivors", lambda s: False)
+    unreserve_old = ActionStep("unreserve-dead-slice", lambda s: False)
+    kill_shrunk = ActionStep("kill-shrunken-gang", lambda s: False)
+    unreserve_shrunk = ActionStep(
+        "unreserve-shrunken-gang", lambda s: False
+    )
+    world = MultiSliceRecoveryWorld(replace_shrunk, replace_full)
+    kill_old._action = world.kill_old_survivors
+    unreserve_old._action = world.unreserve_dead_slice
+    kill_shrunk._action = world.kill_shrunken_gang
+    unreserve_shrunk._action = world.unreserve_shrunken_claims
+    shrink = Phase(
+        "shrink-to-surviving-slice",
+        [kill_old, unreserve_old, replace_shrunk],
+        _Serial(),
+    )
+    regrow_steps = [replace_full] if regrow_skips_kill else [
+        kill_shrunk, unreserve_shrunk, replace_full,
+    ]
+    regrow = Phase(
+        "regrow-to-declared-width", regrow_steps, _Serial()
+    )
+    plan = Plan("recovery", [shrink, regrow], _Serial())
+    world.bind(plan)
+    return plan, world
+
+
+def _multislice_recovery_plan_strict():
+    return _multislice_recovery_plan()
+
+
 # -- the autoscale configuration (ISSUE 15) ---------------------------
 #
 # Models the closed health->action loop's no-flap algebra with the
@@ -1549,6 +1858,7 @@ BUILTIN_CONFIGS: Dict[str, Tuple[Callable[[], Plan], bool]] = {
     "dependency-dag": (_dependency_plan, False),
     "canary": (_canary_plan, True),
     "gang-recovery": (_gang_recovery_plan, True),
+    "multislice-recovery": (_multislice_recovery_plan_strict, False),
     "autoscale": (_autoscale_plan_strict, False),
     "migration": (_migration_plan_strict, True),
 }

@@ -677,8 +677,14 @@ def stepcompare(
     model held against a worker's steplog JSONL records (ISSUE 7).
 
     ``cost`` is a :func:`_cost_model` dict (or None when the mesh has
-    no collectives — a single chip); its wire floor is the CHEAPER of
-    the ring and all-gather spellings per step.  ``floor_us`` is the
+    no collectives — a single chip); its wire floor sums, PER AXIS,
+    the cheaper of the ring and all-gather spellings — each axis's
+    collective picks its own spelling independently, and the dcn
+    entry rides the DCN bandwidth table, so a multi-slice gang's
+    floor includes its cross-slice gradient leg instead of letting
+    the slow DCN hop hide inside a whole-model min (ISSUE 20: the
+    gate would otherwise read an honest multi-slice step as a
+    regression — or a regressed one as fine).  ``floor_us`` is the
     caller's calibrated compute floor (the cost model speaks only for
     the interconnect; bench_train_step calibrates compute by running
     the bare device loop).  ``records`` are steplog dicts — ``wall_s``
@@ -713,15 +719,24 @@ def stepcompare(
         if isinstance(r.get("blocked_s"), (int, float))
     )
     wire_us = 0.0
+    dcn_wire_us = 0.0
     if cost and cost.get("per_step"):
-        wire_us = min(
-            float(cost.get("total_ring_us", 0.0)),
-            float(cost.get("total_allgather_us", 0.0)),
-        )
+        # per-axis cheaper-of: each collective runs ONE spelling, so
+        # the floor is the sum of per-axis minima (<= the min of the
+        # whole-model sums — the gate only loosens for old specs)
+        for e in cost["per_step"]:
+            leg = min(
+                float(e.get("ring_us", 0.0)),
+                float(e.get("allgather_us", 0.0)),
+            )
+            wire_us += leg
+            if e.get("axis") == "dcn":
+                dcn_wire_us += leg
     predicted_floor_us = wire_us + max(0.0, float(floor_us))
     out: Dict[str, Any] = {
         "steps": len(walls),
         "predicted_wire_us": round(wire_us, 1),
+        "predicted_wire_dcn_us": round(dcn_wire_us, 1),
         "compute_floor_us": round(float(floor_us), 1),
         "predicted_floor_us": round(predicted_floor_us, 1),
         "slack": slack,
@@ -861,6 +876,26 @@ def mesh_span_message(where: str, declared: int, total: int,
         + ("reserved chips sit idle" if declared > total
            else "the workload cannot get the chips it lays")
     )
+
+
+def fleet_slice_count(inventory, generation: str) -> Optional[int]:
+    """Distinct registered slices of ``generation`` TPU hosts — the
+    one formula the multi-slice admission gate sizes `tpu: slices: N`
+    against (multi/admission.py).  None when the inventory holds no
+    TPU hosts at all (scheduler bootstrap): sizing against an empty
+    fleet would reject every multi-slice spec exactly when
+    registration must not depend on fleet availability."""
+    if inventory is None:
+        return None
+    slices = set()
+    any_tpu = False
+    for host in inventory.hosts():
+        if not host.generation:
+            continue
+        any_tpu = True
+        if host.generation == generation:
+            slices.add(host.slice_id)
+    return len(slices) if any_tpu else None
 
 
 def _analyze_pod_task(

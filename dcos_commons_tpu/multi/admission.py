@@ -97,6 +97,7 @@ def validate_service_yaml(
         ))
     if spec is not None:
         findings += _mesh_findings(rel, lines, spec)
+        findings += _multislice_findings(rel, lines, spec, inventory)
     return spec, findings
 
 
@@ -109,7 +110,9 @@ def check_rendered_spec(rel: str, lines, spec, inventory=None) -> List[Finding]:
     return check_spec_lines(
         rel, lines, spec, None, host_models_for(inventory),
         apply_suppressions=False, feasibility_hint=_FEASIBILITY_HINT,
-    ) + _mesh_findings(rel, lines, spec)
+    ) + _mesh_findings(rel, lines, spec) + _multislice_findings(
+        rel, lines, spec, inventory
+    )
 
 
 def _mesh_findings(rel: str, lines, spec) -> List[Finding]:
@@ -167,6 +170,78 @@ def _mesh_findings(rel: str, lines, spec) -> List[Finding]:
                 rel, anchor(pod.type), "shard-mesh",
                 mesh_span_message(where, declared, workload.mesh.total,
                                   f"{workload.script}'s mesh"),
+            ))
+    return findings
+
+
+def _multislice_findings(rel: str, lines, spec, inventory) -> List[Finding]:
+    """The `tpu: slices: N` admission gate (ISSUE 20): a multi-slice
+    spec is rejected at PUT when
+
+    * its declared chip span disagrees with slices x hosts-per-slice
+      x chips-per-host (the gang could never claim what it reserves),
+    * its derived mesh lacks the dcn axis (the worker would lay a
+      single-slice mesh over a slice boundary — gradient collectives
+      silently riding DCN as if it were ICI), or
+    * the fleet registers fewer ``generation`` slices than the spec
+      spans (the deploy plan would wait forever; fleet sizing uses
+      the same one-formula helper as CI, shardcheck's
+      ``fleet_slice_count``).
+
+    Findings anchor to the pod's declaring line, rule ``multislice``.
+    Sizing is SKIPPED (like scalar feasibility) while the inventory
+    registers no TPU hosts at all — bootstrap must not reject specs.
+    """
+    from dcos_commons_tpu.analysis.shardcheck import (
+        _make_anchor,
+        declared_chips,
+        fleet_slice_count,
+    )
+    from dcos_commons_tpu.parallel.mesh import derive
+    from dcos_commons_tpu.specification.specs import SpecError
+
+    findings: List[Finding] = []
+    multi = [
+        pod for pod in spec.pods
+        if pod.tpu is not None and pod.tpu.slices > 1
+    ]
+    if not multi:
+        return findings
+    anchor = _make_anchor(lines)
+    for pod in multi:
+        tpu = pod.tpu
+        where = f"pod {pod.type!r}"
+        span = pod.count * tpu.chips_per_host
+        if span != declared_chips(pod):
+            findings.append(Finding(
+                rel, anchor(pod.type), "multislice",
+                f"{where}: count x chips-per-host spans {span} chip(s) "
+                f"but slices x topology declares {declared_chips(pod)} "
+                f"({tpu.slices} slice(s) of {tpu.topology or '?'})",
+            ))
+            continue
+        try:
+            mesh = derive(tpu.mesh_env())
+        except SpecError as e:
+            findings.append(Finding(
+                rel, anchor(pod.type), "multislice", f"{where}: {e}"
+            ))
+            continue
+        if mesh.dcn != tpu.slices:
+            findings.append(Finding(
+                rel, anchor(pod.type), "multislice",
+                f"{where}: {tpu.slices} slices declared but the derived "
+                f"mesh lays dcn={mesh.dcn} — cross-slice collectives "
+                "would not ride the dcn axis",
+            ))
+            continue
+        registered = fleet_slice_count(inventory, tpu.generation)
+        if registered is not None and registered < tpu.slices:
+            findings.append(Finding(
+                rel, anchor(pod.type), "multislice",
+                f"{where}: spans {tpu.slices} slices but the fleet "
+                f"registers only {registered} {tpu.generation} "
+                "slice(s)",
             ))
     return findings
 

@@ -29,13 +29,18 @@ from dcos_commons_tpu.offer.ledger import (
     ReservationLedger,
     new_reservation_id,
 )
+from dcos_commons_tpu.offer.multislice import (
+    ENV_TPU_SLICE_COORDS,
+    SLICE_COORDINATOR_PORT_NAME,
+    eligible_slice_ids,
+    place_slice_set,
+)
 from dcos_commons_tpu.offer.outcome import EvaluationOutcome
 from dcos_commons_tpu.offer.placement import (
     PlacementContext,
     PlacementRule,
     parse_placement,
 )
-from dcos_commons_tpu.offer.torus import find_subslice
 from dcos_commons_tpu.plan.step import PodInstanceRequirement, RecoveryType
 from dcos_commons_tpu.specification.specs import (
     PodSpec,
@@ -460,6 +465,11 @@ class OfferEvaluator:
         # position.
         n_slices = pod.tpu.slices if pod.tpu is not None else 1
         hosts_per_slice = max(1, pod.count // max(1, n_slices))
+        slice_coords: List[str] = []
+        if n_slices > 1:
+            slice_coords = self._existing_slice_coords(
+                requirement, inventory, n_slices, hosts_per_slice
+            )
         task_infos = []
         for index, host_id, reservations in placements:
             worker_id = index
@@ -469,12 +479,18 @@ class OfferEvaluator:
                     ENV_TPU_SLICE_INDEX: str(index // hosts_per_slice),
                     ENV_TPU_NUM_SLICES: str(n_slices),
                 }
+                if slice_coords:
+                    slice_env[ENV_TPU_SLICE_COORDS] = ",".join(slice_coords)
             host = inventory.host(host_id)
             for task_name in requirement.tasks_to_launch:
                 task_spec = requirement.pod.task(task_name)
                 full = task_full_name(requirement.pod.type, index, task_name)
-                task_res = [r for r in reservations if r.task_name == full
-                            and r.container_path != COORDINATOR_PORT_NAME]
+                task_res = [
+                    r for r in reservations if r.task_name == full
+                    and r.container_path not in (
+                        COORDINATOR_PORT_NAME, SLICE_COORDINATOR_PORT_NAME
+                    )
+                ]
                 # rebuild the PORT_* env contract from the reservation's
                 # port list (appended in spec order at claim time)
                 port_env: Dict[str, str] = {}
@@ -609,6 +625,36 @@ class OfferEvaluator:
                 return ""
         return ""
 
+    def _existing_slice_coords(
+        self, requirement: PodInstanceRequirement, inventory,
+        n_slices: int, hosts_per_slice: int,
+    ) -> List[str]:
+        """Rebuild the per-slice coordinator address list from the
+        slice leaders' SLICE_COORDINATOR_PORT_NAME reservations (the
+        multi-slice analogue of ``_existing_coordinator``).  An empty
+        list means some leader's claim is gone — the caller omits
+        TPU_SLICE_COORDS rather than advertise a partial set."""
+        coords: List[str] = []
+        for k in range(n_slices):
+            leader = k * hosts_per_slice
+            addr = ""
+            for r in self._ledger.for_task(
+                task_full_name(
+                    requirement.pod.type, leader,
+                    requirement.tasks_to_launch[0],
+                )
+            ):
+                if r.container_path == SLICE_COORDINATOR_PORT_NAME \
+                        and r.ports:
+                    host = inventory.host(r.host_id)
+                    if host is not None:
+                        addr = _coordinator_address(host, r.ports[0])
+                    break
+            if not addr:
+                return []
+            coords.append(addr)
+        return coords
+
     # -- fresh placement ----------------------------------------------
 
     def _evaluate_gang(
@@ -635,41 +681,16 @@ class OfferEvaluator:
             return EvaluationOutcome.ok(f"host:{snap.host.host_id}")
 
         if index is not None:
-            # torus-neighborhood pre-filter: a contiguous rectangle of
-            # tx*ty chips needs hosts_needed FULLY-FREE hosts inside
-            # one slice, so slices short of that can be skipped before
-            # any anchor search.  The whole slice's hosts (not just
-            # the free ones) are forwarded — the anchor grid's extent
-            # must come from slice membership, never the free subset.
+            # slice-set pre-filter (offer/multislice.py): slices that
+            # cannot hold even one fully-free `topology` rectangle are
+            # skipped before any anchor search
             total_chips = 1
             for d in pod.tpu.topology_dims():
                 total_chips *= d
-            # per-slice host need comes from the HOSTS' chip blocks
-            # (find_subslice tiles by host block, not by the spec's
-            # declared chips-per-host — a mis-declared spec must not
-            # under-approximate here).  Max block area among the
-            # slice's free hosts keeps the filter superset-sound when
-            # blocks are mixed (mixed slices fail the search anyway).
-            hosts = ctx.hosts
-            eligible_slices = set()
-            # the "" bucket (TPU hosts registered without a slice id)
-            # is a searchable slice like any other — find_subslice
-            # groups such hosts under slice "" and can place a gang
-            # there, so skipping it would under-approximate
-            for s, free in index.fully_free_by_slice().items():
-                if not free:
-                    continue
-                area = max(
-                    (
-                        hosts[h].chips_per_host
-                        for h in free if h in hosts
-                    ),
-                    default=0,
-                )
-                if area <= 0:
-                    continue
-                if len(free) >= max(1, -(-total_chips // area)):
-                    eligible_slices.add(s)
+            eligible_slices = eligible_slice_ids(
+                index, ctx.hosts, total_chips,
+                generation=pod.tpu.generation,
+            )
             if eligible_slices:
                 slice_index = index.value_index("slice")
                 candidate_ids: set = set()
@@ -685,35 +706,17 @@ class OfferEvaluator:
 
         # multi-slice gangs (tpu: slices: N): N slice-local sub-gangs,
         # one contiguous `topology` rectangle in each of N DISTINCT
-        # slices.  Workers are numbered slice-major; every worker gets
+        # slices, all on one DCN pool (offer/multislice.py).  Workers
+        # are numbered slice-major; every worker gets
         # TPU_SLICE_INDEX/TPU_NUM_SLICES so the mesh layer lays the dcn
         # (data-parallel-across-slices) axis over the slice boundary
         # and keeps tp/sp collectives on ICI (scaling-book recipe).
         n_slices = pod.tpu.slices
-        ordered: List[ResourceSnapshot] = []
-        used_slices: set = set()
-        outcome = EvaluationOutcome.ok(
-            "gang", f"{n_slices} slice(s) of {pod.tpu.topology}"
-        )
-        for _ in range(n_slices):
-            candidates = [
-                s for s in snapshots if s.host.slice_id not in used_slices
-            ]
-            placement = find_subslice(
-                candidates, pod.tpu.topology_dims(), pod.tpu.chips_per_host,
-                eligible,
-            )
-            outcome.children.append(placement.outcome)
-            if not placement.snapshots:
-                outcome.passed = False
-                outcome.reason = (
-                    f"no free slice for sub-gang "
-                    f"{len(used_slices) + 1}/{n_slices} "
-                    f"(excluded: {sorted(used_slices) or 'none'})"
-                )
-                return EvaluationResult(False, outcome)
-            used_slices.add(placement.snapshots[0].host.slice_id)
-            ordered.extend(placement.snapshots)
+        placement = place_slice_set(snapshots, pod.tpu, eligible)
+        outcome = placement.outcome
+        if not placement.ok:
+            return EvaluationResult(False, outcome)
+        ordered = placement.snapshots
         if len(ordered) != len(requirement.instances):
             outcome.passed = False
             outcome.reason = (
@@ -724,11 +727,31 @@ class OfferEvaluator:
 
         # worker 0's host (slice 0) carries the jax.distributed
         # coordinator for the WHOLE multi-slice gang: one global
-        # rendezvous, slice-local ICI + cross-slice DCN under one mesh
+        # rendezvous, slice-local ICI + cross-slice DCN under one
+        # mesh.  Each slice leader (worker k*hosts_per_slice)
+        # additionally carries a slice-local rendezvous port; the full
+        # slice-major address list is advertised to every worker as
+        # TPU_SLICE_COORDS.  Ports are probed on snapshot COPIES here
+        # and re-allocated identically at claim time — both walks
+        # start from the same committed snapshot state, so the claim
+        # is deterministic (the established coordinator idiom).
         coord_snap = ordered[0]
-        coord_port = coord_snap.copy().allocate_port()
+        probe = coord_snap.copy()
+        coord_port = probe.allocate_port()
         coordinator = _coordinator_address(coord_snap.host, coord_port)
-        hosts_per_slice = len(ordered) // n_slices
+        hosts_per_slice = placement.hosts_per_slice
+        slice_coords: List[str] = []
+        if n_slices > 1:
+            for k in range(n_slices):
+                leader = ordered[k * hosts_per_slice]
+                # slice 0's leader already allocated the global
+                # coordinator port on `probe` — reuse that walk so the
+                # second allocation cannot collide with the first
+                leader_probe = probe if k == 0 else leader.copy()
+                slice_port = leader_probe.allocate_port()
+                slice_coords.append(
+                    _coordinator_address(leader.host, slice_port)
+                )
 
         reservations: List[Reservation] = []
         task_infos: List[TaskInfo] = []
@@ -745,15 +768,21 @@ class OfferEvaluator:
                     ),
                 )
             slice_env = {}
+            slice_coordinator = ""
             if n_slices > 1:
                 slice_env = {
                     ENV_TPU_SLICE_INDEX: str(worker_id // hosts_per_slice),
                     ENV_TPU_NUM_SLICES: str(n_slices),
+                    ENV_TPU_SLICE_COORDS: ",".join(slice_coords),
                 }
+                if worker_id % hosts_per_slice == 0:
+                    slice_coordinator = slice_coords[
+                        worker_id // hosts_per_slice
+                    ]
             res, infos = self._claim_instance(
                 requirement, index_i, work, chips, coordinator,
                 coordinator_here=(worker_id == 0), worker_id=worker_id,
-                extra_env=slice_env,
+                extra_env=slice_env, slice_coordinator=slice_coordinator,
             )
             if res is None:
                 return EvaluationResult(
@@ -894,9 +923,15 @@ class OfferEvaluator:
         coordinator_here: bool,
         worker_id: int,
         extra_env: Optional[Dict[str, str]] = None,
+        slice_coordinator: str = "",
     ):
         """Consume scalars/ports on ``work`` and emit reservations +
-        TaskInfos for every task of one pod instance."""
+        TaskInfos for every task of one pod instance.
+
+        ``slice_coordinator`` (multi-slice gangs, slice leaders only)
+        is this host's slice-local rendezvous address: its port is
+        claimed here under SLICE_COORDINATOR_PORT_NAME, riding the
+        first task's resource ids like the global coordinator port."""
         pod = requirement.pod
         reservations: List[Reservation] = []
         task_infos: List[TaskInfo] = []
@@ -904,13 +939,13 @@ class OfferEvaluator:
         # volume keys shared across the tasks claimed in THIS call
         # (ledger lookups only see already-committed siblings)
         instance_volumes: Dict[str, str] = {}
-        coord_res: Optional[Reservation] = None
+        anchor_res: List[Reservation] = []
         if coordinator_here:
             coord_port = work.allocate_port(int(coordinator.rsplit(":", 1)[1]))
             if coord_port is None:
                 coord_port = work.allocate_port()
                 coordinator = _coordinator_address(work.host, coord_port)
-            coord_res = Reservation(
+            anchor_res.append(Reservation(
                 reservation_id=new_reservation_id(),
                 host_id=work.host.host_id,
                 task_name=task_full_name(
@@ -919,8 +954,24 @@ class OfferEvaluator:
                 cpus=0.0,
                 ports=[coord_port],
                 container_path=COORDINATOR_PORT_NAME,
+            ))
+        if slice_coordinator:
+            slice_port = work.allocate_port(
+                int(slice_coordinator.rsplit(":", 1)[1])
             )
-            reservations.append(coord_res)
+            if slice_port is None:
+                slice_port = work.allocate_port()
+            anchor_res.append(Reservation(
+                reservation_id=new_reservation_id(),
+                host_id=work.host.host_id,
+                task_name=task_full_name(
+                    pod.type, index, requirement.tasks_to_launch[0]
+                ),
+                cpus=0.0,
+                ports=[slice_port],
+                container_path=SLICE_COORDINATOR_PORT_NAME,
+            ))
+        reservations.extend(anchor_res)
         disk_seen_paths: set = set()
         for task_name in requirement.tasks_to_launch:
             task_spec = pod.task(task_name)
@@ -963,12 +1014,13 @@ class OfferEvaluator:
                 volumes=volumes,
             )
             reservations.append(reservation)
-            # the coordinator-port claim rides on the first task's
-            # resource ids so reservation GC (which keeps every id
-            # referenced by a stored TaskInfo) never reclaims it
+            # the coordinator-port claims (global and slice-local)
+            # ride on the first task's resource ids so reservation GC
+            # (which keeps every id referenced by a stored TaskInfo)
+            # never reclaims them
             info_res = [reservation]
-            if coord_res is not None and not task_infos:
-                info_res.append(coord_res)
+            if anchor_res and not task_infos:
+                info_res.extend(anchor_res)
             info = self._build_task_info(
                 requirement, task_spec, index, work.host,
                 # chips follow the RESERVATION holder: only the task

@@ -1,0 +1,195 @@
+"""Multi-slice gang placement: the slice-set layer (ISSUE 20).
+
+A ``tpu: slices: N`` gang spans N ICI slices joined by DCN — the
+scale axis a single torus cannot reach (SURVEY section 5.8 inter-slice
+DCN collectives).  This module owns the SLICE-SET half of gang
+placement:
+
+* :func:`eligible_slice_ids` — the PR 9 fully-free-by-slice
+  pre-filter, factored out of the evaluator: slices that cannot hold
+  even ONE ``topology`` rectangle of fully-free hosts are skipped
+  before any anchor search (superset-sound — the per-slice host need
+  comes from the hosts' own chip blocks, never the declared spec).
+* :func:`place_slice_set` — pick N DISTINCT slices, one contiguous
+  ``topology`` rectangle in each (torus adjacency within a slice via
+  ``find_subslice``), all N reachable over one DCN fabric (the
+  ``dcn_pool`` host attribute; hosts that advertise none share the
+  default pool).  Workers are numbered slice-major so
+  ``worker_id // hosts_per_slice`` IS the slice index — the mesh
+  layer's dcn axis falls exactly on the slice boundary.
+* :func:`slice_leaders` — the per-slice coordinator anchors: slice
+  k's first worker hosts slice k's rendezvous endpoint, advertised to
+  every worker as ``TPU_SLICE_COORDS`` (the global jax.distributed
+  coordinator stays on worker 0; the per-slice addresses give
+  slice-local barriers and the dcn gradient ring a stable anchor per
+  slice).
+
+The evaluator (offer/evaluate.py ``_evaluate_gang``) calls this layer
+for EVERY gang — a single-slice gang is the N=1 case — then claims
+resources host by host exactly as before.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Set, Tuple
+
+from dcos_commons_tpu.offer.outcome import EvaluationOutcome
+from dcos_commons_tpu.offer.torus import find_subslice
+
+# fleet attribute naming the DCN fabric a slice is plugged into; a
+# multi-slice gang's slices must share one pool (cross-pool traffic
+# would transit a slower backbone the bandwidth model does not price).
+# Hosts without the attribute share the DEFAULT ("") pool, so fleets
+# that never set it behave as one flat fabric.
+DCN_POOL_ATTRIBUTE = "dcn_pool"
+
+# env var carrying the per-slice coordinator addresses, comma-joined
+# slice-major ("host0:p0,host1:p1,..."): claim-time facts, injected by
+# the evaluator next to TPU_SLICE_INDEX/TPU_NUM_SLICES
+ENV_TPU_SLICE_COORDS = "TPU_SLICE_COORDS"
+
+# reservation tag for the slice-local rendezvous port riding each
+# slice leader's first task (mirrors COORDINATOR_PORT_NAME)
+SLICE_COORDINATOR_PORT_NAME = "slice-coordinator"
+
+
+def dcn_pool_of(host) -> str:
+    """The DCN fabric a host belongs to ("" = the default pool)."""
+    return (getattr(host, "attributes", None) or {}).get(
+        DCN_POOL_ATTRIBUTE, ""
+    )
+
+
+def hosts_per_slice(tpu) -> int:
+    """Hosts one ``topology`` sub-slice occupies — the slice quantum
+    every multi-slice size computation shares (admission, elastic
+    shrink, trim, worker numbering)."""
+    return max(1, tpu.total_chips // max(1, tpu.chips_per_host))
+
+
+def eligible_slice_ids(index, hosts: Dict[str, object], total_chips: int,
+                       generation: str = "") -> Set[str]:
+    """Slices that could hold ONE fully-free ``total_chips`` rectangle
+    of ``generation`` hosts (any generation when "").
+
+    Torus-neighborhood pre-filter (PR 9): a contiguous rectangle of
+    tx*ty chips needs hosts_needed FULLY-FREE hosts inside one slice,
+    so slices short of that are skipped before any anchor search.
+    The per-slice host need comes from the HOSTS' chip blocks
+    (find_subslice tiles by host block, not by the spec's declared
+    chips-per-host — a mis-declared spec must not under-approximate).
+    Max block area among the slice's free hosts keeps the filter
+    superset-sound when blocks are mixed (mixed slices fail the search
+    anyway).  The "" bucket (TPU hosts registered without a slice id)
+    is a searchable slice like any other.
+    """
+    eligible: Set[str] = set()
+    for s, free in index.fully_free_by_slice().items():
+        if generation:
+            # the spec's generation is a hard placement fact (the
+            # fleet-sizing and admission formulas count per-generation
+            # slices; the evaluator must agree or admission admits
+            # specs that place on the wrong silicon)
+            free = [
+                h for h in free
+                if h in hosts and hosts[h].generation == generation
+            ]
+        if not free:
+            continue
+        area = max(
+            (hosts[h].chips_per_host for h in free if h in hosts),
+            default=0,
+        )
+        if area <= 0:
+            continue
+        if len(free) >= max(1, -(-total_chips // area)):
+            eligible.add(s)
+    return eligible
+
+
+@dataclass
+class SliceSetPlacement:
+    """Result of :func:`place_slice_set`: slice-major ordered host
+    snapshots (worker k lives on ``snapshots[k]``) or a failure
+    outcome explaining every slice's refusal."""
+
+    outcome: EvaluationOutcome
+    snapshots: List = field(default_factory=list)
+    slice_ids: Tuple[str, ...] = ()
+    hosts_per_slice: int = 0
+
+    @property
+    def ok(self) -> bool:
+        return bool(self.snapshots)
+
+
+def place_slice_set(
+    snapshots: List,
+    tpu,
+    eligible: Callable[[object], EvaluationOutcome],
+) -> SliceSetPlacement:
+    """Pick ``tpu.slices`` distinct slices, one ``topology`` rectangle
+    each, all of the spec's ``generation``, all on one DCN pool.
+
+    Greedy first-fit in scan order (deterministic, like every other
+    placement path): the first sub-slice pins the gang's DCN pool,
+    and subsequent searches only see hosts of that pool.  Greedy
+    pool-pinning is sound for the fleets this models — pools partition
+    slices, and scan order visits every pool's slices, so if ANY pool
+    holds N free slices a permutation of the same greedy scan finds
+    it; the failure outcome names the pinned pool so an operator can
+    read why a half-free fleet refused.
+    """
+    n_slices = max(1, tpu.slices)
+    generation = getattr(tpu, "generation", "") or ""
+    ordered: List = []
+    used_slices: Set[str] = set()
+    pool: str = ""
+    pool_pinned = False
+    outcome = EvaluationOutcome.ok(
+        "gang", f"{n_slices} slice(s) of {tpu.topology}"
+    )
+    for _ in range(n_slices):
+        candidates = [
+            s for s in snapshots
+            if s.host.slice_id not in used_slices
+            and (not generation or s.host.generation == generation)
+            and (not pool_pinned or dcn_pool_of(s.host) == pool)
+        ]
+        placement = find_subslice(
+            candidates, tpu.topology_dims(), tpu.chips_per_host, eligible
+        )
+        outcome.children.append(placement.outcome)
+        if not placement.snapshots:
+            outcome.passed = False
+            where = (
+                f" on dcn pool {pool or 'default'}" if pool_pinned else ""
+            )
+            outcome.reason = (
+                f"no free slice for sub-gang "
+                f"{len(used_slices) + 1}/{n_slices}{where} "
+                f"(excluded: {sorted(used_slices) or 'none'})"
+            )
+            return SliceSetPlacement(outcome)
+        anchor = placement.snapshots[0].host
+        used_slices.add(anchor.slice_id)
+        if n_slices > 1 and not pool_pinned:
+            pool = dcn_pool_of(anchor)
+            pool_pinned = True
+        ordered.extend(placement.snapshots)
+    return SliceSetPlacement(
+        outcome,
+        ordered,
+        tuple(sorted(used_slices)),
+        len(ordered) // n_slices,
+    )
+
+
+def slice_leaders(ordered: List, n_slices: int) -> List:
+    """The slice-major leader snapshot of each sub-slice: worker
+    ``k * hosts_per_slice`` anchors slice k's coordinator endpoint."""
+    if n_slices <= 1 or not ordered:
+        return []
+    hps = len(ordered) // n_slices
+    return [ordered[k * hps] for k in range(n_slices)]

@@ -27,6 +27,19 @@ def initialize_from_env(
     COORDINATOR_ADDRESS) skip initialization — jax runs locally.
     """
     env = env if env is not None else os.environ
+    # per-slice coordinator addressing (multi-slice gangs, ISSUE 20):
+    # TPU_SLICE_COORDS lists each slice's rendezvous anchor
+    # slice-major; a worker's own slice anchor is slice_coords[
+    # slice_index].  The GLOBAL jax.distributed rendezvous stays the
+    # single COORDINATOR_ADDRESS — one process group spanning every
+    # slice, dcn collectives riding DCN — while the slice anchors give
+    # slice-local tooling (per-slice barriers, dcn ring debugging) a
+    # stable address without re-deriving placement.
+    slice_coords = [
+        a for a in env.get("TPU_SLICE_COORDS", "").split(",") if a
+    ]
+    num_slices = int(env.get("TPU_NUM_SLICES", "1") or 1)
+    slice_index = int(env.get("TPU_SLICE_INDEX", "0") or 0)
     contract = {
         "coordinator": env.get("COORDINATOR_ADDRESS", ""),
         "worker_id": int(env.get("TPU_WORKER_ID", "0") or 0),
@@ -37,10 +50,23 @@ def initialize_from_env(
         "chips_per_host": int(env.get("TPU_CHIPS_PER_HOST", "0") or 0),
         "topology": env.get("TPU_TOPOLOGY", ""),
         "generation": env.get("TPU_GENERATION", ""),
+        "num_slices": num_slices,
+        "slice_index": slice_index,
+        "hosts_per_slice": int(env.get("TPU_HOSTS_PER_SLICE", "0") or 0),
+        "slice_coords": slice_coords,
+        "slice_coordinator": (
+            slice_coords[slice_index]
+            if 0 <= slice_index < len(slice_coords) else ""
+        ),
     }
     if contract["worker_count"] > 1 and contract["coordinator"]:
         import jax
 
+        if num_slices > 1:
+            LOG.info(
+                "multi-slice gang: slice %d/%d, slice coords %s",
+                slice_index, num_slices, ",".join(slice_coords) or "n/a",
+            )
         LOG.info(
             "jax.distributed.initialize(%s, %d/%d)",
             contract["coordinator"],

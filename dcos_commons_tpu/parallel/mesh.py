@@ -164,7 +164,12 @@ def elastic_reshard_ok(old: MeshSpec, new: MeshSpec) -> bool:
     changing (tp/sp/pp/ep/fsdp) would change leaf SHARDS, and the
     host-gathered npz checkpoint would silently restore a different
     parallelism than the step function expects.  The worker refuses
-    that resume loudly instead."""
+    that resume loudly instead.
+
+    A whole-slice drop or regrow (ISSUE 20 multi-slice elasticity)
+    is exactly a dcn change — the per-slice topology, and with it
+    every model axis, is untouched — so it rides this rule with no
+    special case."""
     return (
         old.tp == new.tp
         and old.sp == new.sp

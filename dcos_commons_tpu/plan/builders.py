@@ -121,12 +121,14 @@ class DeployPlanFactory:
         the fresh step (reference: DefaultStepFactory.getStatus)."""
         expected: Dict[str, str] = {}
         statuses = []
+        missing: List[int] = []
         for index in instances:
             for task_name in step.requirement.tasks_to_launch:
                 full = task_full_name(pod.type, index, task_name)
                 info = state_store.fetch_task(full)
                 if info is None:
-                    return  # never launched: step stays PENDING
+                    missing.append(index)
+                    break  # never launched for this instance
                 if info.labels.get(Label.TARGET_CONFIG) != target_config_id:
                     return  # old config: needs redeploy -> PENDING
                 if info.labels.get(Label.PERMANENTLY_FAILED):
@@ -135,6 +137,31 @@ class DeployPlanFactory:
                 status = state_store.fetch_status(full)
                 if status is not None:
                     statuses.append(status)
+        if missing:
+            # A missing clean SUFFIX of an elastic gang whose initial
+            # deployment already completed is an elastic shrink's
+            # trim-surplus erase (ISSUE 13/20), not an interrupted
+            # deploy: seed the surviving prefix as launched so the
+            # restart-rebuilt plan re-derives COMPLETE.  The width is
+            # the recovery manager's business — its regrow scan
+            # (_maybe_regrow) re-places the gang at declared width
+            # when capacity returns; a PENDING full-width step here
+            # would instead deadlock against the survivors' own
+            # reservations while blocking regrow as externally
+            # managed.  Any other hole stays PENDING.
+            elastic_gang = (
+                pod.gang and pod.tpu is not None and pod.tpu.elastic
+            )
+            suffix = list(range(min(missing), max(instances) + 1))
+            is_clean_suffix = missing == suffix and min(missing) > min(
+                instances
+            )
+            if not (
+                elastic_gang
+                and is_clean_suffix
+                and state_store.deployment_was_completed()
+            ):
+                return  # never launched: step stays PENDING
         # ONCE tasks that already FINISHED must not re-run even though
         # a fresh launch would: mark complete directly
         step.record_launch(expected)

@@ -73,12 +73,31 @@ def shrink_candidates(full_hosts: int, min_hosts: int) -> List[int]:
     ]
 
 
+def slice_shrink_candidates(
+    full_hosts: int, min_hosts: int, host_quantum: int
+) -> List[int]:
+    """Descending whole-slice widths for a multi-slice gang: multiples
+    of ``host_quantum`` (= hosts per slice) below ``full_hosts`` at or
+    above ``min_hosts``.  Dropping whole slices shrinks ONLY the dcn
+    axis — each surviving slice keeps its full ``topology`` rectangle,
+    so the per-slice ICI layout (and every non-batch mesh axis) is
+    untouched and the restore is a pure re-layout regardless of
+    divisibility (``elastic_reshard_ok`` permits any dcn width)."""
+    floor = max(1, int(min_hosts))
+    q = max(1, int(host_quantum))
+    return [
+        k for k in range(full_hosts - q, 0, -q)
+        if k >= floor
+    ]
+
+
 def decide_resize(
     current_hosts: int,
     full_hosts: int,
     declines: int,
     policy: ElasticPolicy,
     maintenance_returning: bool,
+    host_quantum: int = 1,
 ) -> ResizeDecision:
     """The shrink-vs-wait rule.  PURE — no clocks, no inventory: the
     caller feeds observed facts, the rule returns the target size.
@@ -89,6 +108,11 @@ def decide_resize(
     restart when it ends.  Preempted capacity never returns by
     contract, so a pure-preemption loss shrinks as soon as the
     decline budget is spent.
+
+    ``host_quantum`` > 1 is the multi-slice gang case (quantum =
+    hosts per slice): valid widths drop WHOLE slices — the dcn axis
+    shrinks, each surviving slice keeps its topology — instead of the
+    single-slice divisor rule.
     """
     if not policy.enabled:
         return ResizeDecision(current_hosts, "elastic disabled")
@@ -103,13 +127,25 @@ def decide_resize(
             current_hosts,
             "waiting: a maintenance window promises the capacity back",
         )
-    # divisors of the FULL gang size, strictly below the current
-    # target — the checkpoint's dp axis reshards cleanly onto exactly
-    # these widths
-    for k in shrink_candidates(full_hosts, policy.min_hosts):
+    # sizes strictly below the current target the checkpoint reshards
+    # onto cleanly: divisors of the FULL gang (dp axis must divide) —
+    # or whole-slice multiples when the gang spans slices (dcn axis)
+    if host_quantum > 1:
+        candidates = slice_shrink_candidates(
+            full_hosts, policy.min_hosts, host_quantum
+        )
+    else:
+        candidates = shrink_candidates(full_hosts, policy.min_hosts)
+    for k in candidates:
         if k < current_hosts:
+            kind = "slice(s)" if host_quantum > 1 else "hosts"
+            width = k // host_quantum if host_quantum > 1 else k
+            cur = (
+                current_hosts // host_quantum
+                if host_quantum > 1 else current_hosts
+            )
             return ResizeDecision(
-                k, f"shrinking {current_hosts} -> {k} hosts"
+                k, f"shrinking {cur} -> {width} {kind}"
             )
     return ResizeDecision(
         current_hosts,
@@ -155,13 +191,16 @@ def shrunken_pod(pod: PodSpec, target_hosts: int) -> Optional[PodSpec]:
     if pod.tpu is None:
         return dataclasses.replace(pod, count=target_hosts)
     if pod.tpu.slices > 1:
-        # multi-slice gangs do not shrink (yet): count must equal
-        # slices x hosts-per-slice and the dcn axis couples the slice
-        # count to the checkpoint layout — a naive count shrink would
-        # emit a requirement no evaluator can satisfy.  Refusing here
-        # keeps the replace step WAITING at full size, which is
-        # honest; dropping whole slices is future work.
-        return None
+        # multi-slice gangs shrink by WHOLE slices (ISSUE 20): the
+        # per-slice topology is untouched — only `slices` (the dcn
+        # axis) drops — so count must stay a multiple of
+        # hosts-per-slice or the requirement could never satisfy
+        # count == slices x hosts-per-slice
+        hps = max(1, pod.count // pod.tpu.slices)
+        if target_hosts % hps or target_hosts < hps:
+            return None
+        tpu = dataclasses.replace(pod.tpu, slices=target_hosts // hps)
+        return dataclasses.replace(pod, count=target_hosts, tpu=tpu)
     topo = shrink_topology(pod.tpu, target_hosts)
     if topo is None:
         return None
@@ -217,12 +256,19 @@ class ElasticGangStep(DeploymentStep):
                 self._declines = 0
                 return
             self._declines += 1
+            # a multi-slice gang resizes in whole-slice steps: the
+            # quantum pins valid widths to multiples of hosts-per-slice
+            quantum = 1
+            tpu = self._full_pod.tpu
+            if tpu is not None and tpu.slices > 1:
+                quantum = max(1, self._full_pod.count // tpu.slices)
             decision = decide_resize(
                 self.target_hosts,
                 self._full_pod.count,
                 self._declines,
                 self._policy,
                 self._maintenance_probe(),
+                host_quantum=quantum,
             )
             if decision.target_hosts >= self.target_hosts:
                 return
@@ -259,9 +305,15 @@ class ElasticGangStep(DeploymentStep):
                 hosts=pod.count,
                 full=self._full_pod.count,
                 topology=pod.tpu.topology if pod.tpu else "",
+                slices=pod.tpu.slices if pod.tpu else 1,
                 message=(
                     f"elastic re-slice: {decision.reason} "
-                    f"(topology {pod.tpu.topology if pod.tpu else 'n/a'})"
+                    f"(topology {pod.tpu.topology if pod.tpu else 'n/a'}"
+                    + (
+                        f" x {pod.tpu.slices} slice(s)"
+                        if pod.tpu and pod.tpu.slices > 1 else ""
+                    )
+                    + ")"
                 ),
             )
 

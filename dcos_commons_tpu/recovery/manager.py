@@ -147,6 +147,7 @@ class DefaultRecoveryPlanManager(PlanManager):
         """Reference: updatePlan (DefaultRecoveryPlanManager.java:164)."""
         self._prune_completed()
         failed = self._find_failed_pods()
+        self._maybe_regrow(failed)
         for (pod_type, instances), (recovery_type, tasks) in failed.items():
             key = pod_instance_name(pod_type, instances[0])
             if any(
@@ -427,6 +428,115 @@ class DefaultRecoveryPlanManager(PlanManager):
         ) == 1 else f"recover-{pod_type}-gang"
         step = DeploymentStep(name, requirement, backoff=self._backoff)
         return Phase(name, [step], ParallelStrategy())
+
+    # -- whole-slice regrow (ISSUE 20) --------------------------------
+
+    def _maybe_regrow(self, failed: Dict[tuple, tuple]) -> None:
+        """Regrow a multi-slice elastic gang to its declared width.
+
+        After a whole-slice elastic shrink the gang trains healthily
+        at fewer slices — nothing is FAILED, so the failure scan will
+        never touch it again.  This scan watches for exactly that
+        state (a clean shrunken prefix of RUNNING instances) and,
+        once the fleet again holds enough fully-up slices, synthesizes
+        the SAME gang choreography at declared width: kill the
+        shrunken incarnation, unreserve, re-place all slices, trim.
+        The fenced-checkpoint restore re-lays the dcn axis back up
+        exactly as the shrink laid it down.
+
+        Rate-limited by the replacement-failure policy's
+        min-replace-delay (a regrow IS a replace) and journaled as
+        verb=elastic-regrow.  Scoped to multi-slice gangs: a
+        single-slice elastic shrink changes the per-slice topology,
+        and regrowing it is the update plan's `pod replace` path.
+        """
+        if self.inventory is None:
+            return
+        failed_types = {pt for (pt, _i) in failed}
+        for pod in self._spec.pods:
+            if not (
+                pod.gang and pod.tpu is not None and pod.tpu.elastic
+                and pod.tpu.slices > 1
+            ):
+                continue
+            if pod.type in failed_types:
+                continue  # active failure: the gang phase owns it
+            key = pod_instance_name(pod.type, 0)
+            if key in self._phases:
+                continue
+            if any(
+                self._externally_managed(pod_instance_name(pod.type, i))
+                for i in range(pod.count)
+            ):
+                continue
+            width = self._running_width(pod)
+            if width is None:
+                continue
+            if not self._replace_delay_elapsed(key):
+                continue
+            if not self._regrow_capacity(pod):
+                continue
+            instances = list(range(pod.count))
+            phase = self._make_gang_phase(pod, instances, None)
+            self._phases[key] = phase
+            self._record_replace(pod.type, instances)
+            if self.journal is not None:
+                self.journal.append(
+                    "recovery", pod=pod.type, verb="elastic-regrow",
+                    hosts=pod.count, width=width,
+                    message=(
+                        f"regrowing {pod.type} from {width} to "
+                        f"{pod.count} host(s): capacity returned"
+                    ),
+                )
+
+    def _running_width(self, pod) -> Optional[int]:
+        """The width of a HEALTHY shrunken gang: instances 0..w-1 have
+        stored tasks whose latest status satisfies their goal, and
+        instances w.. have none (the trim step's clean prefix).  None
+        for anything else — full width, holes, or any unhealthy task
+        (those are the failure scan's business, not regrow's)."""
+        width = 0
+        for index in range(pod.count):
+            present = False
+            for task_spec in pod.tasks:
+                full = task_full_name(pod.type, index, task_spec.name)
+                info = self._state_store.fetch_task(full)
+                if info is None:
+                    continue
+                present = True
+                status = self._state_store.fetch_status(full)
+                if status is None or status.task_id != info.task_id:
+                    return None
+                if task_spec.goal in (GoalState.FINISH, GoalState.ONCE):
+                    if status.state is not TaskState.FINISHED:
+                        return None
+                elif status.state is not TaskState.RUNNING:
+                    return None
+            if present:
+                if index != width:
+                    return None  # hole: not a clean shrunken prefix
+                width += 1
+        return width if 0 < width < pod.count else None
+
+    def _regrow_capacity(self, pod) -> bool:
+        """True when the fleet holds enough fully-up matching slices
+        to place the gang at declared width.  The shrunken gang's own
+        slices COUNT — the regrow choreography unreserves them before
+        re-placing.  Other services' claims are not visible here, so
+        this over-approximates; a regrow that then cannot place
+        re-shrinks through the same decision rule and converges back.
+        """
+        hps = max(1, pod.count // max(1, pod.tpu.slices))
+        by_slice: Dict[str, int] = {}
+        for host in self.inventory.hosts():
+            if host.generation != pod.tpu.generation:
+                continue
+            if self.inventory.host_state(host.host_id) != "up":
+                continue
+            by_slice[host.slice_id] = by_slice.get(host.slice_id, 0) + 1
+        full = sum(1 for n in by_slice.values() if n >= hps)
+        return full >= pod.tpu.slices
 
     # -- gang-granular recovery (ISSUE 13) ----------------------------
 

@@ -92,10 +92,14 @@ class TpuSpec:
         analyzer (analysis/shardcheck.py): the worker derives its mesh
         from exactly these variables (parallel/mesh.py ``derive``), so
         an analyzer that assembled them independently could approve a
-        mesh the launched task never builds.  Slice-index variables
-        (TPU_NUM_SLICES/TPU_SLICE_INDEX) are claim-time facts and stay
-        with the claim path — here ``slices`` only widens the declared
-        shape for multi-slice pods.
+        mesh the launched task never builds.  Multi-slice pods grow
+        the dcn axis here (TPU_NUM_SLICES widens the declared shape)
+        plus the static half of the per-slice coordinator addressing
+        (TPU_HOSTS_PER_SLICE — slice-major worker numbering means
+        ``worker_id // hosts_per_slice`` is the slice index); which
+        HOST anchors each slice (TPU_SLICE_COORDS) and which slice a
+        worker landed on (TPU_SLICE_INDEX) are claim-time facts and
+        stay with the claim path (offer/evaluate.py).
         """
         env = {
             "TPU_CHIPS_PER_HOST": str(self.chips_per_host),
@@ -105,6 +109,9 @@ class TpuSpec:
             env["TPU_TOPOLOGY"] = self.topology
         if self.slices > 1:
             env["TPU_NUM_SLICES"] = str(self.slices)
+            env["TPU_HOSTS_PER_SLICE"] = str(
+                max(1, self.total_chips // max(1, self.chips_per_host))
+            )
         return env
 
 

@@ -174,6 +174,39 @@ pods:
 """
 
 
+# the multi-slice storm target (ISSUE 20): one gang spanning two
+# 4x4 slices over DCN, elastic so a whole-slice loss can shrink the
+# dcn axis instead of waiting for capacity that never returns
+CHAOS_MULTISLICE_YAML = """
+name: chaossvc
+pods:
+  ctl:
+    count: 1
+    tasks:
+      server:
+        goal: RUNNING
+        cmd: "{cmd}"
+        cpus: 0.5
+        memory: 64
+  trainer:
+    count: 8
+    gang: true
+    tpu:
+      generation: v5e
+      chips-per-host: 4
+      topology: 4x4
+      slices: 2
+      elastic: true
+      min-hosts: 4
+    tasks:
+      worker:
+        goal: RUNNING
+        cmd: "{cmd}"
+        cpus: 1.0
+        memory: 256
+"""
+
+
 def chaos_fleet() -> List[TpuHost]:
     from dcos_commons_tpu.offer.inventory import make_test_fleet
 
@@ -501,12 +534,18 @@ class PreemptSpec:
     cloud reclaim would; counting starts once the storm is armed,
     post-deploy), STORM_START, or RECOVERY_ACTIVE.
     ``kill_scheduler`` also crashes the scheduler at the same
-    boundary — preemption and failover composed."""
+    boundary — preemption and failover composed.
+
+    ``whole_slice`` reinterprets ``hosts`` as a SLICE count (ISSUE
+    20): each victim is one entire slice of a multi-slice gang —
+    every host in the slice dies physically, statuses never arrive —
+    the cloud-reclaim unit a dcn-spanning gang actually loses."""
 
     at: str = STORM_START
     occurrence: int = 1
     hosts: int = 1
     kill_scheduler: bool = False
+    whole_slice: bool = False
 
     def __post_init__(self):
         allowed = CHAOS_KINDS + (STORM_START, RECOVERY_ACTIVE)
@@ -561,7 +600,9 @@ class _StormInjector:
         ]
         for spec in fired:
             self.specs.remove(spec)
-            self.storm.preempt_now(spec.hosts)
+            self.storm.preempt_now(
+                spec.hosts, whole_slice=spec.whole_slice
+            )
             if spec.kill_scheduler:
                 raise SchedulerKilled(kind, spec.occurrence)
 
@@ -614,8 +655,13 @@ class PreemptionStorm:
 
     # -- injector callbacks -------------------------------------------
 
-    def preempt_now(self, k: int) -> None:
-        """Physically preempt up to ``k`` gang-carrying hosts NOW."""
+    def preempt_now(self, k: int, whole_slice: bool = False) -> None:
+        """Physically preempt up to ``k`` gang-carrying hosts NOW.
+
+        ``whole_slice`` reinterprets ``k`` as a count of SLICES: each
+        victim slice loses EVERY host (gang-carrying or not) — the
+        reclaim granularity a multi-slice gang sees when a provider
+        takes back one slice of its dcn span."""
         scheduler = self.scheduler
         assert scheduler is not None
         by_host: Dict[str, int] = {}
@@ -625,7 +671,23 @@ class PreemptionStorm:
         victims = [
             h for h in sorted(by_host)
             if scheduler.inventory.host_state(h) != "preempted"
-        ][:k]
+        ]
+        if whole_slice:
+            dead_slices: List[str] = []
+            for h in victims:
+                host = scheduler.inventory.host(h)
+                sid = host.slice_id if host is not None else ""
+                if sid and sid not in dead_slices:
+                    dead_slices.append(sid)
+            dead_slices = dead_slices[:k]
+            victims = sorted(
+                h.host_id for h in scheduler.inventory.hosts()
+                if h.slice_id in dead_slices
+                and scheduler.inventory.host_state(h.host_id)
+                != "preempted"
+            )
+        else:
+            victims = victims[:k]
         for host_id in victims:
             self.agent.fail_host(host_id)
             scheduler.inventory.set_preempted(host_id)
@@ -711,7 +773,7 @@ class PreemptionStorm:
         recovery_hits = 0
         scheduler.chaos = injector
         for spec in [s for s in self.specs if s.at == STORM_START]:
-            self.preempt_now(spec.hosts)
+            self.preempt_now(spec.hosts, whole_slice=spec.whole_slice)
             if spec.kill_scheduler:
                 report.incarnations += 1
                 scheduler = self.harness.build_scheduler()
@@ -740,7 +802,9 @@ class PreemptionStorm:
                     ]
                     for spec in fired:
                         recovery_specs.remove(spec)
-                        self.preempt_now(spec.hosts)
+                        self.preempt_now(
+                            spec.hosts, whole_slice=spec.whole_slice
+                        )
             except SchedulerKilled:
                 # failover composed with the preemption: successor
                 # over the same persister + inventory + agent
@@ -841,6 +905,37 @@ class PreemptionStorm:
                 f"gang split across slices {sorted(gang_slices)}: "
                 f"{describe}"
             )
+        elif pod.tpu is not None and pod.tpu.slices > 1:
+            # multi-slice convergence (ISSUE 20): the surviving gang
+            # is either the FULL dcn span re-placed or a whole-slice
+            # shrink of it — each surviving sub-slice is complete
+            # (hosts-per-slice workers, one slice each), no worker
+            # sits on a dead slice, and the stored width is a clean
+            # slice multiple (the dp x dcn batch axes resharded
+            # evenly; a ragged width would mean a torn sub-gang)
+            hps = max(1, pod.count // pod.tpu.slices)
+            by_slice: Dict[str, int] = {}
+            stored = 0
+            for name, host in report.final_hosts.items():
+                if not name.startswith(f"{self.gang_pod}-"):
+                    continue
+                if scheduler.state_store.fetch_task(name) is None:
+                    continue  # trimmed by the whole-slice shrink
+                stored += 1
+                h = scheduler.inventory.host(host)
+                assert h is not None, (
+                    f"{name} on unknown host {host}: {describe}"
+                )
+                by_slice[h.slice_id] = by_slice.get(h.slice_id, 0) + 1
+            assert stored and stored % hps == 0 and stored <= pod.count, (
+                f"gang width {stored} is not a whole-slice multiple of "
+                f"{hps} (full {pod.count}): {describe}"
+            )
+            assert len(by_slice) == stored // hps and \
+                all(n == hps for n in by_slice.values()), (
+                    f"torn sub-slice layout {by_slice} for width "
+                    f"{stored}: {describe}"
+                )
         del slices
 
         # 4. the WAL/status consistency the chaos harness promises

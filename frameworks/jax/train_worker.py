@@ -25,6 +25,16 @@ def main() -> int:
     # opts out)
     enable_collective_overlap()
     contract = initialize_from_env()
+    if contract["num_slices"] > 1:
+        # the slice identity an operator needs when reading one
+        # sandbox's log against a whole-gang timeline
+        print(
+            f"multi-slice gang: slice {contract['slice_index']}/"
+            f"{contract['num_slices']} "
+            f"({contract['hosts_per_slice']} host(s)/slice), "
+            f"slice anchor {contract['slice_coordinator'] or 'n/a'}",
+            flush=True,
+        )
 
     import jax
 

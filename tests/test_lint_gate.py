@@ -1520,6 +1520,17 @@ def test_plancheck_repo_gate():
     # empirically, certified over ALL interleavings here
     assert "migration" in by_name, sorted(by_name)
     assert by_name["migration"].states >= 10_000, summary.render()
+    # the multislice-recovery configuration (ISSUE 20) gates the
+    # whole-slice elastic choreography at the same depth: slice-drop
+    # shrink (kill -> unreserve -> replace-shrunken) THEN regrow to
+    # declared width (kill-shrunken -> unreserve-shrunken ->
+    # replace-full) x old/shrunken worker deaths at every point x
+    # the capacity-returns edge x operator verbs, livelock-sound,
+    # with 0 violations of no-split-brain-multislice /
+    # no-double-slice-reservation across all THREE incarnations
+    assert "multislice-recovery" in by_name, sorted(by_name)
+    assert by_name["multislice-recovery"].states >= 10_000, \
+        summary.render()
 
 
 def test_plancheck_catches_broken_cutover_protocol():
@@ -1578,6 +1589,26 @@ def test_plancheck_catches_flapping_governor():
     names = {v.invariant for v in result.violations}
     assert "no-remediation-storm" in names or \
         "no-opposite-concurrent" in names, result.violations
+
+
+def test_plancheck_catches_regrow_without_kill():
+    """Seeded bug: a regrow phase that relaunches the declared width
+    WITHOUT first killing + unreserving the shrunken gang commits the
+    full-width claims while the shrunken incarnation still holds the
+    surviving slice — no-double-slice-reservation fires with a
+    minimal trace (the shortest path is the whole shrink choreography
+    plus capacity-returns plus one launch, nothing more)."""
+    result = plancheck.check_plan(
+        lambda: plancheck._multislice_recovery_plan(
+            regrow_skips_kill=True
+        ),
+        config_name="seeded-regrow-no-kill", max_states=120_000,
+        check_livelock=False,
+    )
+    overlap = [v for v in result.violations
+               if v.invariant == "no-double-slice-reservation"]
+    assert overlap, result.violations
+    assert len(overlap[0].trace) <= 9, overlap[0].render()
 
 
 def test_plancheck_catches_unordered_gang_recovery():
@@ -2197,17 +2228,22 @@ def test_stepcompare_gates_on_mean_vs_floor():
 
 
 def test_stepcompare_wire_model_and_malformed_records():
-    """The wire floor is the CHEAPER collective spelling; records a
-    killed worker truncated (non-numeric/missing wall_s) are skipped,
-    not crashed on."""
+    """The wire floor is the CHEAPER collective spelling PER AXIS
+    (each collective runs ONE spelling, so the floor sums per-axis
+    minima); records a killed worker truncated (non-numeric/missing
+    wall_s) are skipped, not crashed on."""
     cost = {
-        "per_step": [{"axis": "dp"}],
-        "total_ring_us": 500.0,
-        "total_allgather_us": 800.0,
+        "per_step": [
+            {"axis": "dp", "ring_us": 300.0, "allgather_us": 450.0},
+            {"axis": "tp", "ring_us": 350.0, "allgather_us": 200.0},
+        ],
+        "total_ring_us": 650.0,
+        "total_allgather_us": 650.0,
     }
     records = [{"wall_s": 0.0005}]
     out = shardcheck.stepcompare(cost, records, slack=0.25, skip=0)
     assert out["predicted_wire_us"] == 500.0
+    assert out["predicted_wire_dcn_us"] == 0.0  # no dcn leg in this mesh
     assert out["regression"] is False
     out = shardcheck.stepcompare(
         cost, records + [{"wall_s": "garbage"}, {}, {"step": 3}],

@@ -1,0 +1,603 @@
+"""Multi-slice gangs over DCN (ISSUE 20).
+
+End to end: a ``tpu: slices: 2`` gang deploys across two physical
+slices with slice-major worker numbering, per-slice coordinator
+anchors (TPU_SLICE_COORDS + slice-coordinator port reservations) and
+a derived ICIxDCN mesh; killing a whole slice shrinks the gang onto
+the surviving slice (the dcn axis drops, the per-slice topology is
+untouched) and the gang regrows to declared width when the slice
+returns.  Unit level: DCN-pool pinning, generation filtering, the
+admission gate, the worker-side contract parse, stepcompare's DCN
+wire leg, the whole-slice chaos spec, and a bit-identical fenced
+checkpoint re-layout across the dcn shrink.
+"""
+
+import dataclasses
+
+import pytest
+
+from dcos_commons_tpu.common import TaskState, TaskStatus
+from dcos_commons_tpu.offer import (
+    OfferEvaluator,
+    ReservationLedger,
+    SliceInventory,
+)
+from dcos_commons_tpu.offer.inventory import make_test_fleet
+from dcos_commons_tpu.offer.multislice import SLICE_COORDINATOR_PORT_NAME
+from dcos_commons_tpu.plan.step import PodInstanceRequirement
+from dcos_commons_tpu.specification import from_yaml
+from dcos_commons_tpu.state import StateStore
+from dcos_commons_tpu.storage import MemPersister
+from dcos_commons_tpu.testing import (
+    AdvanceCycles,
+    ExpectDeploymentComplete,
+    HostUp,
+    PreemptHost,
+    SendTaskRunning,
+    ServiceTestRunner,
+)
+
+# an 8-worker gang spanning two 4-host slices (4x4 chips each),
+# elastic down to one whole slice
+MULTISLICE_YAML = """
+name: mssvc
+pods:
+  trainer:
+    count: 8
+    gang: true
+    tpu:
+      generation: v5e
+      chips-per-host: 4
+      topology: 4x4
+      slices: 2
+      elastic: true
+      min-hosts: 4
+    tasks:
+      worker:
+        goal: RUNNING
+        cmd: "train"
+        cpus: 1.0
+        memory: 256
+"""
+
+# a small 4-worker/2-slice gang for evaluator-level tests: each slice
+# is 2 hosts of 2x2 chip blocks (one 2x4 sub-slice per slice)
+SMALL_MS_YAML = """
+name: jax
+pods:
+  trainer:
+    count: 4
+    gang: true
+    tpu:
+      generation: v5e
+      chips-per-host: 4
+      topology: 2x4
+      slices: 2
+    tasks:
+      worker:
+        goal: FINISH
+        cmd: "python train.py"
+        cpus: 2.0
+        memory: 4096
+"""
+
+
+def slice_fleet(*slice_ids):
+    return [h for s in slice_ids for h in make_test_fleet(slice_id=s)]
+
+
+def two_host_slice(slice_id, generation="v5e", pool=""):
+    hosts = make_test_fleet(
+        slice_id=slice_id, host_grid=(1, 2), chip_block=(2, 2),
+        generation=generation,
+    )
+    if pool:
+        hosts = [
+            dataclasses.replace(h, attributes={"dcn_pool": pool})
+            for h in hosts
+        ]
+    return hosts
+
+
+def build_eval(yaml_text, hosts):
+    spec = from_yaml(yaml_text)
+    persister = MemPersister()
+    store = StateStore(persister)
+    ledger = ReservationLedger(persister)
+    ev = OfferEvaluator(store, ledger, spec.name, "cfg-1")
+    inv = SliceInventory(hosts)
+    return spec, store, ledger, ev, inv
+
+
+def deploy_multislice(hosts):
+    runner = ServiceTestRunner(MULTISLICE_YAML, hosts=hosts)
+    runner.run([
+        AdvanceCycles(1),
+        *[SendTaskRunning(f"trainer-{i}-worker") for i in range(8)],
+        ExpectDeploymentComplete(),
+    ])
+    return runner
+
+
+def gang_hosts(scheduler):
+    return {
+        info.name: info.agent_id
+        for info in scheduler.state_store.fetch_tasks()
+    }
+
+
+def slice_of(host_id):
+    return host_id.rsplit("-h", 1)[0]
+
+
+def ack_new_launches(world, acked):
+    """RUNNING-ack every WAL'd launch whose process is still alive."""
+    scheduler = world.scheduler
+    for info in list(world.agent.launched):
+        if info.task_id in acked:
+            continue
+        if info.task_id not in world.agent.active_task_ids():
+            continue
+        status = scheduler.state_store.fetch_status(info.name)
+        if status is not None and status.task_id == info.task_id and \
+                status.state is TaskState.STAGING:
+            acked.add(info.task_id)
+            world.agent.send(TaskStatus(
+                task_id=info.task_id, state=TaskState.RUNNING,
+                ready=True, agent_id=info.agent_id,
+            ))
+
+
+def drive_to_recovered(world, cycles=30):
+    acked = set()
+    for _ in range(cycles):
+        world.scheduler.run_cycle()
+        ack_new_launches(world, acked)
+        if world.scheduler.plan("recovery").is_complete:
+            return True
+    return False
+
+
+def recovery_verbs(scheduler):
+    return [
+        e.get("verb")
+        for e in scheduler.journal.events(kinds=("recovery",))
+    ]
+
+
+# -- end-to-end deploy ------------------------------------------------
+
+
+def test_multislice_deploy_env_contract_end_to_end():
+    """tpu: slices: 2 deploys across two physical slices and every
+    worker carries the full ICIxDCN contract: slice-major numbering,
+    TPU_SLICE_COORDS anchored on each slice's first worker, a
+    slice-coordinator port reservation per slice leader, and an env
+    from which the mesh layer derives dcn=2."""
+    from dcos_commons_tpu.parallel.mesh import derive
+
+    runner = deploy_multislice(slice_fleet("pod-a", "pod-b", "pod-c"))
+    scheduler = runner.world.scheduler
+    tasks = sorted(
+        scheduler.state_store.fetch_tasks(),
+        key=lambda i: int(i.env["TPU_WORKER_ID"]),
+    )
+    assert len(tasks) == 8
+
+    # slice-major: workers 0-3 share one slice, 4-7 another
+    slices = [slice_of(i.agent_id) for i in tasks]
+    assert len(set(slices[:4])) == 1 and len(set(slices[4:])) == 1
+    assert slices[0] != slices[4]
+    for i, info in enumerate(tasks):
+        assert info.env["TPU_SLICE_INDEX"] == str(i // 4)
+        assert info.env["TPU_NUM_SLICES"] == "2"
+        assert info.env["TPU_HOSTS_PER_SLICE"] == "4"
+        assert info.env["TPU_WORKER_COUNT"] == "8"
+
+    # per-slice coordinator anchors: one address per slice, anchored
+    # on that slice's first worker, identical for every worker
+    coords = {i.env["TPU_SLICE_COORDS"] for i in tasks}
+    assert len(coords) == 1
+    entries = coords.pop().split(",")
+    assert len(entries) == 2
+    for k, entry in enumerate(entries):
+        leader = tasks[k * 4]
+        assert entry.split(":")[0] == leader.agent_id
+
+    # the rendezvous port is a real reservation on each slice leader
+    anchors = [
+        r for r in scheduler.ledger.all()
+        if r.container_path == SLICE_COORDINATOR_PORT_NAME
+    ]
+    assert sorted(r.host_id for r in anchors) == sorted(
+        [tasks[0].agent_id, tasks[4].agent_id]
+    )
+
+    # the worker derives the dcn axis from this exact env
+    mesh = derive(dict(tasks[0].env))
+    assert (mesh.dcn, mesh.dp, mesh.tp) == (2, 4, 4)
+
+
+# -- whole-slice elasticity -------------------------------------------
+
+
+def test_whole_slice_shrink_then_regrow():
+    """Killing one slice of a 2-slice elastic gang (with no spare
+    capacity anywhere) shrinks the gang onto the surviving slice —
+    per-slice topology untouched, dcn axis dropped, surplus trimmed,
+    zero claims left on the dead slice — and the gang regrows to
+    declared width when the slice returns."""
+    runner = deploy_multislice(slice_fleet("pod-a", "pod-b"))
+    world = runner.world
+    scheduler = world.scheduler
+    placed = gang_hosts(scheduler)
+    victim_slice = slice_of(placed["trainer-0-worker"])
+    victims = sorted(
+        a for a in set(placed.values()) if slice_of(a) == victim_slice
+    )
+    assert len(victims) == 4
+
+    runner.run([PreemptHost(h) for h in victims])
+    assert drive_to_recovered(world)
+
+    # shrunk to ONE whole slice on the survivor
+    after = gang_hosts(scheduler)
+    assert sorted(after) == [f"trainer-{i}-worker" for i in range(4)]
+    assert {slice_of(a) for a in after.values()} == {
+        s for s in ("pod-a", "pod-b") if s != victim_slice
+    }
+    for name in ("trainer-4-worker", "trainer-7-worker"):
+        assert scheduler.state_store.fetch_task(name) is None
+    envs = [i.env for i in scheduler.state_store.fetch_tasks()]
+    for env in envs:
+        # the slice keeps its full per-slice shape; only dcn dropped
+        assert env["TPU_TOPOLOGY"] == "4x4"
+        assert env["TPU_WORKER_COUNT"] == "4"
+        assert "TPU_NUM_SLICES" not in env
+        assert "TPU_SLICE_COORDS" not in env
+    # zero claims survive on the dead slice
+    for h in victims:
+        assert not [r for r in scheduler.ledger.all() if r.host_id == h]
+    verbs = recovery_verbs(scheduler)
+    assert "elastic-shrink" in verbs and "trim-surplus" in verbs
+
+    # the slice comes back -> regrow to declared width
+    runner.run([HostUp(h) for h in victims])
+    acked = set()
+    for _ in range(40):
+        scheduler.run_cycle()
+        ack_new_launches(world, acked)
+        if len(scheduler.state_store.fetch_tasks()) == 8 and \
+                scheduler.plan("recovery").is_complete:
+            break
+    regrown = sorted(
+        scheduler.state_store.fetch_tasks(),
+        key=lambda i: int(i.env["TPU_WORKER_ID"]),
+    )
+    assert len(regrown) == 8
+    assert {slice_of(i.agent_id) for i in regrown} == {"pod-a", "pod-b"}
+    for info in regrown:
+        assert info.env["TPU_NUM_SLICES"] == "2"
+        assert info.env["TPU_WORKER_COUNT"] == "8"
+    assert "elastic-regrow" in recovery_verbs(scheduler)
+
+
+def test_shrunken_gang_survives_scheduler_restart_then_regrows():
+    """Scheduler restart while a multi-slice gang is elastically
+    shrunken must not deadlock.  The restart-rebuilt update plan sees
+    tasks 0..3 at target config and 4..7 missing; seeding that clean
+    suffix hole as PENDING would leave a full-width gang step that can
+    never place (the survivors hold their slice's reservations) while
+    blocking the recovery manager's regrow scan as externally managed.
+    The surviving prefix seeds COMPLETE instead, and regrow fires when
+    the slice returns."""
+    hosts = slice_fleet("pod-a", "pod-b")
+    runner = deploy_multislice(hosts)
+    world = runner.world
+    placed = gang_hosts(world.scheduler)
+    victim_slice = slice_of(placed["trainer-0-worker"])
+    victims = sorted(
+        a for a in set(placed.values()) if slice_of(a) == victim_slice
+    )
+    runner.run([PreemptHost(h) for h in victims])
+    assert drive_to_recovered(world)
+    assert len(world.scheduler.state_store.fetch_tasks()) == 4
+
+    # restart: same persister + agent (the shrunken gang keeps
+    # running), fresh scheduler
+    runner2 = runner.restart()
+    world2 = runner2.build()
+    scheduler = world2.scheduler
+    assert len(scheduler.state_store.fetch_tasks()) == 4
+    # the rebuilt plan re-derives COMPLETE from the shrunken prefix
+    scheduler.run_cycle()
+    assert scheduler.plan("update").is_complete
+
+    # the slice comes back -> the recovery manager regrows
+    runner2.run([HostUp(h) for h in victims])
+    acked = set()
+    for _ in range(40):
+        scheduler.run_cycle()
+        ack_new_launches(world2, acked)
+        if len(scheduler.state_store.fetch_tasks()) == 8 and \
+                scheduler.plan("recovery").is_complete:
+            break
+    regrown = scheduler.state_store.fetch_tasks()
+    assert len(regrown) == 8
+    assert {slice_of(i.agent_id) for i in regrown} == {"pod-a", "pod-b"}
+    assert all(i.env["TPU_NUM_SLICES"] == "2" for i in regrown)
+    assert "elastic-regrow" in recovery_verbs(scheduler)
+
+
+# -- slice-set placement rules ----------------------------------------
+
+
+def test_multislice_gang_pins_one_dcn_pool():
+    """Slices on different DCN fabrics cannot form one gang: the
+    first sub-slice pins the pool, the rest must match, and two free
+    slices on one fabric win over a free slice on another."""
+    fleet = (
+        two_host_slice("pod-a", pool="fabric-1")
+        + two_host_slice("pod-b", pool="fabric-1")
+        + two_host_slice("pod-z", pool="fabric-2")
+    )
+    spec, store, ledger, ev, inv = build_eval(SMALL_MS_YAML, fleet)
+    result = ev.evaluate(
+        PodInstanceRequirement(
+            pod=spec.pod("trainer"), instances=[0, 1, 2, 3]
+        ),
+        inv,
+    )
+    assert result.passed, result.outcome.flatten()
+    placed = {inv.host(i.agent_id).slice_id for i in result.task_infos}
+    assert placed == {"pod-a", "pod-b"}
+
+    # one free slice per fabric: the gang must refuse, naming the pool
+    split = (
+        two_host_slice("pod-a", pool="fabric-1")
+        + two_host_slice("pod-b", pool="fabric-2")
+    )
+    spec, store, ledger, ev, inv = build_eval(SMALL_MS_YAML, split)
+    result = ev.evaluate(
+        PodInstanceRequirement(
+            pod=spec.pod("trainer"), instances=[0, 1, 2, 3]
+        ),
+        inv,
+    )
+    assert not result.passed
+    assert "on dcn pool fabric" in result.outcome.reason
+
+
+def test_multislice_gang_filters_by_generation():
+    """Slice-set placement only sees slices of the spec's generation —
+    the same fact admission and regrow sizing count — so a v5p gang
+    skips free v5e slices instead of landing on the wrong silicon."""
+    fleet = (
+        two_host_slice("pod-old", generation="v5e")
+        + two_host_slice("pod-p1", generation="v5p")
+        + two_host_slice("pod-p2", generation="v5p")
+    )
+    yaml_text = SMALL_MS_YAML.replace(
+        "generation: v5e", "generation: v5p"
+    )
+    spec, store, ledger, ev, inv = build_eval(yaml_text, fleet)
+    result = ev.evaluate(
+        PodInstanceRequirement(
+            pod=spec.pod("trainer"), instances=[0, 1, 2, 3]
+        ),
+        inv,
+    )
+    assert result.passed, result.outcome.flatten()
+    placed = {inv.host(i.agent_id).slice_id for i in result.task_infos}
+    assert placed == {"pod-p1", "pod-p2"}
+
+
+# -- admission gate ---------------------------------------------------
+
+
+def test_admission_multislice_chip_span_mismatch():
+    from dcos_commons_tpu.multi.admission import validate_service_yaml
+
+    bad = MULTISLICE_YAML.replace("count: 8", "count: 4")
+    spec, findings = validate_service_yaml(bad, "mssvc")
+    multi = [f for f in findings if f.rule == "multislice"]
+    assert multi, findings
+    assert "spans 16 chip(s)" in multi[0].message
+    assert multi[0].line > 1  # anchored at the pod, not the file
+
+
+def test_admission_multislice_fleet_sizing():
+    from dcos_commons_tpu.multi.admission import validate_service_yaml
+
+    # one registered v5e slice cannot host a 2-slice gang
+    spec, findings = validate_service_yaml(
+        MULTISLICE_YAML, "mssvc",
+        inventory=SliceInventory(make_test_fleet("pod-a")),
+    )
+    multi = [f for f in findings if f.rule == "multislice"]
+    assert multi and "registers only 1" in multi[0].message
+
+    # two registered slices admit it
+    spec, findings = validate_service_yaml(
+        MULTISLICE_YAML, "mssvc",
+        inventory=SliceInventory(slice_fleet("pod-a", "pod-b")),
+    )
+    assert not [f for f in findings if f.rule == "multislice"], findings
+
+    # scheduler bootstrap (no inventory): sizing is skipped, never
+    # rejected against an empty fleet
+    spec, findings = validate_service_yaml(MULTISLICE_YAML, "mssvc")
+    assert not [f for f in findings if f.rule == "multislice"], findings
+
+
+# -- worker-side contract ---------------------------------------------
+
+
+def test_initialize_from_env_parses_slice_contract():
+    """The bootstrap shim parses the multi-slice env contract and
+    picks this worker's own slice anchor; no COORDINATOR_ADDRESS means
+    no jax.distributed call, so the parse is testable in isolation."""
+    from dcos_commons_tpu.parallel.distributed import initialize_from_env
+
+    contract = initialize_from_env({
+        "TPU_WORKER_ID": "5", "TPU_WORKER_COUNT": "8",
+        "TPU_CHIPS_PER_HOST": "4", "TPU_TOPOLOGY": "4x4",
+        "TPU_NUM_SLICES": "2", "TPU_SLICE_INDEX": "1",
+        "TPU_HOSTS_PER_SLICE": "4",
+        "TPU_SLICE_COORDS": "pod-a-h0-0:12001,pod-b-h0-0:12001",
+    })
+    assert contract["num_slices"] == 2
+    assert contract["slice_index"] == 1
+    assert contract["hosts_per_slice"] == 4
+    assert contract["slice_coords"] == [
+        "pod-a-h0-0:12001", "pod-b-h0-0:12001",
+    ]
+    assert contract["slice_coordinator"] == "pod-b-h0-0:12001"
+
+    # an out-of-range index degrades to "" instead of raising
+    broken = initialize_from_env({
+        "TPU_NUM_SLICES": "2", "TPU_SLICE_INDEX": "7",
+        "TPU_SLICE_COORDS": "a:1,b:2",
+    })
+    assert broken["slice_coordinator"] == ""
+
+
+def test_stepcompare_prices_the_dcn_leg():
+    """The wire floor takes the cheaper spelling PER AXIS and reports
+    the DCN share separately (the leg the slow fabric explains)."""
+    from dcos_commons_tpu.analysis.shardcheck import stepcompare
+
+    cost = {"per_step": [
+        {"axis": "dcn", "ring_us": 100.0, "allgather_us": 150.0},
+        {"axis": "dp", "ring_us": 30.0, "allgather_us": 20.0},
+    ]}
+    out = stepcompare(cost, [])
+    assert out["predicted_wire_us"] == 120.0
+    assert out["predicted_wire_dcn_us"] == 100.0
+    assert out["predicted_floor_us"] == 120.0
+
+
+# -- whole-slice chaos ------------------------------------------------
+
+
+def test_storm_whole_slice_kill_converges():
+    """A whole-slice PreemptSpec kills EVERY host of one gang slice
+    physically (statuses never arrive); with a spare slice available
+    the gang converges back to full width under the storm invariants
+    (exactly one incarnation, slice-aligned workers, no claims on the
+    dead slice)."""
+    from dcos_commons_tpu.testing.chaos import (
+        CHAOS_MULTISLICE_YAML,
+        STORM_START,
+        PreemptSpec,
+        PreemptionStorm,
+    )
+
+    storm = PreemptionStorm(
+        [PreemptSpec(at=STORM_START, hosts=1, whole_slice=True)],
+        yaml_text=CHAOS_MULTISLICE_YAML,
+        hosts=slice_fleet("gang-a", "gang-b", "gang-c"),
+    )
+    try:
+        report = storm.run(timeout_s=120.0)
+    finally:
+        storm.shutdown()
+    assert report.converged
+    # hosts=1 means ONE SLICE: all four of its hosts die together
+    assert len(report.preempted) == 4
+    assert len({slice_of(h) for h in report.preempted}) == 1
+
+
+# -- fenced-checkpoint re-layout across the dcn shrink ----------------
+
+
+def test_dcn_shrink_restore_is_bit_identical_and_deterministic():
+    """A checkpoint written on the 2-slice mesh (dcn=2, dp=2, tp=2)
+    restores onto the 1-slice mesh (dp=2, tp=2) bit-identically —
+    dropping dcn is a pure re-layout — and the resumed run is
+    deterministic: two resumes from the same fenced checkpoint
+    produce the same loss sequence.  Runs on the 8 forced CPU
+    devices conftest provides."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from dcos_commons_tpu.models import (
+        config_from_env,
+        init_params,
+        make_train_step,
+    )
+    from dcos_commons_tpu.parallel.mesh import (
+        MeshSpec,
+        elastic_reshard_ok,
+        make_mesh,
+    )
+    from dcos_commons_tpu.utils import (
+        restore_checkpoint,
+        save_checkpoint,
+        synthetic_tokens,
+    )
+
+    devices = jax.devices()
+    if len(devices) < 8:
+        pytest.skip("needs 8 forced host devices")
+    # the resize rule agrees this is a pure re-layout
+    assert elastic_reshard_ok(
+        MeshSpec(dcn=2, dp=2, tp=2), MeshSpec(dp=2, tp=2)
+    )
+
+    config = config_from_env(
+        {"D_MODEL": "32", "N_LAYERS": "1", "N_HEADS": "2",
+         "N_KV_HEADS": "2", "D_FF": "64", "VOCAB": "64",
+         "SEQ_LEN": "16"},
+        dtype=jnp.float32,
+    )
+    optimizer = optax.adamw(1e-3)
+    tokens, targets = synthetic_tokens(
+        jax.random.key(1), 8, config.max_seq, config.vocab
+    )
+
+    import tempfile
+
+    ckpt = tempfile.mkdtemp(prefix="dcn-shrink-ckpt-")
+    mesh8 = make_mesh(MeshSpec(dcn=2, dp=2, tp=2), devices=devices[:8])
+    with mesh8:
+        params = init_params(config, jax.random.key(0))
+        opt_state = optimizer.init(params)
+        step_fn = make_train_step(config, optimizer, mesh=mesh8)
+        for _ in range(3):
+            params, opt_state, _loss = step_fn(
+                params, opt_state, tokens, targets
+            )
+        state8 = {"params": params, "opt_state": opt_state}
+        save_checkpoint(ckpt, 3, state8)
+        flat8 = [np.asarray(x) for x in jax.tree.leaves(state8)]
+
+    mesh4 = make_mesh(MeshSpec(dp=2, tp=2), devices=devices[:4])
+
+    def resume(junk_seed):
+        with mesh4:
+            junk = init_params(config, jax.random.key(junk_seed))
+            like = {"params": junk, "opt_state": optimizer.init(junk)}
+            restored, step = restore_checkpoint(ckpt, like)
+            assert step == 3
+            # materialize BEFORE training: the step function may
+            # donate its inputs, invalidating the restored buffers
+            flat = [np.asarray(x) for x in jax.tree.leaves(restored)]
+            step_fn4 = make_train_step(config, optimizer, mesh=mesh4)
+            p, o = restored["params"], restored["opt_state"]
+            losses = []
+            for _ in range(3):
+                p, o, loss = step_fn4(p, o, tokens, targets)
+                losses.append(float(loss))
+            return flat, losses
+
+    flat4, losses_a = resume(7)
+    assert len(flat4) == len(flat8)
+    for a, b in zip(flat8, flat4):
+        assert np.array_equal(a, b), \
+            "dcn shrink restore must be bit-identical"
+    _flat, losses_b = resume(11)
+    assert losses_a == losses_b
+    assert all(np.isfinite(v) for v in losses_a)

@@ -368,16 +368,27 @@ def test_elastic_decision_rule_pure_properties():
     small = shrunken_pod(pod, 2)
     assert small.count == 2 and small.tpu.topology == "4x2"
     assert pod.count == 4 and pod.tpu.topology == "4x4"
-    # multi-slice gangs refuse to shrink: count couples to
-    # slices x hosts-per-slice and a naive shrink would emit a
-    # requirement no evaluator can satisfy
+    # multi-slice gangs shrink by WHOLE slices (ISSUE 20): the
+    # per-slice topology is untouched, only `slices` (the dcn axis)
+    # drops — and a target that is not a slice multiple is refused
     import dataclasses as _dc
 
     multi = _dc.replace(pod, count=8, tpu=_dc.replace(pod.tpu, slices=2))
-    assert shrunken_pod(multi, 4) is None
+    one_slice = shrunken_pod(multi, 4)
+    assert one_slice.count == 4 and one_slice.tpu.slices == 1
+    assert one_slice.tpu.topology == "4x4"  # per-slice shape untouched
+    assert shrunken_pod(multi, 3) is None   # not a slice multiple
+    assert multi.count == 8 and multi.tpu.slices == 2  # spec untouched
     # decide_resize shrinks onto divisors of the FULL size even from
     # an already-shrunk width (8 -> 4 -> 2, never 3)
     assert decide_resize(4, 8, 3, on, False).target_hosts == 2
+    # multi-slice quantum: valid widths are whole-slice multiples
+    from dcos_commons_tpu.recovery.elastic import slice_shrink_candidates
+
+    assert slice_shrink_candidates(12, 1, 4) == [8, 4]
+    assert slice_shrink_candidates(8, 5, 4) == []  # floor above 1 slice
+    assert decide_resize(8, 8, 3, on, False, host_quantum=4).target_hosts == 4
+    assert decide_resize(12, 12, 3, on, False, host_quantum=4).target_hosts == 8
 
 
 # -- HTTP surface ------------------------------------------------------
